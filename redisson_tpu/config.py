@@ -1,0 +1,153 @@
+"""Config system — parity with org/redisson/config/ (SURVEY.md §2.1 Config).
+
+The reference exposes a programmatic builder ``Config`` plus YAML/JSON
+loading (``Config.fromYAML`` via ConfigSupport,
+→ org/redisson/config/ConfigSupport.java) with per-mode sections and ~50
+tunables.  We mirror the shape: one dataclass-style ``Config`` with fluent
+setters, ``from_yaml``/``from_dict``/``to_dict``, and the north-star
+``use_tpu_sketch()`` switch that routes sketch objects through the
+``TpuCommandExecutor`` instead of the host grid.
+
+TPU-specific tunables replace netty/pool knobs (SURVEY.md §5 config row):
+batch window, max batch size, bucketing, tenant capacity, shard axis size.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+
+class TpuSketchConfig:
+    """Tunables for the TPU sketch backend (the analog of the netty/pool
+    section of BaseConfig)."""
+
+    def __init__(self):
+        self.enabled = False
+        # Coalescer (CommandBatchService-role) knobs.
+        self.batch_window_us = 200  # flush deadline
+        self.max_batch = 1 << 16  # flush size threshold
+        self.min_bucket = 256  # smallest padded batch shape
+        self.dispatch_threads = 1  # single coalescer thread (SURVEY §5 race row)
+        # Tenancy.
+        self.initial_tenants_per_class = 8  # initial rows per size-class pool
+        self.max_bloom_bits = 1 << 31
+        # Sharding: 0 → use all local devices; 1 → single-device.
+        self.num_shards = 1
+        self.platform: Optional[str] = None  # None → jax default backend
+        # HLL geometry is fixed to Redis parity (p=14) — not configurable,
+        # matching Redis server behavior.
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+    def update(self, d: dict) -> None:
+        for k, v in d.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown tpuSketch config key: {k}")
+            setattr(self, k, v)
+
+
+class Config:
+    """→ org/redisson/config/Config.java."""
+
+    def __init__(self):
+        from redisson_tpu.codecs import DEFAULT_CODEC
+
+        self.codec = DEFAULT_CODEC
+        self.threads = 4  # listener/executor pool (reference: `threads`)
+        self.lock_watchdog_timeout_ms = 30_000  # reference default 30s
+        self.retry_attempts = 3
+        self.retry_interval_ms = 1500
+        self.timeout_ms = 3000
+        self.tpu_sketch = TpuSketchConfig()
+        # Snapshot/restore (checkpoint row, SURVEY.md §5).
+        self.snapshot_dir: Optional[str] = None
+        self.snapshot_interval_s: float = 0.0  # 0 → no periodic snapshots
+
+    # -- fluent setters, mirroring the Java builder idiom ------------------
+
+    def set_codec(self, codec) -> "Config":
+        self.codec = codec
+        return self
+
+    def set_threads(self, n: int) -> "Config":
+        self.threads = n
+        return self
+
+    def use_tpu_sketch(self, **kwargs) -> "Config":
+        """Enable the TPU execution backend for sketch objects — the
+        north-star mode switch (BASELINE.json: `useTpuSketch()`)."""
+        self.tpu_sketch.enabled = True
+        self.tpu_sketch.update(kwargs)
+        return self
+
+    # -- serialization -----------------------------------------------------
+
+    _SIMPLE_KEYS = (
+        "threads",
+        "lock_watchdog_timeout_ms",
+        "retry_attempts",
+        "retry_interval_ms",
+        "timeout_ms",
+        "snapshot_dir",
+        "snapshot_interval_s",
+    )
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {k: getattr(self, k) for k in self._SIMPLE_KEYS}
+        d["codec"] = type(self.codec).__name__
+        d["tpu_sketch"] = self.tpu_sketch.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Config":
+        cfg = cls()
+        d = dict(d)
+        codec_name = d.pop("codec", None)
+        if codec_name:
+            from redisson_tpu import codecs
+
+            codec_cls = getattr(codecs, codec_name, None)
+            if codec_cls is None:
+                raise ValueError(f"unknown codec: {codec_name}")
+            try:
+                cfg.codec = codec_cls()
+            except TypeError as e:
+                raise ValueError(
+                    f"codec {codec_name} takes constructor arguments and cannot "
+                    f"be reconstructed from config; set it with set_codec()"
+                ) from e
+        tpu = d.pop("tpu_sketch", None)
+        for k, v in d.items():
+            if k not in cls._SIMPLE_KEYS:
+                raise ValueError(f"unknown config key: {k}")
+            setattr(cfg, k, v)
+        if tpu:
+            cfg.tpu_sketch.update(tpu)
+        return cfg
+
+    @classmethod
+    def from_yaml(cls, text_or_path: str) -> "Config":
+        """→ Config.fromYAML.  Accepts YAML text or a path to a file.
+        Uses PyYAML if available, else a JSON fallback (YAML superset)."""
+        import os
+
+        text = text_or_path
+        if os.path.exists(text_or_path):
+            with open(text_or_path) as f:
+                text = f.read()
+        try:
+            import yaml  # type: ignore
+
+            data = yaml.safe_load(text)
+        except ImportError:
+            data = json.loads(text)
+        return cls.from_dict(data or {})
+
+    @classmethod
+    def from_json(cls, text: str) -> "Config":
+        return cls.from_dict(json.loads(text))
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)

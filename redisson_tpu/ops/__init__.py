@@ -1,0 +1,11 @@
+"""Jittable sketch kernels + golden NumPy twins.
+
+This is L0 of the build plan (SURVEY.md §7): the device-side replacement for
+what the Redis *server* does for SETBIT/GETBIT/PFADD/PFCOUNT/BITOP — the
+reference client never implements sketch math itself (it ships commands,
+→ org/redisson/RedissonBloomFilter.java, RedissonHyperLogLog.java), so these
+kernels are new TPU-first designs, not ports.
+
+Every kernel has a NumPy golden twin in ``ops/golden.py``; property tests
+assert device-vs-golden equivalence (SURVEY.md §4's "golden CPU model").
+"""

@@ -1,0 +1,5 @@
+"""Host-side infrastructure helpers (hashing, futures, misc).
+
+Role parity with org/redisson/misc/ (promise glue, hashing, async
+semaphores) — see SURVEY.md §2.1 "Misc/infra".
+"""

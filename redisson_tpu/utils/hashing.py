@@ -1,0 +1,196 @@
+"""Batched 128-bit hashing with bit-identical NumPy / JAX twins.
+
+Role parity: org/redisson/misc/Hash.java — Redisson hashes codec-encoded
+bytes to 128 bits (HighwayHash upstream, version-dependent), then derives
+Kirsch–Mitzenmacher double-hash indexes ``index_i = (h1 + i*h2) mod m``
+(→ org/redisson/RedissonBloomFilter.java, SURVEY.md §2.2).
+
+TPU-first design choice: we use a MurmurHash3 **x86_128** variant because it
+is built entirely from 32-bit multiplies/rotates — it runs on the TPU VPU
+without 64-bit emulation, and vectorizes over a batch axis in both NumPy
+(host/golden path) and jax.numpy (device path).  Deviation from canonical
+Murmur3: zero-padded tail bytes are processed through the main block mix
+(instead of the scalar tail path) so the whole batch is one fixed-shape
+vector program; the true byte length is mixed into finalization.  The hash
+therefore differs from reference Murmur3 vectors but keeps the same mixing
+structure and uniformity — FPP parity only requires a uniform 128-bit hash
+plus the same (m, k) formulas (SURVEY.md §7 hard part #4).
+
+The NumPy and JAX implementations share one code path parameterized by the
+array namespace ``xp``; tests assert bit-identical outputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Murmur3 x86_128 block constants.
+_C1 = np.uint32(0x239B961B)
+_C2 = np.uint32(0xAB0E9789)
+_C3 = np.uint32(0x38B34AE5)
+_C4 = np.uint32(0xA1E38B93)
+# Per-lane post-mix adds.
+_N1 = np.uint32(0x561CCD1B)
+_N2 = np.uint32(0x0BCAA747)
+_N3 = np.uint32(0x96CD1C35)
+_N4 = np.uint32(0x32AC3B17)
+# fmix32 constants.
+_F1 = np.uint32(0x85EBCA6B)
+_F2 = np.uint32(0xC2B2AE35)
+
+_FIVE = np.uint32(5)
+DEFAULT_SEED = np.uint32(0x9747B28C)
+
+
+def _rotl32(x, r: int):
+    r = np.uint32(r)
+    return (x << r) | (x >> np.uint32(32 - int(r)))
+
+
+def _fmix32(h):
+    h = h ^ (h >> np.uint32(16))
+    h = h * _F1
+    h = h ^ (h >> np.uint32(13))
+    h = h * _F2
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def murmur3_x86_128(blocks, lengths, xp=np, seed=DEFAULT_SEED):
+    """Batched 128-bit hash.
+
+    Args:
+      blocks: ``uint32[B, 4*nblocks]`` little-endian 32-bit lanes of the
+        zero-padded key bytes (see ``encode_bytes_batch``).
+      lengths: ``uint32[B]`` true byte lengths (mixed into finalization).
+      xp: array namespace — ``numpy`` (golden/host) or ``jax.numpy`` (device).
+      seed: uint32 seed.
+
+    Returns:
+      Tuple ``(c0, c1, c2, c3)`` of ``uint32[B]`` — the 128-bit digest as
+      four 32-bit lanes.
+    """
+    nlanes = blocks.shape[-1]
+    if nlanes % 4 != 0:
+        raise ValueError(f"blocks last dim must be a multiple of 4, got {nlanes}")
+    shape = blocks.shape[:-1]
+    seed = np.uint32(seed)
+    h1 = xp.full(shape, seed, dtype=np.uint32)
+    h2 = xp.full(shape, seed, dtype=np.uint32)
+    h3 = xp.full(shape, seed, dtype=np.uint32)
+    h4 = xp.full(shape, seed, dtype=np.uint32)
+
+    for blk in range(nlanes // 4):
+        k1 = blocks[..., 4 * blk + 0]
+        k2 = blocks[..., 4 * blk + 1]
+        k3 = blocks[..., 4 * blk + 2]
+        k4 = blocks[..., 4 * blk + 3]
+
+        k1 = _rotl32(k1 * _C1, 15) * _C2
+        h1 = h1 ^ k1
+        h1 = _rotl32(h1, 19) + h2
+        h1 = h1 * _FIVE + _N1
+
+        k2 = _rotl32(k2 * _C2, 16) * _C3
+        h2 = h2 ^ k2
+        h2 = _rotl32(h2, 17) + h3
+        h2 = h2 * _FIVE + _N2
+
+        k3 = _rotl32(k3 * _C3, 17) * _C4
+        h3 = h3 ^ k3
+        h3 = _rotl32(h3, 15) + h4
+        h3 = h3 * _FIVE + _N3
+
+        k4 = _rotl32(k4 * _C4, 18) * _C1
+        h4 = h4 ^ k4
+        h4 = _rotl32(h4, 13) + h1
+        h4 = h4 * _FIVE + _N4
+
+    ln = lengths.astype(np.uint32)
+    h1 = h1 ^ ln
+    h2 = h2 ^ ln
+    h3 = h3 ^ ln
+    h4 = h4 ^ ln
+
+    h1 = h1 + h2 + h3 + h4
+    h2 = h2 + h1
+    h3 = h3 + h1
+    h4 = h4 + h1
+
+    h1 = _fmix32(h1)
+    h2 = _fmix32(h2)
+    h3 = _fmix32(h3)
+    h4 = _fmix32(h4)
+
+    h1 = h1 + h2 + h3 + h4
+    h2 = h2 + h1
+    h3 = h3 + h1
+    h4 = h4 + h1
+    return h1, h2, h3, h4
+
+
+def hash128_np(blocks: np.ndarray, lengths: np.ndarray, seed=DEFAULT_SEED):
+    """Host path: returns ``(H1, H2)`` as ``uint64[B]`` (two 64-bit halves).
+
+    Mirrors Hash.hash128's (h1, h2) pair used for Kirsch–Mitzenmacher
+    expansion (→ org/redisson/RedissonBloomFilter.java#hash).
+    """
+    c0, c1, c2, c3 = murmur3_x86_128(blocks, lengths, xp=np, seed=seed)
+    h1 = c0.astype(np.uint64) | (c1.astype(np.uint64) << np.uint64(32))
+    h2 = c2.astype(np.uint64) | (c3.astype(np.uint64) << np.uint64(32))
+    return h1, h2
+
+
+def km_reduce_mod(h1: np.ndarray, h2: np.ndarray, m: int):
+    """Reduce 64-bit double-hash pair mod ``m`` for device-side expansion.
+
+    The device kernel expands ``index_i = (h1m + i*h2m) mod m`` with pure
+    uint32 adds (requires ``m <= 2**31`` so ``a + b < 2**32``).  The exact
+    64-bit mod happens here on the host where uint64 is cheap.
+    """
+    if not 0 < m <= (1 << 31):
+        raise ValueError(f"m must be in (0, 2**31], got {m}")
+    mm = np.uint64(m)
+    return (h1 % mm).astype(np.uint32), (h2 % mm).astype(np.uint32)
+
+
+# --------------------------------------------------------------------------
+# Batch byte encoding: python bytes -> fixed-shape uint32 lane arrays.
+# --------------------------------------------------------------------------
+
+
+def pad_block_lanes(nbytes: int) -> int:
+    """Number of uint32 lanes after padding to a whole 16-byte block."""
+    nblocks = max(1, -(-nbytes // 16))
+    return nblocks * 4
+
+
+def encode_bytes_batch(items) -> tuple[np.ndarray, np.ndarray]:
+    """Encode a list of ``bytes`` into ``(uint32[B, L4], uint32[B])``.
+
+    Zero-pads every key to the batch-wide max whole-16-byte block count.
+    """
+    n = len(items)
+    if n == 0:
+        return np.zeros((0, 4), np.uint32), np.zeros((0,), np.uint32)
+    lengths = np.fromiter((len(b) for b in items), dtype=np.uint32, count=n)
+    lanes = pad_block_lanes(int(lengths.max()))
+    buf = np.zeros((n, lanes * 4), dtype=np.uint8)
+    for i, b in enumerate(items):
+        if b:
+            buf[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+    return buf.view("<u4"), lengths
+
+
+def encode_uint64_batch(arr) -> tuple[np.ndarray, np.ndarray]:
+    """Fast path for integer keys: ``uint64[B] -> (uint32[B, 4], 8)``.
+
+    Matches LongCodec's 8-byte little-endian encoding zero-padded into one
+    16-byte block — bit-identical to routing the same keys through
+    ``encode_bytes_batch``.
+    """
+    a = np.ascontiguousarray(arr, dtype="<u8")
+    n = a.shape[0]
+    blocks = np.zeros((n, 4), dtype=np.uint32)
+    blocks[:, :2] = a.view("<u4").reshape(n, 2)
+    return blocks, np.full((n,), 8, dtype=np.uint32)

@@ -1,0 +1,19 @@
+"""Test harness setup.
+
+Mirrors the reference's "many redis-servers on localhost" trick for testing
+distribution without a real cluster (SURVEY.md §4): we force 8 virtual CPU
+devices so every Mesh/shard_map test runs the real multi-chip code path on
+one host.  Must run before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,79 @@
+"""Hashing twins: NumPy vs JAX bit-identical; distribution sanity."""
+
+import numpy as np
+import pytest
+
+from redisson_tpu.utils import hashing
+
+
+def _random_bytes_batch(rng, n, maxlen=40):
+    return [bytes(rng.integers(0, 256, size=rng.integers(0, maxlen), dtype=np.uint8)) for _ in range(n)]
+
+
+def test_numpy_jax_twins_identical():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    blocks, lengths = hashing.encode_bytes_batch(_random_bytes_batch(rng, 257))
+    out_np = hashing.murmur3_x86_128(blocks, lengths, xp=np)
+    out_jx = hashing.murmur3_x86_128(jnp.asarray(blocks), jnp.asarray(lengths), xp=jnp)
+    for a, b in zip(out_np, out_jx):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_uint64_fast_path_matches_bytes_path():
+    keys = np.arange(1000, dtype=np.uint64) * np.uint64(2654435761)
+    fast_blocks, fast_len = hashing.encode_uint64_batch(keys)
+    slow_blocks, slow_len = hashing.encode_bytes_batch(
+        [int(k).to_bytes(8, "little") for k in keys]
+    )
+    np.testing.assert_array_equal(fast_blocks, slow_blocks)
+    np.testing.assert_array_equal(fast_len, slow_len)
+    h_fast = hashing.hash128_np(fast_blocks, fast_len)
+    h_slow = hashing.hash128_np(slow_blocks, slow_len)
+    np.testing.assert_array_equal(h_fast[0], h_slow[0])
+    np.testing.assert_array_equal(h_fast[1], h_slow[1])
+
+
+def test_hash_determinism_and_sensitivity():
+    b1, l1 = hashing.encode_bytes_batch([b"hello", b"hello", b"hellp"])
+    c = hashing.murmur3_x86_128(b1, l1)
+    assert all(int(x[0]) == int(x[1]) for x in c)
+    assert any(int(x[0]) != int(x[2]) for x in c)
+    # Length is mixed in: zero-padded prefix keys differ.
+    b2, l2 = hashing.encode_bytes_batch([b"a", b"a\x00"])
+    c2 = hashing.murmur3_x86_128(b2, l2)
+    assert any(int(x[0]) != int(x[1]) for x in c2)
+
+
+def test_uniformity_chi_squared():
+    """Low 14 bits of each lane should be uniform over 2^14 buckets."""
+    keys = np.arange(1 << 16, dtype=np.uint64)
+    blocks, lengths = hashing.encode_uint64_batch(keys)
+    c0, c1, c2, c3 = hashing.murmur3_x86_128(blocks, lengths)
+    nbuckets = 1 << 14
+    for lane in (c0, c1, c2, c3):
+        counts = np.bincount(lane & (nbuckets - 1), minlength=nbuckets)
+        expected = len(keys) / nbuckets
+        chi2 = float(((counts - expected) ** 2 / expected).sum())
+        # dof = 16383; 5-sigma band ≈ dof ± 5*sqrt(2*dof) ≈ [15478, 17288]
+        assert 14000 < chi2 < 19000, chi2
+
+
+def test_km_reduce_mod_bounds():
+    keys = np.arange(4096, dtype=np.uint64)
+    blocks, lengths = hashing.encode_uint64_batch(keys)
+    h1, h2 = hashing.hash128_np(blocks, lengths)
+    for m in (17, 9_585_059, 1 << 31):
+        h1m, h2m = hashing.km_reduce_mod(h1, h2, m)
+        assert h1m.dtype == np.uint32 and h2m.dtype == np.uint32
+        assert int(h1m.max()) < m and int(h2m.max()) < m
+    with pytest.raises(ValueError):
+        hashing.km_reduce_mod(h1, h2, (1 << 31) + 1)
+
+
+def test_empty_batch():
+    blocks, lengths = hashing.encode_bytes_batch([])
+    assert blocks.shape == (0, 4)
+    c = hashing.murmur3_x86_128(blocks, lengths)
+    assert c[0].shape == (0,)
