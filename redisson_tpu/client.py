@@ -1,0 +1,52 @@
+"""RedissonTpuClient — the entry-point facade.
+
+Parity with org/redisson/Redisson.java + org/redisson/api/RedissonClient.java
+(SURVEY.md §1 L6): ``create(Config)`` returns a client whose ``get_*``
+methods hand out name-addressed object facades.  The backend behind sketch
+objects is selected by ``Config.use_tpu_sketch()`` (TPU pools vs host golden
+models); the broader catalog (maps, locks, topics, …) is served by the host
+data grid as it lands.
+"""
+
+from __future__ import annotations
+
+from redisson_tpu.config import Config
+from redisson_tpu.objects import BitSet, BloomFilter, CountMinSketch, HyperLogLog
+from redisson_tpu.objects.base import CamelCompatMixin
+from redisson_tpu.objects.engines import HostSketchEngine, TpuSketchEngine
+
+
+class RedissonTpuClient(CamelCompatMixin):
+    def __init__(self, config: Config):
+        self.config = config
+        if config.tpu_sketch.enabled:
+            self._engine = TpuSketchEngine(config)
+        else:
+            self._engine = HostSketchEngine(config)
+        self._shutdown = False
+
+    # -- sketch objects (TPU-backed north star) ----------------------------
+
+    def get_bloom_filter(self, name: str) -> BloomFilter:
+        return BloomFilter(name, self)
+
+    def get_hyper_log_log(self, name: str) -> HyperLogLog:
+        return HyperLogLog(name, self)
+
+    def get_bit_set(self, name: str) -> BitSet:
+        return BitSet(name, self)
+
+    def get_count_min_sketch(self, name: str) -> CountMinSketch:
+        return CountMinSketch(name, self)
+
+    # -- admin -------------------------------------------------------------
+
+    def get_sketch_names(self, kind=None) -> list[str]:
+        return self._engine.names(kind)
+
+    def shutdown(self) -> None:
+        """→ Redisson#shutdown."""
+        self._shutdown = True
+
+    def is_shutdown(self) -> bool:
+        return self._shutdown

@@ -1,0 +1,19 @@
+"""Execution engine: the L1 boundary of the build plan (SURVEY.md §7).
+
+Merges the roles of Redisson's command layer (SURVEY.md §2.1):
+- ``CommandAsyncService`` (async dispatch, sync bridging, retries)
+  → org/redisson/command/CommandAsyncService.java
+- ``CommandBatchService`` (collect N ops, ship as one pipeline)
+  → org/redisson/command/CommandBatchService.java
+- ``RedisExecutor`` (per-attempt state machine)
+  → org/redisson/command/RedisExecutor.java
+
+Here the "server" is an XLA program: dispatch pads the op batch to a
+bucketed shape (bounded compile count), launches a donated-state kernel,
+and returns lazy results (the ``RFuture`` analog) that only synchronize on
+``.result()``.
+"""
+
+from redisson_tpu.executor.tpu_executor import LazyResult, TpuCommandExecutor
+
+__all__ = ["LazyResult", "TpuCommandExecutor"]

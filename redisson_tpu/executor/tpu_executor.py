@@ -1,0 +1,460 @@
+"""TpuCommandExecutor — the north-star intercept point.
+
+BASELINE.json: sketch objects "acquire a TpuCommandExecutor that intercepts
+their hash/bit-manipulation ops at the CommandAsyncService boundary,
+coalesces them via CommandBatchService, and ships the batched bit-tests and
+register-merges to a co-located JAX process".  This module is that executor:
+
+- one jit cache keyed by (opcode, pool class, state length, padded batch),
+  so steady-state traffic never recompiles;
+- op batches padded to power-of-two buckets (≥ config.min_bucket) with a
+  validity mask — padding routes to the pool's scratch slot (ops/bitops.py);
+- pool state buffers are donated to write kernels (no copy per batch);
+- results come back as ``LazyResult`` (the RFuture analog,
+  → org/redisson/api/RFuture.java): device dispatch is async, the caller
+  only blocks when reading a value.
+
+The coalescer (executor/coalescer.py) feeds multi-tenant batches through
+the same dispatch methods.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from redisson_tpu.ops import bitset as bitset_ops
+from redisson_tpu.ops import bloom as bloom_ops
+from redisson_tpu.ops import cms as cms_ops
+from redisson_tpu.ops import golden
+from redisson_tpu.ops import hll as hll_ops
+from redisson_tpu.tenancy import SizeClassPool
+
+
+class LazyResult:
+    """Async result handle (RFuture analog): holds device arrays; transfers
+    to host (and slices off padding) only on .result()."""
+
+    def __init__(self, value, n: Optional[int] = None, transform=None):
+        self._value = value
+        self._n = n
+        self._transform = transform
+        self._done = None
+
+    def result(self):
+        if self._done is None:
+            v = self._value
+            if isinstance(v, jax.Array):
+                v = np.asarray(v)
+            if self._n is not None:
+                v = v[: self._n]
+            if self._transform is not None:
+                v = self._transform(v)
+            self._done = v
+            self._value = None
+        return self._done
+
+    # concurrent.futures-ish aliases
+    def get(self):
+        return self.result()
+
+    def done(self) -> bool:
+        return self._done is not None
+
+
+def _pow2ceil(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class TpuCommandExecutor:
+    def __init__(self, config):
+        self._cfg = config.tpu_sketch
+        self._jit_cache: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    # -- state factory (injected into pools) -------------------------------
+
+    def make_state(self, n: int, dtype):
+        return jnp.zeros((n,), dtype)
+
+    # -- jit plumbing ------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        return max(self._cfg.min_bucket, _pow2ceil(max(1, n)))
+
+    def _jit(self, key: tuple, build, donate: bool):
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            with self._lock:
+                fn = self._jit_cache.get(key)
+                if fn is None:
+                    fn = jax.jit(build(), donate_argnums=(0,) if donate else ())
+                    self._jit_cache[key] = fn
+        return fn
+
+    @staticmethod
+    def _pad(arr: np.ndarray, n_pad: int, fill=0):
+        out = np.full((n_pad,), fill, dtype=arr.dtype)
+        out[: arr.shape[0]] = arr
+        return out
+
+    def _pad_ops(self, n_pad: int, *arrays):
+        padded = [jnp.asarray(self._pad(a, n_pad)) for a in arrays]
+        valid = np.zeros(n_pad, bool)
+        valid[: arrays[0].shape[0]] = True
+        return padded, jnp.asarray(valid)
+
+    # -- bloom -------------------------------------------------------------
+
+    def bloom_add(self, pool: SizeClassPool, rows, m_arr, k: int, h1m, h2m) -> LazyResult:
+        B = h1m.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        key = ("bloom_add", wpr, pool.state.shape[0], Bp, k)
+
+        def build():
+            def f(state, rows, h1m, h2m, m_arr, valid):
+                return bloom_ops.bloom_add(
+                    state, rows, h1m, h2m, m=m_arr, k=k, words_per_row=wpr, valid=valid
+                )
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        # Padded m must be nonzero (mod arithmetic); 1 is harmless.
+        (rows_p, h1_p, h2_p), valid = self._pad_ops(Bp, rows, h1m, h2m)
+        m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
+        pool.state, newly = fn(pool.state, rows_p, h1_p, h2_p, m_p, valid)
+        return LazyResult(newly, B)
+
+    def bloom_contains(self, pool, rows, m_arr, k: int, h1m, h2m) -> LazyResult:
+        B = h1m.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        key = ("bloom_contains", wpr, pool.state.shape[0], Bp, k)
+
+        def build():
+            def f(state, rows, h1m, h2m, m_arr):
+                return bloom_ops.bloom_contains(
+                    state, rows, h1m, h2m, m=m_arr, k=k, words_per_row=wpr
+                )
+            return f
+
+        fn = self._jit(key, build, donate=False)
+        (rows_p, h1_p, h2_p), _ = self._pad_ops(Bp, rows, h1m, h2m)
+        m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
+        out = fn(pool.state, rows_p, h1_p, h2_p, m_p)
+        return LazyResult(out, B)
+
+    def bloom_count(self, pool, row: int, m: int, k: int) -> LazyResult:
+        wpr = pool.row_units
+        key = ("bloom_card", wpr, pool.state.shape[0])
+
+        def build():
+            def f(state, row):
+                return bloom_ops.bloom_cardinality(
+                    state, row, m=0, k=0, words_per_row=wpr
+                )
+            return f
+
+        fn = self._jit(key, build, donate=False)
+        x = fn(pool.state, row)
+
+        def finish(xv):
+            import math
+
+            xv = int(xv)
+            if xv >= m:
+                return m
+            return int(round(-m / k * math.log(1 - xv / m)))
+
+        return LazyResult(x, transform=finish)
+
+    # -- hll ---------------------------------------------------------------
+
+    def hll_add(self, pool, rows, c0, c1, c2) -> LazyResult:
+        B = c0.shape[0]
+        Bp = self._bucket(B)
+        key = ("hll_add", pool.state.shape[0], Bp)
+
+        def build():
+            def f(state, rows, c0, c1, c2, valid):
+                return hll_ops.hll_add(state, rows, c0, c1, c2, valid=valid)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        (rows_p, c0p, c1p, c2p), valid = self._pad_ops(Bp, rows, c0, c1, c2)
+        pool.state = fn(pool.state, rows_p, c0p, c1p, c2p, valid)
+        return LazyResult(True)
+
+    def hll_add_single(self, pool, row: int, c0, c1, c2) -> LazyResult:
+        """Single-tenant PFADD returning the 'changed' boolean."""
+        B = c0.shape[0]
+        Bp = self._bucket(B)
+        key = ("hll_add_single", pool.state.shape[0], Bp)
+
+        def build():
+            def f(state, row, c0, c1, c2, valid):
+                return hll_ops.hll_add_single(state, row, c0, c1, c2, valid=valid)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        (c0p, c1p, c2p), valid = self._pad_ops(Bp, c0, c1, c2)
+        pool.state, changed = fn(pool.state, row, c0p, c1p, c2p, valid)
+        return LazyResult(changed, transform=bool)
+
+    def hll_count(self, pool, row: int) -> LazyResult:
+        key = ("hll_hist", pool.state.shape[0])
+
+        def build():
+            def f(state, row):
+                return hll_ops.hll_histogram(state, row)
+            return f
+
+        fn = self._jit(key, build, donate=False)
+        hist = fn(pool.state, row)
+        return LazyResult(
+            hist, transform=lambda h: int(round(golden.ertl_estimate(h)))
+        )
+
+    def hll_merge(self, pool, dst_row: int, src_rows) -> LazyResult:
+        S = len(src_rows)
+        key = ("hll_merge", pool.state.shape[0], S)
+
+        def build():
+            def f(state, dst, srcs):
+                return hll_ops.hll_merge(state, dst, srcs)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        pool.state = fn(pool.state, dst_row, jnp.asarray(np.asarray(src_rows, np.int32)))
+        return LazyResult(None)
+
+    # -- bitset ------------------------------------------------------------
+
+    def _bitset_rw(self, opname, kernel, pool, rows, idx):
+        B = idx.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        key = (opname, wpr, pool.state.shape[0], Bp)
+
+        def build():
+            def f(state, rows, idx, valid):
+                return kernel(state, rows, idx, words_per_row=wpr, valid=valid)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        (rows_p, idx_p), valid = self._pad_ops(Bp, rows, idx)
+        pool.state, prev = fn(pool.state, rows_p, idx_p, valid)
+        return LazyResult(prev, B)
+
+    def bitset_set(self, pool, rows, idx) -> LazyResult:
+        return self._bitset_rw("bs_set", bitset_ops.bitset_set, pool, rows, idx)
+
+    def bitset_clear_bits(self, pool, rows, idx) -> LazyResult:
+        return self._bitset_rw("bs_clear", bitset_ops.bitset_clear, pool, rows, idx)
+
+    def bitset_flip(self, pool, rows, idx) -> LazyResult:
+        return self._bitset_rw("bs_flip", bitset_ops.bitset_flip, pool, rows, idx)
+
+    def bitset_get(self, pool, rows, idx) -> LazyResult:
+        B = idx.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        key = ("bs_get", wpr, pool.state.shape[0], Bp)
+
+        def build():
+            def f(state, rows, idx):
+                return bitset_ops.bitset_get(state, rows, idx, words_per_row=wpr)
+            return f
+
+        fn = self._jit(key, build, donate=False)
+        (rows_p, idx_p), _ = self._pad_ops(Bp, rows, idx)
+        out = fn(pool.state, rows_p, idx_p)
+        return LazyResult(out, B)
+
+    def bitset_set_range(self, pool, row: int, from_bit: int, to_bit: int, value: bool) -> LazyResult:
+        wpr = pool.row_units
+        key = ("bs_setrange", wpr, pool.state.shape[0], bool(value))
+
+        def build():
+            def f(state, row, fb, tb):
+                return bitset_ops.bitset_set_range(
+                    state, row, fb, tb, words_per_row=wpr, value=value
+                )
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        pool.state = fn(pool.state, row, from_bit, to_bit)
+        return LazyResult(None)
+
+    def _bitset_row_scalar(self, opname, kernel, pool, row):
+        wpr = pool.row_units
+        key = (opname, wpr, pool.state.shape[0])
+
+        def build():
+            def f(state, row):
+                return kernel(state, row, words_per_row=wpr)
+            return f
+
+        fn = self._jit(key, build, donate=False)
+        return LazyResult(fn(pool.state, row), transform=int)
+
+    def bitset_cardinality(self, pool, row) -> LazyResult:
+        return self._bitset_row_scalar(
+            "bs_card", bitset_ops.bitset_cardinality, pool, row
+        )
+
+    def bitset_length(self, pool, row) -> LazyResult:
+        return self._bitset_row_scalar("bs_len", bitset_ops.bitset_length, pool, row)
+
+    def bitset_bitpos(self, pool, row, target_bit: int) -> LazyResult:
+        wpr = pool.row_units
+        key = ("bs_pos", wpr, pool.state.shape[0], target_bit)
+
+        def build():
+            def f(state, row):
+                return bitset_ops.bitset_bitpos(
+                    state, row, words_per_row=wpr, target_bit=target_bit
+                )
+            return f
+
+        fn = self._jit(key, build, donate=False)
+        return LazyResult(fn(pool.state, row), transform=int)
+
+    def bitset_bitop(self, pool, dst_row: int, src_rows, op: str) -> LazyResult:
+        wpr = pool.row_units
+        S = len(src_rows)
+        key = ("bs_bitop", wpr, pool.state.shape[0], S, op)
+
+        def build():
+            def f(state, dst, srcs):
+                return bitset_ops.bitset_bitop_rows(
+                    state, dst, srcs, words_per_row=wpr, op=op, n_src=S
+                )
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        pool.state = fn(
+            pool.state, dst_row, jnp.asarray(np.asarray(src_rows, np.int32))
+        )
+        return LazyResult(None)
+
+    def bitset_get_row(self, pool, row) -> LazyResult:
+        wpr = pool.row_units
+        key = ("bs_getrow", wpr, pool.state.shape[0])
+
+        def build():
+            def f(state, row):
+                return bitset_ops.bitset_get_row(state, row, words_per_row=wpr)
+            return f
+
+        fn = self._jit(key, build, donate=False)
+        return LazyResult(fn(pool.state, row))
+
+    # -- cms ---------------------------------------------------------------
+
+    def cms_update(self, pool, rows, h1w, h2w, weights, d: int, w: int) -> LazyResult:
+        B = h1w.shape[0]
+        Bp = self._bucket(B)
+        key = ("cms_upd", pool.state.shape[0], Bp, d, w)
+
+        def build():
+            def f(state, rows, h1w, h2w, weights):
+                return cms_ops.cms_update(state, rows, h1w, h2w, weights, d=d, w=w)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        # Padded weights are 0 → scatter-add no-ops; no scratch needed.
+        (rows_p, h1p, h2p, w_p), _ = self._pad_ops(Bp, rows, h1w, h2w, weights)
+        pool.state = fn(pool.state, rows_p, h1p, h2p, w_p)
+        return LazyResult(None)
+
+    def cms_estimate(self, pool, rows, h1w, h2w, d: int, w: int) -> LazyResult:
+        B = h1w.shape[0]
+        Bp = self._bucket(B)
+        key = ("cms_est", pool.state.shape[0], Bp, d, w)
+
+        def build():
+            def f(state, rows, h1w, h2w):
+                return cms_ops.cms_estimate(state, rows, h1w, h2w, d=d, w=w)
+            return f
+
+        fn = self._jit(key, build, donate=False)
+        (rows_p, h1p, h2p), _ = self._pad_ops(Bp, rows, h1w, h2w)
+        out = fn(pool.state, rows_p, h1p, h2p)
+        return LazyResult(out, B)
+
+    def cms_update_estimate(self, pool, rows, h1w, h2w, weights, d: int, w: int) -> LazyResult:
+        B = h1w.shape[0]
+        Bp = self._bucket(B)
+        key = ("cms_updest", pool.state.shape[0], Bp, d, w)
+
+        def build():
+            def f(state, rows, h1w, h2w, weights):
+                return cms_ops.cms_update_and_estimate(
+                    state, rows, h1w, h2w, weights, d=d, w=w
+                )
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        (rows_p, h1p, h2p, w_p), _ = self._pad_ops(Bp, rows, h1w, h2w, weights)
+        pool.state, est = fn(pool.state, rows_p, h1p, h2p, w_p)
+        return LazyResult(est, B)
+
+    def cms_merge(self, pool, dst_row: int, src_rows) -> LazyResult:
+        S = len(src_rows)
+        u = pool.row_units
+        key = ("cms_merge", pool.state.shape[0], S, u)
+
+        def build():
+            def f(state, dst, srcs):
+                return cms_ops.cms_merge(state, dst, srcs, cells_per_row=u)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        pool.state = fn(
+            pool.state, dst_row, jnp.asarray(np.asarray(src_rows, np.int32))
+        )
+        return LazyResult(None)
+
+    # -- generic -----------------------------------------------------------
+
+    def zero_row(self, pool, row: int) -> None:
+        """Clear a tenant row (delete / clear() support).  Synchronous."""
+        u = pool.row_units
+        key = ("zero_row", pool.state.shape[0], u, str(pool.spec.dtype))
+
+        def build():
+            def f(state, row):
+                import jax.numpy as jnp
+                from redisson_tpu.ops import bitops
+
+                zeros = jnp.zeros((u,), state.dtype)
+                return bitops.row_update(state, row, zeros, u)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        pool.state = fn(pool.state, row)
+
+    def read_row(self, pool, row: int) -> np.ndarray:
+        """Host copy of one tenant row (migration / snapshot / dump)."""
+        u = pool.row_units
+        return np.asarray(pool.state[row * u : (row + 1) * u])
+
+    def write_row(self, pool, row: int, data: np.ndarray) -> None:
+        u = pool.row_units
+        key = ("write_row", pool.state.shape[0], u, str(pool.spec.dtype))
+
+        def build():
+            def f(state, row, data):
+                from redisson_tpu.ops import bitops
+
+                return bitops.row_update(state, row, data, u)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        pool.state = fn(pool.state, row, jnp.asarray(data))

@@ -1,0 +1,15 @@
+"""RObject layer — parity with org/redisson/api/ interfaces + the flat
+``Redisson*.java`` impls (SURVEY.md §1 L5).
+
+Sketch objects (BloomFilter, HyperLogLog, BitSet, CountMinSketch) delegate
+to a SketchEngine: the TPU engine (tenancy pools + TpuCommandExecutor) when
+``Config.use_tpu_sketch()`` is on, else the host-golden engine (the
+"Redis-backed" analog, also the honest benchmark baseline).
+"""
+
+from redisson_tpu.objects.bloom_filter import BloomFilter
+from redisson_tpu.objects.bitset import BitSet
+from redisson_tpu.objects.count_min_sketch import CountMinSketch
+from redisson_tpu.objects.hyperloglog import HyperLogLog
+
+__all__ = ["BloomFilter", "BitSet", "CountMinSketch", "HyperLogLog"]

@@ -1,0 +1,82 @@
+"""Object-layer base plumbing: RObject idiom + camelCase compatibility.
+
+→ org/redisson/RedissonObject.java (name addressing, delete/rename/exists)
+and org/redisson/api/RObject.java.  Java users call ``tryInit``/``addAll``;
+we expose snake_case Python APIs and transparently alias camelCase so the
+reference API shape survives verbatim.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from redisson_tpu.codecs import encode_batch
+from redisson_tpu.utils import hashing
+
+_CAMEL_RE = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def camel_to_snake(name: str) -> str:
+    return _CAMEL_RE.sub("_", name).lower()
+
+
+class CamelCompatMixin:
+    """bloomFilter.tryInit(...) works exactly like bloom_filter.try_init."""
+
+    def __getattr__(self, item):
+        if not item.startswith("_"):
+            snake = camel_to_snake(item)
+            if snake != item:
+                try:
+                    return object.__getattribute__(self, snake)
+                except AttributeError:
+                    pass
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {item!r}"
+        )
+
+
+class RObject(CamelCompatMixin):
+    """Name-addressed object bound to a client engine."""
+
+    KIND: str = ""
+
+    def __init__(self, name: str, client):
+        self._name = name
+        self._client = client
+        self._engine = client._engine
+        self._codec = client.config.codec
+
+    def get_name(self) -> str:
+        return self._name
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def is_exists(self) -> bool:
+        return self._engine.exists(self._name)
+
+    def delete(self) -> bool:
+        return self._engine.delete(self._name)
+
+    def rename(self, new_name: str) -> None:
+        self._engine.rename(self._name, new_name)
+        self._name = new_name
+
+    # -- hashing helpers shared by sketch objects --------------------------
+
+    def _encode(self, objs) -> tuple[np.ndarray, np.ndarray]:
+        if np.isscalar(objs) or isinstance(objs, (str, bytes)):
+            objs = [objs]
+        return encode_batch(self._codec, objs)
+
+    def _hash_lanes(self, objs):
+        blocks, lengths = self._encode(objs)
+        return hashing.murmur3_x86_128(blocks, lengths)
+
+    def _hash128(self, objs):
+        blocks, lengths = self._encode(objs)
+        return hashing.hash128_np(blocks, lengths)

@@ -1,0 +1,85 @@
+"""BloomFilter — parity with org/redisson/api/RBloomFilter.java /
+org/redisson/RedissonBloomFilter.java (SURVEY.md §2.2).
+
+Same public shape (tryInit/add/contains/count/getSize/...), same (m, k)
+formulas, same Kirsch–Mitzenmacher index math — but add/contains ship one
+vectorized device batch instead of k SETBIT/GETBIT commands per key.
+camelCase aliases work via CamelCompatMixin (``bf.tryInit(...)``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from redisson_tpu.objects.base import RObject
+from redisson_tpu.tenancy import PoolKind
+
+
+class BloomFilter(RObject):
+    KIND = PoolKind.BLOOM
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def try_init(self, expected_insertions: int, false_probability: float) -> bool:
+        """→ RBloomFilter#tryInit: returns False if already initialized."""
+        return self._engine.bloom_try_init(
+            self._name, expected_insertions, false_probability
+        )
+
+    def _params(self) -> dict:
+        p = self._engine.params(self._name)
+        if p is None:
+            raise RuntimeError(f"bloom filter {self._name!r} is not initialized")
+        return p
+
+    def get_size(self) -> int:
+        """→ RBloomFilter#getSize (bit count m)."""
+        return self._params()["size"]
+
+    def get_hash_iterations(self) -> int:
+        return self._params()["hash_iterations"]
+
+    def get_expected_insertions(self) -> int:
+        return self._params()["expected_insertions"]
+
+    def get_false_probability(self) -> float:
+        return self._params()["false_probability"]
+
+    # -- data path ---------------------------------------------------------
+
+    def add(self, obj) -> bool:
+        """→ RBloomFilter#add(T): True iff at least one bit was newly set."""
+        return bool(self.add_async(obj).result()[0])
+
+    def add_all(self, objs) -> int:
+        """→ RBloomFilter#add(Collection): number of newly-added elements."""
+        return int(np.sum(self.add_all_async(objs).result()))
+
+    def add_all_async(self, objs):
+        H1, H2 = self._hash128(objs)
+        return self._engine.bloom_add(self._name, H1, H2)
+
+    add_async = add_all_async
+
+    def contains(self, obj) -> bool:
+        return bool(self.contains_async(obj).result()[0])
+
+    def contains_all(self, objs) -> int:
+        """→ RBloomFilter#contains(Collection): how many are (probably)
+        present."""
+        return int(np.sum(self.contains_each(objs)))
+
+    def contains_each(self, objs) -> np.ndarray:
+        """Vectorized membership: bool per input (TPU-native extension used
+        by the benchmark harness)."""
+        return self.contains_all_async(objs).result()
+
+    def contains_all_async(self, objs):
+        H1, H2 = self._hash128(objs)
+        return self._engine.bloom_contains(self._name, H1, H2)
+
+    contains_async = contains_all_async
+
+    def count(self) -> int:
+        """→ RBloomFilter#count: estimated number of inserted elements."""
+        return int(self._engine.bloom_count(self._name).result())

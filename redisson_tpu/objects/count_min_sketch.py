@@ -1,0 +1,106 @@
+"""CountMinSketch — the NEW RObject (no reference counterpart;
+BASELINE.json requires it with the RObject idiom: tryInit/add/estimate/topK,
+name-addressed, codec-encoded keys — SURVEY.md §2.2).
+
+Geometry: depth d × width w counters per tenant; point estimates are the
+classic min-over-rows upper bound.  A host-side top-K tracker consumes the
+post-update estimates that ride back with each add batch (the streaming
+heavy-hitter path of benchmark config 5).
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+
+import numpy as np
+
+from redisson_tpu.objects.base import RObject
+from redisson_tpu.tenancy import PoolKind
+
+
+class CountMinSketch(RObject):
+    KIND = PoolKind.CMS
+
+    def __init__(self, name, client):
+        super().__init__(name, client)
+        self._topk: dict = {}
+        self._track = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def try_init(self, depth: int, width: int, track_top_k: int = 0) -> bool:
+        """Create with explicit geometry.  ``track_top_k``: keep a live
+        top-K candidate table updated on every add."""
+        created = self._engine.cms_try_init(self._name, int(depth), int(width))
+        if created or track_top_k:
+            # A no-op tryInit (already initialized, no explicit request)
+            # must not silently disable this instance's tracker.
+            self._track = int(track_top_k)
+        return created
+
+    def try_init_by_error(
+        self, epsilon: float, confidence: float, track_top_k: int = 0
+    ) -> bool:
+        """Standard CMS sizing: w = ceil(e/eps), d = ceil(ln(1/(1-conf)))."""
+        w = math.ceil(math.e / epsilon)
+        d = max(1, math.ceil(math.log(1.0 / (1.0 - confidence))))
+        return self.try_init(d, w, track_top_k)
+
+    def _params(self) -> dict:
+        p = self._engine.params(self._name)
+        if p is None:
+            raise RuntimeError(f"count-min sketch {self._name!r} is not initialized")
+        return p
+
+    def get_depth(self) -> int:
+        return self._params()["depth"]
+
+    def get_width(self) -> int:
+        return self._params()["width"]
+
+    # -- data path ---------------------------------------------------------
+
+    def add(self, obj, count: int = 1) -> int:
+        """Add and return the post-update estimate for obj."""
+        return int(self.add_all([obj], [count])[0])
+
+    def add_all(self, objs, counts=None) -> np.ndarray:
+        res = self.add_all_async(objs, counts).result()
+        if self._track:
+            self._update_topk(objs, res)
+        return res
+
+    def add_all_async(self, objs, counts=None):
+        H1, H2 = self._hash128(objs)
+        if counts is None:
+            counts = np.ones(len(H1), np.uint32)
+        return self._engine.cms_add(self._name, H1, H2, np.asarray(counts, np.uint32))
+
+    def estimate(self, obj) -> int:
+        return int(self.estimate_all(np.atleast_1d(obj) if not isinstance(obj, (str, bytes)) else [obj])[0])
+
+    def estimate_all(self, objs) -> np.ndarray:
+        H1, H2 = self._hash128(objs)
+        return self._engine.cms_estimate(self._name, H1, H2).result()
+
+    def merge(self, *other_names: str) -> None:
+        self._engine.cms_merge(self._name, other_names)
+
+    # -- top-K tracking ----------------------------------------------------
+
+    def _update_topk(self, objs, estimates) -> None:
+        if isinstance(objs, np.ndarray):
+            objs = objs.tolist()
+        for o, e in zip(objs, estimates):
+            self._topk[o] = int(e)
+        if len(self._topk) > 4 * max(self._track, 16):
+            keep = heapq.nlargest(
+                2 * self._track, self._topk.items(), key=lambda kv: kv[1]
+            )
+            self._topk = dict(keep)
+
+    def top_k(self, k: int | None = None):
+        """[(key, estimated_count)] heaviest-first among tracked candidates."""
+        k = k or self._track
+        return heapq.nlargest(k, self._topk.items(), key=lambda kv: kv[1])

@@ -1,0 +1,601 @@
+"""Sketch engines: the backend behind BloomFilter/HyperLogLog/BitSet/CMS.
+
+Two implementations of one interface, selected by
+``Config.use_tpu_sketch()`` — the north-star mode switch:
+
+- ``TpuSketchEngine``: tenant registry + size-class device pools +
+  TpuCommandExecutor (stacked arrays, batched kernels).
+- ``HostSketchEngine``: the golden NumPy models, playing the role the Redis
+  server plays for the reference (→ SURVEY.md §2.2: the sketch math the
+  client never implements).  It is also the honest comparison baseline for
+  the benchmark configs.
+
+Both consume identical host-side hash material (the object layer hashes
+once with the shared murmur twins), so FPP/estimates agree bit-for-bit
+between modes — the ≤2% FPP-drift gate reduces to kernel correctness.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Optional
+
+import numpy as np
+
+from redisson_tpu.executor import LazyResult, TpuCommandExecutor
+from redisson_tpu.ops import golden
+from redisson_tpu.tenancy import PoolKind, TenantRegistry
+from redisson_tpu.tenancy.registry import class_words_for_bits
+from redisson_tpu.utils import hashing
+
+
+class ImmediateResult(LazyResult):
+    """Host-engine results are already materialized."""
+
+    def __init__(self, value):
+        super().__init__(value)
+
+
+class TpuSketchEngine:
+    def __init__(self, config):
+        self.config = config
+        self.executor = TpuCommandExecutor(config)
+        self.registry = TenantRegistry(
+            self.executor.make_state,
+            initial_capacity=config.tpu_sketch.initial_tenants_per_class,
+        )
+
+    # -- generic -----------------------------------------------------------
+
+    def exists(self, name: str) -> bool:
+        return self.registry.lookup(name) is not None
+
+    def delete(self, name: str) -> bool:
+        entry = self.registry.lookup(name)
+        if entry is None:
+            return False
+        self.executor.zero_row(entry.pool, entry.row)
+        self.registry.delete(name)
+        return True
+
+    def rename(self, old: str, new: str) -> bool:
+        if old == new or self.registry.lookup(old) is None:
+            return False
+        dest = self.registry.lookup(new)
+        if dest is not None:
+            self.executor.zero_row(dest.pool, dest.row)
+        return self.registry.rename(old, new)
+
+    def names(self, kind=None):
+        return self.registry.names(kind)
+
+    def params(self, name: str) -> Optional[dict]:
+        entry = self.registry.lookup(name)
+        return None if entry is None else entry.params
+
+    def _require(self, name: str, kind: str):
+        entry = self._lookup_kind(name, kind)
+        if entry is None:
+            raise RuntimeError(f"{kind} object {name!r} is not initialized")
+        return entry
+
+    def _lookup_kind(self, name: str, kind: str):
+        """None if absent; TypeError (WRONGTYPE analog) on kind mismatch."""
+        entry = self.registry.lookup(name)
+        if entry is not None and entry.kind != kind:
+            raise TypeError(f"object {name!r} holds a {entry.kind}, not a {kind}")
+        return entry
+
+    # -- bloom -------------------------------------------------------------
+
+    def bloom_try_init(self, name, expected_insertions, false_probability) -> bool:
+        m = golden.optimal_num_of_bits(expected_insertions, false_probability)
+        k = golden.optimal_num_of_hash_functions(expected_insertions, m)
+        params = {
+            "size": m,
+            "hash_iterations": k,
+            "expected_insertions": expected_insertions,
+            "false_probability": false_probability,
+        }
+        _, created = self.registry.try_create(
+            name, PoolKind.BLOOM, (class_words_for_bits(m),), params
+        )
+        return created
+
+    def _bloom_reduce(self, entry, H1, H2):
+        m = entry.params["size"]
+        return hashing.km_reduce_mod(H1, H2, m)
+
+    def bloom_add(self, name, H1, H2) -> LazyResult:
+        entry = self._require(name, PoolKind.BLOOM)
+        h1m, h2m = self._bloom_reduce(entry, H1, H2)
+        rows = np.full(len(H1), entry.row, np.int32)
+        m_arr = np.full(len(H1), entry.params["size"], np.uint32)
+        return self.executor.bloom_add(
+            entry.pool, rows, m_arr, entry.params["hash_iterations"], h1m, h2m
+        )
+
+    def bloom_contains(self, name, H1, H2) -> LazyResult:
+        entry = self._require(name, PoolKind.BLOOM)
+        h1m, h2m = self._bloom_reduce(entry, H1, H2)
+        rows = np.full(len(H1), entry.row, np.int32)
+        m_arr = np.full(len(H1), entry.params["size"], np.uint32)
+        return self.executor.bloom_contains(
+            entry.pool, rows, m_arr, entry.params["hash_iterations"], h1m, h2m
+        )
+
+    def bloom_count(self, name) -> LazyResult:
+        entry = self._require(name, PoolKind.BLOOM)
+        return self.executor.bloom_count(
+            entry.pool, entry.row, entry.params["size"], entry.params["hash_iterations"]
+        )
+
+    # -- hll ---------------------------------------------------------------
+
+    def hll_ensure(self, name):
+        entry, _ = self.registry.try_create(name, PoolKind.HLL, (), {})
+        return entry
+
+    def hll_add(self, name, c0, c1, c2) -> LazyResult:
+        entry = self.hll_ensure(name)
+        return self.executor.hll_add_single(entry.pool, entry.row, c0, c1, c2)
+
+    def hll_count(self, name) -> LazyResult:
+        entry = self._lookup_kind(name, PoolKind.HLL)
+        if entry is None:
+            return ImmediateResult(0)
+        return self.executor.hll_count(entry.pool, entry.row)
+
+    def hll_count_with(self, name, other_names) -> int:
+        """PFCOUNT over several keys = cardinality of the union: merge
+        histogram-side via max of registers without mutating state."""
+        entries = [self._lookup_kind(n, PoolKind.HLL) for n in (name, *other_names)]
+        entries = [e for e in entries if e is not None]
+        if not entries:
+            return 0
+        # All HLL tenants share one pool; union via host max of rows is
+        # small (16KB/row) — fine for a count call.
+        regs = None
+        for e in entries:
+            r = self.executor.read_row(e.pool, e.row)
+            regs = r if regs is None else np.maximum(regs, r)
+        hist = np.bincount(regs, minlength=golden.HLL_Q + 2)
+        return int(round(golden.ertl_estimate(hist)))
+
+    def hll_merge_with(self, name, other_names) -> None:
+        entry = self.hll_ensure(name)
+        srcs = []
+        for n in other_names:
+            e = self._lookup_kind(n, PoolKind.HLL)
+            if e is not None:
+                srcs.append(e.row)
+        if srcs:
+            self.executor.hll_merge(entry.pool, entry.row, srcs)
+
+    # -- bitset ------------------------------------------------------------
+
+    def bitset_ensure(self, name, min_bits: int = 1):
+        entry, created = self.registry.try_create(
+            name, PoolKind.BITSET, (class_words_for_bits(min_bits),), {"nbits": 0}
+        )
+        if not created:
+            self._bitset_grow(entry, min_bits)
+        # Logical size tracking = Redis string-length semantics (SETBIT
+        # grows the value to cover the highest index ever touched).
+        entry.params["nbits"] = max(entry.params.get("nbits", 0), int(min_bits))
+        return entry
+
+    def _bitset_grow(self, entry, min_bits: int) -> None:
+        """Auto-grow semantics of Redis bitmaps: migrate the tenant to a
+        larger size class, copying the row through the host (rare path)."""
+        cur_words = entry.pool.row_units
+        need_words = class_words_for_bits(min_bits)
+        if need_words <= cur_words:
+            return
+        data = self.executor.read_row(entry.pool, entry.row)
+        new_pool = self.registry.pool_for(PoolKind.BITSET, (need_words,))
+        new_row = new_pool.alloc_row()
+        padded = np.zeros(need_words, dtype=np.uint32)
+        padded[: len(data)] = data
+        self.executor.write_row(new_pool, new_row, padded)
+        self.executor.zero_row(entry.pool, entry.row)
+        entry.pool.free_row(entry.row)
+        entry.pool, entry.row = new_pool, new_row
+
+    def bitset_capacity_bits(self, name) -> int:
+        entry = self._lookup_kind(name, PoolKind.BITSET)
+        return 0 if entry is None else entry.pool.row_units * 32
+
+    def bitset_set(self, name, idx, value: bool) -> LazyResult:
+        idx = np.asarray(idx, np.uint32)
+        entry = self.bitset_ensure(name, int(idx.max()) + 1 if idx.size else 1)
+        rows = np.full(len(idx), entry.row, np.int32)
+        if value:
+            return self.executor.bitset_set(entry.pool, rows, idx)
+        return self.executor.bitset_clear_bits(entry.pool, rows, idx)
+
+    def bitset_flip(self, name, idx) -> LazyResult:
+        idx = np.asarray(idx, np.uint32)
+        entry = self.bitset_ensure(name, int(idx.max()) + 1 if idx.size else 1)
+        rows = np.full(len(idx), entry.row, np.int32)
+        return self.executor.bitset_flip(entry.pool, rows, idx)
+
+    def bitset_get(self, name, idx) -> LazyResult:
+        idx = np.asarray(idx, np.uint32)
+        entry = self._lookup_kind(name, PoolKind.BITSET)
+        if entry is None:
+            return ImmediateResult(np.zeros(len(idx), bool))
+        cap = entry.pool.row_units * 32
+        in_range = idx < cap
+        safe_idx = np.where(in_range, idx, 0).astype(np.uint32)
+        rows = np.full(len(idx), entry.row, np.int32)
+        res = self.executor.bitset_get(entry.pool, rows, safe_idx)
+        return LazyResult(res._value, len(idx), transform=lambda v: v & in_range)
+
+    def bitset_set_range(self, name, from_bit, to_bit, value: bool) -> LazyResult:
+        entry = self.bitset_ensure(name, int(to_bit))
+        return self.executor.bitset_set_range(
+            entry.pool, entry.row, int(from_bit), int(to_bit), value
+        )
+
+    def bitset_cardinality(self, name) -> int:
+        entry = self._lookup_kind(name, PoolKind.BITSET)
+        if entry is None:
+            return 0
+        return self.executor.bitset_cardinality(entry.pool, entry.row).result()
+
+    def bitset_length(self, name) -> int:
+        entry = self._lookup_kind(name, PoolKind.BITSET)
+        if entry is None:
+            return 0
+        return self.executor.bitset_length(entry.pool, entry.row).result()
+
+    def bitset_bitpos(self, name, target_bit: int) -> int:
+        entry = self._lookup_kind(name, PoolKind.BITSET)
+        if entry is None:
+            return -1 if target_bit else 0
+        return self.executor.bitset_bitpos(entry.pool, entry.row, target_bit).result()
+
+    def bitset_bitop(self, dest: str, src_names, op: str) -> None:
+        """BITOP dest = op(srcs).  All operands (dest included) are grown
+        into one size class first so their rows co-reside in a single pool
+        (the TPU answer to the reference's same-slot requirement for
+        cross-key BITOP, SURVEY.md §2.2)."""
+        max_bits = max(
+            (self.bitset_capacity_bits(n) for n in (dest, *src_names)),
+            default=0,
+        ) or 32 * 32
+        dst = self.bitset_ensure(dest, max_bits)
+        srcs = []
+        nbits = dst.params.get("nbits", 0)
+        for n in src_names:
+            e = self.bitset_ensure(n, max_bits)
+            srcs.append(e.row)
+            nbits = max(nbits, e.params.get("nbits", 0))
+        self.executor.bitset_bitop(dst.pool, dst.row, srcs, op)
+        dst.params["nbits"] = nbits
+
+    def bitset_to_bytes(self, name) -> bytes:
+        """Dump trimmed to the logical length (Redis STRLEN semantics) so
+        both engines return identical bytes for the same object."""
+        entry = self._lookup_kind(name, PoolKind.BITSET)
+        if entry is None:
+            return b""
+        nbytes = -(-entry.params.get("nbits", 0) // 8)
+        return self.executor.read_row(entry.pool, entry.row).tobytes()[:nbytes]
+
+    # -- cms ---------------------------------------------------------------
+
+    def cms_try_init(self, name, depth: int, width: int) -> bool:
+        params = {"depth": depth, "width": width}
+        _, created = self.registry.try_create(
+            name, PoolKind.CMS, (depth, width), params
+        )
+        return created
+
+    def cms_add(self, name, H1, H2, weights) -> LazyResult:
+        entry = self._require(name, PoolKind.CMS)
+        d, w = entry.params["depth"], entry.params["width"]
+        h1w, h2w = hashing.km_reduce_mod(H1, H2, w)
+        rows = np.full(len(H1), entry.row, np.int32)
+        return self.executor.cms_update_estimate(
+            entry.pool, rows, h1w, h2w, np.asarray(weights, np.uint32), d, w
+        )
+
+    def cms_estimate(self, name, H1, H2) -> LazyResult:
+        entry = self._require(name, PoolKind.CMS)
+        d, w = entry.params["depth"], entry.params["width"]
+        h1w, h2w = hashing.km_reduce_mod(H1, H2, w)
+        rows = np.full(len(H1), entry.row, np.int32)
+        return self.executor.cms_estimate(entry.pool, rows, h1w, h2w, d, w)
+
+    def cms_merge(self, name, other_names) -> None:
+        entry = self._require(name, PoolKind.CMS)
+        srcs = []
+        for n in other_names:
+            e = self._require(n, PoolKind.CMS)
+            if (
+                e.params["depth"] != entry.params["depth"]
+                or e.params["width"] != entry.params["width"]
+            ):
+                raise ValueError("cannot merge CMS with different geometry")
+            srcs.append(e.row)
+        if srcs:
+            self.executor.cms_merge(entry.pool, entry.row, srcs)
+
+
+class HostSketchEngine:
+    """Golden-model backend — the 'Redis server on the host' analog and the
+    benchmark baseline.  Same hash material, same formulas."""
+
+    def __init__(self, config):
+        self.config = config
+        self._lock = threading.RLock()
+        self._objects: dict[str, dict] = {}
+
+    # -- generic -----------------------------------------------------------
+
+    def exists(self, name) -> bool:
+        with self._lock:
+            return name in self._objects
+
+    def delete(self, name) -> bool:
+        with self._lock:
+            return self._objects.pop(name, None) is not None
+
+    def rename(self, old, new) -> bool:
+        with self._lock:
+            if old == new or old not in self._objects:
+                return False
+            self._objects[new] = self._objects.pop(old)
+            return True
+
+    def names(self, kind=None):
+        with self._lock:
+            return [
+                n
+                for n, o in self._objects.items()
+                if kind is None or o["kind"] == kind
+            ]
+
+    def params(self, name):
+        with self._lock:
+            o = self._objects.get(name)
+            return None if o is None else o["params"]
+
+    def _require(self, name, kind):
+        o = self._lookup_kind(name, kind)
+        if o is None:
+            raise RuntimeError(f"{kind} object {name!r} is not initialized")
+        return o
+
+    def _lookup_kind(self, name, kind):
+        with self._lock:
+            o = self._objects.get(name)
+            if o is not None and o["kind"] != kind:
+                raise TypeError(f"object {name!r} holds a {o['kind']}, not a {kind}")
+            return o
+
+    # -- bloom -------------------------------------------------------------
+
+    def bloom_try_init(self, name, expected_insertions, false_probability) -> bool:
+        m = golden.optimal_num_of_bits(expected_insertions, false_probability)
+        k = golden.optimal_num_of_hash_functions(expected_insertions, m)
+        with self._lock:
+            if self._lookup_kind(name, PoolKind.BLOOM) is not None:
+                return False
+            self._objects[name] = {
+                "kind": PoolKind.BLOOM,
+                "model": golden.GoldenBloomFilter(m, k),
+                "params": {
+                    "size": m,
+                    "hash_iterations": k,
+                    "expected_insertions": expected_insertions,
+                    "false_probability": false_probability,
+                },
+            }
+            return True
+
+    def bloom_add(self, name, H1, H2):
+        o = self._require(name, PoolKind.BLOOM)
+        model: golden.GoldenBloomFilter = o["model"]
+        h1m, h2m = hashing.km_reduce_mod(H1, H2, model.size)
+        with self._lock:
+            return ImmediateResult(model.add_hashed(h1m, h2m))
+
+    def bloom_contains(self, name, H1, H2):
+        o = self._require(name, PoolKind.BLOOM)
+        model = o["model"]
+        h1m, h2m = hashing.km_reduce_mod(H1, H2, model.size)
+        with self._lock:
+            return ImmediateResult(model.contains_hashed(h1m, h2m))
+
+    def bloom_count(self, name):
+        o = self._require(name, PoolKind.BLOOM)
+        with self._lock:
+            return ImmediateResult(o["model"].cardinality_estimate())
+
+    # -- hll ---------------------------------------------------------------
+
+    def _hll(self, name):
+        with self._lock:
+            o = self._lookup_kind(name, PoolKind.HLL)
+            if o is None:
+                o = {
+                    "kind": PoolKind.HLL,
+                    "model": golden.GoldenHyperLogLog(),
+                    "params": {},
+                }
+                self._objects[name] = o
+            return o
+
+    def hll_add(self, name, c0, c1, c2):
+        o = self._hll(name)
+        with self._lock:
+            model = o["model"]
+            before = int(model.regs.sum())
+            model.add_hashed(c0, c1, c2)
+            return ImmediateResult(int(model.regs.sum()) != before)
+
+    def hll_count(self, name):
+        o = self._lookup_kind(name, PoolKind.HLL)
+        with self._lock:
+            return ImmediateResult(0 if o is None else o["model"].count())
+
+    def hll_count_with(self, name, other_names) -> int:
+        with self._lock:
+            regs = None
+            for n in (name, *other_names):
+                o = self._lookup_kind(n, PoolKind.HLL)
+                if o is not None:
+                    r = o["model"].regs
+                    regs = r.copy() if regs is None else np.maximum(regs, r)
+            if regs is None:
+                return 0
+            hist = np.bincount(regs, minlength=golden.HLL_Q + 2)
+            return int(round(golden.ertl_estimate(hist)))
+
+    def hll_merge_with(self, name, other_names) -> None:
+        o = self._hll(name)
+        with self._lock:
+            for n in other_names:
+                src = self._lookup_kind(n, PoolKind.HLL)
+                if src is not None:
+                    o["model"].merge(src["model"])
+
+    # -- bitset ------------------------------------------------------------
+
+    def _bitset(self, name):
+        with self._lock:
+            o = self._lookup_kind(name, PoolKind.BITSET)
+            if o is None:
+                o = {
+                    "kind": PoolKind.BITSET,
+                    "model": golden.GoldenBitSet(),
+                    "params": {},
+                }
+                self._objects[name] = o
+            return o
+
+    def bitset_capacity_bits(self, name) -> int:
+        with self._lock:
+            o = self._lookup_kind(name, PoolKind.BITSET)
+            return 0 if o is None else o["model"].bits.size
+
+    def bitset_set(self, name, idx, value: bool):
+        o = self._bitset(name)
+        with self._lock:
+            return ImmediateResult(o["model"].set(np.asarray(idx, np.int64), value))
+
+    def bitset_flip(self, name, idx):
+        o = self._bitset(name)
+        with self._lock:
+            model = o["model"]
+            idx = np.asarray(idx, np.int64)
+            model._grow(int(idx.max()) + 1 if idx.size else 1)
+            prev = np.empty(len(idx), bool)
+            for j, ix in enumerate(idx):
+                prev[j] = model.bits[ix]
+                model.bits[ix] = not model.bits[ix]
+            return ImmediateResult(prev)
+
+    def bitset_get(self, name, idx):
+        with self._lock:
+            o = self._lookup_kind(name, PoolKind.BITSET)
+            if o is None:
+                return ImmediateResult(np.zeros(len(idx), bool))
+            return ImmediateResult(o["model"].get(np.asarray(idx, np.int64)))
+
+    def bitset_set_range(self, name, from_bit, to_bit, value: bool):
+        o = self._bitset(name)
+        with self._lock:
+            model = o["model"]
+            model._grow(int(to_bit))
+            model.bits[int(from_bit) : int(to_bit)] = value
+            return ImmediateResult(None)
+
+    def bitset_cardinality(self, name) -> int:
+        with self._lock:
+            o = self._lookup_kind(name, PoolKind.BITSET)
+            return 0 if o is None else o["model"].cardinality()
+
+    def bitset_length(self, name) -> int:
+        with self._lock:
+            o = self._lookup_kind(name, PoolKind.BITSET)
+            return 0 if o is None else o["model"].length()
+
+    def bitset_bitpos(self, name, target_bit: int) -> int:
+        with self._lock:
+            o = self._lookup_kind(name, PoolKind.BITSET)
+            if o is None:
+                return -1 if target_bit else 0
+            bits = o["model"].bits
+            matches = np.nonzero(bits == bool(target_bit))[0]
+            return int(matches[0]) if matches.size else (-1 if target_bit else bits.size)
+
+    def bitset_bitop(self, dest, src_names, op: str) -> None:
+        with self._lock:
+            srcs = [self._bitset(n)["model"] for n in src_names]
+            size = max((s.bits.size for s in srcs), default=0)
+            for s in srcs:
+                s._grow(size)
+            d = self._bitset(dest)["model"]
+            d._grow(size)
+            if op == "not":
+                res = ~srcs[0].bits
+            else:
+                fn = {"and": np.logical_and, "or": np.logical_or, "xor": np.logical_xor}[op]
+                res = srcs[0].bits
+                for s in srcs[1:]:
+                    res = fn(res, s.bits)
+            d.bits[:size] = res[:size]
+
+    def bitset_to_bytes(self, name) -> bytes:
+        with self._lock:
+            o = self._lookup_kind(name, PoolKind.BITSET)
+            if o is None:
+                return b""
+            return np.packbits(o["model"].bits, bitorder="little").tobytes()
+
+    # -- cms ---------------------------------------------------------------
+
+    def cms_try_init(self, name, depth, width) -> bool:
+        with self._lock:
+            if self._lookup_kind(name, PoolKind.CMS) is not None:
+                return False
+            self._objects[name] = {
+                "kind": PoolKind.CMS,
+                "model": golden.GoldenCountMinSketch(depth, width),
+                "params": {"depth": depth, "width": width},
+            }
+            return True
+
+    def cms_add(self, name, H1, H2, weights):
+        o = self._require(name, PoolKind.CMS)
+        model: golden.GoldenCountMinSketch = o["model"]
+        h1w, h2w = hashing.km_reduce_mod(H1, H2, model.width)
+        with self._lock:
+            model.add_hashed(h1w, h2w, weights)
+            return ImmediateResult(
+                model.estimate_hashed(h1w, h2w).astype(np.uint32)
+            )
+
+    def cms_estimate(self, name, H1, H2):
+        o = self._require(name, PoolKind.CMS)
+        model = o["model"]
+        h1w, h2w = hashing.km_reduce_mod(H1, H2, model.width)
+        with self._lock:
+            return ImmediateResult(model.estimate_hashed(h1w, h2w).astype(np.uint32))
+
+    def cms_merge(self, name, other_names) -> None:
+        o = self._require(name, PoolKind.CMS)
+        with self._lock:
+            for n in other_names:
+                src = self._require(n, PoolKind.CMS)
+                if (
+                    src["params"]["depth"] != o["params"]["depth"]
+                    or src["params"]["width"] != o["params"]["width"]
+                ):
+                    raise ValueError("cannot merge CMS with different geometry")
+                o["model"].merge(src["model"])

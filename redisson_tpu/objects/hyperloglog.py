@@ -1,0 +1,44 @@
+"""HyperLogLog — parity with org/redisson/api/RHyperLogLog.java /
+org/redisson/RedissonHyperLogLog.java.
+
+The reference is a thin PFADD/PFCOUNT/PFMERGE wrapper (SURVEY.md §2.2);
+here the register math runs on device (ops/hll.py) with Redis geometry
+(p=14, registers 0..51) and the Ertl estimator.
+"""
+
+from __future__ import annotations
+
+from redisson_tpu.objects.base import RObject
+from redisson_tpu.tenancy import PoolKind
+
+
+class HyperLogLog(RObject):
+    KIND = PoolKind.HLL
+
+    def add(self, obj) -> bool:
+        """→ RHyperLogLog#add: True iff the estimate changed (a register
+        grew)."""
+        return bool(self.add_async(obj).result())
+
+    def add_all(self, objs) -> bool:
+        """→ RHyperLogLog#addAll(Collection)."""
+        return bool(self.add_all_async(objs).result())
+
+    def add_all_async(self, objs):
+        c0, c1, c2, _ = self._hash_lanes(objs)
+        return self._engine.hll_add(self._name, c0, c1, c2)
+
+    add_async = add_all_async
+
+    def count(self) -> int:
+        """→ RHyperLogLog#count (PFCOUNT)."""
+        return int(self._engine.hll_count(self._name).result())
+
+    def count_with(self, *other_names: str) -> int:
+        """→ RHyperLogLog#countWith (PFCOUNT key [key ...]): union
+        cardinality without mutating any operand."""
+        return self._engine.hll_count_with(self._name, other_names)
+
+    def merge_with(self, *other_names: str) -> None:
+        """→ RHyperLogLog#mergeWith (PFMERGE)."""
+        self._engine.hll_merge_with(self._name, other_names)

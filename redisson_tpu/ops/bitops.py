@@ -1,0 +1,209 @@
+"""Core bit-level device primitives shared by Bloom/BitSet kernels.
+
+TPU-first replacement for what Redis does server-side on SETBIT/GETBIT
+(the reference client only ships those commands in a pipelined batch,
+→ org/redisson/RedissonBitSet.java, SURVEY.md §3.2): a whole batch of bit
+ops becomes ONE XLA program — gathers for reads, and a sort-based
+scatter-OR for writes.
+
+Why the sort: XLA scatter with duplicate indexes has no bitwise-OR
+combiner, and scatter-add would carry when two ops hit the same (word, bit).
+We sort ops lexicographically by (word, bit) — stable, so arrival order is
+preserved within a duplicate run — then only the *first* op of each run
+contributes its mask to a scatter-add into a zero delta buffer (distinct
+bits of one word sum to their OR), and the delta is OR-ed/AND-NOT-ed/XOR-ed
+into the bitmap.  The run structure also yields exact *sequential* result
+semantics (what value each op observed) matching one-op-at-a-time Redis
+execution — SURVEY.md §7 hard part #2.
+
+State convention: a pool of T tenant rows × W words lives as a flat
+``uint32[T*W + 1]`` array; the trailing word is a scratch slot that padded
+(invalid) ops target, so padding never perturbs run-detection for real ops
+and scatters to it are harmless.
+
+All functions here are pure and jittable; the executor layer applies
+``jax.jit`` with buffer donation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+_ONE = np.uint32(1)
+_U5 = np.uint32(5)
+_U31 = np.uint32(31)
+
+
+def expand_km_indexes(h1m: jnp.ndarray, h2m: jnp.ndarray, m, k: int):
+    """Kirsch–Mitzenmacher expansion: ``index_i = (h1 + i*h2) mod m``.
+
+    Parity with RedissonBloomFilter#hash's index loop (SURVEY.md §2.2), in
+    pure uint32: h1m, h2m are pre-reduced mod m on the host
+    (hashing.km_reduce_mod), and m <= 2**31 guarantees ``a + b`` never wraps,
+    so iterated conditional subtraction is exact.  Returns uint32[B, k].
+
+    ``m`` may be a static int or a per-op ``uint32[B]`` array — the latter
+    lets one compiled kernel serve every tenant of a size class even when
+    their exact bit counts differ (same k, same word stride).
+    """
+    if isinstance(m, (int, np.integer)):
+        if not 0 < m <= (1 << 31):
+            raise ValueError(f"m must be in (0, 2**31], got {m}")
+        m32 = np.uint32(m)
+    else:
+        m32 = m.astype(jnp.uint32)
+    idx = h1m
+    cols = [idx]
+    for _ in range(k - 1):
+        idx = idx + h2m
+        idx = jnp.where(idx >= m32, idx - m32, idx)
+        cols.append(idx)
+    return jnp.stack(cols, axis=1)
+
+
+def sort_runs(gword: jnp.ndarray, bit: jnp.ndarray):
+    """Stable lexicographic sort of ops by (word, bit).
+
+    Returns (sw, sb, sp, first, pos_in_run):
+      sw/sb: sorted word/bit arrays (uint32),
+      sp: original position of each sorted op (int32),
+      first: bool mask — first op of each (word, bit) run,
+      pos_in_run: 0-based rank of the op within its run (int32).
+    """
+    n = gword.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    sw, sb, sp = lax.sort((gword, bit, pos), num_keys=2, is_stable=True)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), (sw[1:] != sw[:-1]) | (sb[1:] != sb[:-1])]
+    )
+    run_start = lax.cummax(jnp.where(first, pos, -1))
+    return sw, sb, sp, first, pos - run_start
+
+
+def route_invalid_to_scratch(gword, valid, flat_len: int):
+    """Send padded ops to the trailing scratch word so they can't perturb
+    run-detection or results of real ops (see module docstring)."""
+    if valid is None:
+        return gword
+    return jnp.where(valid, gword, np.uint32(flat_len - 1))
+
+
+def gather_bits(flat_words: jnp.ndarray, gword: jnp.ndarray, bit: jnp.ndarray):
+    """GETBIT batch: uint32[N] of 0/1."""
+    return (flat_words[gword] >> bit) & _ONE
+
+
+def scatter_set_bits(flat_words, gword, bit):
+    """SETBIT(...,1) batch.  Returns (new_flat, prev_bit[N] in arrival order).
+
+    prev_bit has exact sequential semantics: an op observes 1 if the bit was
+    set pre-batch OR an earlier op in the batch set it.
+    """
+    sw, sb, sp, first, _ = sort_runs(gword, bit)
+    pre = gather_bits(flat_words, sw, sb)
+    delta = jnp.zeros_like(flat_words).at[sw].add((_ONE << sb) * first.astype(jnp.uint32))
+    new = flat_words | delta
+    prev_sorted = jnp.where(first, pre, _ONE)
+    prev = jnp.zeros_like(prev_sorted).at[sp].set(prev_sorted)
+    return new, prev
+
+
+def scatter_clear_bits(flat_words, gword, bit):
+    """SETBIT(...,0) batch.  Sequential prev semantics (0 after an earlier
+    clear in the same batch)."""
+    sw, sb, sp, first, _ = sort_runs(gword, bit)
+    pre = gather_bits(flat_words, sw, sb)
+    delta = jnp.zeros_like(flat_words).at[sw].add((_ONE << sb) * first.astype(jnp.uint32))
+    new = flat_words & ~delta
+    prev_sorted = jnp.where(first, pre, np.uint32(0))
+    prev = jnp.zeros_like(prev_sorted).at[sp].set(prev_sorted)
+    return new, prev
+
+
+def scatter_flip_bits(flat_words, gword, bit):
+    """Batch bit flip with parity-exact duplicate handling.
+
+    A run of d flips of the same bit nets to ``d mod 2`` flips; op j in the
+    run observes ``pre ^ (j mod 2)``.
+    """
+    sw, sb, sp, first, pos_in_run = sort_runs(gword, bit)
+    pre = gather_bits(flat_words, sw, sb)
+    nxt_first = jnp.concatenate([first[1:], jnp.ones((1,), bool)])
+    odd_run = (pos_in_run & 1) == 0  # run length parity: last element's rank
+    last_of_run = nxt_first
+    contributes = last_of_run & odd_run  # one entry per odd-length run
+    delta = jnp.zeros_like(flat_words).at[sw].add(
+        (_ONE << sb) * contributes.astype(jnp.uint32)
+    )
+    new = flat_words ^ delta
+    prev_sorted = pre ^ (pos_in_run & 1).astype(jnp.uint32)
+    prev = jnp.zeros_like(prev_sorted).at[sp].set(prev_sorted)
+    return new, prev
+
+
+def row_slice(flat_words: jnp.ndarray, row, words_per_row: int):
+    """Dynamic view of one tenant row (row may be a traced scalar)."""
+    return lax.dynamic_slice(
+        flat_words, (row * words_per_row,), (words_per_row,)
+    )
+
+
+def row_update(flat_words: jnp.ndarray, row, new_row: jnp.ndarray, words_per_row: int):
+    return lax.dynamic_update_slice(flat_words, new_row, (row * words_per_row,))
+
+
+def popcount_row(flat_words, row, words_per_row: int):
+    """BITCOUNT of one tenant row."""
+    words = row_slice(flat_words, row, words_per_row)
+    return jnp.sum(lax.population_count(words).astype(jnp.int32))
+
+
+def bit_length_row(flat_words, row, words_per_row: int):
+    """Index of highest set bit + 1 (java BitSet.length()); 0 if empty."""
+    words = row_slice(flat_words, row, words_per_row)
+    nz = words != 0
+    any_set = jnp.any(nz)
+    widx = jnp.arange(words_per_row, dtype=jnp.int32)
+    last_word = jnp.max(jnp.where(nz, widx, -1))
+    w = words[jnp.maximum(last_word, 0)]
+    msb = _U31 - lax.clz(w)  # valid only when w != 0
+    length = last_word * 32 + msb.astype(jnp.int32) + 1
+    return jnp.where(any_set, length, 0)
+
+
+def bitpos_row(flat_words, row, words_per_row: int, target_bit: int):
+    """BITPOS: index of first bit equal to ``target_bit``.
+
+    Redis semantics: no set bit → -1; no clear bit within the value →
+    the first index past it (size), never -1 for target 0.
+    """
+    words = row_slice(flat_words, row, words_per_row)
+    if target_bit == 0:
+        words = ~words
+    nz = words != 0
+    widx = jnp.arange(words_per_row, dtype=jnp.int32)
+    first_word = jnp.min(jnp.where(nz, widx, words_per_row))
+    w = words[jnp.minimum(first_word, words_per_row - 1)]
+    # Lowest set bit: count trailing zeros = 31 - clz(w & -w).
+    lsb = _U31 - lax.clz(w & (~w + _ONE))
+    pos = first_word * 32 + lsb.astype(jnp.int32)
+    none_found = np.int32(words_per_row * 32 if target_bit == 0 else -1)
+    return jnp.where(jnp.any(nz), pos, none_found)
+
+
+def range_mask_words(words_per_row: int, from_bit, to_bit):
+    """uint32[W] mask with bits [from_bit, to_bit) set (traced scalars ok)."""
+    widx = jnp.arange(words_per_row, dtype=jnp.int32)
+    base = widx * 32
+    # Per word, number of masked bits below/above.
+    lo = jnp.clip(from_bit - base, 0, 32)
+    hi = jnp.clip(to_bit - base, 0, 32)
+    full = np.uint32(0xFFFFFFFF)
+    # mask = bits [lo, hi) within the word.
+    def below(n):  # bits [0, n) set, n in [0, 32]
+        n = n.astype(jnp.uint32)
+        return jnp.where(n >= 32, full, (_ONE << n) - _ONE)
+
+    return below(hi) & ~below(lo)

@@ -1,0 +1,107 @@
+"""BitSet device kernels — semantics of org/redisson/RedissonBitSet.java
+(Redis bitmap SETBIT/GETBIT/BITCOUNT/BITPOS/BITOP/range-set) on stacked
+tenant bitmaps.
+
+Single-bit batches ride the shared sort+scatter machinery in ops/bitops.py
+(exact sequential prev-value semantics, duplicate-safe).  Range ops
+(set(from,to), clear(from,to)) are word-mask kernels — one vector op over
+the row instead of the reference's thousands of batched SETBITs
+(SURVEY.md §2.2 RBitSet row).  Cross-key BITOP AND/OR/XOR/NOT runs
+elementwise on gathered rows; its cross-shard variant lives in parallel/.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from redisson_tpu.ops import bitops
+
+
+def _flat(rows, idx, words_per_row: int):
+    gword = rows.astype(jnp.uint32) * np.uint32(words_per_row) + (idx >> np.uint32(5))
+    return gword, idx & np.uint32(31)
+
+
+def bitset_get(flat_words, rows, idx, *, words_per_row: int):
+    gw, bt = _flat(rows, idx, words_per_row)
+    return bitops.gather_bits(flat_words, gw, bt).astype(bool)
+
+
+def bitset_set(flat_words, rows, idx, *, words_per_row: int, valid=None):
+    gw, bt = _flat(rows, idx, words_per_row)
+    gw = bitops.route_invalid_to_scratch(gw, valid, flat_words.shape[0])
+    new, prev = bitops.scatter_set_bits(flat_words, gw, bt)
+    return new, prev.astype(bool)
+
+
+def bitset_clear(flat_words, rows, idx, *, words_per_row: int, valid=None):
+    gw, bt = _flat(rows, idx, words_per_row)
+    gw = bitops.route_invalid_to_scratch(gw, valid, flat_words.shape[0])
+    new, prev = bitops.scatter_clear_bits(flat_words, gw, bt)
+    return new, prev.astype(bool)
+
+
+def bitset_flip(flat_words, rows, idx, *, words_per_row: int, valid=None):
+    gw, bt = _flat(rows, idx, words_per_row)
+    gw = bitops.route_invalid_to_scratch(gw, valid, flat_words.shape[0])
+    new, prev = bitops.scatter_flip_bits(flat_words, gw, bt)
+    return new, prev.astype(bool)
+
+
+def bitset_set_range(flat_words, row, from_bit, to_bit, *, words_per_row: int, value: bool = True):
+    """set(from, to) — word-mask kernel; from/to may be traced scalars."""
+    mask = bitops.range_mask_words(words_per_row, from_bit, to_bit)
+    cur = bitops.row_slice(flat_words, row, words_per_row)
+    new_row = (cur | mask) if value else (cur & ~mask)
+    return bitops.row_update(flat_words, row, new_row, words_per_row)
+
+
+def bitset_cardinality(flat_words, row, *, words_per_row: int):
+    return bitops.popcount_row(flat_words, row, words_per_row)
+
+
+def bitset_length(flat_words, row, *, words_per_row: int):
+    return bitops.bit_length_row(flat_words, row, words_per_row)
+
+
+def bitset_bitpos(flat_words, row, *, words_per_row: int, target_bit: int):
+    return bitops.bitpos_row(flat_words, row, words_per_row, target_bit)
+
+
+def bitset_bitop(flat_words, dst_row, src_rows_words, *, words_per_row: int, op: str):
+    """BITOP dst = op(src_1, ..., src_n) — cross-key op on pre-gathered rows.
+
+    src_rows_words: uint32[S, W].  op in {and, or, xor, not}; `not` uses the
+    first source only (Redis BITOP NOT is unary).
+    """
+    if op == "and":
+        res = src_rows_words[0]
+        for i in range(1, src_rows_words.shape[0]):
+            res = res & src_rows_words[i]
+    elif op == "or":
+        res = src_rows_words[0]
+        for i in range(1, src_rows_words.shape[0]):
+            res = res | src_rows_words[i]
+    elif op == "xor":
+        res = src_rows_words[0]
+        for i in range(1, src_rows_words.shape[0]):
+            res = res ^ src_rows_words[i]
+    elif op == "not":
+        res = ~src_rows_words[0]
+    else:
+        raise ValueError(f"unknown bitop: {op}")
+    return bitops.row_update(flat_words, dst_row, res, words_per_row)
+
+
+def bitset_get_row(flat_words, row, *, words_per_row: int):
+    """Raw bitmap fetch (asBitSet()/toByteArray() analog)."""
+    return bitops.row_slice(flat_words, row, words_per_row)
+
+
+def bitset_bitop_rows(flat_words, dst_row, src_rows, *, words_per_row: int, op: str, n_src: int):
+    """BITOP with in-kernel source gather: src_rows is int32[n_src]."""
+    rows2d = flat_words[:-1].reshape(-1, words_per_row)
+    return bitset_bitop(
+        flat_words, dst_row, rows2d[src_rows], words_per_row=words_per_row, op=op
+    )

@@ -1,0 +1,67 @@
+"""Count-min sketch device kernels — the new RCountMinSketch object.
+
+Does NOT exist in the reference (SURVEY.md §2.2): BASELINE.json requires it
+as a new RObject-idiom sketch.  Geometry: per tenant, ``d`` rows × ``w``
+counters, stacked as ``uint32[T*d*w + 1]`` flat.  Update is a scatter-add
+(duplicate keys in a batch each count — add semantics need no dedup);
+estimate is a gather + min over rows.  Depth-row indexes reuse the KM
+double-hash expansion with the per-row stride, matching the standard CMS
+construction h_r(x) = (h1 + r*h2) mod w.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from redisson_tpu.ops import bitops
+
+
+def _cell_indexes(rows, h1w, h2w, *, d: int, w: int, cells_per_row: int):
+    """int32[B, d] flat cell indexes; h1w/h2w pre-reduced mod w."""
+    idx = bitops.expand_km_indexes(h1w, h2w, w, d)  # uint32[B, d]
+    depth = np.uint32(w) * jnp.arange(d, dtype=jnp.uint32)[None, :]
+    base = rows.astype(jnp.uint32)[:, None] * np.uint32(cells_per_row)
+    return (base + depth + idx).astype(jnp.int32)
+
+
+def cms_update(flat_counts, rows, h1w, h2w, weights, *, d: int, w: int):
+    """Add ``weights[B]`` (uint32, typically 1) to each key's d cells."""
+    cells = _cell_indexes(rows, h1w, h2w, d=d, w=w, cells_per_row=d * w)
+    upd = jnp.broadcast_to(weights.astype(jnp.uint32)[:, None], cells.shape)
+    return flat_counts.at[cells.reshape(-1)].add(upd.reshape(-1))
+
+
+def cms_estimate(flat_counts, rows, h1w, h2w, *, d: int, w: int):
+    """Point estimate: min over the d cells (classic CMS upper bound)."""
+    cells = _cell_indexes(rows, h1w, h2w, d=d, w=w, cells_per_row=d * w)
+    return flat_counts[cells].min(axis=1)
+
+
+def cms_update_and_estimate(flat_counts, rows, h1w, h2w, weights, *, d: int, w: int):
+    """Fused streaming step (the heavy-hitter ingest path, BASELINE config
+    5): apply updates, then return post-update estimates for the same keys —
+    the host-side top-K tracker consumes the estimates.
+    """
+    new = cms_update(flat_counts, rows, h1w, h2w, weights, d=d, w=w)
+    return new, cms_estimate(new, rows, h1w, h2w, d=d, w=w)
+
+
+def cms_merge_rows(flat_counts, dst_row, src_rows_counts, *, cells_per_row: int):
+    """Merge = elementwise sum of counter arrays (CMS is linear)."""
+    dst = bitops.row_slice(flat_counts, dst_row, cells_per_row)
+    merged = dst + src_rows_counts.sum(axis=0, dtype=jnp.uint32)
+    return bitops.row_update(flat_counts, dst_row, merged, cells_per_row)
+
+
+def cms_merge(flat_counts, dst_row, src_rows, *, cells_per_row: int):
+    """Merge with in-kernel source gather: src_rows is int32[S]."""
+    rows2d = flat_counts[:-1].reshape(-1, cells_per_row)
+    return cms_merge_rows(
+        flat_counts, dst_row, rows2d[src_rows], cells_per_row=cells_per_row
+    )
+
+
+def cms_clear_row(flat_counts, row, *, cells_per_row: int):
+    zeros = jnp.zeros((cells_per_row,), dtype=jnp.uint32)
+    return bitops.row_update(flat_counts, row, zeros, cells_per_row)

@@ -181,6 +181,38 @@ class GoldenHyperLogLog:
 # --------------------------------------------------------------------------
 
 
+class GoldenCountMinSketch:
+    """Golden CMS twin (the new RObject — no reference counterpart)."""
+
+    def __init__(self, depth: int, width: int):
+        self.depth = int(depth)
+        self.width = int(width)
+        self.counts = np.zeros((self.depth, self.width), dtype=np.uint64)
+
+    def _cells(self, h1w: np.ndarray, h2w: np.ndarray) -> np.ndarray:
+        r = np.arange(self.depth, dtype=np.uint64)
+        return (
+            h1w[:, None].astype(np.uint64) + r[None, :] * h2w[:, None].astype(np.uint64)
+        ) % np.uint64(self.width)
+
+    def add_hashed(self, h1w, h2w, weights=None) -> None:
+        cells = self._cells(h1w, h2w)
+        w = (
+            np.ones(len(h1w), np.uint64)
+            if weights is None
+            else np.asarray(weights, np.uint64)
+        )
+        for r in range(self.depth):
+            np.add.at(self.counts[r], cells[:, r], w)
+
+    def estimate_hashed(self, h1w, h2w) -> np.ndarray:
+        cells = self._cells(h1w, h2w)
+        return self.counts[np.arange(self.depth)[None, :], cells].min(axis=1)
+
+    def merge(self, other: "GoldenCountMinSketch") -> None:
+        self.counts += other.counts
+
+
 class GoldenBitSet:
     def __init__(self, nbits: int = 0):
         self.bits = np.zeros(int(nbits), dtype=bool)

@@ -1,0 +1,16 @@
+"""Multi-tenant sketch storage: size-class pools + name registry.
+
+This is L3 of the build plan (SURVEY.md §7): the TPU analog of Redis's
+keyspace for sketch objects.  Thousands of tenants' sketches live as rows of
+stacked device arrays so a mixed batch is one vectorized kernel launch
+(BASELINE.json: "multi-tenant by construction").
+"""
+
+from redisson_tpu.tenancy.registry import (
+    PoolKind,
+    SizeClassPool,
+    TenantEntry,
+    TenantRegistry,
+)
+
+__all__ = ["PoolKind", "SizeClassPool", "TenantEntry", "TenantRegistry"]

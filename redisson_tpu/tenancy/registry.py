@@ -1,0 +1,201 @@
+"""Tenant registry and size-class pools.
+
+Role parity: the registry is the analog of Redis's keyspace + the
+``{name}:config`` hash RedissonBloomFilter keeps next to each bitmap
+(→ org/redisson/RedissonBloomFilter.java tryInit/readConfig, SURVEY.md
+§2.2) — name-addressed objects with per-object parameters, honoring
+tryInit-once semantics.
+
+Heterogeneous tenant sizes (SURVEY.md §7 hard part #3) are handled with
+**size-class pools**: a bloom filter needing m bits lands in the pool whose
+per-row word count is the next power of two ≥ ceil(m/32); all tenants of a
+class share one stacked ``uint32[T*W + 1]`` device array (trailing scratch
+word, see ops/bitops.py).  Pools grow by doubling row capacity; freed rows
+are zeroed and recycled.
+
+Thread-safety: all registry mutations happen under one lock; kernels only
+see pool state through the executor's single dispatch path.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from redisson_tpu.ops.golden import HLL_M
+
+
+class PoolKind:
+    BLOOM = "bloom"
+    BITSET = "bitset"
+    HLL = "hll"
+    CMS = "cms"
+
+
+def _pow2ceil(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def class_words_for_bits(m: int) -> int:
+    """Size class for an m-bit bitmap: pow2 words ≥ ceil(m/32), min 32."""
+    return max(32, _pow2ceil(-(-m // 32)))
+
+
+@dataclass
+class PoolSpec:
+    kind: str
+    class_key: tuple  # (words,) for bloom/bitset, () for hll, (d, w) for cms
+    row_units: int  # array elements per tenant row
+    dtype: Any
+
+    @property
+    def key(self) -> tuple:
+        return (self.kind, *self.class_key)
+
+
+def spec_for(kind: str, class_key: tuple) -> PoolSpec:
+    if kind in (PoolKind.BLOOM, PoolKind.BITSET):
+        (words,) = class_key
+        return PoolSpec(kind, class_key, words, np.uint32)
+    if kind == PoolKind.HLL:
+        return PoolSpec(kind, (), HLL_M, np.uint8)
+    if kind == PoolKind.CMS:
+        d, w = class_key
+        return PoolSpec(kind, class_key, d * w, np.uint32)
+    raise ValueError(f"unknown pool kind: {kind}")
+
+
+class SizeClassPool:
+    """One stacked device array holding all tenants of a size class."""
+
+    def __init__(self, spec: PoolSpec, capacity: int, make_state):
+        self.spec = spec
+        self.capacity = capacity
+        # make_state(n_elements, dtype) -> device array; injected by the
+        # executor so this layer stays device-agnostic (host tests can pass
+        # numpy).
+        self._make_state = make_state
+        self.state = make_state(capacity * spec.row_units + 1, spec.dtype)
+        self._free: list[int] = list(range(capacity - 1, -1, -1))
+        self.generation = 0  # bumped on every growth (jit cache key part)
+
+    @property
+    def row_units(self) -> int:
+        return self.spec.row_units
+
+    def alloc_row(self) -> int:
+        if not self._free:
+            self._grow()
+        return self._free.pop()
+
+    def free_row(self, row: int) -> None:
+        # Caller (executor) must zero the row on device before recycling.
+        self._free.append(row)
+
+    def _grow(self) -> None:
+        import jax.numpy as jnp
+
+        old_cap = self.capacity
+        new_cap = old_cap * 2
+        u = self.spec.row_units
+        extra = self._make_state((new_cap - old_cap) * u + 1, self.spec.dtype)
+        # state[:-1] drops the old scratch word; extra brings the new one.
+        self.state = jnp.concatenate([self.state[:-1], extra])
+        self.capacity = new_cap
+        self.generation += 1
+        self._free.extend(range(new_cap - 1, old_cap - 1, -1))
+
+    def used_rows(self) -> int:
+        return self.capacity - len(self._free)
+
+
+@dataclass
+class TenantEntry:
+    """One named sketch object's placement + parameters (the `{name}:config`
+    analog)."""
+
+    name: str
+    kind: str
+    pool: SizeClassPool
+    row: int
+    params: dict = field(default_factory=dict)
+
+
+class TenantRegistry:
+    def __init__(self, make_state, initial_capacity: int = 8):
+        self._make_state = make_state
+        self._initial_capacity = initial_capacity
+        self._lock = threading.RLock()
+        self._tenants: dict[str, TenantEntry] = {}
+        self._pools: dict[tuple, SizeClassPool] = {}
+
+    def lookup(self, name: str) -> Optional[TenantEntry]:
+        with self._lock:
+            return self._tenants.get(name)
+
+    def pool_for(self, kind: str, class_key: tuple) -> SizeClassPool:
+        with self._lock:
+            spec = spec_for(kind, class_key)
+            pool = self._pools.get(spec.key)
+            if pool is None:
+                pool = SizeClassPool(spec, self._initial_capacity, self._make_state)
+                self._pools[spec.key] = pool
+            return pool
+
+    def try_create(self, name: str, kind: str, class_key: tuple, params: dict):
+        """tryInit semantics: create if absent → (entry, True); if present
+        → (existing, False) regardless of params (reference behavior:
+        tryInit returns false when config already exists)."""
+        with self._lock:
+            entry = self._tenants.get(name)
+            if entry is not None:
+                if entry.kind != kind:
+                    # Redis WRONGTYPE analog: a name holds one object kind.
+                    raise TypeError(
+                        f"object {name!r} holds a {entry.kind}, not a {kind}"
+                    )
+                return entry, False
+            pool = self.pool_for(kind, class_key)
+            row = pool.alloc_row()
+            entry = TenantEntry(name, kind, pool, row, dict(params))
+            self._tenants[name] = entry
+            return entry, True
+
+    def delete(self, name: str) -> Optional[TenantEntry]:
+        """Removes the tenant; caller must zero the row on device *before*
+        calling (the row is immediately reusable)."""
+        with self._lock:
+            entry = self._tenants.pop(name, None)
+            if entry is not None:
+                entry.pool.free_row(entry.row)
+            return entry
+
+    def rename(self, old: str, new: str) -> bool:
+        with self._lock:
+            entry = self._tenants.pop(old, None)
+            if entry is None:
+                return False
+            # RKeys.rename overwrites the destination (Redis RENAME).
+            dest = self._tenants.pop(new, None)
+            if dest is not None:
+                dest.pool.free_row(dest.row)
+            entry.name = new
+            self._tenants[new] = entry
+            return True
+
+    def names(self, kind: Optional[str] = None) -> list[str]:
+        with self._lock:
+            return [
+                n for n, e in self._tenants.items() if kind is None or e.kind == kind
+            ]
+
+    def pools(self) -> list[SizeClassPool]:
+        with self._lock:
+            return list(self._pools.values())
+
+    def entries(self) -> list[TenantEntry]:
+        with self._lock:
+            return list(self._tenants.values())

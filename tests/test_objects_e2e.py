@@ -1,0 +1,192 @@
+"""End-to-end public-API tests, run against BOTH engines.
+
+Mirrors the reference's integration-first strategy (SURVEY.md §4: tests
+drive the real public API against a live backend) — our two backends are
+the TPU pools and the host golden models; parametrizing over both also
+proves mode-switch parity (same results either way).
+"""
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+
+
+@pytest.fixture(params=["tpu", "host"])
+def client(request):
+    cfg = Config()
+    if request.param == "tpu":
+        cfg.use_tpu_sketch(min_bucket=64)
+    return redisson_tpu.create(cfg)
+
+
+def test_bloom_filter_e2e(client):
+    bf = client.get_bloom_filter("bf")
+    assert bf.try_init(10_000, 0.01) is True
+    assert bf.try_init(10_000, 0.01) is False  # tryInit-once semantics
+    assert bf.get_size() == 95851  # optimal m for n=1e4, p=0.01
+    assert bf.get_hash_iterations() == 7
+    assert bf.add("hello") is True
+    assert bf.add("hello") is False
+    assert bf.contains("hello") is True
+    assert bf.contains("goodbye") is False
+    keys = [f"k{i}" for i in range(5000)]
+    newly = bf.add_all(keys)
+    assert newly >= 4990  # all new (tiny chance of in-batch collision)
+    assert bf.contains_all(keys) == 5000
+    ghosts = [f"ghost{i}" for i in range(5000)]
+    fpp = bf.contains_all(ghosts) / 5000
+    assert fpp < 0.02
+    est = bf.count()
+    assert abs(est - 5001) / 5001 < 0.1
+    assert bf.is_exists()
+    assert bf.delete() is True
+    assert not bf.is_exists()
+    with pytest.raises(RuntimeError):
+        bf.add("x")
+
+
+def test_bloom_camel_case_aliases(client):
+    bf = client.get_bloom_filter("bfc")
+    assert bf.tryInit(1000, 0.03) is True
+    assert bf.getSize() == bf.get_size()
+    bf.add("a")
+    assert bf.contains("a")
+    assert client.getBloomFilter("bfc").contains("a")
+
+
+def test_hll_e2e(client):
+    h = client.get_hyper_log_log("hll")
+    assert h.add("a") is True
+    assert h.add("a") is False  # same key: no register change
+    h.add_all([f"u{i}" for i in range(30_000)])
+    c = h.count()
+    assert abs(c - 30_001) / 30_001 < 0.03
+    h2 = client.get_hyper_log_log("hll2")
+    h2.add_all([f"u{i}" for i in range(20_000, 50_000)])
+    union = h.count_with("hll2")
+    assert abs(union - 50_001) / 50_001 < 0.03
+    h.merge_with("hll2")
+    assert abs(h.count() - 50_001) / 50_001 < 0.03
+    # count_with must not have mutated h2
+    assert abs(h2.count() - 30_000) / 30_000 < 0.03
+
+
+def test_bitset_e2e(client):
+    bs = client.get_bit_set("bs")
+    assert bs.get(100) is False
+    assert bs.set(100) is False  # previous value
+    assert bs.set(100) is True
+    assert bs.get(100) is True
+    assert bs.flip(101) is True  # new value
+    assert bs.flip(101) is False
+    assert bs.clear_bit(100) is True
+    assert bs.cardinality() == 0
+    bs.set_range(10, 500)
+    assert bs.cardinality() == 490
+    assert bs.length() == 500
+    assert bs.first_set_bit() == 10
+    assert bs.first_clear_bit() == 0
+    bs.clear_range(20, 30)
+    assert bs.cardinality() == 480
+    # auto-grow
+    bs.set(100_000)
+    assert bs.get(100_000) is True
+    assert bs.cardinality() == 481
+    assert bs.length() == 100_001
+    # vectorized
+    prev = bs.set_many(np.array([7, 7, 8]))
+    assert prev.tolist() == [False, True, False]
+    vals = bs.get_many(np.array([7, 8, 9, 10**6]))
+    assert vals.tolist() == [True, True, False, False]
+
+
+def test_bitset_bitop(client):
+    a = client.get_bit_set("ba")
+    b = client.get_bit_set("bb")
+    a.set_many(np.array([1, 3, 5]))
+    b.set_many(np.array([3, 5, 7]))
+    a.and_op("bb")
+    assert sorted(np.nonzero(a.as_bit_array())[0].tolist()) == [3, 5]
+    a.or_op("bb")
+    assert sorted(np.nonzero(a.as_bit_array())[0].tolist()) == [3, 5, 7]
+    a.xor_op("bb")
+    assert a.cardinality() == 0
+
+
+def test_cms_e2e(client):
+    c = client.get_count_min_sketch("cms")
+    assert c.try_init(4, 1 << 12, track_top_k=5) is True
+    assert c.try_init(4, 1 << 12) is False
+    assert c.add("x") == 1
+    assert c.add("x") == 2
+    assert c.add("x", count=10) == 12
+    assert c.estimate("x") == 12
+    assert c.estimate("never-seen") == 0
+    # heavy hitters
+    stream = ["hot"] * 500 + [f"cold{i}" for i in range(200)]
+    rng = np.random.default_rng(1)
+    rng.shuffle(stream)
+    c.add_all(stream)
+    top = c.top_k(1)
+    assert top[0][0] == "hot" and top[0][1] >= 500
+    # merge
+    c2 = client.get_count_min_sketch("cms2")
+    c2.try_init(4, 1 << 12)
+    c2.add("x", count=5)
+    c.merge("cms2")
+    assert c.estimate("x") == 17
+    c3 = client.get_count_min_sketch("cms3")
+    c3.try_init(2, 64)
+    with pytest.raises(ValueError):
+        c3.merge("cms")
+
+
+def test_mode_parity_bloom():
+    """Same keys through both engines -> identical membership answers
+    (identical hash material + formulas), i.e. FPP drift = 0 by design."""
+    keys = [f"key:{i}" for i in range(2000)]
+    ghosts = [f"ghost:{i}" for i in range(2000)]
+    results = {}
+    for mode in ("tpu", "host"):
+        cfg = Config()
+        if mode == "tpu":
+            cfg.use_tpu_sketch(min_bucket=64)
+        cl = redisson_tpu.create(cfg)
+        bf = cl.get_bloom_filter("parity")
+        bf.try_init(2000, 0.01)
+        bf.add_all(keys)
+        results[mode] = (
+            np.asarray(bf.contains_each(keys)),
+            np.asarray(bf.contains_each(ghosts)),
+        )
+    np.testing.assert_array_equal(results["tpu"][0], results["host"][0])
+    np.testing.assert_array_equal(results["tpu"][1], results["host"][1])
+
+
+def test_tenant_pool_growth():
+    cfg = Config().use_tpu_sketch(min_bucket=64, initial_tenants_per_class=2)
+    cl = redisson_tpu.create(cfg)
+    bfs = []
+    for i in range(5):  # forces pool growth past 2 rows
+        bf = cl.get_bloom_filter(f"g{i}")
+        bf.try_init(1000, 0.01)
+        bf.add_all([f"{i}:{j}" for j in range(100)])
+        bfs.append(bf)
+    for i, bf in enumerate(bfs):
+        assert bf.contains_all([f"{i}:{j}" for j in range(100)]) == 100
+        assert bf.contains(f"{(i + 1) % 5}:0") in (True, False)  # sane
+        # cross-tenant isolation: other tenants' keys mostly absent
+        other = bf.contains_all([f"{(i + 1) % 5}:{j}" for j in range(100)])
+        assert other < 10
+
+
+def test_rename_and_keys(client):
+    bf = client.get_bloom_filter("rn1")
+    bf.try_init(100, 0.01)
+    bf.add("v")
+    bf.rename("rn2")
+    assert bf.contains("v")
+    assert not client.get_bloom_filter("rn1").is_exists()
+    assert "rn2" in client.get_sketch_names()

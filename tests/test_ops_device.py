@@ -1,0 +1,321 @@
+"""Device kernels vs golden NumPy models — the §4 'golden CPU model' gate.
+
+Runs on the CPU backend (8 virtual devices, see conftest); the same kernels
+run unmodified on the real TPU chip.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from redisson_tpu.ops import bitops, bloom, bitset, cms, golden, hll
+from redisson_tpu.utils import hashing
+
+
+def _keys_hashes(n, seed, m=None):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, 1 << 63, size=n, dtype=np.uint64)
+    blocks, lengths = hashing.encode_uint64_batch(keys)
+    if m is None:
+        return hashing.murmur3_x86_128(blocks, lengths)
+    h1, h2 = hashing.hash128_np(blocks, lengths)
+    return hashing.km_reduce_mod(h1, h2, m)
+
+
+def _pool(T, W, seed=None):
+    """Flat word pool with scratch slot, optionally random content."""
+    if seed is None:
+        return jnp.zeros((T * W + 1,), jnp.uint32)
+    rng = np.random.default_rng(seed)
+    arr = rng.integers(0, 1 << 32, size=T * W + 1, dtype=np.uint32)
+    return jnp.asarray(arr)
+
+
+class TestBloom:
+    M = 1 << 16
+    K = 7
+    W = (1 << 16) // 32
+
+    def test_add_contains_vs_golden(self):
+        T = 4
+        pool = _pool(T, self.W)
+        g = [golden.GoldenBloomFilter(self.M, self.K) for _ in range(T)]
+        rng = np.random.default_rng(5)
+        for step in range(3):
+            n = 500
+            h1m, h2m = _keys_hashes(n, 100 + step, m=self.M)
+            rows = rng.integers(0, T, size=n).astype(np.int32)
+            pool, newly = bloom.bloom_add(
+                pool, jnp.asarray(rows), jnp.asarray(h1m), jnp.asarray(h2m),
+                m=self.M, k=self.K, words_per_row=self.W,
+            )
+            # Golden: per-tenant sequential adds in arrival order.
+            newly_g = np.zeros(n, bool)
+            for t in range(T):
+                sel = rows == t
+                newly_g[sel] = g[t].add_hashed(h1m[sel], h2m[sel])
+            np.testing.assert_array_equal(np.asarray(newly), newly_g)
+            got = bloom.bloom_contains(
+                pool, jnp.asarray(rows), jnp.asarray(h1m), jnp.asarray(h2m),
+                m=self.M, k=self.K, words_per_row=self.W,
+            )
+            assert np.asarray(got).all()
+        # Unpacked bit-level equality per tenant.
+        words = np.asarray(pool)[:-1].reshape(T, self.W)
+        for t in range(T):
+            dev_bits = np.unpackbits(
+                words[t].view(np.uint8), bitorder="little"
+            ).astype(bool)
+            np.testing.assert_array_equal(dev_bits, g[t].bits)
+
+    def test_duplicate_keys_in_batch_sequential_semantics(self):
+        pool = _pool(1, self.W)
+        h1m = np.array([123, 123, 456], np.uint32)
+        h2m = np.array([77, 77, 99], np.uint32)
+        rows = jnp.zeros((3,), jnp.int32)
+        pool, newly = bloom.bloom_add(
+            pool, rows, jnp.asarray(h1m), jnp.asarray(h2m),
+            m=self.M, k=self.K, words_per_row=self.W,
+        )
+        assert np.asarray(newly).tolist() == [True, False, True]
+
+    def test_padding_mask_no_perturbation(self):
+        pool = _pool(1, self.W)
+        # One valid op plus padded ops aimed at (0,0) — the word a real op
+        # with h1m=0 would hit.
+        h1m = jnp.asarray(np.array([0, 0, 0], np.uint32))
+        h2m = jnp.asarray(np.array([1, 0, 0], np.uint32))
+        valid = jnp.asarray(np.array([True, False, False]))
+        pool2, newly = bloom.bloom_add(
+            pool, jnp.zeros((3,), jnp.int32), h1m, h2m,
+            m=self.M, k=self.K, words_per_row=self.W, valid=valid,
+        )
+        assert bool(newly[0])
+        # Only the valid op's k bits are set (k distinct bits, h2=1).
+        total = int(np.asarray(
+            bloom.bloom_cardinality(pool2, 0, m=self.M, k=self.K, words_per_row=self.W)
+        ))
+        assert total == self.K
+        # Scratch word may have been written; real words must not include
+        # bits from the padded (h1=0, h2=0) ops beyond the valid op's.
+        g = golden.GoldenBloomFilter(self.M, self.K)
+        g.add_hashed(np.array([0], np.uint32), np.array([1], np.uint32))
+        dev_bits = np.unpackbits(
+            np.asarray(pool2)[:-1].view(np.uint8), bitorder="little"
+        ).astype(bool)
+        np.testing.assert_array_equal(dev_bits, g.bits)
+
+    def test_cardinality_estimate(self):
+        n = 2000
+        m = golden.optimal_num_of_bits(n, 0.01)
+        k = golden.optimal_num_of_hash_functions(n, m)
+        W = -(-m // 32)
+        pool = _pool(1, W)
+        h1m, h2m = _keys_hashes(n, 7, m=m)
+        pool, _ = bloom.bloom_add(
+            pool, jnp.zeros((n,), jnp.int32), jnp.asarray(h1m), jnp.asarray(h2m),
+            m=m, k=k, words_per_row=W,
+        )
+        x = int(np.asarray(bloom.bloom_cardinality(pool, 0, m=m, k=k, words_per_row=W)))
+        import math
+        est = round(-m / k * math.log(1 - x / m))
+        assert abs(est - n) / n < 0.05
+
+
+class TestHll:
+    def test_rank_device_vs_golden(self):
+        c0, c1, c2, _ = _keys_hashes(4096, 11)
+        # Include edge cases: zero lanes.
+        c1 = np.concatenate([c1, np.zeros(4, np.uint32)])
+        c2 = np.concatenate([c2, np.array([0, 1 << 14, (1 << 14) - 1, 0xFFFFFFFF], np.uint32)])
+        c0 = np.concatenate([c0, np.zeros(4, np.uint32)])
+        gi, gr = golden.hll_index_rank(c0, c1, c2)
+        di, dr = hll.hll_index_rank_device(jnp.asarray(c0), jnp.asarray(c1), jnp.asarray(c2))
+        np.testing.assert_array_equal(np.asarray(di), gi.astype(np.int32))
+        np.testing.assert_array_equal(np.asarray(dr), gr)
+
+    def test_add_count_merge_vs_golden(self):
+        T = 3
+        flat = jnp.zeros((T * golden.HLL_M + 1,), jnp.uint8)
+        g = [golden.GoldenHyperLogLog() for _ in range(T)]
+        rng = np.random.default_rng(13)
+        for step in range(2):
+            n = 20000
+            c0, c1, c2, _ = _keys_hashes(n, 200 + step)
+            rows = rng.integers(0, T, size=n).astype(np.int32)
+            flat = hll.hll_add(flat, jnp.asarray(rows), jnp.asarray(c0), jnp.asarray(c1), jnp.asarray(c2))
+            for t in range(T):
+                sel = rows == t
+                g[t].add_hashed(c0[sel], c1[sel], c2[sel])
+        regs = np.asarray(flat)[:-1].reshape(T, golden.HLL_M)
+        for t in range(T):
+            np.testing.assert_array_equal(regs[t], g[t].regs)
+            hist = np.asarray(hll.hll_histogram(flat, t))
+            est = golden.ertl_estimate(hist)
+            assert int(round(est)) == g[t].count()
+        # Device-side estimator close to golden float64 one.
+        dev_est = float(np.asarray(hll.ertl_estimate_device(jnp.asarray(
+            np.asarray(hll.hll_histogram(flat, 0))))))
+        assert abs(dev_est - g[0].count()) / max(g[0].count(), 1) < 1e-3
+        # Merge rows 1,2 into 0.
+        src = jnp.asarray(regs[1:3])
+        flat = hll.hll_merge_rows(flat, 0, src)
+        g[0].merge(g[1], g[2])
+        np.testing.assert_array_equal(
+            np.asarray(flat)[: golden.HLL_M], g[0].regs
+        )
+
+    def test_histograms_all_matches_per_row(self):
+        T = 4
+        rng = np.random.default_rng(3)
+        regs2d = rng.integers(0, 52, size=(T, golden.HLL_M), dtype=np.uint8)
+        flat = jnp.concatenate([jnp.asarray(regs2d).reshape(-1), jnp.zeros((1,), jnp.uint8)])
+        all_h = np.asarray(hll.hll_histograms_all(jnp.asarray(regs2d)))
+        for t in range(T):
+            np.testing.assert_array_equal(
+                all_h[t], np.asarray(hll.hll_histogram(flat, t))
+            )
+
+
+class TestBitSet:
+    W = 64  # 2048 bits per row
+
+    def test_set_get_clear_flip_vs_golden(self):
+        T = 2
+        nbits = self.W * 32
+        pool = _pool(T, self.W)
+        g = [golden.GoldenBitSet(nbits) for _ in range(T)]
+        rng = np.random.default_rng(21)
+        for step in range(3):
+            n = 300
+            idx = rng.integers(0, nbits, size=n).astype(np.uint32)
+            rows = rng.integers(0, T, size=n).astype(np.int32)
+            pool, prev = bitset.bitset_set(
+                pool, jnp.asarray(rows), jnp.asarray(idx), words_per_row=self.W
+            )
+            prev_g = np.zeros(n, bool)
+            for t in range(T):
+                sel = rows == t
+                prev_g[sel] = g[t].set(idx[sel])
+            np.testing.assert_array_equal(np.asarray(prev), prev_g)
+        # flips with deliberate duplicates
+        idx = np.array([5, 5, 5, 9, 9], np.uint32)
+        rows = np.zeros(5, np.int32)
+        pool, prev = bitset.bitset_flip(
+            pool, jnp.asarray(rows), jnp.asarray(idx), words_per_row=self.W
+        )
+        b5, b9 = bool(g[0].bits[5]), bool(g[0].bits[9])
+        assert np.asarray(prev).tolist() == [b5, not b5, b5, b9, not b9]
+        g[0].bits[5] = not b5  # net odd flips
+        # 9 flipped twice -> unchanged
+        # clear batch
+        pool, prev = bitset.bitset_clear(
+            pool, jnp.asarray(rows[:2]), jnp.asarray(np.array([5, 5], np.uint32)),
+            words_per_row=self.W,
+        )
+        assert np.asarray(prev).tolist() == [bool(g[0].bits[5]), False]
+        g[0].bits[5] = False
+        words = np.asarray(pool)[:-1].reshape(T, self.W)
+        for t in range(T):
+            dev_bits = np.unpackbits(words[t].view(np.uint8), bitorder="little").astype(bool)
+            np.testing.assert_array_equal(dev_bits, g[t].bits)
+            assert int(np.asarray(bitset.bitset_cardinality(pool, t, words_per_row=self.W))) == g[t].cardinality()
+            assert int(np.asarray(bitset.bitset_length(pool, t, words_per_row=self.W))) == g[t].length()
+
+    def test_range_set_and_bitpos(self):
+        pool = _pool(1, self.W)
+        pool = bitset.bitset_set_range(pool, 0, 33, 1000, words_per_row=self.W)
+        card = int(np.asarray(bitset.bitset_cardinality(pool, 0, words_per_row=self.W)))
+        assert card == 1000 - 33
+        assert int(np.asarray(bitset.bitset_bitpos(pool, 0, words_per_row=self.W, target_bit=1))) == 33
+        assert int(np.asarray(bitset.bitset_bitpos(pool, 0, words_per_row=self.W, target_bit=0))) == 0
+        # clear a sub-range
+        pool = bitset.bitset_set_range(pool, 0, 100, 200, words_per_row=self.W, value=False)
+        card = int(np.asarray(bitset.bitset_cardinality(pool, 0, words_per_row=self.W)))
+        assert card == (1000 - 33) - 100
+        # full-word boundaries
+        pool2 = bitset.bitset_set_range(_pool(1, self.W), 0, 64, 128, words_per_row=self.W)
+        words = np.asarray(pool2)[:-1]
+        assert words[2] == 0xFFFFFFFF and words[3] == 0xFFFFFFFF
+        assert words[1] == 0 and words[4] == 0
+
+    def test_bitop(self):
+        pool = _pool(4, self.W, seed=9)
+        words = np.asarray(pool)[:-1].reshape(4, self.W)
+        src = jnp.asarray(words[1:3])
+        for op, fn in [("and", np.bitwise_and), ("or", np.bitwise_or), ("xor", np.bitwise_xor)]:
+            out = bitset.bitset_bitop(pool, 0, src, words_per_row=self.W, op=op)
+            np.testing.assert_array_equal(
+                np.asarray(out)[: self.W], fn(words[1], words[2])
+            )
+        out = bitset.bitset_bitop(pool, 0, src[:1], words_per_row=self.W, op="not")
+        np.testing.assert_array_equal(np.asarray(out)[: self.W], ~words[1])
+
+    def test_empty_row_length_and_bitpos(self):
+        pool = _pool(1, self.W)
+        assert int(np.asarray(bitset.bitset_length(pool, 0, words_per_row=self.W))) == 0
+        assert int(np.asarray(bitset.bitset_bitpos(pool, 0, words_per_row=self.W, target_bit=1))) == -1
+
+
+class TestCms:
+    D, Wd = 4, 1 << 12
+
+    def test_update_estimate_vs_golden(self):
+        T = 2
+        cells = self.D * self.Wd
+        flat = jnp.zeros((T * cells + 1,), jnp.uint32)
+        gold = np.zeros((T, self.D, self.Wd), np.uint64)
+        rng = np.random.default_rng(31)
+        n = 5000
+        # Zipf-ish stream with repeats
+        keys = rng.zipf(1.3, size=n).astype(np.uint64) % 500
+        blocks, lengths = hashing.encode_uint64_batch(keys)
+        h1, h2 = hashing.hash128_np(blocks, lengths)
+        h1w, h2w = hashing.km_reduce_mod(h1, h2, self.Wd)
+        rows = rng.integers(0, T, size=n).astype(np.int32)
+        w1 = np.ones(n, np.uint32)
+        flat = cms.cms_update(
+            flat, jnp.asarray(rows), jnp.asarray(h1w), jnp.asarray(h2w),
+            jnp.asarray(w1), d=self.D, w=self.Wd,
+        )
+        for r in range(self.D):
+            idx = (h1w.astype(np.uint64) + np.uint64(r) * h2w.astype(np.uint64)) % np.uint64(self.Wd)
+            np.add.at(gold, (rows, np.full(n, r), idx.astype(np.int64)), 1)
+        np.testing.assert_array_equal(
+            np.asarray(flat)[:-1].reshape(T, self.D, self.Wd), gold.astype(np.uint32)
+        )
+        est = np.asarray(cms.cms_estimate(
+            flat, jnp.asarray(rows), jnp.asarray(h1w), jnp.asarray(h2w),
+            d=self.D, w=self.Wd,
+        ))
+        gold_est = gold[rows[:, None], np.arange(self.D)[None, :],
+                        np.stack([(h1w.astype(np.uint64) + np.uint64(r) * h2w.astype(np.uint64)) % np.uint64(self.Wd)
+                                  for r in range(self.D)], axis=1).astype(np.int64)].min(axis=1)
+        np.testing.assert_array_equal(est, gold_est.astype(np.uint32))
+        # CMS guarantee: estimate >= true count; with w >> distinct keys,
+        # estimates for a key equal its true frequency almost surely.
+        true = np.bincount(keys.astype(np.int64), minlength=500)
+        per_key_est = {}
+        for i in range(n):
+            per_key_est[(rows[i], int(keys[i]))] = int(est[i])
+        for (t, kk), e in per_key_est.items():
+            tc = int(np.sum((keys == kk) & (rows == t)))
+            assert e >= tc
+
+    def test_merge_linearity(self):
+        cells = self.D * self.Wd
+        flat = jnp.zeros((2 * cells + 1,), jnp.uint32)
+        h1w = np.array([5, 9], np.uint32)
+        h2w = np.array([3, 11], np.uint32)
+        flat = cms.cms_update(flat, jnp.asarray(np.array([0, 1], np.int32)),
+                              jnp.asarray(h1w), jnp.asarray(h2w),
+                              jnp.ones((2,), jnp.uint32), d=self.D, w=self.Wd)
+        src = np.asarray(flat)[cells:2 * cells].reshape(1, cells)
+        merged = cms.cms_merge_rows(flat, 0, jnp.asarray(src), cells_per_row=cells)
+        est = np.asarray(cms.cms_estimate(
+            merged, jnp.asarray(np.array([0, 0], np.int32)),
+            jnp.asarray(h1w), jnp.asarray(h2w), d=self.D, w=self.Wd,
+        ))
+        assert est.tolist() == [1, 1]

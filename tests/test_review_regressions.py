@@ -1,0 +1,88 @@
+"""Regression tests for review findings (round 1): wrongtype guards,
+rename safety, bitop sizing, dump parity, bitpos edge, top-K via add()."""
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+
+
+@pytest.fixture(params=["tpu", "host"])
+def client(request):
+    cfg = Config()
+    if request.param == "tpu":
+        cfg.use_tpu_sketch(min_bucket=64)
+    return redisson_tpu.create(cfg)
+
+
+def test_rename_missing_source_keeps_destination(client):
+    bf = client.get_bloom_filter("dest")
+    bf.try_init(100, 0.01)
+    bf.add("v")
+    assert client._engine.rename("nonexistent", "dest") is False
+    assert bf.contains("v")  # destination untouched
+    assert client._engine.rename("dest", "dest") is False
+    assert bf.contains("v")
+
+
+def test_wrongtype_guards(client):
+    bf = client.get_bloom_filter("typed")
+    bf.try_init(100, 0.01)
+    with pytest.raises(TypeError):
+        client.get_hyper_log_log("typed").add("x")
+    with pytest.raises(TypeError):
+        client.get_hyper_log_log("typed").count()
+    with pytest.raises(TypeError):
+        client.get_bit_set("typed").set(1)
+    with pytest.raises(TypeError):
+        client.get_bit_set("typed").cardinality()
+    with pytest.raises(TypeError):
+        client.get_count_min_sketch("typed").try_init(2, 64)
+    h = client.get_hyper_log_log("reallyhll")
+    h.add("x")
+    with pytest.raises(TypeError):
+        h.count_with("typed")
+
+
+def test_bitop_with_larger_destination(client):
+    big = client.get_bit_set("bigdst")
+    big.set(5000)  # larger size class than the sources
+    big.clear_bit(5000)
+    a = client.get_bit_set("srcA")
+    b = client.get_bit_set("srcB")
+    a.set_many(np.array([1, 2]))
+    b.set_many(np.array([2, 3]))
+    client._engine.bitset_bitop("bigdst", ("srcA", "srcB"), "or")
+    arr = big.as_bit_array()
+    assert sorted(np.nonzero(arr)[0].tolist()) == [1, 2, 3]
+
+
+def test_to_byte_array_parity_between_modes():
+    dumps = {}
+    for mode in ("tpu", "host"):
+        cfg = Config()
+        if mode == "tpu":
+            cfg.use_tpu_sketch(min_bucket=64)
+        cl = redisson_tpu.create(cfg)
+        bs = cl.get_bit_set("dump")
+        bs.set(0)
+        bs.set(77)
+        dumps[mode] = bs.to_byte_array()
+    assert dumps["tpu"] == dumps["host"]
+    assert len(dumps["tpu"]) == 10  # ceil(78/8)
+
+
+def test_first_clear_bit_all_set_parity(client):
+    bs = client.get_bit_set("full")
+    bs.set_range(0, 1024)  # exactly fills the smallest size class
+    assert bs.first_clear_bit() == 1024
+
+
+def test_cms_single_add_feeds_topk(client):
+    c = client.get_count_min_sketch("cmstrk")
+    c.try_init(4, 1 << 10, track_top_k=3)
+    for _ in range(5):
+        c.add("solo")
+    top = c.top_k(1)
+    assert top and top[0] == ("solo", 5)
