@@ -1,0 +1,74 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline metric (BASELINE.json): Bloom ``contains()`` ops/sec/chip on the
+steady-state batched path through the full public API (codec encode → hash
+→ executor dispatch → device kernel → result transfer).
+
+``vs_baseline``: ratio against 1M ops/sec — the upper end of the
+single-Redis-instance context documented in BASELINE.md (the reference
+publishes no numbers; a pipelined single Redis server sustains ~0.1–1M
+simple ops/sec, and the reference client's bloom path costs k bit-ops per
+key on that server, so 1M ops/s is a *generous* stand-in baseline).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import redisson_tpu
+    from redisson_tpu import Config
+    from redisson_tpu.codecs import LongCodec
+
+    cfg = Config().set_codec(LongCodec()).use_tpu_sketch(exact_add_semantics=False)
+    client = redisson_tpu.create(cfg)
+
+    bf = client.get_bloom_filter("bench-bf")
+    bf.try_init(1_000_000, 0.01)  # BASELINE config 1 geometry
+
+    B = 1 << 16
+    n_load = 1 << 20  # 1M keys
+    # Load phase (also warms the add kernel at batch size B); async
+    # dispatches pipeline through the executor, sync only at the end.
+    adds = [
+        bf.add_all_async(np.arange(i * B, (i + 1) * B, dtype=np.uint64))
+        for i in range(n_load // B)
+    ]
+    n_added = sum(int(np.sum(r.result())) for r in adds)
+    # Unique keys, but a late key can have all k bits pre-set by earlier
+    # batches; ~0.2% expected at 50% final fill.
+    assert 0.97 * n_load <= n_added <= n_load, n_added
+
+    # Warm the contains kernel, then measure steady state.
+    bf.contains_all_async(np.arange(B, dtype=np.uint64)).result()
+    iters = 50
+    rng = np.random.default_rng(0)
+    batches = [
+        rng.integers(0, 2 * n_load, size=B).astype(np.uint64) for _ in range(iters)
+    ]
+    t0 = time.perf_counter()
+    results = [bf.contains_all_async(b) for b in batches]
+    n_hits = sum(int(np.sum(r.result())) for r in results)
+    dt = time.perf_counter() - t0
+    ops_per_sec = iters * B / dt
+
+    # Sanity: ~half the probe keys were inserted.
+    assert 0.3 < n_hits / (iters * B) < 0.7, n_hits
+
+    baseline = 1_000_000.0  # see module docstring
+    print(
+        json.dumps(
+            {
+                "metric": "bloom_contains_ops_per_sec_per_chip",
+                "value": round(ops_per_sec),
+                "unit": "ops/s",
+                "vs_baseline": round(ops_per_sec / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -31,8 +31,16 @@ class TpuSketchConfig:
         self.dispatch_threads = 1  # single coalescer thread (SURVEY §5 race row)
         # Tenancy.
         self.initial_tenants_per_class = 8  # initial rows per size-class pool
+        # Exact intra-batch sequential semantics for bloom add (sort-based
+        # kernel).  False selects the fast single-tenant add whose
+        # newly-added flags are computed vs pre-batch state (bit-level
+        # results identical; see ops/fastpath.py).
+        self.exact_add_semantics = True
         self.max_bloom_bits = 1 << 31
-        # Sharding: 0 → use all local devices; 1 → single-device.
+        # Sharding: 1 → single-device (current executor).  Values > 1 are
+        # rejected until the sharded-executor integration lands; the
+        # sharded kernels themselves live in parallel/mesh.py and are
+        # exercised by tests + the driver's dryrun_multichip.
         self.num_shards = 1
         self.platform: Optional[str] = None  # None → jax default backend
         # HLL geometry is fixed to Redis parity (p=14) — not configurable,

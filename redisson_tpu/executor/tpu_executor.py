@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from redisson_tpu.ops import bitset as bitset_ops
 from redisson_tpu.ops import bloom as bloom_ops
 from redisson_tpu.ops import cms as cms_ops
+from redisson_tpu.ops import fastpath
 from redisson_tpu.ops import golden
 from redisson_tpu.ops import hll as hll_ops
 from redisson_tpu.tenancy import SizeClassPool
@@ -44,6 +45,13 @@ class LazyResult:
         self._n = n
         self._transform = transform
         self._done = None
+        if isinstance(value, jax.Array):
+            # Start the D2H transfer immediately so .result() overlaps with
+            # subsequent dispatches (hides the per-roundtrip link latency).
+            try:
+                value.copy_to_host_async()
+            except Exception:
+                pass
 
     def result(self):
         if self._done is None:
@@ -147,6 +155,47 @@ class TpuCommandExecutor:
         (rows_p, h1_p, h2_p), _ = self._pad_ops(Bp, rows, h1m, h2m)
         m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
         out = fn(pool.state, rows_p, h1_p, h2_p, m_p)
+        return LazyResult(out, B)
+
+    def bloom_add_fast_st(self, pool, row: int, m: int, k: int, h1m, h2m) -> LazyResult:
+        """Single-tenant fast add (snapshot newly semantics, see
+        ops/fastpath.py).  row/m travel as scalars, not arrays."""
+        B = h1m.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        key = ("bloom_add_fast", wpr, pool.state.shape[0], Bp, k)
+
+        def build():
+            def f(state, row, h1m, h2m, m, valid):
+                return fastpath.bloom_add_fast_st(
+                    state, row, h1m, h2m, m, valid, k=k, words_per_row=wpr
+                )
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        (h1_p, h2_p), valid = self._pad_ops(Bp, h1m, h2m)
+        pool.state, newly = fn(
+            pool.state, np.int32(row), h1_p, h2_p, np.uint32(m), valid
+        )
+        return LazyResult(newly, B)
+
+    def bloom_contains_st(self, pool, row: int, m: int, k: int, h1m, h2m) -> LazyResult:
+        """Single-tenant contains; bit-exact, fewer transfers."""
+        B = h1m.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        key = ("bloom_contains_st", wpr, pool.state.shape[0], Bp, k)
+
+        def build():
+            def f(state, row, h1m, h2m, m):
+                return fastpath.bloom_contains_st(
+                    state, row, h1m, h2m, m, k=k, words_per_row=wpr
+                )
+            return f
+
+        fn = self._jit(key, build, donate=False)
+        (h1_p, h2_p), _ = self._pad_ops(Bp, h1m, h2m)
+        out = fn(pool.state, np.int32(row), h1_p, h2_p, np.uint32(m))
         return LazyResult(out, B)
 
     def bloom_count(self, pool, row: int, m: int, k: int) -> LazyResult:
@@ -360,11 +409,14 @@ class TpuCommandExecutor:
     def cms_update(self, pool, rows, h1w, h2w, weights, d: int, w: int) -> LazyResult:
         B = h1w.shape[0]
         Bp = self._bucket(B)
+        u = pool.row_units
         key = ("cms_upd", pool.state.shape[0], Bp, d, w)
 
         def build():
             def f(state, rows, h1w, h2w, weights):
-                return cms_ops.cms_update(state, rows, h1w, h2w, weights, d=d, w=w)
+                return cms_ops.cms_update(
+                    state, rows, h1w, h2w, weights, d=d, w=w, cells_per_row=u
+                )
             return f
 
         fn = self._jit(key, build, donate=True)
@@ -376,11 +428,14 @@ class TpuCommandExecutor:
     def cms_estimate(self, pool, rows, h1w, h2w, d: int, w: int) -> LazyResult:
         B = h1w.shape[0]
         Bp = self._bucket(B)
+        u = pool.row_units
         key = ("cms_est", pool.state.shape[0], Bp, d, w)
 
         def build():
             def f(state, rows, h1w, h2w):
-                return cms_ops.cms_estimate(state, rows, h1w, h2w, d=d, w=w)
+                return cms_ops.cms_estimate(
+                    state, rows, h1w, h2w, d=d, w=w, cells_per_row=u
+                )
             return f
 
         fn = self._jit(key, build, donate=False)
@@ -391,12 +446,13 @@ class TpuCommandExecutor:
     def cms_update_estimate(self, pool, rows, h1w, h2w, weights, d: int, w: int) -> LazyResult:
         B = h1w.shape[0]
         Bp = self._bucket(B)
+        u = pool.row_units
         key = ("cms_updest", pool.state.shape[0], Bp, d, w)
 
         def build():
             def f(state, rows, h1w, h2w, weights):
                 return cms_ops.cms_update_and_estimate(
-                    state, rows, h1w, h2w, weights, d=d, w=w
+                    state, rows, h1w, h2w, weights, d=d, w=w, cells_per_row=u
                 )
             return f
 

@@ -40,6 +40,11 @@ class ImmediateResult(LazyResult):
 class TpuSketchEngine:
     def __init__(self, config):
         self.config = config
+        if config.tpu_sketch.num_shards not in (0, 1):
+            raise NotImplementedError(
+                "num_shards > 1: sharded-executor integration is not wired "
+                "yet (the sharded kernels exist in parallel/mesh.py)"
+            )
         self.executor = TpuCommandExecutor(config)
         self.registry = TenantRegistry(
             self.executor.make_state,
@@ -110,19 +115,25 @@ class TpuSketchEngine:
     def bloom_add(self, name, H1, H2) -> LazyResult:
         entry = self._require(name, PoolKind.BLOOM)
         h1m, h2m = self._bloom_reduce(entry, H1, H2)
+        m, k = entry.params["size"], entry.params["hash_iterations"]
+        if not self.config.tpu_sketch.exact_add_semantics:
+            return self.executor.bloom_add_fast_st(
+                entry.pool, entry.row, m, k, h1m, h2m
+            )
         rows = np.full(len(H1), entry.row, np.int32)
-        m_arr = np.full(len(H1), entry.params["size"], np.uint32)
-        return self.executor.bloom_add(
-            entry.pool, rows, m_arr, entry.params["hash_iterations"], h1m, h2m
-        )
+        m_arr = np.full(len(H1), m, np.uint32)
+        return self.executor.bloom_add(entry.pool, rows, m_arr, k, h1m, h2m)
 
     def bloom_contains(self, name, H1, H2) -> LazyResult:
         entry = self._require(name, PoolKind.BLOOM)
         h1m, h2m = self._bloom_reduce(entry, H1, H2)
-        rows = np.full(len(H1), entry.row, np.int32)
-        m_arr = np.full(len(H1), entry.params["size"], np.uint32)
-        return self.executor.bloom_contains(
-            entry.pool, rows, m_arr, entry.params["hash_iterations"], h1m, h2m
+        return self.executor.bloom_contains_st(
+            entry.pool,
+            entry.row,
+            entry.params["size"],
+            entry.params["hash_iterations"],
+            h1m,
+            h2m,
         )
 
     def bloom_count(self, name) -> LazyResult:

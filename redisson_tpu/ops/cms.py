@@ -18,33 +18,47 @@ from redisson_tpu.ops import bitops
 
 
 def _cell_indexes(rows, h1w, h2w, *, d: int, w: int, cells_per_row: int):
-    """int32[B, d] flat cell indexes; h1w/h2w pre-reduced mod w."""
+    """int32[B, d] flat cell indexes; h1w/h2w pre-reduced mod w.
+
+    cells_per_row is the pool row stride — padded to a 128-multiple by the
+    registry, which may exceed d*w (tail cells unused).
+    """
     idx = bitops.expand_km_indexes(h1w, h2w, w, d)  # uint32[B, d]
     depth = np.uint32(w) * jnp.arange(d, dtype=jnp.uint32)[None, :]
     base = rows.astype(jnp.uint32)[:, None] * np.uint32(cells_per_row)
     return (base + depth + idx).astype(jnp.int32)
 
 
-def cms_update(flat_counts, rows, h1w, h2w, weights, *, d: int, w: int):
-    """Add ``weights[B]`` (uint32, typically 1) to each key's d cells."""
-    cells = _cell_indexes(rows, h1w, h2w, d=d, w=w, cells_per_row=d * w)
+def cms_update(flat_counts, rows, h1w, h2w, weights, *, d: int, w: int, cells_per_row: int):
+    """Add ``weights[B]`` (uint32, typically 1) to each key's d cells.
+    One-hot row scatter-add: duplicates accumulate exactly."""
+    cells = _cell_indexes(rows, h1w, h2w, d=d, w=w, cells_per_row=cells_per_row)
     upd = jnp.broadcast_to(weights.astype(jnp.uint32)[:, None], cells.shape)
-    return flat_counts.at[cells.reshape(-1)].add(upd.reshape(-1))
+    return bitops.scatter_add_onehot(
+        flat_counts, cells.reshape(-1), upd.reshape(-1)
+    )
 
 
-def cms_estimate(flat_counts, rows, h1w, h2w, *, d: int, w: int):
+def cms_estimate(flat_counts, rows, h1w, h2w, *, d: int, w: int, cells_per_row: int):
     """Point estimate: min over the d cells (classic CMS upper bound)."""
-    cells = _cell_indexes(rows, h1w, h2w, d=d, w=w, cells_per_row=d * w)
-    return flat_counts[cells].min(axis=1)
+    cells = _cell_indexes(rows, h1w, h2w, d=d, w=w, cells_per_row=cells_per_row)
+    vals = bitops.gather_words(flat_counts, cells.reshape(-1))
+    return vals.reshape(cells.shape).min(axis=1)
 
 
-def cms_update_and_estimate(flat_counts, rows, h1w, h2w, weights, *, d: int, w: int):
+def cms_update_and_estimate(
+    flat_counts, rows, h1w, h2w, weights, *, d: int, w: int, cells_per_row: int
+):
     """Fused streaming step (the heavy-hitter ingest path, BASELINE config
     5): apply updates, then return post-update estimates for the same keys —
     the host-side top-K tracker consumes the estimates.
     """
-    new = cms_update(flat_counts, rows, h1w, h2w, weights, d=d, w=w)
-    return new, cms_estimate(new, rows, h1w, h2w, d=d, w=w)
+    new = cms_update(
+        flat_counts, rows, h1w, h2w, weights, d=d, w=w, cells_per_row=cells_per_row
+    )
+    return new, cms_estimate(
+        new, rows, h1w, h2w, d=d, w=w, cells_per_row=cells_per_row
+    )
 
 
 def cms_merge_rows(flat_counts, dst_row, src_rows_counts, *, cells_per_row: int):

@@ -1,0 +1,78 @@
+"""Single-tenant fast-path bloom kernels — the bulk/bench hot path.
+
+Design rationale (measured on the v5e chip): the exact sort-based add in
+ops/bitops.py pays an O(B·k log) lexicographic sort per batch for exact
+sequential duplicate semantics; this path instead materializes the batch's
+bits in an int8 bit-delta ([bits/128, 128] rows, scatter-MAX of one-hot
+rows — idempotent, so duplicate bits need no dedup), packs it to uint32
+words with a weighted lane reduction, and ORs it into the tenant row.
+~4x faster adds.
+
+Semantic difference (documented, opt-in via
+``Config.use_tpu_sketch(exact_add_semantics=False)``): the returned
+``newly_added`` flags are computed against the PRE-BATCH state — two
+identical keys in one batch both report True, where the exact path reports
+True then False.  Bit-level results are identical; only duplicate-key
+flags within a single batch differ.
+
+The single-tenant restriction keeps the bit-delta at one row's size
+(m/8 bytes); multi-tenant coalesced batches use the exact path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from redisson_tpu.ops import bitops
+
+
+def bloom_add_fast_st(flat_words, row, h1m, h2m, m, valid, *, k: int, words_per_row: int):
+    """Single-tenant bulk add.  row and m are traced scalars (no per-op
+    arrays to transfer).  Returns (new_flat, newly bool[B] vs pre-batch).
+    """
+    B = h1m.shape[0]
+    idx = bitops.expand_km_indexes(h1m, h2m, m, k)  # [B, k] bit indexes
+    # Pre-batch membership for newly flags (row gathers, exact).
+    base_word = row.astype(jnp.uint32) * np.uint32(words_per_row)
+    gword = base_word + (idx >> np.uint32(5))
+    bit = idx & np.uint32(31)
+    pre = bitops.gather_bits(flat_words, gword.reshape(-1), bit.reshape(-1))
+    newly = (pre == 0).reshape(B, k).any(axis=1)
+
+    # int8 bit-delta over this tenant's row only, plus one padding row that
+    # absorbs invalid (batch-padding) ops.
+    rb = words_per_row * 32 // 128  # bit-rows in one tenant row
+    local_bit = idx.reshape(-1)
+    brow = (local_bit >> np.uint32(7)).astype(jnp.int32)
+    if valid is not None:
+        valid_flat = jnp.broadcast_to(valid[:, None], idx.shape).reshape(-1)
+        brow = jnp.where(valid_flat, brow, rb)
+    lane = (local_bit & np.uint32(127)).astype(jnp.int32)
+    onehot = (
+        jnp.arange(128, dtype=jnp.int32)[None, :] == lane[:, None]
+    ).astype(jnp.int8)
+    delta8 = jnp.zeros((rb + 1, 128), jnp.int8).at[brow].max(onehot)
+    # Pack 128 bits/row -> 4 uint32 words/row (weighted lane reduction).
+    weights = (np.uint32(1) << np.arange(32, dtype=np.uint32))[None, None, :]
+    packed = (delta8[:rb].reshape(rb, 4, 32).astype(jnp.uint32) * weights).sum(
+        axis=-1, dtype=jnp.uint32
+    )
+    delta_words = packed.reshape(-1)  # [words_per_row]
+
+    cur = bitops.row_slice(flat_words, row, words_per_row)
+    new = bitops.row_update(flat_words, row, cur | delta_words, words_per_row)
+    return new, newly
+
+
+def bloom_contains_st(flat_words, row, h1m, h2m, m, *, k: int, words_per_row: int):
+    """Single-tenant contains with scalar row/m operands (halves the H2D
+    transfer volume vs the per-op array form).  Bit-exact with
+    ops/bloom.bloom_contains."""
+    B = h1m.shape[0]
+    idx = bitops.expand_km_indexes(h1m, h2m, m, k)
+    base_word = row.astype(jnp.uint32) * np.uint32(words_per_row)
+    gword = base_word + (idx >> np.uint32(5))
+    bit = idx & np.uint32(31)
+    bits = bitops.gather_bits(flat_words, gword.reshape(-1), bit.reshape(-1))
+    return bits.reshape(B, k).all(axis=1)

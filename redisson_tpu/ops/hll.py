@@ -43,13 +43,14 @@ def hll_index_rank_device(c0, c1, c2):
 
 
 def hll_add(flat_regs, rows, c0, c1, c2, valid=None):
-    """PFADD batch: scatter-max of ranks.  Padded ops get rank 0 — a no-op
+    """PFADD batch: scatter-max of ranks via the one-hot row form (element
+    scatters are pathological on TPU).  Padded ops get rank 0 — a no-op
     under max — so no scratch routing is needed."""
     idx, rank = hll_index_rank_device(c0, c1, c2)
     if valid is not None:
         rank = jnp.where(valid, rank, np.uint8(0))
     gidx = rows * np.int32(HLL_M) + idx
-    return flat_regs.at[gidx].max(rank)
+    return bitops.scatter_max_onehot(flat_regs, gidx, rank)
 
 
 def hll_histogram(flat_regs, row):

@@ -1,0 +1,33 @@
+"""Sharding & replication over a jax.sharding.Mesh — L4 of the build plan.
+
+The reference's distribution axes (SURVEY.md §2.4) map here:
+- cluster slot-sharding (CRC16 → 16384 slots → master entry,
+  → org/redisson/cluster/ClusterConnectionManager.java) becomes **tenant
+  sharding**: tenant row r lives on shard ``r % S``;
+- giant single keys (2^30-bit RBitSet) shard along the bit axis
+  (**m-sharding**), the analog of the reference's inability to split one
+  key — we CAN, via index arithmetic + collectives;
+- replication/`WAIT syncSlaves` and cross-key BITOP/PFMERGE become XLA
+  collectives over ICI (psum/pmax inside shard_map) instead of
+  Netty/RESP round trips.
+"""
+
+from redisson_tpu.parallel.mesh import (
+    MeshContext,
+    sharded_bloom_add,
+    sharded_bloom_contains,
+    sharded_hll_add,
+    sharded_hll_histogram,
+    sharded_mbit_get,
+    sharded_mbit_set,
+)
+
+__all__ = [
+    "MeshContext",
+    "sharded_bloom_add",
+    "sharded_bloom_contains",
+    "sharded_hll_add",
+    "sharded_hll_histogram",
+    "sharded_mbit_get",
+    "sharded_mbit_set",
+]

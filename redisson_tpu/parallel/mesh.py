@@ -1,0 +1,296 @@
+"""shard_map kernels for multi-chip execution.
+
+Pattern (the embedding-table classic): op batches are replicated to every
+shard; each shard computes an ownership mask, routes non-owned ops to its
+scratch slot, executes the same single-device kernel from ops/ on its local
+pool block, and contributes masked results to a ``psum`` — one ICI
+all-reduce per batch, no host round trips.  Writes need no collective at
+all (each shard owns its rows).
+
+State layout: ``[S, local_len]`` sharded along axis 0 of a 1-D mesh
+(axis name "shard").  Tenant row r → shard ``r % S``, local row ``r // S``
+(round-robin keeps hot tenants spread).  A giant single-tenant bitmap
+shards along words instead: global word g → shard ``g // W_local``
+(contiguous blocks, so range ops touch few shards).
+
+These functions return jitted closures bound to a mesh.  They are exercised
+by the parallel test suite and the driver's ``dryrun_multichip`` on a
+virtual CPU mesh (SURVEY.md §4's "many redis-servers on one host" analog);
+executor integration (``config.num_shards``) is tracked work — the engine
+rejects num_shards > 1 until it lands.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from redisson_tpu.ops import bitops, bloom, hll as hll_ops
+
+
+class MeshContext:
+    """Owns the device mesh and sharding specs (the ConnectionManager-role
+    object for the device 'cluster', → SURVEY.md §2.4)."""
+
+    def __init__(self, devices=None, n_shards: int | None = None):
+        if devices is None:
+            devices = jax.devices()
+        if n_shards is not None:
+            devices = devices[:n_shards]
+        self.devices = devices
+        self.n_shards = len(devices)
+        self.mesh = Mesh(np.array(devices), axis_names=("shard",))
+        self.state_sharding = NamedSharding(self.mesh, P("shard"))
+        self.replicated = NamedSharding(self.mesh, P())
+
+    def make_state(self, local_len: int, dtype):
+        """Allocate a [S, local_len] pool block-sharded over the mesh."""
+        return jax.device_put(
+            jnp.zeros((self.n_shards, local_len), dtype), self.state_sharding
+        )
+
+
+# --------------------------------------------------------------------------
+# Tenant-sharded bloom
+# --------------------------------------------------------------------------
+
+
+def _own_and_local(rows, valid, S: int):
+    my = lax.axis_index("shard")
+    own = (rows % S == my)
+    if valid is not None:
+        own = own & valid
+    return own, rows // S
+
+
+def sharded_bloom_add(ctx: MeshContext, *, k: int, words_per_row: int):
+    """Returns jitted fn(state[S,L], rows, h1m, h2m, m_arr, valid) ->
+    (new_state, newly bool[B]) with exact single-device semantics."""
+    S = ctx.n_shards
+
+    def inner(state, rows, h1m, h2m, m_arr, valid):
+        local = state[0]
+        own, local_rows = _own_and_local(rows, valid, S)
+        new_local, newly = bloom.bloom_add(
+            local, local_rows, h1m, h2m, m=m_arr, k=k,
+            words_per_row=words_per_row, valid=own,
+        )
+        newly = lax.psum(jnp.where(own, newly, False).astype(jnp.int32), "shard")
+        return new_local[None], newly > 0
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P(), P(), P(), P()),
+        out_specs=(P("shard"), P()),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def sharded_bloom_contains(ctx: MeshContext, *, k: int, words_per_row: int):
+    S = ctx.n_shards
+
+    def inner(state, rows, h1m, h2m, m_arr, valid):
+        local = state[0]
+        own, local_rows = _own_and_local(rows, valid, S)
+        safe_rows = jnp.where(own, local_rows, 0)
+        res = bloom.bloom_contains(
+            local, safe_rows, h1m, h2m, m=m_arr, k=k, words_per_row=words_per_row
+        )
+        res = lax.psum(jnp.where(own, res, False).astype(jnp.int32), "shard")
+        return res > 0
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P(), P(), P(), P()),
+        out_specs=P(),
+    )
+    return jax.jit(fn)
+
+
+# --------------------------------------------------------------------------
+# Tenant-sharded HLL
+# --------------------------------------------------------------------------
+
+
+def sharded_hll_add(ctx: MeshContext):
+    S = ctx.n_shards
+
+    def inner(state, rows, c0, c1, c2, valid):
+        local = state[0]
+        own, local_rows = _own_and_local(rows, valid, S)
+        safe_rows = jnp.where(own, local_rows, 0)
+        new_local = hll_ops.hll_add(local, safe_rows, c0, c1, c2, valid=own)
+        return new_local[None]
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P(), P(), P(), P()),
+        out_specs=P("shard"),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def sharded_hll_histogram(ctx: MeshContext):
+    """PFCOUNT path: row lives on one shard; others contribute zeros."""
+    S = ctx.n_shards
+
+    def inner(state, row):
+        local = state[0]
+        my = lax.axis_index("shard")
+        own = (row % S) == my
+        hist = hll_ops.hll_histogram(local, jnp.where(own, row // S, 0))
+        hist = lax.psum(jnp.where(own, hist, 0), "shard")
+        return hist
+
+    fn = jax.shard_map(
+        inner, mesh=ctx.mesh, in_specs=(P("shard"), P()), out_specs=P()
+    )
+    return jax.jit(fn)
+
+
+# --------------------------------------------------------------------------
+# m-sharded giant bitmap (config 3: 2^30-bit RBitSet)
+# --------------------------------------------------------------------------
+
+
+def sharded_mbit_set(ctx: MeshContext, *, words_local: int):
+    """SETBIT batch on a bitmap sharded along words: global word g lives on
+    shard g // words_local.  Returns fn(state[S, words_local+1], idx,
+    valid) -> (new_state, prev bool[B])."""
+    S = ctx.n_shards
+
+    def inner(state, idx, valid):
+        local = state[0]  # [words_local + 1], trailing scratch
+        my = lax.axis_index("shard")
+        gword = idx >> np.uint32(5)
+        bit = idx & np.uint32(31)
+        own = (gword // np.uint32(words_local)) == my.astype(jnp.uint32)
+        if valid is not None:
+            own = own & valid
+        local_word = gword - my.astype(jnp.uint32) * np.uint32(words_local)
+        local_word = bitops.route_invalid_to_scratch(
+            jnp.where(own, local_word, 0), own, words_local + 1
+        )
+        new_local, prev = bitops.scatter_set_bits(local, local_word, bit)
+        prev = lax.psum(jnp.where(own, prev, 0).astype(jnp.int32), "shard")
+        return new_local[None], prev > 0
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P()),
+        out_specs=(P("shard"), P()),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def sharded_mbit_get(ctx: MeshContext, *, words_local: int):
+    S = ctx.n_shards
+
+    def inner(state, idx):
+        local = state[0]
+        my = lax.axis_index("shard")
+        gword = idx >> np.uint32(5)
+        bit = idx & np.uint32(31)
+        own = (gword // np.uint32(words_local)) == my.astype(jnp.uint32)
+        local_word = jnp.where(
+            own, gword - my.astype(jnp.uint32) * np.uint32(words_local), 0
+        )
+        res = bitops.gather_bits(local, local_word, bit)
+        res = lax.psum(jnp.where(own, res, 0).astype(jnp.int32), "shard")
+        return res > 0
+
+    fn = jax.shard_map(
+        inner, mesh=ctx.mesh, in_specs=(P("shard"), P()), out_specs=P()
+    )
+    return jax.jit(fn)
+
+
+# --------------------------------------------------------------------------
+# Cross-shard collectives: PFMERGE / BITOP between rows on different shards
+# --------------------------------------------------------------------------
+
+
+def sharded_hll_merge(ctx: MeshContext):
+    """dst_row ← max(dst_row, src rows), rows anywhere on the mesh.  Each
+    shard broadcasts its owned source rows via psum(max is monotone: zeros
+    elsewhere), then only the dst owner writes."""
+    S = ctx.n_shards
+
+    def inner(state, dst_row, src_rows):
+        from redisson_tpu.ops.golden import HLL_M
+
+        local = state[0]
+        my = lax.axis_index("shard")
+        regs2d = local[:-1].reshape(-1, HLL_M)
+        own_src = (src_rows % S) == my
+        contrib = jnp.where(
+            own_src[:, None], regs2d[jnp.where(own_src, src_rows // S, 0)], 0
+        )
+        # pmax, not psum: registers owned by different shards must combine
+        # by max (zeros from non-owners are the identity for max too).
+        merged_src = lax.pmax(contrib.max(axis=0).astype(jnp.int32), "shard")
+        own_dst = (dst_row % S) == my
+        dst_local = jnp.where(own_dst, dst_row // S, 0)
+        cur = bitops.row_slice(local, dst_local, HLL_M)
+        new_row = jnp.maximum(cur, merged_src.astype(jnp.uint8))
+        new_row = jnp.where(own_dst, new_row, cur)
+        new_local = bitops.row_update(local, dst_local, new_row, HLL_M)
+        return new_local[None]
+
+    fn = jax.shard_map(
+        inner, mesh=ctx.mesh, in_specs=(P("shard"), P(), P()), out_specs=P("shard")
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def sharded_bitop(ctx: MeshContext, *, words_per_row: int, op: str, n_src: int):
+    """BITOP across shards: operand rows are broadcast via psum (each shard
+    contributes rows it owns, zeros otherwise), every shard computes the op,
+    only the dst owner writes the result."""
+    S = ctx.n_shards
+
+    def inner(state, dst_row, src_rows):
+        local = state[0]
+        my = lax.axis_index("shard")
+        rows2d = local[:-1].reshape(-1, words_per_row)
+        own_src = (src_rows % S) == my
+        gathered = jnp.where(
+            own_src[:, None], rows2d[jnp.where(own_src, src_rows // S, 0)], 0
+        )
+        full = lax.psum(gathered, "shard")  # [n_src, W] now complete rows
+        if op == "and":
+            res = full[0]
+            for i in range(1, n_src):
+                res = res & full[i]
+        elif op == "or":
+            res = full[0]
+            for i in range(1, n_src):
+                res = res | full[i]
+        elif op == "xor":
+            res = full[0]
+            for i in range(1, n_src):
+                res = res ^ full[i]
+        elif op == "not":
+            res = ~full[0]
+        else:
+            raise ValueError(op)
+        own_dst = (dst_row % S) == my
+        dst_local = jnp.where(own_dst, dst_row // S, 0)
+        cur = bitops.row_slice(local, dst_local, words_per_row)
+        new_row = jnp.where(own_dst, res, cur)
+        new_local = bitops.row_update(local, dst_local, new_row, words_per_row)
+        return new_local[None]
+
+    fn = jax.shard_map(
+        inner, mesh=ctx.mesh, in_specs=(P("shard"), P(), P()), out_specs=P("shard")
+    )
+    return jax.jit(fn, donate_argnums=(0,))

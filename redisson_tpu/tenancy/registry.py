@@ -40,8 +40,13 @@ def _pow2ceil(n: int) -> int:
 
 
 def class_words_for_bits(m: int) -> int:
-    """Size class for an m-bit bitmap: pow2 words ≥ ceil(m/32), min 32."""
-    return max(32, _pow2ceil(-(-m // 32)))
+    """Size class for an m-bit bitmap: pow2 words ≥ ceil(m/32), min 128.
+
+    The 128-word minimum keeps every pool's word count a multiple of 128 so
+    kernels can view state as [R, 128] lanes (the TPU-efficient gather
+    shape, see ops/bitops.gather_bits).
+    """
+    return max(128, _pow2ceil(-(-m // 32)))
 
 
 @dataclass
@@ -64,7 +69,9 @@ def spec_for(kind: str, class_key: tuple) -> PoolSpec:
         return PoolSpec(kind, (), HLL_M, np.uint8)
     if kind == PoolKind.CMS:
         d, w = class_key
-        return PoolSpec(kind, class_key, d * w, np.uint32)
+        # Row padded to a 128-multiple: kernels need (pool words) % 128 == 0
+        # for the [R, 128] lane view; the tail cells are never probed.
+        return PoolSpec(kind, class_key, -(-d * w // 128) * 128, np.uint32)
     raise ValueError(f"unknown pool kind: {kind}")
 
 

@@ -9,14 +9,25 @@ one host.  Must run before jax is imported anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The session env pins JAX_PLATFORMS=axon (the tunneled TPU) and a
+# sitecustomize imports jax at interpreter startup, so env vars set here are
+# too late — override through jax.config instead (backends initialize
+# lazily, so this still takes effect).  Set RTPU_TEST_PLATFORM to run the
+# suite against another backend explicitly.
+_platform = os.environ.get("RTPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+import jax  # noqa: E402  (after env setup on purpose)
+
+jax.config.update("jax_platforms", _platform)
+# Persistent compile cache: must also go through jax.config (the env vars
+# were read at jax import time, which already happened via sitecustomize).
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -278,7 +278,7 @@ class TestCms:
         w1 = np.ones(n, np.uint32)
         flat = cms.cms_update(
             flat, jnp.asarray(rows), jnp.asarray(h1w), jnp.asarray(h2w),
-            jnp.asarray(w1), d=self.D, w=self.Wd,
+            jnp.asarray(w1), d=self.D, w=self.Wd, cells_per_row=self.D * self.Wd,
         )
         for r in range(self.D):
             idx = (h1w.astype(np.uint64) + np.uint64(r) * h2w.astype(np.uint64)) % np.uint64(self.Wd)
@@ -288,7 +288,7 @@ class TestCms:
         )
         est = np.asarray(cms.cms_estimate(
             flat, jnp.asarray(rows), jnp.asarray(h1w), jnp.asarray(h2w),
-            d=self.D, w=self.Wd,
+            d=self.D, w=self.Wd, cells_per_row=self.D * self.Wd,
         ))
         gold_est = gold[rows[:, None], np.arange(self.D)[None, :],
                         np.stack([(h1w.astype(np.uint64) + np.uint64(r) * h2w.astype(np.uint64)) % np.uint64(self.Wd)
@@ -311,11 +311,11 @@ class TestCms:
         h2w = np.array([3, 11], np.uint32)
         flat = cms.cms_update(flat, jnp.asarray(np.array([0, 1], np.int32)),
                               jnp.asarray(h1w), jnp.asarray(h2w),
-                              jnp.ones((2,), jnp.uint32), d=self.D, w=self.Wd)
+                              jnp.ones((2,), jnp.uint32), d=self.D, w=self.Wd, cells_per_row=self.D * self.Wd)
         src = np.asarray(flat)[cells:2 * cells].reshape(1, cells)
         merged = cms.cms_merge_rows(flat, 0, jnp.asarray(src), cells_per_row=cells)
         est = np.asarray(cms.cms_estimate(
             merged, jnp.asarray(np.array([0, 0], np.int32)),
-            jnp.asarray(h1w), jnp.asarray(h2w), d=self.D, w=self.Wd,
+            jnp.asarray(h1w), jnp.asarray(h2w), d=self.D, w=self.Wd, cells_per_row=self.D * self.Wd,
         ))
         assert est.tolist() == [1, 1]
