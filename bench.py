@@ -22,7 +22,11 @@ def main():
     from redisson_tpu import Config
     from redisson_tpu.codecs import LongCodec
 
-    cfg = Config().set_codec(LongCodec()).use_tpu_sketch(exact_add_semantics=False)
+    # Bulk single-tenant path: fast add kernels, no cross-call coalescing
+    # (that serves the mixed multi-tenant QPS config, not this microbench).
+    cfg = Config().set_codec(LongCodec()).use_tpu_sketch(
+        exact_add_semantics=False, coalesce=False
+    )
     client = redisson_tpu.create(cfg)
 
     bf = client.get_bloom_filter("bench-bf")

@@ -44,8 +44,15 @@ class RedissonTpuClient(CamelCompatMixin):
     def get_sketch_names(self, kind=None) -> list[str]:
         return self._engine.names(kind)
 
+    def get_metrics(self) -> dict:
+        """Coalescer/batch metrics snapshot (SURVEY.md §5 metrics row)."""
+        m = getattr(self._engine, "metrics", None)
+        return {} if m is None else m.snapshot()
+
     def shutdown(self) -> None:
         """→ Redisson#shutdown."""
+        if hasattr(self._engine, "shutdown"):
+            self._engine.shutdown()
         self._shutdown = True
 
     def is_shutdown(self) -> bool:

@@ -25,6 +25,7 @@ class TpuSketchConfig:
     def __init__(self):
         self.enabled = False
         # Coalescer (CommandBatchService-role) knobs.
+        self.coalesce = True  # cross-call op coalescing via flush thread
         self.batch_window_us = 200  # flush deadline
         self.max_batch = 1 << 16  # flush size threshold
         self.min_bucket = 256  # smallest padded batch shape

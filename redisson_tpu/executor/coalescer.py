@@ -1,0 +1,199 @@
+"""BatchCoalescer — cross-call op coalescing (the CommandBatchService role).
+
+The reference collects N commands per *explicit* batch
+(→ org/redisson/command/CommandBatchService.java) and pipelines them in one
+network round trip.  Here coalescing is *implicit and cross-thread*: every
+async sketch op lands in a multi-producer queue; a single flush thread
+(SURVEY.md §5 race row: one coalescer thread keeps host threading trivial)
+drains it into per-(pool, opcode, k) segments and dispatches each segment
+as ONE multi-tenant device batch through the exact kernels.
+
+Flush policy (SURVEY.md §7 hard part #1 — latency vs throughput):
+- a segment flushes when it reaches ``max_batch`` ops, or
+- when its oldest op exceeds the ``batch_window_us`` deadline, or
+- immediately when a caller blocks on a result (``flush_hint``).
+
+Ordering: segments of one pool flush FIFO, so a read submitted after a
+write observes it (per-thread read-your-writes at flush granularity);
+cross-thread order is arrival order, same as concurrent Redisson clients.
+
+Results resolve through ``concurrent.futures.Future``s carrying slices of
+the batch's LazyResult.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+import numpy as np
+
+
+class _Segment:
+    __slots__ = ("key", "dispatch", "chunks", "futures", "nops", "born")
+
+    def __init__(self, key, dispatch):
+        self.key = key
+        self.dispatch = dispatch  # fn(list_of_chunk_arrays) -> LazyResult
+        self.chunks: list[tuple] = []  # per-submit tuples of op arrays
+        self.futures: list[tuple[Future, int, int]] = []  # (future, start, n)
+        self.nops = 0
+        self.born = time.monotonic()
+
+
+class HintedFuture:
+    """Future adapter: a blocking .result() nudges the coalescer to flush
+    immediately instead of waiting out the batch window (the sync-bridge
+    behavior of CommandAsyncService#get).  Optional ``transform`` maps the
+    raw result slice (mirrors LazyResult's transform kwarg)."""
+
+    def __init__(self, fut: Future, coalescer: "BatchCoalescer", transform=None):
+        self._fut = fut
+        self._c = coalescer
+        self._transform = transform
+
+    def result(self, timeout: Optional[float] = 30.0):
+        self._c.flush_hint()
+        v = self._fut.result(timeout)
+        return v if self._transform is None else self._transform(v)
+
+    def get(self):
+        return self.result()
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+
+class BatchCoalescer:
+    def __init__(self, *, batch_window_us: int, max_batch: int, metrics=None):
+        self.window_s = batch_window_us / 1e6
+        self.max_batch = max_batch
+        self.metrics = metrics
+        self._segments: deque[_Segment] = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._inflight = 0  # popped but not yet dispatched
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="rtpu-coalescer", daemon=True
+        )
+        self._thread.start()
+
+    # -- producer side -----------------------------------------------------
+
+    def submit(self, key, dispatch: Callable, arrays: tuple, nops: int) -> Future:
+        """Queue ``nops`` ops (column arrays in ``arrays``) for the segment
+        identified by ``key``; returns a Future of the per-op result slice."""
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("coalescer is shut down")
+            seg = self._segments[-1] if self._segments else None
+            if seg is None or seg.key != key or seg.nops + nops > self.max_batch:
+                seg = _Segment(key, dispatch)
+                self._segments.append(seg)
+                # Wake the flush thread so the window deadline is armed from
+                # the segment's birth, not from the next idle-poll tick.
+                self._wake.notify()
+            seg.chunks.append(arrays)
+            seg.futures.append((fut, seg.nops, nops))
+            seg.nops += nops
+            if seg.nops >= self.max_batch:
+                self._wake.notify()
+        return fut
+
+    def flush_hint(self) -> None:
+        """A caller is about to block on a Future — flush eagerly."""
+        with self._lock:
+            self._wake.notify()
+
+    # -- flush thread ------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._segments and not self._closed:
+                    self._wake.wait(timeout=0.05)
+                if self._closed and not self._segments:
+                    return
+                seg = self._segments[0] if self._segments else None
+                if seg is None:
+                    continue
+                age = time.monotonic() - seg.born
+                if (
+                    seg.nops < self.max_batch
+                    and age < self.window_s
+                    and not self._closed
+                    and len(self._segments) == 1
+                ):
+                    # Young, small, and nothing queued behind it: wait out
+                    # the window (or a notify from a full batch/hint).
+                    self._wake.wait(timeout=self.window_s - age)
+                    if not self._segments:
+                        continue
+                seg = self._segments.popleft()
+                self._inflight += 1
+            self._flush(seg)
+
+    def _flush(self, seg: _Segment) -> None:
+        t0 = time.monotonic()
+        try:
+            if seg.dispatch is None:  # barrier segment (drain)
+                with self._lock:
+                    self._inflight -= 1
+                for fut, _, _ in seg.futures:
+                    if fut.set_running_or_notify_cancel():
+                        fut.set_result(None)
+                return
+            cols = [np.concatenate(c) for c in zip(*seg.chunks)]
+            lazy = seg.dispatch(cols)
+            with self._lock:
+                # Dispatched (device-ordered): drain() may proceed even
+                # though result transfer is still in flight.
+                self._inflight -= 1
+            res = lazy.result() if lazy is not None else None
+            for fut, start, n in seg.futures:
+                if fut.set_running_or_notify_cancel():
+                    fut.set_result(None if res is None else res[start : start + n])
+        except Exception as e:  # pragma: no cover - defensive
+            with self._lock:
+                if self._inflight > 0:
+                    self._inflight -= 1
+            for fut, _, _ in seg.futures:
+                if fut.set_running_or_notify_cancel():
+                    fut.set_exception(e)
+        if self.metrics is not None:
+            self.metrics.record_batch(
+                nops=seg.nops,
+                wait_s=t0 - seg.born,
+                flush_s=time.monotonic() - t0,
+            )
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Barrier: block until every segment submitted BEFORE this call has
+        dispatched — used by direct state reads (count/bitop/merge/snapshot)
+        so they observe all prior ops.  Implemented as a sentinel segment,
+        so sustained producers appending behind the barrier cannot starve
+        it."""
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                return
+            if not self._segments and self._inflight == 0:
+                return
+            seg = _Segment(object(), None)  # unique key: never merged into
+            seg.futures.append((fut, 0, 0))
+            self._segments.append(seg)
+            self._wake.notify()
+        fut.result(timeout)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def shutdown(self, timeout: Optional[float] = 5.0) -> None:
+        with self._lock:
+            self._closed = True
+            self._wake.notify_all()
+        self._thread.join(timeout=timeout)

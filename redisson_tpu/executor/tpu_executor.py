@@ -79,10 +79,16 @@ def _pow2ceil(n: int) -> int:
 
 
 class TpuCommandExecutor:
+    """All dispatch methods are serialized by a global lock (see module
+    docstring): pool.state buffers are donated, so two concurrent dispatches
+    racing on the same state would hand XLA an already-consumed buffer.
+    Device execution itself stays async — the lock only covers enqueue."""
+
     def __init__(self, config):
         self._cfg = config.tpu_sketch
         self._jit_cache: dict[tuple, object] = {}
         self._lock = threading.Lock()
+        self._dispatch_lock = threading.RLock()
 
     # -- state factory (injected into pools) -------------------------------
 
@@ -238,6 +244,23 @@ class TpuCommandExecutor:
         (rows_p, c0p, c1p, c2p), valid = self._pad_ops(Bp, rows, c0, c1, c2)
         pool.state = fn(pool.state, rows_p, c0p, c1p, c2p, valid)
         return LazyResult(True)
+
+    def hll_add_changed(self, pool, rows, c0, c1, c2) -> LazyResult:
+        """Multi-tenant PFADD with exact per-op changed flags (coalesced
+        path)."""
+        B = c0.shape[0]
+        Bp = self._bucket(B)
+        key = ("hll_add_changed", pool.state.shape[0], Bp)
+
+        def build():
+            def f(state, rows, c0, c1, c2, valid):
+                return hll_ops.hll_add_changed(state, rows, c0, c1, c2, valid=valid)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        (rows_p, c0p, c1p, c2p), valid = self._pad_ops(Bp, rows, c0, c1, c2)
+        pool.state, changed = fn(pool.state, rows_p, c0p, c1p, c2p, valid)
+        return LazyResult(changed, B)
 
     def hll_add_single(self, pool, row: int, c0, c1, c2) -> LazyResult:
         """Single-tenant PFADD returning the 'changed' boolean."""
@@ -514,3 +537,48 @@ class TpuCommandExecutor:
 
         fn = self._jit(key, build, donate=True)
         pool.state = fn(pool.state, row, jnp.asarray(data))
+
+
+def _locked(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._dispatch_lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
+
+
+# Serialize every method that reads or swaps pool.state (donated buffers +
+# concurrent threads would otherwise race, see class docstring).
+for _name in (
+    "bloom_add",
+    "bloom_contains",
+    "bloom_add_fast_st",
+    "bloom_contains_st",
+    "bloom_count",
+    "hll_add",
+    "hll_add_changed",
+    "hll_add_single",
+    "hll_count",
+    "hll_merge",
+    "bitset_set",
+    "bitset_clear_bits",
+    "bitset_flip",
+    "bitset_get",
+    "bitset_set_range",
+    "bitset_cardinality",
+    "bitset_length",
+    "bitset_bitpos",
+    "bitset_bitop",
+    "bitset_get_row",
+    "cms_update",
+    "cms_estimate",
+    "cms_update_estimate",
+    "cms_merge",
+    "zero_row",
+    "read_row",
+    "write_row",
+):
+    setattr(TpuCommandExecutor, _name, _locked(getattr(TpuCommandExecutor, _name)))

@@ -37,8 +37,28 @@ class ImmediateResult(LazyResult):
         super().__init__(value)
 
 
+class _MappedFuture:
+    """Future adapter applying a transform on .result()."""
+
+    def __init__(self, fut, transform):
+        self._fut = fut
+        self._transform = transform
+
+    def result(self, *a, **kw):
+        return self._transform(self._fut.result(*a, **kw))
+
+    def get(self):
+        return self.result()
+
+    def done(self):
+        return self._fut.done()
+
+
 class TpuSketchEngine:
     def __init__(self, config):
+        from redisson_tpu.executor.coalescer import BatchCoalescer
+        from redisson_tpu.serve.metrics import Metrics
+
         self.config = config
         if config.tpu_sketch.num_shards not in (0, 1):
             raise NotImplementedError(
@@ -50,6 +70,29 @@ class TpuSketchEngine:
             self.executor.make_state,
             initial_capacity=config.tpu_sketch.initial_tenants_per_class,
         )
+        self.metrics = Metrics()
+        self.coalescer = None
+        if config.tpu_sketch.coalesce:
+            self.coalescer = BatchCoalescer(
+                batch_window_us=config.tpu_sketch.batch_window_us,
+                max_batch=config.tpu_sketch.max_batch,
+                metrics=self.metrics,
+            )
+
+    def shutdown(self) -> None:
+        if self.coalescer is not None:
+            self.coalescer.shutdown()
+
+    def _drain(self) -> None:
+        """Direct state reads must observe all queued coalesced ops."""
+        if self.coalescer is not None:
+            self.coalescer.drain()
+
+    def _submit(self, key, dispatch, arrays, nops):
+        from redisson_tpu.executor.coalescer import HintedFuture
+
+        fut = self.coalescer.submit(key, dispatch, arrays, nops)
+        return HintedFuture(fut, self.coalescer)
 
     # -- generic -----------------------------------------------------------
 
@@ -60,6 +103,7 @@ class TpuSketchEngine:
         entry = self.registry.lookup(name)
         if entry is None:
             return False
+        self._drain()
         self.executor.zero_row(entry.pool, entry.row)
         self.registry.delete(name)
         return True
@@ -67,6 +111,7 @@ class TpuSketchEngine:
     def rename(self, old: str, new: str) -> bool:
         if old == new or self.registry.lookup(old) is None:
             return False
+        self._drain()
         dest = self.registry.lookup(new)
         if dest is not None:
             self.executor.zero_row(dest.pool, dest.row)
@@ -117,27 +162,47 @@ class TpuSketchEngine:
         h1m, h2m = self._bloom_reduce(entry, H1, H2)
         m, k = entry.params["size"], entry.params["hash_iterations"]
         if not self.config.tpu_sketch.exact_add_semantics:
+            # Fast single-tenant bulk path bypasses the coalescer.
             return self.executor.bloom_add_fast_st(
                 entry.pool, entry.row, m, k, h1m, h2m
             )
         rows = np.full(len(H1), entry.row, np.int32)
         m_arr = np.full(len(H1), m, np.uint32)
+        if self.coalescer is not None:
+            pool = entry.pool
+            return self._submit(
+                ("bloom_add", id(pool), k),
+                lambda cols: self.executor.bloom_add(
+                    pool, cols[0], cols[1], k, cols[2], cols[3]
+                ),
+                (rows, m_arr, h1m, h2m),
+                len(H1),
+            )
         return self.executor.bloom_add(entry.pool, rows, m_arr, k, h1m, h2m)
 
     def bloom_contains(self, name, H1, H2) -> LazyResult:
         entry = self._require(name, PoolKind.BLOOM)
         h1m, h2m = self._bloom_reduce(entry, H1, H2)
+        m, k = entry.params["size"], entry.params["hash_iterations"]
+        if self.coalescer is not None:
+            pool = entry.pool
+            rows = np.full(len(H1), entry.row, np.int32)
+            m_arr = np.full(len(H1), m, np.uint32)
+            return self._submit(
+                ("bloom_contains", id(pool), k),
+                lambda cols: self.executor.bloom_contains(
+                    pool, cols[0], cols[1], k, cols[2], cols[3]
+                ),
+                (rows, m_arr, h1m, h2m),
+                len(H1),
+            )
         return self.executor.bloom_contains_st(
-            entry.pool,
-            entry.row,
-            entry.params["size"],
-            entry.params["hash_iterations"],
-            h1m,
-            h2m,
+            entry.pool, entry.row, m, k, h1m, h2m
         )
 
     def bloom_count(self, name) -> LazyResult:
         entry = self._require(name, PoolKind.BLOOM)
+        self._drain()
         return self.executor.bloom_count(
             entry.pool, entry.row, entry.params["size"], entry.params["hash_iterations"]
         )
@@ -150,12 +215,26 @@ class TpuSketchEngine:
 
     def hll_add(self, name, c0, c1, c2) -> LazyResult:
         entry = self.hll_ensure(name)
+        if self.coalescer is not None:
+            pool = entry.pool
+            rows = np.full(len(c0), entry.row, np.int32)
+            fut = self._submit(
+                ("hll_add", id(pool)),
+                lambda cols: self.executor.hll_add_changed(
+                    pool, cols[0], cols[1], cols[2], cols[3]
+                ),
+                (rows, c0, c1, c2),
+                len(c0),
+            )
+            # addAll boolean: did anything change?
+            return _MappedFuture(fut, lambda v: bool(np.any(v)))
         return self.executor.hll_add_single(entry.pool, entry.row, c0, c1, c2)
 
     def hll_count(self, name) -> LazyResult:
         entry = self._lookup_kind(name, PoolKind.HLL)
         if entry is None:
             return ImmediateResult(0)
+        self._drain()
         return self.executor.hll_count(entry.pool, entry.row)
 
     def hll_count_with(self, name, other_names) -> int:
@@ -165,6 +244,7 @@ class TpuSketchEngine:
         entries = [e for e in entries if e is not None]
         if not entries:
             return 0
+        self._drain()
         # All HLL tenants share one pool; union via host max of rows is
         # small (16KB/row) — fine for a count call.
         regs = None
@@ -182,6 +262,7 @@ class TpuSketchEngine:
             if e is not None:
                 srcs.append(e.row)
         if srcs:
+            self._drain()
             self.executor.hll_merge(entry.pool, entry.row, srcs)
 
     # -- bitset ------------------------------------------------------------
@@ -204,6 +285,9 @@ class TpuSketchEngine:
         need_words = class_words_for_bits(min_bits)
         if need_words <= cur_words:
             return
+        # Queued coalesced ops still target the old pool/row — flush them
+        # before copying the row out.
+        self._drain()
         data = self.executor.read_row(entry.pool, entry.row)
         new_pool = self.registry.pool_for(PoolKind.BITSET, (need_words,))
         new_row = new_pool.alloc_row()
@@ -218,19 +302,31 @@ class TpuSketchEngine:
         entry = self._lookup_kind(name, PoolKind.BITSET)
         return 0 if entry is None else entry.pool.row_units * 32
 
+    def _bitset_rw(self, opname, method, entry, idx):
+        rows = np.full(len(idx), entry.row, np.int32)
+        if self.coalescer is not None:
+            pool = entry.pool
+            return self._submit(
+                (opname, id(pool)),
+                lambda cols: method(pool, cols[0], cols[1]),
+                (rows, idx),
+                len(idx),
+            )
+        return method(entry.pool, rows, idx)
+
     def bitset_set(self, name, idx, value: bool) -> LazyResult:
         idx = np.asarray(idx, np.uint32)
         entry = self.bitset_ensure(name, int(idx.max()) + 1 if idx.size else 1)
-        rows = np.full(len(idx), entry.row, np.int32)
         if value:
-            return self.executor.bitset_set(entry.pool, rows, idx)
-        return self.executor.bitset_clear_bits(entry.pool, rows, idx)
+            return self._bitset_rw("bs_set", self.executor.bitset_set, entry, idx)
+        return self._bitset_rw(
+            "bs_clear", self.executor.bitset_clear_bits, entry, idx
+        )
 
     def bitset_flip(self, name, idx) -> LazyResult:
         idx = np.asarray(idx, np.uint32)
         entry = self.bitset_ensure(name, int(idx.max()) + 1 if idx.size else 1)
-        rows = np.full(len(idx), entry.row, np.int32)
-        return self.executor.bitset_flip(entry.pool, rows, idx)
+        return self._bitset_rw("bs_flip", self.executor.bitset_flip, entry, idx)
 
     def bitset_get(self, name, idx) -> LazyResult:
         idx = np.asarray(idx, np.uint32)
@@ -240,12 +336,23 @@ class TpuSketchEngine:
         cap = entry.pool.row_units * 32
         in_range = idx < cap
         safe_idx = np.where(in_range, idx, 0).astype(np.uint32)
+        if self.coalescer is not None:
+            pool = entry.pool
+            rows = np.full(len(idx), entry.row, np.int32)
+            fut = self._submit(
+                ("bs_get", id(pool)),
+                lambda cols: self.executor.bitset_get(pool, cols[0], cols[1]),
+                (rows, safe_idx),
+                len(idx),
+            )
+            return _MappedFuture(fut, lambda v: v & in_range)
         rows = np.full(len(idx), entry.row, np.int32)
         res = self.executor.bitset_get(entry.pool, rows, safe_idx)
         return LazyResult(res._value, len(idx), transform=lambda v: v & in_range)
 
     def bitset_set_range(self, name, from_bit, to_bit, value: bool) -> LazyResult:
         entry = self.bitset_ensure(name, int(to_bit))
+        self._drain()
         return self.executor.bitset_set_range(
             entry.pool, entry.row, int(from_bit), int(to_bit), value
         )
@@ -254,18 +361,21 @@ class TpuSketchEngine:
         entry = self._lookup_kind(name, PoolKind.BITSET)
         if entry is None:
             return 0
+        self._drain()
         return self.executor.bitset_cardinality(entry.pool, entry.row).result()
 
     def bitset_length(self, name) -> int:
         entry = self._lookup_kind(name, PoolKind.BITSET)
         if entry is None:
             return 0
+        self._drain()
         return self.executor.bitset_length(entry.pool, entry.row).result()
 
     def bitset_bitpos(self, name, target_bit: int) -> int:
         entry = self._lookup_kind(name, PoolKind.BITSET)
         if entry is None:
             return -1 if target_bit else 0
+        self._drain()
         return self.executor.bitset_bitpos(entry.pool, entry.row, target_bit).result()
 
     def bitset_bitop(self, dest: str, src_names, op: str) -> None:
@@ -284,6 +394,7 @@ class TpuSketchEngine:
             e = self.bitset_ensure(n, max_bits)
             srcs.append(e.row)
             nbits = max(nbits, e.params.get("nbits", 0))
+        self._drain()
         self.executor.bitset_bitop(dst.pool, dst.row, srcs, op)
         dst.params["nbits"] = nbits
 
@@ -293,6 +404,7 @@ class TpuSketchEngine:
         entry = self._lookup_kind(name, PoolKind.BITSET)
         if entry is None:
             return b""
+        self._drain()
         nbytes = -(-entry.params.get("nbits", 0) // 8)
         return self.executor.read_row(entry.pool, entry.row).tobytes()[:nbytes]
 
@@ -310,8 +422,19 @@ class TpuSketchEngine:
         d, w = entry.params["depth"], entry.params["width"]
         h1w, h2w = hashing.km_reduce_mod(H1, H2, w)
         rows = np.full(len(H1), entry.row, np.int32)
+        wts = np.asarray(weights, np.uint32)
+        if self.coalescer is not None:
+            pool = entry.pool
+            return self._submit(
+                ("cms_add", id(pool), d, w),
+                lambda cols: self.executor.cms_update_estimate(
+                    pool, cols[0], cols[1], cols[2], cols[3], d, w
+                ),
+                (rows, h1w, h2w, wts),
+                len(H1),
+            )
         return self.executor.cms_update_estimate(
-            entry.pool, rows, h1w, h2w, np.asarray(weights, np.uint32), d, w
+            entry.pool, rows, h1w, h2w, wts, d, w
         )
 
     def cms_estimate(self, name, H1, H2) -> LazyResult:
@@ -319,6 +442,16 @@ class TpuSketchEngine:
         d, w = entry.params["depth"], entry.params["width"]
         h1w, h2w = hashing.km_reduce_mod(H1, H2, w)
         rows = np.full(len(H1), entry.row, np.int32)
+        if self.coalescer is not None:
+            pool = entry.pool
+            return self._submit(
+                ("cms_est", id(pool), d, w),
+                lambda cols: self.executor.cms_estimate(
+                    pool, cols[0], cols[1], cols[2], d, w
+                ),
+                (rows, h1w, h2w),
+                len(H1),
+            )
         return self.executor.cms_estimate(entry.pool, rows, h1w, h2w, d, w)
 
     def cms_merge(self, name, other_names) -> None:
@@ -333,6 +466,7 @@ class TpuSketchEngine:
                 raise ValueError("cannot merge CMS with different geometry")
             srcs.append(e.row)
         if srcs:
+            self._drain()
             self.executor.cms_merge(entry.pool, entry.row, srcs)
 
 
@@ -344,6 +478,9 @@ class HostSketchEngine:
         self.config = config
         self._lock = threading.RLock()
         self._objects: dict[str, dict] = {}
+
+    def shutdown(self) -> None:
+        pass
 
     # -- generic -----------------------------------------------------------
 

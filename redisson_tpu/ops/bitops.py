@@ -84,6 +84,22 @@ def sort_runs(gword: jnp.ndarray, bit: jnp.ndarray):
     return sw, sb, sp, first, pos - run_start
 
 
+def segmented_exclusive_max(first: jnp.ndarray, vals: jnp.ndarray):
+    """Exclusive running max within segments (segment starts where ``first``
+    is True).  Classic segmented-scan via associative_scan; used to derive
+    exact sequential semantics (what did op j observe?) for sorted
+    duplicate runs without a serial loop."""
+
+    def comb(a, b):
+        f1, v1 = a
+        f2, v2 = b
+        return f1 | f2, jnp.where(f2, v2, jnp.maximum(v1, v2))
+
+    _, inc = lax.associative_scan(comb, (first, vals))
+    exc = jnp.concatenate([vals[:1] * 0, inc[:-1]])
+    return jnp.where(first, vals * 0, exc)
+
+
 def gather_words(flat: jnp.ndarray, gidx: jnp.ndarray):
     """Element gather from a flat pool array via the [R, 128] row-gather
     form (see gather_bits).  Works for any dtype; exact equivalent of

@@ -87,6 +87,32 @@ def hll_merge(flat_regs, dst_row, src_rows):
     return hll_merge_rows(flat_regs, dst_row, regs2d[src_rows])
 
 
+def hll_add_changed(flat_regs, rows, c0, c1, c2, valid=None):
+    """Multi-tenant PFADD returning per-op 'changed' booleans with exact
+    sequential semantics: op j changed its register iff
+    rank_j > max(pre-batch value, ranks of earlier ops on the same
+    register).  Sort by register + segmented exclusive max scan (the
+    coalesced-path variant of RHyperLogLog#add's boolean)."""
+    from jax import lax
+
+    idx, rank = hll_index_rank_device(c0, c1, c2)
+    if valid is not None:
+        rank = jnp.where(valid, rank, np.uint8(0))
+    gidx = (rows * np.int32(HLL_M) + idx).astype(jnp.uint32)
+    new = bitops.scatter_max_onehot(flat_regs, gidx.astype(jnp.int32), rank)
+
+    n = gidx.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    sg, sr, sp = lax.sort((gidx, rank.astype(jnp.int32), pos), num_keys=1, is_stable=True)
+    pre = bitops.gather_words(flat_regs, sg).astype(jnp.int32)
+    first = jnp.concatenate([jnp.ones((1,), bool), sg[1:] != sg[:-1]])
+    run_prev = bitops.segmented_exclusive_max(first, sr)
+    observed = jnp.maximum(pre, run_prev)
+    changed_sorted = sr > observed
+    changed = jnp.zeros((n,), bool).at[sp].set(changed_sorted)
+    return new, changed
+
+
 def hll_add_single(flat_regs, row, c0, c1, c2, valid=None):
     """PFADD for one tenant, returning (new, changed) — changed is
     RHyperLogLog.add()'s boolean: did any register increase?  Computed as a

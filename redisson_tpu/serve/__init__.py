@@ -1,0 +1,6 @@
+"""Ingest & serving: metrics, topic bus, streaming pipelines (L5,
+SURVEY.md §7)."""
+
+from redisson_tpu.serve.metrics import Metrics
+
+__all__ = ["Metrics"]

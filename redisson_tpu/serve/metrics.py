@@ -1,0 +1,77 @@
+"""Metrics & batch tracing — built in from day one (SURVEY.md §5: the
+reference's OSS core has none; monitoring is a Redisson PRO feature, so this
+is an upgrade, and the BASELINE metrics — ops/sec, batch occupancy, p99
+flush latency — must be measurable from inside the framework).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class _Reservoir:
+    """Bounded latency reservoir for percentile estimates."""
+
+    def __init__(self, cap: int = 4096):
+        self.cap = cap
+        self.values: list[float] = []
+        self.n = 0
+
+    def add(self, v: float) -> None:
+        self.n += 1
+        if len(self.values) < self.cap:
+            self.values.append(v)
+        else:
+            # Deterministic decimated replacement (no RNG needed).
+            self.values[self.n % self.cap] = v
+
+    def percentile(self, p: float) -> float:
+        if not self.values:
+            return 0.0
+        vals = sorted(self.values)
+        idx = min(len(vals) - 1, int(p / 100.0 * len(vals)))
+        return vals[idx]
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.started = time.monotonic()
+        self.ops_total = 0
+        self.batches_total = 0
+        self.batch_occupancy_sum = 0
+        self.wait = _Reservoir()
+        self.flush = _Reservoir()
+
+    def record_batch(self, *, nops: int, wait_s: float, flush_s: float) -> None:
+        with self._lock:
+            self.ops_total += nops
+            self.batches_total += 1
+            self.batch_occupancy_sum += nops
+            self.wait.add(wait_s)
+            self.flush.add(flush_s)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            elapsed = max(time.monotonic() - self.started, 1e-9)
+            batches = max(self.batches_total, 1)
+            return {
+                "ops_total": self.ops_total,
+                "batches_total": self.batches_total,
+                "ops_per_sec": self.ops_total / elapsed,
+                "mean_batch_occupancy": self.batch_occupancy_sum / batches,
+                "p50_wait_ms": self.wait.percentile(50) * 1e3,
+                "p99_wait_ms": self.wait.percentile(99) * 1e3,
+                "p50_flush_ms": self.flush.percentile(50) * 1e3,
+                "p99_flush_ms": self.flush.percentile(99) * 1e3,
+            }
+
+    def render_prometheus(self) -> str:
+        """Plain Prometheus text exposition (SURVEY.md §5 metrics row)."""
+        s = self.snapshot()
+        lines = []
+        for k, v in s.items():
+            lines.append(f"# TYPE redisson_tpu_{k} gauge")
+            lines.append(f"redisson_tpu_{k} {v}")
+        return "\n".join(lines) + "\n"

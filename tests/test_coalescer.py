@@ -1,0 +1,145 @@
+"""Coalescer: correctness under concurrency, merging, ordering, metrics.
+
+The reference's substitute for race detection is hammering the API from
+thread pools (SURVEY.md §4 BaseConcurrentTest#testMultiInstanceConcurrency);
+we do the same against the coalesced TPU engine and check results against
+golden models.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+
+
+def _client(**kw):
+    return redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64, **kw))
+
+
+def test_coalesced_ops_merge_into_batches():
+    cl = _client(batch_window_us=5000, max_batch=4096)
+    bf = cl.get_bloom_filter("c1")
+    bf.try_init(10_000, 0.01)
+    futs = [bf.add_async(f"k{i}") for i in range(50)]
+    results = [f.result() for f in futs]
+    assert all(results)
+    m = cl.get_metrics()
+    # 50 single-op submits must have merged into far fewer device batches.
+    assert m["batches_total"] <= 10, m
+    assert m["ops_total"] == 50
+    assert m["mean_batch_occupancy"] >= 5
+    cl.shutdown()
+
+
+def test_read_your_writes_ordering():
+    cl = _client(batch_window_us=2000)
+    bf = cl.get_bloom_filter("c2")
+    bf.try_init(1000, 0.01)
+    for i in range(20):
+        f = bf.add_async(f"x{i}")
+        assert bf.contains(f"x{i}"), i  # contains segment flushes after add
+        assert f.result()
+    cl.shutdown()
+
+
+def test_concurrent_multi_tenant_hammer():
+    cl = _client(batch_window_us=500)
+    n_threads, n_keys = 8, 300
+    bfs = []
+    for t in range(n_threads):
+        bf = cl.get_bloom_filter(f"tenant{t}")
+        bf.try_init(5000, 0.01)
+        bfs.append(bf)
+    errors = []
+
+    def worker(t):
+        try:
+            bf = bfs[t]
+            keys = [f"t{t}:k{i}" for i in range(n_keys)]
+            futs = [bf.add_async(k) for k in keys]
+            for f in futs:
+                f.result()
+            assert bf.contains_all(keys) == n_keys
+            # other tenants' keys: near-zero hits (p=0.01 target)
+            other = bf.contains_all([f"t{(t+1) % n_threads}:k{i}" for i in range(n_keys)])
+            assert other < n_keys * 0.05
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors, errors
+    cl.shutdown()
+
+
+def test_concurrent_hll_and_cms():
+    cl = _client(batch_window_us=500)
+    h = cl.get_hyper_log_log("ch")
+    c = cl.get_count_min_sketch("cc")
+    c.try_init(4, 1 << 12)
+    errors = []
+
+    def hll_worker(t):
+        try:
+            h.add_all([f"u{t}:{i}" for i in range(2000)])
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def cms_worker(t):
+        try:
+            for _ in range(5):
+                c.add_all(["hot"] * 20)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=hll_worker, args=(t,)) for t in range(4)]
+    threads += [threading.Thread(target=cms_worker, args=(t,)) for t in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors, errors
+    est = h.count()
+    assert abs(est - 8000) / 8000 < 0.05
+    assert c.estimate("hot") == 400
+    cl.shutdown()
+
+
+def test_hll_add_changed_flags_coalesced():
+    cl = _client(batch_window_us=3000)
+    h = cl.get_hyper_log_log("flags")
+    f1 = h.add_async("a")
+    f2 = h.add_async("a")  # same key, same batch: second must be False
+    f3 = h.add_async("b")
+    assert f1.result() is True
+    assert f2.result() is False
+    assert f3.result() is True
+    cl.shutdown()
+
+
+def test_bitset_grow_with_queued_ops():
+    cl = _client(batch_window_us=5000)
+    bs = cl.get_bit_set("grow")
+    futs = [bs._engine.bitset_set("grow", [i], True) for i in range(10)]
+    bs.set(100_000)  # forces class migration; must drain queued sets first
+    for f in futs:
+        f.result()
+    assert bs.cardinality() == 11
+    assert bs.get_many(np.arange(10)).all()
+    cl.shutdown()
+
+
+def test_shutdown_rejects_new_ops():
+    cl = _client()
+    bf = cl.get_bloom_filter("sd")
+    bf.try_init(100, 0.01)
+    bf.add("x")
+    cl.shutdown()
+    with pytest.raises(RuntimeError):
+        bf.add_async("y")
