@@ -397,21 +397,26 @@ class TpuCommandExecutor:
         fn = self._jit(key, build, donate=False)
         return LazyResult(fn(pool.state, row), transform=int)
 
-    def bitset_bitop(self, pool, dst_row: int, src_rows, op: str) -> LazyResult:
+    def bitset_bitop(self, pool, dst_row: int, src_rows, op: str, limit_bits=None) -> LazyResult:
         wpr = pool.row_units
         S = len(src_rows)
-        key = ("bs_bitop", wpr, pool.state.shape[0], S, op)
+        masked = limit_bits is not None  # NOT path: mask to logical length
+        key = ("bs_bitop", wpr, pool.state.shape[0], S, op, masked)
 
         def build():
-            def f(state, dst, srcs):
+            def f(state, dst, srcs, limit):
                 return bitset_ops.bitset_bitop_rows(
-                    state, dst, srcs, words_per_row=wpr, op=op, n_src=S
+                    state, dst, srcs, words_per_row=wpr, op=op, n_src=S,
+                    limit_bits=limit if masked else None,
                 )
             return f
 
         fn = self._jit(key, build, donate=True)
         pool.state = fn(
-            pool.state, dst_row, jnp.asarray(np.asarray(src_rows, np.int32))
+            pool.state,
+            dst_row,
+            jnp.asarray(np.asarray(src_rows, np.int32)),
+            np.int64(limit_bits if masked else 0),
         )
         return LazyResult(None)
 

@@ -69,6 +69,7 @@ class TpuSketchEngine:
         self.registry = TenantRegistry(
             self.executor.make_state,
             initial_capacity=config.tpu_sketch.initial_tenants_per_class,
+            dispatch_lock=self.executor._dispatch_lock,
         )
         self.metrics = Metrics()
         self.coalescer = None
@@ -162,7 +163,11 @@ class TpuSketchEngine:
         h1m, h2m = self._bloom_reduce(entry, H1, H2)
         m, k = entry.params["size"], entry.params["hash_iterations"]
         if not self.config.tpu_sketch.exact_add_semantics:
-            # Fast single-tenant bulk path bypasses the coalescer.
+            # Fast single-tenant bulk path dispatches immediately — but only
+            # after queued coalesced ops flush, so a contains submitted
+            # *before* this add can never observe its writes (arrival-order
+            # contract of the coalescer docstring).
+            self._drain()
             return self.executor.bloom_add_fast_st(
                 entry.pool, entry.row, m, k, h1m, h2m
             )
@@ -267,12 +272,19 @@ class TpuSketchEngine:
 
     # -- bitset ------------------------------------------------------------
 
-    def bitset_ensure(self, name, min_bits: int = 1):
+    def _bitset_entry_with_capacity(self, name, min_bits: int):
+        """Physical placement only — create/migrate so the row can hold
+        ``min_bits``, WITHOUT extending the logical bit length (bitop
+        operands must keep their true lengths)."""
         entry, created = self.registry.try_create(
             name, PoolKind.BITSET, (class_words_for_bits(min_bits),), {"nbits": 0}
         )
         if not created:
             self._bitset_grow(entry, min_bits)
+        return entry
+
+    def bitset_ensure(self, name, min_bits: int = 1):
+        entry = self._bitset_entry_with_capacity(name, min_bits)
         # Logical size tracking = Redis string-length semantics (SETBIT
         # grows the value to cover the highest index ever touched).
         entry.params["nbits"] = max(entry.params.get("nbits", 0), int(min_bits))
@@ -382,20 +394,33 @@ class TpuSketchEngine:
         """BITOP dest = op(srcs).  All operands (dest included) are grown
         into one size class first so their rows co-reside in a single pool
         (the TPU answer to the reference's same-slot requirement for
-        cross-key BITOP, SURVEY.md §2.2)."""
+        cross-key BITOP, SURVEY.md §2.2).
+
+        Redis semantics: dest is *replaced* (its prior value never leaks
+        into the result), and the result length is the max source length.
+        Unary NOT complements the source's full *byte-aligned* string
+        (Redis values are byte strings, so BITOP NOT flips padding bits up
+        to the byte boundary too) and is masked there so tail bits of the
+        size-class row stay 0.
+        """
         max_bits = max(
             (self.bitset_capacity_bits(n) for n in (dest, *src_names)),
             default=0,
         ) or 32 * 32
-        dst = self.bitset_ensure(dest, max_bits)
-        srcs = []
-        nbits = dst.params.get("nbits", 0)
+        dst = self._bitset_entry_with_capacity(dest, max_bits)
+        srcs, src_nbits = [], []
         for n in src_names:
-            e = self.bitset_ensure(n, max_bits)
+            e = self._bitset_entry_with_capacity(n, max_bits)
             srcs.append(e.row)
-            nbits = max(nbits, e.params.get("nbits", 0))
+            src_nbits.append(e.params.get("nbits", 0))
+        nbits = (
+            -(-src_nbits[0] // 8) * 8 if op == "not" else max(src_nbits, default=0)
+        )
         self._drain()
-        self.executor.bitset_bitop(dst.pool, dst.row, srcs, op)
+        self.executor.bitset_bitop(
+            dst.pool, dst.row, srcs, op,
+            limit_bits=nbits if op == "not" else None,
+        )
         dst.params["nbits"] = nbits
 
     def bitset_to_bytes(self, name) -> bytes:
@@ -683,21 +708,33 @@ class HostSketchEngine:
             return int(matches[0]) if matches.size else (-1 if target_bit else bits.size)
 
     def bitset_bitop(self, dest, src_names, op: str) -> None:
+        """Redis BITOP: sources are zero-padded to the max source length
+        (without mutating them), dest is replaced entirely; NOT complements
+        its single source's byte-aligned string (padding bits up to the
+        byte boundary flip to 1, as on a real Redis value) — mirrors
+        TpuSketchEngine."""
         with self._lock:
             srcs = [self._bitset(n)["model"] for n in src_names]
-            size = max((s.bits.size for s in srcs), default=0)
-            for s in srcs:
-                s._grow(size)
-            d = self._bitset(dest)["model"]
-            d._grow(size)
             if op == "not":
-                res = ~srcs[0].bits
+                size = -(-srcs[0].bits.size // 8) * 8
+                res = np.ones(size, dtype=bool)
+                res[: srcs[0].bits.size] = ~srcs[0].bits
             else:
+                size = max((s.bits.size for s in srcs), default=0)
+
+                def padded(b):
+                    if b.size == size:
+                        return b
+                    p = np.zeros(size, dtype=bool)
+                    p[: b.size] = b
+                    return p
+
                 fn = {"and": np.logical_and, "or": np.logical_or, "xor": np.logical_xor}[op]
-                res = srcs[0].bits
+                res = padded(srcs[0].bits).copy()
                 for s in srcs[1:]:
-                    res = fn(res, s.bits)
-            d.bits[:size] = res[:size]
+                    res = fn(res, padded(s.bits))
+            d = self._bitset(dest)["model"]
+            d.bits = np.array(res, dtype=bool)
 
     def bitset_to_bytes(self, name) -> bytes:
         with self._lock:

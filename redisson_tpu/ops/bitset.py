@@ -69,11 +69,14 @@ def bitset_bitpos(flat_words, row, *, words_per_row: int, target_bit: int):
     return bitops.bitpos_row(flat_words, row, words_per_row, target_bit)
 
 
-def bitset_bitop(flat_words, dst_row, src_rows_words, *, words_per_row: int, op: str):
+def bitset_bitop(flat_words, dst_row, src_rows_words, *, words_per_row: int, op: str, limit_bits=None):
     """BITOP dst = op(src_1, ..., src_n) — cross-key op on pre-gathered rows.
 
     src_rows_words: uint32[S, W].  op in {and, or, xor, not}; `not` uses the
-    first source only (Redis BITOP NOT is unary).
+    first source only (Redis BITOP NOT is unary) and complements exactly the
+    source's logical length ``limit_bits`` (a traced scalar) — bits beyond
+    it stay 0, preserving the physical invariant that untouched tail bits
+    of a size-class row are clear.
     """
     if op == "and":
         res = src_rows_words[0]
@@ -89,6 +92,8 @@ def bitset_bitop(flat_words, dst_row, src_rows_words, *, words_per_row: int, op:
             res = res ^ src_rows_words[i]
     elif op == "not":
         res = ~src_rows_words[0]
+        if limit_bits is not None:
+            res = res & bitops.range_mask_words(words_per_row, 0, limit_bits)
     else:
         raise ValueError(f"unknown bitop: {op}")
     return bitops.row_update(flat_words, dst_row, res, words_per_row)
@@ -99,9 +104,14 @@ def bitset_get_row(flat_words, row, *, words_per_row: int):
     return bitops.row_slice(flat_words, row, words_per_row)
 
 
-def bitset_bitop_rows(flat_words, dst_row, src_rows, *, words_per_row: int, op: str, n_src: int):
+def bitset_bitop_rows(flat_words, dst_row, src_rows, *, words_per_row: int, op: str, n_src: int, limit_bits=None):
     """BITOP with in-kernel source gather: src_rows is int32[n_src]."""
     rows2d = flat_words[:-1].reshape(-1, words_per_row)
     return bitset_bitop(
-        flat_words, dst_row, rows2d[src_rows], words_per_row=words_per_row, op=op
+        flat_words,
+        dst_row,
+        rows2d[src_rows],
+        words_per_row=words_per_row,
+        op=op,
+        limit_bits=limit_bits,
     )

@@ -182,12 +182,20 @@ class GoldenHyperLogLog:
 
 
 class GoldenCountMinSketch:
-    """Golden CMS twin (the new RObject — no reference counterpart)."""
+    """Golden CMS twin (the new RObject — no reference counterpart).
+
+    Counters are uint32 — the device pool dtype — so per-cell totals wrap
+    mod 2**32 *identically* in both engines (np.add.at and the device
+    scatter-add share two's-complement wrap semantics).  The documented
+    contract is therefore: per-cell counts are exact up to 2**32-1; callers
+    needing larger totals must shard keys or widen at the application
+    level.
+    """
 
     def __init__(self, depth: int, width: int):
         self.depth = int(depth)
         self.width = int(width)
-        self.counts = np.zeros((self.depth, self.width), dtype=np.uint64)
+        self.counts = np.zeros((self.depth, self.width), dtype=np.uint32)
 
     def _cells(self, h1w: np.ndarray, h2w: np.ndarray) -> np.ndarray:
         r = np.arange(self.depth, dtype=np.uint64)
@@ -198,9 +206,9 @@ class GoldenCountMinSketch:
     def add_hashed(self, h1w, h2w, weights=None) -> None:
         cells = self._cells(h1w, h2w)
         w = (
-            np.ones(len(h1w), np.uint64)
+            np.ones(len(h1w), np.uint32)
             if weights is None
-            else np.asarray(weights, np.uint64)
+            else np.asarray(weights, np.uint32)
         )
         for r in range(self.depth):
             np.add.at(self.counts[r], cells[:, r], w)

@@ -78,13 +78,19 @@ def spec_for(kind: str, class_key: tuple) -> PoolSpec:
 class SizeClassPool:
     """One stacked device array holding all tenants of a size class."""
 
-    def __init__(self, spec: PoolSpec, capacity: int, make_state):
+    def __init__(self, spec: PoolSpec, capacity: int, make_state, dispatch_lock=None):
         self.spec = spec
         self.capacity = capacity
         # make_state(n_elements, dtype) -> device array; injected by the
         # executor so this layer stays device-agnostic (host tests can pass
         # numpy).
         self._make_state = make_state
+        # Growth swaps self.state; a concurrently flushing coalesced write
+        # donates the same buffer and reassigns state with the old-shaped
+        # output, losing the growth (or hitting use-after-donate).  Taking
+        # the executor's dispatch lock around the read-concat-swap makes
+        # growth atomic w.r.t. every dispatch.
+        self._dispatch_lock = dispatch_lock or threading.RLock()
         self.state = make_state(capacity * spec.row_units + 1, spec.dtype)
         self._free: list[int] = list(range(capacity - 1, -1, -1))
         self.generation = 0  # bumped on every growth (jit cache key part)
@@ -94,9 +100,14 @@ class SizeClassPool:
         return self.spec.row_units
 
     def alloc_row(self) -> int:
-        if not self._free:
-            self._grow()
-        return self._free.pop()
+        # Both the grow and the pop sit inside the dispatch lock: alloc_row
+        # is reachable without the registry lock (bitset size-class
+        # migration), so two near-simultaneous allocators racing on one
+        # remaining free row must serialize end-to-end.
+        with self._dispatch_lock:
+            if not self._free:
+                self._grow()
+            return self._free.pop()
 
     def free_row(self, row: int) -> None:
         # Caller (executor) must zero the row on device before recycling.
@@ -132,9 +143,10 @@ class TenantEntry:
 
 
 class TenantRegistry:
-    def __init__(self, make_state, initial_capacity: int = 8):
+    def __init__(self, make_state, initial_capacity: int = 8, dispatch_lock=None):
         self._make_state = make_state
         self._initial_capacity = initial_capacity
+        self._dispatch_lock = dispatch_lock
         self._lock = threading.RLock()
         self._tenants: dict[str, TenantEntry] = {}
         self._pools: dict[tuple, SizeClassPool] = {}
@@ -148,7 +160,12 @@ class TenantRegistry:
             spec = spec_for(kind, class_key)
             pool = self._pools.get(spec.key)
             if pool is None:
-                pool = SizeClassPool(spec, self._initial_capacity, self._make_state)
+                pool = SizeClassPool(
+                    spec,
+                    self._initial_capacity,
+                    self._make_state,
+                    dispatch_lock=self._dispatch_lock,
+                )
                 self._pools[spec.key] = pool
             return pool
 

@@ -86,3 +86,98 @@ def test_cms_single_add_feeds_topk(client):
         c.add("solo")
     top = c.top_k(1)
     assert top and top[0] == ("solo", 5)
+
+
+def test_bitop_not_masks_to_logical_length(client):
+    """ADVICE r1: NOT must complement the source's byte-aligned string
+    (Redis BITOP NOT semantics) in BOTH engines — never the whole
+    physical size-class row."""
+    src = client.get_bit_set("notsrc")
+    src.set_many(np.array([1, 3, 5]))  # logical length 6 -> 1-byte string
+    dst = client.get_bit_set("notdst")
+    client._engine.bitset_bitop("notdst", ("notsrc",), "not")
+    assert dst.cardinality() == 5  # bits 0, 2, 4 + padding bits 6, 7
+    arr = dst.as_bit_array()
+    assert sorted(np.nonzero(arr)[0].tolist()) == [0, 2, 4, 6, 7]
+
+
+def test_bitop_not_parity_between_modes():
+    dumps = {}
+    for mode in ("tpu", "host"):
+        cfg = Config()
+        if mode == "tpu":
+            cfg.use_tpu_sketch(min_bucket=64)
+        cl = redisson_tpu.create(cfg)
+        src = cl.get_bit_set("nsrc")
+        src.set_many(np.array([0, 9]))
+        cl._engine.bitset_bitop("ndst", ("nsrc",), "not")
+        dumps[mode] = (
+            cl.get_bit_set("ndst").to_byte_array(),
+            cl.get_bit_set("ndst").cardinality(),
+        )
+    assert dumps["tpu"] == dumps["host"]
+    assert dumps["tpu"][1] == 14  # 10 logical bits -> 16-bit string, 2 set in src
+
+
+def test_bitop_overwrites_destination(client):
+    """ADVICE r1: Redis BITOP replaces dest entirely — stale high bits of
+    a previously-larger dest must not survive."""
+    dst = client.get_bit_set("owdst")
+    dst.set(5000)  # dest has a high bit + large physical row
+    a = client.get_bit_set("owA")
+    b = client.get_bit_set("owB")
+    a.set_many(np.array([1, 2]))
+    b.set_many(np.array([2, 3]))
+    client._engine.bitset_bitop("owdst", ("owA", "owB"), "or")
+    arr = dst.as_bit_array()
+    assert sorted(np.nonzero(arr)[0].tolist()) == [1, 2, 3]
+    assert dst.cardinality() == 3
+
+
+def test_bitop_does_not_inflate_source_logical_length(client):
+    a = client.get_bit_set("lenA")
+    b = client.get_bit_set("lenB")
+    a.set(2)       # logical length 3
+    b.set(9000)    # much larger class
+    client._engine.bitset_bitop("lenD", ("lenA", "lenB"), "or")
+    # Source A keeps its own logical length (3 bits -> 1-byte string):
+    # NOT of it has 7 bits set, not thousands from B's size class.
+    client._engine.bitset_bitop("lenNA", ("lenA",), "not")
+    assert client.get_bit_set("lenNA").cardinality() == 7
+
+
+def test_cms_counts_wrap_identically_between_modes():
+    """ADVICE r1: CMS counters are uint32 in both engines; totals wrap
+    mod 2**32 identically instead of silently diverging."""
+    ests = {}
+    for mode in ("tpu", "host"):
+        cfg = Config()
+        if mode == "tpu":
+            cfg.use_tpu_sketch(min_bucket=64)
+        cl = redisson_tpu.create(cfg)
+        c = cl.get_count_min_sketch("wrapcms")
+        c.try_init(3, 1 << 8)
+        big = (1 << 31) + 7
+        c.add("k", count=big)
+        c.add("k", count=big)  # 2*(2^31+7) wraps to 14 mod 2^32
+        ests[mode] = int(c.estimate("k"))
+    assert ests["tpu"] == ests["host"] == 14
+
+
+def test_fast_add_drains_pending_coalesced_reads():
+    """ADVICE r1: with exact_add_semantics=False + coalescing on, a fast
+    add must not overtake an earlier queued contains."""
+    cfg = Config().use_tpu_sketch(
+        exact_add_semantics=False, coalesce=True,
+        batch_window_us=200_000, min_bucket=64,
+    )
+    cl = redisson_tpu.create(cfg)
+    bf = cl.get_bloom_filter("orderbf")
+    bf.try_init(1000, 0.01)
+    # Queue a contains (sits in the window), then fast-add the same key.
+    fut = bf.contains_async("late-key")
+    bf.add("late-key")
+    # The earlier read must NOT observe the later write.
+    assert not np.any(fut.result())
+    assert bf.contains("late-key") is True
+    cl.shutdown()
