@@ -38,10 +38,12 @@ class TpuSketchConfig:
         # results identical; see ops/fastpath.py).
         self.exact_add_semantics = True
         self.max_bloom_bits = 1 << 31
-        # Sharding: 1 → single-device (current executor).  Values > 1 are
-        # rejected until the sharded-executor integration lands; the
-        # sharded kernels themselves live in parallel/mesh.py and are
-        # exercised by tests + the driver's dryrun_multichip.
+        # Sharding: 1 → single-device executor; S > 1 → the cluster-mode
+        # analog (executor/sharded_executor.py): tenant row r lives on
+        # shard r % S of a 1-D device mesh, batches replicate to every
+        # shard, results combine via one ICI psum.  Requires >= S devices
+        # (virtual CPU meshes via xla_force_host_platform_device_count
+        # work for tests).
         self.num_shards = 1
         self.platform: Optional[str] = None  # None → jax default backend
         # HLL geometry is fixed to Redis parity (p=14) — not configurable,

@@ -1,0 +1,384 @@
+"""ShardedTpuCommandExecutor — the multi-chip command executor.
+
+The cluster-mode analog of the reference's ClusterConnectionManager +
+CommandExecutor pair (→ org/redisson/cluster/ClusterConnectionManager.java,
+SURVEY.md §2.4 cluster-sharding row): instead of CRC16 slots and MOVED
+redirects, tenant row ``r`` lives on shard ``r % S`` of a 1-D device mesh,
+op batches are replicated to every shard, and each shard executes the same
+single-device kernel on its local pool block with an ownership mask — one
+ICI ``psum`` per batch combines results, no host round trips and no
+redirects (resharding would be an explicit device-array remap).
+
+Pool state: ``[S, local_len]`` arrays block-sharded along axis 0
+(NamedSharding over a ``jax.sharding.Mesh``); each shard's local block is a
+flat ``[rows_local * row_units + 1]`` array with its own trailing scratch
+element, so every kernel from ops/ runs unchanged inside ``shard_map``.
+
+Exposes the exact method surface of TpuCommandExecutor, so the engine and
+coalescer are shard-agnostic: ``Config.use_tpu_sketch(num_shards=S)`` is
+the only switch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from redisson_tpu.ops import bitops
+from redisson_tpu.ops import bitset as bitset_ops
+from redisson_tpu.ops import golden
+from redisson_tpu.executor.tpu_executor import (
+    DISPATCH_METHODS,
+    LazyResult,
+    TpuCommandExecutor,
+    _locked,
+    bloom_count_from_bitcount,
+)
+from redisson_tpu.parallel import mesh as pm
+
+
+class ShardedTpuCommandExecutor(TpuCommandExecutor):
+    def __init__(self, config):
+        super().__init__(config)
+        n = config.tpu_sketch.num_shards
+        self.ctx = pm.MeshContext(n_shards=n)
+        if self.ctx.n_shards < n:
+            raise RuntimeError(
+                f"num_shards={n} but only {self.ctx.n_shards} devices are "
+                f"available (set XLA_FLAGS=--xla_force_host_platform_device_"
+                f"count=N with JAX_PLATFORMS=cpu for a virtual mesh)"
+            )
+        self.S = self.ctx.n_shards
+
+    # -- pool-state factory ------------------------------------------------
+
+    def round_capacity(self, capacity: int) -> int:
+        return -(-capacity // self.S) * self.S
+
+    def make_pool_state(self, capacity: int, row_units: int, dtype):
+        local_len = capacity // self.S * row_units + 1
+        return self.ctx.make_state(local_len, dtype)
+
+    def grow_pool_state(self, state, old_cap: int, new_cap: int, row_units: int, dtype):
+        extra_local = (new_cap - old_cap) // self.S * row_units + 1
+        new_state = jnp.concatenate(
+            [state[:, :-1], jnp.zeros((self.S, extra_local), dtype)], axis=1
+        )
+        return jax.device_put(new_state, self.ctx.state_sharding)
+
+    # -- builder cache (mesh.py builders are already jitted; jax handles
+    # shape polymorphism internally, so keys don't need batch sizes) -------
+
+    def _builder(self, key: tuple, make):
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            with self._lock:
+                fn = self._jit_cache.get(key)
+                if fn is None:
+                    fn = make()
+                    self._jit_cache[key] = fn
+        return fn
+
+    # -- bloom -------------------------------------------------------------
+
+    def bloom_add(self, pool, rows, m_arr, k: int, h1m, h2m) -> LazyResult:
+        B = h1m.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        fn = self._builder(
+            ("sh_bloom_add", wpr, k),
+            lambda: pm.sharded_bloom_add(self.ctx, k=k, words_per_row=wpr),
+        )
+        (rows_p, h1_p, h2_p), valid = self._pad_ops(Bp, rows, h1m, h2m)
+        m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
+        pool.state, newly = fn(pool.state, rows_p, h1_p, h2_p, m_p, valid)
+        return LazyResult(newly, B)
+
+    def bloom_contains(self, pool, rows, m_arr, k: int, h1m, h2m) -> LazyResult:
+        B = h1m.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        fn = self._builder(
+            ("sh_bloom_contains", wpr, k),
+            lambda: pm.sharded_bloom_contains(self.ctx, k=k, words_per_row=wpr),
+        )
+        (rows_p, h1_p, h2_p), valid = self._pad_ops(Bp, rows, h1m, h2m)
+        m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
+        out = fn(pool.state, rows_p, h1_p, h2_p, m_p, valid)
+        return LazyResult(out, B)
+
+    def bloom_add_fast_st(self, pool, row: int, m: int, k: int, h1m, h2m) -> LazyResult:
+        # Sharded mode has no single-tenant bit-delta fast path (the row
+        # lives on one shard anyway); route through the exact multi-tenant
+        # kernel.  Duplicate keys in one batch get exact sequential flags —
+        # a strict refinement of the fast path's pre-batch semantics.
+        rows = np.full(h1m.shape[0], row, np.int32)
+        m_arr = np.full(h1m.shape[0], m, np.uint32)
+        return self.bloom_add(pool, rows, m_arr, k, h1m, h2m)
+
+    def bloom_contains_st(self, pool, row: int, m: int, k: int, h1m, h2m) -> LazyResult:
+        rows = np.full(h1m.shape[0], row, np.int32)
+        m_arr = np.full(h1m.shape[0], m, np.uint32)
+        return self.bloom_contains(pool, rows, m_arr, k, h1m, h2m)
+
+    def bloom_count(self, pool, row: int, m: int, k: int) -> LazyResult:
+        wpr = pool.row_units
+        fn = self._builder(
+            ("sh_popcount", wpr),
+            lambda: pm.sharded_row_reduce(
+                self.ctx,
+                lambda local, lrow: bitops.popcount_row(local, lrow, wpr),
+            ),
+        )
+        x = fn(pool.state, row)
+        return LazyResult(x, transform=lambda xv: bloom_count_from_bitcount(xv, m, k))
+
+    # -- hll ---------------------------------------------------------------
+
+    def hll_add(self, pool, rows, c0, c1, c2) -> LazyResult:
+        # Flag-free PFADD (no changed machinery, no collective) — the hot
+        # bulk path; hll_add_changed serves callers that need the booleans.
+        B = c0.shape[0]
+        Bp = self._bucket(B)
+        fn = self._builder(
+            ("sh_hll_add",), lambda: pm.sharded_hll_add(self.ctx)
+        )
+        (rows_p, c0p, c1p, c2p), valid = self._pad_ops(Bp, rows, c0, c1, c2)
+        pool.state = fn(pool.state, rows_p, c0p, c1p, c2p, valid)
+        return LazyResult(True)
+
+    def _hll_add_changed(self, pool, rows, c0, c1, c2):
+        B = c0.shape[0]
+        Bp = self._bucket(B)
+        fn = self._builder(
+            ("sh_hll_add_changed",),
+            lambda: pm.sharded_hll_add_changed(self.ctx),
+        )
+        (rows_p, c0p, c1p, c2p), valid = self._pad_ops(Bp, rows, c0, c1, c2)
+        return fn(pool.state, rows_p, c0p, c1p, c2p, valid)
+
+    def hll_add_changed(self, pool, rows, c0, c1, c2) -> LazyResult:
+        B = c0.shape[0]
+        pool.state, changed = self._hll_add_changed(pool, rows, c0, c1, c2)
+        return LazyResult(changed, B)
+
+    def hll_add_single(self, pool, row: int, c0, c1, c2) -> LazyResult:
+        rows = np.full(c0.shape[0], row, np.int32)
+        B = c0.shape[0]
+        pool.state, changed = self._hll_add_changed(pool, rows, c0, c1, c2)
+        return LazyResult(changed, B, transform=lambda v: bool(np.any(v)))
+
+    def hll_count(self, pool, row: int) -> LazyResult:
+        from redisson_tpu.ops import hll as hll_ops
+
+        fn = self._builder(
+            ("sh_hll_hist",),
+            lambda: pm.sharded_row_reduce(self.ctx, hll_ops.hll_histogram),
+        )
+        hist = fn(pool.state, row)
+        return LazyResult(
+            hist, transform=lambda h: int(round(golden.ertl_estimate(h)))
+        )
+
+    def hll_merge(self, pool, dst_row: int, src_rows) -> LazyResult:
+        fn = self._builder(
+            ("sh_hll_merge",), lambda: pm.sharded_hll_merge(self.ctx)
+        )
+        pool.state = fn(
+            pool.state, dst_row, jnp.asarray(np.asarray(src_rows, np.int32))
+        )
+        return LazyResult(None)
+
+    # -- bitset ------------------------------------------------------------
+
+    def _bitset_rw(self, opname, kernel, pool, rows, idx):
+        B = idx.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        fn = self._builder(
+            ("sh_" + opname, wpr),
+            lambda: pm.sharded_bitset_rw(self.ctx, kernel, words_per_row=wpr),
+        )
+        (rows_p, idx_p), valid = self._pad_ops(Bp, rows, idx)
+        pool.state, prev = fn(pool.state, rows_p, idx_p, valid)
+        return LazyResult(prev, B)
+
+    def bitset_set(self, pool, rows, idx) -> LazyResult:
+        return self._bitset_rw("bs_set", bitset_ops.bitset_set, pool, rows, idx)
+
+    def bitset_clear_bits(self, pool, rows, idx) -> LazyResult:
+        return self._bitset_rw("bs_clear", bitset_ops.bitset_clear, pool, rows, idx)
+
+    def bitset_flip(self, pool, rows, idx) -> LazyResult:
+        return self._bitset_rw("bs_flip", bitset_ops.bitset_flip, pool, rows, idx)
+
+    def bitset_get(self, pool, rows, idx) -> LazyResult:
+        B = idx.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        fn = self._builder(
+            ("sh_bs_get", wpr),
+            lambda: pm.sharded_bitset_get(self.ctx, words_per_row=wpr),
+        )
+        (rows_p, idx_p), valid = self._pad_ops(Bp, rows, idx)
+        out = fn(pool.state, rows_p, idx_p, valid)
+        return LazyResult(out, B)
+
+    def bitset_set_range(self, pool, row: int, from_bit: int, to_bit: int, value: bool) -> LazyResult:
+        wpr = pool.row_units
+        fn = self._builder(
+            ("sh_bs_setrange", wpr, bool(value)),
+            lambda: pm.sharded_bitset_set_range(
+                self.ctx, words_per_row=wpr, value=value
+            ),
+        )
+        pool.state = fn(pool.state, row, from_bit, to_bit)
+        return LazyResult(None)
+
+    def bitset_cardinality(self, pool, row) -> LazyResult:
+        wpr = pool.row_units
+        fn = self._builder(
+            ("sh_bs_card", wpr),
+            lambda: pm.sharded_row_reduce(
+                self.ctx, lambda local, lrow: bitops.popcount_row(local, lrow, wpr)
+            ),
+        )
+        return LazyResult(fn(pool.state, row), transform=int)
+
+    def bitset_length(self, pool, row) -> LazyResult:
+        wpr = pool.row_units
+        fn = self._builder(
+            ("sh_bs_len", wpr),
+            lambda: pm.sharded_row_reduce(
+                self.ctx, lambda local, lrow: bitops.bit_length_row(local, lrow, wpr)
+            ),
+        )
+        return LazyResult(fn(pool.state, row), transform=int)
+
+    def bitset_bitpos(self, pool, row, target_bit: int) -> LazyResult:
+        wpr = pool.row_units
+        fn = self._builder(
+            ("sh_bs_pos", wpr, target_bit),
+            lambda: pm.sharded_row_reduce(
+                self.ctx,
+                lambda local, lrow: bitops.bitpos_row(
+                    local, lrow, wpr, target_bit
+                ),
+            ),
+        )
+        return LazyResult(fn(pool.state, row), transform=int)
+
+    def bitset_bitop(self, pool, dst_row: int, src_rows, op: str, limit_bits=None) -> LazyResult:
+        wpr = pool.row_units
+        S_src = len(src_rows)
+        masked = limit_bits is not None
+        fn = self._builder(
+            ("sh_bs_bitop", wpr, S_src, op, masked),
+            lambda: pm.sharded_bitop(
+                self.ctx, words_per_row=wpr, op=op, n_src=S_src, masked=masked
+            ),
+        )
+        pool.state = fn(
+            pool.state,
+            dst_row,
+            jnp.asarray(np.asarray(src_rows, np.int32)),
+            np.int64(limit_bits if masked else 0),
+        )
+        return LazyResult(None)
+
+    def bitset_get_row(self, pool, row) -> LazyResult:
+        return LazyResult(self._read_row_device(pool, row))
+
+    # -- cms ---------------------------------------------------------------
+
+    def cms_update(self, pool, rows, h1w, h2w, weights, d: int, w: int) -> LazyResult:
+        B = h1w.shape[0]
+        Bp = self._bucket(B)
+        u = pool.row_units
+        fn = self._builder(
+            ("sh_cms_upd", u, d, w),
+            lambda: pm.sharded_cms_update_estimate(
+                self.ctx, d=d, w=w, cells_per_row=u, update_only=True
+            ),
+        )
+        (rows_p, h1p, h2p, w_p), valid = self._pad_ops(Bp, rows, h1w, h2w, weights)
+        pool.state = fn(pool.state, rows_p, h1p, h2p, w_p, valid)
+        return LazyResult(None)
+
+    def cms_estimate(self, pool, rows, h1w, h2w, d: int, w: int) -> LazyResult:
+        B = h1w.shape[0]
+        Bp = self._bucket(B)
+        u = pool.row_units
+        fn = self._builder(
+            ("sh_cms_est", u, d, w),
+            lambda: pm.sharded_cms_update_estimate(
+                self.ctx, d=d, w=w, cells_per_row=u, estimate_only=True
+            ),
+        )
+        (rows_p, h1p, h2p), valid = self._pad_ops(Bp, rows, h1w, h2w)
+        w_p = jnp.zeros((Bp,), jnp.uint32)
+        out = fn(pool.state, rows_p, h1p, h2p, w_p, valid)
+        return LazyResult(out, B)
+
+    def cms_update_estimate(self, pool, rows, h1w, h2w, weights, d: int, w: int) -> LazyResult:
+        B = h1w.shape[0]
+        Bp = self._bucket(B)
+        u = pool.row_units
+        fn = self._builder(
+            ("sh_cms_updest", u, d, w),
+            lambda: pm.sharded_cms_update_estimate(
+                self.ctx, d=d, w=w, cells_per_row=u
+            ),
+        )
+        (rows_p, h1p, h2p, w_p), valid = self._pad_ops(Bp, rows, h1w, h2w, weights)
+        pool.state, est = fn(pool.state, rows_p, h1p, h2p, w_p, valid)
+        return LazyResult(est, B)
+
+    def cms_merge(self, pool, dst_row: int, src_rows) -> LazyResult:
+        u = pool.row_units
+        fn = self._builder(
+            ("sh_cms_merge", u),
+            lambda: pm.sharded_cms_merge(self.ctx, cells_per_row=u),
+        )
+        pool.state = fn(
+            pool.state, dst_row, jnp.asarray(np.asarray(src_rows, np.int32))
+        )
+        return LazyResult(None)
+
+    # -- generic -----------------------------------------------------------
+
+    def _read_row_device(self, pool, row: int):
+        u = pool.row_units
+        dtype = str(pool.spec.dtype)
+        fn = self._builder(
+            ("sh_read_row", u, dtype),
+            lambda: pm.sharded_row_read(self.ctx, row_units=u),
+        )
+        return fn(pool.state, row)
+
+    def read_row(self, pool, row: int) -> np.ndarray:
+        return np.asarray(self._read_row_device(pool, row))
+
+    def write_row(self, pool, row: int, data: np.ndarray) -> None:
+        u = pool.row_units
+        dtype = str(pool.spec.dtype)
+        fn = self._builder(
+            ("sh_write_row", u, dtype),
+            lambda: pm.sharded_row_write(self.ctx, row_units=u),
+        )
+        pool.state = fn(pool.state, row, jnp.asarray(data))
+
+    def zero_row(self, pool, row: int) -> None:
+        self.write_row(
+            pool, row, np.zeros(pool.row_units, dtype=pool.spec.dtype)
+        )
+
+
+# Same donated-buffer discipline as the base class, over the shared method
+# list (the subclass defines fresh functions, so the base class's wrapping
+# does not carry over; the shared tuple keeps the two executors in lockstep).
+for _name in DISPATCH_METHODS:
+    _impl = ShardedTpuCommandExecutor.__dict__.get(_name)
+    if _impl is not None:  # methods not overridden inherit the wrapped base
+        setattr(ShardedTpuCommandExecutor, _name, _locked(_impl))

@@ -78,6 +78,17 @@ def _pow2ceil(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+def bloom_count_from_bitcount(x, m: int, k: int) -> int:
+    """BITCOUNT inversion n ≈ -m/k·ln(1 - X/m) (→ RedissonBloomFilter#count);
+    shared by the single-device and sharded executors."""
+    import math
+
+    x = int(x)
+    if x >= m:
+        return m
+    return int(round(-m / k * math.log(1 - x / m)))
+
+
 class TpuCommandExecutor:
     """All dispatch methods are serialized by a global lock (see module
     docstring): pool.state buffers are donated, so two concurrent dispatches
@@ -90,10 +101,20 @@ class TpuCommandExecutor:
         self._lock = threading.Lock()
         self._dispatch_lock = threading.RLock()
 
-    # -- state factory (injected into pools) -------------------------------
+    # -- pool-state factory (the executor owns array layout; pools only
+    # hand out row numbers) ------------------------------------------------
 
-    def make_state(self, n: int, dtype):
-        return jnp.zeros((n,), dtype)
+    def round_capacity(self, capacity: int) -> int:
+        return capacity
+
+    def make_pool_state(self, capacity: int, row_units: int, dtype):
+        """Flat [capacity*row_units + 1]; trailing scratch element."""
+        return jnp.zeros((capacity * row_units + 1,), dtype)
+
+    def grow_pool_state(self, state, old_cap: int, new_cap: int, row_units: int, dtype):
+        extra = jnp.zeros(((new_cap - old_cap) * row_units + 1,), dtype)
+        # state[:-1] drops the old scratch element; extra brings the new one.
+        return jnp.concatenate([state[:-1], extra])
 
     # -- jit plumbing ------------------------------------------------------
 
@@ -217,16 +238,7 @@ class TpuCommandExecutor:
 
         fn = self._jit(key, build, donate=False)
         x = fn(pool.state, row)
-
-        def finish(xv):
-            import math
-
-            xv = int(xv)
-            if xv >= m:
-                return m
-            return int(round(-m / k * math.log(1 - xv / m)))
-
-        return LazyResult(x, transform=finish)
+        return LazyResult(x, transform=lambda xv: bloom_count_from_bitcount(xv, m, k))
 
     # -- hll ---------------------------------------------------------------
 
@@ -555,9 +567,10 @@ def _locked(fn):
     return wrapper
 
 
-# Serialize every method that reads or swaps pool.state (donated buffers +
-# concurrent threads would otherwise race, see class docstring).
-for _name in (
+# Every method that reads or swaps pool.state (donated buffers + concurrent
+# threads would otherwise race, see class docstring).  Shared with the
+# sharded executor so the two wrap lists cannot drift.
+DISPATCH_METHODS = (
     "bloom_add",
     "bloom_contains",
     "bloom_add_fast_st",
@@ -585,5 +598,7 @@ for _name in (
     "zero_row",
     "read_row",
     "write_row",
-):
+)
+
+for _name in DISPATCH_METHODS:
     setattr(TpuCommandExecutor, _name, _locked(getattr(TpuCommandExecutor, _name)))

@@ -60,14 +60,16 @@ class TpuSketchEngine:
         from redisson_tpu.serve.metrics import Metrics
 
         self.config = config
-        if config.tpu_sketch.num_shards not in (0, 1):
-            raise NotImplementedError(
-                "num_shards > 1: sharded-executor integration is not wired "
-                "yet (the sharded kernels exist in parallel/mesh.py)"
+        if config.tpu_sketch.num_shards > 1:
+            from redisson_tpu.executor.sharded_executor import (
+                ShardedTpuCommandExecutor,
             )
-        self.executor = TpuCommandExecutor(config)
+
+            self.executor = ShardedTpuCommandExecutor(config)
+        else:
+            self.executor = TpuCommandExecutor(config)
         self.registry = TenantRegistry(
-            self.executor.make_state,
+            self.executor,
             initial_capacity=config.tpu_sketch.initial_tenants_per_class,
             dispatch_lock=self.executor._dispatch_lock,
         )

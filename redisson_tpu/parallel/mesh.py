@@ -14,10 +14,11 @@ shards along words instead: global word g → shard ``g // W_local``
 (contiguous blocks, so range ops touch few shards).
 
 These functions return jitted closures bound to a mesh.  They are exercised
-by the parallel test suite and the driver's ``dryrun_multichip`` on a
-virtual CPU mesh (SURVEY.md §4's "many redis-servers on one host" analog);
-executor integration (``config.num_shards``) is tracked work — the engine
-rejects num_shards > 1 until it lands.
+three ways: directly by the parallel test suite, by the driver's
+``dryrun_multichip`` on a virtual CPU mesh (SURVEY.md §4's "many
+redis-servers on one host" analog), and from the public API through
+``ShardedTpuCommandExecutor`` (executor/sharded_executor.py) when
+``Config.use_tpu_sketch(num_shards=S)`` selects cluster mode.
 """
 
 from __future__ import annotations
@@ -252,13 +253,15 @@ def sharded_hll_merge(ctx: MeshContext):
     return jax.jit(fn, donate_argnums=(0,))
 
 
-def sharded_bitop(ctx: MeshContext, *, words_per_row: int, op: str, n_src: int):
+def sharded_bitop(ctx: MeshContext, *, words_per_row: int, op: str, n_src: int, masked: bool = False):
     """BITOP across shards: operand rows are broadcast via psum (each shard
     contributes rows it owns, zeros otherwise), every shard computes the op,
-    only the dst owner writes the result."""
+    only the dst owner writes the result.  ``masked`` (NOT path): the
+    complement is ANDed with a [0, limit_bits) mask — the byte-aligned
+    logical-length semantics of engines.bitset_bitop."""
     S = ctx.n_shards
 
-    def inner(state, dst_row, src_rows):
+    def inner(state, dst_row, src_rows, limit):
         local = state[0]
         my = lax.axis_index("shard")
         rows2d = local[:-1].reshape(-1, words_per_row)
@@ -281,6 +284,8 @@ def sharded_bitop(ctx: MeshContext, *, words_per_row: int, op: str, n_src: int):
                 res = res ^ full[i]
         elif op == "not":
             res = ~full[0]
+            if masked:
+                res = res & bitops.range_mask_words(words_per_row, 0, limit)
         else:
             raise ValueError(op)
         own_dst = (dst_row % S) == my
@@ -289,6 +294,239 @@ def sharded_bitop(ctx: MeshContext, *, words_per_row: int, op: str, n_src: int):
         new_row = jnp.where(own_dst, res, cur)
         new_local = bitops.row_update(local, dst_local, new_row, words_per_row)
         return new_local[None]
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P(), P()),
+        out_specs=P("shard"),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+# --------------------------------------------------------------------------
+# Builders for the sharded executor (executor/sharded_executor.py): the
+# remaining op surface — bitset single-bit batches, row scalars/reads/
+# writes, CMS, HLL changed-flags — in the same ownership-mask pattern.
+# --------------------------------------------------------------------------
+
+
+def sharded_bitset_rw(ctx: MeshContext, kernel, *, words_per_row: int):
+    """SETBIT/clear/flip batch: ``kernel`` is one of ops.bitset.bitset_set/
+    bitset_clear/bitset_flip.  Returns fn(state, rows, idx, valid) ->
+    (new_state, prev bool[B]) with exact single-device semantics."""
+    S = ctx.n_shards
+
+    def inner(state, rows, idx, valid):
+        local = state[0]
+        own, lrows = _own_and_local(rows, valid, S)
+        new_local, prev = kernel(
+            local, lrows, idx, words_per_row=words_per_row, valid=own
+        )
+        prev = lax.psum(jnp.where(own, prev, False).astype(jnp.int32), "shard")
+        return new_local[None], prev > 0
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P(), P()),
+        out_specs=(P("shard"), P()),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def sharded_bitset_get(ctx: MeshContext, *, words_per_row: int):
+    from redisson_tpu.ops import bitset as bitset_ops
+
+    S = ctx.n_shards
+
+    def inner(state, rows, idx, valid):
+        local = state[0]
+        own, lrows = _own_and_local(rows, valid, S)
+        res = bitset_ops.bitset_get(local, lrows, idx, words_per_row=words_per_row)
+        res = lax.psum(jnp.where(own, res, False).astype(jnp.int32), "shard")
+        return res > 0
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P(), P()),
+        out_specs=P(),
+    )
+    return jax.jit(fn)
+
+
+def sharded_bitset_set_range(ctx: MeshContext, *, words_per_row: int, value: bool):
+    S = ctx.n_shards
+
+    def inner(state, row, from_bit, to_bit):
+        local = state[0]
+        my = lax.axis_index("shard")
+        own = (row % S) == my
+        lrow = row // S
+        mask = bitops.range_mask_words(words_per_row, from_bit, to_bit)
+        cur = bitops.row_slice(local, lrow, words_per_row)
+        new_row = (cur | mask) if value else (cur & ~mask)
+        new_row = jnp.where(own, new_row, cur)
+        return bitops.row_update(local, lrow, new_row, words_per_row)[None]
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P(), P()),
+        out_specs=P("shard"),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def sharded_row_reduce(ctx: MeshContext, fn_local):
+    """Owner-computes-scalar pattern: ``fn_local(local_state, local_row)``
+    runs on the owning shard; everyone else contributes zeros to the psum.
+    Serves BITCOUNT/length/bitpos/popcount/histogram (vector results psum
+    elementwise the same way)."""
+    S = ctx.n_shards
+
+    def inner(state, row):
+        local = state[0]
+        my = lax.axis_index("shard")
+        own = (row % S) == my
+        v = fn_local(local, row // S)
+        return lax.psum(jnp.where(own, v, 0), "shard")
+
+    fn = jax.shard_map(
+        inner, mesh=ctx.mesh, in_specs=(P("shard"), P()), out_specs=P()
+    )
+    return jax.jit(fn)
+
+
+def sharded_row_read(ctx: MeshContext, *, row_units: int):
+    """Fetch one tenant row to every shard (psum broadcast from the owner)."""
+
+    S = ctx.n_shards
+
+    def inner(state, row):
+        local = state[0]
+        my = lax.axis_index("shard")
+        own = (row % S) == my
+        v = bitops.row_slice(local, row // S, row_units)
+        # Only the owner contributes non-zeros, so a native-dtype psum is an
+        # exact broadcast (no overflow possible).
+        return lax.psum(jnp.where(own, v, jnp.zeros_like(v)), "shard")
+
+    fn = jax.shard_map(
+        inner, mesh=ctx.mesh, in_specs=(P("shard"), P()), out_specs=P()
+    )
+    return jax.jit(fn)
+
+
+def sharded_row_write(ctx: MeshContext, *, row_units: int):
+    """Overwrite one tenant row (only the owner applies the update)."""
+    S = ctx.n_shards
+
+    def inner(state, row, data):
+        local = state[0]
+        my = lax.axis_index("shard")
+        own = (row % S) == my
+        lrow = row // S
+        cur = bitops.row_slice(local, lrow, row_units)
+        new_row = jnp.where(own, data, cur)
+        return bitops.row_update(local, lrow, new_row, row_units)[None]
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P()),
+        out_specs=P("shard"),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def sharded_hll_add_changed(ctx: MeshContext):
+    """Multi-tenant PFADD with exact per-op changed flags (coalesced path).
+    Ops on different shards touch different rows, so per-shard sequential
+    semantics compose exactly."""
+    S = ctx.n_shards
+
+    def inner(state, rows, c0, c1, c2, valid):
+        local = state[0]
+        own, lrows = _own_and_local(rows, valid, S)
+        new_local, changed = hll_ops.hll_add_changed(
+            local, jnp.where(own, lrows, 0), c0, c1, c2, valid=own
+        )
+        changed = lax.psum(jnp.where(own, changed, False).astype(jnp.int32), "shard")
+        return new_local[None], changed > 0
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P(), P(), P(), P()),
+        out_specs=(P("shard"), P()),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def sharded_cms_update_estimate(ctx: MeshContext, *, d: int, w: int, cells_per_row: int, estimate_only: bool = False, update_only: bool = False):
+    """CMS update/estimate/fused: non-owned ops scatter weight 0 (the add
+    identity) into shard-local cells, and estimates psum from the owner."""
+    from redisson_tpu.ops import cms as cms_ops
+
+    S = ctx.n_shards
+
+    def inner(state, rows, h1w, h2w, weights, valid):
+        local = state[0]
+        own, lrows = _own_and_local(rows, valid, S)
+        safe_rows = jnp.where(own, lrows, 0)
+        if estimate_only:
+            new_local = local
+        else:
+            wts = jnp.where(own, weights, 0)
+            new_local = cms_ops.cms_update(
+                local, safe_rows, h1w, h2w, wts, d=d, w=w, cells_per_row=cells_per_row
+            )
+        if update_only:
+            return new_local[None]
+        est = cms_ops.cms_estimate(
+            new_local, safe_rows, h1w, h2w, d=d, w=w, cells_per_row=cells_per_row
+        )
+        est = lax.psum(jnp.where(own, est, 0), "shard")
+        if estimate_only:
+            return est
+        return new_local[None], est
+
+    specs_in = (P("shard"), P(), P(), P(), P(), P())
+    if estimate_only:
+        out = P()
+        donate = ()
+    elif update_only:
+        out = P("shard")
+        donate = (0,)
+    else:
+        out = (P("shard"), P())
+        donate = (0,)
+    fn = jax.shard_map(inner, mesh=ctx.mesh, in_specs=specs_in, out_specs=out)
+    return jax.jit(fn, donate_argnums=donate)
+
+
+def sharded_cms_merge(ctx: MeshContext, *, cells_per_row: int):
+    """CMS merge: sources broadcast via psum gather, dst owner adds the sum
+    (CMS is linear)."""
+    S = ctx.n_shards
+
+    def inner(state, dst_row, src_rows):
+        local = state[0]
+        my = lax.axis_index("shard")
+        rows2d = local[:-1].reshape(-1, cells_per_row)
+        own_src = (src_rows % S) == my
+        gathered = jnp.where(
+            own_src[:, None], rows2d[jnp.where(own_src, src_rows // S, 0)], 0
+        )
+        full = lax.psum(gathered, "shard")
+        summed = full.sum(axis=0, dtype=jnp.uint32)
+        own_dst = (dst_row % S) == my
+        dst_local = jnp.where(own_dst, dst_row // S, 0)
+        cur = bitops.row_slice(local, dst_local, cells_per_row)
+        new_row = jnp.where(own_dst, cur + summed, cur)
+        return bitops.row_update(local, dst_local, new_row, cells_per_row)[None]
 
     fn = jax.shard_map(
         inner, mesh=ctx.mesh, in_specs=(P("shard"), P(), P()), out_specs=P("shard")

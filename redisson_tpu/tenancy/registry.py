@@ -78,21 +78,23 @@ def spec_for(kind: str, class_key: tuple) -> PoolSpec:
 class SizeClassPool:
     """One stacked device array holding all tenants of a size class."""
 
-    def __init__(self, spec: PoolSpec, capacity: int, make_state, dispatch_lock=None):
+    def __init__(self, spec: PoolSpec, capacity: int, factory, dispatch_lock=None):
         self.spec = spec
-        self.capacity = capacity
-        # make_state(n_elements, dtype) -> device array; injected by the
-        # executor so this layer stays device-agnostic (host tests can pass
-        # numpy).
-        self._make_state = make_state
+        # The factory (the executor) owns state layout: flat [T*W+1] on one
+        # device, or [S, local] sharded over a mesh.  This layer only hands
+        # out row numbers and never touches array internals.
+        self._factory = factory
+        self.capacity = factory.round_capacity(capacity)
         # Growth swaps self.state; a concurrently flushing coalesced write
         # donates the same buffer and reassigns state with the old-shaped
         # output, losing the growth (or hitting use-after-donate).  Taking
         # the executor's dispatch lock around the read-concat-swap makes
         # growth atomic w.r.t. every dispatch.
         self._dispatch_lock = dispatch_lock or threading.RLock()
-        self.state = make_state(capacity * spec.row_units + 1, spec.dtype)
-        self._free: list[int] = list(range(capacity - 1, -1, -1))
+        self.state = factory.make_pool_state(
+            self.capacity, spec.row_units, spec.dtype
+        )
+        self._free: list[int] = list(range(self.capacity - 1, -1, -1))
         self.generation = 0  # bumped on every growth (jit cache key part)
 
     @property
@@ -114,14 +116,11 @@ class SizeClassPool:
         self._free.append(row)
 
     def _grow(self) -> None:
-        import jax.numpy as jnp
-
         old_cap = self.capacity
         new_cap = old_cap * 2
-        u = self.spec.row_units
-        extra = self._make_state((new_cap - old_cap) * u + 1, self.spec.dtype)
-        # state[:-1] drops the old scratch word; extra brings the new one.
-        self.state = jnp.concatenate([self.state[:-1], extra])
+        self.state = self._factory.grow_pool_state(
+            self.state, old_cap, new_cap, self.spec.row_units, self.spec.dtype
+        )
         self.capacity = new_cap
         self.generation += 1
         self._free.extend(range(new_cap - 1, old_cap - 1, -1))
@@ -143,8 +142,8 @@ class TenantEntry:
 
 
 class TenantRegistry:
-    def __init__(self, make_state, initial_capacity: int = 8, dispatch_lock=None):
-        self._make_state = make_state
+    def __init__(self, factory, initial_capacity: int = 8, dispatch_lock=None):
+        self._factory = factory
         self._initial_capacity = initial_capacity
         self._dispatch_lock = dispatch_lock
         self._lock = threading.RLock()
@@ -163,7 +162,7 @@ class TenantRegistry:
                 pool = SizeClassPool(
                     spec,
                     self._initial_capacity,
-                    self._make_state,
+                    self._factory,
                     dispatch_lock=self._dispatch_lock,
                 )
                 self._pools[spec.key] = pool

@@ -123,14 +123,14 @@ def test_sharded_bitop(ctx):
     m_arr = np.full(5, W * 32, np.uint32)
     state, _ = setf(state, rows, bits, np.zeros(5, np.uint32), m_arr, np.ones(5, bool))
     opf = pm.sharded_bitop(ctx, words_per_row=W, op="or", n_src=2)
-    state = opf(state, 0, np.array([1, 2], np.int32))
+    state = opf(state, 0, np.array([1, 2], np.int32), np.int64(0))
     host = np.asarray(state)
     # row 0 lives on shard 0, local row 0
     words = host[0][:W]
     got = np.unpackbits(words.view(np.uint8), bitorder="little")
     assert sorted(np.nonzero(got)[0].tolist()) == [3, 40, 50, 60]
     opf_and = pm.sharded_bitop(ctx, words_per_row=W, op="and", n_src=2)
-    state = opf_and(state, 0, np.array([1, 2], np.int32))
+    state = opf_and(state, 0, np.array([1, 2], np.int32), np.int64(0))
     host = np.asarray(state)
     got = np.unpackbits(host[0][:W].view(np.uint8), bitorder="little")
     assert sorted(np.nonzero(got)[0].tolist()) == [40]

@@ -1,0 +1,167 @@
+"""Public-API end-to-end tests on the 8-shard virtual CPU mesh.
+
+The sharded-cluster analog of the reference's "many redis-servers on one
+host" integration tests (SURVEY.md §4): same client code as single-device
+mode, with ``use_tpu_sketch(num_shards=8)`` routing every op through
+ShardedTpuCommandExecutor's shard_map kernels and ICI collectives.
+"""
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+
+
+@pytest.fixture(params=["coalesced", "direct"])
+def client(request):
+    cfg = Config().use_tpu_sketch(
+        num_shards=8,
+        min_bucket=64,
+        coalesce=(request.param == "coalesced"),
+        batch_window_us=100,
+    )
+    cl = redisson_tpu.create(cfg)
+    yield cl
+    cl.shutdown()
+
+
+@pytest.fixture
+def host_client():
+    return redisson_tpu.create(Config())
+
+
+def test_bloom_end_to_end_matches_host(client, host_client):
+    keys = [f"key-{i}" for i in range(500)]
+    probes = [f"probe-{i}" for i in range(500)]
+    for cl in (client, host_client):
+        bf = cl.get_bloom_filter("bf")
+        assert bf.try_init(2000, 0.01) is True
+        assert bf.try_init(2000, 0.01) is False
+        bf.add_all(keys)
+    tpu_bf = client.get_bloom_filter("bf")
+    host_bf = host_client.get_bloom_filter("bf")
+    assert all(tpu_bf.contains_each(keys))
+    # Same hash material in both engines -> identical membership answers.
+    np.testing.assert_array_equal(
+        tpu_bf.contains_each(probes), host_bf.contains_each(probes)
+    )
+    assert abs(tpu_bf.count() - host_bf.count()) == 0
+
+
+def test_many_tenants_spread_over_shards(client):
+    # More tenants than shards: forces multi-row-per-shard placement and
+    # pool growth across the mesh.
+    filters = []
+    for t in range(20):
+        bf = client.get_bloom_filter(f"tenant-{t}")
+        bf.try_init(500, 0.01)
+        bf.add_all([f"t{t}-k{i}" for i in range(50)])
+        filters.append(bf)
+    for t, bf in enumerate(filters):
+        assert all(bf.contains_each([f"t{t}-k{i}" for i in range(50)]))
+        # Other tenants' keys are (almost surely) absent.
+        misses = bf.contains_each([f"t{(t + 1) % 20}-k{i}" for i in range(50)])
+        assert np.sum(misses) <= 3
+
+
+def test_hll_count_and_merge(client, host_client):
+    for cl in (client, host_client):
+        h1 = cl.get_hyper_log_log("h1")
+        h2 = cl.get_hyper_log_log("h2")
+        h1.add_all([f"a-{i}" for i in range(5000)])
+        h2.add_all([f"b-{i}" for i in range(5000)])
+        h1.merge_with("h2")
+    tpu = client.get_hyper_log_log("h1").count()
+    host = host_client.get_hyper_log_log("h1").count()
+    assert tpu == host  # identical registers -> identical estimate
+    assert abs(tpu - 10000) / 10000 < 0.05
+
+
+def test_hll_add_returns_changed(client):
+    h = client.get_hyper_log_log("chg")
+    assert h.add("x") is True
+    assert h.add("x") is False
+
+
+def test_bitset_ops_match_host(client, host_client):
+    rng = np.random.default_rng(3)
+    idx = rng.integers(0, 5000, 300).astype(np.uint32)
+    for cl in (client, host_client):
+        bs = cl.get_bit_set("bits")
+        bs.set_many(idx)
+        bs.flip(7)
+        bs.set_range(100, 164)
+        cl._engine.bitset_bitop("bits2", ("bits",), "not")
+    a, b = client.get_bit_set("bits"), host_client.get_bit_set("bits")
+    assert a.cardinality() == b.cardinality()
+    assert a.length() == b.length()
+    assert a.to_byte_array() == b.to_byte_array()
+    assert (
+        client.get_bit_set("bits2").cardinality()
+        == host_client.get_bit_set("bits2").cardinality()
+    )
+    probe = rng.integers(0, 6000, 200).astype(np.uint32)
+    np.testing.assert_array_equal(a.get_many(probe), b.get_many(probe))
+
+
+def test_bitset_growth_migration(client):
+    bs = client.get_bit_set("grower")
+    bs.set(10)
+    bs.set(100_000)  # forces size-class migration across the mesh
+    assert bs.get(10) is True
+    assert bs.get(100_000) is True
+    assert bs.cardinality() == 2
+
+
+def test_cms_estimates_match_host(client, host_client):
+    rng = np.random.default_rng(11)
+    stream = [f"item-{int(z)}" for z in rng.zipf(1.3, 3000)]
+    for cl in (client, host_client):
+        c = cl.get_count_min_sketch("cms")
+        c.try_init(4, 1 << 10)
+        c.add_all(stream)
+        c2 = cl.get_count_min_sketch("cms2")
+        c2.try_init(4, 1 << 10)
+        c2.add_all(stream[:500])
+        c.merge("cms2")
+    probes = [f"item-{i}" for i in range(1, 30)]
+    tpu = client.get_count_min_sketch("cms").estimate_all(probes)
+    host = host_client.get_count_min_sketch("cms").estimate_all(probes)
+    np.testing.assert_array_equal(np.asarray(tpu), np.asarray(host))
+
+
+def test_delete_rename_exists(client):
+    bf = client.get_bloom_filter("adm")
+    bf.try_init(100, 0.01)
+    bf.add("v")
+    assert client._engine.exists("adm")
+    assert client._engine.rename("adm", "adm2")
+    assert not client._engine.exists("adm")
+    assert client.get_bloom_filter("adm2").contains("v")
+    assert client._engine.delete("adm2")
+    assert not client._engine.exists("adm2")
+
+
+def test_concurrent_multi_tenant_traffic(client):
+    import threading
+
+    errors = []
+
+    def worker(t):
+        try:
+            bf = client.get_bloom_filter(f"conc-{t}")
+            bf.try_init(1000, 0.01)
+            for chunk in range(5):
+                keys = [f"w{t}-c{chunk}-{i}" for i in range(40)]
+                bf.add_all(keys)
+                assert all(bf.contains_each(keys))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(6)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
