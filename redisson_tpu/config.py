@@ -28,7 +28,7 @@ class TpuSketchConfig:
         self.coalesce = True  # cross-call op coalescing via flush thread
         self.batch_window_us = 200  # flush deadline
         self.max_batch = 1 << 16  # flush size threshold
-        self.min_bucket = 256  # smallest padded batch shape
+        self.min_bucket = 256  # smallest padded batch shape (floor 32: results travel bit-packed)
         self.dispatch_threads = 1  # single coalescer thread (SURVEY §5 race row)
         # Tenancy.
         self.initial_tenants_per_class = 8  # initial rows per size-class pool

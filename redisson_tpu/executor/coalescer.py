@@ -23,6 +23,7 @@ the batch's LazyResult.
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from collections import deque
@@ -77,6 +78,16 @@ class BatchCoalescer:
         self._wake = threading.Condition(self._lock)
         self._inflight = 0  # popped but not yet dispatched
         self._closed = False
+        # Dispatch and completion are decoupled: the flush thread only
+        # enqueues device work (cheap), while this thread blocks on result
+        # transfers and resolves futures.  Without it every segment's D2H
+        # round trip would serialize the flush loop — one link latency per
+        # segment instead of a deep async pipeline.
+        self._completions: "queue.Queue" = queue.Queue()
+        self._completer = threading.Thread(
+            target=self._complete_loop, name="rtpu-completer", daemon=True
+        )
+        self._completer.start()
         self._thread = threading.Thread(
             target=self._run, name="rtpu-coalescer", daemon=True
         )
@@ -154,10 +165,7 @@ class BatchCoalescer:
                 # Dispatched (device-ordered): drain() may proceed even
                 # though result transfer is still in flight.
                 self._inflight -= 1
-            res = lazy.result() if lazy is not None else None
-            for fut, start, n in seg.futures:
-                if fut.set_running_or_notify_cancel():
-                    fut.set_result(None if res is None else res[start : start + n])
+            self._completions.put((seg, lazy, t0))
         except Exception as e:  # pragma: no cover - defensive
             with self._lock:
                 if self._inflight > 0:
@@ -165,12 +173,30 @@ class BatchCoalescer:
             for fut, _, _ in seg.futures:
                 if fut.set_running_or_notify_cancel():
                     fut.set_exception(e)
-        if self.metrics is not None:
-            self.metrics.record_batch(
-                nops=seg.nops,
-                wait_s=t0 - seg.born,
-                flush_s=time.monotonic() - t0,
-            )
+
+    def _complete_loop(self) -> None:
+        while True:
+            item = self._completions.get()
+            if item is None:
+                return
+            seg, lazy, t0 = item
+            try:
+                res = lazy.result() if lazy is not None else None
+                for fut, start, n in seg.futures:
+                    if fut.set_running_or_notify_cancel():
+                        fut.set_result(
+                            None if res is None else res[start : start + n]
+                        )
+            except Exception as e:  # pragma: no cover - defensive
+                for fut, _, _ in seg.futures:
+                    if fut.set_running_or_notify_cancel():
+                        fut.set_exception(e)
+            if self.metrics is not None:
+                self.metrics.record_batch(
+                    nops=seg.nops,
+                    wait_s=t0 - seg.born,
+                    flush_s=time.monotonic() - t0,
+                )
 
     def drain(self, timeout: float = 30.0) -> None:
         """Barrier: block until every segment submitted BEFORE this call has
@@ -197,3 +223,10 @@ class BatchCoalescer:
             self._closed = True
             self._wake.notify_all()
         self._thread.join(timeout=timeout)
+        if not self._thread.is_alive():
+            # Flush thread fully drained: safe to stop the completer after
+            # the work already queued.  If the join timed out (e.g. a slow
+            # first-compile inside dispatch), leave the daemon completer
+            # running so late completions still resolve their futures.
+            self._completions.put(None)
+            self._completer.join(timeout=timeout)

@@ -39,6 +39,8 @@ from redisson_tpu.parallel import mesh as pm
 
 
 class ShardedTpuCommandExecutor(TpuCommandExecutor):
+    supports_device_hash = False  # keys arrive pre-hashed from the host
+
     def __init__(self, config):
         super().__init__(config)
         n = config.tpu_sketch.num_shards
@@ -88,12 +90,14 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         wpr = pool.row_units
         fn = self._builder(
             ("sh_bloom_add", wpr, k),
-            lambda: pm.sharded_bloom_add(self.ctx, k=k, words_per_row=wpr),
+            lambda: pm.sharded_bloom_add(
+                self.ctx, k=k, words_per_row=wpr, pack_results=True
+            ),
         )
         (rows_p, h1_p, h2_p), valid = self._pad_ops(Bp, rows, h1m, h2m)
         m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
         pool.state, newly = fn(pool.state, rows_p, h1_p, h2_p, m_p, valid)
-        return LazyResult(newly, B)
+        return LazyResult(newly, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bloom_contains(self, pool, rows, m_arr, k: int, h1m, h2m) -> LazyResult:
         B = h1m.shape[0]
@@ -101,12 +105,14 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         wpr = pool.row_units
         fn = self._builder(
             ("sh_bloom_contains", wpr, k),
-            lambda: pm.sharded_bloom_contains(self.ctx, k=k, words_per_row=wpr),
+            lambda: pm.sharded_bloom_contains(
+                self.ctx, k=k, words_per_row=wpr, pack_results=True
+            ),
         )
         (rows_p, h1_p, h2_p), valid = self._pad_ops(Bp, rows, h1m, h2m)
         m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
         out = fn(pool.state, rows_p, h1_p, h2_p, m_p, valid)
-        return LazyResult(out, B)
+        return LazyResult(out, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bloom_add_fast_st(self, pool, row: int, m: int, k: int, h1m, h2m) -> LazyResult:
         # Sharded mode has no single-tenant bit-delta fast path (the row
@@ -153,7 +159,7 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         Bp = self._bucket(B)
         fn = self._builder(
             ("sh_hll_add_changed",),
-            lambda: pm.sharded_hll_add_changed(self.ctx),
+            lambda: pm.sharded_hll_add_changed(self.ctx, pack_results=True),
         )
         (rows_p, c0p, c1p, c2p), valid = self._pad_ops(Bp, rows, c0, c1, c2)
         return fn(pool.state, rows_p, c0p, c1p, c2p, valid)
@@ -161,13 +167,16 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
     def hll_add_changed(self, pool, rows, c0, c1, c2) -> LazyResult:
         B = c0.shape[0]
         pool.state, changed = self._hll_add_changed(pool, rows, c0, c1, c2)
-        return LazyResult(changed, B)
+        return LazyResult(changed, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def hll_add_single(self, pool, row: int, c0, c1, c2) -> LazyResult:
         rows = np.full(c0.shape[0], row, np.int32)
         B = c0.shape[0]
         pool.state, changed = self._hll_add_changed(pool, rows, c0, c1, c2)
-        return LazyResult(changed, B, transform=lambda v: bool(np.any(v)))
+        return LazyResult(
+            changed,
+            transform=lambda v: bool(np.any(bitops.unpack_bool_u32(v, B))),
+        )
 
     def hll_count(self, pool, row: int) -> LazyResult:
         from redisson_tpu.ops import hll as hll_ops
@@ -198,11 +207,13 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         wpr = pool.row_units
         fn = self._builder(
             ("sh_" + opname, wpr),
-            lambda: pm.sharded_bitset_rw(self.ctx, kernel, words_per_row=wpr),
+            lambda: pm.sharded_bitset_rw(
+                self.ctx, kernel, words_per_row=wpr, pack_results=True
+            ),
         )
         (rows_p, idx_p), valid = self._pad_ops(Bp, rows, idx)
         pool.state, prev = fn(pool.state, rows_p, idx_p, valid)
-        return LazyResult(prev, B)
+        return LazyResult(prev, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bitset_set(self, pool, rows, idx) -> LazyResult:
         return self._bitset_rw("bs_set", bitset_ops.bitset_set, pool, rows, idx)
@@ -219,11 +230,13 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         wpr = pool.row_units
         fn = self._builder(
             ("sh_bs_get", wpr),
-            lambda: pm.sharded_bitset_get(self.ctx, words_per_row=wpr),
+            lambda: pm.sharded_bitset_get(
+                self.ctx, words_per_row=wpr, pack_results=True
+            ),
         )
         (rows_p, idx_p), valid = self._pad_ops(Bp, rows, idx)
         out = fn(pool.state, rows_p, idx_p, valid)
-        return LazyResult(out, B)
+        return LazyResult(out, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bitset_set_range(self, pool, row: int, from_bit: int, to_bit: int, value: bool) -> LazyResult:
         wpr = pool.row_units

@@ -27,6 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from redisson_tpu.ops import bitops
 from redisson_tpu.ops import bitset as bitset_ops
 from redisson_tpu.ops import bloom as bloom_ops
 from redisson_tpu.ops import cms as cms_ops
@@ -95,6 +96,10 @@ class TpuCommandExecutor:
     racing on the same state would hand XLA an already-consumed buffer.
     Device execution itself stays async — the lock only covers enqueue."""
 
+    # Single-device layout supports the *_keys_st device-hash kernels; the
+    # sharded executor routes encoded batches through the host hash instead.
+    supports_device_hash = True
+
     def __init__(self, config):
         self._cfg = config.tpu_sketch
         self._jit_cache: dict[tuple, object] = {}
@@ -119,7 +124,10 @@ class TpuCommandExecutor:
     # -- jit plumbing ------------------------------------------------------
 
     def _bucket(self, n: int) -> int:
-        return max(self._cfg.min_bucket, _pow2ceil(max(1, n)))
+        # Floor of 32: boolean results leave the device packed 32-per-word
+        # (bitops.pack_bool_u32), so padded batches must be 32-divisible
+        # regardless of how small the user sets min_bucket.
+        return max(32, self._cfg.min_bucket, _pow2ceil(max(1, n)))
 
     def _jit(self, key: tuple, build, donate: bool):
         fn = self._jit_cache.get(key)
@@ -143,6 +151,17 @@ class TpuCommandExecutor:
         valid[: arrays[0].shape[0]] = True
         return padded, jnp.asarray(valid)
 
+    @staticmethod
+    def _trim_lanes(blocks):
+        """Drop trailing all-zero lane columns before H2D (the kernel
+        rebuilds them, fastpath.pad_lanes); returns (trimmed, orig_lanes).
+        Halves link bytes for 8-byte keys in 16-byte blocks."""
+        L = blocks.shape[1]
+        used = L
+        while used > 1 and not np.any(blocks[:, used - 1]):
+            used -= 1
+        return blocks[:, :used], L
+
     # -- bloom -------------------------------------------------------------
 
     def bloom_add(self, pool: SizeClassPool, rows, m_arr, k: int, h1m, h2m) -> LazyResult:
@@ -153,9 +172,10 @@ class TpuCommandExecutor:
 
         def build():
             def f(state, rows, h1m, h2m, m_arr, valid):
-                return bloom_ops.bloom_add(
+                new, newly = bloom_ops.bloom_add(
                     state, rows, h1m, h2m, m=m_arr, k=k, words_per_row=wpr, valid=valid
                 )
+                return new, bitops.pack_bool_u32(newly)
             return f
 
         fn = self._jit(key, build, donate=True)
@@ -163,7 +183,7 @@ class TpuCommandExecutor:
         (rows_p, h1_p, h2_p), valid = self._pad_ops(Bp, rows, h1m, h2m)
         m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
         pool.state, newly = fn(pool.state, rows_p, h1_p, h2_p, m_p, valid)
-        return LazyResult(newly, B)
+        return LazyResult(newly, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bloom_contains(self, pool, rows, m_arr, k: int, h1m, h2m) -> LazyResult:
         B = h1m.shape[0]
@@ -173,16 +193,16 @@ class TpuCommandExecutor:
 
         def build():
             def f(state, rows, h1m, h2m, m_arr):
-                return bloom_ops.bloom_contains(
+                return bitops.pack_bool_u32(bloom_ops.bloom_contains(
                     state, rows, h1m, h2m, m=m_arr, k=k, words_per_row=wpr
-                )
+                ))
             return f
 
         fn = self._jit(key, build, donate=False)
         (rows_p, h1_p, h2_p), _ = self._pad_ops(Bp, rows, h1m, h2m)
         m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
         out = fn(pool.state, rows_p, h1_p, h2_p, m_p)
-        return LazyResult(out, B)
+        return LazyResult(out, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bloom_add_fast_st(self, pool, row: int, m: int, k: int, h1m, h2m) -> LazyResult:
         """Single-tenant fast add (snapshot newly semantics, see
@@ -194,9 +214,10 @@ class TpuCommandExecutor:
 
         def build():
             def f(state, row, h1m, h2m, m, valid):
-                return fastpath.bloom_add_fast_st(
+                new, newly = fastpath.bloom_add_fast_st(
                     state, row, h1m, h2m, m, valid, k=k, words_per_row=wpr
                 )
+                return new, bitops.pack_bool_u32(newly)
             return f
 
         fn = self._jit(key, build, donate=True)
@@ -204,7 +225,7 @@ class TpuCommandExecutor:
         pool.state, newly = fn(
             pool.state, np.int32(row), h1_p, h2_p, np.uint32(m), valid
         )
-        return LazyResult(newly, B)
+        return LazyResult(newly, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bloom_contains_st(self, pool, row: int, m: int, k: int, h1m, h2m) -> LazyResult:
         """Single-tenant contains; bit-exact, fewer transfers."""
@@ -215,15 +236,122 @@ class TpuCommandExecutor:
 
         def build():
             def f(state, row, h1m, h2m, m):
-                return fastpath.bloom_contains_st(
+                return bitops.pack_bool_u32(fastpath.bloom_contains_st(
                     state, row, h1m, h2m, m, k=k, words_per_row=wpr
-                )
+                ))
             return f
 
         fn = self._jit(key, build, donate=False)
         (h1_p, h2_p), _ = self._pad_ops(Bp, h1m, h2m)
         out = fn(pool.state, np.int32(row), h1_p, h2_p, np.uint32(m))
-        return LazyResult(out, B)
+        return LazyResult(out, transform=lambda v: bitops.unpack_bool_u32(v, B))
+
+    def bloom_add_keys_st(self, pool, row: int, m: int, k: int, blocks, lengths) -> LazyResult:
+        """Single-tenant add from raw codec lanes — murmur + 64-bit mod run
+        in-kernel (ops/fastpath.py device-hash path), so the host ships only
+        the key bytes."""
+        B = blocks.shape[0]
+        Bp = self._bucket(B)
+        blocks, L = self._trim_lanes(blocks)
+        Lt = blocks.shape[1]
+        wpr = pool.row_units
+        const_len = bool(B == 0 or np.all(lengths == lengths[0]))
+        key = ("bloom_add_keys", wpr, pool.state.shape[0], Bp, k, L, Lt, const_len)
+
+        def build():
+            def f(state, row, blocks, lengths, m, valid):
+                new, newly = fastpath.bloom_add_keys_st(
+                    state, row, blocks, lengths, m, valid,
+                    k=k, words_per_row=wpr, target_lanes=L,
+                )
+                return new, bitops.pack_bool_u32(newly)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        blocks_p = np.zeros((Bp, Lt), np.uint32)
+        blocks_p[:B] = blocks
+        valid = np.zeros(Bp, bool)
+        valid[:B] = True
+        len_arg = (
+            np.uint32(lengths[0] if B else 0)
+            if const_len
+            else jnp.asarray(self._pad(np.asarray(lengths, np.uint32), Bp))
+        )
+        pool.state, newly = fn(
+            pool.state,
+            np.int32(row),
+            jnp.asarray(blocks_p),
+            len_arg,
+            np.uint32(m),
+            jnp.asarray(valid),
+        )
+        return LazyResult(newly, transform=lambda v: bitops.unpack_bool_u32(v, B))
+
+    def bloom_contains_keys_st(self, pool, row: int, m: int, k: int, blocks, lengths) -> LazyResult:
+        """Single-tenant contains from raw codec lanes (device-side hash)."""
+        B = blocks.shape[0]
+        Bp = self._bucket(B)
+        blocks, L = self._trim_lanes(blocks)
+        Lt = blocks.shape[1]
+        wpr = pool.row_units
+        const_len = bool(B == 0 or np.all(lengths == lengths[0]))
+        key = ("bloom_contains_keys", wpr, pool.state.shape[0], Bp, k, L, Lt, const_len)
+
+        def build():
+            def f(state, row, blocks, lengths, m):
+                return bitops.pack_bool_u32(fastpath.bloom_contains_keys_st(
+                    state, row, blocks, lengths, m,
+                    k=k, words_per_row=wpr, target_lanes=L,
+                ))
+            return f
+
+        fn = self._jit(key, build, donate=False)
+        blocks_p = np.zeros((Bp, Lt), np.uint32)
+        blocks_p[:B] = blocks
+        len_arg = (
+            np.uint32(lengths[0] if B else 0)
+            if const_len
+            else jnp.asarray(self._pad(np.asarray(lengths, np.uint32), Bp))
+        )
+        out = fn(
+            pool.state, np.int32(row), jnp.asarray(blocks_p), len_arg, np.uint32(m)
+        )
+        return LazyResult(out, transform=lambda v: bitops.unpack_bool_u32(v, B))
+
+    def hll_add_keys_single(self, pool, row: int, blocks, lengths) -> LazyResult:
+        """Single-tenant PFADD from raw codec lanes (device-side hash)."""
+        B = blocks.shape[0]
+        Bp = self._bucket(B)
+        blocks, L = self._trim_lanes(blocks)
+        Lt = blocks.shape[1]
+        const_len = bool(B == 0 or np.all(lengths == lengths[0]))
+        key = ("hll_add_keys", pool.state.shape[0], Bp, L, Lt, const_len)
+
+        def build():
+            def f(state, row, blocks, lengths, valid):
+                return fastpath.hll_add_keys_single(
+                    state, row, blocks, lengths, valid, target_lanes=L
+                )
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        blocks_p = np.zeros((Bp, Lt), np.uint32)
+        blocks_p[:B] = blocks
+        valid = np.zeros(Bp, bool)
+        valid[:B] = True
+        len_arg = (
+            np.uint32(lengths[0] if B else 0)
+            if const_len
+            else jnp.asarray(self._pad(np.asarray(lengths, np.uint32), Bp))
+        )
+        pool.state, changed = fn(
+            pool.state,
+            np.int32(row),
+            jnp.asarray(blocks_p),
+            len_arg,
+            jnp.asarray(valid),
+        )
+        return LazyResult(changed, transform=bool)
 
     def bloom_count(self, pool, row: int, m: int, k: int) -> LazyResult:
         wpr = pool.row_units
@@ -266,13 +394,14 @@ class TpuCommandExecutor:
 
         def build():
             def f(state, rows, c0, c1, c2, valid):
-                return hll_ops.hll_add_changed(state, rows, c0, c1, c2, valid=valid)
+                new, changed = hll_ops.hll_add_changed(state, rows, c0, c1, c2, valid=valid)
+                return new, bitops.pack_bool_u32(changed)
             return f
 
         fn = self._jit(key, build, donate=True)
         (rows_p, c0p, c1p, c2p), valid = self._pad_ops(Bp, rows, c0, c1, c2)
         pool.state, changed = fn(pool.state, rows_p, c0p, c1p, c2p, valid)
-        return LazyResult(changed, B)
+        return LazyResult(changed, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def hll_add_single(self, pool, row: int, c0, c1, c2) -> LazyResult:
         """Single-tenant PFADD returning the 'changed' boolean."""
@@ -327,13 +456,14 @@ class TpuCommandExecutor:
 
         def build():
             def f(state, rows, idx, valid):
-                return kernel(state, rows, idx, words_per_row=wpr, valid=valid)
+                new, prev = kernel(state, rows, idx, words_per_row=wpr, valid=valid)
+                return new, bitops.pack_bool_u32(prev)
             return f
 
         fn = self._jit(key, build, donate=True)
         (rows_p, idx_p), valid = self._pad_ops(Bp, rows, idx)
         pool.state, prev = fn(pool.state, rows_p, idx_p, valid)
-        return LazyResult(prev, B)
+        return LazyResult(prev, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bitset_set(self, pool, rows, idx) -> LazyResult:
         return self._bitset_rw("bs_set", bitset_ops.bitset_set, pool, rows, idx)
@@ -352,13 +482,15 @@ class TpuCommandExecutor:
 
         def build():
             def f(state, rows, idx):
-                return bitset_ops.bitset_get(state, rows, idx, words_per_row=wpr)
+                return bitops.pack_bool_u32(
+                    bitset_ops.bitset_get(state, rows, idx, words_per_row=wpr)
+                )
             return f
 
         fn = self._jit(key, build, donate=False)
         (rows_p, idx_p), _ = self._pad_ops(Bp, rows, idx)
         out = fn(pool.state, rows_p, idx_p)
-        return LazyResult(out, B)
+        return LazyResult(out, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bitset_set_range(self, pool, row: int, from_bit: int, to_bit: int, value: bool) -> LazyResult:
         wpr = pool.row_units
@@ -575,6 +707,9 @@ DISPATCH_METHODS = (
     "bloom_contains",
     "bloom_add_fast_st",
     "bloom_contains_st",
+    "bloom_add_keys_st",
+    "bloom_contains_keys_st",
+    "hll_add_keys_single",
     "bloom_count",
     "hll_add",
     "hll_add_changed",

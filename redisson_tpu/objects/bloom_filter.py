@@ -56,8 +56,7 @@ class BloomFilter(RObject):
         return int(np.sum(self.add_all_async(objs).result()))
 
     def add_all_async(self, objs):
-        H1, H2 = self._hash128(objs)
-        return self._engine.bloom_add(self._name, H1, H2)
+        return self._engine.bloom_add_encoded(self._name, *self._encode(objs))
 
     add_async = add_all_async
 
@@ -75,8 +74,7 @@ class BloomFilter(RObject):
         return self.contains_all_async(objs).result()
 
     def contains_all_async(self, objs):
-        H1, H2 = self._hash128(objs)
-        return self._engine.bloom_contains(self._name, H1, H2)
+        return self._engine.bloom_contains_encoded(self._name, *self._encode(objs))
 
     contains_async = contains_all_async
 

@@ -214,6 +214,34 @@ class TpuSketchEngine:
             entry.pool, entry.row, entry.params["size"], entry.params["hash_iterations"]
         )
 
+    # Encoded entry points: the object layer hands down raw codec lanes and
+    # each engine decides where to hash.  On the direct single-device path
+    # the hash + 64-bit mod run in-kernel (ops/fastpath.py device-hash
+    # path, bit-identical to the host pipeline); coalesced/sharded paths
+    # hash on the host as before.
+
+    def bloom_add_encoded(self, name, blocks, lengths) -> LazyResult:
+        if (
+            not self.config.tpu_sketch.exact_add_semantics
+            and self.executor.supports_device_hash
+        ):
+            entry = self._require(name, PoolKind.BLOOM)
+            m, k = entry.params["size"], entry.params["hash_iterations"]
+            self._drain()
+            return self.executor.bloom_add_keys_st(
+                entry.pool, entry.row, m, k, blocks, lengths
+            )
+        return self.bloom_add(name, *hashing.hash128_np(blocks, lengths))
+
+    def bloom_contains_encoded(self, name, blocks, lengths) -> LazyResult:
+        if self.coalescer is None and self.executor.supports_device_hash:
+            entry = self._require(name, PoolKind.BLOOM)
+            m, k = entry.params["size"], entry.params["hash_iterations"]
+            return self.executor.bloom_contains_keys_st(
+                entry.pool, entry.row, m, k, blocks, lengths
+            )
+        return self.bloom_contains(name, *hashing.hash128_np(blocks, lengths))
+
     # -- hll ---------------------------------------------------------------
 
     def hll_ensure(self, name):
@@ -236,6 +264,15 @@ class TpuSketchEngine:
             # addAll boolean: did anything change?
             return _MappedFuture(fut, lambda v: bool(np.any(v)))
         return self.executor.hll_add_single(entry.pool, entry.row, c0, c1, c2)
+
+    def hll_add_encoded(self, name, blocks, lengths) -> LazyResult:
+        if self.coalescer is None and self.executor.supports_device_hash:
+            entry = self.hll_ensure(name)
+            return self.executor.hll_add_keys_single(
+                entry.pool, entry.row, blocks, lengths
+            )
+        c0, c1, c2, _ = hashing.murmur3_x86_128(blocks, lengths)
+        return self.hll_add(name, c0, c1, c2)
 
     def hll_count(self, name) -> LazyResult:
         entry = self._lookup_kind(name, PoolKind.HLL)
@@ -362,7 +399,7 @@ class TpuSketchEngine:
             return _MappedFuture(fut, lambda v: v & in_range)
         rows = np.full(len(idx), entry.row, np.int32)
         res = self.executor.bitset_get(entry.pool, rows, safe_idx)
-        return LazyResult(res._value, len(idx), transform=lambda v: v & in_range)
+        return _MappedFuture(res, lambda v: v & in_range)
 
     def bitset_set_range(self, name, from_bit, to_bit, value: bool) -> LazyResult:
         entry = self.bitset_ensure(name, int(to_bit))
@@ -591,6 +628,12 @@ class HostSketchEngine:
         with self._lock:
             return ImmediateResult(o["model"].cardinality_estimate())
 
+    def bloom_add_encoded(self, name, blocks, lengths):
+        return self.bloom_add(name, *hashing.hash128_np(blocks, lengths))
+
+    def bloom_contains_encoded(self, name, blocks, lengths):
+        return self.bloom_contains(name, *hashing.hash128_np(blocks, lengths))
+
     # -- hll ---------------------------------------------------------------
 
     def _hll(self, name):
@@ -612,6 +655,10 @@ class HostSketchEngine:
             before = int(model.regs.sum())
             model.add_hashed(c0, c1, c2)
             return ImmediateResult(int(model.regs.sum()) != before)
+
+    def hll_add_encoded(self, name, blocks, lengths):
+        c0, c1, c2, _ = hashing.murmur3_x86_128(blocks, lengths)
+        return self.hll_add(name, c0, c1, c2)
 
     def hll_count(self, name):
         o = self._lookup_kind(name, PoolKind.HLL)

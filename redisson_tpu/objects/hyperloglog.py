@@ -25,8 +25,7 @@ class HyperLogLog(RObject):
         return bool(self.add_all_async(objs).result())
 
     def add_all_async(self, objs):
-        c0, c1, c2, _ = self._hash_lanes(objs)
-        return self._engine.hll_add(self._name, c0, c1, c2)
+        return self._engine.hll_add_encoded(self._name, *self._encode(objs))
 
     add_async = add_all_async
 

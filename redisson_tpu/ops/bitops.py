@@ -145,6 +145,29 @@ def scatter_add_onehot(flat, gidx, values):
     return _scatter_onehot(flat, gidx, values, "add")
 
 
+def pack_bool_u32(flags):
+    """bool[N] -> uint32[N/32] (N % 32 == 0), little-endian bit order.
+
+    Per-op boolean results (contains hits, newly flags, prev bits) leave
+    the device packed 32-to-a-word: D2H link bytes are the scarce resource
+    on a tunneled host (measured ~300x slower than H2D), and 1 bit/op is
+    the information-theoretic floor.  Host side unpacks with
+    ``unpack_bool_u32``.
+    """
+    w = flags.reshape(-1, 32).astype(jnp.uint32)
+    weights = (np.uint32(1) << np.arange(32, dtype=np.uint32))[None, :]
+    return (w * weights).sum(axis=1, dtype=jnp.uint32)
+
+
+def unpack_bool_u32(words, n: int) -> np.ndarray:
+    """Host twin of pack_bool_u32: uint32[N/32] -> bool[n]."""
+    b = np.unpackbits(
+        np.ascontiguousarray(words, dtype=np.uint32).view(np.uint8),
+        bitorder="little",
+    )
+    return b[:n].astype(bool)
+
+
 def route_invalid_to_scratch(gword, valid, flat_len: int):
     """Send padded ops to the trailing scratch word so they can't perturb
     run-detection or results of real ops (see module docstring)."""

@@ -76,3 +76,88 @@ def bloom_contains_st(flat_words, row, h1m, h2m, m, *, k: int, words_per_row: in
     bit = idx & np.uint32(31)
     bits = bitops.gather_bits(flat_words, gword.reshape(-1), bit.reshape(-1))
     return bits.reshape(B, k).all(axis=1)
+
+
+# --------------------------------------------------------------------------
+# Device-side hashing: ship raw codec lanes, hash + reduce in-kernel.
+#
+# The host pipeline (murmur batch + uint64 km_reduce_mod) tops out around
+# ~20M keys/s/core and serializes with dispatch; hashing on the VPU rides
+# along with the gather kernel for free and shrinks H2D to the raw key
+# bytes.  The 64-bit ``h % m`` that km_reduce_mod does with cheap host
+# uint64 is reproduced EXACTLY in uint32 via 64 unrolled bit-Horner steps
+# (r = 2r + bit; r -= m if r >= m — one conditional subtract suffices since
+# r < m <= 2**31 keeps 2r + bit < 2**32), so device-hashed results are
+# bit-identical to the host/golden path and cross-engine parity holds.
+# --------------------------------------------------------------------------
+
+
+def mod64_bits(hi, lo, m32):
+    """Exact ``(hi * 2**32 + lo) % m`` for uint32 lanes, m <= 2**31."""
+    r = jnp.zeros_like(hi)
+    one = np.uint32(1)
+    for word in (hi, lo):
+        for b in range(31, -1, -1):
+            bit = (word >> np.uint32(b)) & one
+            r = (r << one) | bit
+            r = jnp.where(r >= m32, r - m32, r)
+    return r
+
+
+def pad_lanes(blocks, target_lanes: int):
+    """Restore trailing all-zero lanes the host trimmed off before H2D
+    (link bytes are scarce; zeros are free to rebuild).  ``target_lanes``
+    must be the ORIGINAL lane count — murmur mixes every 16-byte block,
+    zeros included, so the block count is part of the hash input."""
+    lanes = blocks.shape[-1]
+    if lanes == target_lanes:
+        return blocks
+    return jnp.concatenate(
+        [
+            blocks,
+            jnp.zeros((*blocks.shape[:-1], target_lanes - lanes), blocks.dtype),
+        ],
+        axis=-1,
+    )
+
+
+def _hash_km_device(blocks, lengths, m, target_lanes: int):
+    """murmur3_x86_128 on device → (h1m, h2m) uint32[B], bit-identical to
+    hashing.hash128_np + hashing.km_reduce_mod."""
+    from redisson_tpu.utils import hashing
+
+    blocks = pad_lanes(blocks, target_lanes)
+    c0, c1, c2, c3 = hashing.murmur3_x86_128(blocks, lengths, xp=jnp)
+    m32 = m.astype(jnp.uint32) if hasattr(m, "astype") else np.uint32(m)
+    # hash128_np: h1 = c0 | c1<<32, h2 = c2 | c3<<32.
+    h1m = mod64_bits(c1, c0, m32)
+    h2m = mod64_bits(c3, c2, m32)
+    return h1m, h2m
+
+
+def bloom_add_keys_st(flat_words, row, blocks, lengths, m, valid, *, k: int, words_per_row: int, target_lanes: int):
+    """Single-tenant bulk add from raw key lanes (device-side hashing)."""
+    h1m, h2m = _hash_km_device(blocks, lengths, m, target_lanes)
+    return bloom_add_fast_st(
+        flat_words, row, h1m, h2m, m, valid, k=k, words_per_row=words_per_row
+    )
+
+
+def bloom_contains_keys_st(flat_words, row, blocks, lengths, m, *, k: int, words_per_row: int, target_lanes: int):
+    """Single-tenant contains from raw key lanes (device-side hashing)."""
+    h1m, h2m = _hash_km_device(blocks, lengths, m, target_lanes)
+    return bloom_contains_st(
+        flat_words, row, h1m, h2m, m, k=k, words_per_row=words_per_row
+    )
+
+
+def hll_add_keys_single(flat_regs, row, blocks, lengths, valid, *, target_lanes: int):
+    """Single-tenant PFADD from raw key lanes — murmur on device, then the
+    standard scatter-max; returns (new, changed)."""
+    from redisson_tpu.ops import hll as hll_ops
+    from redisson_tpu.utils import hashing
+
+    c0, c1, c2, _ = hashing.murmur3_x86_128(
+        pad_lanes(blocks, target_lanes), lengths, xp=jnp
+    )
+    return hll_ops.hll_add_single(flat_regs, row, c0, c1, c2, valid=valid)

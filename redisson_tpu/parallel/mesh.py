@@ -69,9 +69,11 @@ def _own_and_local(rows, valid, S: int):
     return own, rows // S
 
 
-def sharded_bloom_add(ctx: MeshContext, *, k: int, words_per_row: int):
+def sharded_bloom_add(ctx: MeshContext, *, k: int, words_per_row: int, pack_results: bool = False):
     """Returns jitted fn(state[S,L], rows, h1m, h2m, m_arr, valid) ->
-    (new_state, newly bool[B]) with exact single-device semantics."""
+    (new_state, newly bool[B]) with exact single-device semantics.
+    ``pack_results``: return newly packed 32-per-uint32 (bitops.pack_bool_u32)
+    to shrink D2H bytes."""
     S = ctx.n_shards
 
     def inner(state, rows, h1m, h2m, m_arr, valid):
@@ -82,7 +84,10 @@ def sharded_bloom_add(ctx: MeshContext, *, k: int, words_per_row: int):
             words_per_row=words_per_row, valid=own,
         )
         newly = lax.psum(jnp.where(own, newly, False).astype(jnp.int32), "shard")
-        return new_local[None], newly > 0
+        out = newly > 0
+        if pack_results:
+            out = bitops.pack_bool_u32(out)
+        return new_local[None], out
 
     fn = jax.shard_map(
         inner,
@@ -93,7 +98,7 @@ def sharded_bloom_add(ctx: MeshContext, *, k: int, words_per_row: int):
     return jax.jit(fn, donate_argnums=(0,))
 
 
-def sharded_bloom_contains(ctx: MeshContext, *, k: int, words_per_row: int):
+def sharded_bloom_contains(ctx: MeshContext, *, k: int, words_per_row: int, pack_results: bool = False):
     S = ctx.n_shards
 
     def inner(state, rows, h1m, h2m, m_arr, valid):
@@ -104,7 +109,10 @@ def sharded_bloom_contains(ctx: MeshContext, *, k: int, words_per_row: int):
             local, safe_rows, h1m, h2m, m=m_arr, k=k, words_per_row=words_per_row
         )
         res = lax.psum(jnp.where(own, res, False).astype(jnp.int32), "shard")
-        return res > 0
+        out = res > 0
+        if pack_results:
+            out = bitops.pack_bool_u32(out)
+        return out
 
     fn = jax.shard_map(
         inner,
@@ -311,7 +319,7 @@ def sharded_bitop(ctx: MeshContext, *, words_per_row: int, op: str, n_src: int, 
 # --------------------------------------------------------------------------
 
 
-def sharded_bitset_rw(ctx: MeshContext, kernel, *, words_per_row: int):
+def sharded_bitset_rw(ctx: MeshContext, kernel, *, words_per_row: int, pack_results: bool = False):
     """SETBIT/clear/flip batch: ``kernel`` is one of ops.bitset.bitset_set/
     bitset_clear/bitset_flip.  Returns fn(state, rows, idx, valid) ->
     (new_state, prev bool[B]) with exact single-device semantics."""
@@ -324,7 +332,10 @@ def sharded_bitset_rw(ctx: MeshContext, kernel, *, words_per_row: int):
             local, lrows, idx, words_per_row=words_per_row, valid=own
         )
         prev = lax.psum(jnp.where(own, prev, False).astype(jnp.int32), "shard")
-        return new_local[None], prev > 0
+        out = prev > 0
+        if pack_results:
+            out = bitops.pack_bool_u32(out)
+        return new_local[None], out
 
     fn = jax.shard_map(
         inner,
@@ -335,7 +346,7 @@ def sharded_bitset_rw(ctx: MeshContext, kernel, *, words_per_row: int):
     return jax.jit(fn, donate_argnums=(0,))
 
 
-def sharded_bitset_get(ctx: MeshContext, *, words_per_row: int):
+def sharded_bitset_get(ctx: MeshContext, *, words_per_row: int, pack_results: bool = False):
     from redisson_tpu.ops import bitset as bitset_ops
 
     S = ctx.n_shards
@@ -345,7 +356,10 @@ def sharded_bitset_get(ctx: MeshContext, *, words_per_row: int):
         own, lrows = _own_and_local(rows, valid, S)
         res = bitset_ops.bitset_get(local, lrows, idx, words_per_row=words_per_row)
         res = lax.psum(jnp.where(own, res, False).astype(jnp.int32), "shard")
-        return res > 0
+        out = res > 0
+        if pack_results:
+            out = bitops.pack_bool_u32(out)
+        return out
 
     fn = jax.shard_map(
         inner,
@@ -441,7 +455,7 @@ def sharded_row_write(ctx: MeshContext, *, row_units: int):
     return jax.jit(fn, donate_argnums=(0,))
 
 
-def sharded_hll_add_changed(ctx: MeshContext):
+def sharded_hll_add_changed(ctx: MeshContext, *, pack_results: bool = False):
     """Multi-tenant PFADD with exact per-op changed flags (coalesced path).
     Ops on different shards touch different rows, so per-shard sequential
     semantics compose exactly."""
@@ -454,7 +468,10 @@ def sharded_hll_add_changed(ctx: MeshContext):
             local, jnp.where(own, lrows, 0), c0, c1, c2, valid=own
         )
         changed = lax.psum(jnp.where(own, changed, False).astype(jnp.int32), "shard")
-        return new_local[None], changed > 0
+        out = changed > 0
+        if pack_results:
+            out = bitops.pack_bool_u32(out)
+        return new_local[None], out
 
     fn = jax.shard_map(
         inner,

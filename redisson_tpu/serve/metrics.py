@@ -44,6 +44,17 @@ class Metrics:
         self.wait = _Reservoir()
         self.flush = _Reservoir()
 
+    def reset(self) -> None:
+        """Zero counters/reservoirs — benches call this after warmup so
+        first-compile latencies don't pollute steady-state percentiles."""
+        with self._lock:
+            self.started = time.monotonic()
+            self.ops_total = 0
+            self.batches_total = 0
+            self.batch_occupancy_sum = 0
+            self.wait = _Reservoir()
+            self.flush = _Reservoir()
+
     def record_batch(self, *, nops: int, wait_s: float, flush_s: float) -> None:
         with self._lock:
             self.ops_total += nops

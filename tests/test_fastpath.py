@@ -101,3 +101,53 @@ def test_fast_mode_e2e_parity_with_host():
         )
     np.testing.assert_array_equal(results["fast"][0], results["host"][0])
     np.testing.assert_array_equal(results["fast"][1], results["host"][1])
+
+
+def test_device_hash_path_matches_host_engine():
+    """The *_keys_st device-hash kernels (murmur + 64-bit mod in-kernel)
+    must be bit-identical to the host hash pipeline: same membership
+    answers, same newly flags, same HLL changed booleans."""
+    import redisson_tpu
+    from redisson_tpu import Config
+
+    results = {}
+    for mode, kwargs in (
+        ("devhash", dict(exact_add_semantics=False, coalesce=False)),
+        ("host", None),
+    ):
+        cfg = Config()
+        if kwargs is not None:
+            cfg.use_tpu_sketch(min_bucket=64, **kwargs)
+        cl = redisson_tpu.create(cfg)
+        bf = cl.get_bloom_filter("dh-bf")
+        bf.try_init(5000, 0.01)
+        keys = [f"key-{i}" for i in range(300)]
+        n_added = bf.add_all(keys)
+        hits = bf.contains_each(keys + [f"miss-{i}" for i in range(300)])
+        h = cl.get_hyper_log_log("dh-hll")
+        first = h.add("x")
+        second = h.add("x")
+        h.add_all([f"v{i}" for i in range(2000)])
+        results[mode] = (n_added, hits.tolist(), first, second, h.count())
+        cl.shutdown()
+    assert results["devhash"] == results["host"]
+
+
+def test_mod64_bits_exact():
+    """Device bit-Horner mod == host uint64 mod for random 64-bit values."""
+    import jax
+    import jax.numpy as jnp
+
+    from redisson_tpu.ops import fastpath
+
+    rng = np.random.default_rng(5)
+    hi = rng.integers(0, 1 << 32, 512).astype(np.uint32)
+    lo = rng.integers(0, 1 << 32, 512).astype(np.uint32)
+    for m in (3, 17, 9585059, (1 << 31) - 1, 1 << 31):
+        got = np.asarray(
+            jax.jit(lambda h, l: fastpath.mod64_bits(h, l, np.uint32(m)))(
+                jnp.asarray(hi), jnp.asarray(lo)
+            )
+        )
+        h64 = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+        np.testing.assert_array_equal(got, (h64 % np.uint64(m)).astype(np.uint32))
