@@ -2,10 +2,11 @@
 
 Parity with org/redisson/Redisson.java + org/redisson/api/RedissonClient.java
 (SURVEY.md §1 L6): ``create(Config)`` returns a client whose ``get_*``
-methods hand out name-addressed object facades.  The backend behind sketch
-objects is selected by ``Config.use_tpu_sketch()`` (TPU pools vs host golden
-models); the broader catalog (maps, locks, topics, …) is served by the host
-data grid as it lands.
+methods hand out name-addressed object facades.  Sketch objects (bloom /
+HLL / bitset / CMS) run on the engine selected by
+``Config.use_tpu_sketch()`` (TPU pools vs host golden models); the broader
+catalog (buckets, counters, maps, sets, queues, topics, …) is served by
+the in-process host data grid (SURVEY.md §7-L6).
 """
 
 from __future__ import annotations
@@ -14,6 +15,35 @@ from redisson_tpu.config import Config
 from redisson_tpu.objects import BitSet, BloomFilter, CountMinSketch, HyperLogLog
 from redisson_tpu.objects.base import CamelCompatMixin
 from redisson_tpu.objects.engines import HostSketchEngine, TpuSketchEngine
+from redisson_tpu.grid import (
+    AtomicDouble,
+    AtomicLong,
+    BinaryStream,
+    BlockingDeque,
+    BlockingQueue,
+    Bucket,
+    Buckets,
+    DelayedQueue,
+    Deque,
+    DoubleAdder,
+    GridStore,
+    IdGenerator,
+    LexSortedSet,
+    List_,
+    LongAdder,
+    Map,
+    MapCache,
+    PatternTopic,
+    PriorityQueue,
+    Queue,
+    RingBuffer,
+    ScoredSortedSet,
+    Set_,
+    SetCache,
+    SortedSet,
+    Topic,
+)
+from redisson_tpu.grid.topics import TopicBus
 
 
 class RedissonTpuClient(CamelCompatMixin):
@@ -23,6 +53,8 @@ class RedissonTpuClient(CamelCompatMixin):
             self._engine = TpuSketchEngine(config)
         else:
             self._engine = HostSketchEngine(config)
+        self._grid = GridStore()
+        self._topic_bus = TopicBus(n_threads=config.threads)
         self._shutdown = False
 
     # -- sketch objects (TPU-backed north star) ----------------------------
@@ -39,6 +71,96 @@ class RedissonTpuClient(CamelCompatMixin):
     def get_count_min_sketch(self, name: str) -> CountMinSketch:
         return CountMinSketch(name, self)
 
+    # -- buckets / values --------------------------------------------------
+
+    def get_bucket(self, name: str):
+        return Bucket(name, self)
+
+    def get_buckets(self):
+        return Buckets(self)
+
+    def get_binary_stream(self, name: str):
+        return BinaryStream(name, self)
+
+    # -- counters ----------------------------------------------------------
+
+    def get_atomic_long(self, name: str):
+        return AtomicLong(name, self)
+
+    def get_atomic_double(self, name: str):
+        return AtomicDouble(name, self)
+
+    def get_long_adder(self, name: str):
+        return LongAdder(name, self)
+
+    def get_double_adder(self, name: str):
+        return DoubleAdder(name, self)
+
+    def get_id_generator(self, name: str):
+        return IdGenerator(name, self)
+
+    # -- maps --------------------------------------------------------------
+
+    def get_map(self, name: str):
+        return Map(name, self)
+
+    def get_map_cache(self, name: str):
+        return MapCache(name, self)
+
+    # -- sets / lists ------------------------------------------------------
+
+    def get_set(self, name: str):
+        return Set_(name, self)
+
+    def get_set_cache(self, name: str):
+        return SetCache(name, self)
+
+    def get_list(self, name: str):
+        return List_(name, self)
+
+    def get_sorted_set(self, name: str):
+        return SortedSet(name, self)
+
+    def get_scored_sorted_set(self, name: str):
+        return ScoredSortedSet(name, self)
+
+    def get_lex_sorted_set(self, name: str):
+        return LexSortedSet(name, self)
+
+    # -- queues ------------------------------------------------------------
+
+    def get_queue(self, name: str):
+        return Queue(name, self)
+
+    def get_deque(self, name: str):
+        return Deque(name, self)
+
+    def get_blocking_queue(self, name: str):
+        return BlockingQueue(name, self)
+
+    def get_blocking_deque(self, name: str):
+        return BlockingDeque(name, self)
+
+    def get_delayed_queue(self, destination_queue):
+        """→ RedissonClient#getDelayedQueue(RQueue): feeds the given queue."""
+        return DelayedQueue(
+            f"{destination_queue.get_name()}:delayed", self, destination_queue
+        )
+
+    def get_priority_queue(self, name: str):
+        return PriorityQueue(name, self)
+
+    def get_ring_buffer(self, name: str):
+        return RingBuffer(name, self)
+
+    # -- messaging ---------------------------------------------------------
+
+    def get_topic(self, name: str):
+        return Topic(name, self)
+
+    def get_pattern_topic(self, pattern: str):
+        return PatternTopic(pattern, self)
+
     # -- admin -------------------------------------------------------------
 
     def get_sketch_names(self, kind=None) -> list[str]:
@@ -53,6 +175,8 @@ class RedissonTpuClient(CamelCompatMixin):
         """→ Redisson#shutdown."""
         if hasattr(self._engine, "shutdown"):
             self._engine.shutdown()
+        self._grid.shutdown()
+        self._topic_bus.shutdown()
         self._shutdown = True
 
     def is_shutdown(self) -> bool:

@@ -1,0 +1,49 @@
+"""Host data grid — the broader RObject catalog (SURVEY.md §2.3, §7-L6).
+
+The reference's non-sketch objects (maps, sets, queues, counters, topics,
+locks, …) are coordination/data-structure objects with no TPU value; the
+survey's build plan explicitly sanctions host-backed implementations for
+capability parity.  They share one ``GridStore`` keyspace per client
+(name-addressed, codec-encoded, WRONGTYPE-guarded, object-level TTL with
+an eviction sweeper — the EvictionScheduler analog).
+"""
+
+from redisson_tpu.grid.store import GridStore
+from redisson_tpu.grid.buckets import BinaryStream, Bucket, Buckets
+from redisson_tpu.grid.counters import (
+    AtomicDouble,
+    AtomicLong,
+    DoubleAdder,
+    IdGenerator,
+    LongAdder,
+)
+from redisson_tpu.grid.maps import Map, MapCache
+from redisson_tpu.grid.collections import (
+    LexSortedSet,
+    List_,
+    ScoredSortedSet,
+    Set_,
+    SetCache,
+    SortedSet,
+)
+from redisson_tpu.grid.queues import (
+    BlockingDeque,
+    BlockingQueue,
+    DelayedQueue,
+    Deque,
+    PriorityQueue,
+    Queue,
+    RingBuffer,
+)
+from redisson_tpu.grid.topics import PatternTopic, Topic
+
+__all__ = [
+    "GridStore",
+    "Bucket", "Buckets", "BinaryStream",
+    "AtomicLong", "AtomicDouble", "LongAdder", "DoubleAdder", "IdGenerator",
+    "Map", "MapCache",
+    "Set_", "SetCache", "List_", "SortedSet", "ScoredSortedSet", "LexSortedSet",
+    "Queue", "Deque", "BlockingQueue", "BlockingDeque", "DelayedQueue",
+    "PriorityQueue", "RingBuffer",
+    "Topic", "PatternTopic",
+]

@@ -1,0 +1,111 @@
+"""GridObject — shared RObject plumbing for data-grid objects.
+
+→ org/redisson/RedissonObject.java + RedissonExpirable.java: every object
+is name-addressed, codec-encoded, supports delete/rename/exists/TTL and
+``dump()/restore()`` (here: codec-pickled state round-trip).  camelCase
+aliases ride the same CamelCompatMixin as the sketch objects.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional
+
+from redisson_tpu.objects.base import CamelCompatMixin
+
+
+class GridObject(CamelCompatMixin):
+    KIND: str = ""
+
+    def __init__(self, name: str, client):
+        self._name = name
+        self._client = client
+        self._store = client._grid
+        self._codec = client.config.codec
+
+    # -- identity ----------------------------------------------------------
+
+    def get_name(self) -> str:
+        return self._name
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    # -- codec helpers -----------------------------------------------------
+
+    def _enc(self, obj: Any) -> bytes:
+        return self._codec.encode(obj)
+
+    def _dec(self, data: bytes) -> Any:
+        return self._codec.decode(data)
+
+    def _enc_key(self, obj: Any) -> bytes:
+        return self._codec.encode_key(obj)
+
+    def _dec_key(self, data: bytes) -> Any:
+        return self._codec.decode_key(data)
+
+    # -- keyspace ops (→ RedissonObject) -----------------------------------
+
+    def is_exists(self) -> bool:
+        return self._store.exists(self._name)
+
+    def delete(self) -> bool:
+        return self._store.delete(self._name)
+
+    def rename(self, new_name: str) -> None:
+        """→ RedissonObject#rename: raises when the source key does not
+        exist (Redis RENAME semantics); the facade only re-points on
+        success."""
+        if not self._store.rename(self._name, new_name):
+            raise RuntimeError(f"object {self._name!r} does not exist")
+        self._name = new_name
+
+    def touch(self) -> bool:
+        return self._store.exists(self._name)
+
+    def unlink(self) -> bool:
+        return self.delete()
+
+    # -- TTL (→ RedissonExpirable) -----------------------------------------
+
+    def expire(self, ttl_seconds: float) -> bool:
+        return self._store.expire(self._name, float(ttl_seconds))
+
+    def expire_at(self, epoch_seconds: float) -> bool:
+        return self._store.expire_at(self._name, float(epoch_seconds))
+
+    def clear_expire(self) -> bool:
+        return self._store.clear_expire(self._name)
+
+    def remain_time_to_live(self) -> int:
+        return self._store.remain_ttl_ms(self._name)
+
+    # -- dump/restore (→ RObject#dump/restore over DUMP/RESTORE) -----------
+
+    def dump(self) -> bytes:
+        e = self._store.get_entry(self._name, self.KIND)
+        if e is None:
+            raise RuntimeError(f"object {self._name!r} does not exist")
+        return pickle.dumps((self.KIND, e.value), protocol=pickle.HIGHEST_PROTOCOL)
+
+    def restore(self, data: bytes, replace: bool = False) -> None:
+        kind, value = pickle.loads(data)
+        if kind != self.KIND:
+            raise TypeError(f"dump holds a {kind}, not a {self.KIND}")
+        with self._store.lock:
+            if not replace and self._store.exists(self._name):
+                raise RuntimeError(f"object {self._name!r} already exists")
+            self._store.put_entry(self._name, self.KIND, value)
+
+    # -- internals ---------------------------------------------------------
+
+    def _entry(self, create: bool = True):
+        if create:
+            return self._store.ensure_entry(self._name, self.KIND, self._new_value)
+        return self._store.get_entry(self._name, self.KIND)
+
+    @staticmethod
+    def _new_value() -> Any:
+        raise NotImplementedError

@@ -1,0 +1,202 @@
+"""Counters — → org/redisson/RedissonAtomicLong.java, RedissonAtomicDouble,
+RedissonLongAdder/RedissonDoubleAdder (topic-coordinated in the reference;
+in-process the adder IS its sum), RedissonIdGenerator (allocation-block id
+ranges).
+"""
+
+from __future__ import annotations
+
+from redisson_tpu.grid.base import GridObject
+
+
+class AtomicLong(GridObject):
+    KIND = "atomiclong"
+
+    @staticmethod
+    def _new_value():
+        return 0
+
+    def get(self) -> int:
+        e = self._entry(create=False)
+        return 0 if e is None else e.value
+
+    def set(self, value: int) -> None:
+        self._store.put_entry(self._name, self.KIND, int(value))
+
+    def add_and_get(self, delta: int) -> int:
+        with self._store.lock:
+            e = self._entry()
+            e.value = int(e.value) + int(delta)
+            return e.value
+
+    def get_and_add(self, delta: int) -> int:
+        with self._store.lock:
+            e = self._entry()
+            old = int(e.value)
+            e.value = old + int(delta)
+            return old
+
+    def increment_and_get(self) -> int:
+        return self.add_and_get(1)
+
+    def decrement_and_get(self) -> int:
+        return self.add_and_get(-1)
+
+    def get_and_increment(self) -> int:
+        return self.get_and_add(1)
+
+    def get_and_decrement(self) -> int:
+        return self.get_and_add(-1)
+
+    def get_and_set(self, value: int) -> int:
+        with self._store.lock:
+            e = self._entry()
+            old = int(e.value)
+            e.value = int(value)
+            return old
+
+    def compare_and_set(self, expect: int, update: int) -> bool:
+        with self._store.lock:
+            e = self._entry()
+            if int(e.value) != int(expect):
+                return False
+            e.value = int(update)
+            return True
+
+    def get_and_delete(self) -> int:
+        with self._store.lock:
+            old = self.get()
+            self.delete()
+            return old
+
+
+class AtomicDouble(AtomicLong):
+    """→ RedissonAtomicDouble — same surface over float."""
+
+    KIND = "atomicdouble"
+
+    @staticmethod
+    def _new_value():
+        return 0.0
+
+    def get(self) -> float:
+        e = self._entry(create=False)
+        return 0.0 if e is None else e.value
+
+    def set(self, value: float) -> None:
+        self._store.put_entry(self._name, self.KIND, float(value))
+
+    def add_and_get(self, delta: float) -> float:
+        with self._store.lock:
+            e = self._entry()
+            e.value = float(e.value) + float(delta)
+            return e.value
+
+    def get_and_add(self, delta: float) -> float:
+        with self._store.lock:
+            e = self._entry()
+            old = float(e.value)
+            e.value = old + float(delta)
+            return old
+
+    def get_and_set(self, value: float) -> float:
+        with self._store.lock:
+            e = self._entry()
+            old = float(e.value)
+            e.value = float(value)
+            return old
+
+    def compare_and_set(self, expect: float, update: float) -> bool:
+        with self._store.lock:
+            e = self._entry()
+            if float(e.value) != float(expect):
+                return False
+            e.value = float(update)
+            return True
+
+
+class LongAdder(GridObject):
+    """→ RedissonLongAdder.  The reference keeps per-client local counters
+    synced over a topic; in-process the shared cell is the sum itself."""
+
+    KIND = "longadder"
+
+    @staticmethod
+    def _new_value():
+        return 0
+
+    def add(self, delta: int) -> None:
+        with self._store.lock:
+            e = self._entry()
+            e.value = int(e.value) + int(delta)
+
+    def increment(self) -> None:
+        self.add(1)
+
+    def decrement(self) -> None:
+        self.add(-1)
+
+    def sum(self) -> int:
+        e = self._entry(create=False)
+        return 0 if e is None else int(e.value)
+
+    def reset(self) -> None:
+        self._store.put_entry(self._name, self.KIND, 0)
+
+
+class DoubleAdder(GridObject):
+    KIND = "doubleadder"
+
+    @staticmethod
+    def _new_value():
+        return 0.0
+
+    def add(self, delta: float) -> None:
+        with self._store.lock:
+            e = self._entry()
+            e.value = float(e.value) + float(delta)
+
+    def sum(self) -> float:
+        e = self._entry(create=False)
+        return 0.0 if e is None else float(e.value)
+
+    def reset(self) -> None:
+        self._store.put_entry(self._name, self.KIND, 0.0)
+
+
+class IdGenerator(GridObject):
+    """→ org/redisson/RedissonIdGenerator.java: ids handed out from locally
+    cached allocation blocks reserved atomically from the shared counter."""
+
+    KIND = "idgenerator"
+
+    def __init__(self, name, client):
+        super().__init__(name, client)
+        self._local_next = 0
+        self._local_end = 0
+
+    @staticmethod
+    def _new_value():
+        # (next unallocated id, allocation block size)
+        return {"next": 0, "block": 5000}
+
+    def try_init(self, start: int, allocation_size: int) -> bool:
+        with self._store.lock:
+            if self._store.exists(self._name):
+                return False
+            self._store.put_entry(
+                self._name, self.KIND,
+                {"next": int(start), "block": int(allocation_size)},
+            )
+            return True
+
+    def next_id(self) -> int:
+        with self._store.lock:
+            if self._local_next >= self._local_end:
+                e = self._entry()
+                start = e.value["next"]
+                e.value["next"] = start + e.value["block"]
+                self._local_next, self._local_end = start, e.value["next"]
+            v = self._local_next
+            self._local_next += 1
+            return v

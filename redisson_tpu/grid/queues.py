@@ -1,0 +1,446 @@
+"""Queues — → org/redisson/RedissonQueue.java (RQueue over Redis lists),
+RedissonDeque, RedissonBlockingQueue/Deque (BLPOP parked on the store
+condition — the pub/sub-wakeup analog, SURVEY.md §3.3), RedissonDelayedQueue
+(timeout ZSET + transfer task → here a timer thread moving due items into
+the destination queue), RedissonPriorityQueue (comparator order),
+RedissonRingBuffer (capacity-trimmed queue).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Any, Iterable, Optional
+
+from redisson_tpu.grid.base import GridObject
+
+
+class Queue(GridObject):
+    KIND = "list"  # queues are lists in Redis; share the kind (RQueue over RList)
+
+    @staticmethod
+    def _new_value():
+        return []
+
+    def offer(self, value: Any) -> bool:
+        with self._store.lock:
+            self._entry().value.append(self._enc(value))
+            self._store.notify()
+            return True
+
+    add = offer
+
+    def offer_all(self, values: Iterable[Any]) -> bool:
+        with self._store.lock:
+            for v in values:
+                self._entry().value.append(self._enc(v))
+            self._store.notify()
+            return True
+
+    def poll(self) -> Any:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None or not e.value:
+                return None
+            return self._dec(e.value.pop(0))
+
+    def peek(self) -> Any:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None or not e.value:
+                return None
+            return self._dec(e.value[0])
+
+    def poll_last_and_offer_first_to(self, dest_name: str) -> Any:
+        """→ RQueue#pollLastAndOfferFirstTo (RPOPLPUSH)."""
+        with self._store.lock:
+            # WRONGTYPE-check the destination BEFORE popping, so a kind
+            # mismatch cannot lose the element.
+            self._store.get_entry(dest_name, self.KIND)
+            e = self._entry(create=False)
+            if e is None or not e.value:
+                return None
+            vb = e.value.pop()
+            dest = self._client.get_queue(dest_name)
+            dest._entry().value.insert(0, vb)
+            self._store.notify()
+            return self._dec(vb)
+
+    def size(self) -> int:
+        with self._store.lock:
+            e = self._entry(create=False)
+            return 0 if e is None else len(e.value)
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def contains(self, value: Any) -> bool:
+        with self._store.lock:
+            e = self._entry(create=False)
+            return e is not None and self._enc(value) in e.value
+
+    def remove(self, value: Any) -> bool:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return False
+            vb = self._enc(value)
+            if vb not in e.value:
+                return False
+            e.value.remove(vb)
+            return True
+
+    def clear(self) -> bool:
+        return self.delete()
+
+    def read_all(self) -> list:
+        with self._store.lock:
+            e = self._entry(create=False)
+            return [] if e is None else [self._dec(vb) for vb in e.value]
+
+    def __len__(self):
+        return self.size()
+
+
+class Deque(Queue):
+    """→ RedissonDeque: double-ended ops."""
+
+    def add_first(self, value: Any) -> None:
+        with self._store.lock:
+            self._entry().value.insert(0, self._enc(value))
+            self._store.notify()
+
+    def add_last(self, value: Any) -> None:
+        self.offer(value)
+
+    offer_first = add_first
+    offer_last = add_last
+
+    def poll_first(self) -> Any:
+        return self.poll()
+
+    def poll_last(self) -> Any:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None or not e.value:
+                return None
+            return self._dec(e.value.pop())
+
+    def peek_first(self) -> Any:
+        return self.peek()
+
+    def peek_last(self) -> Any:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None or not e.value:
+                return None
+            return self._dec(e.value[-1])
+
+
+class BlockingQueue(Queue):
+    """→ RedissonBlockingQueue: poll with timeout parks on the store
+    condition until an offer lands (the BLPOP pub/sub-wakeup analog)."""
+
+    def poll(self, timeout_seconds: Optional[float] = None) -> Any:
+        if timeout_seconds is None:
+            return super().poll()
+        deadline = time.monotonic() + timeout_seconds
+        with self._store.cond:
+            while True:
+                v = super().poll()
+                if v is not None:
+                    return v
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._store.cond.wait(timeout=remaining)
+
+    def take(self) -> Any:
+        with self._store.cond:
+            while True:
+                v = super().poll()
+                if v is not None:
+                    return v
+                self._store.cond.wait(timeout=1.0)
+
+    def put(self, value: Any) -> None:
+        self.offer(value)
+
+    def drain_to(self, collection: list, max_elements: Optional[int] = None) -> int:
+        with self._store.lock:
+            n = 0
+            while max_elements is None or n < max_elements:
+                v = super().poll()
+                if v is None:
+                    break
+                collection.append(v)
+                n += 1
+            return n
+
+    def poll_from_any(self, timeout_seconds: float, *queue_names: str) -> Any:
+        """→ RBlockingQueue#pollFromAny (BLPOP over several keys)."""
+        queues = [self] + [self._client.get_blocking_queue(n) for n in queue_names]
+        deadline = time.monotonic() + timeout_seconds
+        with self._store.cond:
+            while True:
+                for q in queues:
+                    v = Queue.poll(q)
+                    if v is not None:
+                        return v
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._store.cond.wait(timeout=remaining)
+
+
+class BlockingDeque(BlockingQueue, Deque):
+    """→ RedissonBlockingDeque."""
+
+    def poll_first(self, timeout_seconds: Optional[float] = None) -> Any:
+        return BlockingQueue.poll(self, timeout_seconds)
+
+    def poll_last(self, timeout_seconds: Optional[float] = None) -> Any:
+        if timeout_seconds is None:
+            return Deque.poll_last(self)
+        deadline = time.monotonic() + timeout_seconds
+        with self._store.cond:
+            while True:
+                v = Deque.poll_last(self)
+                if v is not None:
+                    return v
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._store.cond.wait(timeout=remaining)
+
+
+class DelayedQueue(GridObject):
+    """→ org/redisson/RedissonDelayedQueue.java: offer(value, delay) holds
+    the value in a timeout structure; a transfer thread moves due items to
+    the destination queue (the reference's scheduled transfer task)."""
+
+    KIND = "delayedqueue"
+
+    def __init__(self, name: str, client, destination: Queue):
+        super().__init__(name, client)
+        self._dest = destination
+        self._timer: Optional[threading.Timer] = None
+
+    @staticmethod
+    def _new_value():
+        return []  # sorted list of (due_epoch, seq, value bytes)
+
+    _seq = 0
+
+    def offer(self, value: Any, delay_seconds: float) -> None:
+        due = time.time() + float(delay_seconds)
+        with self._store.lock:
+            e = self._entry()
+            DelayedQueue._seq += 1
+            bisect.insort(e.value, (due, DelayedQueue._seq, self._enc(value)))
+            self._schedule_transfer()
+
+    def _schedule_transfer(self) -> None:
+        e = self._entry(create=False)
+        if e is None or not e.value:
+            return
+        delay = max(0.0, e.value[0][0] - time.time())
+        if self._timer is not None:
+            self._timer.cancel()
+        self._timer = threading.Timer(delay, self._transfer_due)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _transfer_due(self) -> None:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return
+            now = time.time()
+            while e.value and e.value[0][0] <= now:
+                _, _, vb = e.value.pop(0)
+                self._dest._entry().value.append(vb)
+            self._store.notify()
+            if e.value:
+                self._schedule_transfer()
+
+    def size(self) -> int:
+        with self._store.lock:
+            e = self._entry(create=False)
+            return 0 if e is None else len(e.value)
+
+    def read_all(self) -> list:
+        with self._store.lock:
+            e = self._entry(create=False)
+            return [] if e is None else [self._dec(vb) for _, _, vb in e.value]
+
+    def remove(self, value: Any) -> bool:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return False
+            vb = self._enc(value)
+            for i, (_, _, b) in enumerate(e.value):
+                if b == vb:
+                    e.value.pop(i)
+                    return True
+            return False
+
+
+class PriorityQueue(GridObject):
+    """→ RedissonPriorityQueue: natural-order poll."""
+
+    KIND = "priorityqueue"
+
+    @staticmethod
+    def _new_value():
+        return []  # sorted list of (value, value bytes)
+
+    def offer(self, value: Any) -> bool:
+        with self._store.lock:
+            e = self._entry()
+            bisect.insort(e.value, (value, self._enc(value)), key=lambda t: t[0])
+            self._store.notify()
+            return True
+
+    add = offer
+
+    def poll(self) -> Any:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None or not e.value:
+                return None
+            return self._dec(e.value.pop(0)[1])
+
+    def peek(self) -> Any:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None or not e.value:
+                return None
+            return self._dec(e.value[0][1])
+
+    def size(self) -> int:
+        with self._store.lock:
+            e = self._entry(create=False)
+            return 0 if e is None else len(e.value)
+
+    def read_all(self) -> list:
+        with self._store.lock:
+            e = self._entry(create=False)
+            return [] if e is None else [v for v, _ in e.value]
+
+
+class RingBuffer(Queue):
+    """→ RedissonRingBuffer: bounded queue; offers past capacity evict the
+    oldest elements.
+
+    The backing value is {"cap", "items"} rather than Queue's plain list,
+    so every inherited method that walks the value is overridden below.
+    """
+
+    KIND = "ringbuffer"
+
+    @staticmethod
+    def _new_value():
+        return {"cap": 0, "items": []}
+
+    def offer_all(self, values: Iterable[Any]) -> bool:
+        with self._store.lock:
+            for v in values:
+                self.offer(v)
+            return True
+
+    def contains(self, value: Any) -> bool:
+        with self._store.lock:
+            e = self._entry(create=False)
+            return e is not None and self._enc(value) in e.value["items"]
+
+    def remove(self, value: Any) -> bool:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return False
+            vb = self._enc(value)
+            if vb not in e.value["items"]:
+                return False
+            e.value["items"].remove(vb)
+            return True
+
+    def poll_last_and_offer_first_to(self, dest_name: str) -> Any:
+        with self._store.lock:
+            self._store.get_entry(dest_name, Queue.KIND)
+            e = self._entry(create=False)
+            if e is None or not e.value["items"]:
+                return None
+            vb = e.value["items"].pop()
+            self._client.get_queue(dest_name)._entry().value.insert(0, vb)
+            self._store.notify()
+            return self._dec(vb)
+
+    def try_set_capacity(self, capacity: int) -> bool:
+        with self._store.lock:
+            e = self._entry()
+            if e.value["cap"]:
+                return False
+            e.value["cap"] = int(capacity)
+            return True
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._store.lock:
+            e = self._entry()
+            e.value["cap"] = int(capacity)
+            self._trim(e)
+
+    def capacity(self) -> int:
+        with self._store.lock:
+            e = self._entry(create=False)
+            return 0 if e is None else e.value["cap"]
+
+    def remaining_capacity(self) -> int:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return 0
+            return max(0, e.value["cap"] - len(e.value["items"]))
+
+    def _trim(self, e) -> None:
+        cap = e.value["cap"]
+        if cap:
+            del e.value["items"][: max(0, len(e.value["items"]) - cap)]
+
+    def offer(self, value: Any) -> bool:
+        with self._store.lock:
+            e = self._entry()
+            if not e.value["cap"]:
+                raise RuntimeError("RingBuffer capacity is not set")
+            e.value["items"].append(self._enc(value))
+            self._trim(e)
+            self._store.notify()
+            return True
+
+    add = offer
+
+    def poll(self) -> Any:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None or not e.value["items"]:
+                return None
+            return self._dec(e.value["items"].pop(0))
+
+    def peek(self) -> Any:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None or not e.value["items"]:
+                return None
+            return self._dec(e.value["items"][0])
+
+    def size(self) -> int:
+        with self._store.lock:
+            e = self._entry(create=False)
+            return 0 if e is None else len(e.value["items"])
+
+    def read_all(self) -> list:
+        with self._store.lock:
+            e = self._entry(create=False)
+            return [] if e is None else [self._dec(vb) for vb in e.value["items"]]

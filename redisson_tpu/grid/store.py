@@ -1,0 +1,180 @@
+"""GridStore — the host keyspace behind the data-grid catalog.
+
+Role parity: the Redis server's keyspace as seen through Redisson
+(→ org/redisson/RedissonObject.java name addressing + RedissonExpirable
+TTL): name → (kind, value, expire_at), with WRONGTYPE guards, lazy expiry
+on access, and a background sweeper standing in for the reference's
+``EvictionScheduler`` (→ org/redisson/eviction/, SURVEY.md §2.1).
+
+All mutation happens under one re-entrant lock; blocking collection ops
+wait on a condition tied to that lock (the pub/sub-wakeup analog of
+BLPOP, → SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from typing import Any, Callable, Optional
+
+
+class GridEntry:
+    __slots__ = ("kind", "value", "expire_at")
+
+    def __init__(self, kind: str, value: Any):
+        self.kind = kind
+        self.value = value
+        self.expire_at: Optional[float] = None  # epoch seconds
+
+    def expired(self, now: float) -> bool:
+        return self.expire_at is not None and now >= self.expire_at
+
+
+class GridStore:
+    SWEEP_INTERVAL_S = 0.25
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.cond = threading.Condition(self.lock)
+        self._data: dict[str, GridEntry] = {}
+        self._sweeper: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- entry access ------------------------------------------------------
+
+    def get_entry(self, name: str, kind: Optional[str] = None) -> Optional[GridEntry]:
+        """Live entry or None; raises TypeError on kind mismatch (the Redis
+        WRONGTYPE analog).  Caller must hold ``self.lock`` for compound
+        read-modify-write sequences."""
+        with self.lock:
+            e = self._data.get(name)
+            if e is not None and e.expired(time.time()):
+                del self._data[name]
+                e = None
+            if e is not None and kind is not None and e.kind != kind:
+                raise TypeError(f"object {name!r} holds a {e.kind}, not a {kind}")
+            return e
+
+    def ensure_entry(self, name: str, kind: str, factory: Callable[[], Any]) -> GridEntry:
+        with self.lock:
+            e = self.get_entry(name, kind)
+            if e is None:
+                e = GridEntry(kind, factory())
+                self._data[name] = e
+            return e
+
+    def put_entry(self, name: str, kind: str, value: Any) -> GridEntry:
+        with self.lock:
+            e = GridEntry(kind, value)
+            self._data[name] = e
+            self.cond.notify_all()
+            return e
+
+    def notify(self) -> None:
+        """Wake blocked takers after a mutation (BLPOP-wakeup analog)."""
+        with self.lock:
+            self.cond.notify_all()
+
+    # -- keyspace admin (RKeys backing) ------------------------------------
+
+    def exists(self, name: str) -> bool:
+        return self.get_entry(name) is not None
+
+    def delete(self, name: str) -> bool:
+        with self.lock:
+            e = self.get_entry(name)
+            if e is None:
+                return False
+            del self._data[name]
+            self.cond.notify_all()
+            return True
+
+    def rename(self, old: str, new: str) -> bool:
+        with self.lock:
+            e = self.get_entry(old)
+            if e is None:
+                return False
+            if old == new:
+                return True  # RENAME key key succeeds when the key exists
+            del self._data[old]
+            self._data[new] = e
+            return True
+
+    def names(self, pattern: Optional[str] = None) -> list[str]:
+        with self.lock:
+            now = time.time()
+            out = []
+            for n, e in list(self._data.items()):
+                if e.expired(now):
+                    del self._data[n]
+                    continue
+                if pattern is None or fnmatch.fnmatchcase(n, pattern):
+                    out.append(n)
+            return out
+
+    # -- TTL (RedissonExpirable parity) ------------------------------------
+
+    def expire(self, name: str, ttl_s: float) -> bool:
+        with self.lock:
+            e = self.get_entry(name)
+            if e is None:
+                return False
+            e.expire_at = time.time() + ttl_s
+            self._ensure_sweeper()
+            return True
+
+    def expire_at(self, name: str, epoch_s: float) -> bool:
+        with self.lock:
+            e = self.get_entry(name)
+            if e is None:
+                return False
+            e.expire_at = float(epoch_s)
+            self._ensure_sweeper()
+            return True
+
+    def clear_expire(self, name: str) -> bool:
+        with self.lock:
+            e = self.get_entry(name)
+            if e is None or e.expire_at is None:
+                return False
+            e.expire_at = None
+            return True
+
+    def remain_ttl_ms(self, name: str) -> int:
+        """→ RExpirable#remainTimeToLive: -2 absent, -1 no TTL, else ms."""
+        with self.lock:
+            e = self.get_entry(name)
+            if e is None:
+                return -2
+            if e.expire_at is None:
+                return -1
+            return max(0, int((e.expire_at - time.time()) * 1000))
+
+    # -- sweeper (EvictionScheduler analog) --------------------------------
+
+    def _ensure_sweeper(self) -> None:
+        if self._sweeper is None or not self._sweeper.is_alive():
+            self._sweeper = threading.Thread(
+                target=self._sweep_loop, name="rtpu-grid-sweeper", daemon=True
+            )
+            self._sweeper.start()
+
+    def _sweep_loop(self) -> None:
+        while not self._closed:
+            time.sleep(self.SWEEP_INTERVAL_S)
+            now = time.time()
+            with self.lock:
+                dead = [n for n, e in self._data.items() if e.expired(now)]
+                for n in dead:
+                    del self._data[n]
+                if dead:
+                    self.cond.notify_all()
+                # Let map-entry TTL structures prune themselves too.
+                for e in self._data.values():
+                    pruner = getattr(e.value, "prune_expired", None)
+                    if pruner is not None:
+                        pruner(now)
+
+    def shutdown(self) -> None:
+        self._closed = True

@@ -1,0 +1,155 @@
+"""Topics — → org/redisson/RedissonTopic.java (RTopic pub/sub),
+RedissonPatternTopic (PSUBSCRIBE glob patterns).
+
+The bus is host-side by design (SURVEY.md §2.4 pub/sub row): listener
+callbacks run on the client's delivery executor, and this is the ingest
+path that feeds the CMS streaming kernel (BASELINE config 5, §3.5).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Optional
+
+from redisson_tpu.objects.base import CamelCompatMixin
+
+
+class TopicBus:
+    """Per-client pub/sub hub (the PublishSubscribeService analog)."""
+
+    def __init__(self, n_threads: int = 2):
+        self._lock = threading.Lock()
+        self._listeners: dict[str, dict[int, Callable]] = {}
+        self._pattern_listeners: dict[str, dict[int, Callable]] = {}
+        self._next_id = 1
+        self._pool = ThreadPoolExecutor(
+            max_workers=n_threads, thread_name_prefix="rtpu-topic"
+        )
+
+    def subscribe(self, channel: str, listener: Callable) -> int:
+        with self._lock:
+            lid = self._next_id
+            self._next_id += 1
+            self._listeners.setdefault(channel, {})[lid] = listener
+            return lid
+
+    def subscribe_pattern(self, pattern: str, listener: Callable) -> int:
+        with self._lock:
+            lid = self._next_id
+            self._next_id += 1
+            self._pattern_listeners.setdefault(pattern, {})[lid] = listener
+            return lid
+
+    def unsubscribe(self, channel: str, listener_id: Optional[int] = None) -> None:
+        with self._lock:
+            if listener_id is None:
+                self._listeners.pop(channel, None)
+            else:
+                self._listeners.get(channel, {}).pop(listener_id, None)
+
+    def unsubscribe_pattern(self, pattern: str, listener_id: Optional[int] = None) -> None:
+        with self._lock:
+            if listener_id is None:
+                self._pattern_listeners.pop(pattern, None)
+            else:
+                self._pattern_listeners.get(pattern, {}).pop(listener_id, None)
+
+    def publish(self, channel: str, message: Any) -> int:
+        """Returns the number of receivers (PUBLISH reply semantics)."""
+        with self._lock:
+            targets = [
+                (None, fn) for fn in self._listeners.get(channel, {}).values()
+            ]
+            for pat, subs in self._pattern_listeners.items():
+                if fnmatch.fnmatchcase(channel, pat):
+                    targets.extend((pat, fn) for fn in subs.values())
+        for pat, fn in targets:
+            if pat is None:
+                self._pool.submit(self._safe, fn, channel, message)
+            else:
+                self._pool.submit(self._safe_pattern, fn, pat, channel, message)
+        return len(targets)
+
+    @staticmethod
+    def _safe(fn, channel, message) -> None:
+        try:
+            fn(channel, message)
+        except Exception:  # listener errors never kill delivery
+            import logging
+
+            logging.getLogger(__name__).exception("topic listener failed")
+
+    @staticmethod
+    def _safe_pattern(fn, pattern, channel, message) -> None:
+        try:
+            fn(pattern, channel, message)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception("pattern listener failed")
+
+    def count_listeners(self, channel: str) -> int:
+        with self._lock:
+            n = len(self._listeners.get(channel, {}))
+            n += sum(
+                len(subs)
+                for pat, subs in self._pattern_listeners.items()
+                if fnmatch.fnmatchcase(channel, pat)
+            )
+            return n
+
+    def drain(self, timeout: float = 5.0) -> None:
+        """Barrier: wait until every queued delivery has run (tests)."""
+        done = threading.Event()
+        self._pool.submit(done.set)
+        done.wait(timeout)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+class Topic(CamelCompatMixin):
+    """→ RTopic: add_listener(fn(channel, msg)) + publish."""
+
+    def __init__(self, name: str, client):
+        self._name = name
+        self._client = client
+        self._bus = client._topic_bus
+
+    def get_name(self) -> str:
+        return self._name
+
+    def add_listener(self, listener: Callable) -> int:
+        return self._bus.subscribe(self._name, listener)
+
+    def remove_listener(self, listener_id: int) -> None:
+        self._bus.unsubscribe(self._name, listener_id)
+
+    def remove_all_listeners(self) -> None:
+        self._bus.unsubscribe(self._name)
+
+    def publish(self, message: Any) -> int:
+        return self._bus.publish(self._name, message)
+
+    def count_subscribers(self) -> int:
+        return self._bus.count_listeners(self._name)
+
+
+class PatternTopic(CamelCompatMixin):
+    """→ RPatternTopic: glob-pattern subscription
+    (listener(fn(pattern, channel, msg)))."""
+
+    def __init__(self, pattern: str, client):
+        self._pattern = pattern
+        self._bus = client._topic_bus
+
+    def get_pattern(self) -> str:
+        return self._pattern
+
+    def add_listener(self, listener: Callable) -> int:
+        return self._bus.subscribe_pattern(self._pattern, listener)
+
+    def remove_listener(self, listener_id: int) -> None:
+        self._bus.unsubscribe_pattern(self._pattern, listener_id)

@@ -1,0 +1,573 @@
+"""Per-object tests for the host data grid (wave 1), mirroring the
+reference's per-RObject test classes (SURVEY.md §4: RedissonBucketTest,
+RedissonMapTest, RedissonQueueTest, RedissonTopicTest, …)."""
+
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+
+
+@pytest.fixture
+def client():
+    cl = redisson_tpu.create(Config())
+    yield cl
+    cl.shutdown()
+
+
+# -- bucket ----------------------------------------------------------------
+
+
+class TestBucket:
+    def test_set_get(self, client):
+        b = client.get_bucket("b1")
+        assert b.get() is None
+        b.set({"a": 1})
+        assert b.get() == {"a": 1}
+        assert b.is_exists()
+
+    def test_set_if_absent_and_exists(self, client):
+        b = client.get_bucket("b2")
+        assert b.set_if_absent("v1") is True
+        assert b.set_if_absent("v2") is False
+        assert b.get() == "v1"
+        assert b.set_if_exists("v3") is True
+        assert b.get() == "v3"
+        assert client.get_bucket("missing").set_if_exists("x") is False
+
+    def test_compare_and_set(self, client):
+        b = client.get_bucket("b3")
+        assert b.compare_and_set(None, "first") is True
+        assert b.compare_and_set("wrong", "nope") is False
+        assert b.compare_and_set("first", "second") is True
+        assert b.get() == "second"
+
+    def test_get_and_ops(self, client):
+        b = client.get_bucket("b4")
+        b.set(10)
+        assert b.get_and_set(20) == 10
+        assert b.get_and_delete() == 20
+        assert b.get() is None
+
+    def test_ttl(self, client):
+        b = client.get_bucket("b5")
+        b.set("ephemeral", ttl_seconds=0.15)
+        assert b.get() == "ephemeral"
+        assert 0 < b.remain_time_to_live() <= 150
+        time.sleep(0.2)
+        assert b.get() is None
+        assert b.remain_time_to_live() == -2
+
+    def test_buckets_multi(self, client):
+        client.get_buckets().set({"x": 1, "y": 2})
+        got = client.get_buckets().get("x", "y", "z")
+        assert got == {"x": 1, "y": 2}
+        assert client.get_buckets().try_set({"y": 9, "w": 3}) is False
+        assert client.get_buckets().try_set({"w": 3}) is True
+
+    def test_wrongtype_guard(self, client):
+        client.get_bucket("typed").set(1)
+        with pytest.raises(TypeError):
+            client.get_map("typed").put("k", "v")
+
+    def test_camelcase(self, client):
+        b = client.get_bucket("camel")
+        b.set("v")
+        assert b.getAndSet("w") == "v"
+        assert client.getBucket("camel").get() == "w"
+
+
+class TestBinaryStream:
+    def test_stream_io(self, client):
+        bs = client.get_binary_stream("bin")
+        out = bs.get_output_stream()
+        out.write(b"hello ")
+        out.close()
+        out = bs.get_output_stream()
+        out.write(b"world")
+        out.close()
+        assert bs.get_input_stream().read() == b"hello world"
+        assert bs.size() == 11
+
+
+# -- counters --------------------------------------------------------------
+
+
+class TestCounters:
+    def test_atomic_long(self, client):
+        a = client.get_atomic_long("al")
+        assert a.get() == 0
+        assert a.increment_and_get() == 1
+        assert a.add_and_get(10) == 11
+        assert a.get_and_add(5) == 11
+        assert a.get() == 16
+        assert a.compare_and_set(16, 100) is True
+        assert a.compare_and_set(16, 0) is False
+        assert a.get_and_set(7) == 100
+        assert a.decrement_and_get() == 6
+
+    def test_atomic_long_concurrent(self, client):
+        a = client.get_atomic_long("alc")
+        threads = [
+            threading.Thread(target=lambda: [a.increment_and_get() for _ in range(500)])
+            for _ in range(4)
+        ]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert a.get() == 2000
+
+    def test_atomic_double(self, client):
+        d = client.get_atomic_double("ad")
+        assert d.add_and_get(1.5) == 1.5
+        assert d.compare_and_set(1.5, 2.25) is True
+        assert d.get() == 2.25
+
+    def test_adders(self, client):
+        la = client.get_long_adder("la")
+        la.add(5)
+        la.increment()
+        la.decrement()
+        assert la.sum() == 5
+        la.reset()
+        assert la.sum() == 0
+        da = client.get_double_adder("da")
+        da.add(0.5)
+        da.add(0.25)
+        assert da.sum() == 0.75
+
+    def test_id_generator(self, client):
+        g = client.get_id_generator("ids")
+        assert g.try_init(100, 10) is True
+        assert g.try_init(0, 5) is False
+        ids = [g.next_id() for _ in range(25)]
+        assert ids == list(range(100, 125))
+        # A second handle allocates a fresh block — ids never collide.
+        g2 = client.get_id_generator("ids")
+        assert g2.next_id() not in ids
+
+
+# -- map -------------------------------------------------------------------
+
+
+class TestMap:
+    def test_put_get_remove(self, client):
+        m = client.get_map("m1")
+        assert m.put("k", "v1") is None
+        assert m.put("k", "v2") == "v1"
+        assert m.get("k") == "v2"
+        assert m.fast_put("k2", 42) is True
+        assert m.fast_put("k2", 43) is False
+        assert m.size() == 2
+        assert m.remove("k") == "v2"
+        assert m.remove("k") is None
+        assert m.fast_remove("k2", "nope") == 1
+
+    def test_conditional_ops(self, client):
+        m = client.get_map("m2")
+        assert m.put_if_absent("k", 1) is None
+        assert m.put_if_absent("k", 2) == 1
+        assert m.replace("k", 5) == 1
+        assert m.replace("missing", 5) is None
+        assert m.replace("k", 5, 6) is True
+        assert m.replace("k", 5, 7) is False
+        assert m.remove("k", 99) is False
+        assert m.remove("k", 6) is True
+
+    def test_views_and_bulk(self, client):
+        m = client.get_map("m3")
+        m.put_all({"a": 1, "b": 2, "c": 3})
+        assert sorted(m.key_set()) == ["a", "b", "c"]
+        assert sorted(m.values()) == [1, 2, 3]
+        assert m.read_all_map() == {"a": 1, "b": 2, "c": 3}
+        assert m.get_all(["a", "c", "z"]) == {"a": 1, "c": 3}
+        assert m.key_set(pattern="[ab]") == ["a", "b"] or sorted(
+            m.key_set(pattern="[ab]")
+        ) == ["a", "b"]
+        assert m.contains_key("a") and not m.contains_key("z")
+        assert m.contains_value(2) and not m.contains_value(9)
+
+    def test_add_and_get(self, client):
+        m = client.get_map("m4")
+        assert m.add_and_get("cnt", 5) == 5
+        assert m.add_and_get("cnt", -2) == 3
+
+    def test_dict_protocol(self, client):
+        m = client.get_map("m5")
+        m["x"] = 1
+        assert m["x"] == 1
+        assert "x" in m
+        assert len(m) == 1
+
+    def test_map_cache_entry_ttl(self, client):
+        mc = client.get_map_cache("mc1")
+        mc.put("t", "gone", ttl_seconds=0.15)
+        mc.put("p", "stays")
+        assert mc.get("t") == "gone"
+        assert mc.remain_time_to_live_entry("t") > 0
+        assert mc.remain_time_to_live_entry("p") == -1
+        time.sleep(0.2)
+        assert mc.get("t") is None
+        assert mc.get("p") == "stays"
+        assert mc.size() == 1
+
+    def test_map_cache_max_idle(self, client):
+        mc = client.get_map_cache("mc2")
+        mc.put("i", "v", max_idle_seconds=0.2)
+        time.sleep(0.1)
+        assert mc.get("i") == "v"  # access refreshes idle clock
+        time.sleep(0.15)
+        assert mc.get("i") == "v"
+        time.sleep(0.25)
+        assert mc.get("i") is None
+
+
+# -- set / list ------------------------------------------------------------
+
+
+class TestSet:
+    def test_basic(self, client):
+        s = client.get_set("s1")
+        assert s.add("a") is True
+        assert s.add("a") is False
+        s.add_all(["b", "c"])
+        assert s.contains("b")
+        assert s.size() == 3
+        assert s.remove("b") is True
+        assert s.remove("b") is False
+        assert sorted(s.read_all()) == ["a", "c"]
+
+    def test_algebra(self, client):
+        a = client.get_set("sa")
+        b = client.get_set("sb")
+        a.add_all([1, 2, 3])
+        b.add_all([2, 3, 4])
+        assert sorted(a.read_union("sb")) == [1, 2, 3, 4]
+        assert sorted(a.read_intersection("sb")) == [2, 3]
+        c = client.get_set("sc")
+        c.add_all([1, 2, 3])
+        c.diff("sb")
+        assert c.read_all() == [1]
+
+    def test_move_and_random(self, client):
+        a = client.get_set("sm1")
+        b = client.get_set("sm2")
+        a.add_all([1, 2])
+        assert a.move("sm2", 1) is True
+        assert a.move("sm2", 99) is False
+        assert b.contains(1)
+        got = b.remove_random(1)
+        assert got and not b.contains(got[0])
+
+    def test_set_cache_ttl(self, client):
+        sc = client.get_set_cache("scache")
+        sc.add("fleeting", ttl_seconds=0.15)
+        sc.add("durable")
+        assert sc.contains("fleeting")
+        time.sleep(0.2)
+        assert not sc.contains("fleeting")
+        assert sc.read_all() == ["durable"]
+
+
+class TestList:
+    def test_basic(self, client):
+        lst = client.get_list("l1")
+        lst.add_all(["a", "b", "c"])
+        assert lst.get(1) == "b"
+        assert lst[0] == "a"
+        lst.set(1, "B")
+        assert lst.read_all() == ["a", "B", "c"]
+        lst.insert(1, "x")
+        assert lst.read_all() == ["a", "x", "B", "c"]
+        assert lst.index_of("B") == 2
+        assert lst.remove("x") is True
+        assert lst.remove_at(0) == "a"
+        assert len(lst) == 2
+
+    def test_sublist_trim(self, client):
+        lst = client.get_list("l2")
+        lst.add_all(list(range(10)))
+        assert lst.sub_list(2, 5) == [2, 3, 4]
+        lst.trim(1, 3)
+        assert lst.read_all() == [1, 2, 3]
+
+
+class TestSortedSets:
+    def test_sorted_set(self, client):
+        ss = client.get_sorted_set("ss")
+        for v in (5, 1, 3):
+            ss.add(v)
+        assert ss.add(3) is False
+        assert ss.read_all() == [1, 3, 5]
+        assert ss.first() == 1 and ss.last() == 5
+        assert ss.remove(3) is True
+        assert ss.read_all() == [1, 5]
+
+    def test_scored_sorted_set(self, client):
+        z = client.get_scored_sorted_set("z")
+        z.add(3.0, "c")
+        z.add(1.0, "a")
+        z.add(2.0, "b")
+        assert z.get_score("b") == 2.0
+        assert z.rank("b") == 1
+        assert z.value_range(0, -1) == ["a", "b", "c"]
+        assert z.value_range_by_score(1.5, 3.0) == ["b", "c"]
+        assert z.add_score("a", 5.0) == 6.0
+        assert z.poll_first() == "b"
+        assert z.poll_last() == "a"
+        assert z.read_all() == ["c"]
+        assert z.entry_range(0, -1) == [("c", 3.0)]
+
+    def test_lex_sorted_set(self, client):
+        lx = client.get_lex_sorted_set("lx")
+        lx.add_all(["b", "a", "d", "c"])
+        assert lx.range("a", False, "d", False) == ["b", "c"]
+        assert lx.range("a", True, "c", True) == ["a", "b", "c"]
+        assert lx.range_head("c") == ["a", "b"]
+        assert lx.range_tail("b", inclusive=True) == ["b", "c", "d"]
+        assert lx.count("a", True, "d", True) == 4
+
+
+# -- queues ----------------------------------------------------------------
+
+
+class TestQueues:
+    def test_fifo(self, client):
+        q = client.get_queue("q1")
+        q.offer("a")
+        q.offer("b")
+        assert q.peek() == "a"
+        assert q.poll() == "a"
+        assert q.poll() == "b"
+        assert q.poll() is None
+
+    def test_rpoplpush(self, client):
+        q = client.get_queue("q2")
+        q.offer_all(["x", "y"])
+        moved = q.poll_last_and_offer_first_to("q3")
+        assert moved == "y"
+        assert client.get_queue("q3").peek() == "y"
+
+    def test_deque(self, client):
+        d = client.get_deque("d1")
+        d.add_last("m")
+        d.add_first("f")
+        d.add_last("l")
+        assert d.peek_first() == "f"
+        assert d.peek_last() == "l"
+        assert d.poll_last() == "l"
+        assert d.poll_first() == "f"
+
+    def test_blocking_poll_timeout(self, client):
+        bq = client.get_blocking_queue("bq1")
+        t0 = time.monotonic()
+        assert bq.poll(timeout_seconds=0.15) is None
+        assert time.monotonic() - t0 >= 0.14
+
+    def test_blocking_wakeup_across_threads(self, client):
+        bq = client.get_blocking_queue("bq2")
+        got = []
+
+        def taker():
+            got.append(bq.poll(timeout_seconds=3.0))
+
+        t = threading.Thread(target=taker)
+        t.start()
+        time.sleep(0.05)
+        bq.offer("wake")
+        t.join(timeout=3.0)
+        assert got == ["wake"]
+
+    def test_poll_from_any(self, client):
+        a = client.get_blocking_queue("any-a")
+        b = client.get_blocking_queue("any-b")
+        b.offer("from-b")
+        assert a.poll_from_any(0.5, "any-b") == "from-b"
+
+    def test_delayed_queue(self, client):
+        dest = client.get_blocking_queue("dq-dest")
+        dq = client.get_delayed_queue(dest)
+        dq.offer("later", 0.2)
+        dq.offer("sooner", 0.05)
+        assert dest.poll() is None  # nothing due yet
+        assert dest.poll(timeout_seconds=2.0) == "sooner"
+        assert dest.poll(timeout_seconds=2.0) == "later"
+        assert dq.size() == 0
+
+    def test_priority_queue(self, client):
+        pq = client.get_priority_queue("pq")
+        for v in (5, 1, 3):
+            pq.offer(v)
+        assert pq.peek() == 1
+        assert [pq.poll(), pq.poll(), pq.poll()] == [1, 3, 5]
+
+    def test_ring_buffer(self, client):
+        rb = client.get_ring_buffer("rb")
+        assert rb.try_set_capacity(3) is True
+        assert rb.try_set_capacity(5) is False
+        for i in range(5):
+            rb.add(i)
+        assert rb.read_all() == [2, 3, 4]  # oldest evicted
+        assert rb.capacity() == 3
+        assert rb.remaining_capacity() == 0
+        assert rb.poll() == 2
+
+
+# -- topics ----------------------------------------------------------------
+
+
+class TestTopics:
+    def test_publish_subscribe(self, client):
+        topic = client.get_topic("news")
+        got = []
+        lid = topic.add_listener(lambda ch, msg: got.append((ch, msg)))
+        n = topic.publish("hello")
+        client._topic_bus.drain()
+        assert n == 1
+        assert got == [("news", "hello")]
+        topic.remove_listener(lid)
+        assert topic.publish("ignored") == 0
+
+    def test_pattern_topic(self, client):
+        pt = client.get_pattern_topic("news.*")
+        got = []
+        pt.add_listener(lambda pat, ch, msg: got.append((pat, ch, msg)))
+        n = client.get_topic("news.sports").publish("goal")
+        client._topic_bus.drain()
+        assert n == 1
+        assert got == [("news.*", "news.sports", "goal")]
+        assert client.get_topic("weather").publish("rain") == 0
+
+    def test_count_subscribers(self, client):
+        t = client.get_topic("counted")
+        t.add_listener(lambda ch, m: None)
+        client.get_pattern_topic("count*").add_listener(lambda p, ch, m: None)
+        assert t.count_subscribers() == 2
+
+    def test_listener_error_does_not_break_delivery(self, client):
+        t = client.get_topic("errs")
+        got = []
+
+        def bad(ch, m):
+            raise RuntimeError("boom")
+
+        t.add_listener(bad)
+        t.add_listener(lambda ch, m: got.append(m))
+        t.publish("m1")
+        client._topic_bus.drain()
+        assert got == ["m1"]
+
+
+# -- object-level TTL + dump/restore ---------------------------------------
+
+
+class TestObjectLifecycle:
+    def test_expire_whole_object(self, client):
+        m = client.get_map("ttl-map")
+        m.put("k", "v")
+        assert m.expire(0.15) is True
+        assert m.remain_time_to_live() > 0
+        time.sleep(0.2)
+        assert m.get("k") is None
+        assert not m.is_exists()
+
+    def test_clear_expire(self, client):
+        b = client.get_bucket("persist")
+        b.set("v")
+        b.expire(0.2)
+        assert b.clear_expire() is True
+        time.sleep(0.25)
+        assert b.get() == "v"
+        assert b.remain_time_to_live() == -1
+
+    def test_sweeper_removes_expired(self, client):
+        b = client.get_bucket("swept")
+        b.set("v")
+        b.expire(0.1)
+        time.sleep(0.5)  # sweeper interval 0.25s
+        with client._grid.lock:
+            assert "swept" not in client._grid._data
+
+    def test_rename(self, client):
+        b = client.get_bucket("old")
+        b.set("v")
+        b.rename("new")
+        assert client.get_bucket("new").get() == "v"
+        assert not client.get_bucket("old").is_exists()
+
+    def test_dump_restore(self, client):
+        m = client.get_map("dumpme")
+        m.put_all({"a": 1, "b": 2})
+        blob = m.dump()
+        m.delete()
+        m.restore(blob)
+        assert m.read_all_map() == {"a": 1, "b": 2}
+        with pytest.raises(RuntimeError):
+            m.restore(blob)  # already exists
+        m.restore(blob, replace=True)
+        with pytest.raises(TypeError):
+            client.get_bucket("dumpme2").restore(blob)
+
+
+# -- review-fix regressions -------------------------------------------------
+
+
+class TestReviewFixes:
+    def test_ring_buffer_inherited_methods(self, client):
+        rb = client.get_ring_buffer("rb-r")
+        rb.try_set_capacity(4)
+        rb.offer_all([1, 2, 3])
+        assert rb.contains(2) is True
+        assert rb.remove(2) is True
+        assert rb.contains(2) is False
+        assert rb.read_all() == [1, 3]
+        moved = rb.poll_last_and_offer_first_to("rb-dest")
+        assert moved == 3
+        assert client.get_queue("rb-dest").peek() == 3
+
+    def test_max_idle_not_refreshed_by_size_or_sweeper(self, client):
+        mc = client.get_map_cache("mc-idle")
+        mc.put("i", "v", max_idle_seconds=0.25)
+        # Trigger the grid sweeper (it calls prune_expired on every value).
+        client.get_bucket("tick").set("x")
+        client.get_bucket("tick").expire(10)
+        for _ in range(6):
+            time.sleep(0.1)
+            mc.size()  # size() must not refresh the idle clock
+        assert mc.get("i") is None
+
+    def test_set_move_wrongtype_keeps_source(self, client):
+        client.get_bucket("dst-b").set(1)
+        s = client.get_set("src-s")
+        s.add("x")
+        with pytest.raises(TypeError):
+            s.move("dst-b", "x")
+        assert s.contains("x")  # element not lost
+
+    def test_queue_transfer_wrongtype_keeps_source(self, client):
+        client.get_bucket("dst-q").set(1)
+        q = client.get_queue("src-q")
+        q.offer("x")
+        with pytest.raises(TypeError):
+            q.poll_last_and_offer_first_to("dst-q")
+        assert q.contains("x")
+
+    def test_rename_missing_raises(self, client):
+        with pytest.raises(RuntimeError):
+            client.get_bucket("ghost").rename("ghost2")
+        assert not client.get_bucket("ghost2").is_exists()
+        b = client.get_bucket("same")
+        b.set("v")
+        b.rename("same")  # RENAME key key: fine when it exists
+        assert b.get() == "v"
+
+    def test_topic_camelcase_full(self, client):
+        t = client.get_topic("cc")
+        assert t.getName() == "cc"
+        lid = t.addListener(lambda ch, m: None)
+        assert t.countSubscribers() == 1
+        t.removeAllListeners()
+        assert t.countSubscribers() == 0
+        assert client.get_pattern_topic("cc*").getPattern() == "cc*"
