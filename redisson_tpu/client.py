@@ -18,29 +18,41 @@ from redisson_tpu.objects.engines import HostSketchEngine, TpuSketchEngine
 from redisson_tpu.grid import (
     AtomicDouble,
     AtomicLong,
+    Batch,
     BinaryStream,
     BlockingDeque,
     BlockingQueue,
     Bucket,
     Buckets,
+    CountDownLatch,
     DelayedQueue,
     Deque,
     DoubleAdder,
+    FairLock,
+    FencedLock,
     GridStore,
     IdGenerator,
+    Keys,
     LexSortedSet,
     List_,
+    Lock,
     LongAdder,
     Map,
     MapCache,
+    MultiLock,
     PatternTopic,
+    PermitExpirableSemaphore,
     PriorityQueue,
     Queue,
+    RateLimiter,
+    ReadWriteLock,
     RingBuffer,
     ScoredSortedSet,
+    Semaphore,
     Set_,
     SetCache,
     SortedSet,
+    SpinLock,
     Topic,
 )
 from redisson_tpu.grid.topics import TopicBus
@@ -160,6 +172,50 @@ class RedissonTpuClient(CamelCompatMixin):
 
     def get_pattern_topic(self, pattern: str):
         return PatternTopic(pattern, self)
+
+    # -- locks & synchronizers ---------------------------------------------
+
+    def get_lock(self, name: str):
+        return Lock(name, self)
+
+    def get_fair_lock(self, name: str):
+        return FairLock(name, self)
+
+    def get_spin_lock(self, name: str):
+        return SpinLock(name, self)
+
+    def get_fenced_lock(self, name: str):
+        return FencedLock(name, self)
+
+    def get_multi_lock(self, *locks):
+        return MultiLock(*locks)
+
+    get_red_lock = get_multi_lock  # → RedissonRedLock (deprecated alias)
+
+    def get_read_write_lock(self, name: str):
+        return ReadWriteLock(name, self)
+
+    def get_semaphore(self, name: str):
+        return Semaphore(name, self)
+
+    def get_permit_expirable_semaphore(self, name: str):
+        return PermitExpirableSemaphore(name, self)
+
+    def get_count_down_latch(self, name: str):
+        return CountDownLatch(name, self)
+
+    def get_rate_limiter(self, name: str):
+        return RateLimiter(name, self)
+
+    # -- batch + keys ------------------------------------------------------
+
+    def create_batch(self):
+        """→ RedissonClient#createBatch: deferred-execution facade."""
+        return Batch(self)
+
+    def get_keys(self):
+        """→ RedissonClient#getKeys."""
+        return Keys(self)
 
     # -- admin -------------------------------------------------------------
 

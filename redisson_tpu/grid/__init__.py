@@ -36,6 +36,20 @@ from redisson_tpu.grid.queues import (
     RingBuffer,
 )
 from redisson_tpu.grid.topics import PatternTopic, Topic
+from redisson_tpu.grid.locks import (
+    CountDownLatch,
+    FairLock,
+    FencedLock,
+    Lock,
+    MultiLock,
+    PermitExpirableSemaphore,
+    RateLimiter,
+    ReadWriteLock,
+    Semaphore,
+    SpinLock,
+)
+from redisson_tpu.grid.keys import Keys
+from redisson_tpu.grid.batch import Batch, BatchResult
 
 __all__ = [
     "GridStore",
@@ -46,4 +60,8 @@ __all__ = [
     "Queue", "Deque", "BlockingQueue", "BlockingDeque", "DelayedQueue",
     "PriorityQueue", "RingBuffer",
     "Topic", "PatternTopic",
+    "Lock", "FairLock", "SpinLock", "FencedLock", "MultiLock",
+    "ReadWriteLock", "Semaphore", "PermitExpirableSemaphore",
+    "CountDownLatch", "RateLimiter",
+    "Keys", "Batch", "BatchResult",
 ]

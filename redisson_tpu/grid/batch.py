@@ -1,0 +1,129 @@
+"""RBatch — → org/redisson/RedissonBatch.java (SURVEY.md §3.4): the
+user-facing deferred-execution facade the north star says must survive.
+
+``client.create_batch()`` hands out batch-scoped object facades; every
+method call queues instead of executing and returns a placeholder future;
+``execute()`` runs the queue in submission order — sketch ops ride their
+``*_async`` forms so the whole batch coalesces into few device dispatches
+(the IN_MEMORY per-node pipeline analog) — and returns a ``BatchResult``
+with one response per queued call.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+_PENDING = object()
+
+
+class BatchResult:
+    """→ org/redisson/api/BatchResult.java."""
+
+    def __init__(self, responses: list):
+        self._responses = responses
+
+    def get_responses(self) -> list:
+        return self._responses
+
+    @property
+    def responses(self) -> list:
+        return self._responses
+
+    def __len__(self):
+        return len(self._responses)
+
+    def __getitem__(self, i):
+        return self._responses[i]
+
+
+class BatchFuture:
+    """Placeholder resolved by Batch.execute() (the RFuture a queued batch
+    call returns in the reference)."""
+
+    def __init__(self):
+        self._value = _PENDING
+
+    def _set(self, value: Any) -> None:
+        self._value = value
+
+    def result(self):
+        if self._value is _PENDING:
+            raise RuntimeError("batch has not been executed yet")
+        return self._value
+
+    get = result
+
+    def done(self) -> bool:
+        return self._value is not _PENDING
+
+
+class _BatchProxy:
+    """Object facade whose method calls queue into the batch."""
+
+    def __init__(self, batch: "Batch", obj):
+        object.__setattr__(self, "_batch", batch)
+        object.__setattr__(self, "_obj", obj)
+
+    def __getattr__(self, item):
+        target = getattr(self._obj, item)  # resolves camelCase aliases too
+        if not callable(target):
+            return target
+
+        def queued(*args, **kwargs):
+            fut = BatchFuture()
+            self._batch._ops.append((self._obj, item, args, kwargs, fut))
+            return fut
+
+        return queued
+
+
+class Batch:
+    """→ RedissonBatch: ``get_*`` mirrors the client surface; objects are
+    batch-scoped proxies."""
+
+    def __init__(self, client):
+        self._client = client
+        self._ops: list[tuple] = []
+        self._executed = False
+
+    def __getattr__(self, item):
+        if item.startswith("get_") or (item.startswith("get") and item[3:4].isupper()):
+            factory = getattr(self._client, item)
+
+            def make(*args, **kwargs):
+                return _BatchProxy(self, factory(*args, **kwargs))
+
+            return make
+        raise AttributeError(item)
+
+    def execute(self) -> BatchResult:
+        """Run every queued call in submission order; returns one response
+        per call.  A batch is single-shot (reference semantics).
+
+        Calls queued through a ``*_async`` method resolve their LazyResult
+        at the end, so sketch dispatches issued earlier in the batch
+        pipeline/coalesce; sync-named calls run with their exact sync
+        return contract.
+        """
+        if self._executed:
+            raise RuntimeError("batch was already executed")
+        self._executed = True
+        staged: list[tuple] = []  # (pending_future_or_None, BatchFuture)
+        for obj, meth, args, kwargs, fut in self._ops:
+            result = getattr(obj, meth)(*args, **kwargs)
+            if meth.endswith("_async") and hasattr(result, "result"):
+                staged.append((result, fut))
+            else:
+                fut._set(result)
+                staged.append((None, fut))
+        responses = []
+        for pending, fut in staged:
+            if pending is not None:
+                fut._set(pending.result())
+            responses.append(fut.result())
+        return BatchResult(responses)
+
+    def discard(self) -> None:
+        """→ RBatch#discard."""
+        self._ops.clear()
+        self._executed = True

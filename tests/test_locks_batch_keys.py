@@ -1,0 +1,389 @@
+"""Tests for locks/synchronizers, RRateLimiter, RKeys, RBatch — mirroring
+the reference's RedissonLockTest / RedissonFairLockTest /
+RedissonSemaphoreTest / RedissonCountDownLatchTest / RedissonBatchTest /
+RedissonKeysTest (SURVEY.md §4)."""
+
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+
+
+@pytest.fixture
+def client():
+    cl = redisson_tpu.create(Config())
+    yield cl
+    cl.shutdown()
+
+
+class TestLock:
+    def test_reentrant(self, client):
+        lk = client.get_lock("L")
+        lk.lock()
+        lk.lock()
+        assert lk.is_held_by_current_thread()
+        assert lk.get_hold_count() == 2
+        lk.unlock()
+        assert lk.is_locked()
+        lk.unlock()
+        assert not lk.is_locked()
+
+    def test_unlock_foreign_raises(self, client):
+        lk = client.get_lock("L2")
+        lk.lock()
+        err = []
+
+        def other():
+            try:
+                lk.unlock()
+            except RuntimeError as e:
+                err.append(e)
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert err
+        lk.unlock()
+
+    def test_contention_and_wakeup(self, client):
+        lk = client.get_lock("L3")
+        order = []
+
+        def worker(n):
+            lk.lock()
+            order.append(n)
+            time.sleep(0.02)
+            lk.unlock()
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert sorted(order) == [0, 1, 2, 3]
+        assert not lk.is_locked()
+
+    def test_try_lock_timeout(self, client):
+        lk = client.get_lock("L4")
+        lk.lock()
+        got = []
+
+        def other():
+            got.append(lk.try_lock(wait_seconds=0.1))
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert got == [False]
+        lk.unlock()
+
+    def test_lease_expiry(self, client):
+        lk = client.get_lock("L5")
+        lk.lock(lease_seconds=0.15)
+        assert 0 < lk.remain_lease_time() <= 150
+        got = []
+
+        def other():
+            got.append(lk.try_lock(wait_seconds=1.0))
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert got == [True]  # lease expired, other thread took it
+
+    def test_force_unlock_and_context_manager(self, client):
+        lk = client.get_lock("L6")
+        with lk:
+            assert lk.is_locked()
+        assert not lk.is_locked()
+        lk.lock()
+        assert lk.force_unlock() is True
+        assert lk.force_unlock() is False
+
+    def test_fenced_lock_token_increases(self, client):
+        fl = client.get_fenced_lock("F")
+        t1 = fl.lock_and_get_token()
+        fl.unlock()
+        t2 = fl.lock_and_get_token()
+        fl.unlock()
+        assert t2 > t1
+        assert fl.get_token() is None
+
+    def test_fair_lock_fifo(self, client):
+        lk = client.get_fair_lock("FA")
+        lk.lock()
+        order = []
+        threads = []
+        for i in range(3):
+            t = threading.Thread(
+                target=lambda n=i: (lk.lock(), order.append(n), lk.unlock())
+            )
+            t.start()
+            time.sleep(0.05)  # deterministic queue order
+            threads.append(t)
+        lk.unlock()
+        [t.join() for t in threads]
+        assert order == [0, 1, 2]
+
+    def test_multi_lock(self, client):
+        a, b = client.get_lock("MA"), client.get_lock("MB")
+        ml = client.get_multi_lock(a, b)
+        assert ml.try_lock() is True
+        assert a.is_locked() and b.is_locked()
+        ml.unlock()
+        assert not a.is_locked() and not b.is_locked()
+        # Partial failure releases what was taken.
+        done = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            b.lock()
+            done.set()
+            release.wait(2)
+            b.unlock()
+
+        t = threading.Thread(target=holder)
+        t.start()
+        done.wait(2)
+        assert ml.try_lock(wait_seconds=0.1) is False
+        assert not a.is_locked()  # rolled back
+        release.set()
+        t.join()
+
+
+class TestReadWriteLock:
+    def test_many_readers(self, client):
+        rw = client.get_read_write_lock("RW")
+        r1, r2 = rw.read_lock(), rw.read_lock()
+        assert r1.try_lock() and r2.try_lock()
+        r1.unlock()
+        r2.unlock()
+
+    def test_writer_excludes_readers_from_other_threads(self, client):
+        rw = client.get_read_write_lock("RW2")
+        w = rw.write_lock()
+        w.lock()
+        got = []
+
+        def reader():
+            got.append(rw.read_lock().try_lock(wait_seconds=0.1))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        t.join()
+        assert got == [False]
+        # Writer may downgrade: its own read lock succeeds.
+        assert rw.read_lock().try_lock() is True
+        w.unlock()
+
+    def test_reader_blocks_writer(self, client):
+        rw = client.get_read_write_lock("RW3")
+        r = rw.read_lock()
+        r.lock()
+        got = []
+
+        def writer():
+            got.append(rw.write_lock().try_lock(wait_seconds=0.1))
+
+        t = threading.Thread(target=writer)
+        t.start()
+        t.join()
+        assert got == [False]
+        r.unlock()
+
+
+class TestSemaphores:
+    def test_semaphore(self, client):
+        s = client.get_semaphore("S")
+        assert s.try_set_permits(2) is True
+        assert s.try_set_permits(5) is False
+        assert s.try_acquire() is True
+        assert s.try_acquire() is True
+        assert s.try_acquire() is False
+        s.release()
+        assert s.available_permits() == 1
+        assert s.drain_permits() == 1
+        s.add_permits(3)
+        assert s.available_permits() == 3
+
+    def test_semaphore_blocking_release(self, client):
+        s = client.get_semaphore("S2")
+        s.try_set_permits(0)
+        got = []
+
+        def taker():
+            got.append(s.try_acquire(wait_seconds=2.0))
+
+        t = threading.Thread(target=taker)
+        t.start()
+        time.sleep(0.05)
+        s.release()
+        t.join()
+        assert got == [True]
+
+    def test_permit_expirable(self, client):
+        s = client.get_permit_expirable_semaphore("PS")
+        assert s.try_set_permits(1) is True
+        pid = s.try_acquire()
+        assert pid is not None
+        assert s.try_acquire() is None
+        assert s.try_release(pid) is True
+        assert s.try_release(pid) is False
+        with pytest.raises(RuntimeError):
+            s.release("bogus")
+
+    def test_permit_lease_expiry(self, client):
+        s = client.get_permit_expirable_semaphore("PS2")
+        s.try_set_permits(1)
+        s.try_acquire(lease_seconds=0.1)
+        assert s.available_permits() == 0
+        time.sleep(0.15)
+        assert s.available_permits() == 1  # reclaimed
+
+    def test_count_down_latch(self, client):
+        latch = client.get_count_down_latch("CDL")
+        assert latch.try_set_count(2) is True
+        assert latch.try_set_count(3) is False
+        done = []
+
+        def waiter():
+            done.append(latch.wait_for(timeout_seconds=2.0))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        latch.count_down()
+        assert latch.get_count() == 1
+        latch.count_down()
+        t.join()
+        assert done == [True]
+        assert latch.wait_for(timeout_seconds=0.0) is True
+
+
+class TestRateLimiter:
+    def test_rate_enforced(self, client):
+        rl = client.get_rate_limiter("RL")
+        assert rl.try_set_rate(rl.OVERALL, 3, 0.2) is True
+        assert rl.try_set_rate(rl.OVERALL, 9, 1.0) is False
+        assert rl.try_acquire() and rl.try_acquire() and rl.try_acquire()
+        assert rl.try_acquire() is False  # window exhausted
+        assert rl.try_acquire(wait_seconds=0.5) is True  # next window
+
+    def test_multi_permit_and_errors(self, client):
+        rl = client.get_rate_limiter("RL2")
+        rl.try_set_rate(rl.OVERALL, 5, 10.0)
+        assert rl.try_acquire(permits=5) is True
+        with pytest.raises(ValueError):
+            rl.try_acquire(permits=6)
+        un = client.get_rate_limiter("RL3")
+        with pytest.raises(RuntimeError):
+            un.try_acquire()
+
+
+class TestKeys:
+    def test_spans_grid_and_sketch(self, client):
+        client.get_bucket("gk1").set(1)
+        client.get_map("gk2").put("a", 1)
+        bf = client.get_bloom_filter("sk1")
+        bf.try_init(100, 0.01)
+        keys = client.get_keys()
+        assert sorted(keys.get_keys()) == ["gk1", "gk2", "sk1"]
+        assert keys.count() == 3
+        assert keys.count_exists("gk1", "sk1", "nope") == 2
+        assert sorted(keys.get_keys("gk*")) == ["gk1", "gk2"]
+
+    def test_delete_and_flush(self, client):
+        client.get_bucket("d1").set(1)
+        client.get_bucket("d2").set(2)
+        client.get_bloom_filter("d3").try_init(100, 0.01)
+        keys = client.get_keys()
+        assert keys.delete("d1", "d3", "missing") == 2
+        assert keys.count() == 1
+        client.get_bucket("e1").set(1)
+        assert keys.delete_by_pattern("d*") == 1
+        keys.flushall()
+        assert keys.count() == 0
+
+    def test_random_and_rename(self, client):
+        keys = client.get_keys()
+        assert keys.random_key() is None
+        client.get_bucket("rk").set("v")
+        assert keys.random_key() == "rk"
+        keys.rename("rk", "rk2")
+        assert client.get_bucket("rk2").get() == "v"
+        with pytest.raises(RuntimeError):
+            keys.rename("nope", "x")
+
+    def test_keys_ttl(self, client):
+        client.get_bucket("tk").set("v")
+        assert client.get_keys().expire("tk", 0.1) is True
+        assert client.get_keys().remain_time_to_live("tk") > 0
+        time.sleep(0.15)
+        assert client.get_keys().remain_time_to_live("tk") == -2
+
+
+class TestBatch:
+    def test_mixed_batch(self, client):
+        batch = client.create_batch()
+        bf = batch.get_bloom_filter("bb")
+        f0 = bf.try_init(1000, 0.01)
+        f1 = bf.add("k1")
+        f2 = bf.contains("k1")
+        bucket = batch.get_bucket("bv")
+        f3 = bucket.set("hello")
+        f4 = bucket.get()
+        counter = batch.get_atomic_long("bc")
+        f5 = counter.increment_and_get()
+        with pytest.raises(RuntimeError):
+            f1.result()  # not executed yet
+        res = batch.execute()
+        assert len(res) == 6
+        assert f0.result() is True
+        assert f1.result() is True
+        assert f2.result() is True
+        assert f4.result() == "hello"
+        assert f5.result() == 1
+        assert res.get_responses()[5] == 1
+        # effects are visible outside the batch
+        assert client.get_bloom_filter("bb").contains("k1")
+        assert client.get_bucket("bv").get() == "hello"
+
+    def test_batch_single_shot(self, client):
+        batch = client.create_batch()
+        batch.get_bucket("x").set(1)
+        batch.execute()
+        with pytest.raises(RuntimeError):
+            batch.execute()
+
+    def test_batch_discard(self, client):
+        batch = client.create_batch()
+        batch.get_bucket("never").set(1)
+        batch.discard()
+        assert not client.get_bucket("never").is_exists()
+
+    def test_batch_coalesces_sketch_ops(self, client2=None):
+        cl = redisson_tpu.create(
+            Config().use_tpu_sketch(min_bucket=64, batch_window_us=50_000)
+        )
+        try:
+            bf = cl.get_bloom_filter("cb")
+            bf.try_init(5000, 0.01)
+            batch = cl.create_batch()
+            proxy = batch.get_bloom_filter("cb")
+            # *_async queued calls resolve at the end of execute(), so the
+            # dispatches pipeline through the coalescer as one stream.
+            futs = [proxy.add_all_async([f"k{i}"]) for i in range(20)]
+            res = batch.execute()
+            assert all(f.result()[0] for f in futs)
+            assert len(res) == 20
+            assert all(bf.contains_each([f"k{i}" for i in range(20)]))
+        finally:
+            cl.shutdown()
+
+    def test_camelcase_through_batch(self, client):
+        batch = client.create_batch()
+        b = batch.getBucket("cc")
+        b.set("v")
+        f = b.getAndSet("w")
+        batch.execute()
+        assert f.result() == "v"
