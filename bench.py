@@ -82,8 +82,15 @@ def bench_hll_pfadd(client):
 def bench_config4_mixed(make_client):
     """Config 4: 1000-tenant stacked blooms, mixed add/contains through the
     coalescer; reports throughput + p50/p99 batch wait+flush latency."""
+    # min_bucket=4096 pins steady-state segments to 4 pow-2 buckets
+    # (4k..32k) — each first-compile on a tunneled device costs ~30s, so
+    # fewer shapes means a short warmup and a compile-free measurement.
+    # max_batch=8192 bounds segment fill time (p99 wait) at offered load;
+    # with min_bucket=4096 only two padded shapes exist, so warmup covers
+    # every compile.
     client = make_client(coalesce=True, exact_add_semantics=True,
-                         batch_window_us=200, max_batch=1 << 15)
+                         batch_window_us=200, max_batch=1 << 13,
+                         min_bucket=4096)
     n_tenants = 1000
     filters = []
     for t in range(n_tenants):
@@ -91,43 +98,73 @@ def bench_config4_mixed(make_client):
         bf.try_init(10_000, 0.01)
         filters.append(bf)
     rng = np.random.default_rng(7)
-    # Warmup: compile both op kinds at the working batch shapes, then zero
-    # the latency reservoirs so steady state isn't polluted by compiles.
-    warm = []
-    for t in range(0, 64):
-        keys = rng.integers(0, 50_000, 256).astype(np.uint64)
-        warm.append(filters[t].add_all_async(keys))
-        warm.append(filters[t].contains_all_async(keys))
-    for f in warm:
-        f.result()
+    # Warmup: compile the mixed kernel at every pow-2 bucket the steady
+    # state can hit (segment sizes vary with flush timing), then zero the
+    # latency reservoirs so measurement sees no compiles.
+    for nchunks in (4, 16, 32, 32):
+        warm = []
+        for i in range(nchunks):
+            keys = rng.integers(0, 50_000, 256).astype(np.uint64)
+            t = int(rng.integers(n_tenants))
+            if i % 3 == 0:
+                warm.append(filters[t].add_all_async(keys))
+            else:
+                warm.append(filters[t].contains_all_async(keys))
+        for f in warm:
+            f.result()
     client._engine.metrics.reset()
 
-    # Mixed traffic: per step pick a tenant, add or probe a small chunk.
-    futs = []
-    n_ops = 0
+    # Offered load: 8 concurrent producers (the reference's many-client
+    # regime), each keeping a sliding window of in-flight futures deep
+    # enough to hide the device link latency (~93 ms/round trip measured
+    # on the tunnel) — throughput then reflects the engine, not one
+    # blocking caller's round trips.
+    import threading
+    from collections import deque
+
+    n_threads = 8
+    steps_per_thread = 1000
     chunk = 256
+
+    def worker(tid):
+        trng = np.random.default_rng(100 + tid)
+        futs = deque()
+        for step in range(steps_per_thread):
+            t = int(trng.integers(n_tenants))
+            keys = trng.integers(0, 50_000, chunk).astype(np.uint64)
+            if step % 3 == 0:
+                futs.append(filters[t].add_all_async(keys))
+            else:
+                futs.append(filters[t].contains_all_async(keys))
+            if len(futs) >= 128:
+                for _ in range(64):
+                    futs.popleft().result()
+        for f in futs:
+            f.result()
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(n_threads)
+    ]
     t0 = time.perf_counter()
-    for step in range(2000):
-        t = int(rng.integers(n_tenants))
-        keys = rng.integers(0, 50_000, chunk).astype(np.uint64)
-        if step % 3 == 0:
-            futs.append(filters[t].add_all_async(keys))
-        else:
-            futs.append(filters[t].contains_all_async(keys))
-        n_ops += chunk
-        if len(futs) >= 64:
-            for f in futs:
-                f.result()
-            futs.clear()
-    for f in futs:
-        f.result()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
     dt = time.perf_counter() - t0
+    n_ops = n_threads * steps_per_thread * chunk
     snap = client.get_metrics()
     client.shutdown()
     return n_ops / dt, snap
 
 
 def main():
+    import jax
+
+    # Persistent compile cache: first-compiles over the device tunnel run
+    # ~30s each; cache them across bench runs.
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     import redisson_tpu
     from redisson_tpu import Config
     from redisson_tpu.codecs import LongCodec

@@ -34,10 +34,11 @@ import numpy as np
 
 
 class _Segment:
-    __slots__ = ("key", "dispatch", "chunks", "futures", "nops", "born")
+    __slots__ = ("key", "pool_key", "dispatch", "chunks", "futures", "nops", "born")
 
-    def __init__(self, key, dispatch):
+    def __init__(self, key, pool_key, dispatch):
         self.key = key
+        self.pool_key = pool_key
         self.dispatch = dispatch  # fn(list_of_chunk_arrays) -> LazyResult
         self.chunks: list[tuple] = []  # per-submit tuples of op arrays
         self.futures: list[tuple[Future, int, int]] = []  # (future, start, n)
@@ -56,8 +57,12 @@ class HintedFuture:
         self._c = coalescer
         self._transform = transform
 
-    def result(self, timeout: Optional[float] = 30.0):
-        self._c.flush_hint()
+    def result(self, timeout: Optional[float] = 120.0):
+        # Default generous enough to absorb a first-compile of a large
+        # bucket on a tunneled device (~30-60s); steady state resolves in
+        # milliseconds.  Callers wanting a strict deadline pass their own.
+        if not self._fut.done():
+            self._c.flush_hint()
         v = self._fut.result(timeout)
         return v if self._transform is None else self._transform(v)
 
@@ -73,7 +78,17 @@ class BatchCoalescer:
         self.window_s = batch_window_us / 1e6
         self.max_batch = max_batch
         self.metrics = metrics
-        self._segments: deque[_Segment] = deque()
+        # Queued segments in creation order (the flush order).  A segment
+        # stays JOINABLE while queued: ``_open`` maps segment key -> the
+        # segment new ops of that key append to, and ``_pool_tail`` maps a
+        # pool identity -> its most recently created segment.  An op may
+        # only join a segment that is still its pool's tail — per-pool
+        # strict arrival order (the slot-FIFO behavior of one Redis
+        # connection) with cross-pool coalescing in between.
+        self._order: deque[_Segment] = deque()
+        self._open: dict = {}
+        self._pool_tail: dict = {}
+        self._hurry = False  # a caller is blocking: drain the queue now
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._inflight = 0  # popped but not yet dispatched
@@ -95,17 +110,30 @@ class BatchCoalescer:
 
     # -- producer side -----------------------------------------------------
 
-    def submit(self, key, dispatch: Callable, arrays: tuple, nops: int) -> Future:
+    def submit(self, key, dispatch: Callable, arrays: tuple, nops: int, pool_key=None) -> Future:
         """Queue ``nops`` ops (column arrays in ``arrays``) for the segment
-        identified by ``key``; returns a Future of the per-op result slice."""
+        identified by ``key``; returns a Future of the per-op result slice.
+
+        ``pool_key`` identifies the state the ops touch (defaults to
+        ``key``): an op joins an existing queued segment of its key only
+        while that segment is still the pool's most recent — otherwise a
+        fresh segment is created, preserving per-pool arrival order."""
+        if pool_key is None:
+            pool_key = key
         fut: Future = Future()
         with self._lock:
             if self._closed:
                 raise RuntimeError("coalescer is shut down")
-            seg = self._segments[-1] if self._segments else None
-            if seg is None or seg.key != key or seg.nops + nops > self.max_batch:
-                seg = _Segment(key, dispatch)
-                self._segments.append(seg)
+            seg = self._open.get(key)
+            if (
+                seg is None
+                or self._pool_tail.get(seg.pool_key) is not seg
+                or seg.nops + nops > self.max_batch
+            ):
+                seg = _Segment(key, pool_key, dispatch)
+                self._open[key] = seg
+                self._pool_tail[pool_key] = seg
+                self._order.append(seg)
                 # Wake the flush thread so the window deadline is armed from
                 # the segment's birth, not from the next idle-poll tick.
                 self._wake.notify()
@@ -119,34 +147,46 @@ class BatchCoalescer:
     def flush_hint(self) -> None:
         """A caller is about to block on a Future — flush eagerly."""
         with self._lock:
+            self._hurry = True
             self._wake.notify()
 
     # -- flush thread ------------------------------------------------------
 
+    def _pop_locked(self) -> _Segment:
+        seg = self._order.popleft()
+        if self._open.get(seg.key) is seg:
+            del self._open[seg.key]
+        if self._pool_tail.get(seg.pool_key) is seg:
+            del self._pool_tail[seg.pool_key]
+        if not self._order:
+            self._hurry = False
+        self._inflight += 1
+        return seg
+
     def _run(self) -> None:
         while True:
             with self._lock:
-                while not self._segments and not self._closed:
+                while not self._order and not self._closed:
+                    self._hurry = False
                     self._wake.wait(timeout=0.05)
-                if self._closed and not self._segments:
+                if self._closed and not self._order:
                     return
-                seg = self._segments[0] if self._segments else None
-                if seg is None:
+                if not self._order:
                     continue
-                age = time.monotonic() - seg.born
+                head = self._order[0]
+                age = time.monotonic() - head.born
                 if (
-                    seg.nops < self.max_batch
+                    head.nops < self.max_batch
                     and age < self.window_s
                     and not self._closed
-                    and len(self._segments) == 1
+                    and not self._hurry
                 ):
-                    # Young, small, and nothing queued behind it: wait out
-                    # the window (or a notify from a full batch/hint).
+                    # Young and small: wait out the window (or a notify from
+                    # a full batch / a blocking caller's hint).  The head
+                    # keeps absorbing ops while it waits.
                     self._wake.wait(timeout=self.window_s - age)
-                    if not self._segments:
-                        continue
-                seg = self._segments.popleft()
-                self._inflight += 1
+                    continue
+                seg = self._pop_locked()
             self._flush(seg)
 
     def _flush(self, seg: _Segment) -> None:
@@ -208,11 +248,13 @@ class BatchCoalescer:
         with self._lock:
             if self._closed:
                 return
-            if not self._segments and self._inflight == 0:
+            if not self._order and self._inflight == 0:
                 return
-            seg = _Segment(object(), None)  # unique key: never merged into
+            barrier = object()  # unique key: never merged into
+            seg = _Segment(barrier, barrier, None)
             seg.futures.append((fut, 0, 0))
-            self._segments.append(seg)
+            self._order.append(seg)
+            self._hurry = True  # the caller is about to block on it
             self._wake.notify()
         fut.result(timeout)
 

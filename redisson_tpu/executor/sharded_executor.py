@@ -114,6 +114,39 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         out = fn(pool.state, rows_p, h1_p, h2_p, m_p, valid)
         return LazyResult(out, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
+    def bloom_mixed(self, pool, rows, m_arr, k: int, h1m, h2m, is_add) -> LazyResult:
+        B = h1m.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        fn = self._builder(
+            ("sh_bloom_mixed", wpr, k),
+            lambda: pm.sharded_bloom_mixed(
+                self.ctx, k=k, words_per_row=wpr, pack_results=True
+            ),
+        )
+        (rows_p, h1_p, h2_p), valid = self._pad_ops(Bp, rows, h1m, h2m)
+        m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
+        add_p = jnp.asarray(self._pad(np.asarray(is_add, bool), Bp))
+        pool.state, res = fn(pool.state, rows_p, h1_p, h2_p, m_p, add_p, valid)
+        return LazyResult(res, transform=lambda v: bitops.unpack_bool_u32(v, B))
+
+    def bitset_mixed(self, pool, rows, idx, opcodes) -> LazyResult:
+        B = idx.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        fn = self._builder(
+            ("sh_bs_mixed", wpr),
+            lambda: pm.sharded_bitset_mixed(
+                self.ctx, words_per_row=wpr, pack_results=True
+            ),
+        )
+        (rows_p, idx_p), valid = self._pad_ops(Bp, rows, idx)
+        ops_p = jnp.asarray(
+            self._pad(np.asarray(opcodes, np.uint32), Bp, fill=bitset_ops.OP_GET)
+        )
+        pool.state, obs = fn(pool.state, rows_p, idx_p, ops_p, valid)
+        return LazyResult(obs, transform=lambda v: bitops.unpack_bool_u32(v, B))
+
     def bloom_add_fast_st(self, pool, row: int, m: int, k: int, h1m, h2m) -> LazyResult:
         # Sharded mode has no single-tenant bit-delta fast path (the row
         # lives on one shard anyway); route through the exact multi-tenant

@@ -124,10 +124,11 @@ class TpuCommandExecutor:
     # -- jit plumbing ------------------------------------------------------
 
     def _bucket(self, n: int) -> int:
-        # Floor of 32: boolean results leave the device packed 32-per-word
-        # (bitops.pack_bool_u32), so padded batches must be 32-divisible
-        # regardless of how small the user sets min_bucket.
-        return max(32, self._cfg.min_bucket, _pow2ceil(max(1, n)))
+        # 32-divisibility: boolean results leave the device packed
+        # 32-per-word (bitops.pack_bool_u32), so both the floor and a
+        # user-set min_bucket (e.g. 48) round up to a multiple of 32.
+        mb = -(-max(32, self._cfg.min_bucket) // 32) * 32
+        return max(mb, _pow2ceil(max(1, n)))
 
     def _jit(self, key: tuple, build, donate: bool):
         fn = self._jit_cache.get(key)
@@ -203,6 +204,91 @@ class TpuCommandExecutor:
         m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
         out = fn(pool.state, rows_p, h1_p, h2_p, m_p)
         return LazyResult(out, transform=lambda v: bitops.unpack_bool_u32(v, B))
+
+    def bloom_mixed(self, pool, rows, m_arr, k: int, h1m, h2m, is_add) -> LazyResult:
+        """Combined add+contains batch (ops/bloom.bloom_mixed): the
+        coalescer's hot path — mixed multi-tenant traffic stays in ONE
+        segment per (pool, k)."""
+        B = h1m.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        key = ("bloom_mixed", wpr, pool.state.shape[0], Bp, k)
+
+        def build():
+            def f(state, rows, h1m, h2m, m_arr, is_add, valid):
+                new, res = bloom_ops.bloom_mixed(
+                    state, rows, h1m, h2m, is_add,
+                    m=m_arr, k=k, words_per_row=wpr, valid=valid,
+                )
+                return new, bitops.pack_bool_u32(res)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        (rows_p, h1_p, h2_p), valid = self._pad_ops(Bp, rows, h1m, h2m)
+        m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
+        add_p = jnp.asarray(self._pad(np.asarray(is_add, bool), Bp))
+        pool.state, res = fn(pool.state, rows_p, h1_p, h2_p, m_p, add_p, valid)
+        return LazyResult(res, transform=lambda v: bitops.unpack_bool_u32(v, B))
+
+    def bloom_mixed_keys(self, pool, rows, m_arr, k: int, blocks, lengths, is_add) -> LazyResult:
+        """Combined add+contains from raw codec lanes — device-side murmur
+        + 64-bit mod (ops/fastpath.py), multi-tenant rows/m as arrays."""
+        B = blocks.shape[0]
+        Bp = self._bucket(B)
+        blocks, L = self._trim_lanes(blocks)
+        Lt = blocks.shape[1]
+        wpr = pool.row_units
+        key = ("bloom_mixed_keys", wpr, pool.state.shape[0], Bp, k, L, Lt)
+
+        def build():
+            def f(state, rows, blocks, lengths, m_arr, is_add, valid):
+                new, res = fastpath.bloom_mixed_keys(
+                    state, rows, blocks, lengths, m_arr, is_add, valid,
+                    k=k, words_per_row=wpr, target_lanes=L,
+                )
+                return new, bitops.pack_bool_u32(res)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        blocks_p = np.zeros((Bp, Lt), np.uint32)
+        blocks_p[:B] = blocks
+        valid = np.zeros(Bp, bool)
+        valid[:B] = True
+        pool.state, res = fn(
+            pool.state,
+            jnp.asarray(self._pad(np.asarray(rows, np.int32), Bp)),
+            jnp.asarray(blocks_p),
+            jnp.asarray(self._pad(np.asarray(lengths, np.uint32), Bp)),
+            jnp.asarray(self._pad(np.asarray(m_arr, np.uint32), Bp, fill=1)),
+            jnp.asarray(self._pad(np.asarray(is_add, bool), Bp)),
+            jnp.asarray(valid),
+        )
+        return LazyResult(res, transform=lambda v: bitops.unpack_bool_u32(v, B))
+
+    def bitset_mixed(self, pool, rows, idx, opcodes) -> LazyResult:
+        """Unified set/clear/flip/get batch (ops/bitset.bitset_mixed) —
+        one segment per bitset pool under interleaved opcodes."""
+        B = idx.shape[0]
+        Bp = self._bucket(B)
+        wpr = pool.row_units
+        key = ("bs_mixed", wpr, pool.state.shape[0], Bp)
+
+        def build():
+            def f(state, rows, idx, opcodes, valid):
+                new, obs = bitset_ops.bitset_mixed(
+                    state, rows, idx, opcodes, words_per_row=wpr, valid=valid
+                )
+                return new, bitops.pack_bool_u32(obs)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        (rows_p, idx_p), valid = self._pad_ops(Bp, rows, idx)
+        # Padded ops are routed to scratch; OP_GET keeps them write-free.
+        ops_p = jnp.asarray(
+            self._pad(np.asarray(opcodes, np.uint32), Bp, fill=bitset_ops.OP_GET)
+        )
+        pool.state, obs = fn(pool.state, rows_p, idx_p, ops_p, valid)
+        return LazyResult(obs, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bloom_add_fast_st(self, pool, row: int, m: int, k: int, h1m, h2m) -> LazyResult:
         """Single-tenant fast add (snapshot newly semantics, see
@@ -705,6 +791,9 @@ def _locked(fn):
 DISPATCH_METHODS = (
     "bloom_add",
     "bloom_contains",
+    "bloom_mixed",
+    "bloom_mixed_keys",
+    "bitset_mixed",
     "bloom_add_fast_st",
     "bloom_contains_st",
     "bloom_add_keys_st",

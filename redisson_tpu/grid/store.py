@@ -40,6 +40,18 @@ class GridStore:
         self._data: dict[str, GridEntry] = {}
         self._sweeper: Optional[threading.Thread] = None
         self._closed = False
+        # Wired by the client to the sketch engine's ``exists``: the user
+        # sees ONE keyspace, so creating a grid object under a name held by
+        # the other backend is the WRONGTYPE error, not a shadow copy.
+        # (The foreign lookup takes only that backend's internal lock and
+        # no foreign path nests back into this store — no lock cycle.)
+        self.foreign_exists = None
+
+    def _guard_foreign(self, name: str) -> None:
+        if self.foreign_exists is not None and self.foreign_exists(name):
+            raise TypeError(
+                f"object {name!r} is held by the sketch backend (WRONGTYPE)"
+            )
 
     # -- entry access ------------------------------------------------------
 
@@ -60,12 +72,15 @@ class GridStore:
         with self.lock:
             e = self.get_entry(name, kind)
             if e is None:
+                self._guard_foreign(name)
                 e = GridEntry(kind, factory())
                 self._data[name] = e
             return e
 
     def put_entry(self, name: str, kind: str, value: Any) -> GridEntry:
         with self.lock:
+            if name not in self._data:
+                self._guard_foreign(name)
             e = GridEntry(kind, value)
             self._data[name] = e
             self.cond.notify_all()

@@ -74,6 +74,9 @@ class TpuSketchEngine:
             dispatch_lock=self.executor._dispatch_lock,
         )
         self.metrics = Metrics()
+        # Wired by the client to the grid store's ``exists`` — one logical
+        # keyspace across both backends (WRONGTYPE on cross-backend reuse).
+        self.foreign_exists = None
         self.coalescer = None
         if config.tpu_sketch.coalesce:
             self.coalescer = BatchCoalescer(
@@ -91,10 +94,10 @@ class TpuSketchEngine:
         if self.coalescer is not None:
             self.coalescer.drain()
 
-    def _submit(self, key, dispatch, arrays, nops):
+    def _submit(self, key, dispatch, arrays, nops, pool_key=None):
         from redisson_tpu.executor.coalescer import HintedFuture
 
-        fut = self.coalescer.submit(key, dispatch, arrays, nops)
+        fut = self.coalescer.submit(key, dispatch, arrays, nops, pool_key=pool_key)
         return HintedFuture(fut, self.coalescer)
 
     # -- generic -----------------------------------------------------------
@@ -140,6 +143,20 @@ class TpuSketchEngine:
             raise TypeError(f"object {name!r} holds a {entry.kind}, not a {kind}")
         return entry
 
+    def _guard_foreign(self, name: str) -> None:
+        """Cross-backend WRONGTYPE: creating a sketch under a name the data
+        grid holds is an error, not a shadow object.  Called before
+        creation while holding no engine lock (the foreign lookup takes
+        only the grid's lock — no nesting, no cycle)."""
+        if (
+            self.foreign_exists is not None
+            and self.registry.lookup(name) is None
+            and self.foreign_exists(name)
+        ):
+            raise TypeError(
+                f"object {name!r} is held by the data grid (WRONGTYPE)"
+            )
+
     # -- bloom -------------------------------------------------------------
 
     def bloom_try_init(self, name, expected_insertions, false_probability) -> bool:
@@ -176,14 +193,18 @@ class TpuSketchEngine:
         rows = np.full(len(H1), entry.row, np.int32)
         m_arr = np.full(len(H1), m, np.uint32)
         if self.coalescer is not None:
+            # Adds and contains share ONE segment per (pool, k) — the
+            # combined kernel keeps exact arrival-order semantics while
+            # mixed traffic coalesces instead of fragmenting (config 4).
             pool = entry.pool
             return self._submit(
-                ("bloom_add", id(pool), k),
-                lambda cols: self.executor.bloom_add(
-                    pool, cols[0], cols[1], k, cols[2], cols[3]
+                ("bloom_mix", id(pool), k),
+                lambda cols: self.executor.bloom_mixed(
+                    pool, cols[0], cols[1], k, cols[2], cols[3], cols[4]
                 ),
-                (rows, m_arr, h1m, h2m),
+                (rows, m_arr, h1m, h2m, np.ones(len(H1), bool)),
                 len(H1),
+                pool_key=id(pool),
             )
         return self.executor.bloom_add(entry.pool, rows, m_arr, k, h1m, h2m)
 
@@ -196,12 +217,13 @@ class TpuSketchEngine:
             rows = np.full(len(H1), entry.row, np.int32)
             m_arr = np.full(len(H1), m, np.uint32)
             return self._submit(
-                ("bloom_contains", id(pool), k),
-                lambda cols: self.executor.bloom_contains(
-                    pool, cols[0], cols[1], k, cols[2], cols[3]
+                ("bloom_mix", id(pool), k),
+                lambda cols: self.executor.bloom_mixed(
+                    pool, cols[0], cols[1], k, cols[2], cols[3], cols[4]
                 ),
-                (rows, m_arr, h1m, h2m),
+                (rows, m_arr, h1m, h2m, np.zeros(len(H1), bool)),
                 len(H1),
+                pool_key=id(pool),
             )
         return self.executor.bloom_contains_st(
             entry.pool, entry.row, m, k, h1m, h2m
@@ -220,22 +242,53 @@ class TpuSketchEngine:
     # path, bit-identical to the host pipeline); coalesced/sharded paths
     # hash on the host as before.
 
+    def _bloom_submit_mixed_keys(self, entry, blocks, lengths, is_add: bool):
+        """Coalesced device-hash path: raw codec lanes ride the mixed
+        kernel; producer threads never hash (GIL relief under offered
+        load).  Lane count is part of the segment key so concatenated
+        chunks always agree on shape."""
+        m, k = entry.params["size"], entry.params["hash_iterations"]
+        pool = entry.pool
+        B = blocks.shape[0]
+        L = blocks.shape[1]
+        rows = np.full(B, entry.row, np.int32)
+        m_arr = np.full(B, m, np.uint32)
+        flags = np.full(B, is_add, bool)
+        lengths = np.asarray(lengths, np.uint32)
+        if lengths.ndim == 0:
+            lengths = np.full(B, lengths, np.uint32)
+        return self._submit(
+            ("bloom_mixk", id(pool), k, L),
+            lambda cols: self.executor.bloom_mixed_keys(
+                pool, cols[0], cols[1], k, cols[2], cols[3], cols[4]
+            ),
+            (rows, m_arr, blocks, lengths, flags),
+            B,
+            pool_key=id(pool),
+        )
+
     def bloom_add_encoded(self, name, blocks, lengths) -> LazyResult:
-        if (
-            not self.config.tpu_sketch.exact_add_semantics
-            and self.executor.supports_device_hash
-        ):
-            entry = self._require(name, PoolKind.BLOOM)
-            m, k = entry.params["size"], entry.params["hash_iterations"]
-            self._drain()
-            return self.executor.bloom_add_keys_st(
-                entry.pool, entry.row, m, k, blocks, lengths
-            )
+        if self.executor.supports_device_hash:
+            if (
+                self.coalescer is not None
+                and self.config.tpu_sketch.exact_add_semantics
+            ):
+                entry = self._require(name, PoolKind.BLOOM)
+                return self._bloom_submit_mixed_keys(entry, blocks, lengths, True)
+            if not self.config.tpu_sketch.exact_add_semantics:
+                entry = self._require(name, PoolKind.BLOOM)
+                m, k = entry.params["size"], entry.params["hash_iterations"]
+                self._drain()
+                return self.executor.bloom_add_keys_st(
+                    entry.pool, entry.row, m, k, blocks, lengths
+                )
         return self.bloom_add(name, *hashing.hash128_np(blocks, lengths))
 
     def bloom_contains_encoded(self, name, blocks, lengths) -> LazyResult:
-        if self.coalescer is None and self.executor.supports_device_hash:
+        if self.executor.supports_device_hash:
             entry = self._require(name, PoolKind.BLOOM)
+            if self.coalescer is not None:
+                return self._bloom_submit_mixed_keys(entry, blocks, lengths, False)
             m, k = entry.params["size"], entry.params["hash_iterations"]
             return self.executor.bloom_contains_keys_st(
                 entry.pool, entry.row, m, k, blocks, lengths
@@ -260,6 +313,7 @@ class TpuSketchEngine:
                 ),
                 (rows, c0, c1, c2),
                 len(c0),
+                pool_key=id(pool),
             )
             # addAll boolean: did anything change?
             return _MappedFuture(fut, lambda v: bool(np.any(v)))
@@ -353,33 +407,54 @@ class TpuSketchEngine:
         entry = self._lookup_kind(name, PoolKind.BITSET)
         return 0 if entry is None else entry.pool.row_units * 32
 
-    def _bitset_rw(self, opname, method, entry, idx):
+    def _bitset_submit_mixed(self, entry, idx, opcode: int):
+        """Coalesced path: every single-bit opcode rides ONE segment per
+        pool through the unified affine kernel (exact sequential
+        semantics), so interleaved set/clear/flip/get never fragment."""
+        pool = entry.pool
         rows = np.full(len(idx), entry.row, np.int32)
+        ops_col = np.full(len(idx), opcode, np.uint32)
+        return self._submit(
+            ("bs_mix", id(pool)),
+            lambda cols: self.executor.bitset_mixed(
+                pool, cols[0], cols[1], cols[2]
+            ),
+            (rows, idx, ops_col),
+            len(idx),
+            pool_key=id(pool),
+        )
+
+    def _bitset_rw(self, opcode: int, method, entry, idx):
         if self.coalescer is not None:
-            pool = entry.pool
-            return self._submit(
-                (opname, id(pool)),
-                lambda cols: method(pool, cols[0], cols[1]),
-                (rows, idx),
-                len(idx),
-            )
+            return self._bitset_submit_mixed(entry, idx, opcode)
+        rows = np.full(len(idx), entry.row, np.int32)
         return method(entry.pool, rows, idx)
 
     def bitset_set(self, name, idx, value: bool) -> LazyResult:
+        from redisson_tpu.ops import bitset as bitset_ops
+
         idx = np.asarray(idx, np.uint32)
         entry = self.bitset_ensure(name, int(idx.max()) + 1 if idx.size else 1)
         if value:
-            return self._bitset_rw("bs_set", self.executor.bitset_set, entry, idx)
+            return self._bitset_rw(
+                bitset_ops.OP_SET, self.executor.bitset_set, entry, idx
+            )
         return self._bitset_rw(
-            "bs_clear", self.executor.bitset_clear_bits, entry, idx
+            bitset_ops.OP_CLEAR, self.executor.bitset_clear_bits, entry, idx
         )
 
     def bitset_flip(self, name, idx) -> LazyResult:
+        from redisson_tpu.ops import bitset as bitset_ops
+
         idx = np.asarray(idx, np.uint32)
         entry = self.bitset_ensure(name, int(idx.max()) + 1 if idx.size else 1)
-        return self._bitset_rw("bs_flip", self.executor.bitset_flip, entry, idx)
+        return self._bitset_rw(
+            bitset_ops.OP_FLIP, self.executor.bitset_flip, entry, idx
+        )
 
     def bitset_get(self, name, idx) -> LazyResult:
+        from redisson_tpu.ops import bitset as bitset_ops
+
         idx = np.asarray(idx, np.uint32)
         entry = self._lookup_kind(name, PoolKind.BITSET)
         if entry is None:
@@ -388,14 +463,7 @@ class TpuSketchEngine:
         in_range = idx < cap
         safe_idx = np.where(in_range, idx, 0).astype(np.uint32)
         if self.coalescer is not None:
-            pool = entry.pool
-            rows = np.full(len(idx), entry.row, np.int32)
-            fut = self._submit(
-                ("bs_get", id(pool)),
-                lambda cols: self.executor.bitset_get(pool, cols[0], cols[1]),
-                (rows, safe_idx),
-                len(idx),
-            )
+            fut = self._bitset_submit_mixed(entry, safe_idx, bitset_ops.OP_GET)
             return _MappedFuture(fut, lambda v: v & in_range)
         rows = np.full(len(idx), entry.row, np.int32)
         res = self.executor.bitset_get(entry.pool, rows, safe_idx)
@@ -488,14 +556,19 @@ class TpuSketchEngine:
         rows = np.full(len(H1), entry.row, np.int32)
         wts = np.asarray(weights, np.uint32)
         if self.coalescer is not None:
+            # Updates and estimates share one segment per (pool, d, w):
+            # estimate ops ride with weight 0 (the scatter-add identity).
+            # Estimates in a flush window may observe adds coalesced into
+            # the same batch — CMS stays an upper bound either way.
             pool = entry.pool
             return self._submit(
-                ("cms_add", id(pool), d, w),
+                ("cms_mix", id(pool), d, w),
                 lambda cols: self.executor.cms_update_estimate(
                     pool, cols[0], cols[1], cols[2], cols[3], d, w
                 ),
                 (rows, h1w, h2w, wts),
                 len(H1),
+                pool_key=id(pool),
             )
         return self.executor.cms_update_estimate(
             entry.pool, rows, h1w, h2w, wts, d, w
@@ -508,13 +581,15 @@ class TpuSketchEngine:
         rows = np.full(len(H1), entry.row, np.int32)
         if self.coalescer is not None:
             pool = entry.pool
+            zeros = np.zeros(len(H1), np.uint32)
             return self._submit(
-                ("cms_est", id(pool), d, w),
-                lambda cols: self.executor.cms_estimate(
-                    pool, cols[0], cols[1], cols[2], d, w
+                ("cms_mix", id(pool), d, w),
+                lambda cols: self.executor.cms_update_estimate(
+                    pool, cols[0], cols[1], cols[2], cols[3], d, w
                 ),
-                (rows, h1w, h2w),
+                (rows, h1w, h2w, zeros),
                 len(H1),
+                pool_key=id(pool),
             )
         return self.executor.cms_estimate(entry.pool, rows, h1w, h2w, d, w)
 

@@ -208,6 +208,105 @@ def scatter_set_bits(flat_words, gword, bit):
     return new, prev
 
 
+def scatter_set_bits_masked(flat_words, gword, bit, is_write):
+    """SETBIT batch where only ``is_write`` ops set their bit; EVERY op
+    (writer or reader) observes the bit value at its sequence position —
+    set pre-batch OR by an earlier *writer* in the batch.
+
+    This is the combined add+contains primitive: mixed read/write traffic
+    on one pool coalesces into a single segment (one device launch) while
+    keeping the exact one-op-at-a-time semantics of sequential Redis
+    execution.  Returns (new_flat, observed uint32[N] 0/1, arrival order).
+    """
+    n = gword.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    wr = is_write.astype(jnp.int32)
+    sw, sb, sp, swr = lax.sort((gword, bit, pos, wr), num_keys=2, is_stable=True)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), (sw[1:] != sw[:-1]) | (sb[1:] != sb[:-1])]
+    )
+    # Earlier writer exists in this run <=> exclusive segmented max of
+    # (pos+1 for writers, 0 for readers) is nonzero.
+    earlier_writer = segmented_exclusive_max(first, swr * (sp + 1)) > 0
+    pre = gather_bits(flat_words, sw, sb)
+    obs_sorted = pre | earlier_writer.astype(jnp.uint32)
+    contributes = (swr > 0) & ~earlier_writer
+    delta = jnp.zeros_like(flat_words).at[sw].add(
+        (_ONE << sb) * contributes.astype(jnp.uint32)
+    )
+    new = flat_words | delta
+    obs = jnp.zeros_like(obs_sorted).at[sp].set(obs_sorted)
+    return new, obs
+
+
+def _segmented_affine_scan(first, b, a):
+    """Segmented scan of bit-affine maps ``x -> a ^ (b & x)`` composed
+    earlier-first.  Returns (eb, ea, ib, ia): exclusive and inclusive
+    composites per element (exclusive = identity (1, 0) at segment starts).
+    Composition (g after f): b = b_g & b_f, a = a_g ^ (b_g & a_f); the
+    segment-reset combine is the standard Blelloch segmented-scan operator,
+    associative because the underlying composition is."""
+
+    def comb(x, y):
+        f1, b1, a1 = x
+        f2, b2, a2 = y
+        return (
+            f1 | f2,
+            jnp.where(f2, b2, b2 & b1),
+            jnp.where(f2, a2, a2 ^ (b2 & a1)),
+        )
+
+    _, ib, ia = lax.associative_scan(comb, (first, b, a))
+    one = jnp.ones_like(b)
+    zero = jnp.zeros_like(a)
+    eb = jnp.where(first, one, jnp.concatenate([one[:1], ib[:-1]]))
+    ea = jnp.where(first, zero, jnp.concatenate([zero[:1], ia[:-1]]))
+    return eb, ea, ib, ia
+
+
+def scatter_bit_affine(flat_words, gword, bit, b_coef, a_coef):
+    """Unified GETBIT/SETBIT/clear/flip batch.  Each op applies
+    ``x -> a ^ (b & x)`` to its bit — get:(1,0), set:(0,1), clear:(0,0),
+    flip:(1,1) — and observes the value just *before* its own application
+    (exact sequential semantics, so set/clear/flip report prev and get
+    reports current).  One launch serves arbitrarily interleaved opcodes,
+    which is what lets the coalescer keep a single segment per bitset pool.
+    Returns (new_flat, observed uint32[N] 0/1, arrival order)."""
+    n = gword.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    sw, sb, sp, sbc, sac = lax.sort(
+        (
+            gword,
+            bit,
+            pos,
+            b_coef.astype(jnp.uint32),
+            a_coef.astype(jnp.uint32),
+        ),
+        num_keys=2,
+        is_stable=True,
+    )
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), (sw[1:] != sw[:-1]) | (sb[1:] != sb[:-1])]
+    )
+    eb, ea, ib, ia = _segmented_affine_scan(first, sbc, sac)
+    pre = gather_bits(flat_words, sw, sb)
+    obs_sorted = ea ^ (eb & pre)
+    # The last element of each run knows the run's final bit value; write
+    # it with a clear+set pair of deltas (distinct bits of one word OR via
+    # scatter-add of disjoint masks).
+    last_of_run = jnp.concatenate([first[1:], jnp.ones((1,), bool)])
+    final = ia ^ (ib & pre)
+    t_delta = jnp.zeros_like(flat_words).at[sw].add(
+        (_ONE << sb) * last_of_run.astype(jnp.uint32)
+    )
+    f_delta = jnp.zeros_like(flat_words).at[sw].add(
+        (_ONE << sb) * (final * last_of_run.astype(jnp.uint32))
+    )
+    new = (flat_words & ~t_delta) | f_delta
+    obs = jnp.zeros_like(obs_sorted).at[sp].set(obs_sorted)
+    return new, obs
+
+
 def scatter_clear_bits(flat_words, gword, bit):
     """SETBIT(...,0) batch.  Sequential prev semantics (0 after an earlier
     clear in the same batch)."""

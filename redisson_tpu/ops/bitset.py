@@ -49,6 +49,24 @@ def bitset_flip(flat_words, rows, idx, *, words_per_row: int, valid=None):
     return new, prev.astype(bool)
 
 
+# Opcode encoding for bitset_mixed: (b << 1) | a of the bit-affine map
+# x -> a ^ (b & x) each op applies to its bit.
+OP_CLEAR, OP_SET, OP_GET, OP_FLIP = 0, 1, 2, 3
+
+
+def bitset_mixed(flat_words, rows, idx, opcodes, *, words_per_row: int, valid=None):
+    """Unified single-bit batch: per-op opcode in {OP_GET, OP_SET,
+    OP_CLEAR, OP_FLIP} (see encoding above).  Exact sequential semantics:
+    every op observes the bit value just before its own application.
+    Returns (new_flat, observed bool[B])."""
+    gw, bt = _flat(rows, idx, words_per_row)
+    gw = bitops.route_invalid_to_scratch(gw, valid, flat_words.shape[0])
+    b_coef = (opcodes >> np.uint32(1)) & np.uint32(1)
+    a_coef = opcodes & np.uint32(1)
+    new, obs = bitops.scatter_bit_affine(flat_words, gw, bt, b_coef, a_coef)
+    return new, obs.astype(bool)
+
+
 def bitset_set_range(flat_words, row, from_bit, to_bit, *, words_per_row: int, value: bool = True):
     """set(from, to) — word-mask kernel; from/to may be traced scalars."""
     mask = bitops.range_mask_words(words_per_row, from_bit, to_bit)

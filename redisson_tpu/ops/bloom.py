@@ -54,6 +54,33 @@ def bloom_add(flat_words, rows, h1m, h2m, *, m: int, k: int, words_per_row: int,
     return new, newly
 
 
+def bloom_mixed(flat_words, rows, h1m, h2m, is_add, *, m, k: int, words_per_row: int, valid=None):
+    """Combined add+contains batch with exact sequential semantics.
+
+    ``is_add`` bool[B] selects per op: add ops set their k bits and report
+    newly-added (some bit unset both pre-batch and by all earlier adds in
+    the batch); contains ops write nothing and report membership at their
+    sequence position (bits set pre-batch or by earlier adds count).
+
+    One kernel for both opcodes lets the coalescer keep a single segment
+    per (pool, k) under mixed traffic — the config-4 shape — instead of
+    breaking a new segment on every add/contains alternation.
+    Returns (new_flat, result bool[B]).
+    """
+    idx = bitops.expand_km_indexes(h1m, h2m, m, k)
+    gword, bit = _op_words(rows[:, None], idx, words_per_row)
+    if valid is not None:
+        gword = bitops.route_invalid_to_scratch(
+            gword, valid[:, None], flat_words.shape[0]
+        )
+    gw, bt = gword.reshape(-1), bit.reshape(-1)
+    wr = jnp.broadcast_to(is_add[:, None], idx.shape).reshape(-1)
+    new, obs = bitops.scatter_set_bits_masked(flat_words, gw, bt, wr)
+    all_set = (obs == 1).reshape(idx.shape).all(axis=1)
+    result = jnp.where(is_add, ~all_set, all_set)
+    return new, result
+
+
 def bloom_cardinality(flat_words, row, *, m: int, k: int, words_per_row: int):
     """BITCOUNT-based estimate pieces: returns the set-bit count X of one
     tenant row; the host applies ``-m/k * ln(1 - X/m)``

@@ -151,6 +151,21 @@ def bloom_contains_keys_st(flat_words, row, blocks, lengths, m, *, k: int, words
     )
 
 
+def bloom_mixed_keys(flat_words, rows, blocks, lengths, m_arr, is_add, valid, *, k: int, words_per_row: int, target_lanes: int):
+    """Multi-tenant combined add+contains from raw key lanes: murmur +
+    exact 64-bit mod run in-kernel (bit-identical to the host pipeline),
+    then the exact sequential mixed kernel.  This is the coalesced hot
+    path: producers ship only codec bytes, so host threads never hash —
+    the config-4 offered-load regime stops serializing on the GIL."""
+    from redisson_tpu.ops import bloom
+
+    h1m, h2m = _hash_km_device(blocks, lengths, m_arr, target_lanes)
+    return bloom.bloom_mixed(
+        flat_words, rows, h1m, h2m, is_add,
+        m=m_arr, k=k, words_per_row=words_per_row, valid=valid,
+    )
+
+
 def hll_add_keys_single(flat_regs, row, blocks, lengths, valid, *, target_lanes: int):
     """Single-tenant PFADD from raw key lanes — murmur on device, then the
     standard scatter-max; returns (new, changed)."""

@@ -123,6 +123,61 @@ def sharded_bloom_contains(ctx: MeshContext, *, k: int, words_per_row: int, pack
     return jax.jit(fn)
 
 
+def sharded_bloom_mixed(ctx: MeshContext, *, k: int, words_per_row: int, pack_results: bool = False):
+    """Combined add+contains (ops/bloom.bloom_mixed) under the ownership-
+    mask pattern: non-owned ops route to the shard's scratch word and are
+    masked out of the psum."""
+    S = ctx.n_shards
+
+    def inner(state, rows, h1m, h2m, m_arr, is_add, valid):
+        local = state[0]
+        own, local_rows = _own_and_local(rows, valid, S)
+        new_local, res = bloom.bloom_mixed(
+            local, local_rows, h1m, h2m, is_add,
+            m=m_arr, k=k, words_per_row=words_per_row, valid=own,
+        )
+        res = lax.psum(jnp.where(own, res, False).astype(jnp.int32), "shard")
+        out = res > 0
+        if pack_results:
+            out = bitops.pack_bool_u32(out)
+        return new_local[None], out
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P(), P(), P(), P(), P()),
+        out_specs=(P("shard"), P()),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def sharded_bitset_mixed(ctx: MeshContext, *, words_per_row: int, pack_results: bool = False):
+    """Unified set/clear/flip/get batch (ops/bitset.bitset_mixed), masked."""
+    from redisson_tpu.ops import bitset as bitset_ops
+
+    S = ctx.n_shards
+
+    def inner(state, rows, idx, opcodes, valid):
+        local = state[0]
+        own, lrows = _own_and_local(rows, valid, S)
+        new_local, obs = bitset_ops.bitset_mixed(
+            local, lrows, idx, opcodes, words_per_row=words_per_row, valid=own
+        )
+        obs = lax.psum(jnp.where(own, obs, False).astype(jnp.int32), "shard")
+        out = obs > 0
+        if pack_results:
+            out = bitops.pack_bool_u32(out)
+        return new_local[None], out
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P(), P(), P()),
+        out_specs=(P("shard"), P()),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
 # --------------------------------------------------------------------------
 # Tenant-sharded HLL
 # --------------------------------------------------------------------------
