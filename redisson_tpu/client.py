@@ -60,12 +60,26 @@ from redisson_tpu.grid.topics import TopicBus
 
 class RedissonTpuClient(CamelCompatMixin):
     def __init__(self, config: Config):
+        import uuid
+
         self.config = config
+        # Per-client identity for lock ownership (→ the reference's
+        # connection-manager UUID in the UUID:threadId lock value).  id()
+        # of a garbage-collected client can be recycled, so it must not
+        # participate in ownership.
+        self.id = uuid.uuid4().hex
         if config.tpu_sketch.enabled:
             self._engine = TpuSketchEngine(config)
         else:
             self._engine = HostSketchEngine(config)
         self._grid = GridStore()
+        # One logical keyspace across both backends (ADVICE r2): creating
+        # an object under a name the other backend holds is WRONGTYPE.
+        # Wired to the lock-free ``probe`` on each side — guards run while
+        # holding the caller's own lock, so a locking foreign lookup would
+        # deadlock (AB-BA).
+        self._engine.foreign_exists = self._grid.probe
+        self._grid.foreign_exists = self._engine.probe
         self._topic_bus = TopicBus(n_threads=config.threads)
         self._shutdown = False
 

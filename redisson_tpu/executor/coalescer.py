@@ -13,6 +13,16 @@ Flush policy (SURVEY.md §7 hard part #1 — latency vs throughput):
 - when its oldest op exceeds the ``batch_window_us`` deadline, or
 - immediately when a caller blocks on a result (``flush_hint``).
 
+Pipelining (measured on the tunneled v5e, round 3): a dispatch whose
+result is synced promptly completes in ~10-40 ms wall-clock, but letting
+more than ~12 dispatches accumulate un-synced degrades EVERY in-flight op
+to ~100 ms (the transport falls back to a slow retirement path).  Two
+rules keep the fast regime:
+- ``max_inflight`` bounds dispatched-but-uncollected segments (a
+  semaphore acquired before dispatch, released by the completer), and
+- consecutive same-key segments are merged at pop time, so a backlog
+  collapses into fewer, larger launches instead of a deep queue.
+
 Ordering: segments of one pool flush FIFO, so a read submitted after a
 write observes it (per-thread read-your-writes at flush granularity);
 cross-thread order is arrival order, same as concurrent Redisson clients.
@@ -74,10 +84,13 @@ class HintedFuture:
 
 
 class BatchCoalescer:
-    def __init__(self, *, batch_window_us: int, max_batch: int, metrics=None):
+    def __init__(self, *, batch_window_us: int, max_batch: int, metrics=None,
+                 max_inflight: int = 8):
         self.window_s = batch_window_us / 1e6
         self.max_batch = max_batch
         self.metrics = metrics
+        # Bounds dispatched-but-uncollected segments (see module docstring).
+        self._inflight_sem = threading.BoundedSemaphore(max(1, max_inflight))
         # Queued segments in creation order (the flush order).  A segment
         # stays JOINABLE while queued: ``_open`` maps segment key -> the
         # segment new ops of that key append to, and ``_pool_tail`` maps a
@@ -163,6 +176,24 @@ class BatchCoalescer:
         self._inflight += 1
         return seg
 
+    def _merge_consecutive_locked(self, head: _Segment) -> _Segment:
+        """Fold consecutive queued segments with the same key into ``head``
+        (up to max_batch): a backlog becomes one larger launch instead of a
+        deep dispatch queue.  Only the immediate run at the front is
+        merged, so per-pool arrival order is trivially preserved (any
+        same-pool segment is same-key here — segment keys embed the pool)."""
+        while self._order:
+            nxt = self._order[0]
+            if nxt.key != head.key or head.nops + nxt.nops > self.max_batch:
+                break
+            self._pop_locked()
+            self._inflight -= 1  # merged segs dispatch as one launch
+            head.chunks.extend(nxt.chunks)
+            for fut, start, n in nxt.futures:
+                head.futures.append((fut, head.nops + start, n))
+            head.nops += nxt.nops
+        return head
+
     def _run(self) -> None:
         while True:
             with self._lock:
@@ -187,6 +218,13 @@ class BatchCoalescer:
                     self._wake.wait(timeout=self.window_s - age)
                     continue
                 seg = self._pop_locked()
+                if seg.dispatch is not None:
+                    seg = self._merge_consecutive_locked(seg)
+            if seg.dispatch is not None:
+                # Throttle BEFORE the flush work: keeps the transport's
+                # in-flight window shallow (fast retirement regime) and
+                # lets the queue behind us keep merging while we wait.
+                self._inflight_sem.acquire()
             self._flush(seg)
 
     def _flush(self, seg: _Segment) -> None:
@@ -199,7 +237,10 @@ class BatchCoalescer:
                     if fut.set_running_or_notify_cancel():
                         fut.set_result(None)
                 return
-            cols = [np.concatenate(c) for c in zip(*seg.chunks)]
+            cols = [
+                c[0] if len(c) == 1 else np.concatenate(c)
+                for c in zip(*seg.chunks)
+            ]
             lazy = seg.dispatch(cols)
             with self._lock:
                 # Dispatched (device-ordered): drain() may proceed even
@@ -210,6 +251,7 @@ class BatchCoalescer:
             with self._lock:
                 if self._inflight > 0:
                     self._inflight -= 1
+            self._inflight_sem.release()
             for fut, _, _ in seg.futures:
                 if fut.set_running_or_notify_cancel():
                     fut.set_exception(e)
@@ -222,12 +264,17 @@ class BatchCoalescer:
             seg, lazy, t0 = item
             try:
                 res = lazy.result() if lazy is not None else None
+                self._inflight_sem.release()
                 for fut, start, n in seg.futures:
                     if fut.set_running_or_notify_cancel():
                         fut.set_result(
                             None if res is None else res[start : start + n]
                         )
             except Exception as e:  # pragma: no cover - defensive
+                try:
+                    self._inflight_sem.release()
+                except ValueError:
+                    pass
                 for fut, _, _ in seg.futures:
                     if fut.set_running_or_notify_cancel():
                         fut.set_exception(e)

@@ -3,11 +3,21 @@
 The cluster-mode analog of the reference's ClusterConnectionManager +
 CommandExecutor pair (→ org/redisson/cluster/ClusterConnectionManager.java,
 SURVEY.md §2.4 cluster-sharding row): instead of CRC16 slots and MOVED
-redirects, tenant row ``r`` lives on shard ``r % S`` of a 1-D device mesh,
-op batches are replicated to every shard, and each shard executes the same
-single-device kernel on its local pool block with an ownership mask — one
-ICI ``psum`` per batch combines results, no host round trips and no
-redirects (resharding would be an explicit device-array remap).
+redirects, tenant row ``r`` lives on shard ``r % S`` of a 1-D device mesh.
+
+Dispatch model (round 3 — partition-by-owner): the host splits every op
+batch by owner shard into ``[S, Bp]`` blocks — the role
+CommandBatchService#executeAsync plays when it groups commands per
+MasterSlaveEntry (SURVEY.md §3.2) — and ``shard_map`` with
+``in_specs=P("shard")`` hands each shard ONLY its ops.  Total device work
+is B (round 2 replicated every batch to every shard: S×B), writes are
+shard-local, and results come back ``[S, Bp]`` bit-packed with no
+collective.  Collectives remain only for genuinely cross-shard ops
+(BITOP/PFMERGE, m-sharded bitmaps — parallel/mesh.py).
+
+Device-side hashing works in sharded mode too (``supports_device_hash``):
+raw codec lanes ride the partition and murmur runs in-kernel, so sharded
+traffic ships key bytes, not 16-byte host hashes.
 
 Pool state: ``[S, local_len]`` arrays block-sharded along axis 0
 (NamedSharding over a ``jax.sharding.Mesh``); each shard's local block is a
@@ -38,8 +48,59 @@ from redisson_tpu.executor.tpu_executor import (
 from redisson_tpu.parallel import mesh as pm
 
 
+class _Partition:
+    """Host-side owner-shard split of one op batch: builds the [S, Bp]
+    scatter layout and the inverse mapping that restores per-op results to
+    arrival order."""
+
+    __slots__ = ("S", "B", "Bp", "order", "sh_sorted", "slot", "lrows", "valid")
+
+    def __init__(self, S: int, rows, bucket_fn):
+        rows = np.asarray(rows, np.int64)
+        self.S = S
+        self.B = int(rows.shape[0])
+        shard = rows % S
+        self.order = np.argsort(shard, kind="stable")
+        counts = np.bincount(shard, minlength=S)
+        self.Bp = bucket_fn(int(counts.max()) if self.B else 1)
+        self.sh_sorted = shard[self.order]
+        offsets = np.zeros(S, np.int64)
+        np.cumsum(counts[:-1], out=offsets[1:])
+        self.slot = np.arange(self.B, dtype=np.int64) - offsets[self.sh_sorted]
+        self.lrows = (rows // S).astype(np.int32)
+        valid = np.zeros((S, self.Bp), bool)
+        valid[self.sh_sorted, self.slot] = True
+        self.valid = valid
+
+    def scatter(self, col, fill=0):
+        """[B] (or [B, L]) column -> [S, Bp] (or [S, Bp, L]) block."""
+        col = np.asarray(col)
+        shape = (self.S, self.Bp) + col.shape[1:]
+        out = np.full(shape, fill, col.dtype)
+        out[self.sh_sorted, self.slot] = col[self.order]
+        return out
+
+    def unpack_bools(self, packed: np.ndarray) -> np.ndarray:
+        """[S, Bp/32] packed results -> bool[B] in arrival order."""
+        un = bitops.unpack_bool_u32(
+            np.ascontiguousarray(packed).reshape(-1), self.S * self.Bp
+        ).reshape(self.S, self.Bp)
+        res = np.empty(self.B, bool)
+        res[self.order] = un[self.sh_sorted, self.slot]
+        return res
+
+    def gather_vals(self, block: np.ndarray) -> np.ndarray:
+        """[S, Bp] per-op values -> [B] in arrival order."""
+        res = np.empty(self.B, block.dtype)
+        res[self.order] = block[self.sh_sorted, self.slot]
+        return res
+
+
 class ShardedTpuCommandExecutor(TpuCommandExecutor):
-    supports_device_hash = False  # keys arrive pre-hashed from the host
+    # Raw codec lanes partition like any other column; murmur runs
+    # in-kernel on the owning shard (was False in round 2 — sharded mode
+    # silently dropped the device-hash fast path).
+    supports_device_hash = True
 
     def __init__(self, config):
         super().__init__(config)
@@ -69,6 +130,9 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         )
         return jax.device_put(new_state, self.ctx.state_sharding)
 
+    def state_from_host(self, pool, arr: np.ndarray) -> None:
+        pool.state = jax.device_put(jnp.asarray(arr), self.ctx.state_sharding)
+
     # -- builder cache (mesh.py builders are already jitted; jax handles
     # shape polymorphism internally, so keys don't need batch sizes) -------
 
@@ -82,70 +146,70 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
                     self._jit_cache[key] = fn
         return fn
 
-    # -- bloom -------------------------------------------------------------
+    def _part(self, rows) -> _Partition:
+        return _Partition(self.S, rows, self._bucket)
+
+    # -- bloom (all single-bit traffic routes through the partitioned
+    # mixed kernel: adds are is_add=True ops, contains is_add=False) -------
+
+    def _bloom_mixed_part(self, pool, rows, m_arr, k: int, h1m, h2m, is_add):
+        wpr = pool.row_units
+        fn = self._builder(
+            ("psh_bloom_mixed", wpr, k),
+            lambda: pm.psharded_bloom_mixed(self.ctx, k=k, words_per_row=wpr),
+        )
+        p = self._part(rows)
+        pool.state, packed = fn(
+            pool.state,
+            jnp.asarray(p.scatter(p.lrows)),
+            jnp.asarray(p.scatter(np.asarray(h1m, np.uint32))),
+            jnp.asarray(p.scatter(np.asarray(h2m, np.uint32))),
+            jnp.asarray(p.scatter(np.asarray(m_arr, np.uint32), fill=1)),
+            jnp.asarray(p.scatter(np.asarray(is_add, bool))),
+            jnp.asarray(p.valid),
+        )
+        return LazyResult(packed, transform=p.unpack_bools)
 
     def bloom_add(self, pool, rows, m_arr, k: int, h1m, h2m) -> LazyResult:
-        B = h1m.shape[0]
-        Bp = self._bucket(B)
-        wpr = pool.row_units
-        fn = self._builder(
-            ("sh_bloom_add", wpr, k),
-            lambda: pm.sharded_bloom_add(
-                self.ctx, k=k, words_per_row=wpr, pack_results=True
-            ),
+        return self._bloom_mixed_part(
+            pool, rows, m_arr, k, h1m, h2m, np.ones(len(h1m), bool)
         )
-        (rows_p, h1_p, h2_p), valid = self._pad_ops(Bp, rows, h1m, h2m)
-        m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
-        pool.state, newly = fn(pool.state, rows_p, h1_p, h2_p, m_p, valid)
-        return LazyResult(newly, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bloom_contains(self, pool, rows, m_arr, k: int, h1m, h2m) -> LazyResult:
-        B = h1m.shape[0]
-        Bp = self._bucket(B)
-        wpr = pool.row_units
-        fn = self._builder(
-            ("sh_bloom_contains", wpr, k),
-            lambda: pm.sharded_bloom_contains(
-                self.ctx, k=k, words_per_row=wpr, pack_results=True
-            ),
+        return self._bloom_mixed_part(
+            pool, rows, m_arr, k, h1m, h2m, np.zeros(len(h1m), bool)
         )
-        (rows_p, h1_p, h2_p), valid = self._pad_ops(Bp, rows, h1m, h2m)
-        m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
-        out = fn(pool.state, rows_p, h1_p, h2_p, m_p, valid)
-        return LazyResult(out, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bloom_mixed(self, pool, rows, m_arr, k: int, h1m, h2m, is_add) -> LazyResult:
-        B = h1m.shape[0]
-        Bp = self._bucket(B)
-        wpr = pool.row_units
-        fn = self._builder(
-            ("sh_bloom_mixed", wpr, k),
-            lambda: pm.sharded_bloom_mixed(
-                self.ctx, k=k, words_per_row=wpr, pack_results=True
-            ),
-        )
-        (rows_p, h1_p, h2_p), valid = self._pad_ops(Bp, rows, h1m, h2m)
-        m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
-        add_p = jnp.asarray(self._pad(np.asarray(is_add, bool), Bp))
-        pool.state, res = fn(pool.state, rows_p, h1_p, h2_p, m_p, add_p, valid)
-        return LazyResult(res, transform=lambda v: bitops.unpack_bool_u32(v, B))
+        return self._bloom_mixed_part(pool, rows, m_arr, k, h1m, h2m, is_add)
 
-    def bitset_mixed(self, pool, rows, idx, opcodes) -> LazyResult:
-        B = idx.shape[0]
-        Bp = self._bucket(B)
+    def bloom_mixed_keys(self, pool, rows, m_arr, k: int, blocks, lengths, is_add) -> LazyResult:
+        """Partitioned device-hash path: key lanes ship to the owning shard
+        only; murmur + exact 64-bit mod run in-kernel (ops/fastpath.py)."""
         wpr = pool.row_units
+        blocks = np.asarray(blocks)
+        blocks_t, L = self._trim_lanes(blocks)
+        Lt = blocks_t.shape[1]
         fn = self._builder(
-            ("sh_bs_mixed", wpr),
-            lambda: pm.sharded_bitset_mixed(
-                self.ctx, words_per_row=wpr, pack_results=True
+            ("psh_bloom_mixk", wpr, k, L, Lt),
+            lambda: pm.psharded_bloom_mixed_keys(
+                self.ctx, k=k, words_per_row=wpr, target_lanes=L
             ),
         )
-        (rows_p, idx_p), valid = self._pad_ops(Bp, rows, idx)
-        ops_p = jnp.asarray(
-            self._pad(np.asarray(opcodes, np.uint32), Bp, fill=bitset_ops.OP_GET)
+        p = self._part(rows)
+        lengths = np.asarray(lengths, np.uint32)
+        if lengths.ndim == 0:
+            lengths = np.full(len(rows), lengths, np.uint32)
+        pool.state, packed = fn(
+            pool.state,
+            jnp.asarray(p.scatter(p.lrows)),
+            jnp.asarray(p.scatter(blocks_t)),
+            jnp.asarray(p.scatter(lengths)),
+            jnp.asarray(p.scatter(np.asarray(m_arr, np.uint32), fill=1)),
+            jnp.asarray(p.scatter(np.asarray(is_add, bool))),
+            jnp.asarray(p.valid),
         )
-        pool.state, obs = fn(pool.state, rows_p, idx_p, ops_p, valid)
-        return LazyResult(obs, transform=lambda v: bitops.unpack_bool_u32(v, B))
+        return LazyResult(packed, transform=p.unpack_bools)
 
     def bloom_add_fast_st(self, pool, row: int, m: int, k: int, h1m, h2m) -> LazyResult:
         # Sharded mode has no single-tenant bit-delta fast path (the row
@@ -161,6 +225,30 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         m_arr = np.full(h1m.shape[0], m, np.uint32)
         return self.bloom_contains(pool, rows, m_arr, k, h1m, h2m)
 
+    def bloom_add_keys_st(self, pool, row: int, m: int, k: int, blocks, lengths) -> LazyResult:
+        B = blocks.shape[0]
+        return self.bloom_mixed_keys(
+            pool,
+            np.full(B, row, np.int32),
+            np.full(B, m, np.uint32),
+            k,
+            blocks,
+            lengths,
+            np.ones(B, bool),
+        )
+
+    def bloom_contains_keys_st(self, pool, row: int, m: int, k: int, blocks, lengths) -> LazyResult:
+        B = blocks.shape[0]
+        return self.bloom_mixed_keys(
+            pool,
+            np.full(B, row, np.int32),
+            np.full(B, m, np.uint32),
+            k,
+            blocks,
+            lengths,
+            np.zeros(B, bool),
+        )
+
     def bloom_count(self, pool, row: int, m: int, k: int) -> LazyResult:
         wpr = pool.row_units
         fn = self._builder(
@@ -175,40 +263,58 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
 
     # -- hll ---------------------------------------------------------------
 
-    def hll_add(self, pool, rows, c0, c1, c2) -> LazyResult:
-        # Flag-free PFADD (no changed machinery, no collective) — the hot
-        # bulk path; hll_add_changed serves callers that need the booleans.
-        B = c0.shape[0]
-        Bp = self._bucket(B)
+    def _hll_changed_part(self, pool, rows, c0, c1, c2):
         fn = self._builder(
-            ("sh_hll_add",), lambda: pm.sharded_hll_add(self.ctx)
+            ("psh_hll_add",), lambda: pm.psharded_hll_add_changed(self.ctx)
         )
-        (rows_p, c0p, c1p, c2p), valid = self._pad_ops(Bp, rows, c0, c1, c2)
-        pool.state = fn(pool.state, rows_p, c0p, c1p, c2p, valid)
+        p = self._part(rows)
+        pool.state, packed = fn(
+            pool.state,
+            jnp.asarray(p.scatter(p.lrows)),
+            jnp.asarray(p.scatter(np.asarray(c0, np.uint32))),
+            jnp.asarray(p.scatter(np.asarray(c1, np.uint32))),
+            jnp.asarray(p.scatter(np.asarray(c2, np.uint32))),
+            jnp.asarray(p.valid),
+        )
+        return packed, p
+
+    def hll_add(self, pool, rows, c0, c1, c2) -> LazyResult:
+        self._hll_changed_part(pool, rows, c0, c1, c2)
         return LazyResult(True)
 
-    def _hll_add_changed(self, pool, rows, c0, c1, c2):
-        B = c0.shape[0]
-        Bp = self._bucket(B)
-        fn = self._builder(
-            ("sh_hll_add_changed",),
-            lambda: pm.sharded_hll_add_changed(self.ctx, pack_results=True),
-        )
-        (rows_p, c0p, c1p, c2p), valid = self._pad_ops(Bp, rows, c0, c1, c2)
-        return fn(pool.state, rows_p, c0p, c1p, c2p, valid)
-
     def hll_add_changed(self, pool, rows, c0, c1, c2) -> LazyResult:
-        B = c0.shape[0]
-        pool.state, changed = self._hll_add_changed(pool, rows, c0, c1, c2)
-        return LazyResult(changed, transform=lambda v: bitops.unpack_bool_u32(v, B))
+        packed, p = self._hll_changed_part(pool, rows, c0, c1, c2)
+        return LazyResult(packed, transform=p.unpack_bools)
 
     def hll_add_single(self, pool, row: int, c0, c1, c2) -> LazyResult:
         rows = np.full(c0.shape[0], row, np.int32)
-        B = c0.shape[0]
-        pool.state, changed = self._hll_add_changed(pool, rows, c0, c1, c2)
+        packed, p = self._hll_changed_part(pool, rows, c0, c1, c2)
         return LazyResult(
-            changed,
-            transform=lambda v: bool(np.any(bitops.unpack_bool_u32(v, B))),
+            packed, transform=lambda v: bool(np.any(p.unpack_bools(v)))
+        )
+
+    def hll_add_keys_single(self, pool, row: int, blocks, lengths) -> LazyResult:
+        blocks = np.asarray(blocks)
+        B = blocks.shape[0]
+        blocks_t, L = self._trim_lanes(blocks)
+        Lt = blocks_t.shape[1]
+        fn = self._builder(
+            ("psh_hll_addk", L, Lt),
+            lambda: pm.psharded_hll_add_keys(self.ctx, target_lanes=L),
+        )
+        p = self._part(np.full(B, row, np.int32))
+        lengths = np.asarray(lengths, np.uint32)
+        if lengths.ndim == 0:
+            lengths = np.full(B, lengths, np.uint32)
+        pool.state, packed = fn(
+            pool.state,
+            jnp.asarray(p.scatter(p.lrows)),
+            jnp.asarray(p.scatter(blocks_t)),
+            jnp.asarray(p.scatter(lengths)),
+            jnp.asarray(p.valid),
+        )
+        return LazyResult(
+            packed, transform=lambda v: bool(np.any(p.unpack_bools(v)))
         )
 
     def hll_count(self, pool, row: int) -> LazyResult:
@@ -234,19 +340,38 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
 
     # -- bitset ------------------------------------------------------------
 
-    def _bitset_rw(self, opname, kernel, pool, rows, idx):
-        B = idx.shape[0]
-        Bp = self._bucket(B)
+    def bitset_mixed(self, pool, rows, idx, opcodes) -> LazyResult:
         wpr = pool.row_units
         fn = self._builder(
-            ("sh_" + opname, wpr),
-            lambda: pm.sharded_bitset_rw(
-                self.ctx, kernel, words_per_row=wpr, pack_results=True
-            ),
+            ("psh_bs_mixed", wpr),
+            lambda: pm.psharded_bitset_mixed(self.ctx, words_per_row=wpr),
         )
-        (rows_p, idx_p), valid = self._pad_ops(Bp, rows, idx)
-        pool.state, prev = fn(pool.state, rows_p, idx_p, valid)
-        return LazyResult(prev, transform=lambda v: bitops.unpack_bool_u32(v, B))
+        p = self._part(rows)
+        pool.state, packed = fn(
+            pool.state,
+            jnp.asarray(p.scatter(p.lrows)),
+            jnp.asarray(p.scatter(np.asarray(idx, np.uint32))),
+            jnp.asarray(
+                p.scatter(np.asarray(opcodes, np.uint32), fill=bitset_ops.OP_GET)
+            ),
+            jnp.asarray(p.valid),
+        )
+        return LazyResult(packed, transform=p.unpack_bools)
+
+    def _bitset_rw(self, opname, kernel, pool, rows, idx):
+        wpr = pool.row_units
+        fn = self._builder(
+            ("psh_" + opname, wpr),
+            lambda: pm.psharded_bitset_rw(self.ctx, kernel, words_per_row=wpr),
+        )
+        p = self._part(rows)
+        pool.state, packed = fn(
+            pool.state,
+            jnp.asarray(p.scatter(p.lrows)),
+            jnp.asarray(p.scatter(np.asarray(idx, np.uint32))),
+            jnp.asarray(p.valid),
+        )
+        return LazyResult(packed, transform=p.unpack_bools)
 
     def bitset_set(self, pool, rows, idx) -> LazyResult:
         return self._bitset_rw("bs_set", bitset_ops.bitset_set, pool, rows, idx)
@@ -258,18 +383,19 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         return self._bitset_rw("bs_flip", bitset_ops.bitset_flip, pool, rows, idx)
 
     def bitset_get(self, pool, rows, idx) -> LazyResult:
-        B = idx.shape[0]
-        Bp = self._bucket(B)
         wpr = pool.row_units
         fn = self._builder(
-            ("sh_bs_get", wpr),
-            lambda: pm.sharded_bitset_get(
-                self.ctx, words_per_row=wpr, pack_results=True
-            ),
+            ("psh_bs_get", wpr),
+            lambda: pm.psharded_bitset_get(self.ctx, words_per_row=wpr),
         )
-        (rows_p, idx_p), valid = self._pad_ops(Bp, rows, idx)
-        out = fn(pool.state, rows_p, idx_p, valid)
-        return LazyResult(out, transform=lambda v: bitops.unpack_bool_u32(v, B))
+        p = self._part(rows)
+        packed = fn(
+            pool.state,
+            jnp.asarray(p.scatter(p.lrows)),
+            jnp.asarray(p.scatter(np.asarray(idx, np.uint32))),
+            jnp.asarray(p.valid),
+        )
+        return LazyResult(packed, transform=p.unpack_bools)
 
     def bitset_set_range(self, pool, row: int, from_bit: int, to_bit: int, value: bool) -> LazyResult:
         wpr = pool.row_units
@@ -338,48 +464,46 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
 
     # -- cms ---------------------------------------------------------------
 
-    def cms_update(self, pool, rows, h1w, h2w, weights, d: int, w: int) -> LazyResult:
-        B = h1w.shape[0]
-        Bp = self._bucket(B)
+    def _cms_part(self, pool, rows, h1w, h2w, weights, d, w, mode):
         u = pool.row_units
         fn = self._builder(
-            ("sh_cms_upd", u, d, w),
-            lambda: pm.sharded_cms_update_estimate(
-                self.ctx, d=d, w=w, cells_per_row=u, update_only=True
+            ("psh_cms", u, d, w, mode),
+            lambda: pm.psharded_cms_update_estimate(
+                self.ctx,
+                d=d,
+                w=w,
+                cells_per_row=u,
+                estimate_only=(mode == "est"),
+                update_only=(mode == "upd"),
             ),
         )
-        (rows_p, h1p, h2p, w_p), valid = self._pad_ops(Bp, rows, h1w, h2w, weights)
-        pool.state = fn(pool.state, rows_p, h1p, h2p, w_p, valid)
-        return LazyResult(None)
+        p = self._part(rows)
+        args = (
+            pool.state,
+            jnp.asarray(p.scatter(p.lrows)),
+            jnp.asarray(p.scatter(np.asarray(h1w, np.uint32))),
+            jnp.asarray(p.scatter(np.asarray(h2w, np.uint32))),
+            jnp.asarray(p.scatter(np.asarray(weights, np.uint32))),
+            jnp.asarray(p.valid),
+        )
+        if mode == "est":
+            est = fn(*args)
+            return LazyResult(est, transform=p.gather_vals)
+        if mode == "upd":
+            pool.state = fn(*args)
+            return LazyResult(None)
+        pool.state, est = fn(*args)
+        return LazyResult(est, transform=p.gather_vals)
+
+    def cms_update(self, pool, rows, h1w, h2w, weights, d: int, w: int) -> LazyResult:
+        return self._cms_part(pool, rows, h1w, h2w, weights, d, w, "upd")
 
     def cms_estimate(self, pool, rows, h1w, h2w, d: int, w: int) -> LazyResult:
-        B = h1w.shape[0]
-        Bp = self._bucket(B)
-        u = pool.row_units
-        fn = self._builder(
-            ("sh_cms_est", u, d, w),
-            lambda: pm.sharded_cms_update_estimate(
-                self.ctx, d=d, w=w, cells_per_row=u, estimate_only=True
-            ),
-        )
-        (rows_p, h1p, h2p), valid = self._pad_ops(Bp, rows, h1w, h2w)
-        w_p = jnp.zeros((Bp,), jnp.uint32)
-        out = fn(pool.state, rows_p, h1p, h2p, w_p, valid)
-        return LazyResult(out, B)
+        zeros = np.zeros(len(rows), np.uint32)
+        return self._cms_part(pool, rows, h1w, h2w, zeros, d, w, "est")
 
     def cms_update_estimate(self, pool, rows, h1w, h2w, weights, d: int, w: int) -> LazyResult:
-        B = h1w.shape[0]
-        Bp = self._bucket(B)
-        u = pool.row_units
-        fn = self._builder(
-            ("sh_cms_updest", u, d, w),
-            lambda: pm.sharded_cms_update_estimate(
-                self.ctx, d=d, w=w, cells_per_row=u
-            ),
-        )
-        (rows_p, h1p, h2p, w_p), valid = self._pad_ops(Bp, rows, h1w, h2w, weights)
-        pool.state, est = fn(pool.state, rows_p, h1p, h2p, w_p, valid)
-        return LazyResult(est, B)
+        return self._cms_part(pool, rows, h1w, h2w, weights, d, w, "updest")
 
     def cms_merge(self, pool, dst_row: int, src_rows) -> LazyResult:
         u = pool.row_units

@@ -121,6 +121,14 @@ class TpuCommandExecutor:
         # state[:-1] drops the old scratch element; extra brings the new one.
         return jnp.concatenate([state[:-1], extra])
 
+    # Snapshot transport (SURVEY.md §5 checkpoint row): full-pool D2H/H2D.
+
+    def state_to_host(self, pool) -> np.ndarray:
+        return np.asarray(pool.state)
+
+    def state_from_host(self, pool, arr: np.ndarray) -> None:
+        pool.state = jnp.asarray(arr)
+
     # -- jit plumbing ------------------------------------------------------
 
     def _bucket(self, n: int) -> int:

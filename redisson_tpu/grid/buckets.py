@@ -46,12 +46,12 @@ class Bucket(GridObject):
     try_set = set_if_absent
 
     def set_if_exists(self, value: Any) -> bool:
-        """→ RBucket#setIfExists (SET XX)."""
+        """→ RBucket#setIfExists (SET XX).  Replaces the entry wholesale —
+        Redis SET XX without KEEPTTL clears any TTL, matching set()."""
         with self._store.lock:
-            e = self._entry(create=False)
-            if e is None:
+            if self._entry(create=False) is None:
                 return False
-            e.value = self._enc(value)
+            self._store.put_entry(self._name, self.KIND, self._enc(value))
             return True
 
     def get_and_set(self, value: Any) -> Any:

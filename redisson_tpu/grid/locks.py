@@ -35,10 +35,25 @@ class Lock(GridObject):
         return {"owner": None, "count": 0, "expire_at": None, "token": 0}
 
     def _me(self):
-        return (id(self._client), threading.get_ident())
+        # UUID:threadId — the reference's lock value (→ RedissonLock).
+        # id(client) would alias once a dead client's id is recycled.
+        return (self._client.id, threading.get_ident())
 
     def _live_state(self):
         e = self._entry()
+        st = e.value
+        if st["owner"] is not None and st["expire_at"] is not None and _now() >= st["expire_at"]:
+            st["owner"] = None
+            st["count"] = 0
+            st["expire_at"] = None
+        return st
+
+    def _live_state_ro(self):
+        """Read-only state peek: does NOT materialize a keyspace entry for
+        an absent lock (in Redis an unheld lock key does not exist)."""
+        e = self._entry(create=False)
+        if e is None:
+            return None
         st = e.value
         if st["owner"] is not None and st["expire_at"] is not None and _now() >= st["expire_at"]:
             st["owner"] = None
@@ -111,22 +126,26 @@ class Lock(GridObject):
 
     def is_locked(self) -> bool:
         with self._store.lock:
-            return self._live_state()["owner"] is not None
+            st = self._live_state_ro()
+            return st is not None and st["owner"] is not None
 
     def is_held_by_current_thread(self) -> bool:
         with self._store.lock:
-            return self._live_state()["owner"] == self._me()
+            st = self._live_state_ro()
+            return st is not None and st["owner"] == self._me()
 
     def get_hold_count(self) -> int:
         with self._store.lock:
-            st = self._live_state()
+            st = self._live_state_ro()
+            if st is None:
+                return 0
             return st["count"] if st["owner"] == self._me() else 0
 
     def remain_lease_time(self) -> int:
         """ms until lease expiry; -1 held without lease, -2 not held."""
         with self._store.lock:
-            st = self._live_state()
-            if st["owner"] is None:
+            st = self._live_state_ro()
+            if st is None or st["owner"] is None:
                 return -2
             if st["expire_at"] is None:
                 return -1
@@ -161,7 +180,9 @@ class FencedLock(Lock):
 
     def get_token(self) -> Optional[int]:
         with self._store.lock:
-            st = self._live_state()
+            st = self._live_state_ro()
+            if st is None:
+                return None
             return st["token"] if st["owner"] == self._me() else None
 
 
@@ -218,7 +239,7 @@ class ReadWriteLock(GridObject):
         return _WriteLock(self)
 
     def _me(self):
-        return (id(self._client), threading.get_ident())
+        return (self._client.id, threading.get_ident())
 
 
 class _ReadLock:
@@ -345,7 +366,8 @@ class Semaphore(GridObject):
 
     def available_permits(self) -> int:
         with self._store.lock:
-            return self._entry().value["permits"]
+            e = self._entry(create=False)
+            return 0 if e is None else e.value["permits"]
 
     def try_acquire(self, permits: int = 1, wait_seconds: float = 0.0) -> bool:
         deadline = _now() + wait_seconds
@@ -416,7 +438,10 @@ class PermitExpirableSemaphore(GridObject):
 
     def available_permits(self) -> int:
         with self._store.lock:
-            st = self._entry().value
+            e = self._entry(create=False)
+            if e is None:
+                return 0
+            st = e.value
             self._reclaim(st)
             return st["permits"]
 
@@ -479,7 +504,8 @@ class CountDownLatch(GridObject):
 
     def get_count(self) -> int:
         with self._store.lock:
-            return self._entry().value["count"]
+            e = self._entry(create=False)
+            return 0 if e is None else e.value["count"]
 
     def count_down(self) -> None:
         with self._store.cond:
@@ -493,7 +519,7 @@ class CountDownLatch(GridObject):
         """→ RCountDownLatch#await (``await`` is reserved in Python)."""
         deadline = None if timeout_seconds is None else _now() + timeout_seconds
         with self._store.cond:
-            while self._entry().value["count"] > 0:
+            while self.get_count() > 0:
                 remaining = None if deadline is None else deadline - _now()
                 if remaining is not None and remaining <= 0:
                     return False
@@ -590,7 +616,7 @@ class RateLimiter(GridObject):
             )
 
     def _bucket(self, st):
-        key = "all" if st["mode"] == self.OVERALL else str(id(self._client))
+        key = "all" if st["mode"] == self.OVERALL else self._client.id
         b = st["buckets"].get(key)
         now = _now()
         if b is None or now >= b["window_end"]:
@@ -625,7 +651,7 @@ class RateLimiter(GridObject):
 
     def available_permits(self) -> int:
         with self._store.lock:
-            st = self._entry().value
-            if st["mode"] is None:
+            e = self._entry(create=False)
+            if e is None or e.value["mode"] is None:
                 return 0
-            return self._bucket(st)["tokens"]
+            return self._bucket(e.value)["tokens"]

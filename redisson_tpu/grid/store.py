@@ -40,11 +40,12 @@ class GridStore:
         self._data: dict[str, GridEntry] = {}
         self._sweeper: Optional[threading.Thread] = None
         self._closed = False
-        # Wired by the client to the sketch engine's ``exists``: the user
+        # Wired by the client to the sketch engine's ``probe``: the user
         # sees ONE keyspace, so creating a grid object under a name held by
         # the other backend is the WRONGTYPE error, not a shadow copy.
-        # (The foreign lookup takes only that backend's internal lock and
-        # no foreign path nests back into this store — no lock cycle.)
+        # The probe MUST be lock-free and side-effect-free on the foreign
+        # backend — each side calls it while holding its own lock, so a
+        # locking probe would be an AB-BA deadlock (found in r3 review).
         self.foreign_exists = None
 
     def _guard_foreign(self, name: str) -> None:
@@ -52,6 +53,12 @@ class GridStore:
             raise TypeError(
                 f"object {name!r} is held by the sketch backend (WRONGTYPE)"
             )
+
+    def probe(self, name: str) -> bool:
+        """Lock-free existence probe for the sketch backend's guard (dict
+        reads are atomic in CPython; expiry checked without reaping)."""
+        e = self._data.get(name)
+        return e is not None and not e.expired(time.time())
 
     # -- entry access ------------------------------------------------------
 

@@ -66,6 +66,37 @@ class RObject(CamelCompatMixin):
         self._engine.rename(self._name, new_name)
         self._name = new_name
 
+    # -- expiry (→ org/redisson/RedissonExpirable.java) --------------------
+
+    def expire(self, ttl_s: float) -> bool:
+        """Schedule deletion ``ttl_s`` seconds from now (EXPIRE)."""
+        return self._engine.expire(self._name, ttl_s)
+
+    def expire_at(self, timestamp: float) -> bool:
+        """Absolute-deadline expiry (EXPIREAT, unix seconds)."""
+        return self._engine.expire_at(self._name, timestamp)
+
+    def clear_expire(self) -> bool:
+        """Remove a pending TTL (PERSIST)."""
+        return self._engine.clear_expire(self._name)
+
+    def remain_time_to_live(self) -> int:
+        """Remaining TTL in ms; -1 no TTL, -2 absent (PTTL)."""
+        return self._engine.remain_ttl_ms(self._name)
+
+    # -- dump/restore (→ org/redisson/RedissonObject.java#dump) ------------
+
+    def dump(self) -> bytes:
+        """Opaque serialized state (DUMP); raises if absent."""
+        data = self._engine.dump(self._name)
+        if data is None:
+            raise RuntimeError(f"object {self._name!r} does not exist")
+        return data
+
+    def restore(self, data: bytes, replace: bool = False) -> None:
+        """Recreate this object from ``dump`` bytes (RESTORE)."""
+        self._engine.restore(self._name, data, replace=replace)
+
     # -- hashing helpers shared by sketch objects --------------------------
 
     def _encode(self, objs) -> tuple[np.ndarray, np.ndarray]:

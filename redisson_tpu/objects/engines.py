@@ -24,6 +24,7 @@ from typing import Optional
 import numpy as np
 
 from redisson_tpu.executor import LazyResult, TpuCommandExecutor
+from redisson_tpu.objects.durability import SketchDurabilityMixin
 from redisson_tpu.ops import golden
 from redisson_tpu.tenancy import PoolKind, TenantRegistry
 from redisson_tpu.tenancy.registry import class_words_for_bits
@@ -54,7 +55,7 @@ class _MappedFuture:
         return self._fut.done()
 
 
-class TpuSketchEngine:
+class TpuSketchEngine(SketchDurabilityMixin):
     def __init__(self, config):
         from redisson_tpu.executor.coalescer import BatchCoalescer
         from redisson_tpu.serve.metrics import Metrics
@@ -83,9 +84,25 @@ class TpuSketchEngine:
                 batch_window_us=config.tpu_sketch.batch_window_us,
                 max_batch=config.tpu_sketch.max_batch,
                 metrics=self.metrics,
+                max_inflight=config.tpu_sketch.max_inflight,
             )
+        # Checkpoint/resume (SURVEY.md §5): restore device state from the
+        # configured snapshot dir, then arm periodic snapshots.
+        if config.snapshot_dir:
+            self.restore_snapshot(config.snapshot_dir)
+            if config.snapshot_interval_s > 0:
+                self._start_snapshotter(
+                    config.snapshot_dir, config.snapshot_interval_s
+                )
 
     def shutdown(self) -> None:
+        self._stop_snapshotter()
+        self._stop_sweeper()
+        if self.config.snapshot_dir:
+            try:
+                self.snapshot(self.config.snapshot_dir)
+            except Exception:  # pragma: no cover — best-effort persistence
+                pass
         if self.coalescer is not None:
             self.coalescer.shutdown()
 
@@ -103,31 +120,48 @@ class TpuSketchEngine:
     # -- generic -----------------------------------------------------------
 
     def exists(self, name: str) -> bool:
-        return self.registry.lookup(name) is not None
+        return self._live_lookup(name) is not None
 
     def delete(self, name: str) -> bool:
-        entry = self.registry.lookup(name)
+        import time as _time
+
+        # detach-then-zero-then-free: only one concurrent deleter (user
+        # call, expiry sweeper, or lazy-expiry reader) wins the pop, and
+        # the row is reusable only after it is zeroed — a stale deleter
+        # can never zero a row already reallocated to a new object.
+        entry = self.registry.detach(name)
         if entry is None:
             return False
+        # An expired-but-unswept entry is already logically gone: free the
+        # row, but report False (Redis DEL on an expired key).  Checked
+        # inline — _live_lookup would recurse through _expire_if_due.
+        was_expired = (
+            entry.expire_at is not None and _time.time() >= entry.expire_at
+        )
         self._drain()
         self.executor.zero_row(entry.pool, entry.row)
-        self.registry.delete(name)
-        return True
+        entry.pool.free_row(entry.row)
+        return not was_expired
 
     def rename(self, old: str, new: str) -> bool:
-        if old == new or self.registry.lookup(old) is None:
+        if old == new or self._live_lookup(old) is None:
             return False
+        self._guard_foreign(new)
         self._drain()
-        dest = self.registry.lookup(new)
+        dest = self.registry.detach(new)
         if dest is not None:
             self.executor.zero_row(dest.pool, dest.row)
+            dest.pool.free_row(dest.row)
         return self.registry.rename(old, new)
 
     def names(self, kind=None):
+        for e in self.registry.entries():
+            if e.expire_at is not None:
+                self._expire_if_due(e)
         return self.registry.names(kind)
 
     def params(self, name: str) -> Optional[dict]:
-        entry = self.registry.lookup(name)
+        entry = self._live_lookup(name)
         return None if entry is None else entry.params
 
     def _require(self, name: str, kind: str):
@@ -137,17 +171,17 @@ class TpuSketchEngine:
         return entry
 
     def _lookup_kind(self, name: str, kind: str):
-        """None if absent; TypeError (WRONGTYPE analog) on kind mismatch."""
-        entry = self.registry.lookup(name)
+        """None if absent/expired; TypeError (WRONGTYPE analog) on kind
+        mismatch."""
+        entry = self._live_lookup(name)
         if entry is not None and entry.kind != kind:
             raise TypeError(f"object {name!r} holds a {entry.kind}, not a {kind}")
         return entry
 
     def _guard_foreign(self, name: str) -> None:
         """Cross-backend WRONGTYPE: creating a sketch under a name the data
-        grid holds is an error, not a shadow object.  Called before
-        creation while holding no engine lock (the foreign lookup takes
-        only the grid's lock — no nesting, no cycle)."""
+        grid holds is an error, not a shadow object.  ``foreign_exists``
+        is the grid's lock-free probe (see client.py wiring)."""
         if (
             self.foreign_exists is not None
             and self.registry.lookup(name) is None
@@ -156,6 +190,17 @@ class TpuSketchEngine:
             raise TypeError(
                 f"object {name!r} is held by the data grid (WRONGTYPE)"
             )
+
+    def probe(self, name: str) -> bool:
+        """Lock-free-ish existence probe for the grid's guard: takes only
+        the registry's leaf lock, never engine/store locks, and never
+        mutates (no expiry reap)."""
+        import time as _time
+
+        entry = self.registry.lookup(name)
+        return entry is not None and (
+            entry.expire_at is None or _time.time() < entry.expire_at
+        )
 
     # -- bloom -------------------------------------------------------------
 
@@ -168,6 +213,8 @@ class TpuSketchEngine:
             "expected_insertions": expected_insertions,
             "false_probability": false_probability,
         }
+        self._live_lookup(name)  # reap an expired holder before tryInit
+        self._guard_foreign(name)
         _, created = self.registry.try_create(
             name, PoolKind.BLOOM, (class_words_for_bits(m),), params
         )
@@ -298,6 +345,8 @@ class TpuSketchEngine:
     # -- hll ---------------------------------------------------------------
 
     def hll_ensure(self, name):
+        self._live_lookup(name)  # reap an expired holder first
+        self._guard_foreign(name)
         entry, _ = self.registry.try_create(name, PoolKind.HLL, (), {})
         return entry
 
@@ -369,6 +418,8 @@ class TpuSketchEngine:
         """Physical placement only — create/migrate so the row can hold
         ``min_bits``, WITHOUT extending the logical bit length (bitop
         operands must keep their true lengths)."""
+        self._live_lookup(name)  # reap an expired holder first
+        self._guard_foreign(name)
         entry, created = self.registry.try_create(
             name, PoolKind.BITSET, (class_words_for_bits(min_bits),), {"nbits": 0}
         )
@@ -544,6 +595,8 @@ class TpuSketchEngine:
 
     def cms_try_init(self, name, depth: int, width: int) -> bool:
         params = {"depth": depth, "width": width}
+        self._live_lookup(name)  # reap an expired holder before tryInit
+        self._guard_foreign(name)
         _, created = self.registry.try_create(
             name, PoolKind.CMS, (depth, width), params
         )
@@ -611,30 +664,69 @@ class TpuSketchEngine:
 
 class HostSketchEngine:
     """Golden-model backend — the 'Redis server on the host' analog and the
-    benchmark baseline.  Same hash material, same formulas."""
+    benchmark baseline.  Same hash material, same formulas; same
+    TTL/dump/restore surface as the TPU engine."""
 
     def __init__(self, config):
         self.config = config
         self._lock = threading.RLock()
         self._objects: dict[str, dict] = {}
+        # Wired by the client to the grid store's lock-free ``probe`` (one
+        # logical keyspace — same contract as TpuSketchEngine).  Called
+        # while holding self._lock, so it MUST NOT take the grid's lock.
+        self.foreign_exists = None
+
+    def _guard_foreign(self, name: str) -> None:
+        if (
+            self.foreign_exists is not None
+            and name not in self._objects
+            and self.foreign_exists(name)
+        ):
+            raise TypeError(
+                f"object {name!r} is held by the data grid (WRONGTYPE)"
+            )
+
+    def probe(self, name: str) -> bool:
+        """Lock-free existence probe for the grid's guard."""
+        import time as _time
+
+        o = self._objects.get(name)
+        if o is None:
+            return False
+        exp = o.get("expire_at")
+        return exp is None or _time.time() < exp
 
     def shutdown(self) -> None:
         pass
 
     # -- generic -----------------------------------------------------------
 
+    def _live(self, name):
+        """Lazy expiry (Redis-style): an overdue object vanishes on touch."""
+        import time as _time
+
+        o = self._objects.get(name)
+        if o is not None and o.get("expire_at") is not None:
+            if _time.time() >= o["expire_at"]:
+                del self._objects[name]
+                return None
+        return o
+
     def exists(self, name) -> bool:
         with self._lock:
-            return name in self._objects
+            return self._live(name) is not None
 
     def delete(self, name) -> bool:
         with self._lock:
-            return self._objects.pop(name, None) is not None
+            live = self._live(name) is not None
+            self._objects.pop(name, None)
+            return live
 
     def rename(self, old, new) -> bool:
         with self._lock:
-            if old == new or old not in self._objects:
+            if old == new or self._live(old) is None:
                 return False
+            self._guard_foreign(new)  # one keyspace: RENAME can't shadow grid
             self._objects[new] = self._objects.pop(old)
             return True
 
@@ -642,13 +734,14 @@ class HostSketchEngine:
         with self._lock:
             return [
                 n
-                for n, o in self._objects.items()
-                if kind is None or o["kind"] == kind
+                for n in list(self._objects)
+                if self._live(n) is not None
+                and (kind is None or self._objects[n]["kind"] == kind)
             ]
 
     def params(self, name):
         with self._lock:
-            o = self._objects.get(name)
+            o = self._live(name)
             return None if o is None else o["params"]
 
     def _require(self, name, kind):
@@ -659,10 +752,71 @@ class HostSketchEngine:
 
     def _lookup_kind(self, name, kind):
         with self._lock:
-            o = self._objects.get(name)
+            o = self._live(name)
             if o is not None and o["kind"] != kind:
                 raise TypeError(f"object {name!r} holds a {o['kind']}, not a {kind}")
             return o
+
+    # -- TTL / dump parity with the TPU engine -----------------------------
+
+    def expire(self, name, ttl_s: float) -> bool:
+        import time as _time
+
+        return self.expire_at(name, _time.time() + ttl_s)
+
+    def expire_at(self, name, ts: float) -> bool:
+        with self._lock:
+            o = self._live(name)
+            if o is None:
+                return False
+            o["expire_at"] = float(ts)
+            return True
+
+    def clear_expire(self, name) -> bool:
+        with self._lock:
+            o = self._live(name)
+            if o is None or o.get("expire_at") is None:
+                return False
+            o["expire_at"] = None
+            return True
+
+    def remain_ttl_ms(self, name) -> int:
+        import time as _time
+
+        with self._lock:
+            o = self._live(name)
+            if o is None:
+                return -2
+            if o.get("expire_at") is None:
+                return -1
+            return max(0, int((o["expire_at"] - _time.time()) * 1000))
+
+    def dump(self, name):
+        import pickle
+
+        with self._lock:
+            o = self._live(name)
+            if o is None:
+                return None
+            return pickle.dumps(
+                {"v": 1, "kind": o["kind"], "params": o["params"], "model": o["model"]}
+            )
+
+    def restore(self, name, data: bytes, replace: bool = False) -> None:
+        import pickle
+
+        d = pickle.loads(data)
+        with self._lock:
+            if self._live(name) is not None:
+                if not replace:
+                    raise ValueError(f"BUSYKEY: {name!r} already exists")
+                del self._objects[name]
+            self._guard_foreign(name)
+            self._objects[name] = {
+                "kind": d["kind"],
+                "model": d["model"],
+                "params": d["params"],
+            }
 
     # -- bloom -------------------------------------------------------------
 
@@ -672,6 +826,7 @@ class HostSketchEngine:
         with self._lock:
             if self._lookup_kind(name, PoolKind.BLOOM) is not None:
                 return False
+            self._guard_foreign(name)
             self._objects[name] = {
                 "kind": PoolKind.BLOOM,
                 "model": golden.GoldenBloomFilter(m, k),
@@ -715,6 +870,7 @@ class HostSketchEngine:
         with self._lock:
             o = self._lookup_kind(name, PoolKind.HLL)
             if o is None:
+                self._guard_foreign(name)
                 o = {
                     "kind": PoolKind.HLL,
                     "model": golden.GoldenHyperLogLog(),
@@ -767,6 +923,7 @@ class HostSketchEngine:
         with self._lock:
             o = self._lookup_kind(name, PoolKind.BITSET)
             if o is None:
+                self._guard_foreign(name)
                 o = {
                     "kind": PoolKind.BITSET,
                     "model": golden.GoldenBitSet(),
@@ -873,6 +1030,7 @@ class HostSketchEngine:
         with self._lock:
             if self._lookup_kind(name, PoolKind.CMS) is not None:
                 return False
+            self._guard_foreign(name)
             self._objects[name] = {
                 "kind": PoolKind.CMS,
                 "model": golden.GoldenCountMinSketch(depth, width),

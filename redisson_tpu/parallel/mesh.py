@@ -123,61 +123,6 @@ def sharded_bloom_contains(ctx: MeshContext, *, k: int, words_per_row: int, pack
     return jax.jit(fn)
 
 
-def sharded_bloom_mixed(ctx: MeshContext, *, k: int, words_per_row: int, pack_results: bool = False):
-    """Combined add+contains (ops/bloom.bloom_mixed) under the ownership-
-    mask pattern: non-owned ops route to the shard's scratch word and are
-    masked out of the psum."""
-    S = ctx.n_shards
-
-    def inner(state, rows, h1m, h2m, m_arr, is_add, valid):
-        local = state[0]
-        own, local_rows = _own_and_local(rows, valid, S)
-        new_local, res = bloom.bloom_mixed(
-            local, local_rows, h1m, h2m, is_add,
-            m=m_arr, k=k, words_per_row=words_per_row, valid=own,
-        )
-        res = lax.psum(jnp.where(own, res, False).astype(jnp.int32), "shard")
-        out = res > 0
-        if pack_results:
-            out = bitops.pack_bool_u32(out)
-        return new_local[None], out
-
-    fn = jax.shard_map(
-        inner,
-        mesh=ctx.mesh,
-        in_specs=(P("shard"), P(), P(), P(), P(), P(), P()),
-        out_specs=(P("shard"), P()),
-    )
-    return jax.jit(fn, donate_argnums=(0,))
-
-
-def sharded_bitset_mixed(ctx: MeshContext, *, words_per_row: int, pack_results: bool = False):
-    """Unified set/clear/flip/get batch (ops/bitset.bitset_mixed), masked."""
-    from redisson_tpu.ops import bitset as bitset_ops
-
-    S = ctx.n_shards
-
-    def inner(state, rows, idx, opcodes, valid):
-        local = state[0]
-        own, lrows = _own_and_local(rows, valid, S)
-        new_local, obs = bitset_ops.bitset_mixed(
-            local, lrows, idx, opcodes, words_per_row=words_per_row, valid=own
-        )
-        obs = lax.psum(jnp.where(own, obs, False).astype(jnp.int32), "shard")
-        out = obs > 0
-        if pack_results:
-            out = bitops.pack_bool_u32(out)
-        return new_local[None], out
-
-    fn = jax.shard_map(
-        inner,
-        mesh=ctx.mesh,
-        in_specs=(P("shard"), P(), P(), P(), P()),
-        out_specs=(P("shard"), P()),
-    )
-    return jax.jit(fn, donate_argnums=(0,))
-
-
 # --------------------------------------------------------------------------
 # Tenant-sharded HLL
 # --------------------------------------------------------------------------
@@ -279,6 +224,167 @@ def sharded_mbit_get(ctx: MeshContext, *, words_local: int):
 
 
 # --------------------------------------------------------------------------
+# Partition-by-owner kernels (round 3): the host splits each batch by owner
+# shard (row % S) into [S, Bp] op blocks — the slot-routing role of
+# CommandBatchService#executeAsync grouping commands per MasterSlaveEntry
+# (SURVEY.md §3.2).  in_specs=P("shard") hands every shard ONLY its ops, so
+# total device work is B (not S×B as under replicate-and-mask), writes stay
+# shard-local, and per-op results come back [S, Bp] with NO collective at
+# all.  Collectives remain only where data genuinely crosses shards
+# (BITOP/PFMERGE/m-sharded bitmaps below).
+# --------------------------------------------------------------------------
+
+
+def _psharded(ctx: MeshContext, inner, n_op_args: int, *, out_state: bool, donate: bool = True):
+    """shard_map wrapper for partitioned op batches: ``inner(local_state,
+    *op_cols)`` sees one shard's [Bp]-shaped columns and returns
+    (new_local, res[Bp-packed]) or just res."""
+
+    def wrapped(state, *ops):
+        local = state[0]
+        cols = [o[0] for o in ops]
+        return inner(local, *cols)
+
+    out_specs = (P("shard"), P("shard")) if out_state else P("shard")
+    fn = jax.shard_map(
+        wrapped,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"),) + (P("shard"),) * n_op_args,
+        out_specs=out_specs,
+    )
+    return jax.jit(fn, donate_argnums=(0,) if (out_state and donate) else ())
+
+
+def psharded_bloom_mixed(ctx: MeshContext, *, k: int, words_per_row: int):
+    """fn(state, lrows, h1m, h2m, m, is_add, valid) -> (new_state,
+    packed[S, Bp/32]); every column [S, Bp], rows already shard-local."""
+
+    def inner(local, lrows, h1m, h2m, m_arr, is_add, valid):
+        new_local, res = bloom.bloom_mixed(
+            local, lrows, h1m, h2m, is_add,
+            m=m_arr, k=k, words_per_row=words_per_row, valid=valid,
+        )
+        return new_local[None], bitops.pack_bool_u32(res)[None]
+
+    return _psharded(ctx, inner, 6, out_state=True)
+
+
+def psharded_bloom_mixed_keys(ctx: MeshContext, *, k: int, words_per_row: int, target_lanes: int):
+    """Device-hash variant: raw codec lanes [S, Bp, L] hash in-kernel (the
+    round-2 sharded mode shipped 16-byte host hashes — the fast path now
+    works sharded too)."""
+    from redisson_tpu.ops import fastpath
+
+    def inner(local, lrows, blocks, lengths, m_arr, is_add, valid):
+        new_local, res = fastpath.bloom_mixed_keys(
+            local, lrows, blocks, lengths, m_arr, is_add, valid,
+            k=k, words_per_row=words_per_row, target_lanes=target_lanes,
+        )
+        return new_local[None], bitops.pack_bool_u32(res)[None]
+
+    return _psharded(ctx, inner, 6, out_state=True)
+
+
+def psharded_bitset_mixed(ctx: MeshContext, *, words_per_row: int):
+    from redisson_tpu.ops import bitset as bitset_ops
+
+    def inner(local, lrows, idx, opcodes, valid):
+        new_local, obs = bitset_ops.bitset_mixed(
+            local, lrows, idx, opcodes, words_per_row=words_per_row, valid=valid
+        )
+        return new_local[None], bitops.pack_bool_u32(obs)[None]
+
+    return _psharded(ctx, inner, 4, out_state=True)
+
+
+def psharded_bitset_rw(ctx: MeshContext, kernel, *, words_per_row: int):
+    def inner(local, lrows, idx, valid):
+        new_local, prev = kernel(
+            local, lrows, idx, words_per_row=words_per_row, valid=valid
+        )
+        return new_local[None], bitops.pack_bool_u32(prev)[None]
+
+    return _psharded(ctx, inner, 3, out_state=True)
+
+
+def psharded_bitset_get(ctx: MeshContext, *, words_per_row: int):
+    from redisson_tpu.ops import bitset as bitset_ops
+
+    def inner(local, lrows, idx, valid):
+        res = bitset_ops.bitset_get(
+            local, jnp.where(valid, lrows, 0), idx, words_per_row=words_per_row
+        )
+        return bitops.pack_bool_u32(res & valid)[None]
+
+    return _psharded(ctx, inner, 3, out_state=False)
+
+
+def psharded_hll_add_changed(ctx: MeshContext):
+    def inner(local, lrows, c0, c1, c2, valid):
+        new_local, changed = hll_ops.hll_add_changed(
+            local, jnp.where(valid, lrows, 0), c0, c1, c2, valid=valid
+        )
+        return new_local[None], bitops.pack_bool_u32(changed)[None]
+
+    return _psharded(ctx, inner, 5, out_state=True)
+
+
+def psharded_hll_add_keys(ctx: MeshContext, *, target_lanes: int):
+    """Device-hash PFADD: murmur in-kernel, then scatter-max with changed
+    flags."""
+    from redisson_tpu.ops import fastpath
+    from redisson_tpu.utils import hashing
+
+    def inner(local, lrows, blocks, lengths, valid):
+        c0, c1, c2, _ = hashing.murmur3_x86_128(
+            fastpath.pad_lanes(blocks, target_lanes), lengths, xp=jnp
+        )
+        new_local, changed = hll_ops.hll_add_changed(
+            local, jnp.where(valid, lrows, 0), c0, c1, c2, valid=valid
+        )
+        return new_local[None], bitops.pack_bool_u32(changed)[None]
+
+    return _psharded(ctx, inner, 4, out_state=True)
+
+
+def psharded_cms_update_estimate(ctx: MeshContext, *, d: int, w: int, cells_per_row: int, estimate_only: bool = False, update_only: bool = False):
+    from redisson_tpu.ops import cms as cms_ops
+
+    def inner(local, lrows, h1w, h2w, weights, valid):
+        safe_rows = jnp.where(valid, lrows, 0)
+        if estimate_only:
+            new_local = local
+        else:
+            wts = jnp.where(valid, weights, 0)
+            new_local = cms_ops.cms_update(
+                local, safe_rows, h1w, h2w, wts, d=d, w=w, cells_per_row=cells_per_row
+            )
+        if update_only:
+            return new_local[None]
+        est = cms_ops.cms_estimate(
+            new_local, safe_rows, h1w, h2w, d=d, w=w, cells_per_row=cells_per_row
+        )
+        est = jnp.where(valid, est, 0)
+        if estimate_only:
+            return est[None]
+        return new_local[None], est[None]
+
+    if estimate_only:
+        return _psharded(ctx, inner, 5, out_state=False)
+    if update_only:
+        def wrapped(state, *ops):
+            return inner(state[0], *[o[0] for o in ops])
+        fn = jax.shard_map(
+            wrapped,
+            mesh=ctx.mesh,
+            in_specs=(P("shard"),) * 6,
+            out_specs=P("shard"),
+        )
+        return jax.jit(fn, donate_argnums=(0,))
+    return _psharded(ctx, inner, 5, out_state=True)
+
+
+# --------------------------------------------------------------------------
 # Cross-shard collectives: PFMERGE / BITOP between rows on different shards
 # --------------------------------------------------------------------------
 
@@ -374,57 +480,6 @@ def sharded_bitop(ctx: MeshContext, *, words_per_row: int, op: str, n_src: int, 
 # --------------------------------------------------------------------------
 
 
-def sharded_bitset_rw(ctx: MeshContext, kernel, *, words_per_row: int, pack_results: bool = False):
-    """SETBIT/clear/flip batch: ``kernel`` is one of ops.bitset.bitset_set/
-    bitset_clear/bitset_flip.  Returns fn(state, rows, idx, valid) ->
-    (new_state, prev bool[B]) with exact single-device semantics."""
-    S = ctx.n_shards
-
-    def inner(state, rows, idx, valid):
-        local = state[0]
-        own, lrows = _own_and_local(rows, valid, S)
-        new_local, prev = kernel(
-            local, lrows, idx, words_per_row=words_per_row, valid=own
-        )
-        prev = lax.psum(jnp.where(own, prev, False).astype(jnp.int32), "shard")
-        out = prev > 0
-        if pack_results:
-            out = bitops.pack_bool_u32(out)
-        return new_local[None], out
-
-    fn = jax.shard_map(
-        inner,
-        mesh=ctx.mesh,
-        in_specs=(P("shard"), P(), P(), P()),
-        out_specs=(P("shard"), P()),
-    )
-    return jax.jit(fn, donate_argnums=(0,))
-
-
-def sharded_bitset_get(ctx: MeshContext, *, words_per_row: int, pack_results: bool = False):
-    from redisson_tpu.ops import bitset as bitset_ops
-
-    S = ctx.n_shards
-
-    def inner(state, rows, idx, valid):
-        local = state[0]
-        own, lrows = _own_and_local(rows, valid, S)
-        res = bitset_ops.bitset_get(local, lrows, idx, words_per_row=words_per_row)
-        res = lax.psum(jnp.where(own, res, False).astype(jnp.int32), "shard")
-        out = res > 0
-        if pack_results:
-            out = bitops.pack_bool_u32(out)
-        return out
-
-    fn = jax.shard_map(
-        inner,
-        mesh=ctx.mesh,
-        in_specs=(P("shard"), P(), P(), P()),
-        out_specs=P(),
-    )
-    return jax.jit(fn)
-
-
 def sharded_bitset_set_range(ctx: MeshContext, *, words_per_row: int, value: bool):
     S = ctx.n_shards
 
@@ -508,75 +563,6 @@ def sharded_row_write(ctx: MeshContext, *, row_units: int):
         out_specs=P("shard"),
     )
     return jax.jit(fn, donate_argnums=(0,))
-
-
-def sharded_hll_add_changed(ctx: MeshContext, *, pack_results: bool = False):
-    """Multi-tenant PFADD with exact per-op changed flags (coalesced path).
-    Ops on different shards touch different rows, so per-shard sequential
-    semantics compose exactly."""
-    S = ctx.n_shards
-
-    def inner(state, rows, c0, c1, c2, valid):
-        local = state[0]
-        own, lrows = _own_and_local(rows, valid, S)
-        new_local, changed = hll_ops.hll_add_changed(
-            local, jnp.where(own, lrows, 0), c0, c1, c2, valid=own
-        )
-        changed = lax.psum(jnp.where(own, changed, False).astype(jnp.int32), "shard")
-        out = changed > 0
-        if pack_results:
-            out = bitops.pack_bool_u32(out)
-        return new_local[None], out
-
-    fn = jax.shard_map(
-        inner,
-        mesh=ctx.mesh,
-        in_specs=(P("shard"), P(), P(), P(), P(), P()),
-        out_specs=(P("shard"), P()),
-    )
-    return jax.jit(fn, donate_argnums=(0,))
-
-
-def sharded_cms_update_estimate(ctx: MeshContext, *, d: int, w: int, cells_per_row: int, estimate_only: bool = False, update_only: bool = False):
-    """CMS update/estimate/fused: non-owned ops scatter weight 0 (the add
-    identity) into shard-local cells, and estimates psum from the owner."""
-    from redisson_tpu.ops import cms as cms_ops
-
-    S = ctx.n_shards
-
-    def inner(state, rows, h1w, h2w, weights, valid):
-        local = state[0]
-        own, lrows = _own_and_local(rows, valid, S)
-        safe_rows = jnp.where(own, lrows, 0)
-        if estimate_only:
-            new_local = local
-        else:
-            wts = jnp.where(own, weights, 0)
-            new_local = cms_ops.cms_update(
-                local, safe_rows, h1w, h2w, wts, d=d, w=w, cells_per_row=cells_per_row
-            )
-        if update_only:
-            return new_local[None]
-        est = cms_ops.cms_estimate(
-            new_local, safe_rows, h1w, h2w, d=d, w=w, cells_per_row=cells_per_row
-        )
-        est = lax.psum(jnp.where(own, est, 0), "shard")
-        if estimate_only:
-            return est
-        return new_local[None], est
-
-    specs_in = (P("shard"), P(), P(), P(), P(), P())
-    if estimate_only:
-        out = P()
-        donate = ()
-    elif update_only:
-        out = P("shard")
-        donate = (0,)
-    else:
-        out = (P("shard"), P())
-        donate = (0,)
-    fn = jax.shard_map(inner, mesh=ctx.mesh, in_specs=specs_in, out_specs=out)
-    return jax.jit(fn, donate_argnums=donate)
 
 
 def sharded_cms_merge(ctx: MeshContext, *, cells_per_row: int):

@@ -132,13 +132,16 @@ class SizeClassPool:
 @dataclass
 class TenantEntry:
     """One named sketch object's placement + parameters (the `{name}:config`
-    analog)."""
+    analog).  ``expire_at``: absolute monotonic-free wall-clock deadline
+    (time.time()) after which the object no longer exists — the
+    RedissonExpirable analog; None = no TTL."""
 
     name: str
     kind: str
     pool: SizeClassPool
     row: int
     params: dict = field(default_factory=dict)
+    expire_at: Optional[float] = None
 
 
 class TenantRegistry:
@@ -195,6 +198,24 @@ class TenantRegistry:
             if entry is not None:
                 entry.pool.free_row(entry.row)
             return entry
+
+    def detach(self, name: str) -> Optional[TenantEntry]:
+        """Atomically remove the name WITHOUT freeing the row — the caller
+        zeroes the row on device and then frees it.  This ordering makes
+        concurrent delete/expiry safe: only one caller wins the pop, and
+        the row cannot be reallocated (and then wrongly zeroed) while a
+        stale deleter still holds it."""
+        with self._lock:
+            return self._tenants.pop(name, None)
+
+    def detach_if(self, name: str, entry: TenantEntry) -> Optional[TenantEntry]:
+        """detach() guarded on entry identity: a no-op if the name was
+        deleted and re-created since the caller captured ``entry`` (expiry
+        reapers must never remove a fresh successor object)."""
+        with self._lock:
+            if self._tenants.get(name) is not entry:
+                return None
+            return self._tenants.pop(name)
 
     def rename(self, old: str, new: str) -> bool:
         with self._lock:

@@ -1,0 +1,198 @@
+"""Sketch durability: TTL/expiry, dump/restore, snapshot round-trips.
+
+Mirrors upstream RedissonExpirable/RedissonObject#dump semantics
+(SURVEY.md §5 checkpoint row): a kill-and-restore must round-trip a loaded
+bloom filter bit-exactly, and an expired sketch must vanish from the
+keyspace.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.codecs import LongCodec
+
+
+def make_client(tmp_path=None, host=False, **kw):
+    cfg = Config().set_codec(LongCodec())
+    if not host:
+        cfg = cfg.use_tpu_sketch(min_bucket=64, **kw)
+    if tmp_path is not None:
+        cfg.snapshot_dir = str(tmp_path)
+    return redisson_tpu.create(cfg)
+
+
+@pytest.fixture(params=["tpu", "host"])
+def client(request):
+    c = make_client(host=(request.param == "host"))
+    yield c
+    c.shutdown()
+
+
+class TestTTL:
+    def test_expire_makes_sketch_vanish(self, client):
+        bf = client.get_bloom_filter("ttl-bf")
+        bf.try_init(1000, 0.01)
+        bf.add(123)
+        assert bf.is_exists()
+        assert bf.remain_time_to_live() == -1
+        assert bf.expire(0.15)
+        assert 0 < bf.remain_time_to_live() <= 150
+        time.sleep(0.2)
+        assert not bf.is_exists()
+        assert bf.remain_time_to_live() == -2
+        # Re-init lands on a fresh, empty filter.
+        assert bf.try_init(1000, 0.01)
+        assert not bf.contains(123)
+
+    def test_clear_expire(self, client):
+        h = client.get_hyper_log_log("ttl-hll")
+        h.add(1)
+        assert h.expire(0.15)
+        assert h.clear_expire()
+        assert h.remain_time_to_live() == -1
+        time.sleep(0.2)
+        assert h.is_exists()
+
+    def test_expire_absent_is_false(self, client):
+        bf = client.get_bloom_filter("ttl-none")
+        assert not bf.expire(1.0)
+        assert not bf.clear_expire()
+
+    def test_delete_expired_reports_false(self, client):
+        bs = client.get_bit_set("ttl-bs")
+        bs.set(5)
+        assert bs.expire(0.05)
+        time.sleep(0.1)
+        assert not bs.delete()
+
+    def test_sweeper_reclaims_without_touch(self):
+        c = make_client()
+        try:
+            bf = c.get_bloom_filter("ttl-sweep")
+            bf.try_init(1000, 0.01)
+            bf.expire(0.1)
+            engine = c._engine
+            deadline = time.time() + 3.0
+            while time.time() < deadline and engine.registry.lookup("ttl-sweep"):
+                time.sleep(0.05)
+            # The sweeper (not a user lookup) removed the registry entry.
+            assert engine.registry.lookup("ttl-sweep") is None
+        finally:
+            c.shutdown()
+
+
+class TestDumpRestore:
+    def test_bloom_dump_restore_bit_exact(self, client):
+        bf = client.get_bloom_filter("dump-bf")
+        bf.try_init(10_000, 0.01)
+        keys = np.arange(5000, dtype=np.uint64)
+        bf.add_all(keys)
+        blob = bf.dump()
+        bf2 = client.get_bloom_filter("dump-bf2")
+        bf2.restore(blob)
+        assert all(bf2.contains_each(keys))
+        probe = np.arange(100_000, 101_000, dtype=np.uint64)
+        assert list(bf.contains_each(probe)) == list(bf2.contains_each(probe))
+
+    def test_restore_busykey(self, client):
+        h = client.get_hyper_log_log("dump-hll")
+        h.add_all([1, 2, 3])
+        blob = h.dump()
+        with pytest.raises(ValueError, match="BUSYKEY"):
+            h.restore(blob)
+        h.restore(blob, replace=True)
+        assert h.is_exists()
+
+    def test_dump_absent_raises(self, client):
+        bf = client.get_bloom_filter("dump-none")
+        with pytest.raises(RuntimeError):
+            bf.dump()
+
+
+class TestSnapshot:
+    def test_kill_and_restore_round_trips(self, tmp_path):
+        c1 = make_client(tmp_path)
+        bf = c1.get_bloom_filter("snap-bf")
+        bf.try_init(10_000, 0.001)
+        keys = np.arange(7000, dtype=np.uint64)
+        bf.add_all(keys)
+        h = c1.get_hyper_log_log("snap-hll")
+        h.add_all(np.arange(3000, dtype=np.uint64))
+        hll_count = h.count()
+        bs = c1.get_bit_set("snap-bs")
+        bs.set_many(np.arange(0, 2048, 7, dtype=np.uint32))
+        probe = np.arange(50_000, 52_000, dtype=np.uint64)
+        fp_pattern = list(bf.contains_each(probe))
+        c1.shutdown()  # writes the final snapshot
+
+        c2 = make_client(tmp_path)  # restores on create
+        try:
+            bf2 = c2.get_bloom_filter("snap-bf")
+            assert bf2.is_exists()
+            assert bf2.count() > 6000
+            assert all(bf2.contains_each(keys))
+            # Bit-exact: identical false-positive pattern, not just hits.
+            assert list(bf2.contains_each(probe)) == fp_pattern
+            assert c2.get_hyper_log_log("snap-hll").count() == hll_count
+            bs2 = c2.get_bit_set("snap-bs")
+            assert bs2.cardinality() == len(range(0, 2048, 7))
+            # Params survived: re-init reports already-initialized.
+            assert not bf2.try_init(10_000, 0.001)
+        finally:
+            c2.shutdown()
+
+    def test_snapshot_preserves_ttl(self, tmp_path):
+        c1 = make_client(tmp_path)
+        bf = c1.get_bloom_filter("snap-ttl")
+        bf.try_init(1000, 0.01)
+        bf.expire(30.0)
+        c1.shutdown()
+        c2 = make_client(tmp_path)
+        try:
+            bf2 = c2.get_bloom_filter("snap-ttl")
+            ttl = bf2.remain_time_to_live()
+            assert 0 < ttl <= 30_000
+        finally:
+            c2.shutdown()
+
+    def test_periodic_snapshotter(self, tmp_path):
+        c = make_client(tmp_path)
+        c.config.snapshot_interval_s = 0.2
+        c._engine._start_snapshotter(str(tmp_path), 0.2)
+        bf = c.get_bloom_filter("snap-periodic")
+        bf.try_init(1000, 0.01)
+        bf.add_all(np.arange(100, dtype=np.uint64))
+        deadline = time.time() + 3.0
+        import os
+
+        while time.time() < deadline and not os.path.exists(
+            tmp_path / "sketch_meta.json"
+        ):
+            time.sleep(0.05)
+        assert (tmp_path / "sketch_meta.json").exists()
+        c.shutdown()
+
+    def test_new_objects_after_restore_get_fresh_rows(self, tmp_path):
+        """Restored free-lists must not hand out rows already owned by
+        restored tenants."""
+        c1 = make_client(tmp_path)
+        for i in range(5):
+            bf = c1.get_bloom_filter(f"fr-{i}")
+            bf.try_init(1000, 0.01)
+            bf.add(i)
+        c1.shutdown()
+        c2 = make_client(tmp_path)
+        try:
+            nbf = c2.get_bloom_filter("fr-new")
+            nbf.try_init(1000, 0.01)
+            nbf.add_all(np.arange(100, dtype=np.uint64))
+            for i in range(5):
+                old = c2.get_bloom_filter(f"fr-{i}")
+                assert old.contains(i)
+                assert old.count() <= 3  # new tenant's keys didn't leak in
+        finally:
+            c2.shutdown()

@@ -181,3 +181,129 @@ def test_fast_add_drains_pending_coalesced_reads():
     assert not np.any(fut.result())
     assert bf.contains("late-key") is True
     cl.shutdown()
+
+
+class TestRound3AdviceFixes:
+    """ADVICE r2: one logical keyspace, read-only lock paths, SET XX TTL,
+    lock owner identity."""
+
+    def _client(self):
+        import redisson_tpu
+        from redisson_tpu import Config
+
+        return redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
+
+    def test_cross_backend_wrongtype_grid_then_sketch(self):
+        import pytest
+
+        c = self._client()
+        try:
+            c.get_bucket("shared-name").set("v")
+            with pytest.raises(TypeError, match="WRONGTYPE|held by"):
+                c.get_bloom_filter("shared-name").try_init(1000, 0.01)
+        finally:
+            c.shutdown()
+
+    def test_cross_backend_wrongtype_sketch_then_grid(self):
+        import pytest
+
+        c = self._client()
+        try:
+            bf = c.get_bloom_filter("shared-name2")
+            bf.try_init(1000, 0.01)
+            with pytest.raises(TypeError, match="WRONGTYPE|held by"):
+                c.get_bucket("shared-name2").set("v")
+        finally:
+            c.shutdown()
+
+    def test_readonly_lock_queries_do_not_materialize(self):
+        c = self._client()
+        try:
+            assert not c.get_lock("ro-lock").is_locked()
+            assert c.get_lock("ro-lock").get_hold_count() == 0
+            assert c.get_semaphore("ro-sem").available_permits() == 0
+            assert c.get_count_down_latch("ro-latch").get_count() == 0
+            assert c.get_rate_limiter("ro-rl").available_permits() == 0
+            names = c.get_keys().get_keys()
+            for n in ("ro-lock", "ro-sem", "ro-latch", "ro-rl"):
+                assert n not in names, n
+        finally:
+            c.shutdown()
+
+    def test_set_if_exists_clears_ttl(self):
+        import time
+
+        c = self._client()
+        try:
+            b = c.get_bucket("xx-ttl")
+            b.set("v1", ttl_seconds=30.0)
+            assert b.remain_time_to_live() > 0
+            assert b.set_if_exists("v2")
+            # SET XX without KEEPTTL clears the TTL, like set().
+            assert b.remain_time_to_live() == -1
+            assert b.get() == "v2"
+        finally:
+            c.shutdown()
+
+    def test_lock_owner_uses_client_uuid(self):
+        c1 = self._client()
+        c2 = self._client()
+        try:
+            assert c1.id != c2.id
+            lk = c1.get_lock("uuid-lock")
+            lk.lock()
+            assert lk._me()[0] == c1.id
+            lk.unlock()
+        finally:
+            c1.shutdown()
+            c2.shutdown()
+
+    def test_cross_backend_guard_no_deadlock(self):
+        """r3 review: foreign-exists probes must be lock-free — a locking
+        probe deadlocks AB-BA when both backends create concurrently."""
+        import threading
+
+        import redisson_tpu
+        from redisson_tpu import Config
+
+        c = redisson_tpu.create(Config())  # host engine (default config)
+        try:
+            stop = threading.Event()
+
+            def sketch_side():
+                i = 0
+                while not stop.is_set() and i < 300:
+                    c.get_bloom_filter(f"dl-bf-{i}").try_init(100, 0.01)
+                    i += 1
+
+            def grid_side():
+                i = 0
+                while not stop.is_set() and i < 300:
+                    c.get_bucket(f"dl-bk-{i}").set(i)
+                    i += 1
+
+            t1 = threading.Thread(target=sketch_side, daemon=True)
+            t2 = threading.Thread(target=grid_side, daemon=True)
+            t1.start(); t2.start()
+            t1.join(timeout=10); t2.join(timeout=10)
+            alive = t1.is_alive() or t2.is_alive()
+            stop.set()
+            assert not alive, "cross-backend creation deadlocked"
+        finally:
+            c.shutdown()
+
+    def test_restore_cannot_shadow_grid(self):
+        import pytest
+
+        c = self._client()
+        try:
+            bf = c.get_bloom_filter("shadow-src")
+            bf.try_init(100, 0.01)
+            blob = bf.dump()
+            c.get_bucket("shadow-dst").set("v")
+            with pytest.raises(TypeError, match="WRONGTYPE|held by"):
+                c._engine.restore("shadow-dst", blob)
+            with pytest.raises(TypeError, match="WRONGTYPE|held by"):
+                c._engine.rename("shadow-src", "shadow-dst")
+        finally:
+            c.shutdown()
