@@ -191,6 +191,103 @@ def bench_config4_mixed(make_client):
     return n_ops / dt, snap
 
 
+def bench_config3_bitset(client):
+    """Config 3: 2^30-bit RBitSet, batched get/set (raw bitmap path).
+
+    On the single bench chip the 128MB row is device-resident; the
+    m-sharded multi-chip layout for the same object is exercised by the
+    CPU-mesh suite (tests/test_mbit_sharded.py) and dryrun_multichip."""
+    NBITS = 1 << 30
+    bs = client.get_bit_set("bench-bs")
+    bs.set(NBITS - 1)  # materialize the full row
+    rng = np.random.default_rng(2)
+    B = 1 << 16
+    bs.set_many(rng.integers(0, NBITS, B).astype(np.uint32))  # warm compile
+    bs.get_many(rng.integers(0, NBITS, B).astype(np.uint32))
+    iters = 12
+    t0 = time.perf_counter()
+    futs = []
+    for i in range(iters):
+        idx = rng.integers(0, NBITS, B).astype(np.uint32)
+        if i % 2 == 0:
+            futs.append(bs.set_many_async(idx))
+        else:
+            futs.append(bs.get_many_async(idx))
+    for f in futs:
+        f.result()
+    dt = time.perf_counter() - t0
+    return iters * B / dt
+
+
+def bench_config5_stream_topk(client):
+    """Config 5: streaming top-K over a topic→CMS pipe.
+
+    Geometry scaled from the 100M-event spec to 16M events for bench
+    wall-clock (same zipf shape, same pipe).  Events ride the real topic
+    (publish → delivery pool → listener → coalescer → device) batched at
+    the producer into 32k-event array messages — the Kafka-style shape;
+    per-event Python dispatch caps near 200k events/s and is reported by
+    the in-process listener path tests instead."""
+    from redisson_tpu.serve import TopicCmsBridge
+
+    cms = client.get_count_min_sketch("bench-cms")
+    cms.try_init(5, 1 << 16, track_top_k=20)
+    bridge = TopicCmsBridge(
+        client, "bench-events", "bench-cms", batch_size=1 << 15,
+        flush_interval_s=0.05,
+    )
+    topic = client.get_topic("bench-events")
+    rng = np.random.default_rng(3)
+    n_events = 16_000_000
+    n_keys = 100_000
+    chunk = 1 << 15
+    stream = (rng.zipf(1.2, size=n_events) % n_keys).astype(np.uint64)
+    topic.publish(stream[:chunk])  # warm the kernel shapes
+    client._topic_bus.drain()
+    bridge.flush()
+    t0 = time.perf_counter()
+    for i in range(chunk, n_events, chunk):
+        topic.publish(stream[i : i + chunk])
+    client._topic_bus.drain()
+    bridge.close()
+    dt = time.perf_counter() - t0
+    true_counts = np.bincount(stream.astype(np.int64))
+    true_top = set(np.argsort(-true_counts)[:10].tolist())
+    got = {int(k) for k, _ in cms.top_k(10)}
+    recall = len(got & true_top) / 10.0
+    return (n_events - chunk) / dt, recall
+
+
+def measure_host_baseline():
+    """Honest comparison baseline (SURVEY.md §6): the configured bench env
+    has NO redis-server binary, so the Redis-backed number cannot be
+    measured here — ``vs_baseline`` is null.  What CAN be measured is the
+    host golden engine (the NumPy stand-in for the Redis server's sketch
+    math) driven through the identical client path; its contains()
+    throughput is reported separately as ``host_engine_ops_per_sec``."""
+    import shutil
+
+    if shutil.which("redis-server"):
+        return None  # future: drive real Redis through the client codec path
+    import redisson_tpu
+    from redisson_tpu import Config
+    from redisson_tpu.codecs import LongCodec
+
+    client = redisson_tpu.create(Config().set_codec(LongCodec()))
+    bf = client.get_bloom_filter("host-bf")
+    bf.try_init(1_000_000, 0.01)
+    B = 1 << 16
+    rng = np.random.default_rng(0)
+    bf.add_all(np.arange(1 << 18, dtype=np.uint64))
+    t0 = time.perf_counter()
+    iters = 8
+    for _ in range(iters):
+        bf.contains_each(rng.integers(0, 1 << 19, B).astype(np.uint64))
+    dt = time.perf_counter() - t0
+    client.shutdown()
+    return iters * B / dt
+
+
 def main():
     import jax
 
@@ -212,23 +309,43 @@ def main():
     client = make_client(exact_add_semantics=False, coalesce=False)
     contains_ops, fpp = bench_bloom_contains(client)
     hll_ops = bench_hll_pfadd(client)
+    bitset_ops = bench_config3_bitset(client)
+    stream_eps, topk_recall = bench_config5_stream_topk(client)
     mixed_ops, metrics = bench_config4_mixed(make_client)
+    host_ops = measure_host_baseline()
 
-    baseline = 1_000_000.0  # see module docstring
+    # vs_baseline: the bench env ships no redis-server, so the Redis-backed
+    # comparison cannot be MEASURED here — null, not assumed (BASELINE.md
+    # comparison row).  vs_host_engine is a real measurement: the NumPy
+    # golden engine (the Redis-server stand-in) through the same client.
     print(
         json.dumps(
             {
                 "metric": "bloom_contains_ops_per_sec_per_chip",
                 "value": round(contains_ops),
                 "unit": "ops/s",
-                "vs_baseline": round(contains_ops / baseline, 2),
+                "vs_baseline": None,
                 "extra": {
                     "hll_pfadd_ops_per_sec": round(hll_ops),
+                    "config3_bitset_ops_per_sec": round(bitset_ops),
                     "config4_mixed_ops_per_sec": round(mixed_ops),
+                    "config5_stream_events_per_sec": round(stream_eps),
+                    "config5_topk_recall_at_10": topk_recall,
                     "p50_batch_ms": metrics.get("p50_wait_ms"),
                     "p99_batch_ms": metrics.get("p99_wait_ms"),
                     "p99_flush_ms": metrics.get("p99_flush_ms"),
                     "measured_fpp": round(fpp, 5),
+                    "host_engine_ops_per_sec": (
+                        None if host_ops is None else round(host_ops)
+                    ),
+                    "vs_host_engine": (
+                        None
+                        if host_ops is None
+                        else round(contains_ops / host_ops, 2)
+                    ),
+                    "vs_baseline_note": "no redis-server in bench env; "
+                    "vs_host_engine measures the NumPy golden engine "
+                    "(Redis-server stand-in) through the same client path",
                 },
             }
         )

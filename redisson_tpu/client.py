@@ -133,6 +133,33 @@ class RedissonTpuClient(CamelCompatMixin):
     def get_map_cache(self, name: str):
         return MapCache(name, self)
 
+    def get_local_cached_map(self, name: str, **options):
+        """→ RedissonClient#getLocalCachedMap (near cache + invalidation
+        topic)."""
+        from redisson_tpu.grid import LocalCachedMap
+
+        return LocalCachedMap(name, self, **options)
+
+    def get_list_multimap(self, name: str):
+        from redisson_tpu.grid import ListMultimap
+
+        return ListMultimap(name, self)
+
+    def get_set_multimap(self, name: str):
+        from redisson_tpu.grid import SetMultimap
+
+        return SetMultimap(name, self)
+
+    def get_list_multimap_cache(self, name: str):
+        from redisson_tpu.grid import ListMultimapCache
+
+        return ListMultimapCache(name, self)
+
+    def get_set_multimap_cache(self, name: str):
+        from redisson_tpu.grid import SetMultimapCache
+
+        return SetMultimapCache(name, self)
+
     # -- sets / lists ------------------------------------------------------
 
     def get_set(self, name: str):
@@ -186,6 +213,18 @@ class RedissonTpuClient(CamelCompatMixin):
 
     def get_pattern_topic(self, pattern: str):
         return PatternTopic(pattern, self)
+
+    def get_stream(self, name: str):
+        """→ RedissonClient#getStream (XADD/XREADGROUP family)."""
+        from redisson_tpu.grid import Stream
+
+        return Stream(name, self)
+
+    def get_reliable_topic(self, name: str):
+        """→ RedissonClient#getReliableTopic (stream-backed, at-least-once)."""
+        from redisson_tpu.grid import ReliableTopic
+
+        return ReliableTopic(name, self)
 
     # -- locks & synchronizers ---------------------------------------------
 

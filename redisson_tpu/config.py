@@ -48,6 +48,11 @@ class TpuSketchConfig:
         # (virtual CPU meshes via xla_force_host_platform_device_count
         # work for tests).
         self.num_shards = 1
+        # Bitset rows at or above this many uint32 words shard along the
+        # m-axis (contiguous word blocks per shard) instead of living on
+        # one shard — config 3's 2^30-bit filter path (SURVEY.md §7-L4).
+        # Only meaningful with num_shards > 1.
+        self.mbit_threshold_words = 1 << 22
         self.platform: Optional[str] = None  # None → jax default backend
         # HLL geometry is fixed to Redis parity (p=14) — not configurable,
         # matching Redis server behavior.

@@ -51,15 +51,24 @@ from redisson_tpu.parallel import mesh as pm
 class _Partition:
     """Host-side owner-shard split of one op batch: builds the [S, Bp]
     scatter layout and the inverse mapping that restores per-op results to
-    arrival order."""
+    arrival order.  ``shard`` may be any per-op owner assignment — row % S
+    for tenant-sharded pools (see ``from_rows``), word-block for m-sharded
+    bitmaps."""
 
     __slots__ = ("S", "B", "Bp", "order", "sh_sorted", "slot", "lrows", "valid")
 
-    def __init__(self, S: int, rows, bucket_fn):
+    @classmethod
+    def from_rows(cls, S: int, rows, bucket_fn) -> "_Partition":
         rows = np.asarray(rows, np.int64)
+        p = cls(S, rows % S, bucket_fn)
+        p.lrows = (rows // S).astype(np.int32)
+        return p
+
+    def __init__(self, S: int, shard, bucket_fn):
+        shard = np.asarray(shard, np.int64)
         self.S = S
-        self.B = int(rows.shape[0])
-        shard = rows % S
+        self.B = int(shard.shape[0])
+        self.lrows = None
         self.order = np.argsort(shard, kind="stable")
         counts = np.bincount(shard, minlength=S)
         self.Bp = bucket_fn(int(counts.max()) if self.B else 1)
@@ -67,7 +76,6 @@ class _Partition:
         offsets = np.zeros(S, np.int64)
         np.cumsum(counts[:-1], out=offsets[1:])
         self.slot = np.arange(self.B, dtype=np.int64) - offsets[self.sh_sorted]
-        self.lrows = (rows // S).astype(np.int32)
         valid = np.zeros((S, self.Bp), bool)
         valid[self.sh_sorted, self.slot] = True
         self.valid = valid
@@ -116,15 +124,38 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
 
     # -- pool-state factory ------------------------------------------------
 
-    def round_capacity(self, capacity: int) -> int:
+    def _mbit_layout(self, row_units: int, kind: str) -> bool:
+        from redisson_tpu.tenancy import PoolKind
+
+        return (
+            kind == PoolKind.BITSET
+            and row_units >= self._cfg.mbit_threshold_words
+            and row_units % self.S == 0
+        )
+
+    def round_capacity(self, capacity: int, row_units: int = 0, kind: str = "") -> int:
+        if self._mbit_layout(row_units, kind):
+            # m-sharded rows span every shard; capacity needs no S-multiple.
+            # Clamp the initial footprint like the base class (giant rows).
+            if capacity * row_units > (1 << 27):
+                return max(1, (1 << 27) // row_units)
+            return capacity
         return -(-capacity // self.S) * self.S
 
-    def make_pool_state(self, capacity: int, row_units: int, dtype):
-        local_len = capacity // self.S * row_units + 1
+    def make_pool_state(self, capacity: int, row_units: int, dtype, kind: str = ""):
+        if self._mbit_layout(row_units, kind):
+            # [S, T * W_local + 1]: each shard holds its word window of
+            # EVERY row (plus its own scratch element).
+            local_len = capacity * (row_units // self.S) + 1
+        else:
+            local_len = capacity // self.S * row_units + 1
         return self.ctx.make_state(local_len, dtype)
 
-    def grow_pool_state(self, state, old_cap: int, new_cap: int, row_units: int, dtype):
-        extra_local = (new_cap - old_cap) // self.S * row_units + 1
+    def grow_pool_state(self, state, old_cap: int, new_cap: int, row_units: int, dtype, kind: str = ""):
+        if self._mbit_layout(row_units, kind):
+            extra_local = (new_cap - old_cap) * (row_units // self.S) + 1
+        else:
+            extra_local = (new_cap - old_cap) // self.S * row_units + 1
         new_state = jnp.concatenate(
             [state[:, :-1], jnp.zeros((self.S, extra_local), dtype)], axis=1
         )
@@ -147,7 +178,33 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         return fn
 
     def _part(self, rows) -> _Partition:
-        return _Partition(self.S, rows, self._bucket)
+        return _Partition.from_rows(self.S, rows, self._bucket)
+
+    # -- m-sharded bitset pools (config 3): rows at/above the word
+    # threshold split their words contiguously across shards ---------------
+
+    def _is_mbit(self, pool) -> bool:
+        from redisson_tpu.tenancy import PoolKind
+
+        return (
+            pool.spec.kind == PoolKind.BITSET
+            and pool.row_units >= self._cfg.mbit_threshold_words
+            and pool.row_units % self.S == 0
+        )
+
+    def _mbit_wl(self, pool) -> int:
+        return pool.row_units // self.S
+
+    def _mpart(self, pool, idx):
+        """Partition single-bit ops by word-shard; returns (partition,
+        local_idx) where local_idx is the bit index within the shard's
+        word window of the row."""
+        WL = self._mbit_wl(pool)
+        idx = np.asarray(idx, np.int64)
+        shard = (idx >> 5) // WL
+        p = _Partition(self.S, shard, self._bucket)
+        lidx = (idx - shard * (WL * 32)).astype(np.uint32)
+        return p, lidx
 
     # -- bloom (all single-bit traffic routes through the partitioned
     # mixed kernel: adds are is_add=True ops, contains is_add=False) -------
@@ -341,6 +398,23 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
     # -- bitset ------------------------------------------------------------
 
     def bitset_mixed(self, pool, rows, idx, opcodes) -> LazyResult:
+        if self._is_mbit(pool):
+            WL = self._mbit_wl(pool)
+            p, lidx = self._mpart(pool, idx)
+            fn = self._builder(
+                ("msh_bs_mixed", WL),
+                lambda: pm.psharded_bitset_mixed(self.ctx, words_per_row=WL),
+            )
+            pool.state, packed = fn(
+                pool.state,
+                jnp.asarray(p.scatter(np.asarray(rows, np.int32))),
+                jnp.asarray(p.scatter(lidx)),
+                jnp.asarray(
+                    p.scatter(np.asarray(opcodes, np.uint32), fill=bitset_ops.OP_GET)
+                ),
+                jnp.asarray(p.valid),
+            )
+            return LazyResult(packed, transform=p.unpack_bools)
         wpr = pool.row_units
         fn = self._builder(
             ("psh_bs_mixed", wpr),
@@ -359,6 +433,20 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         return LazyResult(packed, transform=p.unpack_bools)
 
     def _bitset_rw(self, opname, kernel, pool, rows, idx):
+        if self._is_mbit(pool):
+            WL = self._mbit_wl(pool)
+            p, lidx = self._mpart(pool, idx)
+            fn = self._builder(
+                ("msh_" + opname, WL),
+                lambda: pm.psharded_bitset_rw(self.ctx, kernel, words_per_row=WL),
+            )
+            pool.state, packed = fn(
+                pool.state,
+                jnp.asarray(p.scatter(np.asarray(rows, np.int32))),
+                jnp.asarray(p.scatter(lidx)),
+                jnp.asarray(p.valid),
+            )
+            return LazyResult(packed, transform=p.unpack_bools)
         wpr = pool.row_units
         fn = self._builder(
             ("psh_" + opname, wpr),
@@ -383,6 +471,20 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         return self._bitset_rw("bs_flip", bitset_ops.bitset_flip, pool, rows, idx)
 
     def bitset_get(self, pool, rows, idx) -> LazyResult:
+        if self._is_mbit(pool):
+            WL = self._mbit_wl(pool)
+            p, lidx = self._mpart(pool, idx)
+            fn = self._builder(
+                ("msh_bs_get", WL),
+                lambda: pm.psharded_bitset_get(self.ctx, words_per_row=WL),
+            )
+            packed = fn(
+                pool.state,
+                jnp.asarray(p.scatter(np.asarray(rows, np.int32))),
+                jnp.asarray(p.scatter(lidx)),
+                jnp.asarray(p.valid),
+            )
+            return LazyResult(packed, transform=p.unpack_bools)
         wpr = pool.row_units
         fn = self._builder(
             ("psh_bs_get", wpr),
@@ -398,6 +500,20 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         return LazyResult(packed, transform=p.unpack_bools)
 
     def bitset_set_range(self, pool, row: int, from_bit: int, to_bit: int, value: bool) -> LazyResult:
+        if self._is_mbit(pool):
+            WL = self._mbit_wl(pool)
+            win = WL * 32
+            offs = np.arange(self.S, dtype=np.int64) * win
+            fb = np.clip(int(from_bit) - offs, 0, win).astype(np.int32)
+            tb = np.clip(int(to_bit) - offs, 0, win).astype(np.int32)
+            fn = self._builder(
+                ("msh_bs_setrange", WL, bool(value)),
+                lambda: pm.msharded_set_range(
+                    self.ctx, words_local=WL, value=value
+                ),
+            )
+            pool.state = fn(pool.state, row, jnp.asarray(fb), jnp.asarray(tb))
+            return LazyResult(None)
         wpr = pool.row_units
         fn = self._builder(
             ("sh_bs_setrange", wpr, bool(value)),
@@ -409,6 +525,17 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         return LazyResult(None)
 
     def bitset_cardinality(self, pool, row) -> LazyResult:
+        if self._is_mbit(pool):
+            WL = self._mbit_wl(pool)
+            fn = self._builder(
+                ("msh_bs_card", WL),
+                lambda: pm.msharded_row_map(
+                    self.ctx, lambda local, r: bitops.popcount_row(local, r, WL)
+                ),
+            )
+            return LazyResult(
+                fn(pool.state, row), transform=lambda v: int(np.sum(v))
+            )
         wpr = pool.row_units
         fn = self._builder(
             ("sh_bs_card", wpr),
@@ -419,6 +546,22 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         return LazyResult(fn(pool.state, row), transform=int)
 
     def bitset_length(self, pool, row) -> LazyResult:
+        if self._is_mbit(pool):
+            WL = self._mbit_wl(pool)
+            win = WL * 32
+
+            def combine(parts):
+                parts = np.asarray(parts)
+                glob = [s * win + int(p) for s, p in enumerate(parts) if p > 0]
+                return max(glob) if glob else 0
+
+            fn = self._builder(
+                ("msh_bs_len", WL),
+                lambda: pm.msharded_row_map(
+                    self.ctx, lambda local, r: bitops.bit_length_row(local, r, WL)
+                ),
+            )
+            return LazyResult(fn(pool.state, row), transform=combine)
         wpr = pool.row_units
         fn = self._builder(
             ("sh_bs_len", wpr),
@@ -429,6 +572,29 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         return LazyResult(fn(pool.state, row), transform=int)
 
     def bitset_bitpos(self, pool, row, target_bit: int) -> LazyResult:
+        if self._is_mbit(pool):
+            WL = self._mbit_wl(pool)
+            win = WL * 32
+
+            def combine(parts):
+                parts = np.asarray(parts)
+                if target_bit:
+                    hits = [s * win + int(p) for s, p in enumerate(parts) if p >= 0]
+                    return min(hits) if hits else -1
+                # target 0: a shard reporting win means its window is full.
+                for s, p in enumerate(parts):
+                    if p < win:
+                        return s * win + int(p)
+                return self.S * win
+
+            fn = self._builder(
+                ("msh_bs_pos", WL, target_bit),
+                lambda: pm.msharded_row_map(
+                    self.ctx,
+                    lambda local, r: bitops.bitpos_row(local, r, WL, target_bit),
+                ),
+            )
+            return LazyResult(fn(pool.state, row), transform=combine)
         wpr = pool.row_units
         fn = self._builder(
             ("sh_bs_pos", wpr, target_bit),
@@ -442,9 +608,29 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         return LazyResult(fn(pool.state, row), transform=int)
 
     def bitset_bitop(self, pool, dst_row: int, src_rows, op: str, limit_bits=None) -> LazyResult:
-        wpr = pool.row_units
         S_src = len(src_rows)
         masked = limit_bits is not None
+        if self._is_mbit(pool):
+            WL = self._mbit_wl(pool)
+            win = WL * 32
+            offs = np.arange(self.S, dtype=np.int64) * win
+            limit_local = np.clip(
+                (int(limit_bits) if masked else 0) - offs, 0, win
+            ).astype(np.int64)
+            fn = self._builder(
+                ("msh_bs_bitop", WL, S_src, op, masked),
+                lambda: pm.msharded_bitop(
+                    self.ctx, words_local=WL, op=op, n_src=S_src, masked=masked
+                ),
+            )
+            pool.state = fn(
+                pool.state,
+                dst_row,
+                jnp.asarray(np.asarray(src_rows, np.int32)),
+                jnp.asarray(limit_local),
+            )
+            return LazyResult(None)
+        wpr = pool.row_units
         fn = self._builder(
             ("sh_bs_bitop", wpr, S_src, op, masked),
             lambda: pm.sharded_bitop(
@@ -519,6 +705,15 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
     # -- generic -----------------------------------------------------------
 
     def _read_row_device(self, pool, row: int):
+        if self._is_mbit(pool):
+            WL = self._mbit_wl(pool)
+            fn = self._builder(
+                ("msh_read_row", WL),
+                lambda: pm.msharded_row_map(
+                    self.ctx, lambda local, r: bitops.row_slice(local, r, WL)
+                ),
+            )
+            return fn(pool.state, row).reshape(-1)  # [S, WL] -> [U]
         u = pool.row_units
         dtype = str(pool.spec.dtype)
         fn = self._builder(
@@ -531,6 +726,16 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         return np.asarray(self._read_row_device(pool, row))
 
     def write_row(self, pool, row: int, data: np.ndarray) -> None:
+        if self._is_mbit(pool):
+            WL = self._mbit_wl(pool)
+            fn = self._builder(
+                ("msh_write_row", WL),
+                lambda: pm.msharded_row_write(self.ctx, words_local=WL),
+            )
+            pool.state = fn(
+                pool.state, row, jnp.asarray(np.asarray(data).reshape(self.S, WL))
+            )
+            return
         u = pool.row_units
         dtype = str(pool.spec.dtype)
         fn = self._builder(

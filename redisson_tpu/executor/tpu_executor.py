@@ -109,14 +109,19 @@ class TpuCommandExecutor:
     # -- pool-state factory (the executor owns array layout; pools only
     # hand out row numbers) ------------------------------------------------
 
-    def round_capacity(self, capacity: int) -> int:
+    def round_capacity(self, capacity: int, row_units: int = 0, kind: str = "") -> int:
+        # Giant rows (config-3 scale bitmaps): don't pre-allocate the
+        # default 8 tenants' worth — cap the initial footprint at ~512MB
+        # and let doubling growth take over.
+        if row_units and capacity * row_units > (1 << 27):
+            return max(1, (1 << 27) // row_units)
         return capacity
 
-    def make_pool_state(self, capacity: int, row_units: int, dtype):
+    def make_pool_state(self, capacity: int, row_units: int, dtype, kind: str = ""):
         """Flat [capacity*row_units + 1]; trailing scratch element."""
         return jnp.zeros((capacity * row_units + 1,), dtype)
 
-    def grow_pool_state(self, state, old_cap: int, new_cap: int, row_units: int, dtype):
+    def grow_pool_state(self, state, old_cap: int, new_cap: int, row_units: int, dtype, kind: str = ""):
         extra = jnp.zeros(((new_cap - old_cap) * row_units + 1,), dtype)
         # state[:-1] drops the old scratch element; extra brings the new one.
         return jnp.concatenate([state[:-1], extra])

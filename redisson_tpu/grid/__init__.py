@@ -18,6 +18,14 @@ from redisson_tpu.grid.counters import (
     LongAdder,
 )
 from redisson_tpu.grid.maps import Map, MapCache
+from redisson_tpu.grid.local_cached_map import LocalCachedMap
+from redisson_tpu.grid.multimaps import (
+    ListMultimap,
+    ListMultimapCache,
+    SetMultimap,
+    SetMultimapCache,
+)
+from redisson_tpu.grid.streams import ReliableTopic, Stream
 from redisson_tpu.grid.collections import (
     LexSortedSet,
     List_,
@@ -55,7 +63,9 @@ __all__ = [
     "GridStore",
     "Bucket", "Buckets", "BinaryStream",
     "AtomicLong", "AtomicDouble", "LongAdder", "DoubleAdder", "IdGenerator",
-    "Map", "MapCache",
+    "Map", "MapCache", "LocalCachedMap",
+    "ListMultimap", "SetMultimap", "ListMultimapCache", "SetMultimapCache",
+    "Stream", "ReliableTopic",
     "Set_", "SetCache", "List_", "SortedSet", "ScoredSortedSet", "LexSortedSet",
     "Queue", "Deque", "BlockingQueue", "BlockingDeque", "DelayedQueue",
     "PriorityQueue", "RingBuffer",

@@ -1,0 +1,177 @@
+"""RLocalCachedMap — → org/redisson/RedissonLocalCachedMap.java +
+org/redisson/cache/ (LocalCacheView, LRU caches, invalidation-topic sync
+strategies).
+
+The reference keeps a near cache in each client and invalidates peers
+through a topic; writes publish the touched key hashes.  Here the shared
+state is the grid Map entry, the near cache is a per-HANDLE dict (bounded
+LRU), and invalidation rides the client's TopicBus on the map's own
+``{name}:topic`` channel — every handle (including other handles in this
+process, the reference's multi-client analog) subscribes and drops
+invalidated keys.
+
+Sync strategies (→ SyncStrategy): INVALIDATE (default) clears peer cache
+entries on write; UPDATE pushes the new value; NONE publishes nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Optional
+
+from redisson_tpu.grid.maps import Map
+
+INVALIDATE = "invalidate"
+UPDATE = "update"
+NONE = "none"
+
+
+class LocalCachedMap(Map):
+    KIND = "map"  # shares the backing Map keyspace entry
+
+    def __init__(self, name, client, *, cache_size: int = 4096,
+                 sync_strategy: str = INVALIDATE):
+        import uuid
+
+        super().__init__(name, client)
+        if sync_strategy not in (INVALIDATE, UPDATE, NONE):
+            raise ValueError(f"unknown sync strategy: {sync_strategy}")
+        self._cache: OrderedDict[bytes, Any] = OrderedDict()
+        self._cache_size = cache_size
+        self._sync = sync_strategy
+        self._bus = client._topic_bus
+        self._channel = f"{name}:topic"
+        # Invalidation messages carry the writer's cache id so the writer
+        # skips its own (its near cache already holds the fresh value) —
+        # the reference's excludedId on LocalCachedMapInvalidate.
+        self._cache_id = uuid.uuid4().hex
+        # The near cache is touched by user threads AND the TopicBus
+        # delivery pool (_on_sync) — one lock guards every mutation.
+        self._cache_lock = threading.Lock()
+        self._inval_gen = 0
+        self._listener_id = self._bus.subscribe(self._channel, self._on_sync)
+
+    # -- near cache plumbing -----------------------------------------------
+
+    def _on_sync(self, channel, message) -> None:
+        origin, op, kb, vb = message
+        if origin == self._cache_id:
+            return
+        with self._cache_lock:
+            # Any processed invalidation bumps the generation: a reader
+            # that sampled the backing map BEFORE this message must not
+            # install its (possibly stale) value afterwards.
+            self._inval_gen += 1
+            if kb is None:  # full clear
+                self._cache.clear()
+                return
+            if op == UPDATE and vb is not None:
+                self._cache_put_locked(kb, self._dec(vb))
+            else:
+                self._cache.pop(kb, None)
+
+    def _cache_put(self, kb: bytes, value: Any) -> None:
+        with self._cache_lock:
+            self._cache_put_locked(kb, value)
+
+    def _cache_put_locked(self, kb: bytes, value: Any) -> None:
+        self._cache[kb] = value
+        self._cache.move_to_end(kb)
+        while len(self._cache) > self._cache_size:
+            self._cache.popitem(last=False)  # LRU eviction
+
+    def _publish(self, kb: Optional[bytes], vb: Optional[bytes]) -> None:
+        if self._sync == NONE:
+            return
+        self._bus.publish(self._channel, (self._cache_id, self._sync, kb, vb))
+
+    # -- overridden read/write paths ---------------------------------------
+
+    def get(self, key: Any) -> Any:
+        kb = self._enc_key(key)
+        with self._cache_lock:
+            if kb in self._cache:
+                self._cache.move_to_end(kb)
+                return self._cache[kb]
+            gen = self._inval_gen
+        val = super().get(key)
+        if val is not None:
+            with self._cache_lock:
+                # Install only if no invalidation raced the backing read —
+                # otherwise a stale value could be cached forever.
+                if self._inval_gen == gen:
+                    self._cache_put_locked(kb, val)
+        return val
+
+    def put(self, key: Any, value: Any) -> Any:
+        prev = super().put(key, value)
+        kb = self._enc_key(key)
+        self._cache_put(kb, value)
+        self._publish(kb, self._enc(value) if self._sync == UPDATE else None)
+        return prev
+
+    def fast_put(self, key: Any, value: Any) -> bool:
+        created = super().fast_put(key, value)
+        kb = self._enc_key(key)
+        self._cache_put(kb, value)
+        self._publish(kb, self._enc(value) if self._sync == UPDATE else None)
+        return created
+
+    def remove(self, key: Any, expected: Any = None) -> Any:
+        if expected is None:
+            prev = super().remove(key)
+        else:
+            prev = super().remove(key, expected)
+        kb = self._enc_key(key)
+        with self._cache_lock:
+            self._cache.pop(kb, None)
+        self._publish(kb, None)
+        return prev
+
+    def fast_remove(self, *keys: Any) -> int:
+        n = super().fast_remove(*keys)
+        for k in keys:
+            kb = self._enc_key(k)
+            with self._cache_lock:
+                self._cache.pop(kb, None)
+            self._publish(kb, None)
+        return n
+
+    def clear(self) -> bool:
+        """→ RLocalCachedMap: clears backing map + every near cache."""
+        existed = self.delete()
+        with self._cache_lock:
+            self._cache.clear()
+        if self._sync != NONE:
+            self._bus.publish(
+                self._channel, (self._cache_id, INVALIDATE, None, None)
+            )
+        return existed
+
+    # -- cache introspection (→ RLocalCachedMap#cachedEntrySet etc.) -------
+
+    def cached_size(self) -> int:
+        with self._cache_lock:
+            return len(self._cache)
+
+    def cached_key_set(self) -> list:
+        with self._cache_lock:
+            return [self._dec_key(kb) for kb in self._cache]
+
+    def clear_local_cache(self) -> None:
+        """→ RLocalCachedMap#clearLocalCache (this handle only)."""
+        with self._cache_lock:
+            self._cache.clear()
+
+    def pre_load_cache(self) -> None:
+        """→ RLocalCachedMap#preloadCache: warm the near cache with the
+        whole backing map."""
+        for k, v in self.entries():
+            self._cache_put(self._enc_key(k), v)
+
+    def destroy(self) -> None:
+        """Unsubscribe this handle's invalidation listener."""
+        self._bus.unsubscribe(self._channel, self._listener_id)
+        with self._cache_lock:
+            self._cache.clear()

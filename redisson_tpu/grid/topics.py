@@ -101,10 +101,25 @@ class TopicBus:
             return n
 
     def drain(self, timeout: float = 5.0) -> None:
-        """Barrier: wait until every queued delivery has run (tests)."""
-        done = threading.Event()
-        self._pool.submit(done.set)
-        done.wait(timeout)
+        """Barrier: wait until every queued delivery has run.  One sentinel
+        is not enough with a multi-worker pool (it can run on an idle
+        worker while another worker is mid-callback) — all workers must
+        rendezvous, which forces each to finish its queued deliveries."""
+        n = max(1, getattr(self._pool, "_max_workers", 1))
+        barrier = threading.Barrier(n + 1)
+
+        def hold():
+            try:
+                barrier.wait(timeout)
+            except threading.BrokenBarrierError:  # pragma: no cover
+                pass
+
+        for _ in range(n):
+            self._pool.submit(hold)
+        try:
+            barrier.wait(timeout)
+        except threading.BrokenBarrierError:  # pragma: no cover
+            pass
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)

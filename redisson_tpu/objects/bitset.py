@@ -38,6 +38,14 @@ class BitSet(RObject):
         """Vectorized SETBIT: previous value per index."""
         return self._engine.bitset_set(self._name, np.asarray(indexes), value).result()
 
+    # RFuture-idiom async variants (→ RBitSetAsync#setAsync/getAsync).
+
+    def get_many_async(self, indexes):
+        return self._engine.bitset_get(self._name, np.asarray(indexes))
+
+    def set_many_async(self, indexes, value: bool = True):
+        return self._engine.bitset_set(self._name, np.asarray(indexes), value)
+
     def clear_bit(self, index: int) -> bool:
         """→ RBitSet#clear(index)."""
         return bool(

@@ -3,14 +3,16 @@ BASELINE.json requires it with the RObject idiom: tryInit/add/estimate/topK,
 name-addressed, codec-encoded keys — SURVEY.md §2.2).
 
 Geometry: depth d × width w counters per tenant; point estimates are the
-classic min-over-rows upper bound.  A host-side top-K tracker consumes the
-post-update estimates that ride back with each add batch (the streaming
-heavy-hitter path of benchmark config 5).
+classic min-over-rows upper bound.  Heavy-hitter tracking (benchmark
+config 5) is ENGINE-shared and name-addressed (engines.TopKStore): every
+handle to one sketch sees one candidate table; each add batch offers its
+heaviest candidates (argpartition over the post-update estimate stream
+that rides back with the batch), and ``top_k()`` re-estimates candidates
+on device so the ranking reflects current counts exactly.
 """
 
 from __future__ import annotations
 
-import heapq
 import math
 
 import numpy as np
@@ -22,21 +24,15 @@ from redisson_tpu.tenancy import PoolKind
 class CountMinSketch(RObject):
     KIND = PoolKind.CMS
 
-    def __init__(self, name, client):
-        super().__init__(name, client)
-        self._topk: dict = {}
-        self._track = 0
-
     # -- lifecycle ---------------------------------------------------------
 
     def try_init(self, depth: int, width: int, track_top_k: int = 0) -> bool:
         """Create with explicit geometry.  ``track_top_k``: keep a live
-        top-K candidate table updated on every add."""
+        top-K candidate table updated on every add (shared across every
+        handle to this name)."""
         created = self._engine.cms_try_init(self._name, int(depth), int(width))
-        if created or track_top_k:
-            # A no-op tryInit (already initialized, no explicit request)
-            # must not silently disable this instance's tracker.
-            self._track = int(track_top_k)
+        if track_top_k:
+            self._engine.topk.configure(self._name, int(track_top_k))
         return created
 
     def try_init_by_error(
@@ -66,19 +62,57 @@ class CountMinSketch(RObject):
         return int(self.add_all([obj], [count])[0])
 
     def add_all(self, objs, counts=None) -> np.ndarray:
-        res = self.add_all_async(objs, counts).result()
-        if self._track:
-            self._update_topk(objs, res)
-        return res
+        return self.add_all_async(objs, counts).result()
 
     def add_all_async(self, objs, counts=None):
         H1, H2 = self._hash128(objs)
         if counts is None:
             counts = np.ones(len(H1), np.uint32)
-        return self._engine.cms_add(self._name, H1, H2, np.asarray(counts, np.uint32))
+        fut = self._engine.cms_add(
+            self._name, H1, H2, np.asarray(counts, np.uint32)
+        )
+        k = self._engine.topk.track(self._name)
+        if not k:
+            return fut
+        name, engine = self._name, self._engine
+        objs_ref = list(objs) if not isinstance(objs, np.ndarray) else objs
+
+        def offer(est):
+            # Select the batch's heaviest UNIQUE keys (a heavy key appears
+            # many times per batch; taking top ops would offer only its
+            # duplicates), then push ≤4k candidates to the shared table.
+            est = np.asarray(est)
+            n_offer = min(4 * max(k, 16), est.shape[0])
+            if isinstance(objs_ref, np.ndarray):
+                uniq, inv = np.unique(objs_ref, return_inverse=True)
+                per_key = np.zeros(len(uniq), est.dtype)
+                np.maximum.at(per_key, inv, est)
+                keys_list, ests_arr = uniq, per_key
+            else:
+                best: dict = {}
+                for o, e in zip(objs_ref, est):
+                    e = int(e)
+                    if best.get(o, -1) < e:
+                        best[o] = e
+                keys_list = list(best)
+                ests_arr = np.fromiter(best.values(), dtype=np.int64)
+            if n_offer < len(keys_list):
+                top = np.argpartition(ests_arr, -n_offer)[-n_offer:]
+            else:
+                top = np.arange(len(keys_list))
+            # Keep keys as their ORIGINAL scalar types (.tolist() would
+            # turn np.uint64 into int, which codecs encode differently —
+            # re-estimation would then miss every candidate).
+            keys = [keys_list[i] for i in top]
+            engine.topk.offer(name, keys, ests_arr[top])
+            return est
+
+        return _OfferOnResult(fut, offer)
 
     def estimate(self, obj) -> int:
-        return int(self.estimate_all(np.atleast_1d(obj) if not isinstance(obj, (str, bytes)) else [obj])[0])
+        # [obj], never np.atleast_1d: coercing a python int to np.int64
+        # changes its codec encoding, silently estimating a different key.
+        return int(self.estimate_all([obj])[0])
 
     def estimate_all(self, objs) -> np.ndarray:
         H1, H2 = self._hash128(objs)
@@ -87,20 +121,40 @@ class CountMinSketch(RObject):
     def merge(self, *other_names: str) -> None:
         self._engine.cms_merge(self._name, other_names)
 
-    # -- top-K tracking ----------------------------------------------------
-
-    def _update_topk(self, objs, estimates) -> None:
-        if isinstance(objs, np.ndarray):
-            objs = objs.tolist()
-        for o, e in zip(objs, estimates):
-            self._topk[o] = int(e)
-        if len(self._topk) > 4 * max(self._track, 16):
-            keep = heapq.nlargest(
-                2 * self._track, self._topk.items(), key=lambda kv: kv[1]
-            )
-            self._topk = dict(keep)
+    # -- top-K tracking (engine-shared, see module docstring) --------------
 
     def top_k(self, k: int | None = None):
-        """[(key, estimated_count)] heaviest-first among tracked candidates."""
-        k = k or self._track
-        return heapq.nlargest(k, self._topk.items(), key=lambda kv: kv[1])
+        """[(key, estimated_count)] heaviest-first.  Candidates come from
+        the engine-shared table; their counts are RE-ESTIMATED on device
+        at call time, so the ranking reflects all adds from every handle."""
+        k = k or self._engine.topk.track(self._name) or 10
+        cands = self._engine.topk.candidates(self._name)
+        if not cands:
+            return []
+        ests = self.estimate_all(cands)
+        order = np.argsort(-ests, kind="stable")[:k]
+        return [(cands[i], int(ests[i])) for i in order]
+
+
+class _OfferOnResult:
+    """Future adapter: feeds the engine's top-K table exactly once when the
+    batch's estimates materialize."""
+
+    def __init__(self, fut, offer):
+        self._fut = fut
+        self._offer = offer
+        self._done_val = None
+        self._offered = False
+
+    def result(self, *a, **kw):
+        v = self._fut.result(*a, **kw)
+        if not self._offered:
+            self._offered = True
+            self._done_val = self._offer(v)
+        return self._done_val if self._done_val is not None else v
+
+    def get(self):
+        return self.result()
+
+    def done(self):
+        return self._fut.done()

@@ -50,6 +50,9 @@ class SketchDurabilityMixin:
                     self._drain()
                     self.executor.zero_row(entry.pool, entry.row)
                     entry.pool.free_row(entry.row)
+                    # Shared heavy-hitter table dies with the object (a
+                    # successor under this name must not inherit ghosts).
+                    self.topk.drop(entry.name)
                 return True
         return False
 
@@ -211,9 +214,13 @@ class SketchDurabilityMixin:
         with self.executor._dispatch_lock:
             for i, pm in enumerate(meta["pools"]):
                 pool = self.registry.pool_for(pm["kind"], tuple(pm["class_key"]))
-                cap = self.executor.round_capacity(pm["capacity"])
-                while pool.capacity < cap:
-                    pool._grow()
+                # The snapshot's capacity is already executor-valid (it was
+                # produced by this executor shape) — install it VERBATIM.
+                # Re-rounding could clamp a grown capacity back down (giant
+                # rows) and hand occupied rows to new tenants.
+                pool.capacity = int(pm["capacity"])
+                pool._free = list(range(pool.capacity - 1, -1, -1))
+                pool.generation += 1
                 arr = data[f"pool_{i}"]
                 self.executor.state_from_host(pool, arr)
             by_key = {tuple(p.spec.key): p for p in self.registry.pools()}

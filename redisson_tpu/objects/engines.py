@@ -38,6 +38,70 @@ class ImmediateResult(LazyResult):
         super().__init__(value)
 
 
+class TopKStore:
+    """Engine-shared heavy-hitter candidate tables (BASELINE config 5).
+
+    Name-addressed: every CountMinSketch handle for ``name`` — from any
+    number of client facades — sees ONE table (round-2 review flagged the
+    per-instance dict: two handles to the same sketch disagreed).  The
+    table holds candidate keys with their last-seen estimates, max-merged
+    and pruned; ``top_k()`` re-estimates candidates on device for
+    exactness, so the table only needs to not LOSE heavy keys."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tables: dict[str, dict] = {}
+
+    def configure(self, name: str, k: int) -> None:
+        with self._lock:
+            t = self._tables.get(name)
+            if t is None:
+                self._tables[name] = {"k": int(k), "cands": {}}
+            else:
+                t["k"] = max(t["k"], int(k))
+
+    def track(self, name: str) -> int:
+        with self._lock:
+            t = self._tables.get(name)
+            return 0 if t is None else t["k"]
+
+    def offer(self, name: str, keys, estimates) -> None:
+        """Max-merge a batch's post-update estimates.  Only the batch's
+        heaviest 4k candidates are offered by callers (argpartition over
+        the estimate stream), so the table stays small under 100M-event
+        ingest."""
+        import heapq
+
+        with self._lock:
+            t = self._tables.get(name)
+            if t is None:
+                return
+            cands = t["cands"]
+            for key, est in zip(keys, estimates):
+                e = int(est)
+                if cands.get(key, 0) < e:
+                    cands[key] = e
+            cap = 4 * max(t["k"], 16)
+            if len(cands) > 2 * cap:
+                keep = heapq.nlargest(cap, cands.items(), key=lambda kv: kv[1])
+                t["cands"] = dict(keep)
+
+    def candidates(self, name: str) -> list:
+        with self._lock:
+            t = self._tables.get(name)
+            return [] if t is None else list(t["cands"])
+
+    def drop(self, name: str) -> None:
+        with self._lock:
+            self._tables.pop(name, None)
+
+    def rename(self, old: str, new: str) -> None:
+        with self._lock:
+            t = self._tables.pop(old, None)
+            if t is not None:
+                self._tables[new] = t
+
+
 class _MappedFuture:
     """Future adapter applying a transform on .result()."""
 
@@ -75,6 +139,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
             dispatch_lock=self.executor._dispatch_lock,
         )
         self.metrics = Metrics()
+        self.topk = TopKStore()
         # Wired by the client to the grid store's ``exists`` — one logical
         # keyspace across both backends (WRONGTYPE on cross-backend reuse).
         self.foreign_exists = None
@@ -141,6 +206,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
         self._drain()
         self.executor.zero_row(entry.pool, entry.row)
         entry.pool.free_row(entry.row)
+        self.topk.drop(name)
         return not was_expired
 
     def rename(self, old: str, new: str) -> bool:
@@ -148,11 +214,17 @@ class TpuSketchEngine(SketchDurabilityMixin):
             return False
         self._guard_foreign(new)
         self._drain()
-        dest = self.registry.detach(new)
+        # Atomic rename FIRST: if the source vanished since the check
+        # (expiry race), the destination must be left untouched.  The
+        # displaced dest is zeroed before its row becomes reusable.
+        ok, dest = self.registry.rename_detach_dest(old, new)
+        if not ok:
+            return False
         if dest is not None:
             self.executor.zero_row(dest.pool, dest.row)
             dest.pool.free_row(dest.row)
-        return self.registry.rename(old, new)
+        self.topk.rename(old, new)
+        return True
 
     def names(self, kind=None):
         for e in self.registry.entries():
@@ -671,6 +743,7 @@ class HostSketchEngine:
         self.config = config
         self._lock = threading.RLock()
         self._objects: dict[str, dict] = {}
+        self.topk = TopKStore()
         # Wired by the client to the grid store's lock-free ``probe`` (one
         # logical keyspace — same contract as TpuSketchEngine).  Called
         # while holding self._lock, so it MUST NOT take the grid's lock.
@@ -709,6 +782,7 @@ class HostSketchEngine:
         if o is not None and o.get("expire_at") is not None:
             if _time.time() >= o["expire_at"]:
                 del self._objects[name]
+                self.topk.drop(name)
                 return None
         return o
 
@@ -720,6 +794,7 @@ class HostSketchEngine:
         with self._lock:
             live = self._live(name) is not None
             self._objects.pop(name, None)
+            self.topk.drop(name)
             return live
 
     def rename(self, old, new) -> bool:
@@ -728,6 +803,7 @@ class HostSketchEngine:
                 return False
             self._guard_foreign(new)  # one keyspace: RENAME can't shadow grid
             self._objects[new] = self._objects.pop(old)
+            self.topk.rename(old, new)
             return True
 
     def names(self, kind=None):

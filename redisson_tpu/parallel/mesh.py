@@ -385,6 +385,93 @@ def psharded_cms_update_estimate(ctx: MeshContext, *, d: int, w: int, cells_per_
 
 
 # --------------------------------------------------------------------------
+# m-sharded multi-tenant bitset pools (config 3, SURVEY.md §7-L4): rows at
+# or above Config.mbit_threshold_words split their WORDS contiguously
+# across shards — global word g of row r lives on shard g // W_local at
+# local row r.  Batch ops partition by word-shard host-side and reuse the
+# psharded_* kernels with local coordinates; the builders below cover the
+# whole-row ops (scalar reduces, range writes, BITOP), which are
+# embarrassingly shard-local — per-shard partial results return [S] to the
+# host for combination, no collective at all.
+# --------------------------------------------------------------------------
+
+
+def msharded_row_map(ctx: MeshContext, fn_local):
+    """Each shard computes ``fn_local(local_state, row)`` over its word
+    slice of the row; results come back stacked [S, ...] for host-side
+    combination (sum for popcount, offset-max for length, …)."""
+
+    def inner(state, row):
+        v = jnp.asarray(fn_local(state[0], row))
+        return v[None]
+
+    fn = jax.shard_map(
+        inner, mesh=ctx.mesh, in_specs=(P("shard"), P()), out_specs=P("shard")
+    )
+    return jax.jit(fn)
+
+
+def msharded_row_write(ctx: MeshContext, *, words_local: int):
+    """Overwrite one row: data arrives pre-split [S, W_local]."""
+
+    def inner(state, row, data):
+        local = state[0]
+        return bitops.row_update(local, row, data[0], words_local)[None]
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P("shard")),
+        out_specs=P("shard"),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def msharded_set_range(ctx: MeshContext, *, words_local: int, value: bool):
+    """Range set/clear: the host clips the global [from, to) to each
+    shard's word window; every shard applies its local mask."""
+
+    def inner(state, row, fb, tb):
+        local = state[0]
+        mask = bitops.range_mask_words(words_local, fb[0], tb[0])
+        cur = bitops.row_slice(local, row, words_local)
+        new_row = (cur | mask) if value else (cur & ~mask)
+        return bitops.row_update(local, row, new_row, words_local)[None]
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P("shard"), P("shard")),
+        out_specs=P("shard"),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def msharded_bitop(ctx: MeshContext, *, words_local: int, op: str, n_src: int, masked: bool = False):
+    """BITOP on m-sharded rows: every operand's words for this shard are
+    local, so each shard computes its slice independently — no collective
+    (contrast sharded_bitop above, where whole rows live on one shard).
+    ``limit`` arrives per-shard (the NOT mask clipped to the local window).
+    """
+    from redisson_tpu.ops import bitset as bitset_ops
+
+    def inner(state, dst_row, src_rows, limit):
+        local = state[0]
+        return bitset_ops.bitset_bitop_rows(
+            local, dst_row, src_rows, words_per_row=words_local, op=op,
+            n_src=n_src, limit_bits=limit[0] if masked else None,
+        )[None]
+
+    fn = jax.shard_map(
+        inner,
+        mesh=ctx.mesh,
+        in_specs=(P("shard"), P(), P(), P("shard")),
+        out_specs=P("shard"),
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+# --------------------------------------------------------------------------
 # Cross-shard collectives: PFMERGE / BITOP between rows on different shards
 # --------------------------------------------------------------------------
 

@@ -2,5 +2,6 @@
 SURVEY.md §7)."""
 
 from redisson_tpu.serve.metrics import Metrics
+from redisson_tpu.serve.ingest import TopicCmsBridge
 
-__all__ = ["Metrics"]
+__all__ = ["Metrics", "TopicCmsBridge"]

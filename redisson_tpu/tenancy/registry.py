@@ -81,10 +81,13 @@ class SizeClassPool:
     def __init__(self, spec: PoolSpec, capacity: int, factory, dispatch_lock=None):
         self.spec = spec
         # The factory (the executor) owns state layout: flat [T*W+1] on one
-        # device, or [S, local] sharded over a mesh.  This layer only hands
-        # out row numbers and never touches array internals.
+        # device, [S, local] row-sharded over a mesh, or [S, words/S]
+        # m-sharded for giant bitmaps.  This layer only hands out row
+        # numbers and never touches array internals.
         self._factory = factory
-        self.capacity = factory.round_capacity(capacity)
+        self.capacity = factory.round_capacity(
+            capacity, row_units=spec.row_units, kind=spec.kind
+        )
         # Growth swaps self.state; a concurrently flushing coalesced write
         # donates the same buffer and reassigns state with the old-shaped
         # output, losing the growth (or hitting use-after-donate).  Taking
@@ -92,7 +95,7 @@ class SizeClassPool:
         # growth atomic w.r.t. every dispatch.
         self._dispatch_lock = dispatch_lock or threading.RLock()
         self.state = factory.make_pool_state(
-            self.capacity, spec.row_units, spec.dtype
+            self.capacity, spec.row_units, spec.dtype, kind=spec.kind
         )
         self._free: list[int] = list(range(self.capacity - 1, -1, -1))
         self.generation = 0  # bumped on every growth (jit cache key part)
@@ -119,7 +122,8 @@ class SizeClassPool:
         old_cap = self.capacity
         new_cap = old_cap * 2
         self.state = self._factory.grow_pool_state(
-            self.state, old_cap, new_cap, self.spec.row_units, self.spec.dtype
+            self.state, old_cap, new_cap, self.spec.row_units, self.spec.dtype,
+            kind=self.spec.kind,
         )
         self.capacity = new_cap
         self.generation += 1
@@ -218,17 +222,26 @@ class TenantRegistry:
             return self._tenants.pop(name)
 
     def rename(self, old: str, new: str) -> bool:
+        ok, dest = self.rename_detach_dest(old, new)
+        if dest is not None:
+            dest.pool.free_row(dest.row)
+        return ok
+
+    def rename_detach_dest(self, old: str, new: str):
+        """Atomic rename; the displaced destination entry (if any) is
+        returned WITHOUT freeing its row, so the caller can zero it before
+        reuse.  Returns (renamed, displaced_dest | None) — if ``old`` is
+        gone (e.g. expired between the caller's check and this call), the
+        destination is left untouched (Redis RENAME with a missing source
+        errors without side effects)."""
         with self._lock:
             entry = self._tenants.pop(old, None)
             if entry is None:
-                return False
-            # RKeys.rename overwrites the destination (Redis RENAME).
+                return False, None
             dest = self._tenants.pop(new, None)
-            if dest is not None:
-                dest.pool.free_row(dest.row)
             entry.name = new
             self._tenants[new] = entry
-            return True
+            return True, dest
 
     def names(self, kind: Optional[str] = None) -> list[str]:
         with self._lock:

@@ -9,12 +9,16 @@ TPU-first design choice: we use a MurmurHash3 **x86_128** variant because it
 is built entirely from 32-bit multiplies/rotates — it runs on the TPU VPU
 without 64-bit emulation, and vectorizes over a batch axis in both NumPy
 (host/golden path) and jax.numpy (device path).  Deviation from canonical
-Murmur3: zero-padded tail bytes are processed through the main block mix
-(instead of the scalar tail path) so the whole batch is one fixed-shape
-vector program; the true byte length is mixed into finalization.  The hash
-therefore differs from reference Murmur3 vectors but keeps the same mixing
-structure and uniformity — FPP parity only requires a uniform 128-bit hash
-plus the same (m, k) formulas (SURVEY.md §7 hard part #4).
+Murmur3: each key's zero-padded tail bytes (up to its own whole-16-byte
+block count) go through the main block mix instead of the scalar tail
+path, and the true byte length is mixed into finalization.  Blocks beyond
+a key's own count are MASKED out of the mix, so a key's hash never
+depends on the batch it rides in (a key hashes identically alone and in
+any mixed-length batch — round-3 fix: the unmasked version made
+estimates/membership silently miss across differently-shaped batches).
+The hash differs from reference Murmur3 vectors but keeps the same mixing
+structure and uniformity — FPP parity only requires a uniform 128-bit
+hash plus the same (m, k) formulas (SURVEY.md §7 hard part #4).
 
 The NumPy and JAX implementations share one code path parameterized by the
 array namespace ``xp``; tests assert bit-identical outputs.
@@ -80,37 +84,52 @@ def murmur3_x86_128(blocks, lengths, xp=np, seed=DEFAULT_SEED):
     h3 = xp.full(shape, seed, dtype=np.uint32)
     h4 = xp.full(shape, seed, dtype=np.uint32)
 
-    for blk in range(nlanes // 4):
+    ln32 = lengths.astype(np.uint32)
+    # Whole-16-byte blocks each key owns (min 1); blocks past a key's own
+    # count must not perturb its lanes (batch-shape independence).
+    nblocks_key = xp.maximum(
+        np.uint32(1), (ln32 + np.uint32(15)) >> np.uint32(4)
+    )
+    n_blk = nlanes // 4
+    for blk in range(n_blk):
         k1 = blocks[..., 4 * blk + 0]
         k2 = blocks[..., 4 * blk + 1]
         k3 = blocks[..., 4 * blk + 2]
         k4 = blocks[..., 4 * blk + 3]
 
         k1 = _rotl32(k1 * _C1, 15) * _C2
-        h1 = h1 ^ k1
-        h1 = _rotl32(h1, 19) + h2
-        h1 = h1 * _FIVE + _N1
+        n1 = h1 ^ k1
+        n1 = _rotl32(n1, 19) + h2
+        n1 = n1 * _FIVE + _N1
 
         k2 = _rotl32(k2 * _C2, 16) * _C3
-        h2 = h2 ^ k2
-        h2 = _rotl32(h2, 17) + h3
-        h2 = h2 * _FIVE + _N2
+        n2 = h2 ^ k2
+        n2 = _rotl32(n2, 17) + h3
+        n2 = n2 * _FIVE + _N2
 
         k3 = _rotl32(k3 * _C3, 17) * _C4
-        h3 = h3 ^ k3
-        h3 = _rotl32(h3, 15) + h4
-        h3 = h3 * _FIVE + _N3
+        n3 = h3 ^ k3
+        n3 = _rotl32(n3, 15) + h4
+        n3 = n3 * _FIVE + _N3
 
         k4 = _rotl32(k4 * _C4, 18) * _C1
-        h4 = h4 ^ k4
-        h4 = _rotl32(h4, 13) + h1
-        h4 = h4 * _FIVE + _N4
+        n4 = h4 ^ k4
+        n4 = _rotl32(n4, 13) + n1  # chains through the UPDATED h1
+        n4 = n4 * _FIVE + _N4
 
-    ln = lengths.astype(np.uint32)
-    h1 = h1 ^ ln
-    h2 = h2 ^ ln
-    h3 = h3 ^ ln
-    h4 = h4 ^ ln
+        if n_blk == 1:
+            h1, h2, h3, h4 = n1, n2, n3, n4
+        else:
+            active = np.uint32(blk) < nblocks_key
+            h1 = xp.where(active, n1, h1)
+            h2 = xp.where(active, n2, h2)
+            h3 = xp.where(active, n3, h3)
+            h4 = xp.where(active, n4, h4)
+
+    h1 = h1 ^ ln32
+    h2 = h2 ^ ln32
+    h3 = h3 ^ ln32
+    h4 = h4 ^ ln32
 
     h1 = h1 + h2 + h3 + h4
     h2 = h2 + h1

@@ -77,3 +77,32 @@ def test_empty_batch():
     assert blocks.shape == (0, 4)
     c = hashing.murmur3_x86_128(blocks, lengths)
     assert c[0].shape == (0,)
+
+
+def test_hash_is_batch_shape_independent():
+    """r3 fix: a key's hash must not depend on the batch it rides in —
+    the unmasked block mix made mixed-length batches hash short keys
+    against the batch-wide padding width."""
+    from redisson_tpu.utils import hashing
+
+    single, ls = hashing.encode_bytes_batch([b"x"])
+    hs = hashing.murmur3_x86_128(single, ls)
+    mixed, lm = hashing.encode_bytes_batch([b"x", b"a-much-longer-key-here!!!"])
+    hm = hashing.murmur3_x86_128(mixed, lm)
+    assert all(int(a[0]) == int(b[0]) for a, b in zip(hs, hm))
+    # And through the public API: estimate finds keys added in other batches.
+    import redisson_tpu
+    from redisson_tpu import Config
+
+    c = redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
+    try:
+        cms = c.get_count_min_sketch("mixlen")
+        cms.try_init(4, 1 << 12)
+        cms.add("x", count=12)
+        assert list(cms.estimate_all(["x", "a-much-longer-key"])) == [12, 0]
+        bf = c.get_bloom_filter("mixlen-bf")
+        bf.try_init(1000, 0.01)
+        bf.add("y")
+        assert list(bf.contains_each(["y", "a-much-longer-key"])) == [True, False]
+    finally:
+        c.shutdown()

@@ -1,0 +1,127 @@
+"""m-sharded giant bitsets through the public API (config 3,
+SURVEY.md §7-L4): rows at/above ``mbit_threshold_words`` split their words
+contiguously across the 8-device virtual mesh; every BitSet operation must
+agree with the host golden engine bit-for-bit.
+"""
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+
+# Low threshold so modest test shapes exercise the m-sharded layout.
+THRESH = 256
+NBITS = 1 << 16  # -> 2048-word rows, WL=256 over 8 shards
+
+
+@pytest.fixture
+def clients():
+    tpu = redisson_tpu.create(
+        Config().use_tpu_sketch(
+            num_shards=8, mbit_threshold_words=THRESH, min_bucket=64
+        )
+    )
+    host = redisson_tpu.create(Config())
+    yield tpu, host
+    tpu.shutdown()
+    host.shutdown()
+
+
+def both(clients, name):
+    return clients[0].get_bit_set(name), clients[1].get_bit_set(name)
+
+
+class TestMbitSharded:
+    def test_pool_is_msharded(self, clients):
+        tpu, _ = clients
+        bs = tpu.get_bit_set("layout")
+        bs.set(NBITS - 1)
+        entry = tpu._engine.registry.lookup("layout")
+        assert tpu._engine.executor._is_mbit(entry.pool)
+        # state [S, T*WL+1]
+        assert entry.pool.state.shape[0] == 8
+
+    def test_set_get_across_shards(self, clients):
+        a, b = both(clients, "sg")
+        rng = np.random.default_rng(3)
+        idx = rng.integers(0, NBITS, 5000).astype(np.uint32)
+        pa = a.set_many(idx)
+        pb = b.set_many(idx)
+        assert list(pa) == list(pb)  # exact sequential prev-bit semantics
+        probe = rng.integers(0, NBITS, 8000).astype(np.uint32)
+        assert list(a.get_many(probe)) == list(b.get_many(probe))
+        assert a.cardinality() == b.cardinality()
+
+    def test_mixed_ops_sequential_semantics(self, clients):
+        a, b = both(clients, "mix")
+        rng = np.random.default_rng(4)
+        idx = rng.integers(0, NBITS, 3000).astype(np.uint32)
+        a.set_many(idx)
+        b.set_many(idx)
+        flip_idx = rng.integers(0, NBITS, 512).astype(np.uint32)
+        for i in flip_idx[:32]:
+            assert a.flip(int(i)) == b.flip(int(i))
+        clear_idx = idx[:500]
+        assert list(a.set_many(clear_idx, value=False)) == list(
+            b.set_many(clear_idx, value=False)
+        )
+        assert a.cardinality() == b.cardinality()
+
+    def test_length_bitpos(self, clients):
+        a, b = both(clients, "len")
+        for i in (0, 1000, NBITS // 2 + 7, NBITS - 3):
+            a.set(i)
+            b.set(i)
+        assert a.length() == b.length()
+        assert a.first_set_bit() == b.first_set_bit()
+        assert a.first_clear_bit() == b.first_clear_bit()
+
+    def test_set_range_spanning_shards(self, clients):
+        a, b = both(clients, "range")
+        lo, hi = NBITS // 4 + 13, 3 * NBITS // 4 - 5  # spans several shards
+        a.set(NBITS - 1)  # materialize full capacity first
+        b.set(NBITS - 1)
+        a.set_range(lo, hi)
+        b.set_range(lo, hi)
+        assert a.cardinality() == b.cardinality()
+        probe = np.asarray(
+            [lo - 1, lo, lo + 1, NBITS // 2, hi - 1, hi, hi + 1], np.uint32
+        )
+        assert list(a.get_many(probe)) == list(b.get_many(probe))
+        a.clear_range(lo + 100, hi - 100)
+        b.clear_range(lo + 100, hi - 100)
+        assert a.cardinality() == b.cardinality()
+
+    def test_bitop_and_not(self, clients):
+        tpu, host = clients
+        rng = np.random.default_rng(5)
+        for c in (tpu, host):
+            x = c.get_bit_set("bo-x")
+            y = c.get_bit_set("bo-y")
+            x.set(NBITS - 1)
+            y.set(NBITS - 1)
+            x.set_many(rng.integers(0, NBITS, 4000).astype(np.uint32))
+            rng2 = np.random.default_rng(6)
+            y.set_many(rng2.integers(0, NBITS, 4000).astype(np.uint32))
+            rng = np.random.default_rng(5)  # same draws for both clients
+        ax = tpu.get_bit_set("bo-x")
+        bx = host.get_bit_set("bo-x")
+        ax.and_op("bo-y")
+        bx.and_op("bo-y")
+        assert ax.cardinality() == bx.cardinality()
+        assert ax.to_byte_array() == bx.to_byte_array()
+        ax.not_op()
+        bx.not_op()
+        assert ax.to_byte_array() == bx.to_byte_array()
+
+    def test_dump_restore_msharded(self, clients):
+        tpu, _ = clients
+        bs = tpu.get_bit_set("dump-m")
+        idx = np.arange(0, NBITS, 37, dtype=np.uint32)
+        bs.set_many(idx)
+        blob = bs.dump()
+        bs2 = tpu.get_bit_set("dump-m2")
+        bs2.restore(blob)
+        assert bs2.cardinality() == len(idx)
+        assert list(bs2.get_many(idx)) == [True] * len(idx)

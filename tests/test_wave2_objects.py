@@ -1,0 +1,271 @@
+"""Catalog wave 2: multimaps, RLocalCachedMap, RStream, RReliableTopic
+(VERDICT r2 Next #8 — per-family test classes like test_grid_objects.py).
+"""
+
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+
+
+@pytest.fixture
+def client():
+    c = redisson_tpu.create(Config())
+    yield c
+    c.shutdown()
+
+
+class TestListMultimap:
+    def test_put_get_duplicates(self, client):
+        mm = client.get_list_multimap("lmm")
+        assert mm.put("k", "a")
+        assert mm.put("k", "a")  # duplicates allowed
+        assert mm.put("k", "b")
+        assert mm.get_all("k") == ["a", "a", "b"]
+        assert mm.size() == 3
+        assert mm.key_size() == 1
+
+    def test_remove_one_occurrence(self, client):
+        mm = client.get_list_multimap("lmm2")
+        mm.put_all("k", ["a", "a", "b"])
+        assert mm.remove("k", "a")
+        assert mm.get_all("k") == ["a", "b"]
+        assert not mm.remove("k", "zzz")
+
+    def test_remove_all_and_fast_remove(self, client):
+        mm = client.get_list_multimap("lmm3")
+        mm.put_all("k1", ["a", "b"])
+        mm.put_all("k2", ["c"])
+        assert mm.remove_all("k1") == ["a", "b"]
+        assert not mm.contains_key("k1")
+        assert mm.fast_remove("k2", "missing") == 1
+
+    def test_entries_values_keyset(self, client):
+        mm = client.get_list_multimap("lmm4")
+        mm.put("x", 1)
+        mm.put("y", 2)
+        assert sorted(mm.key_set()) == ["x", "y"]
+        assert sorted(mm.values()) == [1, 2]
+        assert sorted(mm.entries()) == [("x", 1), ("y", 2)]
+
+
+class TestSetMultimap:
+    def test_distinct_values(self, client):
+        mm = client.get_set_multimap("smm")
+        assert mm.put("k", "a")
+        assert not mm.put("k", "a")  # set semantics
+        assert mm.put("k", "b")
+        assert sorted(mm.get_all("k")) == ["a", "b"]
+        assert mm.contains_entry("k", "a")
+        assert not mm.contains_entry("k", "zzz")
+        assert mm.contains_value("b")
+
+
+class TestMultimapCache:
+    def test_per_key_ttl(self, client):
+        mm = client.get_set_multimap_cache("smmc")
+        mm.put("hot", 1)
+        mm.put("cold", 2)
+        assert mm.expire_key("cold", 0.1)
+        assert mm.remain_key_ttl_ms("cold") > 0
+        assert mm.remain_key_ttl_ms("hot") == -1
+        assert mm.remain_key_ttl_ms("absent") == -2
+        time.sleep(0.15)
+        assert not mm.contains_key("cold")
+        assert mm.contains_key("hot")
+
+
+class TestLocalCachedMap:
+    def test_near_cache_hit(self, client):
+        m = client.get_local_cached_map("lcm")
+        m.put("a", 1)
+        assert m.get("a") == 1
+        assert m.cached_size() >= 1
+        # Reads served from the near cache even if backing entry mutates
+        # underneath without invalidation (direct Map handle):
+        raw = client.get_map("lcm")
+        raw.fast_put("a", 99)
+        assert m.get("a") == 1  # stale by design until invalidated
+
+    def test_invalidation_between_handles(self, client):
+        m1 = client.get_local_cached_map("lcm2")
+        m2 = client.get_local_cached_map("lcm2")
+        m1.put("k", "v1")
+        assert m2.get("k") == "v1"  # m2 caches it
+        m1.put("k", "v2")  # publishes invalidation
+        client._topic_bus.drain()
+        assert m2.get("k") == "v2"  # m2's cache entry was dropped
+
+    def test_update_strategy_pushes_value(self, client):
+        from redisson_tpu.grid.local_cached_map import UPDATE
+
+        m1 = client.get_local_cached_map("lcm3", sync_strategy=UPDATE)
+        m2 = client.get_local_cached_map("lcm3", sync_strategy=UPDATE)
+        m1.put("k", "v1")
+        client._topic_bus.drain()
+        # m2 received the VALUE without ever reading the backing map.
+        assert m2.cached_size() == 1
+        assert m2.get("k") == "v1"
+
+    def test_writer_keeps_own_cache(self, client):
+        m = client.get_local_cached_map("lcm4")
+        m.put("k", "v")
+        client._topic_bus.drain()
+        assert m.cached_size() == 1  # own write didn't self-invalidate
+
+    def test_lru_bound(self, client):
+        m = client.get_local_cached_map("lcm5", cache_size=4)
+        for i in range(10):
+            m.put(f"k{i}", i)
+        assert m.cached_size() <= 4
+
+
+class TestStream:
+    def test_add_range_read(self, client):
+        s = client.get_stream("st")
+        id1 = s.add({"f": "v1"})
+        id2 = s.add({"f": "v2"})
+        assert s.size() == 2
+        entries = s.range()
+        assert [i for i, _ in entries] == [id1, id2]
+        assert entries[0][1] == {"f": "v1"}
+        assert s.rev_range()[0][0] == id2
+        assert [i for i, _ in s.read(from_id=id1)] == [id2]
+        assert s.get(id1) == {"f": "v1"}
+        assert s.last_id() == id2
+
+    def test_explicit_ids_and_ordering(self, client):
+        s = client.get_stream("st2")
+        s.add({"a": 1}, id="5-1")
+        with pytest.raises(ValueError):
+            s.add({"a": 2}, id="5-1")  # not greater than last
+        s.add({"a": 2}, id="5-2")
+        assert [i for i, _ in s.range()] == ["5-1", "5-2"]
+
+    def test_trim_and_delete(self, client):
+        s = client.get_stream("st3")
+        ids = [s.add({"n": i}) for i in range(10)]
+        assert s.remove(ids[3]) == 1
+        assert s.size() == 9
+        assert s.trim(5) == 4
+        assert s.size() == 5
+
+    def test_maxlen_on_add(self, client):
+        s = client.get_stream("st4")
+        for i in range(10):
+            s.add({"n": i}, maxlen=3)
+        assert s.size() == 3
+
+    def test_consumer_groups_deliver_and_ack(self, client):
+        s = client.get_stream("grp")
+        s.create_group("g1", from_id="0-0")
+        ids = [s.add({"n": i}) for i in range(5)]
+        got1 = s.read_group("g1", "c1", count=3)
+        assert [i for i, _ in got1] == ids[:3]
+        got2 = s.read_group("g1", "c2")
+        assert [i for i, _ in got2] == ids[3:]
+        # Pending before ack
+        p = s.pending("g1")
+        assert p["total"] == 5
+        assert p["consumers"] == {"c1": 3, "c2": 2}
+        assert s.ack("g1", *[i for i, _ in got1]) == 3
+        assert s.pending("g1")["total"] == 2
+        # Re-read own pending (explicit id, not ">")
+        own = s.read_group("g1", "c2", ids="0-0")
+        assert [i for i, _ in own] == ids[3:]
+
+    def test_group_from_dollar_sees_only_new(self, client):
+        s = client.get_stream("grp2")
+        s.add({"n": "old"})
+        s.create_group("g", from_id="$")
+        assert s.read_group("g", "c") == []
+        nid = s.add({"n": "new"})
+        assert [i for i, _ in s.read_group("g", "c")] == [nid]
+
+    def test_claim_idle_entries(self, client):
+        s = client.get_stream("grp3")
+        s.create_group("g", from_id="0-0")
+        mid = s.add({"n": 1})
+        s.read_group("g", "dead-consumer")
+        time.sleep(0.05)
+        claimed = s.claim("g", "rescuer", 10, mid)
+        assert [i for i, _ in claimed] == [mid]
+        pr = s.pending_range("g")
+        assert pr[0]["consumer"] == "rescuer"
+        assert pr[0]["delivered"] == 2
+        # min_idle not reached -> no claim
+        assert s.claim("g", "again", 60_000, mid) == []
+
+    def test_auto_claim(self, client):
+        s = client.get_stream("grp4")
+        s.create_group("g", from_id="0-0")
+        ids = [s.add({"n": i}) for i in range(4)]
+        s.read_group("g", "dead")
+        time.sleep(0.05)
+        claimed = s.auto_claim("g", "live", 10, count=3)
+        assert [i for i, _ in claimed] == ids[:3]
+
+    def test_busygroup_and_nogroup(self, client):
+        s = client.get_stream("grp5")
+        s.create_group("g")
+        with pytest.raises(ValueError, match="BUSYGROUP"):
+            s.create_group("g")
+        with pytest.raises(ValueError, match="NOGROUP"):
+            s.read_group("missing", "c")
+        assert s.remove_group("g")
+        assert not s.remove_group("g")
+
+    def test_blocking_read(self, client):
+        s = client.get_stream("blk")
+        got = []
+
+        def reader():
+            got.extend(s.read(from_id="$", block_seconds=5.0))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.1)
+        s.add({"x": 42})
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert got and got[0][1] == {"x": 42}
+
+    def test_xinfo(self, client):
+        s = client.get_stream("info")
+        s.create_group("g")
+        s.add({"a": 1})
+        s.read_group("g", "c1")
+        groups = s.list_groups()
+        assert groups[0]["name"] == "g"
+        assert groups[0]["pending"] == 1
+        cons = s.list_consumers("g")
+        assert cons == [{"name": "c1", "pending": 1}]
+
+
+class TestReliableTopic:
+    def test_at_least_once_delivery(self, client):
+        rt = client.get_reliable_topic("rel")
+        rt.publish("before-subscribe")  # no listener yet: not replayed
+        got = []
+        rt.add_listener(lambda ch, msg: got.append(msg))
+        rt.publish("m1")
+        rt.publish("m2")
+        deadline = time.time() + 5
+        while time.time() < deadline and len(got) < 2:
+            time.sleep(0.02)
+        assert got == ["m1", "m2"]
+        assert rt.count_listeners() == 1
+
+    def test_two_listeners_both_receive(self, client):
+        rt = client.get_reliable_topic("rel2")
+        a, b = [], []
+        rt.add_listener(lambda ch, m: a.append(m))
+        rt.add_listener(lambda ch, m: b.append(m))
+        rt.publish("x")
+        deadline = time.time() + 5
+        while time.time() < deadline and (len(a) < 1 or len(b) < 1):
+            time.sleep(0.02)
+        assert a == ["x"] and b == ["x"]
