@@ -81,6 +81,11 @@ class RedissonTpuClient(CamelCompatMixin):
         self._engine.foreign_exists = self._grid.probe
         self._grid.foreign_exists = self._engine.probe
         self._topic_bus = TopicBus(n_threads=config.threads)
+        import threading
+
+        self._services_lock = threading.Lock()
+        self._executor_services: dict = {}
+        self._remote_services: dict = {}
         self._shutdown = False
 
     # -- sketch objects (TPU-backed north star) ----------------------------
@@ -203,8 +208,48 @@ class RedissonTpuClient(CamelCompatMixin):
     def get_priority_queue(self, name: str):
         return PriorityQueue(name, self)
 
+    def get_priority_blocking_queue(self, name: str):
+        from redisson_tpu.grid import PriorityBlockingQueue
+
+        return PriorityBlockingQueue(name, self)
+
+    def get_priority_deque(self, name: str):
+        from redisson_tpu.grid import PriorityDeque
+
+        return PriorityDeque(name, self)
+
+    def get_transfer_queue(self, name: str):
+        from redisson_tpu.grid import TransferQueue
+
+        return TransferQueue(name, self)
+
     def get_ring_buffer(self, name: str):
         return RingBuffer(name, self)
+
+    # -- geo / time-series -------------------------------------------------
+
+    def get_geo(self, name: str):
+        """→ RedissonClient#getGeo."""
+        from redisson_tpu.grid import Geo
+
+        return Geo(name, self)
+
+    def get_time_series(self, name: str):
+        """→ RedissonClient#getTimeSeries."""
+        from redisson_tpu.grid import TimeSeries
+
+        return TimeSeries(name, self)
+
+    def get_jcache(self, name: str, **config):
+        """→ org.redisson.jcache.JCache (JSR-107 facade)."""
+        from redisson_tpu.grid import JCache
+
+        return JCache(name, self, **config)
+
+    def get_cache_manager(self):
+        from redisson_tpu.grid import CacheManager
+
+        return CacheManager(self)
 
     # -- messaging ---------------------------------------------------------
 
@@ -259,6 +304,59 @@ class RedissonTpuClient(CamelCompatMixin):
 
     def get_rate_limiter(self, name: str):
         return RateLimiter(name, self)
+
+    # -- services ----------------------------------------------------------
+
+    def get_executor_service(self, name: str):
+        """→ RedissonClient#getExecutorService (register_workers(n) is the
+        RedissonNode analog).  Name-shared: every handle for ``name`` is
+        ONE service — workers registered through one handle run tasks
+        submitted through any other."""
+        from redisson_tpu.grid import ExecutorService
+
+        with self._services_lock:
+            svc = self._executor_services.get(name)
+            if svc is None or svc.is_shutdown():
+                svc = ExecutorService(name, self)
+                self._executor_services[name] = svc
+            return svc
+
+    def get_remote_service(self, name: str = "remote"):
+        """→ RedissonClient#getRemoteService.  Name-shared like
+        get_executor_service."""
+        from redisson_tpu.grid import RemoteService
+
+        with self._services_lock:
+            svc = self._remote_services.get(name)
+            if svc is None:
+                svc = RemoteService(name, self)
+                self._remote_services[name] = svc
+            return svc
+
+    def create_transaction(self):
+        """→ RedissonClient#createTransaction (optimistic)."""
+        from redisson_tpu.grid import Transaction
+
+        return Transaction(self)
+
+    def get_script(self):
+        """→ RedissonClient#getScript: named atomic procedures (the Lua
+        analog — Python callables run under the grid lock)."""
+        from redisson_tpu.grid import ScriptService
+
+        return ScriptService(self)
+
+    def get_live_object_service(self):
+        """→ RedissonClient#getLiveObjectService."""
+        from redisson_tpu.grid import LiveObjectService
+
+        return LiveObjectService(self)
+
+    def get_map_reduce(self, source_map, **options):
+        """→ RMap#mapReduce entry point."""
+        from redisson_tpu.grid import MapReduce
+
+        return MapReduce(self, source_map, **options)
 
     # -- batch + keys ------------------------------------------------------
 

@@ -15,5 +15,18 @@ and returns lazy results (the ``RFuture`` analog) that only synchronize on
 """
 
 from redisson_tpu.executor.tpu_executor import LazyResult, TpuCommandExecutor
+from redisson_tpu.executor.failures import (
+    DispatchTimeoutError,
+    KernelExecutionError,
+    RedissonTpuError,
+    RetryExhaustedError,
+)
 
-__all__ = ["LazyResult", "TpuCommandExecutor"]
+__all__ = [
+    "LazyResult",
+    "TpuCommandExecutor",
+    "RedissonTpuError",
+    "DispatchTimeoutError",
+    "KernelExecutionError",
+    "RetryExhaustedError",
+]

@@ -33,6 +33,7 @@ the batch's LazyResult.
 
 from __future__ import annotations
 
+import concurrent.futures
 import queue
 import threading
 import time
@@ -41,6 +42,12 @@ from concurrent.futures import Future
 from typing import Callable, Optional
 
 import numpy as np
+
+from redisson_tpu.executor.failures import (
+    DispatchTimeoutError,
+    KernelExecutionError,
+    RetryExhaustedError,
+)
 
 
 class _Segment:
@@ -73,7 +80,12 @@ class HintedFuture:
         # milliseconds.  Callers wanting a strict deadline pass their own.
         if not self._fut.done():
             self._c.flush_hint()
-        v = self._fut.result(timeout)
+        try:
+            v = self._fut.result(timeout)
+        except concurrent.futures.TimeoutError as e:
+            raise DispatchTimeoutError(
+                f"result not ready within {timeout}s"
+            ) from e
         return v if self._transform is None else self._transform(v)
 
     def get(self):
@@ -85,10 +97,16 @@ class HintedFuture:
 
 class BatchCoalescer:
     def __init__(self, *, batch_window_us: int, max_batch: int, metrics=None,
-                 max_inflight: int = 8):
+                 max_inflight: int = 8, retry_attempts: int = 3,
+                 retry_interval_s: float = 0.05):
         self.window_s = batch_window_us / 1e6
         self.max_batch = max_batch
         self.metrics = metrics
+        # RedisExecutor-style retry budget for dispatch-time failures
+        # (executor/failures.py): state is not consumed when the executor
+        # method raises synchronously, so re-dispatch is safe.
+        self.retry_attempts = max(1, retry_attempts)
+        self.retry_interval_s = retry_interval_s
         # Bounds dispatched-but-uncollected segments (see module docstring).
         self._inflight_sem = threading.BoundedSemaphore(max(1, max_inflight))
         # Queued segments in creation order (the flush order).  A segment
@@ -241,20 +259,39 @@ class BatchCoalescer:
                 c[0] if len(c) == 1 else np.concatenate(c)
                 for c in zip(*seg.chunks)
             ]
-            lazy = seg.dispatch(cols)
+            lazy = None
+            last_err: Optional[BaseException] = None
+            for attempt in range(self.retry_attempts):
+                try:
+                    lazy = seg.dispatch(cols)
+                    last_err = None
+                    break
+                except Exception as e:
+                    # Dispatch-time failure: pool state not consumed (the
+                    # executor method raised before returning) — retry
+                    # with backoff, the RedisExecutor loop shape.
+                    last_err = e
+                    if attempt + 1 < self.retry_attempts:
+                        time.sleep(self.retry_interval_s * (attempt + 1))
+            if last_err is not None:
+                raise RetryExhaustedError(self.retry_attempts, last_err)
             with self._lock:
                 # Dispatched (device-ordered): drain() may proceed even
                 # though result transfer is still in flight.
                 self._inflight -= 1
             self._completions.put((seg, lazy, t0))
-        except Exception as e:  # pragma: no cover - defensive
+        except Exception as e:
             with self._lock:
                 if self._inflight > 0:
                     self._inflight -= 1
             self._inflight_sem.release()
-            for fut, _, _ in seg.futures:
+            for fut, start, n in seg.futures:
                 if fut.set_running_or_notify_cancel():
-                    fut.set_exception(e)
+                    fut.set_exception(
+                        e
+                        if isinstance(e, RetryExhaustedError)
+                        else KernelExecutionError(seg.key, start, n, seg.nops, e)
+                    )
 
     def _complete_loop(self) -> None:
         while True:
@@ -270,14 +307,19 @@ class BatchCoalescer:
                         fut.set_result(
                             None if res is None else res[start : start + n]
                         )
-            except Exception as e:  # pragma: no cover - defensive
+            except Exception as e:
+                # Completion-time failure: the device batch died after
+                # donation — NOT retryable; attribute each caller's op
+                # range within the failed launch (partial-batch surface).
                 try:
                     self._inflight_sem.release()
                 except ValueError:
                     pass
-                for fut, _, _ in seg.futures:
+                for fut, start, n in seg.futures:
                     if fut.set_running_or_notify_cancel():
-                        fut.set_exception(e)
+                        fut.set_exception(
+                            KernelExecutionError(seg.key, start, n, seg.nops, e)
+                        )
             if self.metrics is not None:
                 self.metrics.record_batch(
                     nops=seg.nops,

@@ -1,0 +1,60 @@
+"""Executor-boundary failure semantics (SURVEY.md §5 failure row).
+
+The reference's RedisExecutor wraps every command in a retry state machine
+(``retryAttempts`` × ``retryInterval``, → org/redisson/command/
+RedisExecutor.java) and surfaces typed exceptions.  The TPU analog splits
+failures by WHERE they surface:
+
+- **Dispatch-time** (tracing/shape/compile errors raised synchronously by
+  the executor method): pool state was not consumed — safe to retry with
+  backoff.  Exhaustion raises ``RetryExhaustedError``.
+- **Completion-time** (device execution/transfer errors surfacing at
+  result collection): state buffers may already be donated/overwritten —
+  NOT retried; every affected op's future fails with a
+  ``KernelExecutionError`` that attributes the op range within the
+  segment (the partial-batch failure surface).
+- **Result-wait timeouts**: blocking on a future past its deadline raises
+  ``DispatchTimeoutError`` (the response-timeout of the reference's
+  batch options).
+"""
+
+from __future__ import annotations
+
+
+class RedissonTpuError(Exception):
+    """Base class for executor-boundary failures."""
+
+
+class DispatchTimeoutError(RedissonTpuError, TimeoutError):
+    """A blocking result wait exceeded its deadline."""
+
+
+class RetryExhaustedError(RedissonTpuError):
+    """Dispatch kept failing after the configured retry budget."""
+
+    def __init__(self, attempts: int, cause: BaseException):
+        super().__init__(
+            f"dispatch failed after {attempts} attempts: {cause!r}"
+        )
+        self.attempts = attempts
+        self.__cause__ = cause
+
+
+class KernelExecutionError(RedissonTpuError):
+    """A device batch failed at completion; carries the failed op range.
+
+    ``op_start``/``op_count`` locate THIS future's ops within the failed
+    segment (per-op attribution: callers learn exactly which of their ops
+    were in the doomed launch); ``segment_ops`` is the launch's total."""
+
+    def __init__(self, segment_key, op_start: int, op_count: int,
+                 segment_ops: int, cause: BaseException):
+        super().__init__(
+            f"device batch {segment_key!r} failed: ops "
+            f"[{op_start}, {op_start + op_count}) of {segment_ops} — {cause!r}"
+        )
+        self.segment_key = segment_key
+        self.op_start = op_start
+        self.op_count = op_count
+        self.segment_ops = segment_ops
+        self.__cause__ = cause

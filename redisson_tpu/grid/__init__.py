@@ -39,10 +39,16 @@ from redisson_tpu.grid.queues import (
     BlockingQueue,
     DelayedQueue,
     Deque,
+    PriorityBlockingQueue,
+    PriorityDeque,
     PriorityQueue,
     Queue,
     RingBuffer,
+    TransferQueue,
 )
+from redisson_tpu.grid.geo import Geo
+from redisson_tpu.grid.timeseries import TimeSeries
+from redisson_tpu.grid.jcache import CacheManager, JCache
 from redisson_tpu.grid.topics import PatternTopic, Topic
 from redisson_tpu.grid.locks import (
     CountDownLatch,
@@ -58,6 +64,15 @@ from redisson_tpu.grid.locks import (
 )
 from redisson_tpu.grid.keys import Keys
 from redisson_tpu.grid.batch import Batch, BatchResult
+from redisson_tpu.grid.services import (
+    ExecutorService,
+    LiveObjectService,
+    MapReduce,
+    RemoteService,
+    ScriptService,
+    Transaction,
+    TransactionException,
+)
 
 __all__ = [
     "GridStore",
@@ -68,10 +83,14 @@ __all__ = [
     "Stream", "ReliableTopic",
     "Set_", "SetCache", "List_", "SortedSet", "ScoredSortedSet", "LexSortedSet",
     "Queue", "Deque", "BlockingQueue", "BlockingDeque", "DelayedQueue",
-    "PriorityQueue", "RingBuffer",
+    "PriorityQueue", "PriorityBlockingQueue", "PriorityDeque",
+    "TransferQueue", "RingBuffer",
+    "Geo", "TimeSeries", "JCache", "CacheManager",
     "Topic", "PatternTopic",
     "Lock", "FairLock", "SpinLock", "FencedLock", "MultiLock",
     "ReadWriteLock", "Semaphore", "PermitExpirableSemaphore",
     "CountDownLatch", "RateLimiter",
     "Keys", "Batch", "BatchResult",
+    "ExecutorService", "RemoteService", "Transaction", "TransactionException",
+    "ScriptService", "LiveObjectService", "MapReduce",
 ]

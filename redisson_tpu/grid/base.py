@@ -109,3 +109,18 @@ class GridObject(CamelCompatMixin):
     @staticmethod
     def _new_value() -> Any:
         raise NotImplementedError
+
+    def __getattr__(self, item):
+        # RFuture idiom parity (→ every reference object's *Async twin):
+        # ``fooAsync``/``foo_async`` works for EVERY grid method — host
+        # ops complete immediately, so the future arrives resolved.
+        if item.endswith("_async") and not item.startswith("_"):
+            sync = getattr(self, item[: -len("_async")], None)
+            if callable(sync):
+                from redisson_tpu.objects.base import CompletedFuture
+
+                def async_form(*args, **kwargs):
+                    return CompletedFuture(sync(*args, **kwargs))
+
+                return async_form
+        return super().__getattr__(item)

@@ -110,6 +110,16 @@ class Batch:
         self._executed = True
         staged: list[tuple] = []  # (pending_future_or_None, BatchFuture)
         for obj, meth, args, kwargs, fut in self._ops:
+            # Sync-named sketch calls ride their deferred (async) forms so
+            # the whole batch coalesces into few device dispatches — the
+            # reference batch pipelines everything by construction
+            # (SURVEY.md §3.4); resolved values keep the sync contract.
+            deferred = getattr(type(obj), "_DEFERRED", {}).get(meth)
+            if deferred is not None:
+                staged.append(
+                    (getattr(obj, deferred)(*args, **kwargs), fut)
+                )
+                continue
             result = getattr(obj, meth)(*args, **kwargs)
             if meth.endswith("_async") and hasattr(result, "result"):
                 staged.append((result, fut))

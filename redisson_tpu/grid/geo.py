@@ -1,0 +1,171 @@
+"""RGeo — → org/redisson/RedissonGeo.java over GEOADD/GEODIST/GEOPOS/
+GEOSEARCH/GEOHASH (SURVEY.md §2.3 geo row).
+
+Members map to (longitude, latitude); distances use the haversine great-
+circle formula on the same Earth radius Redis uses (6372797.560856 m), so
+GEODIST parity holds to Redis's own precision class.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+from redisson_tpu.grid.base import GridObject
+
+_EARTH_M = 6372797.560856  # Redis's earth radius (meters)
+_UNITS = {"m": 1.0, "km": 1000.0, "mi": 1609.34, "ft": 0.3048}
+_BASE32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+
+
+def _haversine_m(lon1, lat1, lon2, lat2) -> float:
+    p1, p2 = math.radians(lat1), math.radians(lat2)
+    dp = p2 - p1
+    dl = math.radians(lon2 - lon1)
+    a = math.sin(dp / 2) ** 2 + math.cos(p1) * math.cos(p2) * math.sin(dl / 2) ** 2
+    return 2 * _EARTH_M * math.asin(math.sqrt(a))
+
+
+def _geohash(lon: float, lat: float, precision: int = 11) -> str:
+    """Standard base32 geohash (the GEOHASH reply shape)."""
+    lat_r = [-90.0, 90.0]
+    lon_r = [-180.0, 180.0]
+    bits = []
+    even = True
+    while len(bits) < precision * 5:
+        if even:
+            mid = (lon_r[0] + lon_r[1]) / 2
+            if lon >= mid:
+                bits.append(1)
+                lon_r[0] = mid
+            else:
+                bits.append(0)
+                lon_r[1] = mid
+        else:
+            mid = (lat_r[0] + lat_r[1]) / 2
+            if lat >= mid:
+                bits.append(1)
+                lat_r[0] = mid
+            else:
+                bits.append(0)
+                lat_r[1] = mid
+        even = not even
+    out = []
+    for i in range(0, len(bits), 5):
+        idx = 0
+        for b in bits[i : i + 5]:
+            idx = (idx << 1) | b
+        out.append(_BASE32[idx])
+    return "".join(out)
+
+
+class Geo(GridObject):
+    KIND = "geo"
+
+    @staticmethod
+    def _new_value():
+        return {}  # member bytes -> (lon, lat)
+
+    # -- writes ------------------------------------------------------------
+
+    def add(self, longitude: float, latitude: float, member: Any) -> int:
+        """→ RGeo#add: 1 if the member was new."""
+        if not (-180.0 <= longitude <= 180.0 and -85.05112878 <= latitude <= 85.05112878):
+            raise ValueError("coordinates out of range (GEOADD limits)")
+        with self._store.lock:
+            e = self._entry()
+            mb = self._enc(member)
+            new = mb not in e.value
+            e.value[mb] = (float(longitude), float(latitude))
+            return int(new)
+
+    def add_entries(self, *entries: tuple) -> int:
+        """add((lon, lat, member), ...) — returns count of new members."""
+        return sum(self.add(lon, lat, m) for lon, lat, m in entries)
+
+    def remove(self, member: Any) -> bool:
+        with self._store.lock:
+            e = self._entry(create=False)
+            return e is not None and e.value.pop(self._enc(member), None) is not None
+
+    # -- reads -------------------------------------------------------------
+
+    def pos(self, *members: Any) -> dict:
+        """→ RGeo#pos (GEOPOS): member -> (lon, lat), absent skipped."""
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return {}
+            out = {}
+            for m in members:
+                got = e.value.get(self._enc(m))
+                if got is not None:
+                    out[m] = got
+            return out
+
+    def dist(self, a: Any, b: Any, unit: str = "m") -> Optional[float]:
+        """→ RGeo#dist (GEODIST)."""
+        scale = _UNITS[unit]
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return None
+            pa = e.value.get(self._enc(a))
+            pb = e.value.get(self._enc(b))
+            if pa is None or pb is None:
+                return None
+            return _haversine_m(*pa, *pb) / scale
+
+    def hash(self, *members: Any) -> dict:
+        """→ RGeo#hash (GEOHASH)."""
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return {}
+            out = {}
+            for m in members:
+                got = e.value.get(self._enc(m))
+                if got is not None:
+                    out[m] = _geohash(*got)
+            return out
+
+    # -- search (GEOSEARCH) -------------------------------------------------
+
+    def search_radius(self, longitude: float, latitude: float, radius: float,
+                      unit: str = "m", count: Optional[int] = None,
+                      with_dist: bool = False):
+        """→ RGeo#search (BYRADIUS FROMLONLAT), nearest-first."""
+        limit_m = radius * _UNITS[unit]
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return []
+            hits = []
+            for mb, (lon, lat) in e.value.items():
+                d = _haversine_m(longitude, latitude, lon, lat)
+                if d <= limit_m:
+                    hits.append((d, mb))
+        hits.sort(key=lambda t: t[0])
+        if count is not None:
+            hits = hits[:count]
+        if with_dist:
+            return [(self._dec(mb), d / _UNITS[unit]) for d, mb in hits]
+        return [self._dec(mb) for _, mb in hits]
+
+    def search_radius_from_member(self, member: Any, radius: float,
+                                  unit: str = "m", count: Optional[int] = None,
+                                  with_dist: bool = False):
+        """→ RGeo#search (BYRADIUS FROMMEMBER)."""
+        with self._store.lock:
+            e = self._entry(create=False)
+            origin = None if e is None else e.value.get(self._enc(member))
+        if origin is None:
+            raise ValueError(f"member {member!r} has no position")
+        return self.search_radius(
+            origin[0], origin[1], radius, unit, count, with_dist
+        )
+
+    def size(self) -> int:
+        with self._store.lock:
+            e = self._entry(create=False)
+            return 0 if e is None else len(e.value)

@@ -1,0 +1,113 @@
+"""JCache — → org.redisson.jcache.* (JSR-107 javax.cache.Cache over a
+Redisson map, SURVEY.md §2.3 caching-standards row).
+
+JSR-107 contracts over the MapCache backing: ``put`` returns nothing,
+``remove`` returns whether a mapping was removed, ``get_and_put``/
+``get_and_remove`` return the previous value, iteration yields entries.
+A per-cache default expiry policy (creation TTL) stands in for the JSR
+ExpiryPolicy; per-entry TTL rides the MapCache machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+from redisson_tpu.grid.maps import MapCache
+
+
+class JCache(MapCache):
+    KIND = "mapcache"  # shares MapCache's keyspace semantics
+
+    def __init__(self, name: str, client, *,
+                 default_ttl_seconds: Optional[float] = None):
+        super().__init__(name, client)
+        self._default_ttl = default_ttl_seconds
+
+    # -- javax.cache.Cache surface -----------------------------------------
+
+    def get(self, key: Any) -> Any:
+        return super().get(key)
+
+    def put(self, key: Any, value: Any) -> None:
+        """JSR-107 put returns void."""
+        super().fast_put(key, value, ttl_seconds=self._default_ttl)
+
+    def get_and_put(self, key: Any, value: Any) -> Any:
+        return super().put(key, value, ttl_seconds=self._default_ttl)
+
+    def put_if_absent(self, key: Any, value: Any) -> bool:
+        """JSR-107 contract: True iff the value was set."""
+        return (
+            super().put_if_absent(key, value, ttl_seconds=self._default_ttl)
+            is None
+        )
+
+    def get_all(self, keys: Iterable[Any]) -> dict:
+        return super().get_all(keys)
+
+    def contains_key(self, key: Any) -> bool:
+        return super().contains_key(key)
+
+    def remove(self, key: Any, old_value: Any = None) -> bool:
+        """JSR-107: True iff a mapping was removed (2-arg form compares)."""
+        if old_value is None:
+            return super().fast_remove(key) > 0
+        return bool(super().remove(key, old_value))
+
+    def get_and_remove(self, key: Any) -> Any:
+        with self._store.lock:
+            prev = super().get(key)
+            super().fast_remove(key)
+            return prev
+
+    def replace(self, key: Any, value: Any) -> bool:
+        """JSR-107: True iff the key existed."""
+        with self._store.lock:
+            if not super().contains_key(key):
+                return False
+            super().fast_put(key, value, ttl_seconds=self._default_ttl)
+            return True
+
+    def remove_all(self, keys: Optional[Iterable[Any]] = None) -> None:
+        if keys is None:
+            super().clear()
+        else:
+            super().fast_remove(*list(keys))
+
+    def clear(self) -> None:
+        super().clear()
+
+    def __iter__(self):
+        return iter(super().entry_set())
+
+    def close(self) -> None:
+        """JSR-107 lifecycle no-op (in-process cache)."""
+
+    def is_closed(self) -> bool:
+        return False
+
+
+class CacheManager:
+    """→ javax.cache.CacheManager via Redisson's JCacheManager."""
+
+    def __init__(self, client):
+        self._client = client
+        self._caches: dict[str, JCache] = {}
+
+    def create_cache(self, name: str, **config) -> JCache:
+        cache = JCache(name, self._client, **config)
+        self._caches[name] = cache
+        return cache
+
+    def get_cache(self, name: str) -> Optional[JCache]:
+        if name in self._caches:
+            return self._caches[name]
+        return self.create_cache(name)
+
+    def destroy_cache(self, name: str) -> None:
+        cache = self._caches.pop(name, None)
+        if cache is not None:
+            cache.clear()
+
+    def get_cache_names(self) -> list:
+        return list(self._caches)

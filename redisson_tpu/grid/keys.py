@@ -10,6 +10,14 @@ import random
 from typing import Optional
 
 
+def _chunked_snapshot_iter(fetch, count: int):
+    """Shared SCAN-cursor shape: snapshot once, yield lazily in chunks."""
+    names = fetch()
+    step = max(1, count)
+    for i in range(0, len(names), step):
+        yield from names[i : i + step]
+
+
 class Keys:
     def __init__(self, client):
         self._client = client
@@ -23,6 +31,14 @@ class Keys:
         if pattern is not None:
             sketch = [n for n in sketch if fnmatch.fnmatchcase(n, pattern)]
         return names + sketch
+
+    def scan_iterator(self, pattern: Optional[str] = None, count: int = 10):
+        """→ RKeys#getKeysByPattern's SCAN-cursor idiom: lazy snapshot
+        iteration in ``count``-sized chunks (O(N) total — one keyspace
+        scan).  Guarantees (stronger than Redis SCAN): every key present
+        at iterator creation is yielded exactly once; keys created
+        mid-scan do not appear."""
+        return _chunked_snapshot_iter(lambda: self.get_keys(pattern), count)
 
     def count(self) -> int:
         """→ RKeys#count (DBSIZE)."""

@@ -231,6 +231,19 @@ class Map(GridObject):
                 for kb, slot in e.value.data.items()
             ]
 
+    def key_iterator(self, pattern: Optional[str] = None, count: int = 10):
+        """HSCAN-cursor idiom: lazy snapshot iteration in chunks (see
+        Keys.scan_iterator for the guarantee)."""
+        from redisson_tpu.grid.keys import _chunked_snapshot_iter
+
+        return _chunked_snapshot_iter(lambda: self.key_set(pattern), count)
+
+    def entry_iterator(self, count: int = 10):
+        for k in self.key_iterator(count=count):
+            v = self.get(k)
+            if v is not None:
+                yield (k, v)
+
     def read_all_map(self) -> dict:
         return dict(self.entry_set())
 

@@ -444,3 +444,199 @@ class RingBuffer(Queue):
         with self._store.lock:
             e = self._entry(create=False)
             return [] if e is None else [self._dec(vb) for vb in e.value["items"]]
+
+
+class PriorityBlockingQueue(PriorityQueue):
+    """→ RedissonPriorityBlockingQueue: natural-order poll with blocking
+    take/poll(timeout) parked on the store condition."""
+
+    KIND = "priorityqueue"
+
+    def poll(self, timeout_seconds: Optional[float] = None) -> Any:
+        if timeout_seconds is None:
+            return super().poll()
+        deadline = time.monotonic() + timeout_seconds
+        with self._store.cond:
+            while True:
+                v = super().poll()
+                if v is not None:
+                    return v
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._store.cond.wait(timeout=remaining)
+
+    def take(self) -> Any:
+        with self._store.cond:
+            while True:
+                v = super().poll()
+                if v is not None:
+                    return v
+                self._store.cond.wait(timeout=1.0)
+
+    def put(self, value: Any) -> None:
+        self.offer(value)
+
+
+class PriorityDeque(PriorityQueue):
+    """→ RedissonPriorityDeque: priority order with access to BOTH ends
+    (pollFirst = smallest, pollLast = largest)."""
+
+    KIND = "priorityqueue"
+
+    def poll_first(self) -> Any:
+        return self.poll()
+
+    def poll_last(self) -> Any:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None or not e.value:
+                return None
+            return self._dec(e.value.pop()[1])
+
+    def peek_first(self) -> Any:
+        return self.peek()
+
+    def peek_last(self) -> Any:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None or not e.value:
+                return None
+            return self._dec(e.value[-1][1])
+
+
+class TransferQueue(BlockingQueue):
+    """→ RedissonTransferQueue: ``transfer`` blocks the producer until a
+    consumer takes the element (the handoff contract); plain offer/poll
+    still behave like a queue.  Backing container is the SAME list shape
+    as Queue (inherited drain_to/read_all and friends must keep working);
+    pending transfers ride [bytes, marker] slots that every read path
+    decodes through ``_decode_slot``."""
+
+    KIND = "queue"
+
+    def _transfer_locked(self, value: Any, deadline: Optional[float]) -> bool:
+        """Caller holds the store cond.  Appends the offer, waits for a
+        consumer to take it; withdraws on timeout."""
+        e = self._entry()
+        slot = [self._enc(value), object()]
+        e.value.append(slot)
+        self._store.cond.notify_all()
+        while any(s is slot for s in e.value if isinstance(s, list)):
+            remaining = (
+                None if deadline is None else deadline - time.monotonic()
+            )
+            if remaining is not None and remaining <= 0:
+                try:
+                    e.value.remove(slot)  # withdraw the offer
+                except ValueError:
+                    return True  # taken between checks
+                return False
+            self._store.cond.wait(
+                timeout=1.0 if remaining is None else min(1.0, remaining)
+            )
+        return True
+
+    def transfer(self, value: Any, timeout_seconds: Optional[float] = None) -> bool:
+        """Blocks until a consumer removes the element; False on timeout
+        (the element is withdrawn, tryTransfer-with-timeout semantics)."""
+        deadline = (
+            None
+            if timeout_seconds is None
+            else time.monotonic() + timeout_seconds
+        )
+        with self._store.cond:
+            return self._transfer_locked(value, deadline)
+
+    def _waiting_count(self, delta: int = 0) -> int:
+        """Waiting-consumer count shared across every handle of this queue
+        (kept on the store, keyed by name — handle-local state would make
+        hasWaitingConsumer lie between handles)."""
+        reg = self._store.__dict__.setdefault("_tq_waiting", {})
+        reg[self._name] = reg.get(self._name, 0) + delta
+        return reg[self._name]
+
+    def try_transfer(self, value: Any) -> bool:
+        """Immediate handoff: succeeds only if a consumer is waiting AT
+        the moment of the call.  The waiting-check and the offer happen
+        under ONE cond hold (no check-then-act gap); the short grace wait
+        only covers the woken consumer's re-acquisition of the lock."""
+        with self._store.cond:
+            if self._waiting_count() <= 0:
+                return False
+            return self._transfer_locked(
+                value, time.monotonic() + 1.0
+            )
+
+    def _decode_slot(self, raw):
+        return self._dec(raw[0] if isinstance(raw, list) else raw)
+
+    def poll(self, timeout_seconds: Optional[float] = None) -> Any:
+        deadline = (
+            None
+            if timeout_seconds is None
+            else time.monotonic() + timeout_seconds
+        )
+        with self._store.cond:
+            self._waiting_count(+1)
+            try:
+                while True:
+                    e = self._entry(create=False)
+                    if e is not None and e.value:
+                        raw = e.value.pop(0)
+                        self._store.cond.notify_all()  # wake transferers
+                        return self._decode_slot(raw)
+                    if deadline is None:
+                        return None
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._store.cond.wait(timeout=min(1.0, remaining))
+            finally:
+                self._waiting_count(-1)
+
+    def take(self) -> Any:
+        with self._store.cond:
+            self._waiting_count(+1)
+            try:
+                while True:
+                    e = self._entry(create=False)
+                    if e is not None and e.value:
+                        raw = e.value.pop(0)
+                        self._store.cond.notify_all()
+                        return self._decode_slot(raw)
+                    self._store.cond.wait(timeout=1.0)
+            finally:
+                self._waiting_count(-1)
+
+    def peek(self) -> Any:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None or not e.value:
+                return None
+            return self._decode_slot(e.value[0])
+
+    def read_all(self) -> list:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return []
+            return [self._decode_slot(raw) for raw in e.value]
+
+    def drain_to(self, collection: list, max_elements: Optional[int] = None) -> int:
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return 0
+            n = len(e.value) if max_elements is None else min(
+                max_elements, len(e.value)
+            )
+            for _ in range(n):
+                collection.append(self._decode_slot(e.value.pop(0)))
+            if n:
+                self._store.cond.notify_all()
+            return n
+
+    def has_waiting_consumer(self) -> bool:
+        with self._store.lock:
+            return self._waiting_count() > 0

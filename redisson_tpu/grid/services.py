@@ -1,0 +1,573 @@
+"""Coordination services — the reference's service layer (SURVEY.md §2.3
+services row):
+
+- ``ExecutorService`` → org/redisson/executor/ (RExecutorService +
+  RScheduledExecutorService): tasks serialize into a grid queue; worker
+  threads (the RedissonNode analog) poll and execute; futures resolve
+  through a per-task response slot.
+- ``RemoteService`` → org/redisson/remote/ (RRemoteService): method
+  invocations ride a request queue to a registered implementation;
+  responses return on per-invocation channels with ack semantics.
+- ``Transaction`` → org/redisson/transaction/ (RTransaction): optimistic
+  — reads collect a validation set, writes buffer in an operation log,
+  commit validates + applies atomically under the store lock.
+- ``ScriptService`` → RScript/RFunction: named procedures executed
+  ATOMICALLY against the grid (the Lua-atomicity analog; procedures are
+  Python callables — there is no Lua VM here, by design).
+- ``LiveObjectService`` → org/redisson/liveobject/: attribute-mapped
+  proxies whose fields live in an RMap.
+- ``MapReduce`` → org/redisson/mapreduce/: mapper/reducer over map
+  entries fanned out on the executor service's workers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+from redisson_tpu.objects.base import CamelCompatMixin
+
+_MISSING = object()
+
+
+class TaskFuture:
+    """RExecutorFuture analog."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self._cancelled = False
+
+    def _resolve(self, value=None, error=None):
+        self._value = value
+        self._error = error
+        self._event.set()
+
+    def cancel(self) -> bool:
+        if self._event.is_set():
+            return False
+        self._cancelled = True
+        self._event.set()
+        return True
+
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("task result not ready")
+        if self._cancelled:
+            raise RuntimeError("task was cancelled")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    get = result
+
+
+class ExecutorService(CamelCompatMixin):
+    """→ RExecutorService / RScheduledExecutorService.
+
+    Tasks are (callable, args, kwargs) tuples on a named in-process queue;
+    ``register_workers(n)`` is the RedissonNode analog — without workers,
+    tasks queue but never run (exactly the reference's model where a
+    separate worker JVM polls the task queue)."""
+
+    def __init__(self, name: str, client):
+        self._name = name
+        self._client = client
+        self._tasks: "list[tuple]" = []
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._workers: list[threading.Thread] = []
+        self._futures: dict[str, TaskFuture] = {}
+        self._shutdown = False
+        self._timer: Optional[threading.Thread] = None
+        self._scheduled: list[tuple] = []  # (fire_at, period|None, task)
+        self._periodic: set[str] = set()  # futures stay open for cancel()
+
+    def get_name(self) -> str:
+        return self._name
+
+    # -- submission (→ RExecutorService#submit) -----------------------------
+
+    def submit(self, fn: Callable, *args, **kwargs) -> TaskFuture:
+        fut = TaskFuture()
+        task_id = uuid.uuid4().hex
+        with self._cond:
+            if self._shutdown:
+                raise RuntimeError("executor service is shut down")
+            self._futures[task_id] = fut
+            self._tasks.append((task_id, fn, args, kwargs))
+            self._cond.notify()
+        return fut
+
+    def execute(self, fn: Callable, *args, **kwargs) -> None:
+        """→ RExecutorService#execute (fire-and-forget)."""
+        self.submit(fn, *args, **kwargs)
+
+    # -- scheduling (→ RScheduledExecutorService) ---------------------------
+
+    def schedule(self, fn: Callable, delay_seconds: float, *args, **kwargs) -> TaskFuture:
+        fut = TaskFuture()
+        task_id = uuid.uuid4().hex
+        with self._cond:
+            if self._shutdown:
+                raise RuntimeError("executor service is shut down")
+            self._futures[task_id] = fut
+            self._scheduled.append(
+                (time.monotonic() + delay_seconds, None, (task_id, fn, args, kwargs))
+            )
+            self._ensure_timer()
+        return fut
+
+    def schedule_at_fixed_rate(self, fn: Callable, initial_delay_seconds: float,
+                               period_seconds: float, *args, **kwargs) -> TaskFuture:
+        """Returns a future usable only for cancel() (like the reference's
+        scheduled future for periodic tasks)."""
+        fut = TaskFuture()
+        task_id = uuid.uuid4().hex
+        with self._cond:
+            if self._shutdown:
+                raise RuntimeError("executor service is shut down")
+            self._futures[task_id] = fut
+            self._periodic.add(task_id)
+            self._scheduled.append(
+                (
+                    time.monotonic() + initial_delay_seconds,
+                    period_seconds,
+                    (task_id, fn, args, kwargs),
+                )
+            )
+            self._ensure_timer()
+        return fut
+
+    def _ensure_timer(self) -> None:
+        if self._timer is None or not self._timer.is_alive():
+            self._timer = threading.Thread(
+                target=self._timer_loop, name="rtpu-exec-timer", daemon=True
+            )
+            self._timer.start()
+
+    def _timer_loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._shutdown:
+                    return
+                now = time.monotonic()
+                due = [s for s in self._scheduled if s[0] <= now]
+                self._scheduled = [s for s in self._scheduled if s[0] > now]
+                for fire_at, period, task in due:
+                    fut = self._futures.get(task[0])
+                    if fut is not None and fut.cancelled():
+                        continue
+                    self._tasks.append(task)
+                    if period is not None:
+                        self._scheduled.append((fire_at + period, period, task))
+                if due:
+                    self._cond.notify_all()
+            time.sleep(0.02)
+
+    # -- workers (→ RedissonNode / TasksRunnerService) ----------------------
+
+    def register_workers(self, n: int = 1) -> None:
+        for _ in range(n):
+            t = threading.Thread(
+                target=self._worker_loop, name=f"rtpu-exec-{self._name}",
+                daemon=True,
+            )
+            self._workers.append(t)
+            t.start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._tasks and not self._shutdown:
+                    self._cond.wait(timeout=0.5)
+                if self._shutdown and not self._tasks:
+                    return
+                task_id, fn, args, kwargs = self._tasks.pop(0)
+            fut = self._futures.get(task_id)
+            if fut is not None and fut.cancelled():
+                self._futures.pop(task_id, None)
+                continue
+            # Periodic tasks keep their future OPEN (it exists for
+            # cancel(), like the reference's scheduled future).
+            resolve = fut is not None and task_id not in self._periodic
+            try:
+                value = fn(*args, **kwargs)
+                if resolve and not fut.done():
+                    fut._resolve(value=value)
+            except BaseException as e:  # task errors resolve the future
+                if resolve and not fut.done():
+                    fut._resolve(error=e)
+            finally:
+                if resolve:  # one-shot futures leave the table once run
+                    self._futures.pop(task_id, None)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def get_task_count(self) -> int:
+        with self._lock:
+            return len(self._tasks)
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def is_shutdown(self) -> bool:
+        return self._shutdown
+
+
+class RemoteService(CamelCompatMixin):
+    """→ RRemoteService: request-queue RPC between a registered
+    implementation and proxies (get())."""
+
+    def __init__(self, name: str, client):
+        self._name = name
+        self._client = client
+        self._impls: dict[str, tuple] = {}  # iface -> (impl, executor)
+        self._lock = threading.Lock()
+
+    def register(self, iface: str, impl: Any, workers: int = 1) -> None:
+        """→ RRemoteService#register(Class, T, workers)."""
+        ex = ExecutorService(f"{self._name}:{iface}:workers", self._client)
+        ex.register_workers(workers)
+        with self._lock:
+            self._impls[iface] = (impl, ex)
+
+    def deregister(self, iface: str) -> None:
+        with self._lock:
+            got = self._impls.pop(iface, None)
+        if got is not None:
+            got[1].shutdown()
+
+    def get(self, iface: str, timeout_seconds: float = 30.0):
+        """→ RRemoteService#get: sync proxy; raises if no impl answers
+        within the ack timeout."""
+        service = self
+
+        class _Proxy(CamelCompatMixin):
+            def __getattr__(self, method):
+                def call(*args, **kwargs):
+                    with service._lock:
+                        got = service._impls.get(iface)
+                    if got is None:
+                        raise RuntimeError(
+                            f"no workers registered for {iface!r}"
+                        )
+                    impl, ex = got
+                    fut = ex.submit(getattr(impl, method), *args, **kwargs)
+                    return fut.result(timeout_seconds)
+
+                return call
+
+        return _Proxy()
+
+    def get_async(self, iface: str):
+        """Async proxy: calls return TaskFutures."""
+        service = self
+
+        class _AsyncProxy(CamelCompatMixin):
+            def __getattr__(self, method):
+                def call(*args, **kwargs):
+                    with service._lock:
+                        got = service._impls.get(iface)
+                    if got is None:
+                        raise RuntimeError(
+                            f"no workers registered for {iface!r}"
+                        )
+                    impl, ex = got
+                    return ex.submit(getattr(impl, method), *args, **kwargs)
+
+                return call
+
+        return _AsyncProxy()
+
+
+class TransactionException(RuntimeError):
+    """→ org.redisson.transaction.TransactionException."""
+
+
+class Transaction(CamelCompatMixin):
+    """→ RTransaction (optimistic): reads collect a validation snapshot,
+    writes buffer in an operation log; commit() validates every read
+    under the store lock and applies the log atomically, raising
+    TransactionException when a concurrent writer invalidated a read."""
+
+    def __init__(self, client):
+        self._client = client
+        self._store = client._grid
+        self._reads: dict[tuple, Any] = {}  # (name, key_bytes|None) -> snapshot
+        self._writes: list[tuple] = []  # (apply_fn,)
+        self._done = False
+
+    # -- transactional facades ---------------------------------------------
+
+    def get_bucket(self, name: str):
+        return _TxBucket(self, name)
+
+    def get_map(self, name: str):
+        return _TxMap(self, name)
+
+    # -- commit/rollback -----------------------------------------------------
+
+    def _check_open(self):
+        if self._done:
+            raise TransactionException("transaction already completed")
+
+    def commit(self) -> None:
+        self._check_open()
+        self._done = True
+        with self._store.lock:
+            for (name, kb), snapshot in self._reads.items():
+                cur = self._current(name, kb)
+                if cur != snapshot:
+                    raise TransactionException(
+                        f"read of {name!r} invalidated by a concurrent write"
+                    )
+            for apply_fn in self._writes:
+                apply_fn()
+            self._store.cond.notify_all()
+
+    def rollback(self) -> None:
+        self._check_open()
+        self._done = True
+        self._reads.clear()
+        self._writes.clear()
+
+    def _current(self, name: str, kb: Optional[bytes]):
+        e = self._store.get_entry(name)
+        if e is None:
+            return None
+        if kb is None:
+            return e.value
+        slot = e.value.live(kb) if hasattr(e.value, "live") else None
+        return None if slot is None else slot[0]
+
+
+class _TxBucket:
+    def __init__(self, tx: Transaction, name: str):
+        self._tx = tx
+        self._name = name
+        self._codec = tx._client.config.codec
+        self._local: Any = _MISSING
+
+    def get(self):
+        self._tx._check_open()
+        if self._local is not _MISSING:
+            return None if self._local is None else self._codec.decode(self._local)
+        with self._tx._store.lock:
+            e = self._tx._store.get_entry(self._name, "bucket")
+            snapshot = None if e is None else e.value
+            self._tx._reads[(self._name, None)] = snapshot
+            return None if snapshot is None else self._codec.decode(snapshot)
+
+    def set(self, value) -> None:
+        self._tx._check_open()
+        vb = self._codec.encode(value)
+        self._local = vb
+        store, name = self._tx._store, self._name
+
+        def apply():
+            store.put_entry(name, "bucket", vb)
+
+        self._tx._writes.append(apply)
+
+    def delete(self) -> None:
+        self._tx._check_open()
+        self._local = None
+        store, name = self._tx._store, self._name
+        self._tx._writes.append(lambda: store.delete(name))
+
+
+class _TxMap:
+    def __init__(self, tx: Transaction, name: str):
+        self._tx = tx
+        self._name = name
+        self._codec = tx._client.config.codec
+        self._local: dict[bytes, Any] = {}
+
+    def get(self, key):
+        self._tx._check_open()
+        kb = self._codec.encode_key(key)
+        if kb in self._local:
+            vb = self._local[kb]
+            return None if vb is None else self._codec.decode(vb)
+        with self._tx._store.lock:
+            cur = self._tx._current(self._name, kb)
+            self._tx._reads[(self._name, kb)] = cur
+            return None if cur is None else self._codec.decode(cur)
+
+    def put(self, key, value) -> None:
+        self._tx._check_open()
+        kb = self._codec.encode_key(key)
+        vb = self._codec.encode(value)
+        self._local[kb] = vb
+        tx, name = self._tx, self._name
+
+        def apply():
+            from redisson_tpu.grid.maps import _MapValue
+
+            e = tx._store.ensure_entry(name, "map", _MapValue)
+            e.value.data[kb] = [vb, None, None, time.time()]
+
+        self._tx._writes.append(apply)
+
+    def remove(self, key) -> None:
+        self._tx._check_open()
+        kb = self._codec.encode_key(key)
+        self._local[kb] = None
+        tx, name = self._tx, self._name
+
+        def apply():
+            e = tx._store.get_entry(name, "map")
+            if e is not None:
+                e.value.data.pop(kb, None)
+
+        self._tx._writes.append(apply)
+
+
+class ScriptService(CamelCompatMixin):
+    """→ RScript/RFunction analog: named procedures run ATOMICALLY against
+    the grid (under the store lock — the Lua-script atomicity contract).
+    Procedures are Python callables ``fn(client, keys, args)`` registered
+    in-process; there is deliberately no Lua VM."""
+
+    def __init__(self, client):
+        self._client = client
+        self._fns: dict[str, Callable] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, fn: Callable) -> None:
+        """→ SCRIPT LOAD (returns nothing; the name is the sha analog)."""
+        with self._lock:
+            self._fns[name] = fn
+
+    def eval(self, name: str, keys: list = (), args: list = ()):
+        """→ RScript#eval(EVALSHA): atomic w.r.t. every other grid op."""
+        with self._lock:
+            fn = self._fns.get(name)
+        if fn is None:
+            raise KeyError(f"NOSCRIPT: {name!r} is not registered")
+        with self._client._grid.lock:
+            out = fn(self._client, list(keys), list(args))
+            self._client._grid.cond.notify_all()
+            return out
+
+
+class LiveObjectService(CamelCompatMixin):
+    """→ RLiveObjectService: instances whose attributes live in an RMap
+    named ``{class}:{id}`` — every attribute read/write is a map op, so
+    state is shared across handles (the @REntity/@RId proxy pattern)."""
+
+    def __init__(self, client):
+        self._client = client
+
+    def _map_for(self, cls_name: str, rid) -> Any:
+        return self._client.get_map(f"live:{cls_name}:{rid}")
+
+    def persist(self, obj: Any, rid=None) -> "LiveProxy":
+        """Store a plain object's __dict__ and return its live proxy."""
+        cls_name = type(obj).__name__
+        rid = rid if rid is not None else getattr(obj, "id", None)
+        if rid is None:
+            raise ValueError("live object needs an 'id' attribute or rid=")
+        m = self._map_for(cls_name, rid)
+        for k, v in vars(obj).items():
+            m.fast_put(k, v)
+        return LiveProxy(self._client, cls_name, rid)
+
+    def get(self, cls_or_name, rid) -> "LiveProxy":
+        name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
+        return LiveProxy(self._client, name, rid)
+
+    def delete(self, cls_or_name, rid) -> bool:
+        name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
+        return self._map_for(name, rid).delete()
+
+    def exists(self, cls_or_name, rid) -> bool:
+        name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
+        return self._map_for(name, rid).is_exists()
+
+
+class LiveProxy:
+    """Attribute-mapped live object (the ByteBuddy proxy analog)."""
+
+    def __init__(self, client, cls_name: str, rid):
+        object.__setattr__(self, "_map", client.get_map(f"live:{cls_name}:{rid}"))
+        object.__setattr__(self, "_cls_name", cls_name)
+        object.__setattr__(self, "_rid", rid)
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return self._map.get(item)
+
+    def __setattr__(self, item, value):
+        self._map.fast_put(item, value)
+
+    def __delattr__(self, item):
+        self._map.fast_remove(item)
+
+
+class MapReduce(CamelCompatMixin):
+    """→ RMapReduce: mapper over a Map's entries, grouped shuffle, reducer
+    per key — fanned out over an ExecutorService's workers in chunks."""
+
+    def __init__(self, client, source_map, *, workers: int = 4,
+                 chunk_size: int = 256):
+        self._client = client
+        self._source = source_map
+        self._mapper: Optional[Callable] = None
+        self._reducer: Optional[Callable] = None
+        self._workers = workers
+        self._chunk = chunk_size
+
+    def mapper(self, fn: Callable) -> "MapReduce":
+        """``fn(key, value) -> iterable[(k2, v2)]``."""
+        self._mapper = fn
+        return self
+
+    def reducer(self, fn: Callable) -> "MapReduce":
+        """``fn(k2, values) -> result``."""
+        self._reducer = fn
+        return self
+
+    def execute(self) -> dict:
+        if self._mapper is None or self._reducer is None:
+            raise RuntimeError("mapper and reducer must both be set")
+        entries = self._source.entry_set()
+        ex = ExecutorService("mapreduce", self._client)
+        ex.register_workers(self._workers)
+        try:
+            chunks = [
+                entries[i : i + self._chunk]
+                for i in range(0, len(entries), self._chunk)
+            ]
+
+            def run_chunk(chunk):
+                out = []
+                for k, v in chunk:
+                    out.extend(self._mapper(k, v))
+                return out
+
+            futs = [ex.submit(run_chunk, c) for c in chunks]
+            shuffled: dict[Any, list] = {}
+            for f in futs:
+                for k2, v2 in f.result(60.0):
+                    shuffled.setdefault(k2, []).append(v2)
+            rfuts = {
+                k2: ex.submit(self._reducer, k2, vals)
+                for k2, vals in shuffled.items()
+            }
+            return {k2: f.result(60.0) for k2, f in rfuts.items()}
+        finally:
+            ex.shutdown()

@@ -30,7 +30,11 @@ class CamelCompatMixin:
             snake = camel_to_snake(item)
             if snake != item:
                 try:
-                    return object.__getattribute__(self, snake)
+                    # getattr (not object.__getattribute__) so snake-case
+                    # names served by a subclass __getattr__ — e.g. the
+                    # synthesized *_async forms — resolve for camelCase too
+                    # (putAsync → put_async).  No recursion: snake != item.
+                    return getattr(self, snake)
                 except AttributeError:
                     pass
         raise AttributeError(
@@ -38,10 +42,49 @@ class CamelCompatMixin:
         )
 
 
+class MappedFuture:
+    """Future adapter applying a transform on .result() — used by the
+    deferred (batch-pipelined) forms of sync-named methods."""
+
+    def __init__(self, fut, transform):
+        self._fut = fut
+        self._transform = transform
+
+    def result(self, *a, **kw):
+        return self._transform(self._fut.result(*a, **kw))
+
+    get = result
+
+    def done(self):
+        return self._fut.done()
+
+
+class CompletedFuture:
+    """Already-resolved future (RFuture parity for host-grid ops)."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self, *a, **kw):
+        return self._value
+
+    get = result
+
+    @staticmethod
+    def done():
+        return True
+
+
 class RObject(CamelCompatMixin):
-    """Name-addressed object bound to a client engine."""
+    """Name-addressed object bound to a client engine.
+
+    ``_DEFERRED`` maps sync-named methods to attributes returning a future
+    whose resolved value matches the SYNC return contract — the batch
+    facade routes queued sync calls through these so a natural batch
+    pipelines instead of executing sequentially (SURVEY.md §3.4)."""
 
     KIND: str = ""
+    _DEFERRED: dict = {}
 
     def __init__(self, name: str, client):
         self._name = name

@@ -17,6 +17,12 @@ from redisson_tpu.tenancy import PoolKind
 class BitSet(RObject):
     KIND = PoolKind.BITSET
 
+    # Batch pipelining (SURVEY.md §3.4).
+    _DEFERRED = {
+        "set_many": "set_many_async",
+        "get_many": "get_many_async",
+    }
+
     # -- single/batch bit ops ---------------------------------------------
 
     def get(self, index: int) -> bool:

@@ -11,12 +11,36 @@ from __future__ import annotations
 
 import numpy as np
 
-from redisson_tpu.objects.base import RObject
+from redisson_tpu.objects.base import MappedFuture, RObject
 from redisson_tpu.tenancy import PoolKind
 
 
 class BloomFilter(RObject):
     KIND = PoolKind.BLOOM
+
+    # Batch pipelining: sync-named calls ride these async forms inside
+    # Batch.execute (resolved values match the sync contracts).
+    _DEFERRED = {
+        "add": "add_deferred",
+        "add_all": "add_all_deferred",
+        "contains": "contains_deferred",
+        "contains_all": "contains_all_deferred",
+        "contains_each": "contains_all_async",
+    }
+
+    def add_deferred(self, obj):
+        return MappedFuture(self.add_all_async([obj]), lambda v: bool(v[0]))
+
+    def add_all_deferred(self, objs):
+        return MappedFuture(self.add_all_async(objs), lambda v: int(np.sum(v)))
+
+    def contains_deferred(self, obj):
+        return MappedFuture(self.contains_all_async([obj]), lambda v: bool(v[0]))
+
+    def contains_all_deferred(self, objs):
+        return MappedFuture(
+            self.contains_all_async(objs), lambda v: int(np.sum(v))
+        )
 
     # -- lifecycle ---------------------------------------------------------
 

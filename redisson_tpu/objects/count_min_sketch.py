@@ -24,6 +24,30 @@ from redisson_tpu.tenancy import PoolKind
 class CountMinSketch(RObject):
     KIND = PoolKind.CMS
 
+    # Batch pipelining (SURVEY.md §3.4).
+    _DEFERRED = {
+        "add": "add_deferred",
+        "add_all": "add_all_async",
+        "estimate": "estimate_deferred",
+        "estimate_all": "estimate_all_async",
+    }
+
+    def add_deferred(self, obj, count: int = 1):
+        from redisson_tpu.objects.base import MappedFuture
+
+        return MappedFuture(
+            self.add_all_async([obj], [count]), lambda v: int(v[0])
+        )
+
+    def estimate_deferred(self, obj):
+        from redisson_tpu.objects.base import MappedFuture
+
+        return MappedFuture(self.estimate_all_async([obj]), lambda v: int(v[0]))
+
+    def estimate_all_async(self, objs):
+        H1, H2 = self._hash128(objs)
+        return self._engine.cms_estimate(self._name, H1, H2)
+
     # -- lifecycle ---------------------------------------------------------
 
     def try_init(self, depth: int, width: int, track_top_k: int = 0) -> bool:
@@ -115,8 +139,7 @@ class CountMinSketch(RObject):
         return int(self.estimate_all([obj])[0])
 
     def estimate_all(self, objs) -> np.ndarray:
-        H1, H2 = self._hash128(objs)
-        return self._engine.cms_estimate(self._name, H1, H2).result()
+        return self.estimate_all_async(objs).result()
 
     def merge(self, *other_names: str) -> None:
         self._engine.cms_merge(self._name, other_names)

@@ -150,6 +150,8 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 max_batch=config.tpu_sketch.max_batch,
                 metrics=self.metrics,
                 max_inflight=config.tpu_sketch.max_inflight,
+                retry_attempts=config.retry_attempts,
+                retry_interval_s=config.retry_interval_ms / 1000.0,
             )
         # Checkpoint/resume (SURVEY.md §5): restore device state from the
         # configured snapshot dir, then arm periodic snapshots.

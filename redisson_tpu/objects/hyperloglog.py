@@ -15,6 +15,22 @@ from redisson_tpu.tenancy import PoolKind
 class HyperLogLog(RObject):
     KIND = PoolKind.HLL
 
+    # Batch pipelining (SURVEY.md §3.4): sync-named adds coalesce.
+    _DEFERRED = {
+        "add": "add_deferred",
+        "add_all": "add_deferred_all",
+    }
+
+    def add_deferred(self, obj):
+        from redisson_tpu.objects.base import MappedFuture
+
+        return MappedFuture(self.add_async(obj), bool)
+
+    def add_deferred_all(self, objs):
+        from redisson_tpu.objects.base import MappedFuture
+
+        return MappedFuture(self.add_all_async(objs), bool)
+
     def add(self, obj) -> bool:
         """→ RHyperLogLog#add: True iff the estimate changed (a register
         grew)."""

@@ -1,0 +1,407 @@
+"""RESP front door — the serving layer of SURVEY.md §2.4 (comm row):
+a RESP2 TCP server over the client engine, so existing Redis clients
+(redis-cli, redis-py, a stock Redisson) can drive the framework's
+keyspace and sketch objects without the Python API.
+
+Command surface (the subset the north-star objects + grid need):
+  PING ECHO  GET SET DEL EXISTS EXPIRE PEXPIRE TTL PTTL PERSIST
+  SETBIT GETBIT BITCOUNT BITPOS
+  PFADD PFCOUNT PFMERGE
+  BF.RESERVE BF.ADD BF.MADD BF.EXISTS BF.MEXISTS   (RedisBloom shape)
+  CMS.INITBYDIM CMS.INCRBY CMS.QUERY               (RedisBloom CMS shape)
+  LPUSH RPUSH LPOP RPOP LLEN
+  HSET HGET HDEL HLEN
+  KEYS DBSIZE FLUSHALL
+
+Values travel as raw bytes (RESP bulk strings) through a ByteArray-style
+codec boundary: what a foreign client SETs is exactly what it GETs.
+One thread per connection (the serving pool analog); all state lives in
+the embedded RedissonTpuClient.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+
+class RespError(Exception):
+    pass
+
+
+def _encode_simple(s: str) -> bytes:
+    return b"+" + s.encode() + b"\r\n"
+
+
+def _encode_error(s: str) -> bytes:
+    return b"-ERR " + s.encode() + b"\r\n"
+
+
+def _encode_int(n: int) -> bytes:
+    return b":" + str(int(n)).encode() + b"\r\n"
+
+
+def _encode_bulk(v) -> bytes:
+    if v is None:
+        return b"$-1\r\n"
+    if isinstance(v, str):
+        v = v.encode()
+    return b"$" + str(len(v)).encode() + b"\r\n" + v + b"\r\n"
+
+
+def _encode_array(items) -> bytes:
+    out = b"*" + str(len(items)).encode() + b"\r\n"
+    for it in items:
+        if isinstance(it, int):
+            out += _encode_int(it)
+        else:
+            out += _encode_bulk(it)
+    return out
+
+
+class _Reader:
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._buf = b""
+
+    def _read_line(self) -> Optional[bytes]:
+        while b"\r\n" not in self._buf:
+            data = self._sock.recv(65536)
+            if not data:
+                return None
+            self._buf += data
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> Optional[bytes]:
+        while len(self._buf) < n + 2:
+            data = self._sock.recv(65536)
+            if not data:
+                return None
+            self._buf += data
+        out, self._buf = self._buf[:n], self._buf[n + 2 :]
+        return out
+
+    def read_command(self) -> Optional[list[bytes]]:
+        line = self._read_line()
+        if line is None:
+            return None
+        if not line.startswith(b"*"):
+            # inline command (redis-cli fallback)
+            return line.split()
+        n = int(line[1:])
+        args = []
+        for _ in range(n):
+            hdr = self._read_line()
+            if hdr is None or not hdr.startswith(b"$"):
+                return None
+            size = int(hdr[1:])
+            data = self._read_exact(size)
+            if data is None:
+                return None
+            args.append(data)
+        return args
+
+
+class RespServer:
+    """Embedded RESP2 endpoint over a RedissonTpuClient."""
+
+    def __init__(self, client, host: str = "127.0.0.1", port: int = 0):
+        self._client = client
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+        self._closed = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="rtpu-resp-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="rtpu-resp-conn", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        reader = _Reader(conn)
+        try:
+            while True:
+                cmd = reader.read_command()
+                if cmd is None:
+                    return
+                try:
+                    reply = self._dispatch(cmd)
+                except RespError as e:
+                    reply = _encode_error(str(e))
+                except Exception as e:  # command errors never kill the conn
+                    reply = _encode_error(f"{type(e).__name__}: {e}")
+                conn.sendall(reply)
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- command dispatch ---------------------------------------------------
+
+    def _dispatch(self, cmd: list[bytes]) -> bytes:
+        name = cmd[0].decode().upper()
+        handler = getattr(self, "_cmd_" + name.replace(".", "_"), None)
+        if handler is None:
+            raise RespError(f"unknown command '{name}'")
+        return handler([c for c in cmd[1:]])
+
+    @staticmethod
+    def _s(b: bytes) -> str:
+        return b.decode()
+
+    # connection/admin
+
+    def _cmd_PING(self, args):
+        return _encode_simple("PONG") if not args else _encode_bulk(args[0])
+
+    def _cmd_ECHO(self, args):
+        return _encode_bulk(args[0])
+
+    def _cmd_KEYS(self, args):
+        pattern = self._s(args[0]) if args else "*"
+        return _encode_array(self._client.get_keys().get_keys(pattern))
+
+    def _cmd_DBSIZE(self, args):
+        return _encode_int(self._client.get_keys().count())
+
+    def _cmd_FLUSHALL(self, args):
+        self._client.get_keys().flushall()
+        return _encode_simple("OK")
+
+    # strings (raw-bytes bucket)
+
+    def _bucket(self, key: bytes):
+        from redisson_tpu.grid.buckets import Bucket
+
+        b = Bucket(self._s(key), self._client)
+        # Foreign clients speak raw bytes: bypass the configured codec.
+        b._enc = lambda v: v if isinstance(v, bytes) else str(v).encode()
+        b._dec = lambda v: v
+        return b
+
+    def _cmd_SET(self, args):
+        key, value = args[0], args[1]
+        ttl = None
+        i = 2
+        while i < len(args):
+            opt = args[i].decode().upper()
+            if opt == "EX":
+                ttl = float(args[i + 1])
+                i += 2
+            elif opt == "PX":
+                ttl = float(args[i + 1]) / 1000.0
+                i += 2
+            else:
+                raise RespError(f"unsupported SET option {opt}")
+        self._bucket(key).set(value, ttl_seconds=ttl)
+        return _encode_simple("OK")
+
+    def _cmd_GET(self, args):
+        return _encode_bulk(self._bucket(args[0]).get())
+
+    def _cmd_DEL(self, args):
+        return _encode_int(
+            self._client.get_keys().delete(*[self._s(a) for a in args])
+        )
+
+    def _cmd_EXISTS(self, args):
+        return _encode_int(
+            self._client.get_keys().count_exists(*[self._s(a) for a in args])
+        )
+
+    def _cmd_EXPIRE(self, args):
+        ok = self._client.get_keys().expire(self._s(args[0]), float(args[1]))
+        return _encode_int(int(ok))
+
+    def _cmd_PEXPIRE(self, args):
+        ok = self._client.get_keys().expire(
+            self._s(args[0]), float(args[1]) / 1000.0
+        )
+        return _encode_int(int(ok))
+
+    def _cmd_TTL(self, args):
+        ms = self._client.get_keys().remain_time_to_live(self._s(args[0]))
+        return _encode_int(ms if ms < 0 else ms // 1000)
+
+    def _cmd_PTTL(self, args):
+        return _encode_int(
+            self._client.get_keys().remain_time_to_live(self._s(args[0]))
+        )
+
+    def _cmd_PERSIST(self, args):
+        name = self._s(args[0])
+        grid_ok = self._client._grid.clear_expire(name)
+        eng = getattr(self._client._engine, "clear_expire", None)
+        return _encode_int(int(grid_ok or (eng is not None and eng(name))))
+
+    # bitmaps -> BitSet
+
+    def _cmd_SETBIT(self, args):
+        bs = self._client.get_bit_set(self._s(args[0]))
+        prev = bs.set(int(args[1]), bool(int(args[2])))
+        return _encode_int(int(prev))
+
+    def _cmd_GETBIT(self, args):
+        bs = self._client.get_bit_set(self._s(args[0]))
+        return _encode_int(int(bs.get(int(args[1]))))
+
+    def _cmd_BITCOUNT(self, args):
+        if len(args) > 1:
+            # Range form unsupported — error, never silently-wrong data.
+            raise RespError("BITCOUNT with ranges is not supported")
+        return _encode_int(self._client.get_bit_set(self._s(args[0])).cardinality())
+
+    def _cmd_BITPOS(self, args):
+        if len(args) > 2:
+            raise RespError("BITPOS with ranges is not supported")
+        bs = self._client.get_bit_set(self._s(args[0]))
+        target = int(args[1])
+        return _encode_int(
+            bs.first_set_bit() if target else bs.first_clear_bit()
+        )
+
+    # HLL
+
+    def _cmd_PFADD(self, args):
+        h = self._client.get_hyper_log_log(self._s(args[0]))
+        return _encode_int(int(h.add_all([a for a in args[1:]])))
+
+    def _cmd_PFCOUNT(self, args):
+        h = self._client.get_hyper_log_log(self._s(args[0]))
+        if len(args) > 1:
+            return _encode_int(h.count_with(*[self._s(a) for a in args[1:]]))
+        return _encode_int(h.count())
+
+    def _cmd_PFMERGE(self, args):
+        h = self._client.get_hyper_log_log(self._s(args[0]))
+        h.merge_with(*[self._s(a) for a in args[1:]])
+        return _encode_simple("OK")
+
+    # Bloom (RedisBloom command shape)
+
+    def _cmd_BF_RESERVE(self, args):
+        bf = self._client.get_bloom_filter(self._s(args[0]))
+        created = bf.try_init(int(args[2]), float(args[1]))
+        if not created:
+            raise RespError("item exists")
+        return _encode_simple("OK")
+
+    def _cmd_BF_ADD(self, args):
+        bf = self._client.get_bloom_filter(self._s(args[0]))
+        return _encode_int(int(bf.add(args[1])))
+
+    def _cmd_BF_MADD(self, args):
+        bf = self._client.get_bloom_filter(self._s(args[0]))
+        newly = bf.add_all_async([a for a in args[1:]]).result()
+        return _encode_array([int(v) for v in newly])
+
+    def _cmd_BF_EXISTS(self, args):
+        bf = self._client.get_bloom_filter(self._s(args[0]))
+        return _encode_int(int(bf.contains(args[1])))
+
+    def _cmd_BF_MEXISTS(self, args):
+        bf = self._client.get_bloom_filter(self._s(args[0]))
+        hits = bf.contains_each([a for a in args[1:]])
+        return _encode_array([int(v) for v in hits])
+
+    # CMS (RedisBloom command shape)
+
+    def _cmd_CMS_INITBYDIM(self, args):
+        cms = self._client.get_count_min_sketch(self._s(args[0]))
+        cms.try_init(int(args[2]), int(args[1]))
+        return _encode_simple("OK")
+
+    def _cmd_CMS_INCRBY(self, args):
+        cms = self._client.get_count_min_sketch(self._s(args[0]))
+        out = []
+        for i in range(1, len(args), 2):
+            out.append(cms.add(args[i], int(args[i + 1])))
+        return _encode_array(out)
+
+    def _cmd_CMS_QUERY(self, args):
+        cms = self._client.get_count_min_sketch(self._s(args[0]))
+        return _encode_array(
+            [int(v) for v in cms.estimate_all([a for a in args[1:]])]
+        )
+
+    # lists
+
+    def _list(self, key: bytes):
+        # Redis lists ARE deques (LPUSH/RPOP both ends).
+        from redisson_tpu.grid.queues import Deque
+
+        lst = Deque(self._s(key), self._client)
+        lst._enc = lambda v: v if isinstance(v, bytes) else str(v).encode()
+        lst._dec = lambda v: v
+        return lst
+
+    def _cmd_RPUSH(self, args):
+        lst = self._list(args[0])
+        for v in args[1:]:
+            lst.offer(v)
+        return _encode_int(lst.size())
+
+    def _cmd_LPUSH(self, args):
+        lst = self._list(args[0])
+        for v in args[1:]:
+            lst.add_first(v)
+        return _encode_int(lst.size())
+
+    def _cmd_LPOP(self, args):
+        return _encode_bulk(self._list(args[0]).poll_first())
+
+    def _cmd_RPOP(self, args):
+        return _encode_bulk(self._list(args[0]).poll_last())
+
+    def _cmd_LLEN(self, args):
+        return _encode_int(self._list(args[0]).size())
+
+    # hashes
+
+    def _map(self, key: bytes):
+        from redisson_tpu.grid.maps import Map
+
+        m = Map(self._s(key), self._client)
+        m._enc = lambda v: v if isinstance(v, bytes) else str(v).encode()
+        m._dec = lambda v: v
+        m._enc_key = m._enc
+        m._dec_key = m._dec
+        return m
+
+    def _cmd_HSET(self, args):
+        m = self._map(args[0])
+        n = 0
+        for i in range(1, len(args), 2):
+            if m.fast_put(args[i], args[i + 1]):
+                n += 1
+        return _encode_int(n)
+
+    def _cmd_HGET(self, args):
+        return _encode_bulk(self._map(args[0]).get(args[1]))
+
+    def _cmd_HDEL(self, args):
+        return _encode_int(self._map(args[0]).fast_remove(*args[1:]))
+
+    def _cmd_HLEN(self, args):
+        return _encode_int(self._map(args[0]).size())

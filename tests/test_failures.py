@@ -1,0 +1,139 @@
+"""Executor-boundary failure semantics (SURVEY.md §5 failure row /
+VERDICT r2 Next #7): injected kernel failures must retry (dispatch-time),
+fail with per-op attribution (completion-time), and time out with a typed
+error.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.executor.coalescer import BatchCoalescer, HintedFuture
+from redisson_tpu.executor.failures import (
+    DispatchTimeoutError,
+    KernelExecutionError,
+    RetryExhaustedError,
+)
+
+
+class _Lazy:
+    def __init__(self, value=None, error=None):
+        self._value = value
+        self._error = error
+
+    def result(self):
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+def make_coalescer(**kw):
+    kw.setdefault("batch_window_us", 100)
+    kw.setdefault("max_batch", 1 << 10)
+    kw.setdefault("retry_interval_s", 0.01)
+    return BatchCoalescer(**kw)
+
+
+class TestDispatchRetry:
+    def test_transient_dispatch_failure_retries(self):
+        c = make_coalescer(retry_attempts=3)
+        calls = []
+
+        def flaky(cols):
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("transient trace error")
+            return _Lazy(np.arange(len(cols[0])))
+
+        fut = c.submit("k", flaky, (np.arange(4),), 4)
+        out = HintedFuture(fut, c).result(5.0)
+        assert list(out) == [0, 1, 2, 3]
+        assert len(calls) == 3  # two failures + one success
+        c.shutdown()
+
+    def test_retry_budget_exhaustion(self):
+        c = make_coalescer(retry_attempts=2)
+
+        def always_fails(cols):
+            raise RuntimeError("permanent")
+
+        fut = c.submit("k", always_fails, (np.arange(4),), 4)
+        with pytest.raises(RetryExhaustedError) as ei:
+            HintedFuture(fut, c).result(5.0)
+        assert ei.value.attempts == 2
+        assert isinstance(ei.value.__cause__, RuntimeError)
+        c.shutdown()
+
+
+class TestCompletionFailure:
+    def test_per_op_attribution(self):
+        """A segment holding two submissions fails at completion: each
+        caller's error names ITS op range within the launch."""
+        c = make_coalescer()
+
+        def dispatch(cols):
+            return _Lazy(error=RuntimeError("device died"))
+
+        f1 = c.submit("k", dispatch, (np.arange(3),), 3)
+        f2 = c.submit("k", dispatch, (np.arange(5),), 5)
+        with pytest.raises(KernelExecutionError) as e1:
+            HintedFuture(f1, c).result(5.0)
+        with pytest.raises(KernelExecutionError) as e2:
+            HintedFuture(f2, c).result(5.0)
+        ranges = sorted(
+            [(e1.value.op_start, e1.value.op_count),
+             (e2.value.op_start, e2.value.op_count)]
+        )
+        assert ranges == [(0, 3), (3, 5)]
+        assert e1.value.segment_ops == 8
+        assert isinstance(e1.value.__cause__, RuntimeError)
+        c.shutdown()
+
+    def test_completion_failure_not_retried(self):
+        c = make_coalescer(retry_attempts=3)
+        calls = []
+
+        def dispatch(cols):
+            calls.append(1)
+            return _Lazy(error=RuntimeError("async device error"))
+
+        fut = c.submit("k", dispatch, (np.arange(2),), 2)
+        with pytest.raises(KernelExecutionError):
+            HintedFuture(fut, c).result(5.0)
+        assert len(calls) == 1  # donated state: no blind re-dispatch
+        c.shutdown()
+
+    def test_later_segments_survive_failure(self):
+        c = make_coalescer()
+        state = {"fail": True}
+
+        def dispatch(cols):
+            if state["fail"]:
+                state["fail"] = False
+                return _Lazy(error=RuntimeError("one bad launch"))
+            return _Lazy(np.zeros(len(cols[0]), bool))
+
+        f1 = c.submit("a", dispatch, (np.arange(2),), 2)
+        with pytest.raises(KernelExecutionError):
+            HintedFuture(f1, c).result(5.0)
+        f2 = c.submit("b", dispatch, (np.arange(2),), 2)
+        assert list(HintedFuture(f2, c).result(5.0)) == [False, False]
+        c.shutdown()
+
+
+class TestTimeout:
+    def test_result_timeout_is_typed(self):
+        c = make_coalescer()
+        release = {"go": False}
+
+        def dispatch(cols):
+            while not release["go"]:
+                time.sleep(0.01)
+            return _Lazy(np.zeros(1, bool))
+
+        fut = c.submit("k", dispatch, (np.arange(1),), 1)
+        with pytest.raises(DispatchTimeoutError):
+            HintedFuture(fut, c).result(0.1)
+        release["go"] = True
+        c.shutdown()

@@ -1,0 +1,156 @@
+"""RESP front door (SURVEY.md §2.4 comm row): a raw RESP2 client drives
+the engine's keyspace and sketch objects over TCP."""
+
+import socket
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.serve.resp import RespServer
+
+
+class RespClient:
+    """Minimal RESP2 client (what redis-py does on the wire)."""
+
+    def __init__(self, host, port):
+        self._sock = socket.create_connection((host, port), timeout=10)
+        self._buf = b""
+
+    def cmd(self, *args):
+        out = b"*" + str(len(args)).encode() + b"\r\n"
+        for a in args:
+            if not isinstance(a, bytes):
+                a = str(a).encode()
+            out += b"$" + str(len(a)).encode() + b"\r\n" + a + b"\r\n"
+        self._sock.sendall(out)
+        return self._read_reply()
+
+    def _line(self):
+        while b"\r\n" not in self._buf:
+            self._buf += self._sock.recv(65536)
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _exact(self, n):
+        while len(self._buf) < n + 2:
+            self._buf += self._sock.recv(65536)
+        out, self._buf = self._buf[:n], self._buf[n + 2:]
+        return out
+
+    def _read_reply(self):
+        line = self._line()
+        t, body = line[:1], line[1:]
+        if t == b"+":
+            return body.decode()
+        if t == b"-":
+            raise RuntimeError(body.decode())
+        if t == b":":
+            return int(body)
+        if t == b"$":
+            n = int(body)
+            return None if n < 0 else self._exact(n)
+        if t == b"*":
+            return [self._read_reply() for _ in range(int(body))]
+        raise RuntimeError(f"bad reply type {t!r}")
+
+    def close(self):
+        self._sock.close()
+
+
+@pytest.fixture
+def resp():
+    client = redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
+    server = RespServer(client)
+    conn = RespClient(server.host, server.port)
+    yield conn
+    conn.close()
+    server.close()
+    client.shutdown()
+
+
+class TestRespFrontDoor:
+    def test_ping_echo(self, resp):
+        assert resp.cmd("PING") == "PONG"
+        assert resp.cmd("ECHO", "hello") == b"hello"
+
+    def test_strings_and_keys(self, resp):
+        assert resp.cmd("SET", "k", "v") == "OK"
+        assert resp.cmd("GET", "k") == b"v"
+        assert resp.cmd("EXISTS", "k") == 1
+        assert resp.cmd("DBSIZE") == 1
+        assert resp.cmd("DEL", "k") == 1
+        assert resp.cmd("GET", "k") is None
+
+    def test_expire_ttl(self, resp):
+        resp.cmd("SET", "e", "v", "EX", "30")
+        ttl = resp.cmd("TTL", "e")
+        assert 0 < ttl <= 30
+        assert resp.cmd("PERSIST", "e") == 1
+        assert resp.cmd("TTL", "e") == -1
+
+    def test_bitmaps(self, resp):
+        assert resp.cmd("SETBIT", "b", 7, 1) == 0
+        assert resp.cmd("SETBIT", "b", 7, 1) == 1  # prev bit
+        assert resp.cmd("GETBIT", "b", 7) == 1
+        assert resp.cmd("BITCOUNT", "b") == 1
+        assert resp.cmd("BITPOS", "b", 1) == 7
+
+    def test_hll(self, resp):
+        assert resp.cmd("PFADD", "h", "a", "b", "c") == 1
+        assert resp.cmd("PFCOUNT", "h") == 3
+        resp.cmd("PFADD", "h2", "c", "d")
+        assert resp.cmd("PFCOUNT", "h", "h2") == 4
+        assert resp.cmd("PFMERGE", "h", "h2") == "OK"
+        assert resp.cmd("PFCOUNT", "h") == 4
+
+    def test_bloom_redisbloom_shape(self, resp):
+        assert resp.cmd("BF.RESERVE", "bf", "0.01", "1000") == "OK"
+        assert resp.cmd("BF.ADD", "bf", "x") == 1
+        assert resp.cmd("BF.ADD", "bf", "x") == 0
+        assert resp.cmd("BF.EXISTS", "bf", "x") == 1
+        assert resp.cmd("BF.EXISTS", "bf", "ghost") == 0
+        assert resp.cmd("BF.MADD", "bf", "a", "b") == [1, 1]
+        assert resp.cmd("BF.MEXISTS", "bf", "a", "ghost") == [1, 0]
+
+    def test_cms_redisbloom_shape(self, resp):
+        assert resp.cmd("CMS.INITBYDIM", "c", 2048, 5) == "OK"
+        assert resp.cmd("CMS.INCRBY", "c", "hot", 10) == [10]
+        assert resp.cmd("CMS.QUERY", "c", "hot", "cold") == [10, 0]
+
+    def test_lists_and_hashes(self, resp):
+        assert resp.cmd("RPUSH", "l", "a", "b") == 2
+        assert resp.cmd("LPUSH", "l", "z") == 3
+        assert resp.cmd("LPOP", "l") == b"z"
+        assert resp.cmd("RPOP", "l") == b"b"
+        assert resp.cmd("LLEN", "l") == 1
+        assert resp.cmd("HSET", "m", "f1", "v1", "f2", "v2") == 2
+        assert resp.cmd("HGET", "m", "f1") == b"v1"
+        assert resp.cmd("HDEL", "m", "f1") == 1
+        assert resp.cmd("HLEN", "m") == 1
+
+    def test_unknown_command_is_error_not_disconnect(self, resp):
+        with pytest.raises(RuntimeError, match="unknown command"):
+            resp.cmd("NOPE")
+        assert resp.cmd("PING") == "PONG"  # connection survives
+
+    def test_concurrent_connections(self, resp):
+        import threading
+
+        host, port = resp._sock.getpeername()
+
+        def worker(i, results):
+            c = RespClient(host, port)
+            c.cmd("SET", f"cc{i}", str(i))
+            results.append(c.cmd("GET", f"cc{i}"))
+            c.close()
+
+        results = []
+        threads = [
+            threading.Thread(target=worker, args=(i, results)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(results) == sorted(str(i).encode() for i in range(8))

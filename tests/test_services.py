@@ -1,0 +1,226 @@
+"""Coordination services (SURVEY.md §2.3 services row): executor service,
+remote service, transactions, script service, live objects, map-reduce.
+"""
+
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.grid import TransactionException
+
+
+@pytest.fixture
+def client():
+    c = redisson_tpu.create(Config())
+    yield c
+    c.shutdown()
+
+
+class TestExecutorService:
+    def test_submit_runs_on_workers(self, client):
+        ex = client.get_executor_service("ex1")
+        ex.register_workers(2)
+        futs = [ex.submit(lambda i=i: i * i) for i in range(10)]
+        assert [f.result(5.0) for f in futs] == [i * i for i in range(10)]
+        ex.shutdown()
+
+    def test_no_workers_means_tasks_queue(self, client):
+        ex = client.get_executor_service("ex2")
+        fut = ex.submit(lambda: 42)
+        with pytest.raises(TimeoutError):
+            fut.result(0.1)
+        assert ex.get_task_count() == 1
+        ex.register_workers(1)  # the RedissonNode shows up
+        assert fut.result(5.0) == 42
+        ex.shutdown()
+
+    def test_task_error_propagates(self, client):
+        ex = client.get_executor_service("ex3")
+        ex.register_workers(1)
+
+        def boom():
+            raise ValueError("task failed")
+
+        with pytest.raises(ValueError, match="task failed"):
+            ex.submit(boom).result(5.0)
+        ex.shutdown()
+
+    def test_schedule_delay(self, client):
+        ex = client.get_executor_service("ex4")
+        ex.register_workers(1)
+        t0 = time.monotonic()
+        fut = ex.schedule(lambda: "late", 0.15)
+        assert fut.result(5.0) == "late"
+        assert time.monotonic() - t0 >= 0.14
+        ex.shutdown()
+
+    def test_fixed_rate_and_cancel(self, client):
+        ex = client.get_executor_service("ex5")
+        ex.register_workers(1)
+        hits = []
+        fut = ex.schedule_at_fixed_rate(lambda: hits.append(1), 0.01, 0.05)
+        deadline = time.time() + 3
+        while time.time() < deadline and len(hits) < 3:
+            time.sleep(0.02)
+        assert len(hits) >= 3
+        fut.cancel()
+        n = len(hits)
+        time.sleep(0.2)
+        assert len(hits) <= n + 1  # at most one in-flight fire after cancel
+        ex.shutdown()
+
+
+class TestRemoteService:
+    def test_sync_invocation(self, client):
+        class Calc:
+            def mul(self, a, b):
+                return a * b
+
+        rs = client.get_remote_service()
+        rs.register("Calc", Calc(), workers=2)
+        proxy = rs.get("Calc")
+        assert proxy.mul(6, 7) == 42
+
+    def test_async_invocation(self, client):
+        class Echo:
+            def say(self, s):
+                return f"echo:{s}"
+
+        rs = client.get_remote_service()
+        rs.register("Echo", Echo())
+        fut = rs.get_async("Echo").say("hi")
+        assert fut.result(5.0) == "echo:hi"
+
+    def test_unregistered_raises(self, client):
+        rs = client.get_remote_service()
+        with pytest.raises(RuntimeError, match="no workers"):
+            rs.get("Nope").anything()
+
+
+class TestTransaction:
+    def test_commit_applies_atomically(self, client):
+        tx = client.create_transaction()
+        tx.get_bucket("tb").set("v1")
+        tx.get_map("tm").put("k", 1)
+        # Nothing visible before commit.
+        assert client.get_bucket("tb").get() is None
+        tx.commit()
+        assert client.get_bucket("tb").get() == "v1"
+        assert client.get_map("tm").get("k") == 1
+
+    def test_conflicting_write_aborts(self, client):
+        client.get_bucket("cb").set("original")
+        tx = client.create_transaction()
+        assert tx.get_bucket("cb").get() == "original"  # read-validated
+        client.get_bucket("cb").set("sneaky concurrent write")
+        tx.get_bucket("cb").set("tx value")
+        with pytest.raises(TransactionException):
+            tx.commit()
+        assert client.get_bucket("cb").get() == "sneaky concurrent write"
+
+    def test_rollback_discards(self, client):
+        tx = client.create_transaction()
+        tx.get_bucket("rb").set("x")
+        tx.rollback()
+        assert client.get_bucket("rb").get() is None
+        with pytest.raises(TransactionException):
+            tx.commit()  # single-shot
+
+    def test_read_your_writes_inside_tx(self, client):
+        tx = client.create_transaction()
+        b = tx.get_bucket("ry")
+        b.set("mine")
+        assert b.get() == "mine"
+        m = tx.get_map("rym")
+        m.put("k", 5)
+        assert m.get("k") == 5
+        tx.commit()
+
+
+class TestScriptService:
+    def test_atomic_procedure(self, client):
+        s = client.get_script()
+
+        def incr_both(cl, keys, args):
+            a = cl.get_atomic_long(keys[0])
+            b = cl.get_atomic_long(keys[1])
+            a.add_and_get(args[0])
+            b.add_and_get(args[0])
+            return a.get() + b.get()
+
+        s.register("incr-both", incr_both)
+        out = s.eval("incr-both", keys=["x", "y"], args=[5])
+        assert out == 10
+        assert client.get_atomic_long("x").get() == 5
+
+    def test_noscript(self, client):
+        with pytest.raises(KeyError, match="NOSCRIPT"):
+            client.get_script().eval("missing")
+
+
+class TestLiveObjects:
+    def test_persist_and_get(self, client):
+        class Account:
+            def __init__(self, id, owner, balance):
+                self.id = id
+                self.owner = owner
+                self.balance = balance
+
+        svc = client.get_live_object_service()
+        live = svc.persist(Account(7, "ada", 100))
+        # Another handle sees the same state (map-backed).
+        again = svc.get("Account", 7)
+        assert again.owner == "ada"
+        again.balance = 250
+        assert live.balance == 250
+        assert svc.exists(Account, 7)
+        assert svc.delete(Account, 7)
+        assert not svc.exists(Account, 7)
+
+
+class TestMapReduce:
+    def test_word_count(self, client):
+        m = client.get_map("docs")
+        m.put("d1", "a b a")
+        m.put("d2", "b c")
+        m.put("d3", "a")
+        mr = client.get_map_reduce(m, workers=3, chunk_size=1)
+        out = (
+            mr.mapper(lambda k, v: [(w, 1) for w in v.split()])
+            .reducer(lambda k, vals: sum(vals))
+            .execute()
+        )
+        assert out == {"a": 3, "b": 2, "c": 1}
+
+
+class TestServiceHandleSharing:
+    """r3 review: services are name-shared — workers registered through
+    one handle run tasks submitted through another."""
+
+    def test_executor_service_shared_across_handles(self, client):
+        client.get_executor_service("shared").register_workers(1)
+        fut = client.get_executor_service("shared").submit(lambda: "ran")
+        assert fut.result(5.0) == "ran"
+
+    def test_remote_service_shared_across_handles(self, client):
+        class Svc:
+            def hi(self):
+                return "hello"
+
+        client.get_remote_service().register("Svc", Svc())
+        assert client.get_remote_service().get("Svc").hi() == "hello"
+
+    def test_schedule_after_shutdown_raises(self, client):
+        import pytest as _pytest
+
+        ex = client.get_executor_service("sd")
+        ex.shutdown()
+        with _pytest.raises(RuntimeError):
+            ex.schedule(lambda: 1, 0.01)
+        # A fresh handle after shutdown gets a working service again.
+        ex2 = client.get_executor_service("sd")
+        ex2.register_workers(1)
+        assert ex2.submit(lambda: 2).result(5.0) == 2

@@ -1,0 +1,208 @@
+"""Catalog wave 3: Geo, TimeSeries, TransferQueue, PriorityBlocking/Deque,
+JCache, SCAN iterators."""
+
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+
+
+@pytest.fixture
+def client():
+    c = redisson_tpu.create(Config())
+    yield c
+    c.shutdown()
+
+
+class TestGeo:
+    def test_add_pos_dist(self, client):
+        g = client.get_geo("geo")
+        assert g.add(13.361389, 38.115556, "Palermo") == 1
+        assert g.add(15.087269, 37.502669, "Catania") == 1
+        assert g.add(13.361389, 38.115556, "Palermo") == 0  # update
+        d = g.dist("Palermo", "Catania", "km")
+        assert d is not None and 160 < d < 172  # Redis reports ~166.27 km
+        pos = g.pos("Palermo", "ghost")
+        assert "Palermo" in pos and "ghost" not in pos
+
+    def test_search_radius(self, client):
+        g = client.get_geo("geo2")
+        g.add(13.361389, 38.115556, "Palermo")
+        g.add(15.087269, 37.502669, "Catania")
+        got = g.search_radius(15, 37, 200, "km")
+        assert got == ["Catania", "Palermo"]  # nearest first
+        near = g.search_radius(15, 37, 100, "km")
+        assert near == ["Catania"]
+        with_d = g.search_radius_from_member("Palermo", 200, "km", with_dist=True)
+        assert with_d[0][0] == "Palermo" and with_d[0][1] < 1e-6
+
+    def test_geohash(self, client):
+        g = client.get_geo("geo3")
+        g.add(13.361389, 38.115556, "Palermo")
+        h = g.hash("Palermo")["Palermo"]
+        assert h.startswith("sqc8b49rny")  # Redis's GEOHASH prefix
+
+    def test_coordinate_validation(self, client):
+        g = client.get_geo("geo4")
+        with pytest.raises(ValueError):
+            g.add(200.0, 0.0, "bad")
+
+
+class TestTimeSeries:
+    def test_add_get_range(self, client):
+        ts = client.get_time_series("ts")
+        for t in (30, 10, 20):
+            ts.add(t, f"v{t}")
+        assert ts.size() == 3
+        assert ts.get(20) == "v20"
+        assert ts.range(10, 25) == [(10, "v10"), (20, "v20")]
+        assert ts.range_reversed(0, 100)[0] == (30, "v30")
+        assert ts.first() == ["v10"]
+        assert ts.last() == ["v30"]
+        assert ts.first_timestamp() == 10
+        assert ts.last_timestamp() == 30
+
+    def test_same_timestamp_replaces(self, client):
+        ts = client.get_time_series("ts2")
+        ts.add(5, "old")
+        ts.add(5, "new")
+        assert ts.size() == 1
+        assert ts.get(5) == "new"
+
+    def test_poll_and_remove_range(self, client):
+        ts = client.get_time_series("ts3")
+        for t in range(5):
+            ts.add(t, t)
+        assert ts.poll_first() == [0]
+        assert ts.poll_last(2) == [4, 3]
+        assert ts.remove_range(1, 2) == 2
+        assert ts.size() == 0
+
+    def test_entry_ttl(self, client):
+        ts = client.get_time_series("ts4")
+        ts.add(1, "stays")
+        ts.add(2, "goes", ttl_seconds=0.1)
+        time.sleep(0.15)
+        assert ts.size() == 1
+        assert ts.get(2) is None
+
+    def test_labels(self, client):
+        ts = client.get_time_series("ts5")
+        ts.add(1, "v", label="L")
+        assert ts.entry_range(0, 10) == [(1, "v", "L")]
+
+
+class TestTransferQueue:
+    def test_transfer_blocks_until_taken(self, client):
+        q = client.get_transfer_queue("tq")
+        done = []
+
+        def producer():
+            done.append(q.transfer("hot-potato", timeout_seconds=5.0))
+
+        t = threading.Thread(target=producer)
+        t.start()
+        time.sleep(0.1)
+        assert not done  # still blocked: nobody took it
+        assert q.take() == "hot-potato"
+        t.join(timeout=5)
+        assert done == [True]
+
+    def test_transfer_timeout_withdraws(self, client):
+        q = client.get_transfer_queue("tq2")
+        assert q.transfer("x", timeout_seconds=0.1) is False
+        assert q.poll() is None  # withdrawn, not left behind
+
+    def test_try_transfer_needs_waiting_consumer(self, client):
+        q = client.get_transfer_queue("tq3")
+        assert q.try_transfer("x") is False
+        got = []
+        t = threading.Thread(target=lambda: got.append(q.poll(2.0)))
+        t.start()
+        time.sleep(0.1)
+        assert q.has_waiting_consumer()
+        assert q.try_transfer("y") is True
+        t.join(timeout=5)
+        assert got == ["y"]
+
+
+class TestPriorityVariants:
+    def test_priority_blocking_take(self, client):
+        q = client.get_priority_blocking_queue("pbq")
+        got = []
+        t = threading.Thread(target=lambda: got.append(q.take()))
+        t.start()
+        time.sleep(0.05)
+        q.offer(5)
+        t.join(timeout=5)
+        assert got == [5]
+        q.offer(3)
+        q.offer(9)
+        assert q.poll(1.0) == 3  # natural order
+
+    def test_priority_deque_both_ends(self, client):
+        d = client.get_priority_deque("pdq")
+        for v in (5, 1, 9, 3):
+            d.offer(v)
+        assert d.peek_first() == 1
+        assert d.peek_last() == 9
+        assert d.poll_first() == 1
+        assert d.poll_last() == 9
+        assert d.read_all() == [3, 5]
+
+
+class TestJCache:
+    def test_jsr107_contracts(self, client):
+        cache = client.get_jcache("jc")
+        assert cache.put("k", "v") is None
+        assert cache.get("k") == "v"
+        assert cache.get_and_put("k", "v2") == "v"
+        assert cache.put_if_absent("k", "x") is False
+        assert cache.put_if_absent("new", "n") is True
+        assert cache.contains_key("k")
+        assert cache.remove("missing") is False
+        assert cache.remove("k") is True
+        assert cache.get_and_remove("new") == "n"
+        assert not cache.contains_key("new")
+
+    def test_remove_with_old_value(self, client):
+        cache = client.get_jcache("jc2")
+        cache.put("k", "v")
+        assert cache.remove("k", "wrong") is False
+        assert cache.remove("k", "v") is True
+
+    def test_default_ttl(self, client):
+        cache = client.get_jcache("jc3", default_ttl_seconds=0.1)
+        cache.put("k", "v")
+        assert cache.get("k") == "v"
+        time.sleep(0.15)
+        assert cache.get("k") is None
+
+    def test_cache_manager(self, client):
+        mgr = client.get_cache_manager()
+        c1 = mgr.create_cache("m1")
+        assert mgr.get_cache("m1") is c1
+        c1.put("k", 1)
+        mgr.destroy_cache("m1")
+        assert "m1" not in mgr.get_cache_names()
+
+
+class TestScanIterators:
+    def test_keys_scan(self, client):
+        for i in range(25):
+            client.get_bucket(f"scan:{i}").set(i)
+        got = list(client.get_keys().scan_iterator("scan:*", count=7))
+        assert sorted(got) == sorted(f"scan:{i}" for i in range(25))
+        assert len(got) == len(set(got))  # exactly once
+
+    def test_map_hscan(self, client):
+        m = client.get_map("hm")
+        for i in range(15):
+            m.put(f"k{i}", i)
+        keys = list(m.key_iterator(count=4))
+        assert sorted(keys) == sorted(f"k{i}" for i in range(15))
+        entries = dict(m.entry_iterator(count=4))
+        assert entries["k3"] == 3
