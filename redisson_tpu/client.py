@@ -256,6 +256,24 @@ class RedissonTpuClient(CamelCompatMixin):
     def get_topic(self, name: str):
         return Topic(name, self)
 
+    def get_sharded_topic(self, name: str):
+        """→ RedissonClient#getShardedTopic."""
+        from redisson_tpu.grid.topics import ShardedTopic
+
+        return ShardedTopic(name, self)
+
+    def get_json_bucket(self, name: str):
+        """→ RedissonClient#getJsonBucket."""
+        from redisson_tpu.grid.buckets import JsonBucket
+
+        return JsonBucket(name, self)
+
+    def get_nodes_group(self):
+        """→ RedissonClient#getNodesGroup: per-device ping/info."""
+        from redisson_tpu.serve.nodes import NodesGroup
+
+        return NodesGroup(self)
+
     def get_pattern_topic(self, pattern: str):
         return PatternTopic(pattern, self)
 

@@ -148,3 +148,100 @@ class BinaryStream(GridObject):
 
     def get_input_stream(self) -> io.BytesIO:
         return io.BytesIO(self.get())
+
+
+class JsonBucket(Bucket):
+    """→ RJsonBucket (RedisJSON-backed bucket): JSON value with dot-path
+    reads/writes (`$` or empty = root, `a.b.0.c` walks objects/arrays)."""
+
+    KIND = "bucket"
+
+    def __init__(self, name, client):
+        super().__init__(name, client)
+        import json as _json
+
+        # JSON values travel as canonical JSON bytes regardless of codec.
+        self._enc = lambda v: _json.dumps(v).encode()
+        self._dec = lambda b: _json.loads(b.decode())
+
+    @staticmethod
+    def _walk(root, path):
+        if path in ("", "$", None):
+            return root, None, None
+        parts = [p for p in str(path).replace("$.", "").split(".") if p]
+        cur = root
+        for p in parts[:-1]:
+            cur = cur[int(p)] if isinstance(cur, list) else cur[p]
+        leaf = parts[-1]
+        key = int(leaf) if isinstance(cur, list) else leaf
+        return cur[key], cur, key
+
+    def get_path(self, path: str = "$"):
+        """→ RJsonBucket#get(path) (JSON.GET)."""
+        doc = self.get()
+        if doc is None:
+            return None
+        value, _, _ = self._walk(doc, path)
+        return value
+
+    def _save(self, doc) -> None:
+        """In-place value update PRESERVING the key's TTL — RedisJSON path
+        writes (JSON.SET path / NUMINCRBY / ARRAPPEND) never touch key
+        expiry, unlike SET."""
+        with self._store.lock:
+            e = self._entry()
+            e.value = self._enc(doc)
+
+    def set_path(self, path: str, value) -> None:
+        """→ RJsonBucket#set(path, value) (JSON.SET)."""
+        if path in ("", "$", None):
+            self.set(value)
+            return
+        with self._store.lock:
+            doc = self.get()
+            if doc is None:
+                raise ValueError("document does not exist; set the root first")
+            _, parent, key = self._walk(doc, path)
+            parent[key] = value
+            self._save(doc)
+
+    def array_append(self, path: str, *values) -> int:
+        """→ JSON.ARRAPPEND: new array length."""
+        with self._store.lock:
+            doc = self.get()
+            arr, parent, key = self._walk(doc, path)
+            if not isinstance(arr, list):
+                raise TypeError(f"path {path!r} does not hold an array")
+            arr.extend(values)
+            self._save(doc)
+            return len(arr)
+
+    def string_append(self, path: str, suffix: str) -> int:
+        """→ JSON.STRAPPEND: new string length."""
+        with self._store.lock:
+            doc = self.get()
+            s, parent, key = self._walk(doc, path)
+            if not isinstance(s, str):
+                raise TypeError(f"path {path!r} does not hold a string")
+            out = s + suffix
+            if parent is None:
+                self._save(out)
+            else:
+                parent[key] = out
+                self._save(doc)
+            return len(out)
+
+    def increment(self, path: str, delta) -> float:
+        """→ JSON.NUMINCRBY."""
+        with self._store.lock:
+            doc = self.get()
+            n, parent, key = self._walk(doc, path)
+            if not isinstance(n, (int, float)) or isinstance(n, bool):
+                raise TypeError(f"path {path!r} does not hold a number")
+            out = n + delta
+            if parent is None:
+                self._save(out)
+            else:
+                parent[key] = out
+                self._save(doc)
+            return out

@@ -11,11 +11,12 @@ from typing import Optional
 
 
 def _chunked_snapshot_iter(fetch, count: int):
-    """Shared SCAN-cursor shape: snapshot once, yield lazily in chunks."""
-    names = fetch()
-    step = max(1, count)
-    for i in range(0, len(names), step):
-        yield from names[i : i + step]
+    """Shared SCAN-cursor shape: the snapshot is taken EAGERLY (at
+    iterator creation, so the stated every-key-present-now guarantee
+    holds even if consumption is deferred); iteration is a plain walk —
+    ``count`` is accepted for SCAN-API parity but has no semantic effect
+    on an in-process snapshot."""
+    return iter(fetch())
 
 
 class Keys:
@@ -33,11 +34,10 @@ class Keys:
         return names + sketch
 
     def scan_iterator(self, pattern: Optional[str] = None, count: int = 10):
-        """→ RKeys#getKeysByPattern's SCAN-cursor idiom: lazy snapshot
-        iteration in ``count``-sized chunks (O(N) total — one keyspace
-        scan).  Guarantees (stronger than Redis SCAN): every key present
-        at iterator creation is yielded exactly once; keys created
-        mid-scan do not appear."""
+        """→ RKeys#getKeysByPattern's SCAN-cursor idiom (one O(N)
+        keyspace snapshot).  Guarantees (stronger than Redis SCAN): every
+        key present at iterator creation is yielded exactly once; keys
+        created after creation do not appear."""
         return _chunked_snapshot_iter(lambda: self.get_keys(pattern), count)
 
     def count(self) -> int:

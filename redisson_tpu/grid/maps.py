@@ -58,6 +58,39 @@ class Map(GridObject):
     def _new_value():
         return _MapValue()
 
+    # -- entry listeners (→ RMapCache#addListener: EntryCreated/Updated/
+    # Removed listeners ride the client's topic bus on the map's own
+    # event channel, so every handle sees every mutation.  TTL expiry is
+    # lazy/sweeper-driven with no client context, so no Expired event
+    # fires — the reference's expired-listener has no analog here) --------
+
+    _EVENT_CREATED = "created"
+    _EVENT_UPDATED = "updated"
+    _EVENT_REMOVED = "removed"
+
+    def _event_channel(self) -> str:
+        return f"{self._name}:map-events"
+
+    def add_listener(self, listener, event: Optional[str] = None) -> int:
+        """``listener(event, key, value)``; ``event`` filters to one of
+        'created'/'updated'/'removed' (None = all)."""
+        bus = self._client._topic_bus
+
+        def on_event(channel, message):
+            ev, key, value = message
+            if event is None or ev == event:
+                listener(ev, key, value)
+
+        return bus.subscribe(self._event_channel(), on_event)
+
+    def remove_listener(self, listener_id: int) -> None:
+        self._client._topic_bus.unsubscribe(self._event_channel(), listener_id)
+
+    def _emit(self, event: str, key, value) -> None:
+        bus = getattr(self._client, "_topic_bus", None)
+        if bus is not None and bus.count_listeners(self._event_channel()):
+            bus.publish(self._event_channel(), (event, key, value))
+
     # -- core --------------------------------------------------------------
 
     def put(self, key: Any, value: Any) -> Any:
@@ -67,7 +100,12 @@ class Map(GridObject):
             kb = self._enc_key(key)
             prev = e.value.live(kb)
             e.value.data[kb] = [self._enc(value), None, None, time.time()]
-            return None if prev is None else self._dec(prev[0])
+        self._emit(
+            self._EVENT_UPDATED if prev is not None else self._EVENT_CREATED,
+            key,
+            value,
+        )
+        return None if prev is None else self._dec(prev[0])
 
     def fast_put(self, key: Any, value: Any) -> bool:
         """→ RMap#fastPut: True iff the key was new (skips prev fetch)."""
@@ -76,7 +114,10 @@ class Map(GridObject):
             kb = self._enc_key(key)
             existed = e.value.live(kb) is not None
             e.value.data[kb] = [self._enc(value), None, None, time.time()]
-            return not existed
+        self._emit(
+            self._EVENT_UPDATED if existed else self._EVENT_CREATED, key, value
+        )
+        return not existed
 
     def put_if_absent(self, key: Any, value: Any) -> Any:
         with self._store.lock:
@@ -86,7 +127,8 @@ class Map(GridObject):
             if cur is not None:
                 return self._dec(cur[0])
             e.value.data[kb] = [self._enc(value), None, None, time.time()]
-            return None
+        self._emit(self._EVENT_CREATED, key, value)
+        return None
 
     def get(self, key: Any) -> Any:
         with self._store.lock:
@@ -124,22 +166,27 @@ class Map(GridObject):
                 if slot[0] != self._enc(expected):
                     return False
                 del e.value.data[kb]
-                return True
-            del e.value.data[kb]
-            return self._dec(slot[0])
+                removed = True
+            else:
+                del e.value.data[kb]
+                removed = False
+        self._emit(self._EVENT_REMOVED, key, self._dec(slot[0]))
+        return True if removed else self._dec(slot[0])
 
     def fast_remove(self, *keys: Any) -> int:
+        removed = []
         with self._store.lock:
             e = self._entry(create=False)
             if e is None:
                 return 0
-            n = 0
             for k in keys:
                 kb = self._enc_key(k)
                 if e.value.live(kb) is not None:
                     del e.value.data[kb]
-                    n += 1
-            return n
+                    removed.append(k)
+        for k in removed:
+            self._emit(self._EVENT_REMOVED, k, None)
+        return len(removed)
 
     def replace(self, key: Any, value: Any, new_value: Any = _MISSING):
         """→ RMap#replace(key, newValue) returning the previous value, or
@@ -156,10 +203,14 @@ class Map(GridObject):
                 if slot[0] != self._enc(value):
                     return False
                 slot[0] = self._enc(new_value)
-                return True
-            old = self._dec(slot[0])
-            slot[0] = self._enc(value)
-            return old
+                out = True
+                emitted = new_value
+            else:
+                out = self._dec(slot[0])
+                slot[0] = self._enc(value)
+                emitted = value
+        self._emit(self._EVENT_UPDATED, key, emitted)
+        return out
 
     def contains_key(self, key: Any) -> bool:
         with self._store.lock:
@@ -276,7 +327,12 @@ class MapCache(Map):
         with self._store.lock:
             prev = self.get(key)
             self._put_slot(key, value, ttl_seconds, max_idle_seconds)
-            return prev
+        self._emit(
+            self._EVENT_UPDATED if prev is not None else self._EVENT_CREATED,
+            key,
+            value,
+        )
+        return prev
 
     def fast_put(self, key: Any, value: Any, ttl_seconds: Optional[float] = None,
                  max_idle_seconds: Optional[float] = None) -> bool:
@@ -284,7 +340,10 @@ class MapCache(Map):
             e = self._entry()
             existed = e.value.live(self._enc_key(key)) is not None
             self._put_slot(key, value, ttl_seconds, max_idle_seconds)
-            return not existed
+        self._emit(
+            self._EVENT_UPDATED if existed else self._EVENT_CREATED, key, value
+        )
+        return not existed
 
     def put_if_absent(self, key: Any, value: Any, ttl_seconds: Optional[float] = None,
                       max_idle_seconds: Optional[float] = None) -> Any:

@@ -640,3 +640,31 @@ class TransferQueue(BlockingQueue):
     def has_waiting_consumer(self) -> bool:
         with self._store.lock:
             return self._waiting_count() > 0
+
+    def contains(self, value: Any) -> bool:
+        """Sees pending-transfer slots too (an element mid-handoff IS in
+        the queue — inherited byte-compare would miss the slot shape)."""
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return False
+            vb = self._enc(value)
+            return any(
+                (raw[0] if isinstance(raw, list) else raw) == vb
+                for raw in e.value
+            )
+
+    def remove(self, value: Any) -> bool:
+        """Removing a pending-transfer element counts as consuming it —
+        the blocked transferer resolves True."""
+        with self._store.cond:
+            e = self._entry(create=False)
+            if e is None:
+                return False
+            vb = self._enc(value)
+            for i, raw in enumerate(e.value):
+                if (raw[0] if isinstance(raw, list) else raw) == vb:
+                    del e.value[i]
+                    self._store.cond.notify_all()
+                    return True
+            return False

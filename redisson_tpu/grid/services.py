@@ -220,6 +220,16 @@ class ExecutorService(CamelCompatMixin):
     def shutdown(self) -> None:
         with self._cond:
             self._shutdown = True
+            # Scheduled-but-not-yet-due tasks will never fire: resolve
+            # their futures with a rejection instead of leaving callers
+            # blocked forever.
+            for _fire_at, _period, task in self._scheduled:
+                fut = self._futures.pop(task[0], None)
+                if fut is not None and not fut.done():
+                    fut._resolve(
+                        error=RuntimeError("executor service shut down")
+                    )
+            self._scheduled.clear()
             self._cond.notify_all()
 
     def is_shutdown(self) -> bool:

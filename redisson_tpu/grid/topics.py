@@ -168,3 +168,10 @@ class PatternTopic(CamelCompatMixin):
 
     def remove_listener(self, listener_id: int) -> None:
         self._bus.unsubscribe_pattern(self._pattern, listener_id)
+
+
+class ShardedTopic(Topic):
+    """→ RedissonShardedTopic (SPUBLISH/SSUBSCRIBE): in Redis cluster the
+    channel pins to one slot's shard; in-process there is one bus, so the
+    semantic difference (no cross-shard broadcast fan-out) is moot — the
+    API class exists so reference code ports verbatim."""

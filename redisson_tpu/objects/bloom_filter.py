@@ -102,6 +102,18 @@ class BloomFilter(RObject):
 
     contains_async = contains_all_async
 
+    # -- read replication (SURVEY §2.4 replication row) ---------------------
+
+    def set_replicated(self) -> bool:
+        """Copy this filter's row to EVERY mesh shard: reads spread
+        round-robin across copies (contains() is read-heavy — the
+        ReadMode.SLAVE analog), writes broadcast to all.  False on a
+        single-device executor (nothing to spread across)."""
+        return self._engine.bloom_replicate(self._name)
+
+    def is_replicated(self) -> bool:
+        return self._engine.bloom_is_replicated(self._name)
+
     def count(self) -> int:
         """→ RBloomFilter#count: estimated number of inserted elements."""
         return int(self._engine.bloom_count(self._name).result())

@@ -48,8 +48,14 @@ class SketchDurabilityMixin:
                 detached = self.registry.detach_if(entry.name, entry)
                 if detached is not None:
                     self._drain()
-                    self.executor.zero_row(entry.pool, entry.row)
-                    entry.pool.free_row(entry.row)
+                    rows = (
+                        list(entry.replica_rows)
+                        if entry.replica_rows
+                        else [entry.row]
+                    )
+                    for row in rows:
+                        self.executor.zero_row(entry.pool, row)
+                        entry.pool.free_row(row)
                     # Shared heavy-hitter table dies with the object (a
                     # successor under this name must not inherit ghosts).
                     self.topk.drop(entry.name)
@@ -189,6 +195,7 @@ class SketchDurabilityMixin:
                     "row": e.row,
                     "params": e.params,
                     "expire_at": e.expire_at,
+                    "replica_rows": e.replica_rows,
                 }
                 for e in self.registry.entries()
             ]
@@ -227,13 +234,16 @@ class SketchDurabilityMixin:
             for t in meta["tenants"]:
                 pool = by_key[tuple(t["pool_key"])]
                 row = int(t["row"])
-                if row in pool._free:
-                    pool._free.remove(row)
+                replicas = t.get("replica_rows")
+                owned = list(replicas) if replicas else [row]
+                for r in owned:
+                    if r in pool._free:
+                        pool._free.remove(r)
                 from redisson_tpu.tenancy.registry import TenantEntry
 
                 self.registry._tenants[t["name"]] = TenantEntry(
                     t["name"], t["kind"], pool, row, dict(t["params"]),
-                    t.get("expire_at"),
+                    t.get("expire_at"), replicas,
                 )
                 if t.get("expire_at") is not None:
                     self._ensure_sweeper()

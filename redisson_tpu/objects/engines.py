@@ -206,10 +206,16 @@ class TpuSketchEngine(SketchDurabilityMixin):
             entry.expire_at is not None and _time.time() >= entry.expire_at
         )
         self._drain()
-        self.executor.zero_row(entry.pool, entry.row)
-        entry.pool.free_row(entry.row)
+        for row in self._entry_rows(entry):
+            self.executor.zero_row(entry.pool, row)
+            entry.pool.free_row(row)
         self.topk.drop(name)
         return not was_expired
+
+    @staticmethod
+    def _entry_rows(entry) -> list:
+        """Every device row an entry owns (primary + read replicas)."""
+        return list(entry.replica_rows) if entry.replica_rows else [entry.row]
 
     def rename(self, old: str, new: str) -> bool:
         if old == new or self._live_lookup(old) is None:
@@ -223,8 +229,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if not ok:
             return False
         if dest is not None:
-            self.executor.zero_row(dest.pool, dest.row)
-            dest.pool.free_row(dest.row)
+            for row in self._entry_rows(dest):
+                self.executor.zero_row(dest.pool, row)
+                dest.pool.free_row(row)
         self.topk.rename(old, new)
         return True
 
@@ -276,6 +283,79 @@ class TpuSketchEngine(SketchDurabilityMixin):
             entry.expire_at is None or _time.time() < entry.expire_at
         )
 
+    # -- bloom read replication (SURVEY §2.4 replication row / the
+    # ReadMode.SLAVE analog): a hot tenant's row copies to every shard;
+    # reads spread round-robin across copies, writes broadcast to all ----
+
+    def bloom_replicate(self, name: str) -> bool:
+        """Replicate a bloom filter's row to every mesh shard.  No-op
+        (False) on the single-device executor — there is nothing to
+        spread reads across.
+
+        Ordering vs concurrent writers (bloom bits only ever turn ON, so
+        OR-merge makes this safe): the replica rows are published FIRST
+        (new writers broadcast from then on, landing bits in the fresh
+        rows), THEN queued primary-only writes drain, THEN the primary is
+        OR-merged into each replica — a broadcast bit is never erased and
+        a drained primary bit always reaches every copy.  The drain+merge
+        runs twice, closing writers that captured the pre-publish state
+        but had not yet submitted at the first drain."""
+        S = getattr(self.executor, "S", 1)
+        if S <= 1:
+            return False
+        entry = self._lookup_kind(name, PoolKind.BLOOM)
+        if entry is None:
+            raise RuntimeError(f"bloom filter {name!r} is not initialized")
+        with self.registry._lock:
+            if entry.replica_rows:
+                return True
+            replicas = [None] * S
+            replicas[entry.row % S] = entry.row
+            for s in range(S):
+                if replicas[s] is None:
+                    replicas[s] = entry.pool.alloc_row_with_residue(s, S)
+            entry.replica_rows = replicas  # published: writers broadcast now
+        for _ in range(2):
+            self._drain()
+            for r in replicas:
+                if r != entry.row:
+                    # replica |= primary (device-side, serialized with all
+                    # dispatches by the executor lock; rows are uint32
+                    # bitmaps, so the bitset OR kernel applies verbatim).
+                    self.executor.bitset_bitop(
+                        entry.pool, r, [r, entry.row], "or"
+                    )
+        return True
+
+    def bloom_is_replicated(self, name: str) -> bool:
+        entry = self._lookup_kind(name, PoolKind.BLOOM)
+        return bool(entry is not None and entry.replica_rows)
+
+    def _bloom_expand_ops(self, entry, B: int, is_add):
+        """(rows[B'], expand_idx[B'], primary_pos[B]) for a replicated
+        entry: writes fan out to every replica (results identical on all
+        copies — every write reaches every copy, so any one stands in);
+        reads rotate across replicas.  ``expand_idx`` maps each expanded
+        op back to its source op (for gathering the other columns)."""
+        replicas = np.asarray(entry.replica_rows, np.int32)
+        S = len(replicas)
+        base = getattr(self, "_rr_counter", 0)
+        self._rr_counter = base + B  # benign race: balance, not correctness
+        is_add = np.asarray(is_add, bool)
+        # Vectorized expansion (this is the dispatch hot path): each add
+        # becomes S consecutive slots (replica 0..S-1), each read one slot.
+        counts = np.where(is_add, S, 1)
+        primary_pos = np.zeros(B, np.int64)
+        np.cumsum(counts[:-1], out=primary_pos[1:])
+        expand_idx = np.repeat(np.arange(B, dtype=np.int64), counts)
+        ranks = np.arange(len(expand_idx), dtype=np.int64) - primary_pos[expand_idx]
+        rows = np.where(
+            is_add[expand_idx],
+            replicas[ranks % S],
+            replicas[(base + expand_idx) % S],
+        ).astype(np.int32)
+        return rows, expand_idx, primary_pos
+
     # -- bloom -------------------------------------------------------------
 
     def bloom_try_init(self, name, expected_insertions, false_probability) -> bool:
@@ -298,11 +378,44 @@ class TpuSketchEngine(SketchDurabilityMixin):
         m = entry.params["size"]
         return hashing.km_reduce_mod(H1, H2, m)
 
+    def _bloom_dispatch_hashed(self, entry, h1m, h2m, is_add) -> LazyResult:
+        """One mixed-kernel dispatch for hashed ops, honoring replication:
+        replicated entries expand (writes fan to every copy, reads rotate)
+        and results gather back to per-source-op shape."""
+        m, k = entry.params["size"], entry.params["hash_iterations"]
+        B = len(h1m)
+        is_add = np.asarray(is_add, bool)
+        if entry.replica_rows:
+            rows, eidx, ppos = self._bloom_expand_ops(entry, B, is_add)
+            h1m, h2m, is_add = h1m[eidx], h2m[eidx], is_add[eidx]
+            gather = lambda v: v[ppos]  # noqa: E731
+        else:
+            rows = np.full(B, entry.row, np.int32)
+            gather = None
+        m_arr = np.full(len(rows), m, np.uint32)
+        pool = entry.pool
+        if self.coalescer is not None:
+            # Adds and contains share ONE segment per (pool, k) — the
+            # combined kernel keeps exact arrival-order semantics while
+            # mixed traffic coalesces instead of fragmenting (config 4).
+            fut = self._submit(
+                ("bloom_mix", id(pool), k),
+                lambda cols: self.executor.bloom_mixed(
+                    pool, cols[0], cols[1], k, cols[2], cols[3], cols[4]
+                ),
+                (rows, m_arr, h1m, h2m, is_add),
+                len(rows),
+                pool_key=id(pool),
+            )
+            return fut if gather is None else _MappedFuture(fut, gather)
+        res = self.executor.bloom_mixed(pool, rows, m_arr, k, h1m, h2m, is_add)
+        return res if gather is None else _MappedFuture(res, gather)
+
     def bloom_add(self, name, H1, H2) -> LazyResult:
         entry = self._require(name, PoolKind.BLOOM)
         h1m, h2m = self._bloom_reduce(entry, H1, H2)
         m, k = entry.params["size"], entry.params["hash_iterations"]
-        if not self.config.tpu_sketch.exact_add_semantics:
+        if not self.config.tpu_sketch.exact_add_semantics and not entry.replica_rows:
             # Fast single-tenant bulk path dispatches immediately — but only
             # after queued coalesced ops flush, so a contains submitted
             # *before* this add can never observe its writes (arrival-order
@@ -311,40 +424,17 @@ class TpuSketchEngine(SketchDurabilityMixin):
             return self.executor.bloom_add_fast_st(
                 entry.pool, entry.row, m, k, h1m, h2m
             )
-        rows = np.full(len(H1), entry.row, np.int32)
-        m_arr = np.full(len(H1), m, np.uint32)
-        if self.coalescer is not None:
-            # Adds and contains share ONE segment per (pool, k) — the
-            # combined kernel keeps exact arrival-order semantics while
-            # mixed traffic coalesces instead of fragmenting (config 4).
-            pool = entry.pool
-            return self._submit(
-                ("bloom_mix", id(pool), k),
-                lambda cols: self.executor.bloom_mixed(
-                    pool, cols[0], cols[1], k, cols[2], cols[3], cols[4]
-                ),
-                (rows, m_arr, h1m, h2m, np.ones(len(H1), bool)),
-                len(H1),
-                pool_key=id(pool),
-            )
-        return self.executor.bloom_add(entry.pool, rows, m_arr, k, h1m, h2m)
+        return self._bloom_dispatch_hashed(
+            entry, h1m, h2m, np.ones(len(H1), bool)
+        )
 
     def bloom_contains(self, name, H1, H2) -> LazyResult:
         entry = self._require(name, PoolKind.BLOOM)
         h1m, h2m = self._bloom_reduce(entry, H1, H2)
         m, k = entry.params["size"], entry.params["hash_iterations"]
-        if self.coalescer is not None:
-            pool = entry.pool
-            rows = np.full(len(H1), entry.row, np.int32)
-            m_arr = np.full(len(H1), m, np.uint32)
-            return self._submit(
-                ("bloom_mix", id(pool), k),
-                lambda cols: self.executor.bloom_mixed(
-                    pool, cols[0], cols[1], k, cols[2], cols[3], cols[4]
-                ),
-                (rows, m_arr, h1m, h2m, np.zeros(len(H1), bool)),
-                len(H1),
-                pool_key=id(pool),
+        if self.coalescer is not None or entry.replica_rows:
+            return self._bloom_dispatch_hashed(
+                entry, h1m, h2m, np.zeros(len(H1), bool)
             )
         return self.executor.bloom_contains_st(
             entry.pool, entry.row, m, k, h1m, h2m
@@ -364,40 +454,52 @@ class TpuSketchEngine(SketchDurabilityMixin):
     # hash on the host as before.
 
     def _bloom_submit_mixed_keys(self, entry, blocks, lengths, is_add: bool):
-        """Coalesced device-hash path: raw codec lanes ride the mixed
-        kernel; producer threads never hash (GIL relief under offered
-        load).  Lane count is part of the segment key so concatenated
-        chunks always agree on shape."""
+        """Device-hash path: raw codec lanes ride the mixed kernel;
+        producer threads never hash (GIL relief under offered load).
+        Replicated entries expand writes to every copy and rotate reads.
+        Lane count is part of the segment key so concatenated chunks
+        always agree on shape."""
         m, k = entry.params["size"], entry.params["hash_iterations"]
         pool = entry.pool
         B = blocks.shape[0]
         L = blocks.shape[1]
-        rows = np.full(B, entry.row, np.int32)
-        m_arr = np.full(B, m, np.uint32)
-        flags = np.full(B, is_add, bool)
         lengths = np.asarray(lengths, np.uint32)
         if lengths.ndim == 0:
             lengths = np.full(B, lengths, np.uint32)
-        return self._submit(
-            ("bloom_mixk", id(pool), k, L),
-            lambda cols: self.executor.bloom_mixed_keys(
-                pool, cols[0], cols[1], k, cols[2], cols[3], cols[4]
-            ),
-            (rows, m_arr, blocks, lengths, flags),
-            B,
-            pool_key=id(pool),
+        flags = np.full(B, is_add, bool)
+        if entry.replica_rows:
+            rows, eidx, ppos = self._bloom_expand_ops(entry, B, flags)
+            blocks, lengths, flags = blocks[eidx], lengths[eidx], flags[eidx]
+            gather = lambda v: v[ppos]  # noqa: E731
+        else:
+            rows = np.full(B, entry.row, np.int32)
+            gather = None
+        m_arr = np.full(len(rows), m, np.uint32)
+        if self.coalescer is not None:
+            fut = self._submit(
+                ("bloom_mixk", id(pool), k, L),
+                lambda cols: self.executor.bloom_mixed_keys(
+                    pool, cols[0], cols[1], k, cols[2], cols[3], cols[4]
+                ),
+                (rows, m_arr, blocks, lengths, flags),
+                len(rows),
+                pool_key=id(pool),
+            )
+            return fut if gather is None else _MappedFuture(fut, gather)
+        res = self.executor.bloom_mixed_keys(
+            pool, rows, m_arr, k, blocks, lengths, flags
         )
+        return res if gather is None else _MappedFuture(res, gather)
 
     def bloom_add_encoded(self, name, blocks, lengths) -> LazyResult:
         if self.executor.supports_device_hash:
+            entry = self._require(name, PoolKind.BLOOM)
             if (
                 self.coalescer is not None
                 and self.config.tpu_sketch.exact_add_semantics
-            ):
-                entry = self._require(name, PoolKind.BLOOM)
+            ) or entry.replica_rows:
                 return self._bloom_submit_mixed_keys(entry, blocks, lengths, True)
             if not self.config.tpu_sketch.exact_add_semantics:
-                entry = self._require(name, PoolKind.BLOOM)
                 m, k = entry.params["size"], entry.params["hash_iterations"]
                 self._drain()
                 return self.executor.bloom_add_keys_st(
@@ -408,7 +510,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
     def bloom_contains_encoded(self, name, blocks, lengths) -> LazyResult:
         if self.executor.supports_device_hash:
             entry = self._require(name, PoolKind.BLOOM)
-            if self.coalescer is not None:
+            if self.coalescer is not None or entry.replica_rows:
                 return self._bloom_submit_mixed_keys(entry, blocks, lengths, False)
             m, k = entry.params["size"], entry.params["hash_iterations"]
             return self.executor.bloom_contains_keys_st(
@@ -941,6 +1043,12 @@ class HostSketchEngine:
 
     def bloom_contains_encoded(self, name, blocks, lengths):
         return self.bloom_contains(name, *hashing.hash128_np(blocks, lengths))
+
+    def bloom_replicate(self, name) -> bool:
+        return False  # one host copy; nothing to spread reads across
+
+    def bloom_is_replicated(self, name) -> bool:
+        return False
 
     # -- hll ---------------------------------------------------------------
 

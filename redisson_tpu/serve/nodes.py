@@ -1,0 +1,81 @@
+"""NodesGroup — → org/redisson/api/NodesGroup / RedisNodes (SURVEY.md
+§2.3 admin row): per-node ping/info.  Nodes here are the devices of the
+execution backend (the mesh shards in cluster mode, the single chip
+otherwise); ``ping`` round-trips a tiny computation through each device.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+
+class Node:
+    def __init__(self, device, shard: int):
+        self._device = device
+        self.shard = shard
+
+    @property
+    def address(self) -> str:
+        return f"{self._device.platform}:{self._device.id}"
+
+    def ping(self, timeout_seconds: float = 30.0) -> bool:
+        """One tiny device round trip (the PING health check analog)."""
+        import jax.numpy as jnp
+
+        try:
+            import jax
+
+            x = jax.device_put(jnp.ones((8,), jnp.uint32), self._device)
+            return int((x + 1).sum()) == 16
+        except Exception:
+            return False
+
+    def info(self) -> dict[str, Any]:
+        """→ Node#info (INFO reply analog): device identity + memory."""
+        d = self._device
+        out = {
+            "id": d.id,
+            "platform": d.platform,
+            "device_kind": getattr(d, "device_kind", "unknown"),
+            "process_index": getattr(d, "process_index", 0),
+            "shard": self.shard,
+        }
+        try:
+            stats = d.memory_stats()
+            if stats:
+                out["bytes_in_use"] = stats.get("bytes_in_use")
+                out["bytes_limit"] = stats.get("bytes_limit")
+        except Exception:
+            pass
+        return out
+
+    def time(self) -> float:
+        """→ Node#time (TIME): host clock — devices carry no wall clock."""
+        return time.time()
+
+
+class NodesGroup:
+    """→ RedissonClient#getNodesGroup."""
+
+    def __init__(self, client):
+        self._client = client
+
+    def _devices(self):
+        engine = self._client._engine
+        ctx = getattr(getattr(engine, "executor", None), "ctx", None)
+        if ctx is not None:
+            return list(ctx.devices)
+        import jax
+
+        try:
+            return [jax.devices()[0]]
+        except Exception:
+            return []
+
+    def get_nodes(self) -> list[Node]:
+        return [Node(d, i) for i, d in enumerate(self._devices())]
+
+    def ping_all(self) -> bool:
+        nodes = self.get_nodes()
+        return bool(nodes) and all(n.ping() for n in nodes)

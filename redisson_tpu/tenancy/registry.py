@@ -118,6 +118,16 @@ class SizeClassPool:
         # Caller (executor) must zero the row on device before recycling.
         self._free.append(row)
 
+    def alloc_row_with_residue(self, residue: int, S: int) -> int:
+        """Allocate a row with ``row % S == residue`` — replica placement
+        needs one copy resident on each mesh shard."""
+        with self._dispatch_lock:
+            while True:
+                for i in range(len(self._free) - 1, -1, -1):
+                    if self._free[i] % S == residue:
+                        return self._free.pop(i)
+                self._grow()
+
     def _grow(self) -> None:
         old_cap = self.capacity
         new_cap = old_cap * 2
@@ -146,6 +156,9 @@ class TenantEntry:
     row: int
     params: dict = field(default_factory=dict)
     expire_at: Optional[float] = None
+    # Read replication (SURVEY §2.4 replication row): one row per mesh
+    # shard (index s holds the copy with row % S == s); None = single copy.
+    replica_rows: Optional[list] = None
 
 
 class TenantRegistry:

@@ -1,0 +1,105 @@
+"""Catalog wave 4: map entry listeners, ShardedTopic, JsonBucket,
+NodesGroup admin."""
+
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+
+
+@pytest.fixture
+def client():
+    c = redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
+    yield c
+    c.shutdown()
+
+
+class TestMapEntryListeners:
+    def test_created_updated_removed_events(self, client):
+        m = client.get_map("lm")
+        events = []
+        m.add_listener(lambda ev, k, v: events.append((ev, k, v)))
+        m.put("k", 1)       # created
+        m.put("k", 2)       # updated
+        m.remove("k")       # removed
+        client._topic_bus.drain()
+        assert events == [
+            ("created", "k", 1), ("updated", "k", 2), ("removed", "k", 2),
+        ]
+
+    def test_event_filter_and_remove_listener(self, client):
+        m = client.get_map("lm2")
+        created = []
+        lid = m.add_listener(lambda ev, k, v: created.append(k), event="created")
+        m.put("a", 1)
+        m.put("a", 2)  # update: filtered out
+        client._topic_bus.drain()
+        assert created == ["a"]
+        m.remove_listener(lid)
+        m.put("b", 1)
+        client._topic_bus.drain()
+        assert created == ["a"]
+
+    def test_mapcache_puts_emit(self, client):
+        mc = client.get_map_cache("lmc")
+        events = []
+        mc.add_listener(lambda ev, k, v: events.append(ev))
+        mc.put("k", 1, ttl_seconds=30)
+        mc.fast_put("k", 2)
+        client._topic_bus.drain()
+        assert events == ["created", "updated"]
+
+
+class TestShardedTopic:
+    def test_publish_subscribe(self, client):
+        t = client.get_sharded_topic("st")
+        got = []
+        t.add_listener(lambda ch, m: got.append(m))
+        assert t.publish("msg") == 1
+        client._topic_bus.drain()
+        assert got == ["msg"]
+
+
+class TestJsonBucket:
+    def test_root_and_paths(self, client):
+        jb = client.get_json_bucket("doc")
+        jb.set({"user": {"name": "ada", "tags": ["a"], "visits": 1}})
+        assert jb.get_path("user.name") == "ada"
+        jb.set_path("user.name", "grace")
+        assert jb.get_path("user.name") == "grace"
+        assert jb.array_append("user.tags", "b", "c") == 3
+        assert jb.get_path("user.tags") == ["a", "b", "c"]
+        assert jb.increment("user.visits", 5) == 6
+        assert jb.string_append("user.name", "!") == 6
+        assert jb.get_path("$")["user"]["name"] == "grace!"
+
+    def test_array_index_paths(self, client):
+        jb = client.get_json_bucket("doc2")
+        jb.set({"xs": [{"v": 1}, {"v": 2}]})
+        assert jb.get_path("xs.1.v") == 2
+        jb.set_path("xs.0.v", 10)
+        assert jb.get_path("xs.0.v") == 10
+
+
+class TestNodesGroup:
+    def test_ping_and_info(self, client):
+        ng = client.get_nodes_group()
+        nodes = ng.get_nodes()
+        assert nodes, "at least one device node"
+        assert ng.ping_all()
+        info = nodes[0].info()
+        assert "platform" in info and "id" in info
+        assert nodes[0].time() > 0
+
+    def test_sharded_mesh_lists_all_shards(self):
+        c = redisson_tpu.create(
+            Config().use_tpu_sketch(num_shards=8, min_bucket=64)
+        )
+        try:
+            nodes = c.get_nodes_group().get_nodes()
+            assert len(nodes) == 8
+            assert [n.shard for n in nodes] == list(range(8))
+        finally:
+            c.shutdown()
