@@ -396,6 +396,12 @@ class RedissonTpuClient(CamelCompatMixin):
         m = getattr(self._engine, "metrics", None)
         return {} if m is None else m.snapshot()
 
+    def get_profiler(self):
+        """→ jax.profiler device-trace capture (SURVEY.md §5 tracing row)."""
+        from redisson_tpu.serve.metrics import Profiler
+
+        return Profiler()
+
     def shutdown(self) -> None:
         """→ Redisson#shutdown."""
         if hasattr(self._engine, "shutdown"):

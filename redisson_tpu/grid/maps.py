@@ -352,7 +352,8 @@ class MapCache(Map):
             if cur is not None:
                 return cur
             self._put_slot(key, value, ttl_seconds, max_idle_seconds)
-            return None
+        self._emit(self._EVENT_CREATED, key, value)
+        return None
 
     def _put_slot(self, key, value, ttl_s, idle_s) -> None:
         e = self._entry()

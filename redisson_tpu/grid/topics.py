@@ -27,6 +27,12 @@ class TopicBus:
         self._pool = ThreadPoolExecutor(
             max_workers=n_threads, thread_name_prefix="rtpu-topic"
         )
+        # Per-channel FIFO delivery: each channel's messages drain on ONE
+        # worker at a time (cross-channel parallelism preserved) — with a
+        # free-for-all pool, two mutations' events could be observed out
+        # of order by the same listener.
+        self._chan_queues: dict[str, list] = {}
+        self._chan_active: set[str] = set()
 
     def subscribe(self, channel: str, listener: Callable) -> int:
         with self._lock:
@@ -57,7 +63,8 @@ class TopicBus:
                 self._pattern_listeners.get(pattern, {}).pop(listener_id, None)
 
     def publish(self, channel: str, message: Any) -> int:
-        """Returns the number of receivers (PUBLISH reply semantics)."""
+        """Returns the number of receivers (PUBLISH reply semantics).
+        Deliveries for one channel run in publish order (FIFO)."""
         with self._lock:
             targets = [
                 (None, fn) for fn in self._listeners.get(channel, {}).values()
@@ -65,12 +72,29 @@ class TopicBus:
             for pat, subs in self._pattern_listeners.items():
                 if fnmatch.fnmatchcase(channel, pat):
                     targets.extend((pat, fn) for fn in subs.values())
-        for pat, fn in targets:
-            if pat is None:
-                self._pool.submit(self._safe, fn, channel, message)
-            else:
-                self._pool.submit(self._safe_pattern, fn, pat, channel, message)
+            if targets:
+                self._chan_queues.setdefault(channel, []).append(
+                    (targets, message)
+                )
+                if channel not in self._chan_active:
+                    self._chan_active.add(channel)
+                    self._pool.submit(self._drain_channel, channel)
         return len(targets)
+
+    def _drain_channel(self, channel: str) -> None:
+        while True:
+            with self._lock:
+                queue = self._chan_queues.get(channel)
+                if not queue:
+                    self._chan_active.discard(channel)
+                    self._chan_queues.pop(channel, None)
+                    return
+                targets, message = queue.pop(0)
+            for pat, fn in targets:
+                if pat is None:
+                    self._safe(fn, channel, message)
+                else:
+                    self._safe_pattern(fn, pat, channel, message)
 
     @staticmethod
     def _safe(fn, channel, message) -> None:

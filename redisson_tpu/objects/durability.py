@@ -36,6 +36,13 @@ class SketchDurabilityMixin:
     """Requires: self.registry, self.executor, self._drain(), self.delete().
     """
 
+    @staticmethod
+    def _entry_rows(entry) -> list:
+        """Every device row an entry owns (primary + read replicas) — the
+        ONE place this enumeration lives (delete/expiry/rename/restore
+        all free through it)."""
+        return list(entry.replica_rows) if entry.replica_rows else [entry.row]
+
     # -- TTL / expiry (RedissonExpirable analog) ---------------------------
 
     def _expire_if_due(self, entry) -> bool:
@@ -48,12 +55,7 @@ class SketchDurabilityMixin:
                 detached = self.registry.detach_if(entry.name, entry)
                 if detached is not None:
                     self._drain()
-                    rows = (
-                        list(entry.replica_rows)
-                        if entry.replica_rows
-                        else [entry.row]
-                    )
-                    for row in rows:
+                    for row in self._entry_rows(entry):
                         self.executor.zero_row(entry.pool, row)
                         entry.pool.free_row(row)
                     # Shared heavy-hitter table dies with the object (a
@@ -232,19 +234,19 @@ class SketchDurabilityMixin:
                 self.executor.state_from_host(pool, arr)
             by_key = {tuple(p.spec.key): p for p in self.registry.pools()}
             for t in meta["tenants"]:
+                from redisson_tpu.tenancy.registry import TenantEntry
+
                 pool = by_key[tuple(t["pool_key"])]
                 row = int(t["row"])
                 replicas = t.get("replica_rows")
-                owned = list(replicas) if replicas else [row]
-                for r in owned:
-                    if r in pool._free:
-                        pool._free.remove(r)
-                from redisson_tpu.tenancy.registry import TenantEntry
-
-                self.registry._tenants[t["name"]] = TenantEntry(
+                restored = TenantEntry(
                     t["name"], t["kind"], pool, row, dict(t["params"]),
                     t.get("expire_at"), replicas,
                 )
+                for r in self._entry_rows(restored):
+                    if r in pool._free:
+                        pool._free.remove(r)
+                self.registry._tenants[t["name"]] = restored
                 if t.get("expire_at") is not None:
                     self._ensure_sweeper()
         return True

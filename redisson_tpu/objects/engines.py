@@ -212,11 +212,6 @@ class TpuSketchEngine(SketchDurabilityMixin):
         self.topk.drop(name)
         return not was_expired
 
-    @staticmethod
-    def _entry_rows(entry) -> list:
-        """Every device row an entry owns (primary + read replicas)."""
-        return list(entry.replica_rows) if entry.replica_rows else [entry.row]
-
     def rename(self, old: str, new: str) -> bool:
         if old == new or self._live_lookup(old) is None:
             return False
@@ -378,6 +373,17 @@ class TpuSketchEngine(SketchDurabilityMixin):
         m = entry.params["size"]
         return hashing.km_reduce_mod(H1, H2, m)
 
+    def _replication_fence(self, entry, saw_replicas, redispatch) -> None:
+        """Close the writer-vs-set_replicated race: a writer that read
+        ``replica_rows`` as unset and SUBMITTED before the publish is
+        reached by bloom_replicate's drain+merge; a writer whose submit
+        lands after the merge re-checks here (post-submit) and, seeing
+        the publish, re-dispatches the same ops as a broadcast.  Bloom
+        bits only turn ON, so the redundant re-write is idempotent and
+        the original future's results stay valid."""
+        if not saw_replicas and entry.replica_rows:
+            redispatch()
+
     def _bloom_dispatch_hashed(self, entry, h1m, h2m, is_add) -> LazyResult:
         """One mixed-kernel dispatch for hashed ops, honoring replication:
         replicated entries expand (writes fan to every copy, reads rotate)
@@ -385,7 +391,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         m, k = entry.params["size"], entry.params["hash_iterations"]
         B = len(h1m)
         is_add = np.asarray(is_add, bool)
-        if entry.replica_rows:
+        orig = (h1m, h2m, is_add)
+        saw_replicas = bool(entry.replica_rows)
+        if saw_replicas:
             rows, eidx, ppos = self._bloom_expand_ops(entry, B, is_add)
             h1m, h2m, is_add = h1m[eidx], h2m[eidx], is_add[eidx]
             gather = lambda v: v[ppos]  # noqa: E731
@@ -407,9 +415,17 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 len(rows),
                 pool_key=id(pool),
             )
-            return fut if gather is None else _MappedFuture(fut, gather)
-        res = self.executor.bloom_mixed(pool, rows, m_arr, k, h1m, h2m, is_add)
-        return res if gather is None else _MappedFuture(res, gather)
+        else:
+            fut = self.executor.bloom_mixed(
+                pool, rows, m_arr, k, h1m, h2m, is_add
+            )
+        if bool(np.any(orig[2])):
+            self._replication_fence(
+                entry,
+                saw_replicas,
+                lambda: self._bloom_dispatch_hashed(entry, *orig),
+            )
+        return fut if gather is None else _MappedFuture(fut, gather)
 
     def bloom_add(self, name, H1, H2) -> LazyResult:
         entry = self._require(name, PoolKind.BLOOM)
@@ -421,9 +437,17 @@ class TpuSketchEngine(SketchDurabilityMixin):
             # *before* this add can never observe its writes (arrival-order
             # contract of the coalescer docstring).
             self._drain()
-            return self.executor.bloom_add_fast_st(
+            res = self.executor.bloom_add_fast_st(
                 entry.pool, entry.row, m, k, h1m, h2m
             )
+            self._replication_fence(
+                entry,
+                False,
+                lambda: self._bloom_dispatch_hashed(
+                    entry, h1m, h2m, np.ones(len(H1), bool)
+                ),
+            )
+            return res
         return self._bloom_dispatch_hashed(
             entry, h1m, h2m, np.ones(len(H1), bool)
         )
@@ -467,7 +491,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if lengths.ndim == 0:
             lengths = np.full(B, lengths, np.uint32)
         flags = np.full(B, is_add, bool)
-        if entry.replica_rows:
+        orig = (blocks, lengths)
+        saw_replicas = bool(entry.replica_rows)
+        if saw_replicas:
             rows, eidx, ppos = self._bloom_expand_ops(entry, B, flags)
             blocks, lengths, flags = blocks[eidx], lengths[eidx], flags[eidx]
             gather = lambda v: v[ppos]  # noqa: E731
@@ -485,11 +511,17 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 len(rows),
                 pool_key=id(pool),
             )
-            return fut if gather is None else _MappedFuture(fut, gather)
-        res = self.executor.bloom_mixed_keys(
-            pool, rows, m_arr, k, blocks, lengths, flags
-        )
-        return res if gather is None else _MappedFuture(res, gather)
+        else:
+            fut = self.executor.bloom_mixed_keys(
+                pool, rows, m_arr, k, blocks, lengths, flags
+            )
+        if is_add:
+            self._replication_fence(
+                entry,
+                saw_replicas,
+                lambda: self._bloom_submit_mixed_keys(entry, *orig, True),
+            )
+        return fut if gather is None else _MappedFuture(fut, gather)
 
     def bloom_add_encoded(self, name, blocks, lengths) -> LazyResult:
         if self.executor.supports_device_hash:
@@ -502,9 +534,17 @@ class TpuSketchEngine(SketchDurabilityMixin):
             if not self.config.tpu_sketch.exact_add_semantics:
                 m, k = entry.params["size"], entry.params["hash_iterations"]
                 self._drain()
-                return self.executor.bloom_add_keys_st(
+                res = self.executor.bloom_add_keys_st(
                     entry.pool, entry.row, m, k, blocks, lengths
                 )
+                self._replication_fence(
+                    entry,
+                    False,
+                    lambda: self._bloom_submit_mixed_keys(
+                        entry, blocks, lengths, True
+                    ),
+                )
+                return res
         return self.bloom_add(name, *hashing.hash128_np(blocks, lengths))
 
     def bloom_contains_encoded(self, name, blocks, lengths) -> LazyResult:

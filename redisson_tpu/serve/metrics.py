@@ -86,3 +86,74 @@ class Metrics:
             lines.append(f"# TYPE redisson_tpu_{k} gauge")
             lines.append(f"redisson_tpu_{k} {v}")
         return "\n".join(lines) + "\n"
+
+
+class Profiler:
+    """jax.profiler integration (SURVEY.md §5 tracing row): captures a
+    device trace (TensorBoard/Perfetto-compatible) around a workload
+    window, alongside the per-batch wait/flush reservoirs above.
+
+    Usage::
+
+        prof = client.get_profiler()
+        prof.start("/tmp/rtpu-trace")
+        ... workload ...
+        prof.stop()   # trace dir now holds the .trace/.pb files
+
+    Or as a context manager: ``with client.get_profiler().trace(dir): ...``
+    """
+
+    def __init__(self):
+        self._active = False
+
+    def start(self, log_dir: str) -> None:
+        import jax
+
+        if self._active:
+            raise RuntimeError("a profiler trace is already active")
+        jax.profiler.start_trace(log_dir)
+        self._active = True
+
+    def stop(self) -> None:
+        import jax
+
+        if not self._active:
+            return
+        jax.profiler.stop_trace()
+        self._active = False
+
+    def trace(self, log_dir: str):
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _ctx():
+            self.start(log_dir)
+            try:
+                yield self
+            finally:
+                self.stop()
+
+        return _ctx()
+
+    @staticmethod
+    def annotate(name: str):
+        """Named region inside a trace (→ jax.profiler.TraceAnnotation)."""
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+
+    @staticmethod
+    def device_memory() -> dict:
+        """Current device memory stats (bytes), when the backend exposes
+        them."""
+        import jax
+
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            return {
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+            }
+        except Exception:
+            return {}

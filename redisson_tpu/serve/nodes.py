@@ -20,16 +20,27 @@ class Node:
         return f"{self._device.platform}:{self._device.id}"
 
     def ping(self, timeout_seconds: float = 30.0) -> bool:
-        """One tiny device round trip (the PING health check analog)."""
-        import jax.numpy as jnp
+        """One tiny device round trip (the PING health check analog),
+        bounded by ``timeout_seconds`` — a wedged device returns False
+        instead of hanging the health check."""
+        import threading
 
-        try:
+        result = [False]
+
+        def probe():
             import jax
+            import jax.numpy as jnp
 
-            x = jax.device_put(jnp.ones((8,), jnp.uint32), self._device)
-            return int((x + 1).sum()) == 16
-        except Exception:
-            return False
+            try:
+                x = jax.device_put(jnp.ones((8,), jnp.uint32), self._device)
+                result[0] = int((x + 1).sum()) == 16
+            except Exception:
+                result[0] = False
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout_seconds)
+        return result[0] and not t.is_alive()
 
     def info(self) -> dict[str, Any]:
         """→ Node#info (INFO reply analog): device identity + memory."""

@@ -103,3 +103,35 @@ class TestNodesGroup:
             assert [n.shard for n in nodes] == list(range(8))
         finally:
             c.shutdown()
+
+
+class TestProfiler:
+    def test_trace_capture(self, client, tmp_path):
+        import os
+
+        prof = client.get_profiler()
+        with prof.trace(str(tmp_path)):
+            bf = client.get_bloom_filter("prof-bf")
+            bf.try_init(1000, 0.01)
+            bf.add_all([1, 2, 3])
+            with prof.annotate("probe"):
+                bf.contains(1)
+        # A trace directory with at least one artifact was produced.
+        found = [
+            os.path.join(r, f)
+            for r, _, fs in os.walk(tmp_path)
+            for f in fs
+        ]
+        assert found, "profiler produced no trace files"
+        assert isinstance(prof.device_memory(), dict)
+
+    def test_double_start_raises(self, client, tmp_path):
+        import pytest as _pytest
+
+        prof = client.get_profiler()
+        prof.start(str(tmp_path))
+        try:
+            with _pytest.raises(RuntimeError):
+                prof.start(str(tmp_path))
+        finally:
+            prof.stop()
