@@ -115,3 +115,54 @@ class TestReplication:
         bf.try_init(1000, 0.01)
         assert bf.set_replicated() is False
         assert bf.is_replicated() is False
+
+
+class TestReplicationFence:
+    def test_fence_redispatches_when_publish_races(self, client):
+        """A writer that captured replica_rows=None before the publish
+        must re-dispatch as a broadcast (post-submit re-check)."""
+        eng = client._engine
+        bf = client.get_bloom_filter("fence")
+        bf.try_init(10_000, 0.01)
+        entry = eng.registry.lookup("fence")
+        calls = []
+        eng._replication_fence(entry, False, lambda: calls.append(1))
+        assert calls == []  # not replicated: nothing to do
+        bf.set_replicated()
+        eng._replication_fence(entry, False, lambda: calls.append(1))
+        assert calls == [1]  # stale capture + published -> re-dispatch
+        eng._replication_fence(entry, True, lambda: calls.append(1))
+        assert calls == [1]  # fresh capture: no re-dispatch
+
+    def test_concurrent_writes_during_replicate_no_false_negatives(self, client):
+        """Stress the real race: writers add while set_replicated runs;
+        afterwards every added key must be visible on EVERY replica."""
+        import threading
+
+        import numpy as np
+
+        bf = client.get_bloom_filter("fence-stress")
+        bf.try_init(50_000, 0.01)
+        added = []
+        stop = threading.Event()
+
+        def writer(tid):
+            i = 0
+            while not stop.is_set() and i < 40:
+                keys = np.arange(tid * 10_000 + i * 50,
+                                 tid * 10_000 + i * 50 + 50, dtype=np.uint64)
+                bf.add_all(keys)
+                added.append(keys)
+                i += 1
+
+        threads = [threading.Thread(target=writer, args=(t,)) for t in range(3)]
+        for t in threads:
+            t.start()
+        bf.set_replicated()
+        stop.set()
+        for t in threads:
+            t.join()
+        all_keys = np.concatenate(added)
+        # Check MANY times: reads rotate across every replica row.
+        for _ in range(8):
+            assert all(bf.contains_each(all_keys)), "false negative on a replica"
