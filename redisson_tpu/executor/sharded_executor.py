@@ -109,6 +109,9 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
     # in-kernel on the owning shard (was False in round 2 — sharded mode
     # silently dropped the device-hash fast path).
     supports_device_hash = True
+    # The Pallas sequential kernel operates on a single-device VMEM table;
+    # sharded CMS traffic uses the partitioned XLA path instead.
+    supports_pallas_cms = False
 
     def __init__(self, config):
         super().__init__(config)

@@ -732,6 +732,44 @@ class TpuCommandExecutor:
         pool.state, est = fn(pool.state, rows_p, h1p, h2p, w_p)
         return LazyResult(est, B)
 
+    # Pallas heavy-hitter path (BASELINE config 5): exact SEQUENTIAL
+    # streaming semantics — op j's estimate includes ops < j only, which
+    # the vectorized XLA path cannot express (it applies the whole batch
+    # before estimating).  The counter table is VMEM-resident for the
+    # launch.  Single-device only; the sharded executor falls back.
+    supports_pallas_cms = True
+
+    def cms_update_estimate_seq(self, pool, row: int, h1w, h2w, weights, d: int, w: int) -> LazyResult:
+        from redisson_tpu.ops import pallas_cms
+
+        B = h1w.shape[0]
+        u = pool.row_units
+        interpret = jax.default_backend() == "cpu"
+        key = ("cms_seq", pool.state.shape[0], u, d, w, -(-B // 128) * 128)
+
+        def build():
+            def f(state, row, h1, h2, wt):
+                rowdata = bitops.row_slice(state, row, u)
+                table = rowdata[: d * w].reshape(d, w)
+                new_table, est = pallas_cms.cms_update_estimate_seq(
+                    table, h1, h2, wt, d=d, w=w, interpret=interpret
+                )
+                newrow = jnp.concatenate(
+                    [new_table.reshape(-1), rowdata[d * w :]]
+                )
+                return bitops.row_update(state, row, newrow, u), est
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        pool.state, est = fn(
+            pool.state,
+            np.int32(row),
+            jnp.asarray(np.asarray(h1w, np.uint32)),
+            jnp.asarray(np.asarray(h2w, np.uint32)),
+            jnp.asarray(np.asarray(weights, np.uint32)),
+        )
+        return LazyResult(est, B)
+
     def cms_merge(self, pool, dst_row: int, src_rows) -> LazyResult:
         S = len(src_rows)
         u = pool.row_units
@@ -831,6 +869,7 @@ DISPATCH_METHODS = (
     "cms_update",
     "cms_estimate",
     "cms_update_estimate",
+    "cms_update_estimate_seq",
     "cms_merge",
     "zero_row",
     "read_row",

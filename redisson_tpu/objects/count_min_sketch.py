@@ -98,6 +98,11 @@ class CountMinSketch(RObject):
         k = self._engine.topk.track(self._name)
         if not k:
             return fut
+        return _OfferOnResult(fut, self._make_offer(objs, k))
+
+    def _make_offer(self, objs, k: int):
+        """Top-K candidate feed shared by add_all_async and add_all_seq:
+        the batch's heaviest UNIQUE keys (≤4k) go to the engine table."""
         name, engine = self._name, self._engine
         objs_ref = list(objs) if not isinstance(objs, np.ndarray) else objs
 
@@ -131,7 +136,28 @@ class CountMinSketch(RObject):
             engine.topk.offer(name, keys, ests_arr[top])
             return est
 
-        return _OfferOnResult(fut, offer)
+        return offer
+
+    def add_all_seq(self, objs, counts=None) -> np.ndarray:
+        """Exact-streaming variant of add_all (the Pallas heavy-hitter
+        kernel, BASELINE config 5): each op's returned estimate reflects
+        only the ops before it in the batch — the true at-sequence-point
+        streaming semantics.  add_all's vectorized path instead returns
+        post-whole-batch estimates (same final table either way)."""
+        H1, H2 = self._hash128(objs)
+        if counts is None:
+            counts = np.ones(len(H1), np.uint32)
+        fut = self._engine.cms_add_seq(
+            self._name, H1, H2, np.asarray(counts, np.uint32)
+        )
+        res = np.asarray(fut.result())
+        k = self._engine.topk.track(self._name)
+        if k:
+            # Sequential estimates are per-op lower than batch-final; the
+            # shared table max-merges, so offering them is still sound —
+            # same unique-key/cap selection as add_all_async.
+            self._make_offer(objs, k)(res)
+        return res
 
     def estimate(self, obj) -> int:
         # [obj], never np.atleast_1d: coercing a python int to np.int64

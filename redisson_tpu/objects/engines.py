@@ -862,6 +862,28 @@ class TpuSketchEngine(SketchDurabilityMixin):
             )
         return self.executor.cms_estimate(entry.pool, rows, h1w, h2w, d, w)
 
+    def cms_add_seq(self, name, H1, H2, weights) -> LazyResult:
+        """Exact-streaming add+estimate via the Pallas heavy-hitter kernel
+        (BASELINE config 5): op j's estimate reflects ops < j only — the
+        true at-sequence-point streaming contract.  Falls back to the
+        vectorized XLA path where the kernel isn't available (sharded
+        mode), whose estimates include the whole batch."""
+        entry = self._require(name, PoolKind.CMS)
+        d, w = entry.params["depth"], entry.params["width"]
+        if (
+            not getattr(self.executor, "supports_pallas_cms", False)
+            or (d * w) % 128 != 0  # VMEM lane-block geometry
+            or d * w * 4 > (8 << 20)  # table must fit VMEM
+            or len(H1) == 0
+        ):
+            return self.cms_add(name, H1, H2, weights)
+        h1w, h2w = hashing.km_reduce_mod(H1, H2, w)
+        self._drain()  # sequential semantics: all queued ops land first
+        return self.executor.cms_update_estimate_seq(
+            entry.pool, entry.row, h1w, h2w,
+            np.asarray(weights, np.uint32), d, w,
+        )
+
     def cms_merge(self, name, other_names) -> None:
         entry = self._require(name, PoolKind.CMS)
         srcs = []
@@ -1280,6 +1302,20 @@ class HostSketchEngine:
         h1w, h2w = hashing.km_reduce_mod(H1, H2, model.width)
         with self._lock:
             return ImmediateResult(model.estimate_hashed(h1w, h2w).astype(np.uint32))
+
+    def cms_add_seq(self, name, H1, H2, weights):
+        """Exact-streaming semantics (parity with the TPU Pallas path):
+        one-op-at-a-time through the golden model."""
+        o = self._require(name, PoolKind.CMS)
+        model = o["model"]
+        h1w, h2w = hashing.km_reduce_mod(H1, H2, model.width)
+        weights = np.asarray(weights, np.uint32)
+        with self._lock:
+            est = np.zeros(len(h1w), np.uint32)
+            for j in range(len(h1w)):
+                model.add_hashed(h1w[j : j + 1], h2w[j : j + 1], weights[j : j + 1])
+                est[j] = model.estimate_hashed(h1w[j : j + 1], h2w[j : j + 1])[0]
+            return ImmediateResult(est)
 
     def cms_merge(self, name, other_names) -> None:
         o = self._require(name, PoolKind.CMS)

@@ -1,0 +1,150 @@
+"""Pallas heavy-hitter kernel (BASELINE config 5): single-tenant CMS
+update+estimate with the counter table resident in VMEM.
+
+Why Pallas here: the streaming heavy-hitter step is a scatter/gather loop
+with per-op data dependence (op j's estimate must include ops < j — the
+true streaming semantics).  The XLA path (ops/cms.py) vectorizes by
+applying ALL updates then estimating, so same-batch duplicates see each
+other's counts; this kernel walks ops IN ORDER against the VMEM-resident
+table, giving exact sequential streaming estimates while the table stays
+on-chip for the whole batch (one HBM round trip per launch instead of
+d gathers + d scatters).
+
+Geometry bound: the [d, w] table must fit VMEM — d*w*4 bytes ≲ 8MB, which
+covers every BASELINE config-5 shape (5 × 65536 = 1.3MB).
+
+Semantics note (tested in tests/test_pallas_cms.py): for batches with no
+duplicate keys the outputs are IDENTICAL to the XLA path; for duplicates
+the sequential estimates are each ≤ the batch-final XLA estimate and both
+remain valid CMS upper bounds of the true counts.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _kernel(h1_ref, h2_ref, wt_ref, state_in_ref, state_ref, out_ref, *,
+            d: int, w: int):
+    # state_in_ref aliases state_ref (input_output_aliases): all reads and
+    # writes go through the OUTPUT ref so the table updates in place.
+    del state_in_ref
+    import jax.experimental.pallas as pl
+
+    B = h1_ref.shape[1]
+    w_i = jnp.int32(w)
+    lanes = jnp.arange(128, dtype=jnp.int32)
+
+    # Mosaic requires dynamic VMEM slice starts to be PROVABLY 128-aligned:
+    # every dynamic access is a 128-lane block read-modify-write with a
+    # one-hot lane select (q*128 is syntactically a lane multiple).  All
+    # in-kernel arithmetic runs in int32 (Mosaic lacks unsigned reductions
+    # and scalar bitcasts) — counters must stay < 2**31, a non-constraint
+    # for CMS counts; uint32<->int32 happens as lossless VECTOR bitcasts
+    # at the block boundary.
+    def _i32(blk):
+        return lax.bitcast_convert_type(blk, jnp.int32)
+
+    def _u32(blk):
+        return lax.bitcast_convert_type(blk, jnp.uint32)
+
+    def _load1(ref, pos):
+        q = pos >> 7
+        lane = pos & 127
+        blk = _i32(ref[0, pl.ds(q * 128, 128)])
+        return jnp.sum(jnp.where(lanes == lane, blk, 0))
+
+    def _rmw_add(ref, pos, delta):
+        q = pos >> 7
+        lane = pos & 127
+        blk = _i32(ref[0, pl.ds(q * 128, 128)])
+        hit = lanes == lane
+        new = jnp.sum(jnp.where(hit, blk, 0)) + delta
+        ref[0, pl.ds(q * 128, 128)] = _u32(jnp.where(hit, new, blk))
+        return new
+
+    def _store1(ref, pos, value):
+        q = pos >> 7
+        lane = pos & 127
+        blk = _i32(ref[0, pl.ds(q * 128, 128)])
+        ref[0, pl.ds(q * 128, 128)] = _u32(
+            jnp.where(lanes == lane, value, blk)
+        )
+
+    def body(j, carry):
+        h1 = _load1(h1_ref, j)
+        h2 = _load1(h2_ref, j)
+        wt = _load1(wt_ref, j)
+        est = jnp.int32(2**31 - 1)
+        idx = h1
+        for r in range(d):  # static unroll over depth
+            if r:
+                # KM expansion idx_r = (h1 + r*h2) mod w via conditional
+                # subtract (h1, h2 pre-reduced mod w, so one step per add).
+                idx = idx + h2
+                idx = jnp.where(idx >= w_i, idx - w_i, idx)
+            cur = _rmw_add(state_ref, jnp.int32(r * w) + idx, wt)
+            est = jnp.minimum(est, cur)
+        _store1(out_ref, j, est)
+        return carry
+
+    lax.fori_loop(0, B, body, jnp.int32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("d", "w", "interpret"))
+def cms_update_estimate_seq(table, h1w, h2w, weights, *, d: int, w: int,
+                            interpret: bool = False):
+    """(new_table, est[B]): sequential streaming update+estimate.
+
+    Args:
+      table: uint32[d, w] counter table (one tenant).
+      h1w/h2w: uint32[B] pre-reduced mod w (hashing.km_reduce_mod).
+      weights: uint32[B] per-op increments (0 = pure estimate op).
+    """
+    import jax.experimental.pallas as pl
+
+    if (d * w) % 128 != 0:
+        raise ValueError("d*w must be a multiple of 128 (VMEM lane blocks)")
+    B = h1w.shape[0]
+    if B == 0:  # a (1, 0) output fails Mosaic layout verification
+        return table, jnp.zeros((0,), jnp.uint32)
+    Bp = -(-B // 128) * 128  # pad ops to whole lane blocks; padded ops
+    if Bp != B:  # carry weight 0 (the scatter-add identity)
+        pad = Bp - B
+        h1w = jnp.concatenate([h1w, jnp.zeros(pad, jnp.uint32)])
+        h2w = jnp.concatenate([h2w, jnp.zeros(pad, jnp.uint32)])
+        weights = jnp.concatenate([weights, jnp.zeros(pad, jnp.uint32)])
+    kern = functools.partial(_kernel, d=d, w=w)
+    new_flat, est = pl.pallas_call(
+        kern,
+        out_shape=(
+            jax.ShapeDtypeStruct((1, d * w), jnp.uint32),
+            jax.ShapeDtypeStruct((1, Bp), jnp.uint32),
+        ),
+        input_output_aliases={3: 0},  # table updates in place in VMEM
+        interpret=interpret,
+    )(h1w[None], h2w[None], weights[None], table.reshape(1, d * w))
+    return new_flat.reshape(d, w), est[0, :B]
+
+
+def golden_seq(table: np.ndarray, h1w, h2w, weights, *, d: int, w: int):
+    """NumPy twin: the exact sequential semantics the kernel implements."""
+    table = table.copy()
+    est = np.zeros(len(h1w), np.uint32)
+    for j in range(len(h1w)):
+        vals = []
+        idx = int(h1w[j])
+        for r in range(d):
+            if r:
+                idx += int(h2w[j])
+                if idx >= w:
+                    idx -= w
+            table[r, idx] += int(weights[j])
+            vals.append(table[r, idx])
+        est[j] = min(vals)
+    return table, est
