@@ -732,20 +732,26 @@ class TpuCommandExecutor:
         pool.state, est = fn(pool.state, rows_p, h1p, h2p, w_p)
         return LazyResult(est, B)
 
-    # Pallas heavy-hitter path (BASELINE config 5): exact SEQUENTIAL
-    # streaming semantics — op j's estimate includes ops < j only, which
-    # the vectorized XLA path cannot express (it applies the whole batch
-    # before estimating).  The counter table is VMEM-resident for the
-    # launch.  Single-device only; the sharded executor falls back.
+    # Pallas heavy-hitter path (BASELINE config 5): SEQUENTIAL streaming
+    # semantics — op j's estimate is its at-sequence-point value (ops ≤ j
+    # applied, later ops excluded), which the vectorized XLA path cannot
+    # express (it applies the whole batch before estimating).  The counter
+    # table is VMEM-resident for the launch.  Single-device only; the
+    # sharded executor falls back.
     supports_pallas_cms = True
 
     def cms_update_estimate_seq(self, pool, row: int, h1w, h2w, weights, d: int, w: int) -> LazyResult:
         from redisson_tpu.ops import pallas_cms
 
         B = h1w.shape[0]
+        # Pad BEFORE the jit boundary so varying batch sizes share one
+        # compiled executable per 128-block bucket (padding inside the
+        # trace would respecialize per raw B).  Padded ops carry weight 0
+        # — the scatter-add identity.
+        Bp = -(-B // 128) * 128
         u = pool.row_units
         interpret = jax.default_backend() == "cpu"
-        key = ("cms_seq", pool.state.shape[0], u, d, w, -(-B // 128) * 128)
+        key = ("cms_seq", pool.state.shape[0], u, d, w, Bp)
 
         def build():
             def f(state, row, h1, h2, wt):
@@ -764,9 +770,9 @@ class TpuCommandExecutor:
         pool.state, est = fn(
             pool.state,
             np.int32(row),
-            jnp.asarray(np.asarray(h1w, np.uint32)),
-            jnp.asarray(np.asarray(h2w, np.uint32)),
-            jnp.asarray(np.asarray(weights, np.uint32)),
+            jnp.asarray(self._pad(np.asarray(h1w, np.uint32), Bp)),
+            jnp.asarray(self._pad(np.asarray(h2w, np.uint32), Bp)),
+            jnp.asarray(self._pad(np.asarray(weights, np.uint32), Bp)),
         )
         return LazyResult(est, B)
 

@@ -139,11 +139,12 @@ class CountMinSketch(RObject):
         return offer
 
     def add_all_seq(self, objs, counts=None) -> np.ndarray:
-        """Exact-streaming variant of add_all (the Pallas heavy-hitter
-        kernel, BASELINE config 5): each op's returned estimate reflects
-        only the ops before it in the batch — the true at-sequence-point
-        streaming semantics.  add_all's vectorized path instead returns
-        post-whole-batch estimates (same final table either way)."""
+        """Streaming variant of add_all (the Pallas heavy-hitter kernel,
+        BASELINE config 5): each op's returned estimate is its
+        AT-SEQUENCE-POINT value — its own update applied, LATER ops in
+        the batch excluded (five adds of one key return 1,2,3,4,5).
+        add_all's vectorized path instead returns post-whole-batch
+        estimates (5,5,5,5,5); the final table is identical either way."""
         H1, H2 = self._hash128(objs)
         if counts is None:
             counts = np.ones(len(H1), np.uint32)

@@ -862,12 +862,18 @@ class TpuSketchEngine(SketchDurabilityMixin):
             )
         return self.executor.cms_estimate(entry.pool, rows, h1w, h2w, d, w)
 
+    # Per-launch op cap for the Pallas path: 4 uint32[B] operands must
+    # share VMEM with the table; bigger batches chunk (state carries
+    # across chunks, so sequential semantics are preserved exactly).
+    _SEQ_CHUNK = 1 << 15
+
     def cms_add_seq(self, name, H1, H2, weights) -> LazyResult:
-        """Exact-streaming add+estimate via the Pallas heavy-hitter kernel
-        (BASELINE config 5): op j's estimate reflects ops < j only — the
-        true at-sequence-point streaming contract.  Falls back to the
-        vectorized XLA path where the kernel isn't available (sharded
-        mode), whose estimates include the whole batch."""
+        """Streaming add+estimate via the Pallas heavy-hitter kernel
+        (BASELINE config 5): op j's estimate is its AT-SEQUENCE-POINT
+        value — ops ≤ j applied (its own update included), later ops
+        excluded.  Falls back to the vectorized XLA path where the kernel
+        isn't available (sharded mode) or the geometry doesn't fit VMEM
+        lane blocks; the fallback's estimates include the whole batch."""
         entry = self._require(name, PoolKind.CMS)
         d, w = entry.params["depth"], entry.params["width"]
         if (
@@ -878,10 +884,25 @@ class TpuSketchEngine(SketchDurabilityMixin):
         ):
             return self.cms_add(name, H1, H2, weights)
         h1w, h2w = hashing.km_reduce_mod(H1, H2, w)
+        weights = np.asarray(weights, np.uint32)
         self._drain()  # sequential semantics: all queued ops land first
-        return self.executor.cms_update_estimate_seq(
-            entry.pool, entry.row, h1w, h2w,
-            np.asarray(weights, np.uint32), d, w,
+        B = len(h1w)
+        if B <= self._SEQ_CHUNK:
+            return self.executor.cms_update_estimate_seq(
+                entry.pool, entry.row, h1w, h2w, weights, d, w
+            )
+        parts = [
+            self.executor.cms_update_estimate_seq(
+                entry.pool, entry.row,
+                h1w[i : i + self._SEQ_CHUNK],
+                h2w[i : i + self._SEQ_CHUNK],
+                weights[i : i + self._SEQ_CHUNK],
+                d, w,
+            )
+            for i in range(0, B, self._SEQ_CHUNK)
+        ]
+        return ImmediateResult(
+            np.concatenate([np.asarray(p.result()) for p in parts])
         )
 
     def cms_merge(self, name, other_names) -> None:

@@ -1,0 +1,92 @@
+"""L8 framework integrations: @Cacheable-style decorator, cache manager,
+TTL'd web-session store."""
+
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.integrations import CacheManagerAdapter, SessionStore, cached
+
+
+@pytest.fixture
+def client():
+    c = redisson_tpu.create(Config())
+    yield c
+    c.shutdown()
+
+
+class TestCachedDecorator:
+    def test_memoizes_and_evicts(self, client):
+        calls = []
+
+        @cached(client, "fib-cache")
+        def slow_square(x):
+            calls.append(x)
+            return x * x
+
+        assert slow_square(4) == 16
+        assert slow_square(4) == 16
+        assert calls == [4]  # second call served from cache
+        slow_square.cache_evict(4)
+        assert slow_square(4) == 16
+        assert calls == [4, 4]
+
+    def test_ttl(self, client):
+        calls = []
+
+        @cached(client, "ttl-cache", ttl_seconds=0.1)
+        def f(x):
+            calls.append(x)
+            return x + 1
+
+        f(1)
+        time.sleep(0.15)
+        f(1)
+        assert calls == [1, 1]  # expired between calls
+
+    def test_custom_key_and_clear(self, client):
+        @cached(client, "k-cache", key_fn=lambda user_id: f"u:{user_id}")
+        def profile(user_id):
+            return {"id": user_id}
+
+        profile(7)
+        assert profile.cache.contains_key("u:7")
+        profile.cache_clear()
+        assert not profile.cache.contains_key("u:7")
+
+
+class TestCacheManagerAdapter:
+    def test_named_configs(self, client):
+        mgr = CacheManagerAdapter(
+            client, {"short": {"ttl_seconds": 0.1}, "long": {}}
+        )
+        mgr.get_cache("short").put("k", 1)
+        mgr.get_cache("long").put("k", 2)
+        time.sleep(0.15)
+        assert mgr.get_cache("short").get("k") is None
+        assert mgr.get_cache("long").get("k") == 2
+        assert "short" in mgr.get_cache_names()
+
+
+class TestSessionStore:
+    def test_create_load_save(self, client):
+        store = SessionStore(client, max_inactive_seconds=30)
+        s = store.create()
+        s["user"] = "ada"
+        s.save()
+        again = store.load(s.session_id)
+        assert again["user"] == "ada"
+        again.invalidate()
+        assert store.load(s.session_id) is None
+
+    def test_inactivity_expiry_and_touch(self, client):
+        store = SessionStore(client, max_inactive_seconds=0.2)
+        s = store.create()
+        time.sleep(0.12)
+        assert store.load(s.session_id) is not None  # touch resets window
+        time.sleep(0.12)
+        assert store.load(s.session_id) is not None
+        time.sleep(0.25)
+        assert store.load(s.session_id) is None  # inactivity exceeded
