@@ -121,7 +121,10 @@ def bench_config4_mixed(make_client):
     while nbucket <= (1 << 17):
         keys = rng.integers(0, 50_000, nbucket).astype(np.uint64)
         t = int(rng.integers(n_tenants))
-        filters[t].add_all_async(keys).result()
+        # Explicit generous timeout: a cold-cache first compile of the
+        # biggest bucket can exceed the 120s default on a slow tunnel
+        # phase, and a crashed warmup would fail the whole bench.
+        filters[t].add_all_async(keys).result(timeout=600.0)
         nbucket *= 2
     # And a burst of small mixed chunks (the steady-state arrival shape).
     warm = []
