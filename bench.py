@@ -14,10 +14,10 @@ The other tracked BASELINE metrics ride in ``extra``:
 - ``measured_fpp``: observed false-positive rate of the loaded config-1
   filter (target ≤ ~1.2 * nominal 1%), the FPP-drift evidence.
 
-``vs_baseline``: ratio against 1M ops/sec — the upper end of the
-single-Redis-instance context documented in BASELINE.md (the reference
-publishes no numbers; a pipelined single Redis server sustains ~0.1–1M
-simple ops/sec).
+``vs_baseline``: null — the bench env ships no redis-server, so the
+Redis-backed comparison cannot be MEASURED here (BASELINE.md comparison
+row); ``vs_host_engine`` is the measured ratio against the NumPy golden
+engine (the Redis-server stand-in) through the identical client path.
 """
 
 import json
