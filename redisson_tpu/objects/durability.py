@@ -201,7 +201,18 @@ class SketchDurabilityMixin:
                 }
                 for e in self.registry.entries()
             ]
-        meta = {"version": _DUMP_VERSION, "pools": pool_meta, "tenants": tenants}
+        meta = {
+            "version": _DUMP_VERSION,
+            "pools": pool_meta,
+            "tenants": tenants,
+            # Topology stamp: restores onto a DIFFERENT shard count remap
+            # row-by-row (the explicit device-array remap that stands in
+            # for cluster resharding, SURVEY §2.4).
+            "num_shards": getattr(self.executor, "S", 1),
+            "mbit_threshold_words": getattr(
+                self.config.tpu_sketch, "mbit_threshold_words", 0
+            ),
+        }
         tmp_npz = os.path.join(directory, _SNAP_POOLS + ".tmp.npz")
         tmp_meta = os.path.join(directory, _SNAP_META + ".tmp")
         np.savez(tmp_npz, **arrays)
@@ -212,7 +223,13 @@ class SketchDurabilityMixin:
 
     def restore_snapshot(self, directory: str) -> bool:
         """Load a snapshot written by ``snapshot``; True if one was found.
-        Called at engine init (before any traffic), so no drain needed."""
+        Called at engine init (before any traffic), so no drain needed.
+
+        Resharding: a snapshot taken at shard count S_old restores onto
+        ANY shard count — when topologies differ, tenant rows are
+        extracted from the old layout host-side and written through the
+        current executor row-by-row (the explicit device-array remap
+        SURVEY §2.4 names in place of MOVED-redirect resharding)."""
         meta_path = os.path.join(directory, _SNAP_META)
         pools_path = os.path.join(directory, _SNAP_POOLS)
         if not (os.path.exists(meta_path) and os.path.exists(pools_path)):
@@ -220,36 +237,120 @@ class SketchDurabilityMixin:
         with open(meta_path) as f:
             meta = json.load(f)
         data = np.load(pools_path)
+        s_new = getattr(self.executor, "S", 1)
+        new_thresh = getattr(self.config.tpu_sketch, "mbit_threshold_words", 0)
+        if "num_shards" in meta:
+            s_old = int(meta["num_shards"])
+        elif meta["pools"]:
+            # Legacy snapshot (no topology stamp): the array shape tells —
+            # sharded states are 2-D [S, local], single-device flat.
+            arr0 = data["pool_0"]
+            s_old = arr0.shape[0] if arr0.ndim == 2 else 1
+        else:
+            s_old = s_new
+        # Missing threshold stamp (legacy): assume unchanged config.
+        old_thresh = int(meta.get("mbit_threshold_words", new_thresh))
+        # Verbatim install is only valid when the LAYOUT matches — shard
+        # count AND (on a mesh) the m-shard threshold, which changes how
+        # bitset pools arrange words without changing array shapes.
+        same_topology = s_old == s_new and (
+            s_new == 1 or old_thresh == new_thresh
+        )
+        from typing import Callable
+
+        remap_rows: dict[tuple, Callable[[int], np.ndarray]] = {}
         with self.executor._dispatch_lock:
             for i, pm in enumerate(meta["pools"]):
                 pool = self.registry.pool_for(pm["kind"], tuple(pm["class_key"]))
-                # The snapshot's capacity is already executor-valid (it was
-                # produced by this executor shape) — install it VERBATIM.
-                # Re-rounding could clamp a grown capacity back down (giant
-                # rows) and hand occupied rows to new tenants.
-                pool.capacity = int(pm["capacity"])
-                pool._free = list(range(pool.capacity - 1, -1, -1))
-                pool.generation += 1
                 arr = data[f"pool_{i}"]
-                self.executor.state_from_host(pool, arr)
+                if same_topology:
+                    # The snapshot's capacity is already executor-valid
+                    # (produced by this executor shape) — install VERBATIM.
+                    # Re-rounding could clamp a grown capacity back down
+                    # (giant rows) and hand occupied rows to new tenants.
+                    pool.capacity = int(pm["capacity"])
+                    pool._free = list(range(pool.capacity - 1, -1, -1))
+                    pool.generation += 1
+                    self.executor.state_from_host(pool, arr)
+                else:
+                    remap_rows[tuple(pm["key"])] = self._extract_rows(
+                        arr, pm, s_old,
+                        int(meta.get("mbit_threshold_words", 0)),
+                    )
             by_key = {tuple(p.spec.key): p for p in self.registry.pools()}
             for t in meta["tenants"]:
                 from redisson_tpu.tenancy.registry import TenantEntry
 
-                pool = by_key[tuple(t["pool_key"])]
-                row = int(t["row"])
-                replicas = t.get("replica_rows")
-                restored = TenantEntry(
-                    t["name"], t["kind"], pool, row, dict(t["params"]),
-                    t.get("expire_at"), replicas,
-                )
-                for r in self._entry_rows(restored):
-                    if r in pool._free:
-                        pool._free.remove(r)
-                self.registry._tenants[t["name"]] = restored
+                if same_topology:
+                    pool = by_key[tuple(t["pool_key"])]
+                    row = int(t["row"])
+                    replicas = t.get("replica_rows")
+                    restored = TenantEntry(
+                        t["name"], t["kind"], pool, row, dict(t["params"]),
+                        t.get("expire_at"), replicas,
+                    )
+                    for r in self._entry_rows(restored):
+                        if r in pool._free:
+                            pool._free.remove(r)
+                    self.registry._tenants[t["name"]] = restored
+                else:
+                    # Reshard: old row numbers are topology-specific —
+                    # allocate fresh placement and write the extracted
+                    # row through the CURRENT executor.  Read replicas
+                    # are dropped (their placement was per-old-shard);
+                    # re-replicate on demand.
+                    getter = remap_rows[tuple(t["pool_key"])]
+                    entry, created = self.registry.try_create(
+                        t["name"], t["kind"], tuple(t["pool_key"])[1:],
+                        dict(t["params"]),
+                    )
+                    if not created:
+                        # Mirrors restore()'s BUSYKEY: never write snapshot
+                        # data over a live tenant's row.
+                        raise ValueError(
+                            f"BUSYKEY: {t['name']!r} already exists — "
+                            f"reshard-restore needs an empty keyspace"
+                        )
+                    entry.expire_at = t.get("expire_at")
+                    self.executor.write_row(
+                        entry.pool, entry.row, getter(int(t["row"]))
+                    )
                 if t.get("expire_at") is not None:
                     self._ensure_sweeper()
         return True
+
+    @staticmethod
+    def _extract_rows(arr: np.ndarray, pm: dict, s_old: int, mbit_thresh: int):
+        """Row getter over a snapshot pool array from a DIFFERENT topology:
+        decodes the old executor layout host-side (flat single-device,
+        [S, rows_local*U+1] row-sharded, or [S, cap*(U/S)+1] m-sharded)."""
+        from redisson_tpu.tenancy import PoolKind
+        from redisson_tpu.tenancy.registry import spec_for
+
+        spec = spec_for(pm["kind"], tuple(pm["class_key"]))
+        u = spec.row_units
+        if s_old == 1:
+            def get(row: int) -> np.ndarray:
+                return arr[row * u : (row + 1) * u]
+            return get
+        mbit = (
+            pm["kind"] == PoolKind.BITSET
+            and mbit_thresh
+            and u >= mbit_thresh
+            and u % s_old == 0
+        )
+        if mbit:
+            wl = u // s_old
+            def get(row: int) -> np.ndarray:
+                return np.concatenate(
+                    [arr[s, row * wl : (row + 1) * wl] for s in range(s_old)]
+                )
+            return get
+
+        def get(row: int) -> np.ndarray:
+            local = row // s_old
+            return arr[row % s_old, local * u : (local + 1) * u]
+        return get
 
     def _start_snapshotter(self, directory: str, interval_s: float) -> None:
         stop = threading.Event()
