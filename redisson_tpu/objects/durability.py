@@ -274,10 +274,23 @@ class SketchDurabilityMixin:
                     self.executor.state_from_host(pool, arr)
                 else:
                     remap_rows[tuple(pm["key"])] = self._extract_rows(
-                        arr, pm, s_old,
-                        int(meta.get("mbit_threshold_words", 0)),
+                        arr, pm, s_old, old_thresh
                     )
             by_key = {tuple(p.spec.key): p for p in self.registry.pools()}
+            if not same_topology:
+                # Atomic refusal: verify EVERY snapshot name is free
+                # before creating any, so a BUSYKEY never leaves a
+                # half-restored keyspace behind.
+                busy = [
+                    t["name"]
+                    for t in meta["tenants"]
+                    if self.registry.lookup(t["name"]) is not None
+                ]
+                if busy:
+                    raise ValueError(
+                        f"BUSYKEY: {busy[:3]!r} already exist — "
+                        f"reshard-restore needs an empty keyspace"
+                    )
             for t in meta["tenants"]:
                 from redisson_tpu.tenancy.registry import TenantEntry
 
@@ -304,9 +317,7 @@ class SketchDurabilityMixin:
                         t["name"], t["kind"], tuple(t["pool_key"])[1:],
                         dict(t["params"]),
                     )
-                    if not created:
-                        # Mirrors restore()'s BUSYKEY: never write snapshot
-                        # data over a live tenant's row.
+                    if not created:  # raced a concurrent creator post-check
                         raise ValueError(
                             f"BUSYKEY: {t['name']!r} already exists — "
                             f"reshard-restore needs an empty keyspace"

@@ -11,6 +11,10 @@ Command surface (the subset the north-star objects + grid need):
   CMS.INITBYDIM CMS.INCRBY CMS.QUERY               (RedisBloom CMS shape)
   LPUSH RPUSH LPOP RPOP LLEN
   HSET HGET HDEL HLEN
+  SADD SREM SISMEMBER SCARD SMEMBERS
+  ZADD ZSCORE ZRANGE ZCARD ZREM
+  INCR INCRBY DECR
+  PUBLISH SUBSCRIBE UNSUBSCRIBE                     (push replies)
   KEYS DBSIZE FLUSHALL
 
 Values travel as raw bytes (RESP bulk strings) through a ByteArray-style
@@ -104,6 +108,23 @@ class _Reader:
         return args
 
 
+class _ConnCtx:
+    """Per-connection state: serialized writes (pub/sub pushes interleave
+    with replies) + this connection's channel subscriptions."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.lock = threading.Lock()
+        self.subs: dict[str, int] = {}  # channel -> bus listener id
+
+    def send(self, frame: bytes) -> None:
+        with self.lock:
+            try:
+                self.sock.sendall(frame)
+            except OSError:
+                pass  # peer gone; the read loop will notice
+
+
 class RespServer:
     """Embedded RESP2 endpoint over a RedissonTpuClient."""
 
@@ -135,19 +156,23 @@ class RespServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         reader = _Reader(conn)
+        ctx = _ConnCtx(conn)
         try:
             while True:
                 cmd = reader.read_command()
                 if cmd is None:
                     return
                 try:
-                    reply = self._dispatch(cmd)
+                    reply = self._dispatch(cmd, ctx)
                 except RespError as e:
                     reply = _encode_error(str(e))
                 except Exception as e:  # command errors never kill the conn
                     reply = _encode_error(f"{type(e).__name__}: {e}")
-                conn.sendall(reply)
+                ctx.send(reply)
         finally:
+            # Drop this connection's subscriptions with it.
+            for channel, lid in list(ctx.subs.items()):
+                self._client._topic_bus.unsubscribe(channel, lid)
             conn.close()
 
     def close(self) -> None:
@@ -159,8 +184,11 @@ class RespServer:
 
     # -- command dispatch ---------------------------------------------------
 
-    def _dispatch(self, cmd: list[bytes]) -> bytes:
+    def _dispatch(self, cmd: list[bytes], ctx: "_ConnCtx") -> bytes:
         name = cmd[0].decode().upper()
+        ctx_handler = getattr(self, "_cmdctx_" + name.replace(".", "_"), None)
+        if ctx_handler is not None:  # connection-stateful (pub/sub)
+            return ctx_handler([c for c in cmd[1:]], ctx)
         handler = getattr(self, "_cmd_" + name.replace(".", "_"), None)
         if handler is None:
             raise RespError(f"unknown command '{name}'")
@@ -169,6 +197,13 @@ class RespServer:
     @staticmethod
     def _s(b: bytes) -> str:
         return b.decode()
+
+    @staticmethod
+    def _raw(obj):
+        """Foreign clients speak raw bytes: bypass the configured codec."""
+        obj._enc = lambda v: v if isinstance(v, bytes) else str(v).encode()
+        obj._dec = lambda v: v
+        return obj
 
     # connection/admin
 
@@ -194,11 +229,7 @@ class RespServer:
     def _bucket(self, key: bytes):
         from redisson_tpu.grid.buckets import Bucket
 
-        b = Bucket(self._s(key), self._client)
-        # Foreign clients speak raw bytes: bypass the configured codec.
-        b._enc = lambda v: v if isinstance(v, bytes) else str(v).encode()
-        b._dec = lambda v: v
-        return b
+        return self._raw(Bucket(self._s(key), self._client))
 
     def _cmd_SET(self, args):
         key, value = args[0], args[1]
@@ -351,10 +382,7 @@ class RespServer:
         # Redis lists ARE deques (LPUSH/RPOP both ends).
         from redisson_tpu.grid.queues import Deque
 
-        lst = Deque(self._s(key), self._client)
-        lst._enc = lambda v: v if isinstance(v, bytes) else str(v).encode()
-        lst._dec = lambda v: v
-        return lst
+        return self._raw(Deque(self._s(key), self._client))
 
     def _cmd_RPUSH(self, args):
         lst = self._list(args[0])
@@ -382,9 +410,7 @@ class RespServer:
     def _map(self, key: bytes):
         from redisson_tpu.grid.maps import Map
 
-        m = Map(self._s(key), self._client)
-        m._enc = lambda v: v if isinstance(v, bytes) else str(v).encode()
-        m._dec = lambda v: v
+        m = self._raw(Map(self._s(key), self._client))
         m._enc_key = m._enc
         m._dec_key = m._dec
         return m
@@ -405,3 +431,146 @@ class RespServer:
 
     def _cmd_HLEN(self, args):
         return _encode_int(self._map(args[0]).size())
+
+    # sets
+
+    def _set(self, key: bytes):
+        from redisson_tpu.grid.collections import Set_
+
+        return self._raw(Set_(self._s(key), self._client))
+
+    def _cmd_SADD(self, args):
+        s = self._set(args[0])
+        return _encode_int(sum(int(s.add(v)) for v in args[1:]))
+
+    def _cmd_SREM(self, args):
+        s = self._set(args[0])
+        return _encode_int(sum(int(s.remove(v)) for v in args[1:]))
+
+    def _cmd_SISMEMBER(self, args):
+        return _encode_int(int(self._set(args[0]).contains(args[1])))
+
+    def _cmd_SCARD(self, args):
+        return _encode_int(self._set(args[0]).size())
+
+    def _cmd_SMEMBERS(self, args):
+        return _encode_array(self._set(args[0]).read_all())
+
+    # sorted sets
+
+    def _zset(self, key: bytes):
+        from redisson_tpu.grid.collections import ScoredSortedSet
+
+        return self._raw(ScoredSortedSet(self._s(key), self._client))
+
+    def _cmd_ZADD(self, args):
+        z = self._zset(args[0])
+        n = 0
+        for i in range(1, len(args), 2):
+            n += int(z.add(float(args[i]), args[i + 1]))
+        return _encode_int(n)
+
+    def _cmd_ZSCORE(self, args):
+        score = self._zset(args[0]).get_score(args[1])
+        return _encode_bulk(None if score is None else repr(score))
+
+    def _cmd_ZRANGE(self, args):
+        z = self._zset(args[0])
+        withscores = len(args) > 3 and args[3].decode().upper() == "WITHSCORES"
+        if not withscores:
+            return _encode_array(z.value_range(int(args[1]), int(args[2])))
+        flat = []
+        for member, score in z.entry_range(int(args[1]), int(args[2])):
+            flat.extend([member, repr(score)])
+        return _encode_array(flat)
+
+    def _cmd_ZCARD(self, args):
+        return _encode_int(self._zset(args[0]).size())
+
+    def _cmd_ZREM(self, args):
+        z = self._zset(args[0])
+        return _encode_int(sum(int(z.remove(m)) for m in args[1:]))
+
+    # pub/sub (push replies — the SUBSCRIBE protocol shape)
+
+    def _cmd_PUBLISH(self, args):
+        n = self._client._topic_bus.publish(self._s(args[0]), args[1])
+        return _encode_int(n)
+
+    def _cmdctx_SUBSCRIBE(self, args, ctx: _ConnCtx):
+        if not args:
+            raise RespError("wrong number of arguments for 'subscribe'")
+        for raw in args:
+            channel = self._s(raw)
+            already = channel in ctx.subs
+            # Ack FIRST, then register: a concurrent PUBLISH must not push
+            # its 'message' frame ahead of this channel's 'subscribe' ack.
+            ctx.send(
+                b"*3\r\n"
+                + _encode_bulk(b"subscribe")
+                + _encode_bulk(raw)
+                + _encode_int(len(ctx.subs) + (0 if already else 1))
+            )
+            if already:
+                continue
+
+            def on_msg(ch, message, _name=raw):
+                payload = (
+                    message
+                    if isinstance(message, bytes)
+                    else str(message).encode()
+                )
+                ctx.send(
+                    b"*3\r\n"
+                    + _encode_bulk(b"message")
+                    + _encode_bulk(_name)
+                    + _encode_bulk(payload)
+                )
+
+            ctx.subs[channel] = self._client._topic_bus.subscribe(
+                channel, on_msg
+            )
+        return b""  # acks already pushed in order
+
+    def _cmdctx_UNSUBSCRIBE(self, args, ctx: _ConnCtx):
+        channels = [self._s(a) for a in args] or list(ctx.subs)
+        if not channels:
+            # Redis replies even when nothing was subscribed — an empty
+            # reply would wedge the client waiting forever.
+            return (
+                b"*3\r\n"
+                + _encode_bulk(b"unsubscribe")
+                + _encode_bulk(None)
+                + _encode_int(0)
+            )
+        out = b""
+        for channel in channels:
+            lid = ctx.subs.pop(channel, None)
+            if lid is not None:
+                self._client._topic_bus.unsubscribe(channel, lid)
+            out += (
+                b"*3\r\n"
+                + _encode_bulk(b"unsubscribe")
+                + _encode_bulk(channel.encode())
+                + _encode_int(len(ctx.subs))
+            )
+        return out
+
+    # counters
+
+    def _cmd_INCR(self, args):
+        return _encode_int(
+            self._client.get_atomic_long(self._s(args[0])).increment_and_get()
+        )
+
+    def _cmd_INCRBY(self, args):
+        return _encode_int(
+            self._client.get_atomic_long(self._s(args[0])).add_and_get(
+                int(args[1])
+            )
+        )
+
+    def _cmd_DECR(self, args):
+        return _encode_int(
+            self._client.get_atomic_long(self._s(args[0])).add_and_get(-1)
+        )

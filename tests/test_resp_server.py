@@ -154,3 +154,62 @@ class TestRespFrontDoor:
         for t in threads:
             t.join()
         assert sorted(results) == sorted(str(i).encode() for i in range(8))
+
+
+class TestRespSetsZsetsCounters:
+    def test_sets(self, resp):
+        assert resp.cmd("SADD", "s", "a", "b", "a") == 2
+        assert resp.cmd("SISMEMBER", "s", "a") == 1
+        assert resp.cmd("SCARD", "s") == 2
+        assert sorted(resp.cmd("SMEMBERS", "s")) == [b"a", b"b"]
+        assert resp.cmd("SREM", "s", "a", "ghost") == 1
+
+    def test_zsets(self, resp):
+        assert resp.cmd("ZADD", "z", "2.5", "b", "1.0", "a") == 2
+        assert resp.cmd("ZSCORE", "z", "b") == b"2.5"
+        assert resp.cmd("ZRANGE", "z", 0, -1) == [b"a", b"b"]
+        ws = resp.cmd("ZRANGE", "z", 0, -1, "WITHSCORES")
+        assert ws == [b"a", b"1.0", b"b", b"2.5"]
+        assert resp.cmd("ZCARD", "z") == 2
+        assert resp.cmd("ZREM", "z", "a") == 1
+
+    def test_counters(self, resp):
+        assert resp.cmd("INCR", "c") == 1
+        assert resp.cmd("INCRBY", "c", 10) == 11
+        assert resp.cmd("DECR", "c") == 10
+
+
+class TestRespPubSub:
+    def test_subscribe_publish_roundtrip(self, resp):
+        import threading
+        import time
+
+        host, port = resp._sock.getpeername()
+        sub = RespClient(host, port)
+        frames = sub.cmd("SUBSCRIBE", "news")
+        assert frames == [b"subscribe", b"news", 1]
+        got = []
+
+        def reader():
+            got.append(sub._read_reply())
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.1)
+        assert resp.cmd("PUBLISH", "news", "hello") == 1
+        t.join(timeout=5)
+        assert got == [[b"message", b"news", b"hello"]]
+        assert sub.cmd("UNSUBSCRIBE", "news") == [b"unsubscribe", b"news", 0]
+        sub.close()
+
+    def test_disconnect_drops_subscription(self, resp):
+        import time
+
+        host, port = resp._sock.getpeername()
+        sub = RespClient(host, port)
+        sub.cmd("SUBSCRIBE", "gone")
+        sub.close()
+        deadline = time.time() + 3
+        while time.time() < deadline and resp.cmd("PUBLISH", "gone", "x") > 0:
+            time.sleep(0.05)
+        assert resp.cmd("PUBLISH", "gone", "x") == 0
