@@ -274,6 +274,30 @@ class RedissonTpuClient(CamelCompatMixin):
 
         return NodesGroup(self)
 
+    def get_failure_monitor(self, interval_s: float = 1.0):
+        """Shared background monitor surfacing dead shards as typed events
+        (the ClusterConnectionManager topology-monitor analog, SURVEY §5
+        failure row).  Not started automatically — call ``.start()``."""
+        from redisson_tpu.serve.nodes import FailureMonitor
+
+        with self._services_lock:  # one shared monitor, race-free create
+            if getattr(self, "_failure_monitor", None) is None:
+                self._failure_monitor = FailureMonitor(
+                    self.get_nodes_group(), interval_s=interval_s
+                )
+            return self._failure_monitor
+
+    def change_topology(self, num_shards: int) -> bool:
+        """Online reshard of the sketch engine (SURVEY §2.4 cluster row):
+        remap every device row onto a new shard count on the LIVE engine —
+        no restart, no keyspace wipe, zero lost writes (see
+        SketchDurabilityMixin.change_topology)."""
+        if not hasattr(self._engine, "change_topology"):
+            raise RuntimeError(
+                "change_topology requires the TPU sketch engine"
+            )
+        return self._engine.change_topology(num_shards)
+
     def get_pattern_topic(self, pattern: str):
         return PatternTopic(pattern, self)
 
@@ -404,6 +428,8 @@ class RedissonTpuClient(CamelCompatMixin):
 
     def shutdown(self) -> None:
         """→ Redisson#shutdown."""
+        if getattr(self, "_failure_monitor", None) is not None:
+            self._failure_monitor.stop()
         if hasattr(self._engine, "shutdown"):
             self._engine.shutdown()
         self._grid.shutdown()

@@ -33,6 +33,19 @@ class TpuSketchConfig:
         # keeps the transport in its fast retirement regime — measured on
         # the tunneled v5e, >12 un-synced dispatches degrade every op).
         self.max_inflight = 8
+        # Engine-side backpressure (the ConnectionPool#acquire role): a
+        # producer's submit() BLOCKS once this many ops are queued ahead of
+        # the flush thread — without it any unpaced client recreates the
+        # unbounded-queue p99 catastrophe (round-2 postmortem).  0 → auto
+        # (8 × max_batch).
+        self.max_queued_ops = 0
+        # Adaptive in-flight: shrink the dispatch window toward
+        # min_inflight while observed launch retirement is slow (the
+        # transport's >~12-launch cliff degrades EVERY op when the link
+        # enters its slow phase), grow back toward max_inflight when
+        # retirements are fast.
+        self.adaptive_inflight = True
+        self.min_inflight = 2
         # Tenancy.
         self.initial_tenants_per_class = 8  # initial rows per size-class pool
         # Exact intra-batch sequential semantics for bloom add (sort-based

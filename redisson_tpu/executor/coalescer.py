@@ -51,13 +51,21 @@ from redisson_tpu.executor.failures import (
 
 
 class _Segment:
-    __slots__ = ("key", "pool_key", "dispatch", "chunks", "futures", "nops", "born")
+    __slots__ = (
+        "key", "pool_key", "dispatch", "chunks", "metas", "futures",
+        "nops", "born",
+    )
 
     def __init__(self, key, pool_key, dispatch):
         self.key = key
         self.pool_key = pool_key
         self.dispatch = dispatch  # fn(list_of_chunk_arrays) -> LazyResult
         self.chunks: list[tuple] = []  # per-submit tuples of op arrays
+        # Per-submit metadata (parallel to chunks) for run-length dispatch:
+        # values constant across one submit (tenant row, m, op flag, const
+        # key length) travel ONCE per chunk instead of once per op — the
+        # dispatch expands them device-side.  None for plain segments.
+        self.metas: Optional[list] = None
         self.futures: list[tuple[Future, int, int]] = []  # (future, start, n)
         self.nops = 0
         self.born = time.monotonic()
@@ -98,7 +106,8 @@ class HintedFuture:
 class BatchCoalescer:
     def __init__(self, *, batch_window_us: int, max_batch: int, metrics=None,
                  max_inflight: int = 8, retry_attempts: int = 3,
-                 retry_interval_s: float = 0.05):
+                 retry_interval_s: float = 0.05, max_queued_ops: int = 0,
+                 adaptive_inflight: bool = True, min_inflight: int = 2):
         self.window_s = batch_window_us / 1e6
         self.max_batch = max_batch
         self.metrics = metrics
@@ -107,8 +116,27 @@ class BatchCoalescer:
         # method raises synchronously, so re-dispatch is safe.
         self.retry_attempts = max(1, retry_attempts)
         self.retry_interval_s = retry_interval_s
+        # Engine-side backpressure (the pooled-acquire role): submit()
+        # blocks while this many ops sit queued ahead of the flush thread.
+        self.max_queued_ops = max_queued_ops if max_queued_ops > 0 else 8 * max_batch
+        self._queued_ops = 0
         # Bounds dispatched-but-uncollected segments (see module docstring).
-        self._inflight_sem = threading.BoundedSemaphore(max(1, max_inflight))
+        # A counter + condition instead of a semaphore so the limit can
+        # ADAPT: when a launch retires slowly (the transport's slow phase)
+        # the window shrinks multiplicatively toward min_inflight; fast
+        # retirements grow it back additively (AIMD).
+        self._max_inflight_cfg = max(1, max_inflight)
+        self._min_inflight = max(1, min(min_inflight, self._max_inflight_cfg))
+        self._adaptive = adaptive_inflight
+        self._inflight_limit = self._max_inflight_cfg
+        self._uncollected = 0
+        self._inflight_cv = threading.Condition(threading.Lock())
+        self._good_streak = 0
+        # Retirement thresholds (s): measured on the tunneled v5e —
+        # pipelined launches retire in 10-50 ms in the fast regime;
+        # >250 ms signals the slow phase / cliff.
+        self.slow_launch_s = 0.25
+        self.fast_launch_s = 0.08
         # Queued segments in creation order (the flush order).  A segment
         # stays JOINABLE while queued: ``_open`` maps segment key -> the
         # segment new ops of that key append to, and ``_pool_tail`` maps a
@@ -122,6 +150,9 @@ class BatchCoalescer:
         self._hurry = False  # a caller is blocking: drain the queue now
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
+        # Producers blocked on the queue bound wait here; notified as
+        # segments pop for dispatch.
+        self._admit = threading.Condition(self._lock)
         self._inflight = 0  # popped but not yet dispatched
         self._closed = False
         # Dispatch and completion are decoupled: the flush thread only
@@ -141,18 +172,36 @@ class BatchCoalescer:
 
     # -- producer side -----------------------------------------------------
 
-    def submit(self, key, dispatch: Callable, arrays: tuple, nops: int, pool_key=None) -> Future:
+    def submit(self, key, dispatch: Callable, arrays: tuple, nops: int, pool_key=None, meta=None) -> Future:
         """Queue ``nops`` ops (column arrays in ``arrays``) for the segment
         identified by ``key``; returns a Future of the per-op result slice.
 
         ``pool_key`` identifies the state the ops touch (defaults to
         ``key``): an op joins an existing queued segment of its key only
         while that segment is still the pool's most recent — otherwise a
-        fresh segment is created, preserving per-pool arrival order."""
+        fresh segment is created, preserving per-pool arrival order.
+
+        ``meta``: per-chunk run-length metadata; when present the segment's
+        dispatch is called as ``dispatch(cols, metas)`` where ``metas`` is
+        the list of (nops, meta) per chunk in order.  All submits of one
+        key must agree on using meta or not (keys embed the path)."""
         if pool_key is None:
             pool_key = key
         fut: Future = Future()
         with self._lock:
+            if self._closed:
+                raise RuntimeError("coalescer is shut down")
+            # Backpressure: block while the queue is at capacity (an
+            # oversize single submit is admitted when the queue is empty,
+            # so it can never deadlock).  The flush thread only ever
+            # REMOVES queued ops, so this wait cannot starve.
+            while (
+                self._queued_ops > 0
+                and self._queued_ops + nops > self.max_queued_ops
+                and not self._closed
+            ):
+                self._wake.notify()
+                self._admit.wait(timeout=1.0)
             if self._closed:
                 raise RuntimeError("coalescer is shut down")
             seg = self._open.get(key)
@@ -162,6 +211,8 @@ class BatchCoalescer:
                 or seg.nops + nops > self.max_batch
             ):
                 seg = _Segment(key, pool_key, dispatch)
+                if meta is not None:
+                    seg.metas = []
                 self._open[key] = seg
                 self._pool_tail[pool_key] = seg
                 self._order.append(seg)
@@ -169,8 +220,11 @@ class BatchCoalescer:
                 # the segment's birth, not from the next idle-poll tick.
                 self._wake.notify()
             seg.chunks.append(arrays)
+            if meta is not None:
+                seg.metas.append((nops, meta))
             seg.futures.append((fut, seg.nops, nops))
             seg.nops += nops
+            self._queued_ops += nops
             if seg.nops >= self.max_batch:
                 self._wake.notify()
         return fut
@@ -192,6 +246,9 @@ class BatchCoalescer:
         if not self._order:
             self._hurry = False
         self._inflight += 1
+        if seg.nops:
+            self._queued_ops -= seg.nops
+            self._admit.notify_all()
         return seg
 
     def _merge_consecutive_locked(self, head: _Segment) -> _Segment:
@@ -207,6 +264,8 @@ class BatchCoalescer:
             self._pop_locked()
             self._inflight -= 1  # merged segs dispatch as one launch
             head.chunks.extend(nxt.chunks)
+            if head.metas is not None:
+                head.metas.extend(nxt.metas)
             for fut, start, n in nxt.futures:
                 head.futures.append((fut, head.nops + start, n))
             head.nops += nxt.nops
@@ -242,8 +301,43 @@ class BatchCoalescer:
                 # Throttle BEFORE the flush work: keeps the transport's
                 # in-flight window shallow (fast retirement regime) and
                 # lets the queue behind us keep merging while we wait.
-                self._inflight_sem.acquire()
+                self._acquire_launch_slot()
             self._flush(seg)
+
+    def _acquire_launch_slot(self) -> None:
+        with self._inflight_cv:
+            while self._uncollected >= self._inflight_limit:
+                self._inflight_cv.wait(timeout=0.5)
+            self._uncollected += 1
+
+    def _release_launch_slot(self, collect_s: Optional[float],
+                             genuine: bool = True) -> None:
+        """Free a dispatched-launch slot; ``collect_s`` (the observed
+        retirement latency of the launch, None on error paths) drives the
+        AIMD window: halve on a slow retirement, +1 after a streak of
+        fast ones.  ``genuine``: False when the completer was backlogged
+        when it picked this launch up — such launches retired while the
+        completer was blocked elsewhere, so a near-zero collect time says
+        nothing about link health and must NOT feed the grow streak
+        (slow measurements stay valid either way: the result really did
+        take that long to arrive)."""
+        with self._inflight_cv:
+            self._uncollected = max(0, self._uncollected - 1)
+            if self._adaptive and collect_s is not None:
+                if collect_s > self.slow_launch_s:
+                    self._inflight_limit = max(
+                        self._min_inflight, self._inflight_limit // 2
+                    )
+                    self._good_streak = 0
+                elif genuine and collect_s < self.fast_launch_s:
+                    self._good_streak += 1
+                    if (
+                        self._good_streak >= 4
+                        and self._inflight_limit < self._max_inflight_cfg
+                    ):
+                        self._inflight_limit += 1
+                        self._good_streak = 0
+            self._inflight_cv.notify_all()
 
     def _flush(self, seg: _Segment) -> None:
         t0 = time.monotonic()
@@ -263,7 +357,10 @@ class BatchCoalescer:
             last_err: Optional[BaseException] = None
             for attempt in range(self.retry_attempts):
                 try:
-                    lazy = seg.dispatch(cols)
+                    if seg.metas is not None:
+                        lazy = seg.dispatch(cols, seg.metas)
+                    else:
+                        lazy = seg.dispatch(cols)
                     last_err = None
                     break
                 except Exception as e:
@@ -284,7 +381,7 @@ class BatchCoalescer:
             with self._lock:
                 if self._inflight > 0:
                     self._inflight -= 1
-            self._inflight_sem.release()
+            self._release_launch_slot(None)
             for fut, start, n in seg.futures:
                 if fut.set_running_or_notify_cancel():
                     fut.set_exception(
@@ -299,9 +396,16 @@ class BatchCoalescer:
             if item is None:
                 return
             seg, lazy, t0 = item
+            # A backlogged completions queue means this launch retired
+            # while we were blocked on an earlier one — its collect time
+            # is not a genuine link-health sample (see _release_launch_slot).
+            genuine = self._completions.qsize() == 0
             try:
+                t_collect = time.monotonic()
                 res = lazy.result() if lazy is not None else None
-                self._inflight_sem.release()
+                self._release_launch_slot(
+                    time.monotonic() - t_collect, genuine=genuine
+                )
                 for fut, start, n in seg.futures:
                     if fut.set_running_or_notify_cancel():
                         fut.set_result(
@@ -311,10 +415,7 @@ class BatchCoalescer:
                 # Completion-time failure: the device batch died after
                 # donation — NOT retryable; attribute each caller's op
                 # range within the failed launch (partial-batch surface).
-                try:
-                    self._inflight_sem.release()
-                except ValueError:
-                    pass
+                self._release_launch_slot(None)
                 for fut, start, n in seg.futures:
                     if fut.set_running_or_notify_cancel():
                         fut.set_exception(

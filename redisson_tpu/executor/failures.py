@@ -40,6 +40,13 @@ class RetryExhaustedError(RedissonTpuError):
         self.__cause__ = cause
 
 
+class ExecutorRetiredError(RedissonTpuError):
+    """The executor was replaced by a live topology change while this
+    dispatch was in flight (the MOVED-redirect analog).  Dispatch-time and
+    retryable: pool state was not consumed; the coalescer's retry loop
+    re-evaluates ``engine.executor`` and lands on the new topology."""
+
+
 class KernelExecutionError(RedissonTpuError):
     """A device batch failed at completion; carries the failed op range.
 

@@ -112,6 +112,9 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
     # The Pallas sequential kernel operates on a single-device VMEM table;
     # sharded CMS traffic uses the partitioned XLA path instead.
     supports_pallas_cms = False
+    # Partition-by-owner reorders ops host-side before dispatch, so runs
+    # metadata can't describe the shipped order — per-op-array path.
+    supports_runs_metadata = False
 
     def __init__(self, config):
         super().__init__(config)

@@ -99,6 +99,10 @@ class TpuCommandExecutor:
     # Single-device layout supports the *_keys_st device-hash kernels; the
     # sharded executor routes encoded batches through the host hash instead.
     supports_device_hash = True
+    # Run-length segment metadata (bloom_mixed_keys_runs): single-device
+    # only — the sharded executor's partition-by-owner dispatch reorders
+    # ops before expansion, so it keeps the per-op-array path.
+    supports_runs_metadata = True
 
     def __init__(self, config):
         self._cfg = config.tpu_sketch
@@ -277,6 +281,109 @@ class TpuCommandExecutor:
             jnp.asarray(valid),
         )
         return LazyResult(res, transform=lambda v: bitops.unpack_bool_u32(v, B))
+
+    def bloom_mixed_keys_runs(self, pool, k: int, blocks, lengths, run_rows, run_m, run_flags, run_starts) -> LazyResult:
+        """Coalesced mixed path with RUN-LENGTH metadata (PROFILE.md
+        remaining-lever 1): per-op rows/m/is_add/valid are constant within
+        each submitted chunk, so they ship once per run (C entries + C+1
+        cumulative starts) and expand to per-op arrays ON DEVICE via
+        searchsorted — cutting link bytes/op from ~22-30 to ~8-12 on the
+        config-4 mixed path.  ``lengths``: uint32 scalar when every op in
+        the launch shares one key length (the common codec case), else a
+        per-op array.  ``run_starts[i]``: first op index of run i;
+        ``run_starts[C]`` = total real ops (ops beyond it are padding)."""
+        B = int(run_starts[-1])
+        Bp = self._bucket(B)
+        blocks, L = self._trim_lanes(blocks)
+        Lt = blocks.shape[1]
+        C = len(run_rows)
+        # One compiled shape for any C ≤ 1024 (the padded runs cost ~13KB
+        # on the wire — noise); degenerate many-tiny-chunk segments grow
+        # the bucket rather than fail.
+        Cp = max(1024, _pow2ceil(C))
+        wpr = pool.row_units
+        const_len = np.ndim(lengths) == 0
+        key = ("bloom_mixk_runs", wpr, pool.state.shape[0], Bp, k, L, Lt, Cp, const_len)
+
+        def build():
+            def f(state, blocks, lengths, rr, rm, rf, starts, n_ops):
+                iota = jax.lax.iota(jnp.int32, Bp)
+                # Run index of op i = #(run ends ≤ i); padded ends equal
+                # n_ops, so tail ops clip to the last run (valid=False
+                # routes them to scratch).
+                seg = jnp.minimum(
+                    jnp.searchsorted(starts[1:], iota, side="right"), Cp - 1
+                )
+                new, res = fastpath.bloom_mixed_keys(
+                    state, rr[seg], blocks, lengths, rm[seg], rf[seg],
+                    iota < n_ops, k=k, words_per_row=wpr, target_lanes=L,
+                )
+                return new, bitops.pack_bool_u32(res)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        blocks_p = np.zeros((Bp, Lt), np.uint32)
+        blocks_p[:B] = blocks
+        starts_p = np.full(Cp + 1, B, np.int32)
+        starts_p[: C + 1] = run_starts
+        len_arg = (
+            np.uint32(lengths)
+            if const_len
+            else jnp.asarray(self._pad(np.asarray(lengths, np.uint32), Bp))
+        )
+        pool.state, res = fn(
+            pool.state,
+            jnp.asarray(blocks_p),
+            len_arg,
+            jnp.asarray(self._pad(np.asarray(run_rows, np.int32), Cp)),
+            jnp.asarray(self._pad(np.asarray(run_m, np.uint32), Cp, fill=1)),
+            jnp.asarray(self._pad(np.asarray(run_flags, bool), Cp)),
+            jnp.asarray(starts_p),
+            np.int32(B),
+        )
+        return LazyResult(res, transform=lambda v: bitops.unpack_bool_u32(v, B))
+
+    def bitset_mixed_runs(self, pool, idx, run_rows, run_ops, run_starts) -> LazyResult:
+        """bitset_mixed with RUN-LENGTH metadata (row + opcode constant per
+        submitted chunk, expanded on device) — same scheme as
+        bloom_mixed_keys_runs; cuts the coalesced bitset path from ~13 to
+        ~4 bytes/op on the wire."""
+        B = int(run_starts[-1])
+        Bp = self._bucket(B)
+        C = len(run_rows)
+        Cp = max(1024, _pow2ceil(C))
+        wpr = pool.row_units
+        key = ("bs_mixed_runs", wpr, pool.state.shape[0], Bp, Cp)
+
+        def build():
+            def f(state, idx, rr, ro, starts, n_ops):
+                iota = jax.lax.iota(jnp.int32, Bp)
+                seg = jnp.minimum(
+                    jnp.searchsorted(starts[1:], iota, side="right"), Cp - 1
+                )
+                new, obs = bitset_ops.bitset_mixed(
+                    state, rr[seg], idx, ro[seg],
+                    words_per_row=wpr, valid=iota < n_ops,
+                )
+                return new, bitops.pack_bool_u32(obs)
+            return f
+
+        fn = self._jit(key, build, donate=True)
+        starts_p = np.full(Cp + 1, B, np.int32)
+        starts_p[: len(run_starts)] = run_starts
+        pool.state, obs = fn(
+            pool.state,
+            jnp.asarray(self._pad(np.asarray(idx, np.uint32), Bp)),
+            jnp.asarray(self._pad(np.asarray(run_rows, np.int32), Cp)),
+            jnp.asarray(
+                self._pad(
+                    np.asarray(run_ops, np.uint32), Cp, fill=bitset_ops.OP_GET
+                )
+            ),
+            jnp.asarray(starts_p),
+            np.int32(B),
+        )
+        return LazyResult(obs, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bitset_mixed(self, pool, rows, idx, opcodes) -> LazyResult:
         """Unified set/clear/flip/get batch (ops/bitset.bitset_mixed) —
@@ -834,9 +941,32 @@ class TpuCommandExecutor:
 def _locked(fn):
     import functools
 
+    from redisson_tpu.executor.failures import ExecutorRetiredError
+
     @functools.wraps(fn)
     def wrapper(self, *args, **kwargs):
         with self._dispatch_lock:
+            # A live change_topology may have swapped this executor out
+            # while the caller was blocked on the lock (callers read
+            # ``engine.executor`` BEFORE acquiring it).  Running the old
+            # kernel against the re-laid-out pool.state would corrupt or
+            # crash.  FORWARD to the successor executor instead (same
+            # lock object, reentrant) so direct non-coalesced callers
+            # never see a spurious failure — except the *_runs methods
+            # when the successor doesn't support runs metadata (its
+            # inherited implementation would be layout-wrong): those
+            # raise retryable and the coalescer's retry loop re-binds,
+            # re-checking supports_runs_metadata at the engine level.
+            if getattr(self, "_retired", False):
+                succ = getattr(self, "_successor", None)
+                if succ is not None and not (
+                    fn.__name__.endswith("_runs")
+                    and not getattr(succ, "supports_runs_metadata", False)
+                ):
+                    return getattr(succ, fn.__name__)(*args, **kwargs)
+                raise ExecutorRetiredError(
+                    f"{type(self).__name__} was retired by a topology change"
+                )
             return fn(self, *args, **kwargs)
 
     return wrapper
@@ -850,7 +980,9 @@ DISPATCH_METHODS = (
     "bloom_contains",
     "bloom_mixed",
     "bloom_mixed_keys",
+    "bloom_mixed_keys_runs",
     "bitset_mixed",
+    "bitset_mixed_runs",
     "bloom_add_fast_st",
     "bloom_contains_st",
     "bloom_add_keys_st",

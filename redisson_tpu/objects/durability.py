@@ -18,16 +18,18 @@ Mixed into TpuSketchEngine (objects/engines.py).
 
 from __future__ import annotations
 
+import io
 import json
 import os
-import pickle
+import struct
 import threading
 import time
 from typing import Optional
 
 import numpy as np
 
-_DUMP_VERSION = 1
+_DUMP_VERSION = 2
+_DUMP_MAGIC = b"RTPU"
 _SNAP_META = "sketch_meta.json"
 _SNAP_POOLS = "sketch_pools.npz"
 
@@ -43,6 +45,21 @@ class SketchDurabilityMixin:
         all free through it)."""
         return list(entry.replica_rows) if entry.replica_rows else [entry.row]
 
+    def _reap_rows(self, pool, rows, epoch: int) -> None:
+        """Zero-then-free detached rows, guarded by the pool's topology
+        epoch: a live change_topology that ran between the caller's
+        detach and this call already freed the rows in its wholesale
+        free-list rebuild (the entry was detached, so its rows weren't in
+        ``used``) — zeroing/freeing again would wipe or double-free a row
+        possibly reallocated since.  Atomic with the swap via the
+        dispatch lock (the swap bumps the epoch while holding it)."""
+        with pool._dispatch_lock:
+            if pool.topology_epoch != epoch:
+                return
+            for row in rows:
+                self.executor.zero_row(pool, row)  # RLock: reentrant
+                pool.free_row(row)
+
     # -- TTL / expiry (RedissonExpirable analog) ---------------------------
 
     def _expire_if_due(self, entry) -> bool:
@@ -54,10 +71,9 @@ class SketchDurabilityMixin:
             if time.time() >= entry.expire_at:
                 detached = self.registry.detach_if(entry.name, entry)
                 if detached is not None:
+                    epoch = entry.pool.topology_epoch
                     self._drain()
-                    for row in self._entry_rows(entry):
-                        self.executor.zero_row(entry.pool, row)
-                        entry.pool.free_row(row)
+                    self._reap_rows(entry.pool, self._entry_rows(entry), epoch)
                     # Shared heavy-hitter table dies with the object (a
                     # successor under this name must not inherit ghosts).
                     self.topk.drop(entry.name)
@@ -100,20 +116,28 @@ class SketchDurabilityMixin:
         return max(0, int((entry.expire_at - time.time()) * 1000))
 
     def _ensure_sweeper(self) -> None:
-        """Background expiry sweep, started lazily on the first TTL."""
+        """Background expiry sweep, started lazily on the first TTL.
+        Double-checked under the registry lock: two threads setting their
+        first TTLs concurrently must not each start a sweeper (the orphan
+        would keep reaping after _stop_sweeper, ADVICE r3 low)."""
         if getattr(self, "_sweeper", None) is not None:
             return
-        stop = threading.Event()
+        with self.registry._lock:
+            if getattr(self, "_sweeper", None) is not None:
+                return
+            stop = threading.Event()
 
-        def sweep():
-            while not stop.wait(0.25):
-                for entry in self.registry.entries():
-                    if entry.expire_at is not None:
-                        self._expire_if_due(entry)
+            def sweep():
+                while not stop.wait(0.25):
+                    for entry in self.registry.entries():
+                        if entry.expire_at is not None:
+                            self._expire_if_due(entry)
 
-        t = threading.Thread(target=sweep, name="rtpu-sketch-sweeper", daemon=True)
-        self._sweeper = (t, stop)
-        t.start()
+            t = threading.Thread(
+                target=sweep, name="rtpu-sketch-sweeper", daemon=True
+            )
+            self._sweeper = (t, stop)
+            t.start()
 
     def _stop_sweeper(self) -> None:
         sw = getattr(self, "_sweeper", None)
@@ -125,26 +149,39 @@ class SketchDurabilityMixin:
 
     def dump(self, name: str) -> Optional[bytes]:
         """Serialized object state, or None if absent (upstream raises on
-        missing key at RESTORE time, not DUMP)."""
+        missing key at RESTORE time, not DUMP).
+
+        Wire format is DATA-ONLY (no pickle — dump blobs may cross trust
+        boundaries, and the reference's DUMP/RESTORE format is data-only,
+        ADVICE r3): ``RTPU | u32 header_len | json header | npy row``."""
         entry = self._live_lookup(name)
         if entry is None:
             return None
         self._drain()
         row = self.executor.read_row(entry.pool, entry.row)
-        return pickle.dumps(
+        header = json.dumps(
             {
                 "v": _DUMP_VERSION,
                 "kind": entry.kind,
-                "class_key": tuple(entry.pool.spec.class_key),
+                "class_key": list(entry.pool.spec.class_key),
                 "params": dict(entry.params),
-                "row": row,
             }
+        ).encode("utf-8")
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(row), allow_pickle=False)
+        return (
+            _DUMP_MAGIC + struct.pack("<I", len(header)) + header + buf.getvalue()
         )
 
     def restore(self, name: str, data: bytes, replace: bool = False) -> None:
         """Recreate an object from ``dump`` bytes.  BUSYKEY analog: raises
         if the name exists and ``replace`` is False."""
-        d = pickle.loads(data)
+        if len(data) < 8 or data[:4] != _DUMP_MAGIC:
+            raise ValueError("not a sketch dump (bad magic)")
+        (hlen,) = struct.unpack("<I", data[4:8])
+        d = json.loads(data[8 : 8 + hlen].decode("utf-8"))
+        d["class_key"] = tuple(d.get("class_key", ()))
+        d["row"] = np.load(io.BytesIO(data[8 + hlen :]), allow_pickle=False)
         if d.get("v") != _DUMP_VERSION:
             raise ValueError(f"unsupported dump version: {d.get('v')}")
         if self._live_lookup(name) is not None:
@@ -173,9 +210,13 @@ class SketchDurabilityMixin:
         restore never sees a torn snapshot."""
         os.makedirs(directory, exist_ok=True)
         self._drain()
-        # The dispatch lock freezes pool.state swaps (donation) and registry
-        # growth for the duration of the D2H reads.
-        with self.executor._dispatch_lock:
+        # Lock ORDER: registry._lock strictly before the dispatch lock —
+        # the same order try_create/bloom_replicate use (registry then
+        # pool.alloc_row).  Taking them inverted here deadlocked a periodic
+        # snapshot against any concurrent object creation (ADVICE r3 high).
+        # Holding both also makes the capture point-in-time consistent:
+        # no tenant create/delete/grow can interleave with the D2H reads.
+        with self.registry._lock, self.executor._dispatch_lock:
             pools = self.registry.pools()
             arrays = {}
             pool_meta = []
@@ -259,7 +300,18 @@ class SketchDurabilityMixin:
         from typing import Callable
 
         remap_rows: dict[tuple, Callable[[int], np.ndarray]] = {}
-        with self.executor._dispatch_lock:
+        # Same lock order as snapshot(): registry before dispatch.
+        with self.registry._lock, self.executor._dispatch_lock:
+            if same_topology and self.registry.entries():
+                # The verbatim install below resets every pool's free list
+                # and overwrites the tenant table — on a live keyspace that
+                # would hand occupied rows to new objects (silent aliasing,
+                # ADVICE r3 medium).  Atomic refusal BEFORE any mutation.
+                live = self.registry.names()
+                raise ValueError(
+                    f"BUSYKEY: {live[:3]!r} already exist — snapshot "
+                    f"restore needs an empty keyspace"
+                )
             for i, pm in enumerate(meta["pools"]):
                 pool = self.registry.pool_for(pm["kind"], tuple(pm["class_key"]))
                 arr = data[f"pool_{i}"]
@@ -328,6 +380,188 @@ class SketchDurabilityMixin:
                     )
                 if t.get("expire_at") is not None:
                     self._ensure_sweeper()
+        return True
+
+    # -- Online reshard (SURVEY §2.4 cluster row) --------------------------
+
+    def change_topology(self, num_shards: int) -> bool:
+        """Live reshard — the ClusterConnectionManager slot-remap /
+        MasterSlaveEntry#changeMaster analog: swap the running engine onto
+        a new shard count WITHOUT restart or keyspace wipe, with zero lost
+        writes under concurrent traffic.
+
+        Protocol:
+        1. registry._lock — new op lookups/creates block for the swap's
+           duration (ops already past lookup keep flowing into the
+           coalescer; they stay valid, see 5);
+        2. drain the coalescer — everything queued dispatches on the OLD
+           executor and layout;
+        3. dispatch lock — device state quiescent;
+        4. D2H every pool, decode rows via the topology-aware extractor
+           (the snapshot-reshard machinery), compose the new layout
+           host-side, install a fresh executor that INHERITS the dispatch
+           lock object (queued dispatch closures late-bind
+           ``self.executor``, so segments submitted mid-swap run on the
+           new executor);
+        5. release — row numbers are topology-STABLE (only their physical
+           placement changes), so ops that captured a row before the swap
+           stay correct verbatim.
+
+        Read replication is disabled by the swap (placement was
+        per-old-shard); the replica rows themselves stay QUARANTINED —
+        written with the filter's data in the new layout and never
+        returned to the free list — because a producer may have read
+        ``entry.replica_rows`` before the swap and submit ops targeting
+        them after it (writes land harmlessly in a valid copy, reads
+        still see correct bits).  Quarantined rows are permanently
+        retired from the pool — a bounded leak of (S_old-1) rows per
+        replicated object per reshard, the price of the zero-lost-writes
+        guarantee (a snapshot/restore cycle reclaims them).
+        Re-replicate on demand.  Returns False if the topology is
+        unchanged.  On failure the engine rolls back to the old topology
+        (config, executor, every pool) — no partial swap survives."""
+        s_new = int(num_shards)
+        s_old = getattr(self.executor, "S", 1)
+        if s_new == s_old:
+            return False
+        if s_new < 1:
+            raise ValueError(f"num_shards must be >= 1, got {s_new}")
+        from redisson_tpu.executor.tpu_executor import TpuCommandExecutor
+
+        with self.registry._lock:
+            self._drain()
+            old_exec = self.executor
+            old_thresh = getattr(
+                self.config.tpu_sketch, "mbit_threshold_words", 0
+            )
+            with old_exec._dispatch_lock:
+                self.config.tpu_sketch.num_shards = s_new
+                try:
+                    if s_new > 1:
+                        from redisson_tpu.executor.sharded_executor import (
+                            ShardedTpuCommandExecutor,
+                        )
+
+                        new_exec = ShardedTpuCommandExecutor(self.config)
+                    else:
+                        new_exec = TpuCommandExecutor(self.config)
+                except Exception:
+                    self.config.tpu_sketch.num_shards = s_old
+                    raise
+                # ONE dispatch lock for the engine's lifetime: closures in
+                # queued segments and pool.alloc_row hold references to
+                # this object — swapping it would split the mutual
+                # exclusion domain.
+                new_exec._dispatch_lock = old_exec._dispatch_lock
+                entries = self.registry.entries()
+                # Phase 1 — PURE: compose every pool's new-layout array and
+                # free list host-side; nothing is mutated until all pools
+                # composed (a failure here leaves the engine untouched).
+                plans = []  # (pool, cap_new, new_arr, new_free)
+                for pool in self.registry.pools():
+                    arr = old_exec.state_to_host(pool)
+                    pm = {
+                        "kind": pool.spec.kind,
+                        "class_key": list(pool.spec.class_key),
+                        "capacity": pool.capacity,
+                    }
+                    getter = self._extract_rows(arr, pm, s_old, old_thresh)
+                    u = pool.spec.row_units
+                    dtype = pool.spec.dtype
+                    mbit_new = s_new > 1 and new_exec._mbit_layout(
+                        u, pool.spec.kind
+                    )
+                    # Row numbers are preserved: capacity only rounds UP
+                    # (to an S-multiple for the row-sharded layout); never
+                    # re-clamped down (a grown pool must keep its rows).
+                    if s_new == 1 or mbit_new:
+                        cap_new = pool.capacity
+                    else:
+                        cap_new = -(-pool.capacity // s_new) * s_new
+                    live = [e for e in entries if e.pool is pool]
+                    # Every row in-flight ops may target survives the swap
+                    # with its data: primaries AND read replicas (see
+                    # docstring — replicas are quarantined, not freed).
+                    keep_rows: list[int] = []
+                    for e in live:
+                        keep_rows.extend(self._entry_rows(e))
+                    if s_new == 1:
+                        new_arr = np.zeros(cap_new * u + 1, dtype)
+                        for r in keep_rows:
+                            new_arr[r * u : (r + 1) * u] = getter(r)
+                    elif mbit_new:
+                        wl = u // s_new
+                        new_arr = np.zeros((s_new, cap_new * wl + 1), dtype)
+                        for r in keep_rows:
+                            data = getter(r)
+                            for s in range(s_new):
+                                new_arr[s, r * wl : (r + 1) * wl] = (
+                                    data[s * wl : (s + 1) * wl]
+                                )
+                    else:
+                        new_arr = np.zeros(
+                            (s_new, cap_new // s_new * u + 1), dtype
+                        )
+                        for r in keep_rows:
+                            local = r // s_new
+                            new_arr[
+                                r % s_new, local * u : (local + 1) * u
+                            ] = getter(r)
+                    used = set(keep_rows)
+                    new_free = [
+                        r for r in range(cap_new - 1, -1, -1) if r not in used
+                    ]
+                    plans.append((pool, cap_new, new_arr, new_free))
+                # Phase 2 — MUTATE, journaled: any failure restores every
+                # pool, the config, and the executor binding.
+                journal = []
+                try:
+                    for pool, cap_new, new_arr, new_free in plans:
+                        journal.append(
+                            (
+                                pool,
+                                pool.state,
+                                pool.capacity,
+                                pool._free,
+                                pool.generation,
+                                pool.topology_epoch,
+                                pool._factory,
+                            )
+                        )
+                        pool.capacity = cap_new
+                        pool._free = new_free
+                        pool.generation += 1
+                        # Reap sequences (delete/expiry/rename/migration)
+                        # that detached BEFORE this swap must not
+                        # zero/free again: their rows were reclaimed by
+                        # the rebuild above (engines._reap_rows checks
+                        # this epoch under the dispatch lock we hold).
+                        pool.topology_epoch += 1
+                        pool._factory = new_exec
+                        new_exec.state_from_host(pool, new_arr)
+                except Exception:
+                    for pool, st, cap, free, gen, ep, fac in journal:
+                        pool.state = st
+                        pool.capacity = cap
+                        pool._free = free
+                        pool.generation = gen
+                        pool.topology_epoch = ep
+                        pool._factory = fac
+                    self.config.tpu_sketch.num_shards = s_old
+                    raise
+                # Point of no return — all device state installed.
+                for e in entries:
+                    e.replica_rows = None  # quarantined, not freed
+                self.registry._factory = new_exec
+                self.executor = new_exec
+                # Retire the old executor LAST: a caller that read
+                # engine.executor before this swap and is blocked on the
+                # dispatch lock gets FORWARDED to the successor when it
+                # acquires (see _locked in tpu_executor.py); runs-metadata
+                # dispatches that can't forward raise retryable into the
+                # coalescer's retry loop instead.
+                old_exec._successor = new_exec
+                old_exec._retired = True
         return True
 
     @staticmethod

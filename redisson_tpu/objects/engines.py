@@ -102,6 +102,29 @@ class TopKStore:
                 self._tables[new] = t
 
 
+class _ConcatLazy:
+    """LazyResult adapter concatenating per-group results in op order —
+    used when a mid-segment migration split one coalesced launch into
+    consecutive per-pool launches (futures slice by [start, start+n)
+    against the concatenation, which preserves op order)."""
+
+    def __init__(self, parts):
+        self._parts = parts
+        self._done = None
+
+    def result(self):
+        if self._done is None:
+            self._done = np.concatenate([p.result() for p in self._parts])
+            self._parts = None
+        return self._done
+
+    def get(self):
+        return self.result()
+
+    def done(self) -> bool:
+        return self._done is not None
+
+
 class _MappedFuture:
     """Future adapter applying a transform on .result()."""
 
@@ -152,6 +175,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 max_inflight=config.tpu_sketch.max_inflight,
                 retry_attempts=config.retry_attempts,
                 retry_interval_s=config.retry_interval_ms / 1000.0,
+                max_queued_ops=config.tpu_sketch.max_queued_ops,
+                adaptive_inflight=config.tpu_sketch.adaptive_inflight,
+                min_inflight=config.tpu_sketch.min_inflight,
             )
         # Checkpoint/resume (SURVEY.md §5): restore device state from the
         # configured snapshot dir, then arm periodic snapshots.
@@ -178,10 +204,12 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if self.coalescer is not None:
             self.coalescer.drain()
 
-    def _submit(self, key, dispatch, arrays, nops, pool_key=None):
+    def _submit(self, key, dispatch, arrays, nops, pool_key=None, meta=None):
         from redisson_tpu.executor.coalescer import HintedFuture
 
-        fut = self.coalescer.submit(key, dispatch, arrays, nops, pool_key=pool_key)
+        fut = self.coalescer.submit(
+            key, dispatch, arrays, nops, pool_key=pool_key, meta=meta
+        )
         return HintedFuture(fut, self.coalescer)
 
     # -- generic -----------------------------------------------------------
@@ -205,10 +233,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         was_expired = (
             entry.expire_at is not None and _time.time() >= entry.expire_at
         )
+        epoch = entry.pool.topology_epoch
         self._drain()
-        for row in self._entry_rows(entry):
-            self.executor.zero_row(entry.pool, row)
-            entry.pool.free_row(row)
+        self._reap_rows(entry.pool, self._entry_rows(entry), epoch)
         self.topk.drop(name)
         return not was_expired
 
@@ -224,9 +251,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if not ok:
             return False
         if dest is not None:
-            for row in self._entry_rows(dest):
-                self.executor.zero_row(dest.pool, row)
-                dest.pool.free_row(row)
+            self._reap_rows(
+                dest.pool, self._entry_rows(dest), dest.pool.topology_epoch
+            )
         self.topk.rename(old, new)
         return True
 
@@ -477,6 +504,65 @@ class TpuSketchEngine(SketchDurabilityMixin):
     # path, bit-identical to the host pipeline); coalesced/sharded paths
     # hash on the host as before.
 
+    def _runs_dispatch(self, pool, k):
+        """Flush-time dispatch for the run-length mixed path: folds the
+        segment's per-chunk metas into per-RUN metadata arrays (row, m,
+        is_add once per chunk + cumulative starts) and ships them with the
+        concatenated key blocks (executor.bloom_mixed_keys_runs).  Key
+        lengths collapse to one scalar when every chunk is const-length."""
+
+        def dispatch(cols, metas):
+            C = len(metas)
+            run_rows = np.empty(C, np.int32)
+            run_m = np.empty(C, np.uint32)
+            run_flags = np.empty(C, np.bool_)
+            starts = np.zeros(C + 1, np.int32)
+            const_val = None
+            all_const = True
+            for i, (nops, (row, m, flag, ln)) in enumerate(metas):
+                run_rows[i] = row
+                run_m[i] = m
+                run_flags[i] = flag
+                starts[i + 1] = starts[i] + nops
+                if isinstance(ln, (int, np.integer)):
+                    if const_val is None:
+                        const_val = int(ln)
+                    elif const_val != int(ln):
+                        all_const = False
+                else:
+                    all_const = False
+            if all_const:
+                lengths = np.uint32(0 if const_val is None else const_val)
+            else:
+                lengths = np.concatenate(
+                    [
+                        np.full(nops, ln, np.uint32)
+                        if isinstance(ln, (int, np.integer))
+                        else np.asarray(ln, np.uint32)
+                        for nops, (_, _, _, ln) in metas
+                    ]
+                )
+            if not getattr(self.executor, "supports_runs_metadata", False):
+                # The executor changed under a queued segment (live
+                # change_topology swaps in a sharded executor, which has
+                # no runs kernel): expand the runs host-side and take the
+                # per-op-array path — rows are topology-stable, so the
+                # queued ops stay valid verbatim.
+                B = int(starts[-1])
+                rows = np.repeat(run_rows, np.diff(starts))
+                m_arr = np.repeat(run_m, np.diff(starts))
+                flags = np.repeat(run_flags, np.diff(starts))
+                if np.ndim(lengths) == 0:
+                    lengths = np.full(B, lengths, np.uint32)
+                return self.executor.bloom_mixed_keys(
+                    pool, rows, m_arr, k, cols[0], lengths, flags
+                )
+            return self.executor.bloom_mixed_keys_runs(
+                pool, k, cols[0], lengths, run_rows, run_m, run_flags, starts
+            )
+
+        return dispatch
+
     def _bloom_submit_mixed_keys(self, entry, blocks, lengths, is_add: bool):
         """Device-hash path: raw codec lanes ride the mixed kernel;
         producer threads never hash (GIL relief under offered load).
@@ -488,11 +574,44 @@ class TpuSketchEngine(SketchDurabilityMixin):
         B = blocks.shape[0]
         L = blocks.shape[1]
         lengths = np.asarray(lengths, np.uint32)
+        saw_replicas = bool(entry.replica_rows)
+        if (
+            self.coalescer is not None
+            and not saw_replicas
+            and getattr(self.executor, "supports_runs_metadata", False)
+        ):
+            # Run-length path: row/m/is_add are constant across this call,
+            # so they ride the segment as ONE meta tuple instead of B-long
+            # arrays — ~22→~8 bytes/op on the wire (PROFILE.md lever 1) and
+            # no np.full per submit on the producer thread.
+            if lengths.ndim == 0:
+                len_meta = int(lengths)
+            else:
+                const = B > 0 and bool(np.all(lengths == lengths[0]))
+                len_meta = int(lengths[0]) if const else lengths
+            fut = self._submit(
+                ("bloom_mixkr", id(pool), k, L),
+                self._runs_dispatch(pool, k),
+                (blocks,),
+                B,
+                pool_key=id(pool),
+                meta=(entry.row, m, is_add, len_meta),
+            )
+            if is_add:
+                self._replication_fence(
+                    entry,
+                    saw_replicas,
+                    # _bloom_submit_mixed_keys accepts scalar lengths, so
+                    # the original (blocks, lengths) pair re-submits as-is.
+                    lambda: self._bloom_submit_mixed_keys(
+                        entry, blocks, lengths, True
+                    ),
+                )
+            return fut
         if lengths.ndim == 0:
             lengths = np.full(B, lengths, np.uint32)
         flags = np.full(B, is_add, bool)
         orig = (blocks, lengths)
-        saw_replicas = bool(entry.replica_rows)
         if saw_replicas:
             rows, eidx, ppos = self._bloom_expand_ops(entry, B, flags)
             blocks, lengths, flags = blocks[eidx], lengths[eidx], flags[eidx]
@@ -500,8 +619,8 @@ class TpuSketchEngine(SketchDurabilityMixin):
         else:
             rows = np.full(B, entry.row, np.int32)
             gather = None
-        m_arr = np.full(len(rows), m, np.uint32)
         if self.coalescer is not None:
+            m_arr = np.full(len(rows), m, np.uint32)
             fut = self._submit(
                 ("bloom_mixk", id(pool), k, L),
                 lambda cols: self.executor.bloom_mixed_keys(
@@ -512,6 +631,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 pool_key=id(pool),
             )
         else:
+            m_arr = np.full(len(rows), m, np.uint32)
             fut = self.executor.bloom_mixed_keys(
                 pool, rows, m_arr, k, blocks, lengths, flags
             )
@@ -652,23 +772,46 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def _bitset_grow(self, entry, min_bits: int) -> None:
         """Auto-grow semantics of Redis bitmaps: migrate the tenant to a
-        larger size class, copying the row through the host (rare path)."""
+        larger size class, copying the row through the host (rare path).
+
+        The commit (write new row, zero+free old, repoint the entry) runs
+        under the dispatch lock with a topology-epoch check: if a live
+        change_topology swapped layouts mid-migration, the swap's free-
+        list rebuild already reclaimed the not-yet-attached new row — we
+        retry against the fresh layout instead of committing stale state."""
         cur_words = entry.pool.row_units
         need_words = class_words_for_bits(min_bits)
         if need_words <= cur_words:
             return
-        # Queued coalesced ops still target the old pool/row — flush them
-        # before copying the row out.
+        # Shrink the queue first (optional — flush-time row resolution in
+        # _bitset_submit_mixed makes queued ops follow the repoint, so
+        # correctness doesn't depend on this drain).
         self._drain()
-        data = self.executor.read_row(entry.pool, entry.row)
-        new_pool = self.registry.pool_for(PoolKind.BITSET, (need_words,))
-        new_row = new_pool.alloc_row()
-        padded = np.zeros(need_words, dtype=np.uint32)
-        padded[: len(data)] = data
-        self.executor.write_row(new_pool, new_row, padded)
-        self.executor.zero_row(entry.pool, entry.row)
-        entry.pool.free_row(entry.row)
-        entry.pool, entry.row = new_pool, new_row
+        while True:
+            old_pool, old_row = entry.pool, entry.row
+            epoch_old = old_pool.topology_epoch
+            new_pool = self.registry.pool_for(PoolKind.BITSET, (need_words,))
+            epoch_new = new_pool.topology_epoch
+            new_row = new_pool.alloc_row()
+            with old_pool._dispatch_lock:
+                if (
+                    old_pool.topology_epoch != epoch_old
+                    or new_pool.topology_epoch != epoch_new
+                ):
+                    # A topology swap rebuilt the free lists (new_row is
+                    # back in _free — do NOT free it again); retry against
+                    # the fresh layout.
+                    continue
+                # Read INSIDE the lock: the copy and the commit are atomic
+                # vs concurrent flushes applying ops to the old row.
+                data = self.executor.read_row(old_pool, old_row)
+                padded = np.zeros(need_words, dtype=np.uint32)
+                padded[: len(data)] = data
+                self.executor.write_row(new_pool, new_row, padded)
+                self.executor.zero_row(old_pool, old_row)
+                old_pool.free_row(old_row)
+                entry.pool, entry.row = new_pool, new_row
+                return
 
     def bitset_capacity_bits(self, name) -> int:
         entry = self._lookup_kind(name, PoolKind.BITSET)
@@ -677,25 +820,74 @@ class TpuSketchEngine(SketchDurabilityMixin):
     def _bitset_submit_mixed(self, entry, idx, opcode: int):
         """Coalesced path: every single-bit opcode rides ONE segment per
         pool through the unified affine kernel (exact sequential
-        semantics), so interleaved set/clear/flip/get never fragment."""
-        pool = entry.pool
-        rows = np.full(len(idx), entry.row, np.int32)
-        ops_col = np.full(len(idx), opcode, np.uint32)
+        semantics), so interleaved set/clear/flip/get never fragment.
+
+        Placement (entry.pool/row) resolves at FLUSH time, under the
+        dispatch lock, from per-chunk metas — not at submit: a size-class
+        migration (_bitset_grow) or live change_topology committing while
+        ops sit queued repoints the entry, and baked-at-submit rows would
+        land writes in the old, freed row (lost updates).  Flush-time
+        resolution linearizes queued ops AFTER the commit, onto the row
+        that now holds the data."""
+
+        def dispatch(cols, metas):
+            with self.executor._dispatch_lock:  # atomic vs migration commit
+                # Group CONSECUTIVE chunks by their resolved pool (op
+                # order is preserved — groups split only at chunk
+                # boundaries).  More than one group only when a migration
+                # committed mid-segment.
+                groups = []  # (pool, [(nops, row, opcode)], idx_lo, idx_hi)
+                off = 0
+                for nops, (e, op) in metas:
+                    pool, row = e.pool, e.row
+                    if groups and groups[-1][0] is pool:
+                        groups[-1][1].append((nops, row, op))
+                        groups[-1][3] = off + nops
+                    else:
+                        groups.append([pool, [(nops, row, op)], off, off + nops])
+                    off += nops
+                results = []
+                for pool, runs, lo, hi in groups:
+                    gidx = cols[0][lo:hi]
+                    if getattr(self.executor, "supports_runs_metadata", False):
+                        run_rows = np.array([r for _, r, _ in runs], np.int32)
+                        run_ops = np.array([o for _, _, o in runs], np.uint32)
+                        starts = np.zeros(len(runs) + 1, np.int32)
+                        starts[1:] = np.cumsum([n for n, _, _ in runs])
+                        results.append(
+                            self.executor.bitset_mixed_runs(
+                                pool, gidx, run_rows, run_ops, starts
+                            )
+                        )
+                    else:
+                        rows = np.concatenate(
+                            [np.full(n, r, np.int32) for n, r, _ in runs]
+                        )
+                        ops_col = np.concatenate(
+                            [np.full(n, o, np.uint32) for n, _, o in runs]
+                        )
+                        results.append(
+                            self.executor.bitset_mixed(pool, rows, gidx, ops_col)
+                        )
+                return results[0] if len(results) == 1 else _ConcatLazy(results)
+
         return self._submit(
-            ("bs_mix", id(pool)),
-            lambda cols: self.executor.bitset_mixed(
-                pool, cols[0], cols[1], cols[2]
-            ),
-            (rows, idx, ops_col),
+            ("bs_mix", id(entry.pool)),
+            dispatch,
+            (np.asarray(idx, np.uint32),),
             len(idx),
-            pool_key=id(pool),
+            pool_key=id(entry.pool),
+            meta=(entry, opcode),
         )
 
     def _bitset_rw(self, opcode: int, method, entry, idx):
         if self.coalescer is not None:
             return self._bitset_submit_mixed(entry, idx, opcode)
-        rows = np.full(len(idx), entry.row, np.int32)
-        return method(entry.pool, rows, idx)
+        # Resolve placement and dispatch atomically vs a concurrent
+        # size-class migration (same lock its commit holds).
+        with self.executor._dispatch_lock:
+            rows = np.full(len(idx), entry.row, np.int32)
+            return method(entry.pool, rows, idx)
 
     def bitset_set(self, name, idx, value: bool) -> LazyResult:
         from redisson_tpu.ops import bitset as bitset_ops

@@ -66,6 +66,117 @@ class Node:
         return time.time()
 
 
+class NodeDownEvent:
+    """Typed failure event: a shard's device stopped answering ping —
+    the failedSlaveCheckInterval / PingConnectionHandler analog
+    (SURVEY.md §5 failure row)."""
+
+    def __init__(self, shard: int, address: str):
+        self.shard = shard
+        self.address = address
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"NodeDownEvent(shard={self.shard}, address={self.address!r})"
+
+
+class NodeUpEvent:
+    """Recovery counterpart of NodeDownEvent."""
+
+    def __init__(self, shard: int, address: str):
+        self.shard = shard
+        self.address = address
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"NodeUpEvent(shard={self.shard}, address={self.address!r})"
+
+
+class FailureMonitor:
+    """Background monitor consuming ``Node.ping`` on an interval and
+    surfacing dead/recovered shards as typed events — the topology-
+    monitor loop of ClusterConnectionManager reduced to its pod-local
+    substance.  Listeners receive NodeDownEvent exactly once per
+    down-transition (and NodeUpEvent on recovery), not once per failed
+    ping."""
+
+    def __init__(self, nodes_group: "NodesGroup", interval_s: float = 1.0,
+                 ping_timeout_s: float = 10.0):
+        import threading
+
+        self._ng = nodes_group
+        self.interval_s = interval_s
+        self.ping_timeout_s = ping_timeout_s
+        self._listeners: list = []
+        self._down: set[int] = set()
+        self._stop = threading.Event()
+        self._thread = None
+        self._threading = threading
+        # Serializes sweeps: a monitor thread whose stop() join timed out
+        # (wedged ping) may overlap the next start()'s thread briefly —
+        # the lock keeps _down/listener emission race-free until the old
+        # thread sees its own stop event and exits.
+        self._sweep_lock = threading.Lock()
+
+    def add_listener(self, cb) -> None:
+        """``cb(event)`` is invoked from the monitor thread."""
+        self._listeners.append(cb)
+
+    def down_shards(self) -> set:
+        return set(self._down)
+
+    def check_once(self) -> list:
+        """One synchronous sweep (also what the thread loops); returns the
+        events emitted."""
+        with self._sweep_lock:
+            return self._check_once_locked()
+
+    def _check_once_locked(self) -> list:
+        events = []
+        for node in self._ng.get_nodes():
+            ok = node.ping(self.ping_timeout_s)
+            if not ok and node.shard not in self._down:
+                self._down.add(node.shard)
+                events.append(NodeDownEvent(node.shard, node.address))
+            elif ok and node.shard in self._down:
+                self._down.discard(node.shard)
+                events.append(NodeUpEvent(node.shard, node.address))
+        for ev in events:
+            for cb in self._listeners:
+                try:
+                    cb(ev)
+                except Exception:  # pragma: no cover — listener bug
+                    pass
+        return events
+
+    def start(self) -> None:
+        with self._sweep_lock:  # start/stop are thread-safe
+            if self._thread is not None and self._thread.is_alive():
+                if not self._stop.is_set():
+                    return  # already running
+            # Each thread closes over its OWN stop event: clearing a
+            # shared event would resurrect a zombie thread whose stop()
+            # join timed out on a wedged ping (it would loop forever
+            # beside the new one).
+            stop = self._threading.Event()
+            self._stop = stop
+
+            def loop():
+                while not stop.wait(self.interval_s):
+                    self.check_once()
+
+            self._thread = self._threading.Thread(
+                target=loop, name="rtpu-failure-monitor", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            if not t.is_alive():
+                self._thread = None
+
+
 class NodesGroup:
     """→ RedissonClient#getNodesGroup."""
 

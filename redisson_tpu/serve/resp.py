@@ -46,6 +46,19 @@ def _encode_int(n: int) -> bytes:
     return b":" + str(int(n)).encode() + b"\r\n"
 
 
+def _fmt_score(score: float) -> str:
+    """Redis-style double formatting: integral scores print as integers
+    ('1', not '1.0'); non-finite as 'inf'/'-inf'/'nan'; everything else
+    %.17g (the shortest exact form Redis emits)."""
+    import math
+
+    if not math.isfinite(score):
+        return repr(score)  # 'inf' / '-inf' / 'nan' — Redis spelling
+    if score == int(score) and abs(score) < 1e17:
+        return "%d" % int(score)
+    return "%.17g" % score
+
+
 def _encode_bulk(v) -> bytes:
     if v is None:
         return b"$-1\r\n"
@@ -472,7 +485,7 @@ class RespServer:
 
     def _cmd_ZSCORE(self, args):
         score = self._zset(args[0]).get_score(args[1])
-        return _encode_bulk(None if score is None else repr(score))
+        return _encode_bulk(None if score is None else _fmt_score(score))
 
     def _cmd_ZRANGE(self, args):
         z = self._zset(args[0])
@@ -481,7 +494,7 @@ class RespServer:
             return _encode_array(z.value_range(int(args[1]), int(args[2])))
         flat = []
         for member, score in z.entry_range(int(args[1]), int(args[2])):
-            flat.extend([member, repr(score)])
+            flat.extend([member, _fmt_score(score)])
         return _encode_array(flat)
 
     def _cmd_ZCARD(self, args):

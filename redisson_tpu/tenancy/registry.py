@@ -99,6 +99,11 @@ class SizeClassPool:
         )
         self._free: list[int] = list(range(self.capacity - 1, -1, -1))
         self.generation = 0  # bumped on every growth (jit cache key part)
+        # Bumped (under the dispatch lock) by a live change_topology,
+        # which rebuilds the free list wholesale: reap sequences that
+        # detached an entry BEFORE the swap must not zero/free the row
+        # again afterwards (engines._reap_rows checks this epoch).
+        self.topology_epoch = 0
 
     @property
     def row_units(self) -> int:

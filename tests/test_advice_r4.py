@@ -1,0 +1,167 @@
+"""Regression tests for round-3 advisor findings, fixed in round 4:
+snapshot lock-order deadlock, same-topology restore BUSYKEY, data-only
+dump format, sweeper singleton, Redis-style zset score formatting."""
+
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+
+
+def _tpu_client():
+    cfg = Config()
+    cfg.use_tpu_sketch(min_bucket=64)
+    return redisson_tpu.create(cfg)
+
+
+def test_snapshot_vs_create_no_deadlock(tmp_path):
+    """ADVICE r3 high: snapshot() took dispatch→registry while try_create
+    takes registry→dispatch — a periodic snapshot racing object creation
+    deadlocked both.  Hammer the two paths concurrently."""
+    c = _tpu_client()
+    try:
+        c.get_bloom_filter("dl-seed").try_init(100, 0.01)
+        stop = threading.Event()
+        errors = []
+
+        def snap_side():
+            i = 0
+            while not stop.is_set() and i < 60:
+                try:
+                    c._engine.snapshot(str(tmp_path / "snap"))
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+                    return
+                i += 1
+
+        def create_side():
+            i = 0
+            while not stop.is_set() and i < 300:
+                try:
+                    c.get_bloom_filter(f"dl-bf-{i}").try_init(100, 0.01)
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+                    return
+                i += 1
+
+        t1 = threading.Thread(target=snap_side, daemon=True)
+        t2 = threading.Thread(target=create_side, daemon=True)
+        t1.start(); t2.start()
+        t1.join(timeout=30); t2.join(timeout=30)
+        alive = t1.is_alive() or t2.is_alive()
+        stop.set()
+        assert not errors, errors
+        assert not alive, "snapshot vs create deadlocked"
+    finally:
+        c.shutdown()
+
+
+def test_same_topology_restore_refuses_live_keyspace(tmp_path):
+    """ADVICE r3 medium: the verbatim (same-topology) restore path reset
+    pool free-lists under live tenants — silent row aliasing.  It must
+    refuse with BUSYKEY, atomically, like the reshard path does."""
+    c = _tpu_client()
+    try:
+        bf = c.get_bloom_filter("snap-a")
+        bf.try_init(1000, 0.01)
+        bf.add("x")
+        c._engine.snapshot(str(tmp_path))
+    finally:
+        c.shutdown()
+
+    c2 = _tpu_client()
+    try:
+        c2.get_bloom_filter("live-b").try_init(1000, 0.01)
+        with pytest.raises(ValueError, match="BUSYKEY"):
+            c2._engine.restore_snapshot(str(tmp_path))
+        # Atomic refusal: the live object must be untouched.
+        assert c2._engine._live_lookup("live-b") is not None
+    finally:
+        c2.shutdown()
+
+    # Empty keyspace: restore works and state round-trips.
+    c3 = _tpu_client()
+    try:
+        assert c3._engine.restore_snapshot(str(tmp_path)) is True
+        assert c3.get_bloom_filter("snap-a").contains("x")
+    finally:
+        c3.shutdown()
+
+
+def test_dump_format_is_data_only():
+    """ADVICE r3 low: dump blobs must not be pickle (arbitrary code
+    execution across trust boundaries)."""
+    import pickle
+
+    c = _tpu_client()
+    try:
+        bf = c.get_bloom_filter("fmt")
+        bf.try_init(500, 0.01)
+        bf.add("payload")
+        blob = bf.dump()
+        assert blob.startswith(b"RTPU")
+        with pytest.raises(Exception):
+            pickle.loads(blob)  # not a pickle stream
+        with pytest.raises(ValueError, match="magic"):
+            c._engine.restore("fmt2", b"\x80\x04garbage")
+        c._engine.restore("fmt-copy", blob)
+        assert c.get_bloom_filter("fmt-copy").contains("payload")
+    finally:
+        c.shutdown()
+
+
+def test_sweeper_started_exactly_once():
+    """ADVICE r3 low: concurrent first-TTL setters must not each start a
+    sweeper thread (the orphan would outlive _stop_sweeper)."""
+    c = _tpu_client()
+    try:
+        for i in range(8):
+            c.get_bloom_filter(f"ttl-{i}").try_init(100, 0.01)
+        before = sum(
+            1 for t in threading.enumerate() if t.name == "rtpu-sketch-sweeper"
+        )
+        barrier = threading.Barrier(8)
+
+        def arm(i):
+            barrier.wait()
+            c._engine.expire(f"ttl-{i}", 30.0)
+
+        ts = [threading.Thread(target=arm, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        after = sum(
+            1 for t in threading.enumerate() if t.name == "rtpu-sketch-sweeper"
+        )
+        assert after - before == 1
+        c._engine._stop_sweeper()
+        time.sleep(0.4)
+        remaining = sum(
+            1
+            for t in threading.enumerate()
+            if t.name == "rtpu-sketch-sweeper" and t.is_alive()
+        )
+        assert remaining == before
+    finally:
+        c.shutdown()
+
+
+def test_zset_score_formatting_redis_style():
+    """ADVICE r3 low: integral scores must encode as '1', not '1.0'."""
+    from redisson_tpu.serve.resp import _fmt_score
+
+    assert _fmt_score(1.0) == "1"
+    assert _fmt_score(-3.0) == "-3"
+    assert _fmt_score(0.0) == "0"
+    assert _fmt_score(1.5) == "1.5"
+    assert _fmt_score(2.25) == "2.25"
+    # %.17g round-trips exactly
+    assert float(_fmt_score(0.1)) == 0.1
+    # Non-finite scores are valid in Redis (ZADD z inf a).
+    assert _fmt_score(float("inf")) == "inf"
+    assert _fmt_score(float("-inf")) == "-inf"
+    assert _fmt_score(float("nan")) == "nan"

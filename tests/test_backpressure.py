@@ -1,0 +1,148 @@
+"""Engine-side backpressure + adaptive in-flight (round 4, VERDICT #2):
+submit() blocks at the queue bound (the pooled-acquire role) so an
+unpaced producer cannot build an unbounded queue; the dispatch window
+shrinks when launch retirement degrades (the >12-launch transport cliff)
+and grows back when it recovers."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.executor.coalescer import BatchCoalescer
+
+
+class _FakeLazy:
+    def __init__(self, value, delay_s=0.0):
+        self._v = value
+        self._delay = delay_s
+
+    def result(self):
+        if self._delay:
+            time.sleep(self._delay)
+        return self._v
+
+
+def _mk(**kw):
+    kw.setdefault("batch_window_us", 500)
+    kw.setdefault("max_batch", 1024)
+    return BatchCoalescer(**kw)
+
+
+def test_submit_blocks_at_queue_bound():
+    """A producer outrunning a slow dispatch path must block in submit()
+    (engine backpressure), keeping the queue at or under the bound."""
+    gate = threading.Event()
+    max_seen = [0]
+
+    def dispatch(cols):
+        gate.wait(5.0)  # first launch stalls; queue builds behind it
+        return _FakeLazy(np.concatenate(cols))
+
+    c = _mk(max_queued_ops=2048, max_inflight=1)
+    try:
+        futs = []
+        t0 = time.monotonic()
+
+        def producer():
+            for i in range(40):
+                futs.append(
+                    c.submit(
+                        ("k",), dispatch, (np.arange(256, dtype=np.int64),), 256
+                    )
+                )
+                max_seen[0] = max(max_seen[0], c._queued_ops)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        # Producer must be blocked well before 40 submits (40*256 ≫ 2048).
+        assert t.is_alive(), "producer was never backpressured"
+        assert c._queued_ops <= 2048
+        gate.set()
+        t.join(timeout=20)
+        assert not t.is_alive()
+        assert max_seen[0] <= 2048
+        for f in futs:
+            assert f.result(timeout=20) is not None
+    finally:
+        gate.set()
+        c.shutdown()
+
+
+def test_oversize_single_submit_admitted_when_empty():
+    """One submit larger than the bound must pass (no self-deadlock)."""
+    c = _mk(max_queued_ops=100)
+    try:
+        f = c.submit(
+            ("big",),
+            lambda cols: _FakeLazy(np.concatenate(cols)),
+            (np.arange(5000, dtype=np.int64),),
+            5000,
+        )
+        assert len(f.result(timeout=10)) == 5000
+    finally:
+        c.shutdown()
+
+
+def test_adaptive_window_shrinks_on_slow_retirement_and_regrows():
+    c = _mk(max_inflight=8, min_inflight=2, adaptive_inflight=True)
+    c.slow_launch_s = 0.05
+    c.fast_launch_s = 0.02
+    try:
+        slow = lambda cols: _FakeLazy(np.concatenate(cols), delay_s=0.12)  # noqa: E731
+        fast = lambda cols: _FakeLazy(np.concatenate(cols), delay_s=0.0)  # noqa: E731
+        assert c._inflight_limit == 8
+        for i in range(6):
+            c.submit((f"s{i}",), slow, (np.arange(8, dtype=np.int64),), 8).result(
+                timeout=10
+            )
+        assert c._inflight_limit == 2, c._inflight_limit
+        # Recovery: a streak of fast retirements grows the window back.
+        for i in range(80):
+            c.submit((f"f{i}",), fast, (np.arange(8, dtype=np.int64),), 8).result(
+                timeout=10
+            )
+        assert c._inflight_limit >= 6, c._inflight_limit
+    finally:
+        c.shutdown()
+
+
+def test_unpaced_producer_bounded_latency_end_to_end():
+    """VERDICT #2 done-criterion: an unpaced producer WITHOUT any
+    client-side future window sees bounded batch-wait p99 — the engine's
+    own admission control is the bound."""
+    cfg = Config().use_tpu_sketch(
+        min_bucket=64, batch_window_us=200, max_batch=4096,
+        max_queued_ops=16384,
+    )
+    cl = redisson_tpu.create(cfg)
+    try:
+        bf = cl.get_bloom_filter("bp")
+        bf.try_init(50_000, 0.01)
+        # Warm every pow-2 bucket the run can hit (merge-at-pop forms
+        # segments up to max_batch) so no compile lands in the window.
+        b = 256
+        while b <= 4096:
+            bf.add_all_async(np.arange(b, dtype=np.uint64)).result(timeout=120)
+            b *= 2
+        cl._engine.metrics.reset()
+        futs = []
+        rng = np.random.default_rng(0)
+        for i in range(400):  # no pacing, no result() while submitting
+            futs.append(
+                bf.add_all_async(rng.integers(0, 1 << 20, 256).astype(np.uint64))
+            )
+        for f in futs:
+            f.result(timeout=60)
+        m = cl.get_metrics()
+        # Queue bound 16k ops @ >100k ops/s device floor ⇒ sub-second wait
+        # even on a loaded CPU test host; without backpressure this shape
+        # queued 100k+ ops and p99 grew with producer speed (round 2).
+        assert m["p99_wait_ms"] < 2000, m
+        assert m["ops_total"] == 400 * 256
+    finally:
+        cl.shutdown()

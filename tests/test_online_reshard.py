@@ -1,0 +1,320 @@
+"""Online reshard + failure monitor (round 4, VERDICT #4): live
+change_topology without restart/wipe, zero lost writes under concurrent
+traffic; FailureMonitor surfaces dead shards as typed events."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.codecs import LongCodec
+from redisson_tpu.serve.nodes import FailureMonitor, NodeDownEvent, NodeUpEvent
+
+
+def _client(**kw):
+    kw.setdefault("min_bucket", 64)
+    kw.setdefault("batch_window_us", 300)
+    cfg = Config().set_codec(LongCodec()).use_tpu_sketch(**kw)
+    return redisson_tpu.create(cfg)
+
+
+def test_reshard_1_to_4_preserves_all_object_kinds():
+    c = _client()
+    try:
+        bf = c.get_bloom_filter("rs-bf")
+        bf.try_init(10_000, 0.01)
+        keys = np.arange(2000, dtype=np.uint64)
+        bf.add_all(keys)
+        h = c.get_hyper_log_log("rs-hll")
+        h.add_all(np.arange(5000, dtype=np.uint64))
+        hll_before = h.count()
+        bs = c.get_bit_set("rs-bs")
+        idx = np.array([1, 77, 4095, 12345], dtype=np.uint32)
+        bs.set_many(idx)
+        bits_before = bs.as_bit_array()
+        cms = c.get_count_min_sketch("rs-cms")
+        cms.try_init(4, 1 << 12)
+        cms.add_all(np.arange(100, dtype=np.uint64), np.full(100, 3))
+
+        assert c.change_topology(4) is True
+        assert getattr(c._engine.executor, "S", 1) == 4
+
+        assert int(np.sum(bf.contains_each(keys))) == len(keys)
+        assert h.count() == hll_before  # register-exact remap
+        assert np.array_equal(bs.as_bit_array(), bits_before)
+        assert cms.estimate(np.uint64(5)) >= 3
+
+        # And back down to a single device.
+        assert c.change_topology(1) is True
+        assert int(np.sum(bf.contains_each(keys))) == len(keys)
+        assert h.count() == hll_before
+        assert np.array_equal(bs.as_bit_array(), bits_before)
+        assert c.change_topology(1) is False  # no-op
+    finally:
+        c.shutdown()
+
+
+def test_reshard_under_concurrent_traffic_zero_lost_writes():
+    """VERDICT #4 done-criterion: reshard 1→4 while producers keep
+    writing; every acknowledged add must be present afterwards."""
+    c = _client()
+    try:
+        n_threads = 4
+        bfs = []
+        for t in range(n_threads):
+            bf = c.get_bloom_filter(f"cc-{t}")
+            bf.try_init(50_000, 0.01)
+            bfs.append(bf)
+        errors = []
+        acked = [[] for _ in range(n_threads)]
+        stop = threading.Event()
+
+        def producer(tid):
+            bf = bfs[tid]
+            base = tid << 32
+            i = 0
+            try:
+                while not stop.is_set() and i < 8000:
+                    ks = np.arange(base + i, base + i + 64, dtype=np.uint64)
+                    bf.add_all_async(ks).result(timeout=120)
+                    acked[tid].append((base + i, base + i + 64))
+                    i += 64
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=producer, args=(t,), daemon=True)
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        import time
+
+        time.sleep(0.5)  # let traffic build
+        assert c.change_topology(4) is True
+        time.sleep(0.5)  # traffic continues on the new topology
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert all(acked[t] for t in range(n_threads)), "no traffic flowed"
+        for t in range(n_threads):
+            for lo, hi in acked[t]:
+                ks = np.arange(lo, hi, dtype=np.uint64)
+                got = int(np.sum(bfs[t].contains_each(ks)))
+                assert got == hi - lo, (t, lo, hi, got)
+    finally:
+        c.shutdown()
+
+
+def test_reshard_drops_replicas_but_keeps_reads_correct():
+    c = _client(num_shards=2)
+    try:
+        bf = c.get_bloom_filter("rep")
+        bf.try_init(5000, 0.01)
+        keys = np.arange(500, dtype=np.uint64)
+        bf.add_all(keys)
+        assert bf.set_replicated() is True
+        assert bf.is_replicated()
+        assert c.change_topology(4) is True
+        assert not bf.is_replicated()  # placement was per-old-shard
+        assert int(np.sum(bf.contains_each(keys))) == len(keys)
+        assert bf.set_replicated() is True  # re-replicate on the new mesh
+        assert int(np.sum(bf.contains_each(keys))) == len(keys)
+    finally:
+        c.shutdown()
+
+
+def test_failure_monitor_emits_typed_events():
+    c = _client()
+    try:
+        mon = c.get_failure_monitor()
+        events = []
+        mon.add_listener(events.append)
+
+        class _DeadNode:
+            shard = 0
+            address = "cpu:0"
+
+            def ping(self, timeout=None):
+                return False
+
+        class _LiveNode:
+            shard = 0
+            address = "cpu:0"
+
+            def ping(self, timeout=None):
+                return True
+
+        class _FakeGroup:
+            def __init__(self):
+                self.nodes = [_DeadNode()]
+
+            def get_nodes(self):
+                return self.nodes
+
+        mon._ng = _FakeGroup()
+        evs = mon.check_once()
+        assert len(evs) == 1 and isinstance(evs[0], NodeDownEvent)
+        assert mon.down_shards() == {0}
+        assert mon.check_once() == []  # once per transition, not per ping
+        mon._ng.nodes = [_LiveNode()]
+        evs = mon.check_once()
+        assert len(evs) == 1 and isinstance(evs[0], NodeUpEvent)
+        assert events and isinstance(events[0], NodeDownEvent)
+        assert mon.down_shards() == set()
+    finally:
+        c.shutdown()
+
+
+def test_change_topology_failure_rolls_back():
+    """A failed swap (more shards than devices) must leave the engine
+    fully on the old topology — config, executor, pools."""
+    c = _client()
+    try:
+        bf = c.get_bloom_filter("rb")
+        bf.try_init(1000, 0.01)
+        bf.add_all(np.arange(100, dtype=np.uint64))
+        with pytest.raises(RuntimeError, match="devices"):
+            c.change_topology(64)  # CPU mesh has 8
+        assert c._engine.config.tpu_sketch.num_shards == 1
+        assert getattr(c._engine.executor, "S", 1) == 1
+        assert int(np.sum(bf.contains_each(np.arange(100, dtype=np.uint64)))) == 100
+        # And a valid reshard still works afterwards.
+        assert c.change_topology(4) is True
+        assert int(np.sum(bf.contains_each(np.arange(100, dtype=np.uint64)))) == 100
+    finally:
+        c.shutdown()
+
+
+def test_reshard_quarantines_replica_rows():
+    """Replica rows must NOT return to the free list (in-flight ops may
+    target them) — they stay written with the filter's data."""
+    c = _client(num_shards=2)
+    try:
+        bf = c.get_bloom_filter("q")
+        bf.try_init(5000, 0.01)
+        bf.add_all(np.arange(200, dtype=np.uint64))
+        assert bf.set_replicated()
+        entry = c._engine.registry.lookup("q")
+        replica_rows = [r for r in entry.replica_rows if r != entry.row]
+        assert replica_rows
+        assert c.change_topology(4) is True
+        assert entry.replica_rows is None
+        pool = entry.pool
+        for r in replica_rows:
+            assert r not in pool._free, "replica row was freed into the pool"
+        # Quarantined rows still hold the data (an in-flight read targeting
+        # them must see correct bits): check via raw row readback.
+        row_data = c._engine.executor.read_row(pool, entry.row)
+        for r in replica_rows:
+            assert np.array_equal(
+                c._engine.executor.read_row(pool, r), row_data
+            )
+    finally:
+        c.shutdown()
+
+
+def test_bitset_writes_survive_concurrent_size_class_migration():
+    """Lost-update regression: coalesced bitset sets racing an auto-grow
+    (size-class migration) must all land — flush-time row resolution."""
+    c = _client(batch_window_us=2000)
+    try:
+        bs = c.get_bit_set("grow")
+        bs.set(10)  # small size class
+        errors = []
+        acked = []
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            try:
+                while not stop.is_set() and i < 3000:
+                    bs.set(100 + i)  # stays within the small class range
+                    acked.append(100 + i)
+                    i += 1
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        import time
+
+        time.sleep(0.05)
+        # Trigger migrations to successively larger size classes mid-storm.
+        bs.set(5_000)
+        bs.set(50_000)
+        bs.set(500_000)
+        stop.set()
+        t.join(timeout=60)
+        assert not errors, errors
+        assert acked
+        arr = bs.as_bit_array()
+        missing = [i for i in acked if not arr[i]]
+        assert not missing, f"{len(missing)} acknowledged sets lost: {missing[:5]}"
+        assert arr[5_000] and arr[50_000] and arr[500_000]
+    finally:
+        c.shutdown()
+
+
+def test_retired_executor_forwards_or_raises_typed():
+    """A caller that captured the pre-swap executor must NOT run the old
+    kernel against the re-laid-out state: plain dispatches forward
+    transparently to the successor; runs-metadata dispatches (whose
+    successor implementation would be layout-wrong) raise the typed
+    retryable error for the coalescer's retry loop."""
+    from redisson_tpu.executor.failures import ExecutorRetiredError
+
+    c = _client()
+    try:
+        bf = c.get_bloom_filter("ret")
+        bf.try_init(1000, 0.01)
+        bf.add_all(np.arange(10, dtype=np.uint64))
+        old_exec = c._engine.executor
+        entry = c._engine.registry.lookup("ret")
+        m = entry.params["size"]
+        k = entry.params["hash_iterations"]
+        assert c.change_topology(2) is True
+        # Plain dispatch: forwards to the successor (correct answer, no
+        # spurious failure for non-coalesced callers).
+        assert int(old_exec.bloom_count(entry.pool, entry.row, m, k).result()) > 0
+        # Runs-metadata dispatch: sharded successor can't run it — typed
+        # retryable so the coalescer re-binds and re-checks support.
+        with pytest.raises(ExecutorRetiredError):
+            old_exec.bloom_mixed_keys_runs(
+                entry.pool, k, np.zeros((1, 2), np.uint32), np.uint32(8),
+                np.array([entry.row], np.int32), np.array([m], np.uint32),
+                np.array([True]), np.array([0, 1], np.int32),
+            )
+        # The live path keeps working end-to-end.
+        assert int(np.sum(bf.contains_each(np.arange(10, dtype=np.uint64)))) == 10
+    finally:
+        c.shutdown()
+
+
+def test_failure_monitor_restart_after_stop():
+    c = _client()
+    try:
+        mon = c.get_failure_monitor(interval_s=0.05)
+        mon.start()
+        mon.stop()
+        mon.start()  # must actually resume sweeping (stop event cleared)
+        import time
+
+        time.sleep(0.3)
+        assert mon._thread is not None and mon._thread.is_alive()
+        mon.stop()
+    finally:
+        c.shutdown()
+
+
+def test_failure_monitor_real_devices_ping_ok():
+    c = _client()
+    try:
+        mon = c.get_failure_monitor()
+        assert mon.check_once() == []  # healthy devices emit nothing
+        assert mon.down_shards() == set()
+    finally:
+        c.shutdown()
