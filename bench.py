@@ -31,7 +31,10 @@ def bench_bloom_contains(client):
     bf = client.get_bloom_filter("bench-bf")
     bf.try_init(1_000_000, 0.01)
 
-    B = 1 << 18  # bigger batches amortize the tunnel's fixed per-launch cost
+    B = 1 << 19  # bigger batches amortize the tunnel's fixed per-launch cost
+    # (r4 sweep: at a degraded-link phase, 512k-op launches measured ~1.5x
+    # the throughput of 256k; at fast-link phases batch cost is sublinear
+    # so larger stays at least neutral)
     n_load = 1 << 20
     adds = [
         bf.add_all_async(np.arange(i * B, (i + 1) * B, dtype=np.uint64))
@@ -44,10 +47,12 @@ def bench_bloom_contains(client):
     # Best-of-3 passes: the tunneled link's throughput varies >2x between
     # runs minutes apart (measured r3), so a single pass under-reports the
     # engine; the best pass is the honest steady-state capability number.
+    # Per-pass numbers travel in extra.headline_passes so a drop is
+    # attributable (engine regression vs link phase) from the JSON alone.
     bf.contains_all_async(np.arange(B, dtype=np.uint64)).result()
     iters = 16
     rng = np.random.default_rng(0)
-    best = 0.0
+    passes = []
     for _pass in range(3):
         batches = [
             rng.integers(0, 2 * n_load, size=B).astype(np.uint64)
@@ -58,12 +63,12 @@ def bench_bloom_contains(client):
         n_hits = sum(int(np.sum(r.result())) for r in results)
         dt = time.perf_counter() - t0
         assert 0.3 < n_hits / (iters * B) < 0.7, n_hits
-        best = max(best, iters * B / dt)
+        passes.append(iters * B / dt)
 
     # Measured FPP: probe keys strictly outside the loaded range.
     probe = rng.integers(3 * n_load, 8 * n_load, size=1 << 17).astype(np.uint64)
     fpp = float(np.mean(bf.contains_each(probe)))
-    return best, fpp
+    return max(passes), fpp, passes
 
 
 def bench_hll_pfadd(client):
@@ -105,7 +110,8 @@ def bench_config4_mixed(make_client):
     """
     client = make_client(coalesce=True, exact_add_semantics=True,
                          batch_window_us=200, max_batch=1 << 17,
-                         min_bucket=4096, max_inflight=16)
+                         min_bucket=4096, max_inflight=16,
+                         max_queued_ops=1 << 16)
     n_tenants = 1000
     filters = []
     for t in range(n_tenants):
@@ -141,8 +147,9 @@ def bench_config4_mixed(make_client):
 
     # Paced offered load: 8 producers, 1.25M QPS aggregate target (25%
     # above the 1M spec).  Each producer paces its submissions against the
-    # wall clock; a deque window bounds per-producer in-flight futures so
-    # a stalled engine applies back-pressure instead of unbounded queueing.
+    # wall clock; back-pressure is the ENGINE's (max_queued_ops admission
+    # control in the coalescer) — producers hold futures without any
+    # client-side window, shedding completed ones without blocking.
     import threading
     from collections import deque
 
@@ -175,9 +182,8 @@ def bench_config4_mixed(make_client):
             else:
                 futs.append(filters[t].contains_all_async(keys))
             step += 1
-            if len(futs) >= 128:
-                while len(futs) > 64:
-                    futs.popleft().result()
+            while futs and futs[0].done():  # shed resolved, never block;
+                futs.popleft().result()  # .result() surfaces op failures
         for f in futs:
             f.result()
         counts[tid] = step * chunk
@@ -264,6 +270,34 @@ def bench_config5_stream_topk(client):
     return (n_events - chunk) / dt, recall
 
 
+def measure_link_calibration():
+    """Raw transport capability AT BENCH TIME, reported alongside the
+    engine numbers so a BENCH_rN drop is attributable from the JSON alone
+    (the shared tunnel's throughput swings >2x — r4 measured 22-160 MB/s
+    H2D and 0.2-360 ms resident round trips across phases on identical
+    code).  ``h2d_MBps`` bounds key-shipping throughput (the headline
+    ships ~8 bytes/key); ``resident_rt_ms`` bounds per-launch retirement."""
+    import jax
+
+    out = {}
+    arr = np.zeros(8 << 20, np.uint8)
+    best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        jax.device_put(arr).block_until_ready()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    out["link_h2d_MBps"] = round(8 / best)
+    x = jax.device_put(np.ones(1024, np.uint32))
+    f = jax.jit(lambda a: a.sum())
+    f(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(10):
+        int(f(x))
+    out["link_resident_rt_ms"] = round((time.perf_counter() - t0) * 100, 2)
+    return out
+
+
 def measure_host_baseline():
     """Honest comparison baseline (SURVEY.md §6): the configured bench env
     has NO redis-server binary, so the Redis-backed number cannot be
@@ -312,16 +346,19 @@ def main():
 
     # Bulk single-tenant path: device-side hashing, no cross-call coalescing
     # (that serves the mixed multi-tenant QPS config below).
+    link = measure_link_calibration()
     client = make_client(exact_add_semantics=False, coalesce=False)
-    contains_ops, fpp = bench_bloom_contains(client)
+    contains_ops, fpp, headline_passes = bench_bloom_contains(client)
     hll_ops = bench_hll_pfadd(client)
     bitset_ops = bench_config3_bitset(client)
     stream_eps, topk_recall = bench_config5_stream_topk(client)
     # Config 4 is best-of-2 full runs: like the headline, the tunnel's
     # throughput swings >2x between minutes — keep the pass with the
-    # higher throughput (its latency numbers travel with it).
+    # higher throughput (its latency numbers travel with it); both passes
+    # are reported so a drop is attributable from the JSON alone.
     mixed_ops, metrics = bench_config4_mixed(make_client)
     mixed_ops2, metrics2 = bench_config4_mixed(make_client)
+    config4_passes = [round(mixed_ops), round(mixed_ops2)]
     if mixed_ops2 > mixed_ops:
         mixed_ops, metrics = mixed_ops2, metrics2
     host_ops = measure_host_baseline()
@@ -338,6 +375,15 @@ def main():
                 "unit": "ops/s",
                 "vs_baseline": None,
                 "extra": {
+                    **link,
+                    "headline_passes": [round(p) for p in headline_passes],
+                    "headline_median": round(
+                        float(np.median(headline_passes))
+                    ),
+                    "config4_passes": config4_passes,
+                    "config4_median": round(
+                        float(np.median(config4_passes))
+                    ),
                     "hll_pfadd_ops_per_sec": round(hll_ops),
                     "config3_bitset_ops_per_sec": round(bitset_ops),
                     "config4_mixed_ops_per_sec": round(mixed_ops),
