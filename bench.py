@@ -270,6 +270,83 @@ def bench_config5_stream_topk(client):
     return (n_events - chunk) / dt, recall
 
 
+def bench_full_geometry(make_client):
+    """``--full`` mode (BASELINE configs 2 and 5 at their SPEC'd geometry
+    — 10M-cardinality HLL stream, 100M-event CMS top-K): run once per
+    round outside the driver's default bench, results appended to
+    BASELINE.md.  Wall-clock heavy by design."""
+    client = make_client(exact_add_semantics=False, coalesce=False)
+    out = {}
+
+    # Config 2 at 10M cardinality.
+    h = client.get_hyper_log_log("full-hll")
+    B = 1 << 19
+    n = 10_000_000
+    h.add_all_async(np.arange(B, dtype=np.uint64)).result()  # warm
+    from collections import deque
+
+    futs = deque()
+    t0 = time.perf_counter()
+    for i in range(0, n, B):
+        futs.append(
+            h.add_all_async(np.arange(i, min(i + B, n), dtype=np.uint64))
+        )
+        while len(futs) > 8:
+            futs.popleft().result()
+    while futs:
+        futs.popleft().result()
+    dt = time.perf_counter() - t0
+    est = h.count()
+    out["full_hll_pfadd_ops_per_sec"] = round(n / dt)
+    out["full_hll_cardinality"] = n
+    out["full_hll_estimate"] = est
+    out["full_hll_rel_error"] = round(abs(est - n) / n, 5)
+
+    # Config 5 at 100M events (zipf stream, chunked generation).
+    from redisson_tpu.serve import TopicCmsBridge
+
+    cms = client.get_count_min_sketch("full-cms")
+    cms.try_init(5, 1 << 16, track_top_k=20)
+    bridge = TopicCmsBridge(
+        client, "full-events", "full-cms", batch_size=1 << 15,
+        flush_interval_s=0.05,
+    )
+    topic = client.get_topic("full-events")
+    rng = np.random.default_rng(13)
+    n_events = 100_000_000
+    n_keys = 100_000
+    chunk = 1 << 18
+    true_counts = np.zeros(n_keys, np.int64)
+    warm = (rng.zipf(1.2, size=chunk) % n_keys).astype(np.uint64)
+    topic.publish(warm)
+    client._topic_bus.drain()
+    bridge.flush()
+    true_counts += np.bincount(warm.astype(np.int64), minlength=n_keys)
+    t0 = time.perf_counter()
+    done = chunk
+    while done < n_events:
+        stream = (rng.zipf(1.2, size=chunk) % n_keys).astype(np.uint64)
+        topic.publish(stream)
+        true_counts += np.bincount(stream.astype(np.int64), minlength=n_keys)
+        done += chunk
+    client._topic_bus.drain()
+    bridge.close()
+    dt = time.perf_counter() - t0
+    true_top = set(np.argsort(-true_counts)[:10].tolist())
+    got = {int(k) for k, _ in cms.top_k(10)}
+    # CMS estimator error over the true top-10 (where estimates matter).
+    est_err = []
+    for k in true_top:
+        est = cms.estimate(np.uint64(k))
+        est_err.append(abs(est - true_counts[k]) / max(1, true_counts[k]))
+    out["full_cms_events"] = n_events
+    out["full_cms_events_per_sec"] = round((done - chunk) / dt)
+    out["full_cms_topk_recall_at_10"] = len(got & true_top) / 10.0
+    out["full_cms_top10_max_rel_est_error"] = round(max(est_err), 5)
+    client.shutdown()
+    return out
+
+
 def measure_link_calibration():
     """Raw transport capability AT BENCH TIME, reported alongside the
     engine numbers so a BENCH_rN drop is attributable from the JSON alone
@@ -329,6 +406,8 @@ def measure_host_baseline():
 
 
 def main():
+    import sys
+
     import jax
 
     # Persistent compile cache: first-compiles over the device tunnel run
@@ -343,6 +422,11 @@ def main():
     def make_client(**kw):
         cfg = Config().set_codec(LongCodec()).use_tpu_sketch(**kw)
         return redisson_tpu.create(cfg)
+
+    if "--full" in sys.argv:
+        # Spec'd-geometry validation pass (not part of the driver run).
+        print(json.dumps({"full_geometry": bench_full_geometry(make_client)}))
+        return
 
     # Bulk single-tenant path: device-side hashing, no cross-call coalescing
     # (that serves the mixed multi-tenant QPS config below).
@@ -389,6 +473,10 @@ def main():
                     "config4_mixed_ops_per_sec": round(mixed_ops),
                     "config5_stream_events_per_sec": round(stream_eps),
                     "config5_topk_recall_at_10": topk_recall,
+                    "config5_path": "xla_vectorized",  # production path is
+                    # the vectorized XLA add_all via TopicCmsBridge; the
+                    # Pallas kernel serves add_all_seq's exact
+                    # at-sequence-point semantics (PROFILE.md Pallas note)
                     "p50_batch_ms": metrics.get("p50_wait_ms"),
                     "p99_batch_ms": metrics.get("p99_wait_ms"),
                     "p99_flush_ms": metrics.get("p99_flush_ms"),

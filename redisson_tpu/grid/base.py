@@ -112,15 +112,59 @@ class GridObject(CamelCompatMixin):
 
     def __getattr__(self, item):
         # RFuture idiom parity (→ every reference object's *Async twin):
-        # ``fooAsync``/``foo_async`` works for EVERY grid method — host
-        # ops complete immediately, so the future arrives resolved.
+        # ``fooAsync``/``foo_async`` works for EVERY grid method, running
+        # off the caller thread on a dedicated thread per call.  Per-call
+        # threads (not a bounded pool) because grid ops may legitimately
+        # BLOCK (queue take/poll, lock waits) — a shared bounded pool
+        # deadlocks once blocked ops occupy every worker and the op that
+        # would unblock them queues behind.  Like the reference's async
+        # facade, ordering across independent async calls is not
+        # guaranteed; Batch provides the ordered pipeline.
         if item.endswith("_async") and not item.startswith("_"):
             sync = getattr(self, item[: -len("_async")], None)
             if callable(sync):
-                from redisson_tpu.objects.base import CompletedFuture
 
                 def async_form(*args, **kwargs):
-                    return CompletedFuture(sync(*args, **kwargs))
+                    return _spawn_future(sync, args, kwargs)
 
                 return async_form
         return super().__getattr__(item)
+
+
+def _spawn_future(fn, args, kwargs):
+    """Run ``fn`` on its own daemon thread; returns a concurrent-style
+    future (result/get/done).  Unbounded by construction — blocking grid
+    ops cannot starve each other."""
+    import concurrent.futures
+    import threading
+
+    fut: "concurrent.futures.Future" = concurrent.futures.Future()
+
+    def run():
+        if not fut.set_running_or_notify_cancel():
+            return
+        try:
+            fut.set_result(fn(*args, **kwargs))
+        except BaseException as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=run, daemon=True, name="rtpu-grid-async").start()
+    return _PoolFuture(fut)
+
+
+class _PoolFuture:
+    """concurrent.futures adapter with the RFuture-ish get/done surface
+    the sketch futures expose.  ``result()`` waits indefinitely by
+    default, matching concurrent.futures and the sync-call contract."""
+
+    def __init__(self, fut):
+        self._fut = fut
+
+    def result(self, timeout: Optional[float] = None):
+        return self._fut.result(timeout)
+
+    def get(self):
+        return self.result()
+
+    def done(self) -> bool:
+        return self._fut.done()

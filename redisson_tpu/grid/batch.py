@@ -108,30 +108,55 @@ class Batch:
         if self._executed:
             raise RuntimeError("batch was already executed")
         self._executed = True
+        from redisson_tpu.grid.base import GridObject
+
+        serial = None  # per-execute single worker: grid ops leave the
+        # caller thread but keep submission order (the one-connection
+        # pipeline ordering of the reference batch)
         staged: list[tuple] = []  # (pending_future_or_None, BatchFuture)
-        for obj, meth, args, kwargs, fut in self._ops:
-            # Sync-named sketch calls ride their deferred (async) forms so
-            # the whole batch coalesces into few device dispatches — the
-            # reference batch pipelines everything by construction
-            # (SURVEY.md §3.4); resolved values keep the sync contract.
-            deferred = getattr(type(obj), "_DEFERRED", {}).get(meth)
-            if deferred is not None:
-                staged.append(
-                    (getattr(obj, deferred)(*args, **kwargs), fut)
-                )
-                continue
-            result = getattr(obj, meth)(*args, **kwargs)
-            if meth.endswith("_async") and hasattr(result, "result"):
-                staged.append((result, fut))
-            else:
-                fut._set(result)
-                staged.append((None, fut))
-        responses = []
-        for pending, fut in staged:
-            if pending is not None:
-                fut._set(pending.result())
-            responses.append(fut.result())
-        return BatchResult(responses)
+        try:
+            for obj, meth, args, kwargs, fut in self._ops:
+                # Sync-named sketch calls ride their deferred (async)
+                # forms so the whole batch coalesces into few device
+                # dispatches — the reference batch pipelines everything
+                # by construction (SURVEY.md §3.4); resolved values keep
+                # the sync contract.
+                deferred = getattr(type(obj), "_DEFERRED", {}).get(meth)
+                if deferred is not None:
+                    staged.append(
+                        (getattr(obj, deferred)(*args, **kwargs), fut)
+                    )
+                    continue
+                if isinstance(obj, GridObject) and not meth.endswith("_async"):
+                    # Grid ops pipeline too: off the caller thread (so
+                    # interleaved sketch submits keep coalescing without
+                    # waiting on host work), strictly ordered by the
+                    # single worker.
+                    if serial is None:
+                        from concurrent.futures import ThreadPoolExecutor
+
+                        serial = ThreadPoolExecutor(
+                            max_workers=1, thread_name_prefix="rtpu-batch"
+                        )
+                    staged.append(
+                        (serial.submit(getattr(obj, meth), *args, **kwargs), fut)
+                    )
+                    continue
+                result = getattr(obj, meth)(*args, **kwargs)
+                if meth.endswith("_async") and hasattr(result, "result"):
+                    staged.append((result, fut))
+                else:
+                    fut._set(result)
+                    staged.append((None, fut))
+            responses = []
+            for pending, fut in staged:
+                if pending is not None:
+                    fut._set(pending.result())
+                responses.append(fut.result())
+            return BatchResult(responses)
+        finally:
+            if serial is not None:
+                serial.shutdown(wait=False)
 
     def discard(self) -> None:
         """→ RBatch#discard."""

@@ -148,6 +148,27 @@ class ExecutorService(CamelCompatMixin):
             self._ensure_timer()
         return fut
 
+    def schedule_cron(self, fn: Callable, cron: str, *args, **kwargs) -> TaskFuture:
+        """→ RScheduledExecutorService#schedule(cron) with the upstream
+        CronExpression grammar (Quartz 6-field with seconds, or classic
+        5-field).  Periodic: the returned future exists for cancel()."""
+        from redisson_tpu.grid.cron import CronExpression
+
+        expr = CronExpression(cron)
+        fut = TaskFuture()
+        task_id = uuid.uuid4().hex
+        with self._cond:
+            if self._shutdown:
+                raise RuntimeError("executor service is shut down")
+            self._futures[task_id] = fut
+            self._periodic.add(task_id)
+            delay = expr.next_after(time.time()) - time.time()
+            self._scheduled.append(
+                (time.monotonic() + delay, expr, (task_id, fn, args, kwargs))
+            )
+            self._ensure_timer()
+        return fut
+
     def _ensure_timer(self) -> None:
         if self._timer is None or not self._timer.is_alive():
             self._timer = threading.Thread(
@@ -169,7 +190,17 @@ class ExecutorService(CamelCompatMixin):
                         continue
                     self._tasks.append(task)
                     if period is not None:
-                        self._scheduled.append((fire_at + period, period, task))
+                        from redisson_tpu.grid.cron import CronExpression
+
+                        if isinstance(period, CronExpression):
+                            # Cron re-arm: wall-clock next fire mapped
+                            # onto the monotonic timer.
+                            delay = period.next_after(time.time()) - time.time()
+                            self._scheduled.append((now + delay, period, task))
+                        else:
+                            self._scheduled.append(
+                                (fire_at + period, period, task)
+                            )
                 if due:
                     self._cond.notify_all()
             time.sleep(0.02)
@@ -259,9 +290,14 @@ class RemoteService(CamelCompatMixin):
         if got is not None:
             got[1].shutdown()
 
-    def get(self, iface: str, timeout_seconds: float = 30.0):
-        """→ RRemoteService#get: sync proxy; raises if no impl answers
-        within the ack timeout."""
+    def get(self, iface: str, timeout_seconds: float = 30.0,
+            ack_timeout_seconds: float = 1.0):
+        """→ RRemoteService#get(Class, executionTimeout, ackTimeout): sync
+        proxy.  A worker must ACK pickup of the invocation within
+        ``ack_timeout_seconds`` (the reference's ack message on the
+        per-invocation response queue) or the call fails with
+        RemoteServiceAckTimeoutException WITHOUT waiting the full
+        execution timeout — the no-live-worker fast-fail."""
         service = self
 
         class _Proxy(CamelCompatMixin):
@@ -274,7 +310,36 @@ class RemoteService(CamelCompatMixin):
                             f"no workers registered for {iface!r}"
                         )
                     impl, ex = got
-                    fut = ex.submit(getattr(impl, method), *args, **kwargs)
+                    ack = threading.Event()
+                    target = getattr(impl, method)
+                    # ack vs timeout is decided EXACTLY once under this
+                    # lock: either the worker acks first (we await the
+                    # result) or the caller times out first (the worker
+                    # then refuses to start, so the invocation NEVER runs
+                    # after an ack-timeout was reported — no invisible
+                    # side effects).
+                    gate_lock = threading.Lock()
+                    state = {"v": "pending"}
+
+                    def acked_call():
+                        with gate_lock:
+                            if state["v"] == "timedout":
+                                return None  # late pickup: refuse to run
+                            state["v"] = "acked"
+                            ack.set()
+                        return target(*args, **kwargs)
+
+                    fut = ex.submit(acked_call)
+                    if not ack.wait(ack_timeout_seconds):
+                        with gate_lock:
+                            if state["v"] == "pending":
+                                state["v"] = "timedout"
+                                fut.cancel()
+                                raise RemoteServiceAckTimeoutException(
+                                    f"no worker acked {iface}.{method} "
+                                    f"within {ack_timeout_seconds}s"
+                                )
+                        # The worker won the race and is executing.
                     return fut.result(timeout_seconds)
 
                 return call
@@ -302,6 +367,11 @@ class RemoteService(CamelCompatMixin):
         return _AsyncProxy()
 
 
+class RemoteServiceAckTimeoutException(RuntimeError):
+    """→ org.redisson.remote.RemoteServiceAckTimeoutException: no worker
+    acknowledged the invocation within the ack timeout."""
+
+
 class TransactionException(RuntimeError):
     """→ org.redisson.transaction.TransactionException."""
 
@@ -326,6 +396,10 @@ class Transaction(CamelCompatMixin):
 
     def get_map(self, name: str):
         return _TxMap(self, name)
+
+    def get_set(self, name: str):
+        """→ RTransaction#getSet (upstream transactions cover sets too)."""
+        return _TxSet(self, name)
 
     # -- commit/rollback -----------------------------------------------------
 
@@ -359,8 +433,12 @@ class Transaction(CamelCompatMixin):
             return None
         if kb is None:
             return e.value
-        slot = e.value.live(kb) if hasattr(e.value, "live") else None
-        return None if slot is None else slot[0]
+        if hasattr(e.value, "live"):  # map: per-key live slot
+            slot = e.value.live(kb)
+            return None if slot is None else slot[0]
+        if isinstance(e.value, dict):  # set: membership snapshot
+            return kb in e.value
+        return None
 
 
 class _TxBucket:
@@ -443,6 +521,55 @@ class _TxMap:
                 e.value.data.pop(kb, None)
 
         self._tx._writes.append(apply)
+
+
+class _TxSet:
+    """Transactional set facade (→ org/redisson/transaction/
+    RedissonTransactionalSet): contains() snapshots membership for
+    commit-time validation; add/remove buffer in the operation log."""
+
+    def __init__(self, tx: Transaction, name: str):
+        self._tx = tx
+        self._name = name
+        self._codec = tx._client.config.codec
+        self._local: dict[bytes, bool] = {}  # staged membership
+
+    def contains(self, value) -> bool:
+        self._tx._check_open()
+        kb = self._codec.encode(value)
+        if kb in self._local:
+            return self._local[kb]
+        with self._tx._store.lock:
+            cur = self._tx._current(self._name, kb)
+            self._tx._reads[(self._name, kb)] = cur
+            return bool(cur)
+
+    def add(self, value) -> bool:
+        added = not self.contains(value)
+        kb = self._codec.encode(value)
+        self._local[kb] = True
+        tx, name = self._tx, self._name
+
+        def apply():
+            e = tx._store.ensure_entry(name, "set", dict)
+            e.value[kb] = None
+
+        tx._writes.append(apply)
+        return added
+
+    def remove(self, value) -> bool:
+        removed = self.contains(value)
+        kb = self._codec.encode(value)
+        self._local[kb] = False
+        tx, name = self._tx, self._name
+
+        def apply():
+            e = tx._store.get_entry(name, "set")
+            if e is not None:
+                e.value.pop(kb, None)
+
+        tx._writes.append(apply)
+        return removed
 
 
 class ScriptService(CamelCompatMixin):

@@ -420,16 +420,25 @@ class ReliableTopic(GridObject):
 
     def __init__(self, name, client):
         super().__init__(name, client)
+        import threading
+
         self._stream = Stream(name, client)
         self._listeners: dict[int, tuple[str, Any]] = {}
         self._next_id = 0
         self._pump: Optional[Any] = None
 
     def publish(self, message: Any) -> int:
-        """Appends to the stream; returns subscriber count."""
+        """Appends to the stream; returns subscriber count.  Delivery is
+        signal-driven: Stream.add notifies the SHARED store condition, so
+        the pump wakes for publishes from ANY handle of this topic (not
+        just this one) — no poll tax, no per-handle wakeup gap."""
         self._stream.add({"m": message})
         with self._store.lock:
             return len(self._listeners)
+
+    def _added_count(self) -> int:
+        e = self._stream._entry(create=False)
+        return 0 if e is None else e.value.added
 
     def add_listener(self, listener) -> int:
         import threading
@@ -463,9 +472,7 @@ class ReliableTopic(GridObject):
         while True:
             with self._store.lock:
                 subs = list(self._listeners.items())
-            if not subs:
-                time.sleep(0.05)
-                continue
+                seen = self._added_count()
             delivered = False
             for lid, (group, fn) in subs:
                 try:
@@ -481,7 +488,13 @@ class ReliableTopic(GridObject):
                     self._stream.ack(group, mid)
                     delivered = True
             if not delivered:
-                time.sleep(0.01)
+                # Park on the SHARED store condition Stream.add notifies
+                # (condvar, not a poll tax); the added-counter re-check
+                # under the lock closes the publish-before-park window;
+                # 1 s fallback bounds exotic writers that bypass XADD.
+                with self._store.cond:
+                    if self._added_count() == seen:
+                        self._store.cond.wait(timeout=1.0)
 
     def count_listeners(self) -> int:
         with self._store.lock:

@@ -76,6 +76,12 @@ class CountMinSketch(RObject):
     def get_depth(self) -> int:
         return self._params()["depth"]
 
+    def total_count(self) -> int:
+        """Total inserted weight (the RedisBloom CMS.INFO 'count' field):
+        row-0 cell sum — every increment lands once per depth row."""
+        self._params()
+        return self._engine.cms_total(self._name)
+
     def get_width(self) -> int:
         return self._params()["width"]
 

@@ -1010,6 +1010,16 @@ class TpuSketchEngine(SketchDurabilityMixin):
         )
         return created
 
+    def cms_total(self, name) -> int:
+        """Total inserted weight (CMS.INFO 'count'): every increment adds
+        its weight to exactly one cell per depth row, so row 0's sum is
+        the total."""
+        entry = self._require(name, PoolKind.CMS)
+        w = entry.params["width"]
+        self._drain()
+        row = self.executor.read_row(entry.pool, entry.row)
+        return int(np.asarray(row[:w], np.uint64).sum())
+
     def cms_add(self, name, H1, H2, weights) -> LazyResult:
         entry = self._require(name, PoolKind.CMS)
         d, w = entry.params["depth"], entry.params["width"]
@@ -1498,6 +1508,11 @@ class HostSketchEngine:
                 "params": {"depth": depth, "width": width},
             }
             return True
+
+    def cms_total(self, name) -> int:
+        o = self._require(name, PoolKind.CMS)
+        with self._lock:
+            return int(np.asarray(o["model"].counts[0], np.uint64).sum())
 
     def cms_add(self, name, H1, H2, weights):
         o = self._require(name, PoolKind.CMS)

@@ -7,15 +7,16 @@ Command surface (the subset the north-star objects + grid need):
   PING ECHO  GET SET DEL EXISTS EXPIRE PEXPIRE TTL PTTL PERSIST
   SETBIT GETBIT BITCOUNT BITPOS
   PFADD PFCOUNT PFMERGE
-  BF.RESERVE BF.ADD BF.MADD BF.EXISTS BF.MEXISTS   (RedisBloom shape)
-  CMS.INITBYDIM CMS.INCRBY CMS.QUERY               (RedisBloom CMS shape)
-  LPUSH RPUSH LPOP RPOP LLEN
+  BF.RESERVE BF.ADD BF.MADD BF.EXISTS BF.MEXISTS BF.INFO (RedisBloom shape)
+  CMS.INITBYDIM CMS.INCRBY CMS.QUERY CMS.MERGE CMS.INFO  (RedisBloom CMS)
+  LPUSH RPUSH LPOP RPOP LLEN BLPOP BRPOP            (condvar blocking pops)
   HSET HGET HDEL HLEN
   SADD SREM SISMEMBER SCARD SMEMBERS
   ZADD ZSCORE ZRANGE ZCARD ZREM
   INCR INCRBY DECR
   PUBLISH SUBSCRIBE UNSUBSCRIBE                     (push replies)
-  KEYS DBSIZE FLUSHALL
+  MULTI EXEC DISCARD                                (contiguous-exec txn)
+  KEYS SCAN DBSIZE FLUSHALL
 
 Values travel as raw bytes (RESP bulk strings) through a ByteArray-style
 codec boundary: what a foreign client SETs is exactly what it GETs.
@@ -81,6 +82,13 @@ class _Reader:
     def __init__(self, sock: socket.socket):
         self._sock = sock
         self._buf = b""
+        # True while a multi-part frame is partially parsed: an idle
+        # timeout that fires here must close the connection (continuing
+        # would desync the protocol stream), see _serve_conn.
+        self.frame_started = False
+
+    def at_frame_boundary(self) -> bool:
+        return not self.frame_started and not self._buf
 
     def _read_line(self) -> Optional[bytes]:
         while b"\r\n" not in self._buf:
@@ -101,11 +109,17 @@ class _Reader:
         return out
 
     def read_command(self) -> Optional[list[bytes]]:
+        self.frame_started = False
         line = self._read_line()
         if line is None:
             return None
+        # Set until the frame parses COMPLETELY — a timeout propagating
+        # out mid-frame leaves it set and the caller must close (resuming
+        # would desync the stream).
+        self.frame_started = True
         if not line.startswith(b"*"):
             # inline command (redis-cli fallback)
+            self.frame_started = False
             return line.split()
         n = int(line[1:])
         args = []
@@ -118,17 +132,21 @@ class _Reader:
             if data is None:
                 return None
             args.append(data)
+        self.frame_started = False
         return args
 
 
 class _ConnCtx:
     """Per-connection state: serialized writes (pub/sub pushes interleave
-    with replies) + this connection's channel subscriptions."""
+    with replies), this connection's channel subscriptions, and the
+    MULTI/EXEC transaction queue."""
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self.lock = threading.Lock()
         self.subs: dict[str, int] = {}  # channel -> bus listener id
+        self.in_multi = False
+        self.queued: list = []  # commands queued since MULTI
 
     def send(self, frame: bytes) -> None:
         with self.lock:
@@ -139,10 +157,26 @@ class _ConnCtx:
 
 
 class RespServer:
-    """Embedded RESP2 endpoint over a RedissonTpuClient."""
+    """Embedded RESP2 endpoint over a RedissonTpuClient.
 
-    def __init__(self, client, host: str = "127.0.0.1", port: int = 0):
+    Bounded (SURVEY §2.1 pub/sub + pools rows): at most
+    ``max_connections`` concurrent connections (excess are refused with
+    an error, the ``maxclients`` behavior) and an ``idle_timeout_s``
+    after which a silent connection is closed — subscriber connections
+    are exempt, like Redis's default timeout handling for blocked/
+    subscribed clients."""
+
+    def __init__(self, client, host: str = "127.0.0.1", port: int = 0,
+                 max_connections: int = 256, idle_timeout_s: float = 300.0):
         self._client = client
+        self.max_connections = max_connections
+        self.idle_timeout_s = idle_timeout_s
+        self._nconn = 0
+        self._conn_lock = threading.Lock()
+        # SCAN resume state: cursor id -> last key returned (see _cmd_SCAN).
+        self._scan_states: dict[int, str] = {}
+        self._scan_next = 0
+        self._scan_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -162,6 +196,17 @@ class RespServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            with self._conn_lock:
+                if self._nconn >= self.max_connections:
+                    try:
+                        conn.sendall(
+                            b"-ERR max number of clients reached\r\n"
+                        )
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
+                self._nconn += 1
             threading.Thread(
                 target=self._serve_conn, args=(conn,),
                 name="rtpu-resp-conn", daemon=True,
@@ -170,9 +215,19 @@ class RespServer:
     def _serve_conn(self, conn: socket.socket) -> None:
         reader = _Reader(conn)
         ctx = _ConnCtx(conn)
+        if self.idle_timeout_s:
+            conn.settimeout(self.idle_timeout_s)
         try:
             while True:
-                cmd = reader.read_command()
+                try:
+                    cmd = reader.read_command()
+                except socket.timeout:
+                    # Subscribers may idle legitimately — but only at a
+                    # frame boundary; a timeout mid-frame (or with bytes
+                    # buffered) would desync the protocol on resume.
+                    if ctx.subs and reader.at_frame_boundary():
+                        continue
+                    return  # reclaim the slot
                 if cmd is None:
                     return
                 try:
@@ -187,6 +242,8 @@ class RespServer:
             for channel, lid in list(ctx.subs.items()):
                 self._client._topic_bus.unsubscribe(channel, lid)
             conn.close()
+            with self._conn_lock:
+                self._nconn -= 1
 
     def close(self) -> None:
         self._closed = True
@@ -199,6 +256,19 @@ class RespServer:
 
     def _dispatch(self, cmd: list[bytes], ctx: "_ConnCtx") -> bytes:
         name = cmd[0].decode().upper()
+        if ctx.in_multi and name not in ("EXEC", "DISCARD", "MULTI"):
+            # Redis MULTI semantics: commands queue (validated for
+            # existence only) and run contiguously at EXEC.
+            if getattr(
+                self, "_cmd_" + name.replace(".", "_"), None
+            ) is None and getattr(
+                self, "_cmdctx_" + name.replace(".", "_"), None
+            ) is None:
+                ctx.queued = None  # poison: EXEC must abort
+                raise RespError(f"unknown command '{name}'")
+            if ctx.queued is not None:
+                ctx.queued.append(cmd)
+            return _encode_simple("QUEUED")
         ctx_handler = getattr(self, "_cmdctx_" + name.replace(".", "_"), None)
         if ctx_handler is not None:  # connection-stateful (pub/sub)
             return ctx_handler([c for c in cmd[1:]], ctx)
@@ -218,10 +288,90 @@ class RespServer:
         obj._dec = lambda v: v
         return obj
 
+    # transactions (→ the reference's REDIS_WRITE_ATOMIC batch mode,
+    # SURVEY §3.4: commands queue client-side and execute contiguously
+    # at EXEC on this connection's thread)
+
+    def _cmdctx_MULTI(self, args, ctx: _ConnCtx):
+        if ctx.in_multi:
+            raise RespError("MULTI calls can not be nested")
+        ctx.in_multi = True
+        ctx.queued = []
+        return _encode_simple("OK")
+
+    def _cmdctx_EXEC(self, args, ctx: _ConnCtx):
+        if not ctx.in_multi:
+            raise RespError("EXEC without MULTI")
+        queued, ctx.queued, ctx.in_multi = ctx.queued, [], False
+        if queued is None:  # a queue-time error poisons the transaction
+            raise RespError("Transaction discarded because of previous errors")
+        frames = []
+        for c in queued:
+            try:
+                frames.append(self._dispatch(c, ctx))
+            except RespError as e:
+                frames.append(_encode_error(str(e)))
+            except Exception as e:
+                frames.append(_encode_error(f"{type(e).__name__}: {e}"))
+        return b"*" + str(len(frames)).encode() + b"\r\n" + b"".join(frames)
+
+    def _cmdctx_DISCARD(self, args, ctx: _ConnCtx):
+        if not ctx.in_multi:
+            raise RespError("DISCARD without MULTI")
+        ctx.in_multi = False
+        ctx.queued = []
+        return _encode_simple("OK")
+
     # connection/admin
 
     def _cmd_PING(self, args):
         return _encode_simple("PONG") if not args else _encode_bulk(args[0])
+
+    def _cmd_SCAN(self, args):
+        """Cursor iteration with the Redis SCAN guarantee (keys present
+        for the whole iteration are returned): the integer cursor maps to
+        server-side resume state holding the LAST KEY returned, and each
+        page lists live keys lexicographically after it — concurrent
+        deletes can't shift the position.  State for abandoned cursors is
+        evicted LRU (cap 1024)."""
+        cursor = int(args[0])
+        pattern, count = None, 10
+        i = 1
+        while i < len(args):
+            opt = args[i].decode().upper()
+            if opt == "MATCH":
+                pattern = self._s(args[i + 1])
+                i += 2
+            elif opt == "COUNT":
+                count = int(args[i + 1])
+                if count < 1:
+                    raise RespError("syntax error")
+                i += 2
+            else:
+                raise RespError("syntax error")
+        with self._scan_lock:
+            after = None if cursor == 0 else self._scan_states.pop(cursor, None)
+            if cursor != 0 and after is None:
+                # Unknown/evicted cursor: Redis treats it as terminated.
+                return b"*2\r\n" + _encode_bulk("0") + _encode_array([])
+        keys = sorted(self._client.get_keys().get_keys(pattern))
+        if after is not None:
+            import bisect
+
+            start = bisect.bisect_right(keys, after)
+        else:
+            start = 0
+        page = keys[start : start + count]
+        if start + count < len(keys):
+            with self._scan_lock:
+                self._scan_next += 1
+                nxt = self._scan_next
+                self._scan_states[nxt] = page[-1]
+                while len(self._scan_states) > 1024:  # LRU cap
+                    self._scan_states.pop(next(iter(self._scan_states)))
+        else:
+            nxt = 0
+        return b"*2\r\n" + _encode_bulk(str(nxt)) + _encode_array(page)
 
     def _cmd_ECHO(self, args):
         return _encode_bulk(args[0])
@@ -389,6 +539,49 @@ class RespServer:
             [int(v) for v in cms.estimate_all([a for a in args[1:]])]
         )
 
+    def _cmd_CMS_MERGE(self, args):
+        """CMS.MERGE dest numKeys src [src ...] — RedisBloom OVERWRITE
+        semantics: dest becomes the sum of the sources (dest's prior
+        counts survive only if dest is itself listed as a source).
+        Weights unsupported — error, never silently-wrong data."""
+        n = int(args[1])
+        dest = self._s(args[0])
+        srcs = [self._s(a) for a in args[2 : 2 + n]]
+        if len(args) > 2 + n:
+            raise RespError("CMS.MERGE WEIGHTS is not supported")
+        cms = self._client.get_count_min_sketch(dest)
+        if dest not in srcs:
+            # Overwrite: reset dest, then accumulate the sources.
+            d, w = cms.get_depth(), cms.get_width()
+            self._client._engine.delete(dest)
+            cms.try_init(d, w)
+        others = [s for s in srcs if s != dest]
+        if others:
+            cms.merge(*others)
+        return _encode_simple("OK")
+
+    def _cmd_CMS_INFO(self, args):
+        cms = self._client.get_count_min_sketch(self._s(args[0]))
+        return _encode_array(
+            [
+                "width", cms.get_width(),
+                "depth", cms.get_depth(),
+                "count", cms.total_count(),
+            ]
+        )
+
+    def _cmd_BF_INFO(self, args):
+        bf = self._client.get_bloom_filter(self._s(args[0]))
+        return _encode_array(
+            [
+                "Capacity", bf.get_expected_insertions(),
+                "Size", (bf.get_size() + 7) // 8,  # bits → bytes
+                "Number of filters", 1,
+                "Number of items inserted", bf.count(),
+                "Expansion rate", None,  # non-scaling filter
+            ]
+        )
+
     # lists
 
     def _list(self, key: bytes):
@@ -414,6 +607,40 @@ class RespServer:
 
     def _cmd_RPOP(self, args):
         return _encode_bulk(self._list(args[0]).poll_last())
+
+    def _bpop(self, args, first: bool) -> bytes:
+        """BLPOP/BRPOP: condvar-parked on the grid store (no poll pump) —
+        the store's offer() notifies the same condition BlockingQueue
+        uses.  Multi-key form checks keys in argument order each wakeup,
+        Redis-style."""
+        import time as _time
+
+        if len(args) < 2:
+            raise RespError("wrong number of arguments for 'blpop'")
+        *keys, timeout = args
+        t = float(timeout)
+        qs = [(self._s(k), self._list(k)) for k in keys]
+        store = qs[0][1]._store
+        deadline = None if t == 0 else _time.monotonic() + t
+        with store.cond:
+            while True:
+                for name, q in qs:
+                    v = q.poll_first() if first else q.poll_last()
+                    if v is not None:
+                        return b"*2\r\n" + _encode_bulk(name) + _encode_bulk(v)
+                if deadline is None:
+                    store.cond.wait(timeout=1.0)
+                else:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return b"*-1\r\n"  # null array: timed out
+                    store.cond.wait(timeout=remaining)
+
+    def _cmd_BLPOP(self, args):
+        return self._bpop(args, first=True)
+
+    def _cmd_BRPOP(self, args):
+        return self._bpop(args, first=False)
 
     def _cmd_LLEN(self, args):
         return _encode_int(self._list(args[0]).size())

@@ -71,7 +71,93 @@ class TestGridAsyncFacades:
         m.fastPutAsync("k", 2).result()
         assert m.getAsync("k").result() == 2
 
-    def test_async_future_is_done(self, client):
+    def test_async_future_resolves_off_thread(self, client):
+        """Grid *_async runs off the caller thread (real futures), and
+        blocking async ops can't starve one another (r4: VERDICT #5)."""
+        import threading
+
+        from redisson_tpu.grid.base import _spawn_future
+
+        caller = threading.current_thread().name
+        threads = []
+
+        def probe():
+            threads.append(threading.current_thread().name)
+            return "ok"
+
+        fut = _spawn_future(probe, (), {})
+        assert fut.result(timeout=10) == "ok"
+        assert threads and threads[0] != caller
         b = client.get_bucket("ab2")
-        fut = b.set_async("v")
-        assert fut.done()
+        f2 = b.set_async("v")
+        assert f2.result(timeout=10) is None
+        assert f2.done()
+        assert b.get() == "v"
+        # Blocking async ops + the op that unblocks them, concurrently:
+        # the per-call-thread design cannot deadlock on pool exhaustion.
+        q = client.get_blocking_queue("abq")
+        takes = [q.poll_async(5.0) for _ in range(4)]
+        for i in range(4):
+            q.offer_async(i).result(timeout=10)
+        got = sorted(t.result(timeout=10) for t in takes)
+        assert got == [0, 1, 2, 3]
+
+
+class TestMixedBatchPipelining:
+    """VERDICT r3 #5 done-criterion: a batch interleaving map (grid) and
+    bloom (sketch) ops coalesces the sketch ops into <=2 device
+    dispatches while grid ops run off the caller thread, in order."""
+
+    def test_interleaved_map_bloom_batch(self):
+        import threading
+
+        import numpy as np
+
+        cfg = Config().use_tpu_sketch(min_bucket=64, batch_window_us=5000)
+        client = redisson_tpu.create(cfg)
+        try:
+            bf = client.get_bloom_filter("mixb")
+            bf.try_init(10_000, 0.01)
+            bf.add("warm")  # compile outside the measured window
+            client._engine.metrics.reset()
+            caller = threading.current_thread().name
+            grid_threads = []
+            m = client.get_map("mixm")
+            from redisson_tpu.grid.maps import Map
+
+            orig_put = Map.put
+
+            def traced_put(self, k, v):
+                grid_threads.append(threading.current_thread().name)
+                return orig_put(self, k, v)
+
+            Map.put = traced_put
+
+            batch = client.create_batch()
+            bbf = batch.get_bloom_filter("mixb")
+            bm = batch.get_map("mixm")
+            futs = []
+            for i in range(10):
+                futs.append(bbf.add(f"k{i}"))
+                futs.append(bm.put(f"mk{i}", i))
+                futs.append(bbf.contains(f"k{i}"))
+            res = batch.execute()
+            assert len(res) == 30
+            # sketch results honored the sync contracts
+            adds = res.get_responses()[0::3]
+            gets = res.get_responses()[2::3]
+            assert all(isinstance(a, bool) for a in adds)
+            assert all(g is True for g in gets)
+            # grid ops landed, in order, off the caller thread
+            assert m.size() == 10
+            assert len(grid_threads) == 10
+            assert all(t != caller for t in grid_threads)
+            assert all(t.startswith("rtpu-batch") for t in grid_threads)
+            # sketch ops coalesced into <=2 device dispatches
+            mm = client.get_metrics()
+            assert mm["batches_total"] <= 2, mm
+        finally:
+            from redisson_tpu.grid.maps import Map
+
+            Map.put = orig_put
+            client.shutdown()
