@@ -187,6 +187,10 @@ class ExecutorService(CamelCompatMixin):
                 for fire_at, period, task in due:
                     fut = self._futures.get(task[0])
                     if fut is not None and fut.cancelled():
+                        # Cancelled periodic/cron tasks leave the tables
+                        # for good — no re-arm, no future leak.
+                        self._futures.pop(task[0], None)
+                        self._periodic.discard(task[0])
                         continue
                     self._tasks.append(task)
                     if period is not None:
@@ -386,6 +390,10 @@ class Transaction(CamelCompatMixin):
         self._client = client
         self._store = client._grid
         self._reads: dict[tuple, Any] = {}  # (name, key_bytes|None) -> snapshot
+        # Set-membership reads validate as BOOLEANS: 'entry absent' and
+        # 'entry exists, member absent' are the same observation (False),
+        # unlike bucket/map reads where None is a distinct value.
+        self._set_reads: dict[tuple, bool] = {}
         self._writes: list[tuple] = []  # (apply_fn,)
         self._done = False
 
@@ -414,6 +422,11 @@ class Transaction(CamelCompatMixin):
             for (name, kb), snapshot in self._reads.items():
                 cur = self._current(name, kb)
                 if cur != snapshot:
+                    raise TransactionException(
+                        f"read of {name!r} invalidated by a concurrent write"
+                    )
+            for (name, kb), member in self._set_reads.items():
+                if bool(self._current(name, kb)) != member:
                     raise TransactionException(
                         f"read of {name!r} invalidated by a concurrent write"
                     )
@@ -540,9 +553,9 @@ class _TxSet:
         if kb in self._local:
             return self._local[kb]
         with self._tx._store.lock:
-            cur = self._tx._current(self._name, kb)
-            self._tx._reads[(self._name, kb)] = cur
-            return bool(cur)
+            cur = bool(self._tx._current(self._name, kb))
+            self._tx._set_reads[(self._name, kb)] = cur
+            return cur
 
     def add(self, value) -> bool:
         added = not self.contains(value)

@@ -420,8 +420,6 @@ class ReliableTopic(GridObject):
 
     def __init__(self, name, client):
         super().__init__(name, client)
-        import threading
-
         self._stream = Stream(name, client)
         self._listeners: dict[int, tuple[str, Any]] = {}
         self._next_id = 0

@@ -1020,6 +1020,13 @@ class TpuSketchEngine(SketchDurabilityMixin):
         row = self.executor.read_row(entry.pool, entry.row)
         return int(np.asarray(row[:w], np.uint64).sum())
 
+    def cms_reset(self, name) -> None:
+        """Zero a CMS's counters in place (CMS.MERGE overwrite semantics)
+        — the registry entry and any top-K configuration survive."""
+        entry = self._require(name, PoolKind.CMS)
+        self._drain()
+        self.executor.zero_row(entry.pool, entry.row)
+
     def cms_add(self, name, H1, H2, weights) -> LazyResult:
         entry = self._require(name, PoolKind.CMS)
         d, w = entry.params["depth"], entry.params["width"]
@@ -1513,6 +1520,11 @@ class HostSketchEngine:
         o = self._require(name, PoolKind.CMS)
         with self._lock:
             return int(np.asarray(o["model"].counts[0], np.uint64).sum())
+
+    def cms_reset(self, name) -> None:
+        o = self._require(name, PoolKind.CMS)
+        with self._lock:
+            o["model"].counts[:] = 0
 
     def cms_add(self, name, H1, H2, weights):
         o = self._require(name, PoolKind.CMS)

@@ -147,6 +147,7 @@ class _ConnCtx:
         self.subs: dict[str, int] = {}  # channel -> bus listener id
         self.in_multi = False
         self.queued: list = []  # commands queued since MULTI
+        self.in_exec = False  # replaying an EXEC (blocking cmds don't block)
 
     def send(self, frame: bytes) -> None:
         with self.lock:
@@ -258,7 +259,14 @@ class RespServer:
         name = cmd[0].decode().upper()
         if ctx.in_multi and name not in ("EXEC", "DISCARD", "MULTI"):
             # Redis MULTI semantics: commands queue (validated for
-            # existence only) and run contiguously at EXEC.
+            # existence only) and run contiguously at EXEC.  Pub/sub
+            # commands are rejected like Redis does — their push replies
+            # would break the EXEC array framing.
+            if name in ("SUBSCRIBE", "UNSUBSCRIBE"):
+                ctx.queued = None  # poison: EXEC must abort
+                raise RespError(
+                    f"{name} is not allowed in transactions"
+                )
             if getattr(
                 self, "_cmd_" + name.replace(".", "_"), None
             ) is None and getattr(
@@ -306,13 +314,17 @@ class RespServer:
         if queued is None:  # a queue-time error poisons the transaction
             raise RespError("Transaction discarded because of previous errors")
         frames = []
-        for c in queued:
-            try:
-                frames.append(self._dispatch(c, ctx))
-            except RespError as e:
-                frames.append(_encode_error(str(e)))
-            except Exception as e:
-                frames.append(_encode_error(f"{type(e).__name__}: {e}"))
+        ctx.in_exec = True  # blocking commands act non-blocking (Redis)
+        try:
+            for c in queued:
+                try:
+                    frames.append(self._dispatch(c, ctx))
+                except RespError as e:
+                    frames.append(_encode_error(str(e)))
+                except Exception as e:
+                    frames.append(_encode_error(f"{type(e).__name__}: {e}"))
+        finally:
+            ctx.in_exec = False
         return b"*" + str(len(frames)).encode() + b"\r\n" + b"".join(frames)
 
     def _cmdctx_DISCARD(self, args, ctx: _ConnCtx):
@@ -551,10 +563,10 @@ class RespServer:
             raise RespError("CMS.MERGE WEIGHTS is not supported")
         cms = self._client.get_count_min_sketch(dest)
         if dest not in srcs:
-            # Overwrite: reset dest, then accumulate the sources.
-            d, w = cms.get_depth(), cms.get_width()
-            self._client._engine.delete(dest)
-            cms.try_init(d, w)
+            # Overwrite: zero the counters in place (registry entry and
+            # top-K config survive; no delete→reinit window where
+            # concurrent CMS.QUERY would see 'not initialized').
+            self._client._engine.cms_reset(dest)
         others = [s for s in srcs if s != dest]
         if others:
             cms.merge(*others)
@@ -608,11 +620,12 @@ class RespServer:
     def _cmd_RPOP(self, args):
         return _encode_bulk(self._list(args[0]).poll_last())
 
-    def _bpop(self, args, first: bool) -> bytes:
+    def _bpop(self, args, first: bool, nonblocking: bool = False) -> bytes:
         """BLPOP/BRPOP: condvar-parked on the grid store (no poll pump) —
         the store's offer() notifies the same condition BlockingQueue
         uses.  Multi-key form checks keys in argument order each wakeup,
-        Redis-style."""
+        Redis-style.  ``nonblocking``: inside MULTI/EXEC a blocking
+        command returns nil immediately (Redis transaction semantics)."""
         import time as _time
 
         if len(args) < 2:
@@ -628,6 +641,8 @@ class RespServer:
                     v = q.poll_first() if first else q.poll_last()
                     if v is not None:
                         return b"*2\r\n" + _encode_bulk(name) + _encode_bulk(v)
+                if nonblocking:
+                    return b"*-1\r\n"  # in EXEC: never block
                 if deadline is None:
                     store.cond.wait(timeout=1.0)
                 else:
@@ -636,11 +651,11 @@ class RespServer:
                         return b"*-1\r\n"  # null array: timed out
                     store.cond.wait(timeout=remaining)
 
-    def _cmd_BLPOP(self, args):
-        return self._bpop(args, first=True)
+    def _cmdctx_BLPOP(self, args, ctx: _ConnCtx):
+        return self._bpop(args, first=True, nonblocking=ctx.in_exec)
 
-    def _cmd_BRPOP(self, args):
-        return self._bpop(args, first=False)
+    def _cmdctx_BRPOP(self, args, ctx: _ConnCtx):
+        return self._bpop(args, first=False, nonblocking=ctx.in_exec)
 
     def _cmd_LLEN(self, args):
         return _encode_int(self._list(args[0]).size())

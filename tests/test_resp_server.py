@@ -393,3 +393,38 @@ class TestRespServerBounds:
         finally:
             server.close()
             client.shutdown()
+
+
+class TestRespReviewFixesR4:
+    def test_subscribe_rejected_in_multi(self, resp):
+        resp.cmd("MULTI")
+        with pytest.raises(RuntimeError, match="not allowed in transactions"):
+            resp.cmd("SUBSCRIBE", "ch")
+        with pytest.raises(RuntimeError, match="discarded"):
+            resp.cmd("EXEC")
+
+    def test_blpop_in_multi_is_nonblocking(self, resp):
+        import time
+
+        resp.cmd("RPUSH", "mbq", "only")
+        resp.cmd("MULTI")
+        resp.cmd("BLPOP", "mbq", "0")
+        resp.cmd("BLPOP", "mbq", "0")  # empty now: must NOT block
+        t0 = time.monotonic()
+        out = resp.cmd("EXEC")
+        assert time.monotonic() - t0 < 2.0
+        assert out[0] == [b"mbq", b"only"]
+        assert out[1] is None  # nil, Redis non-blocking-in-MULTI
+
+    def test_cms_merge_keeps_topk_config(self, resp):
+        # dest created with top-K via the python API, merged via RESP.
+        import redisson_tpu as _rt
+
+        # reuse the server's embedded client through a plain CMS handle
+        resp.cmd("CMS.INITBYDIM", "mk-src", "1024", "4")
+        resp.cmd("CMS.INCRBY", "mk-src", "hot", "9")
+        resp.cmd("CMS.INITBYDIM", "mk-dst", "1024", "4")
+        resp.cmd("CMS.INCRBY", "mk-dst", "stale", "5")
+        assert resp.cmd("CMS.MERGE", "mk-dst", "1", "mk-src") == "OK"
+        assert resp.cmd("CMS.QUERY", "mk-dst", "hot") == [9]
+        assert resp.cmd("CMS.QUERY", "mk-dst", "stale") == [0]  # overwritten

@@ -339,3 +339,66 @@ class TestServicesDepthR4:
         rs2 = client.get_remote_service("acks2")
         rs2.register("svc", Impl(), workers=1)
         assert rs2.get("svc", ack_timeout_seconds=2.0).ping() == "pong"
+
+
+class TestServicesReviewFixesR4:
+    def test_txset_absent_vs_empty_entry_not_spurious_conflict(self, client):
+        s = client.get_set("txs3")
+        tx = client.create_transaction()
+        ts = tx.get_set("txs3")
+        assert ts.contains("y") is False  # set entry doesn't even exist yet
+        s.add("x")  # creates the entry; 'y' membership UNCHANGED (False)
+        ts.add("z")
+        tx.commit()  # must NOT raise: observed membership still False
+        assert s.contains("z") and s.contains("x")
+
+    def test_cancelled_cron_task_does_not_leak(self, client):
+        import time
+
+        ex = client.get_executor_service("leak")
+        ex.register_workers(1)
+        futs = [
+            ex.schedule_cron(lambda: None, "* * * * * ?") for _ in range(5)
+        ]
+        for f in futs:
+            assert f.cancel()
+        time.sleep(1.5)  # let the timer sweep the cancelled entries
+        assert len(ex._futures) == 0
+        assert len(ex._periodic) == 0
+
+    def test_cron_dow_conventions(self):
+        from redisson_tpu.grid.cron import CronExpression
+
+        # Quartz 6-field numeric: 1=SUN .. 7=SAT
+        q = CronExpression("0 0 12 ? * 1")
+        assert q.dow == frozenset({0})  # Sunday internally
+        q = CronExpression("0 0 12 ? * 7")
+        assert q.dow == frozenset({6})  # Saturday
+        # classic 5-field numeric: 0=SUN .. 6=SAT, 7 also Sunday
+        c = CronExpression("0 12 * * 0")
+        assert c.dow == frozenset({0})
+        c = CronExpression("0 12 * * 7")
+        assert c.dow == frozenset({0})
+        # names identical in both
+        assert CronExpression("0 0 12 ? * SUN").dow == frozenset({0})
+        assert CronExpression("0 12 * * SAT").dow == frozenset({6})
+
+    def test_cron_dom_dow_or_semantics(self):
+        from datetime import datetime
+
+        from redisson_tpu.grid.cron import CronExpression
+
+        # 'midnight on the 13th OR every Friday' (vixie OR rule)
+        c = CronExpression("0 0 13 * FRI")
+        # 2026-02-06 is a Friday but not the 13th
+        assert c._minute_matches(datetime(2026, 2, 6, 0, 0))
+        # 2026-02-13 is Friday the 13th
+        assert c._minute_matches(datetime(2026, 2, 13, 0, 0))
+        # 2026-03-13 is a Friday... pick a non-Friday 13th: 2026-04-13 (Mon)
+        assert c._minute_matches(datetime(2026, 4, 13, 0, 0))
+        # non-13th non-Friday
+        assert not c._minute_matches(datetime(2026, 2, 9, 0, 0))
+        # One side unrestricted keeps AND semantics
+        c = CronExpression("0 0 * * FRI")
+        assert c._minute_matches(datetime(2026, 2, 6, 0, 0))
+        assert not c._minute_matches(datetime(2026, 2, 9, 0, 0))
