@@ -31,29 +31,17 @@ def bench_bloom_contains(client):
     bf = client.get_bloom_filter("bench-bf")
     bf.try_init(1_000_000, 0.01)
 
-    B = 1 << 19  # bigger batches amortize the tunnel's fixed per-launch cost
-    # (r4 sweep: at a degraded-link phase, 512k-op launches measured ~1.5x
-    # the throughput of 256k; at fast-link phases batch cost is sublinear
-    # so larger stays at least neutral)
     n_load = 1 << 20
     adds = [
-        bf.add_all_async(np.arange(i * B, (i + 1) * B, dtype=np.uint64))
-        for i in range(n_load // B)
+        bf.add_all_async(np.arange(i << 18, (i + 1) << 18, dtype=np.uint64))
+        for i in range(n_load >> 18)
     ]
     n_added = sum(int(np.sum(r.result())) for r in adds)
     assert 0.97 * n_load <= n_added <= n_load, n_added
 
-    # Warm, then measure steady state (async pipeline, block at the end).
-    # Best-of-3 passes: the tunneled link's throughput varies >2x between
-    # runs minutes apart (measured r3), so a single pass under-reports the
-    # engine; the best pass is the honest steady-state capability number.
-    # Per-pass numbers travel in extra.headline_passes so a drop is
-    # attributable (engine regression vs link phase) from the JSON alone.
-    bf.contains_all_async(np.arange(B, dtype=np.uint64)).result()
-    iters = 16
     rng = np.random.default_rng(0)
-    passes = []
-    for _pass in range(3):
+
+    def run_pass(B, iters):
         batches = [
             rng.integers(0, 2 * n_load, size=B).astype(np.uint64)
             for _ in range(iters)
@@ -63,12 +51,32 @@ def bench_bloom_contains(client):
         n_hits = sum(int(np.sum(r.result())) for r in results)
         dt = time.perf_counter() - t0
         assert 0.3 < n_hits / (iters * B) < 0.7, n_hits
-        passes.append(iters * B / dt)
+        return iters * B / dt
+
+    # The tunnel's per-launch cost is phase-dependent and NON-MONOTONIC
+    # in batch size (r4 measured 512k-op launches beating 1M-op 2.3x in
+    # one phase and the reverse ordering in another) — probe candidate
+    # sizes with short passes, then measure at today's winner.
+    probe = {}
+    for B in (1 << 18, 1 << 19, 1 << 20):
+        bf.contains_all_async(np.arange(B, dtype=np.uint64)).result()  # warm
+        probe[B] = run_pass(B, 6)
+    B = max(probe, key=probe.get)
+
+    # Best-of-3 measured passes: the link's throughput varies >2x between
+    # runs minutes apart, so a single pass under-reports the engine; the
+    # best pass is the honest steady-state capability number.  Per-pass
+    # numbers travel in extra.headline_passes so a drop is attributable
+    # (engine regression vs link phase) from the JSON alone.
+    iters = max(8, (1 << 23) // B)
+    passes = []
+    for _pass in range(3):
+        passes.append(run_pass(B, iters))
 
     # Measured FPP: probe keys strictly outside the loaded range.
-    probe = rng.integers(3 * n_load, 8 * n_load, size=1 << 17).astype(np.uint64)
-    fpp = float(np.mean(bf.contains_each(probe)))
-    return max(passes), fpp, passes
+    fp_keys = rng.integers(3 * n_load, 8 * n_load, size=1 << 17).astype(np.uint64)
+    fpp = float(np.mean(bf.contains_each(fp_keys)))
+    return max(passes), fpp, passes, B
 
 
 def bench_hll_pfadd(client):
@@ -108,10 +116,15 @@ def bench_config4_mixed(make_client):
     to UN-collected queues); min_bucket=4096 bounds the set of padded
     shapes so warmup covers every compile.
     """
+    # max_batch=256k + min_inflight=4: on a high-latency link phase the
+    # adaptive window shrinks (AIMD) and throughput is bounded by
+    # limit x max_batch / RT — bigger launches keep the ceiling above the
+    # 1M spec even at 350 ms round trips (r4 capture: 2 x 128k / 0.35s
+    # = 731k was the binding cap).
     client = make_client(coalesce=True, exact_add_semantics=True,
-                         batch_window_us=200, max_batch=1 << 17,
-                         min_bucket=4096, max_inflight=16,
-                         max_queued_ops=1 << 16)
+                         batch_window_us=200, max_batch=1 << 18,
+                         min_bucket=4096, max_inflight=16, min_inflight=4,
+                         max_queued_ops=1 << 19)
     n_tenants = 1000
     filters = []
     for t in range(n_tenants):
@@ -124,7 +137,7 @@ def bench_config4_mixed(make_client):
     # exact-size submission per bucket pins each shape deterministically.
     # Then zero the latency reservoirs so measurement sees no compiles.
     nbucket = 4096
-    while nbucket <= (1 << 17):
+    while nbucket <= (1 << 18):
         keys = rng.integers(0, 50_000, nbucket).astype(np.uint64)
         t = int(rng.integers(n_tenants))
         # Explicit generous timeout: a cold-cache first compile of the
@@ -213,7 +226,7 @@ def bench_config3_bitset(client):
     bs = client.get_bit_set("bench-bs")
     bs.set(NBITS - 1)  # materialize the full row
     rng = np.random.default_rng(2)
-    B = 1 << 16
+    B = 1 << 18  # latency-bound link phases: throughput ~ B/RT
     bs.set_many(rng.integers(0, NBITS, B).astype(np.uint32))  # warm compile
     bs.get_many(rng.integers(0, NBITS, B).astype(np.uint32))
     iters = 12
@@ -432,7 +445,7 @@ def main():
     # (that serves the mixed multi-tenant QPS config below).
     link = measure_link_calibration()
     client = make_client(exact_add_semantics=False, coalesce=False)
-    contains_ops, fpp, headline_passes = bench_bloom_contains(client)
+    contains_ops, fpp, headline_passes, headline_B = bench_bloom_contains(client)
     hll_ops = bench_hll_pfadd(client)
     bitset_ops = bench_config3_bitset(client)
     stream_eps, topk_recall = bench_config5_stream_topk(client)
@@ -464,6 +477,7 @@ def main():
                     "headline_median": round(
                         float(np.median(headline_passes))
                     ),
+                    "headline_batch_ops": headline_B,
                     "config4_passes": config4_passes,
                     "config4_median": round(
                         float(np.median(config4_passes))
