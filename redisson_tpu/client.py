@@ -274,6 +274,16 @@ class RedissonTpuClient(CamelCompatMixin):
 
         return NodesGroup(self)
 
+    def reactive(self):
+        """→ RedissonClient's reactive facade (RedissonReactiveClient /
+        RedissonRxClient analog): every object method returns an
+        asyncio awaitable — see redisson_tpu/reactive.py."""
+        from redisson_tpu.reactive import ReactiveClient
+
+        return ReactiveClient(self)
+
+    rx = reactive  # → RedissonRxClient spelling
+
     def get_failure_monitor(self, interval_s: float = 1.0):
         """Shared background monitor surfacing dead shards as typed events
         (the ClusterConnectionManager topology-monitor analog, SURVEY §5

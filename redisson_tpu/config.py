@@ -67,6 +67,14 @@ class TpuSketchConfig:
         # Only meaningful with num_shards > 1.
         self.mbit_threshold_words = 1 << 22
         self.platform: Optional[str] = None  # None → jax default backend
+        # Multi-host (DCN) — docs/MULTIHOST.md.  When coordinator_address
+        # is set the engine joins the standard JAX distributed runtime
+        # before device discovery; num_shards then counts GLOBAL shards.
+        # Unmeasurable in the single-chip bench env — accepted and armed,
+        # designed-for rather than exercised.
+        self.coordinator_address: Optional[str] = None
+        self.num_processes = 1
+        self.process_id = 0
         # HLL geometry is fixed to Redis parity (p=14) — not configurable,
         # matching Redis server behavior.
 

@@ -148,6 +148,24 @@ class TpuSketchEngine(SketchDurabilityMixin):
         from redisson_tpu.serve.metrics import Metrics
 
         self.config = config
+        self._dist_initialized = False
+        if config.tpu_sketch.coordinator_address:
+            # Multi-host: join the JAX distributed runtime BEFORE any
+            # device discovery (docs/MULTIHOST.md) — after this,
+            # jax.devices() spans every process's chips and the sharded
+            # executor's mesh covers them transparently.  Guarded: a
+            # second engine in the process (client restart) must not
+            # re-initialize.
+            import jax
+
+            already = getattr(jax.distributed, "is_initialized", None)
+            if not (already is not None and already()):
+                jax.distributed.initialize(
+                    config.tpu_sketch.coordinator_address,
+                    num_processes=config.tpu_sketch.num_processes,
+                    process_id=config.tpu_sketch.process_id,
+                )
+                self._dist_initialized = True
         if config.tpu_sketch.num_shards > 1:
             from redisson_tpu.executor.sharded_executor import (
                 ShardedTpuCommandExecutor,
@@ -198,6 +216,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 pass
         if self.coalescer is not None:
             self.coalescer.shutdown()
+        if self._dist_initialized:  # pair with jax.distributed.initialize
+            import jax
+
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # pragma: no cover — runtime already gone
+                pass
+            self._dist_initialized = False
 
     def _drain(self) -> None:
         """Direct state reads must observe all queued coalesced ops."""

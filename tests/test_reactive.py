@@ -1,0 +1,125 @@
+"""Reactive (asyncio) facade — the RedissonReactiveClient/RxClient
+analog (SURVEY §2.3 facades row): reflective wrapping, awaitable
+methods, off-event-loop execution."""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+
+
+@pytest.fixture(params=["tpu", "host"])
+def client(request):
+    cfg = Config()
+    if request.param == "tpu":
+        cfg.use_tpu_sketch(min_bucket=64)
+    c = redisson_tpu.create(cfg)
+    yield c
+    c.shutdown()
+
+
+def test_reactive_bloom_roundtrip(client):
+    rc = client.reactive()
+
+    async def main():
+        bf = rc.get_bloom_filter("rx-bf")
+        assert await bf.try_init(10_000, 0.01) is True
+        assert await bf.add("alice") is True
+        assert await bf.contains("alice") is True
+        assert await bf.contains("ghost") is False
+        added = await bf.add_all(np.arange(100, dtype=np.uint64))
+        assert added == 100
+        return await bf.count()
+
+    est = asyncio.run(main())
+    assert est > 50
+
+
+def test_reactive_grid_objects_and_camelcase(client):
+    rc = client.rx()  # the RxClient spelling
+
+    async def main():
+        m = rc.get_map("rx-m")
+        await m.put("k", 1)
+        assert await m.get("k") == 1
+        assert await m.containsKey("k") is True  # camelCase rides through
+        q = rc.get_queue("rx-q")
+        await q.offer("x")
+        assert await q.poll() == "x"
+        b = rc.getBucket("rx-b")  # camelCase factory
+        await b.set("v")
+        return await b.get()
+
+    assert asyncio.run(main()) == "v"
+
+
+def test_reactive_runs_off_event_loop(client):
+    """Blocking work must not run on the loop thread."""
+    rc = client.reactive()
+    loop_thread = []
+
+    async def main():
+        loop_thread.append(threading.current_thread().name)
+        q = rc.get_blocking_queue("rx-bq")
+
+        async def producer():
+            await asyncio.sleep(0.2)
+            await q.offer("late")
+
+        # A blocking poll awaited CONCURRENTLY with the producer on one
+        # event loop: only possible if the poll runs off-loop.
+        got, _ = await asyncio.gather(q.poll(5.0), producer())
+        return got
+
+    assert asyncio.run(main()) == "late"
+
+
+def test_reactive_many_blocking_ops_no_pool_deadlock(client):
+    """More concurrent blocking awaits than any bounded pool has workers
+    — per-call threads mean the unblocking offer always runs."""
+    rc = client.reactive()
+
+    async def main():
+        q = rc.get_blocking_queue("rx-dl")
+        n = 40  # far beyond the default-executor worker count
+
+        async def producer():
+            await asyncio.sleep(0.2)
+            for i in range(n):
+                await q.offer(i)
+
+        results = await asyncio.gather(
+            *[q.poll(10.0) for _ in range(n)], producer()
+        )
+        return sorted(r for r in results[:n])
+
+    assert asyncio.run(main()) == list(range(40))
+
+
+def test_reactive_async_named_methods_resolve_to_values(client):
+    """Awaiting fooAsync/*_async must yield the VALUE, not a future."""
+    rc = client.reactive()
+
+    async def main():
+        m = rc.get_map("rx-av")
+        await m.put("k", 7)
+        got = await m.get_async("k")
+        got2 = await m.getAsync("k")
+        return got, got2
+
+    assert asyncio.run(main()) == (7, 7)
+
+
+def test_reactive_concurrent_awaitables(client):
+    rc = client.reactive()
+
+    async def main():
+        counter = rc.get_atomic_long("rx-ctr")
+        await asyncio.gather(*[counter.increment_and_get() for _ in range(50)])
+        return await counter.get()
+
+    assert asyncio.run(main()) == 50
