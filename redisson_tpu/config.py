@@ -70,8 +70,9 @@ class TpuSketchConfig:
         # Multi-host (DCN) — docs/MULTIHOST.md.  When coordinator_address
         # is set the engine joins the standard JAX distributed runtime
         # before device discovery; num_shards then counts GLOBAL shards.
-        # Unmeasurable in the single-chip bench env — accepted and armed,
-        # designed-for rather than exercised.
+        # Exercised across two real processes by tests/test_multihost.py;
+        # multi-host PERFORMANCE stays unmeasurable in the single-chip
+        # bench env.
         self.coordinator_address: Optional[str] = None
         self.num_processes = 1
         self.process_id = 0

@@ -44,6 +44,7 @@ from redisson_tpu.executor.tpu_executor import (
     TpuCommandExecutor,
     _locked,
     bloom_count_from_bitcount,
+    ensure_addressable,
 )
 from redisson_tpu.parallel import mesh as pm
 
@@ -729,7 +730,7 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         return fn(pool.state, row)
 
     def read_row(self, pool, row: int) -> np.ndarray:
-        return np.asarray(self._read_row_device(pool, row))
+        return np.asarray(ensure_addressable(self._read_row_device(pool, row)))
 
     def write_row(self, pool, row: int, data: np.ndarray) -> None:
         if self._is_mbit(pool):

@@ -37,11 +37,36 @@ from redisson_tpu.ops import hll as hll_ops
 from redisson_tpu.tenancy import SizeClassPool
 
 
+_REPLICATORS: dict = {}
+
+
+def ensure_addressable(arr):
+    """Multi-host (docs/MULTIHOST.md): a result sharded over a mesh that
+    spans other processes cannot be fetched host-side directly — replicate
+    it first (XLA lowers the gather to DCN collectives).  Single-process
+    arrays pass through untouched; result blocks are bit-packed, so the
+    replicated copy is tiny."""
+    if not isinstance(arr, jax.Array) or arr.is_fully_addressable:
+        return arr
+    mesh = arr.sharding.mesh  # Mesh hashes by content: equal meshes share
+    rep = _REPLICATORS.get(mesh)  # one cached replicator across engines
+    if rep is None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = jax.jit(
+            lambda a: a, out_shardings=NamedSharding(mesh, PartitionSpec())
+        )
+        _REPLICATORS[mesh] = rep
+    return rep(arr)
+
+
 class LazyResult:
     """Async result handle (RFuture analog): holds device arrays; transfers
     to host (and slices off padding) only on .result()."""
 
     def __init__(self, value, n: Optional[int] = None, transform=None):
+        if isinstance(value, jax.Array):
+            value = ensure_addressable(value)
         self._value = value
         self._n = n
         self._transform = transform
@@ -133,7 +158,19 @@ class TpuCommandExecutor:
     # Snapshot transport (SURVEY.md §5 checkpoint row): full-pool D2H/H2D.
 
     def state_to_host(self, pool) -> np.ndarray:
-        return np.asarray(pool.state)
+        st = pool.state
+        if isinstance(st, jax.Array) and not st.is_fully_addressable:
+            # Multi-host: replicate one shard block at a time — peak extra
+            # device memory is one block, not the whole pool (a sharded
+            # pool can exceed a single device).  Must run in lockstep on
+            # every controller, like any dispatch (docs/MULTIHOST.md).
+            return np.stack(
+                [
+                    np.asarray(ensure_addressable(st[s]))
+                    for s in range(st.shape[0])
+                ]
+            )
+        return np.asarray(st)
 
     def state_from_host(self, pool, arr: np.ndarray) -> None:
         pool.state = jnp.asarray(arr)

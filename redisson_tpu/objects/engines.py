@@ -202,9 +202,26 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if config.snapshot_dir:
             self.restore_snapshot(config.snapshot_dir)
             if config.snapshot_interval_s > 0:
-                self._start_snapshotter(
-                    config.snapshot_dir, config.snapshot_interval_s
-                )
+                import jax
+
+                if jax.process_count() > 1:
+                    # The timer thread fires at independent wall-clock
+                    # times per controller, and snapshot() dispatches
+                    # device work — that breaks multi-controller lockstep
+                    # (docs/MULTIHOST.md "Lockstep discipline").  Explicit
+                    # snapshot() calls, issued at the same program point
+                    # on every controller, remain supported.
+                    import warnings
+
+                    warnings.warn(
+                        "periodic snapshots are disabled under multi-host: "
+                        "call snapshot() explicitly at a coordinated point "
+                        "on every controller (docs/MULTIHOST.md)"
+                    )
+                else:
+                    self._start_snapshotter(
+                        config.snapshot_dir, config.snapshot_interval_s
+                    )
 
     def shutdown(self) -> None:
         self._stop_snapshotter()

@@ -1,0 +1,92 @@
+"""Worker process for the two-process multi-host test (docs/MULTIHOST.md).
+
+Each worker is one *controller* in a standard JAX multi-controller SPMD
+deployment: it joins the distributed runtime through the engine's own
+``coordinator_address`` config path (objects/engines.py), then drives the
+IDENTICAL op stream as its peer — the lockstep discipline every
+multi-controller JAX program follows.  The device mesh spans both
+processes (4 virtual CPU devices each → 8 global shards), so every
+dispatch here exercises the real cross-process path: sharded pool state,
+partition-by-owner dispatch, and replicate-on-fetch results
+(executor/tpu_executor.py ``ensure_addressable``) whose gathers XLA
+lowers to inter-process (DCN-role) collectives.
+
+Run: ``python tests/multihost_worker.py <process_id> <port>``.
+Prints one ``MH-OK <checksum-fields>`` line on success; the parent test
+asserts both workers exit 0 with identical checksums.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Force exactly 4 local devices, replacing any inherited count (the pytest
+# parent pins 8 for the single-process mesh suite).
+_flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+]
+_flags.append("--xla_force_host_platform_device_count=4")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = int(sys.argv[2])
+
+    import redisson_tpu
+    from redisson_tpu import Config
+    from redisson_tpu.codecs import LongCodec
+
+    cfg = (
+        Config()
+        .set_codec(LongCodec())
+        .use_tpu_sketch(
+            num_shards=8,
+            coalesce=False,  # lockstep SPMD: dispatch order must be the
+            # program order on every controller; the timing-driven
+            # coalescer is a single-controller feature
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=2,
+            process_id=pid,
+        )
+    )
+    client = redisson_tpu.create(cfg)
+    assert len(jax.devices()) == 8, jax.devices()
+    assert jax.process_count() == 2
+
+    # Bloom: cross-process sharded rows, device-side hashing.
+    bf = client.get_bloom_filter("mh-bf")
+    bf.try_init(50_000, 0.01)
+    added = bf.add_all(np.arange(1000, dtype=np.uint64))
+    got = bf.contains_each(np.arange(2000, dtype=np.uint64))
+    assert bool(np.all(got[:1000])), "loaded keys must hit"
+    fpp = float(np.mean(got[1000:]))
+    assert fpp < 0.05, fpp
+    count_est = bf.count()
+
+    # HLL: scatter-max registers + Ertl estimate across shards.
+    h = client.get_hyper_log_log("mh-hll")
+    h.add_all(np.arange(20_000, dtype=np.uint64))
+    est = h.count()
+    assert abs(est - 20_000) / 20_000 < 0.05, est
+
+    # BitSet: single-bit ops + cardinality reduce over the mesh.
+    bs = client.get_bit_set("mh-bs")
+    bs.set_many(np.arange(0, 4096, 7, dtype=np.uint32))
+    card = bs.cardinality()
+    assert card == len(range(0, 4096, 7)), card
+
+    client.shutdown()
+    print(f"MH-OK {added} {count_est} {est} {card}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
