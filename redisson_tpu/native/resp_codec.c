@@ -1,0 +1,155 @@
+/* RESP2 wire codec — the native hot loop of the front door.
+ *
+ * Role parity: org/redisson/client/handler/CommandDecoder (the reference
+ * decodes RESP frames inside Netty's native-transport event loop; this
+ * framework's serving tier is Python, so the per-byte frame scan is the
+ * one place the host language binds — SURVEY.md §7 stance: native code
+ * where the Python host loop is the measured bottleneck).
+ *
+ * One call parses as many COMPLETE pipelined command frames
+ * (`*N\r\n` followed by N `$len\r\n<bytes>\r\n` bulks) as fit the caller's
+ * descriptor capacity, writing per-argument (offset, length) descriptors
+ * into flat arrays — zero copies; Python slices the argument bytes out of
+ * its own buffer afterwards.
+ *
+ * Exit conditions (err):
+ *   0 — clean stop: out of complete frames, or descriptor capacity hit.
+ *   1 — protocol error at byte *consumed (caller: surface/close).
+ *   2 — frame does not start with '*' (inline command etc.): caller
+ *       falls back to the slow-path parser for this frame.
+ * Frames already parsed before the stop are always valid; *consumed is
+ * the exact byte count they occupy.
+ *
+ * Build: cc -O2 -shared -fPIC resp_codec.c -o _resp_codec.so
+ * (loaded via ctypes — redisson_tpu/serve/native_codec.py).
+ */
+
+#include <stdint.h>
+
+long rtpu_resp_parse(const unsigned char *buf, long len,
+                     long max_frames, long max_args_total,
+                     long *counts, long *offs, long *lens,
+                     long *consumed, long *err)
+{
+    long pos = 0, nframes = 0, nargs = 0;
+    *err = 0;
+    while (nframes < max_frames) {
+        long p = pos;
+        if (p >= len)
+            break;
+        if (buf[p] != '*') {
+            *err = 2;
+            break;
+        }
+        /* *N\r\n header */
+        long q = p + 1, n = 0, digs = 0;
+        while (q < len && buf[q] >= '0' && buf[q] <= '9') {
+            n = n * 10 + (buf[q] - '0');
+            q++;
+            digs++;
+            if (n > 1024 * 1024) { /* argv cap, matches Redis proto limit */
+                *err = 1;
+                goto out;
+            }
+        }
+        if (q + 1 >= len)
+            break; /* incomplete header */
+        if (digs == 0 || buf[q] != '\r' || buf[q + 1] != '\n') {
+            *err = 1;
+            break;
+        }
+        q += 2;
+        if (nargs + n > max_args_total) {
+            /* Descriptor capacity: stop BEFORE this frame.  If it is the
+             * FIRST frame, no progress is possible at any buffer size —
+             * signal fallback so the caller's slow path (which has no
+             * argc capacity) parses it instead of waiting forever. */
+            if (nframes == 0)
+                *err = 2;
+            break;
+        }
+        long ok = 1;
+        for (long i = 0; i < n; i++) {
+            if (q >= len) {
+                ok = 0;
+                break;
+            }
+            if (buf[q] != '$') {
+                *err = 1;
+                goto out;
+            }
+            long r = q + 1, blen = 0, d2 = 0;
+            while (r < len && buf[r] >= '0' && buf[r] <= '9') {
+                blen = blen * 10 + (buf[r] - '0');
+                r++;
+                d2++;
+                if (blen > 512L * 1024 * 1024) { /* proto-max-bulk-len */
+                    *err = 1;
+                    goto out;
+                }
+            }
+            if (r + 1 >= len) {
+                ok = 0;
+                break;
+            }
+            if (d2 == 0 || buf[r] != '\r' || buf[r + 1] != '\n') {
+                *err = 1;
+                goto out;
+            }
+            r += 2;
+            if (r + blen + 2 > len) {
+                ok = 0;
+                break;
+            }
+            if (buf[r + blen] != '\r' || buf[r + blen + 1] != '\n') {
+                *err = 1;
+                goto out;
+            }
+            offs[nargs + i] = r;
+            lens[nargs + i] = blen;
+            q = r + blen + 2;
+        }
+        if (!ok)
+            break; /* incomplete frame: wait for more bytes */
+        counts[nframes] = n;
+        nframes++;
+        nargs += n;
+        pos = q;
+    }
+out:
+    *consumed = pos;
+    return nframes;
+}
+
+/* Serialize a batch of integer replies (`:n\r\n`) — the common reply shape
+ * of SETBIT/SADD/HSET/... pipelines; one call per flush instead of one
+ * Python string-build per reply. Returns bytes written, or -1 if the
+ * output buffer is too small. */
+long rtpu_resp_encode_ints(const long *vals, long n, unsigned char *out,
+                           long cap)
+{
+    long w = 0;
+    for (long i = 0; i < n; i++) {
+        long v = vals[i];
+        unsigned char tmp[24];
+        long t = 0, neg = 0;
+        if (w + 26 > cap)
+            return -1;
+        if (v < 0) {
+            neg = 1;
+            v = -v;
+        }
+        do {
+            tmp[t++] = '0' + (unsigned char)(v % 10);
+            v /= 10;
+        } while (v);
+        out[w++] = ':';
+        if (neg)
+            out[w++] = '-';
+        while (t)
+            out[w++] = tmp[--t];
+        out[w++] = '\r';
+        out[w++] = '\n';
+    }
+    return w;
+}

@@ -68,8 +68,23 @@ def _encode_bulk(v) -> bytes:
     return b"$" + str(len(v)).encode() + b"\r\n" + v + b"\r\n"
 
 
+_int_encoder = None
+_int_encoder_loaded = False
+
+
 def _encode_array(items) -> bytes:
+    global _int_encoder, _int_encoder_loaded
     out = b"*" + str(len(items)).encode() + b"\r\n"
+    if len(items) >= 8 and all(type(it) is int for it in items):
+        # Batch integer replies (BF.MADD / BF.MEXISTS / CMS.QUERY
+        # pipelines) serialize in one native call (rtpu_resp_encode_ints).
+        if not _int_encoder_loaded:
+            from redisson_tpu.serve import native_codec
+
+            _int_encoder = native_codec.get_parser()
+            _int_encoder_loaded = True
+        if _int_encoder is not None:
+            return out + _int_encoder.encode_ints(items)
     for it in items:
         if isinstance(it, int):
             out += _encode_int(it)
@@ -86,9 +101,18 @@ class _Reader:
         # timeout that fires here must close the connection (continuing
         # would desync the protocol stream), see _serve_conn.
         self.frame_started = False
+        # Native batch parser (serve/native_codec.py): one C call frames
+        # a whole pipelined recv; parsed-ahead commands queue here.  None
+        # → pure-Python slow path (no compiler / RTPU_NO_NATIVE_RESP).
+        from collections import deque
+
+        from redisson_tpu.serve import native_codec
+
+        self._native = native_codec.get_parser()
+        self._pending: "deque[list[bytes]]" = deque()
 
     def at_frame_boundary(self) -> bool:
-        return not self.frame_started and not self._buf
+        return not self.frame_started and not self._buf and not self._pending
 
     def _read_line(self) -> Optional[bytes]:
         while b"\r\n" not in self._buf:
@@ -109,6 +133,38 @@ class _Reader:
         return out
 
     def read_command(self) -> Optional[list[bytes]]:
+        if self._native is not None:
+            return self._read_command_native()
+        return self._read_command_py()
+
+    def _read_command_native(self) -> Optional[list[bytes]]:
+        from redisson_tpu.serve import native_codec
+
+        while True:
+            if self._pending:
+                self.frame_started = False
+                return self._pending.popleft()
+            if self._buf:
+                frames, consumed, err = self._native.parse(self._buf)
+                if frames:
+                    self._buf = self._buf[consumed:]
+                    self._pending.extend(frames)
+                    continue
+                if err != native_codec.PARSE_OK:
+                    # Inline command or malformed frame: hand the bytes
+                    # to the slow path, which reproduces the Python
+                    # behavior exactly (split / RespError path).
+                    return self._read_command_py()
+                # Incomplete frame: block for more bytes.  Flag it so an
+                # idle timeout firing here closes the connection instead
+                # of desyncing the stream (see _serve_conn).
+                self.frame_started = True
+            data = self._sock.recv(65536)
+            if not data:
+                return None
+            self._buf += data
+
+    def _read_command_py(self) -> Optional[list[bytes]]:
         self.frame_started = False
         line = self._read_line()
         if line is None:

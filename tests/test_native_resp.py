@@ -1,0 +1,201 @@
+"""Native RESP codec (native/resp_codec.c via serve/native_codec.py).
+
+Parity discipline mirrors the kernel/golden-twin strategy (SURVEY.md §4):
+the C parser must frame byte streams exactly like the pure-Python
+``_Reader`` path, across pipelining, arbitrary chunk splits, binary
+payloads, and malformed input.
+"""
+
+import os
+import random
+import socket
+import threading
+
+import pytest
+
+from redisson_tpu.serve import native_codec
+from redisson_tpu.serve.native_codec import get_parser
+from redisson_tpu.serve.resp import _Reader
+
+
+def _wire(args):
+    out = b"*%d\r\n" % len(args)
+    for a in args:
+        out += b"$%d\r\n%s\r\n" % (len(a), a)
+    return out
+
+
+@pytest.fixture(scope="module")
+def parser():
+    p = get_parser()
+    assert p is not None, "native codec must build in this image (cc present)"
+    return p
+
+
+def test_parse_pipeline(parser):
+    cmds = [
+        [b"PING"],
+        [b"SET", b"k", b"v" * 100],
+        [b"GET", b""],  # empty bulk
+        [b"BF.MADD", b"f"] + [b"item%d" % i for i in range(50)],
+        [b"SET", b"bin", bytes(range(256)) + b"\r\n$9\r\n*3\r\n"],  # wire bytes inside payload
+    ]
+    buf = b"".join(_wire(c) for c in cmds)
+    frames, consumed, err = parser.parse(buf)
+    assert err == native_codec.PARSE_OK
+    assert consumed == len(buf)
+    assert frames == cmds
+
+
+def test_parse_incomplete_then_complete(parser):
+    cmd = [b"SET", b"key", b"value"]
+    buf = _wire(cmd)
+    for cut in range(len(buf)):
+        frames, consumed, err = parser.parse(buf[:cut])
+        assert frames == [] and consumed == 0
+        assert err == native_codec.PARSE_OK, (cut, err)
+    frames, consumed, err = parser.parse(buf)
+    assert frames == [cmd] and consumed == len(buf)
+
+
+def test_parse_trailing_partial(parser):
+    full = _wire([b"PING"]) * 3
+    tail = _wire([b"SET", b"a", b"b"])[:7]
+    frames, consumed, err = parser.parse(full + tail)
+    assert len(frames) == 3 and consumed == len(full)
+    assert err == native_codec.PARSE_OK
+
+
+def test_parse_inline_fallback(parser):
+    frames, consumed, err = parser.parse(b"PING\r\n")
+    assert frames == [] and consumed == 0
+    assert err == native_codec.PARSE_FALLBACK
+    # Pipelined frames BEFORE the inline command still parse.
+    frames, consumed, err = parser.parse(_wire([b"PING"]) + b"QUIT\r\n")
+    assert frames == [[b"PING"]]
+    assert err == native_codec.PARSE_FALLBACK
+
+
+def test_parse_protocol_errors(parser):
+    for bad in (
+        b"*2\r\nPING\r\n",  # missing $ header
+        b"*x\r\n",  # non-numeric argc
+        b"*1\r\n$3\r\nabcd\r\n",  # bulk length mismatch (no CRLF at end)
+        b"*1\r\n$x\r\n",  # non-numeric bulk len
+    ):
+        frames, consumed, err = parser.parse(bad)
+        assert err == native_codec.PARSE_PROTO_ERROR, bad
+        assert frames == []
+
+
+def test_parse_first_frame_exceeds_arg_capacity(parser):
+    # A COMPLETE frame with more args than the descriptor capacity must
+    # signal fallback (the slow path has no argc cap) — not read as
+    # "incomplete", which would block the connection forever.
+    big = [b"HSET", b"h"] + [b"f%d" % i for i in range(parser.MAX_ARGS)]
+    frames, consumed, err = parser.parse(_wire(big))
+    assert frames == [] and consumed == 0
+    assert err == native_codec.PARSE_FALLBACK
+    # Frames before the oversized one still parse; capacity stops cleanly.
+    frames, consumed, err = parser.parse(_wire([b"PING"]) + _wire(big))
+    assert frames == [[b"PING"]]
+    assert err == native_codec.PARSE_OK
+
+
+def test_reader_handles_oversized_frame(parser):
+    big = [b"HSET", b"h"] + [b"f%d" % i for i in range(parser.MAX_ARGS)]
+    payload = _wire([b"PING"]) + _wire(big) + _wire([b"PING"])
+    got = _drive_reader(payload, 65536, native=True)
+    assert got == [[b"PING"], big, [b"PING"]]
+
+
+def test_encode_array_int_fast_path(parser):
+    from redisson_tpu.serve.resp import _encode_array
+
+    vals = list(range(50)) + [-3, 10**12]
+    expect = b"*%d\r\n" % len(vals) + b"".join(b":%d\r\n" % v for v in vals)
+    assert _encode_array(vals) == expect
+    # Mixed arrays keep the general path.
+    assert _encode_array([1, b"x"]) == b"*2\r\n:1\r\n$1\r\nx\r\n"
+
+
+def test_encode_ints(parser):
+    vals = [0, 1, -1, 42, -42, 10**17, -(10**17)]
+    assert parser.encode_ints(vals) == b"".join(
+        b":%d\r\n" % v for v in vals
+    )
+
+
+def _reader_pair():
+    a, b = socket.socketpair()
+    return _Reader(a), a, b
+
+
+def _drive_reader(payload, chunks, native: bool):
+    """Feed ``payload`` to a _Reader in ``chunks``-byte slices; collect
+    every command it frames."""
+    if native:
+        os.environ.pop("RTPU_NO_NATIVE_RESP", None)
+    else:
+        os.environ["RTPU_NO_NATIVE_RESP"] = "1"
+    try:
+        reader, a, b = _reader_pair()
+        assert (reader._native is not None) == native
+    finally:
+        os.environ.pop("RTPU_NO_NATIVE_RESP", None)
+
+    def feed():
+        for i in range(0, len(payload), chunks):
+            b.sendall(payload[i : i + chunks])
+        b.shutdown(socket.SHUT_WR)
+
+    t = threading.Thread(target=feed)
+    t.start()
+    got = []
+    while True:
+        cmd = reader.read_command()
+        if cmd is None:
+            break
+        got.append(cmd)
+    t.join()
+    a.close()
+    b.close()
+    return got
+
+
+@pytest.mark.parametrize("chunks", [1, 3, 64, 65536])
+def test_reader_parity_native_vs_python(parser, chunks):
+    rng = random.Random(42)
+    cmds = []
+    for _ in range(40):
+        n = rng.randint(1, 6)
+        cmds.append(
+            [bytes(rng.randrange(256) for _ in range(rng.randint(0, 40))) for _ in range(n)]
+        )
+    cmds.append([b"INLINE", b"CMD"])  # sent inline (no * framing)
+    payload = b"".join(_wire(c) for c in cmds[:-1]) + b"INLINE CMD\r\n"
+    native = _drive_reader(payload, chunks, native=True)
+    pure = _drive_reader(payload, chunks, native=False)
+    assert native == pure == cmds
+
+
+def test_reader_fallback_on_malformed(parser):
+    # A malformed frame must produce the same outcome on both paths:
+    # the Python slow path raises ValueError (int(b'x')) in both cases.
+    payload = _wire([b"PING"]) + b"*1\r\n$x\r\n"
+    for native in (True, False):
+        if native:
+            os.environ.pop("RTPU_NO_NATIVE_RESP", None)
+        else:
+            os.environ["RTPU_NO_NATIVE_RESP"] = "1"
+        try:
+            reader, a, b = _reader_pair()
+        finally:
+            os.environ.pop("RTPU_NO_NATIVE_RESP", None)
+        b.sendall(payload)
+        b.shutdown(socket.SHUT_WR)
+        assert reader.read_command() == [b"PING"]
+        with pytest.raises(ValueError):
+            reader.read_command()
+        a.close()
+        b.close()
