@@ -398,6 +398,19 @@ class RedissonTpuClient(CamelCompatMixin):
 
         return ScriptService(self)
 
+    def get_function(self):
+        """→ RedissonClient#getFunction (RFunction, upstream ≥3.17):
+        libraries of named atomic procedures with FCALL/FCALL_RO
+        semantics."""
+        from redisson_tpu.grid import FunctionService
+
+        with self._services_lock:
+            svc = getattr(self, "_function_service", None)
+            if svc is None:
+                svc = FunctionService(self)
+                self._function_service = svc
+            return svc
+
     def get_live_object_service(self):
         """→ RedissonClient#getLiveObjectService."""
         from redisson_tpu.grid import LiveObjectService

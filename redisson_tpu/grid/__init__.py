@@ -66,6 +66,7 @@ from redisson_tpu.grid.keys import Keys
 from redisson_tpu.grid.batch import Batch, BatchResult
 from redisson_tpu.grid.services import (
     ExecutorService,
+    FunctionService,
     LiveObjectService,
     MapReduce,
     RemoteService,
@@ -92,5 +93,5 @@ __all__ = [
     "CountDownLatch", "RateLimiter",
     "Keys", "Batch", "BatchResult",
     "ExecutorService", "RemoteService", "Transaction", "TransactionException",
-    "ScriptService", "LiveObjectService", "MapReduce",
+    "ScriptService", "FunctionService", "LiveObjectService", "MapReduce",
 ]

@@ -613,6 +613,116 @@ class ScriptService(CamelCompatMixin):
             return out
 
 
+class FunctionService(CamelCompatMixin):
+    """→ RFunction (org/redisson/api/RFunction.java, upstream ≥3.17):
+    Redis Functions group named procedures into LIBRARIES (FUNCTION LOAD
+    ships a library of functions; FCALL invokes one by name).  Same
+    atomicity contract as ScriptService — a call runs under the grid
+    lock, indivisible w.r.t. every other grid op.  ``call_ro`` mirrors
+    FCALL_RO's read-only contract: the function must not mutate (the
+    contract is declarative here, as upstream's is — Redis enforces it
+    via script flags, we via the no_writes registration flag).
+
+    Libraries hold Python callables ``fn(client, keys, args)``; there is
+    deliberately no Lua VM (ScriptService's design note applies)."""
+
+    def __init__(self, client):
+        self._client = client
+        # library -> {function name -> (fn, no_writes)}
+        self._libs: dict[str, dict] = {}
+        self._by_name: dict[str, tuple] = {}  # flat FCALL lookup
+        self._lock = threading.Lock()
+
+    def load(self, library: str, functions: dict, *, replace: bool = False,
+             no_writes: tuple = ()) -> None:
+        """→ FUNCTION LOAD [REPLACE]: register a library.  ``functions``
+        maps function name -> callable; names are GLOBAL across libraries
+        (the Redis rule) — loading a clashing name raises unless
+        ``replace`` and the name belongs to this same library."""
+        with self._lock:
+            if library in self._libs and not replace:
+                raise ValueError(f"library {library!r} already exists")
+            for fname in functions:
+                owner = self._by_name.get(fname)
+                if owner is not None and owner[0] != library:
+                    raise ValueError(
+                        f"function {fname!r} already registered by "
+                        f"library {owner[0]!r}"
+                    )
+            old = self._libs.pop(library, {})
+            for fname in old:
+                self._by_name.pop(fname, None)
+            lib = {
+                fname: (fn, fname in no_writes)
+                for fname, fn in functions.items()
+            }
+            self._libs[library] = lib
+            for fname, entry in lib.items():
+                self._by_name[fname] = (library, *entry)
+
+    def call(self, name: str, keys: list = (), args: list = ()):
+        """→ FCALL: atomic named-function invocation."""
+        with self._lock:
+            entry = self._by_name.get(name)
+        if entry is None:
+            raise KeyError(f"Function not found: {name!r}")
+        _, fn, _ = entry
+        with self._client._grid.lock:
+            out = fn(self._client, list(keys), list(args))
+            self._client._grid.cond.notify_all()
+            return out
+
+    def call_ro(self, name: str, keys: list = (), args: list = ()):
+        """→ FCALL_RO: only functions registered ``no_writes`` qualify."""
+        with self._lock:
+            entry = self._by_name.get(name)
+        if entry is None:
+            raise KeyError(f"Function not found: {name!r}")
+        _, fn, ro = entry
+        if not ro:
+            raise ValueError(
+                f"Can not execute a function with write flag using fcall_ro: "
+                f"{name!r}"
+            )
+        with self._client._grid.lock:
+            return fn(self._client, list(keys), list(args))
+
+    def list(self, library_pattern: Optional[str] = None) -> list:
+        """→ FUNCTION LIST [LIBRARYNAME pat]: library metadata."""
+        import fnmatch
+
+        with self._lock:
+            out = []
+            for lib, fns in self._libs.items():
+                if library_pattern and not fnmatch.fnmatch(lib, library_pattern):
+                    continue
+                out.append(
+                    {
+                        "library_name": lib,
+                        "functions": [
+                            {"name": f, "flags": ["no-writes"] if ro else []}
+                            for f, (_, ro) in fns.items()
+                        ],
+                    }
+                )
+            return out
+
+    def delete(self, library: str) -> None:
+        """→ FUNCTION DELETE."""
+        with self._lock:
+            fns = self._libs.pop(library, None)
+            if fns is None:
+                raise KeyError(f"Library not found: {library!r}")
+            for fname in fns:
+                self._by_name.pop(fname, None)
+
+    def flush(self) -> None:
+        """→ FUNCTION FLUSH."""
+        with self._lock:
+            self._libs.clear()
+            self._by_name.clear()
+
+
 class LiveObjectService(CamelCompatMixin):
     """→ RLiveObjectService: instances whose attributes live in an RMap
     named ``{class}:{id}`` — every attribute read/write is a map op, so

@@ -34,6 +34,34 @@ _SNAP_META = "sketch_meta.json"
 _SNAP_POOLS = "sketch_pools.npz"
 
 
+def safe_load_npy(buf: io.BytesIO) -> np.ndarray:
+    """np.load for UNTRUSTED dump payloads: a forged .npy header can
+    declare an arbitrarily large shape and make np.load allocate
+    terabytes before reading a byte — validate the declared size against
+    the bytes actually present BEFORE allocating."""
+    version = np.lib.format.read_magic(buf)
+    if version == (1, 0):
+        shape, fortran, dtype = np.lib.format.read_array_header_1_0(buf)
+    elif version == (2, 0):
+        shape, fortran, dtype = np.lib.format.read_array_header_2_0(buf)
+    else:
+        raise ValueError(f"unsupported npy version {version}")
+    if dtype.hasobject:
+        raise ValueError("object arrays are not allowed in dumps")
+    count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    nbytes = count * dtype.itemsize
+    remaining = len(buf.getbuffer()) - buf.tell()
+    if nbytes > remaining:
+        raise ValueError(
+            f"npy payload declares {nbytes} bytes but only {remaining} follow"
+        )
+    data = buf.read(nbytes)
+    arr = np.frombuffer(data, dtype=dtype, count=count)
+    if fortran:  # pragma: no cover — np.save emits C-order for C arrays
+        return arr.reshape(shape, order="F")
+    return arr.reshape(shape)
+
+
 class SketchDurabilityMixin:
     """Requires: self.registry, self.executor, self._drain(), self.delete().
     """
@@ -181,7 +209,7 @@ class SketchDurabilityMixin:
         (hlen,) = struct.unpack("<I", data[4:8])
         d = json.loads(data[8 : 8 + hlen].decode("utf-8"))
         d["class_key"] = tuple(d.get("class_key", ()))
-        d["row"] = np.load(io.BytesIO(data[8 + hlen :]), allow_pickle=False)
+        d["row"] = safe_load_npy(io.BytesIO(data[8 + hlen :]))
         if d.get("v") != _DUMP_VERSION:
             raise ValueError(f"unsupported dump version: {d.get('v')}")
         if self._live_lookup(name) is not None:

@@ -1306,21 +1306,121 @@ class HostSketchEngine:
                 return -1
             return max(0, int((o["expire_at"] - _time.time()) * 1000))
 
+    # Data-only dump wire format (no pickle — dump blobs may cross trust
+    # boundaries; the reference's DUMP/RESTORE payload is data-only,
+    # ADVICE r3): RTPH | u32 header_len | json header | npy arrays.
+    # The header records the golden-model class by NAME and its int
+    # scalars; arrays ride as concatenated .npy blobs in header order.
+    _DUMP_MAGIC = b"RTPH"
+
     def dump(self, name):
-        import pickle
+        import io
+        import json
+        import struct
 
         with self._lock:
             o = self._live(name)
             if o is None:
                 return None
-            return pickle.dumps(
-                {"v": 1, "kind": o["kind"], "params": o["params"], "model": o["model"]}
+            m = o["model"]
+            scalars, arrays = {}, []
+            for k_, v_ in vars(m).items():
+                if isinstance(v_, np.ndarray):
+                    arrays.append(k_)
+                elif isinstance(v_, (int, np.integer)):
+                    scalars[k_] = int(v_)
+                else:  # pragma: no cover — golden models hold ints+arrays
+                    raise TypeError(f"non-serializable model field {k_!r}")
+            header = json.dumps(
+                {
+                    "v": 2,
+                    "kind": o["kind"],
+                    "params": dict(o["params"]),
+                    "model_cls": type(m).__name__,
+                    "scalars": scalars,
+                    "arrays": arrays,
+                }
+            ).encode("utf-8")
+            buf = io.BytesIO()
+            for k_ in arrays:
+                np.save(buf, getattr(m, k_), allow_pickle=False)
+            return (
+                self._DUMP_MAGIC
+                + struct.pack("<I", len(header))
+                + header
+                + buf.getvalue()
             )
 
-    def restore(self, name, data: bytes, replace: bool = False) -> None:
-        import pickle
+    # Per-class schemas for restore-time validation: dumps cross trust
+    # boundaries, so field names, dtypes, shapes, and bounds are all
+    # checked before a model is built (a forged blob must not create a
+    # corrupt object or a giant allocation).
+    _RESTORE_SCHEMAS = {
+        "GoldenBloomFilter": {
+            "scalars": {"size": (1, 1 << 33), "hash_iterations": (1, 64)},
+            "arrays": {"bits": (np.bool_, lambda s: (s["size"],))},
+        },
+        "GoldenHyperLogLog": {
+            "scalars": {},
+            "arrays": {"regs": (np.uint8, lambda s: (golden.HLL_M,))},
+        },
+        "GoldenCountMinSketch": {
+            "scalars": {"depth": (1, 64), "width": (1, 1 << 27)},
+            "arrays": {
+                "counts": (np.uint32, lambda s: (s["depth"], s["width"]))
+            },
+        },
+        "GoldenBitSet": {
+            "scalars": {},
+            "arrays": {"bits": (np.bool_, None)},  # any 1-D length ≤ cap
+        },
+    }
 
-        d = pickle.loads(data)
+    def restore(self, name, data: bytes, replace: bool = False) -> None:
+        import io
+        import json
+        import struct
+
+        from redisson_tpu.objects.durability import safe_load_npy
+
+        if len(data) < 8 or data[:4] != self._DUMP_MAGIC:
+            raise ValueError("not a host-sketch dump (bad magic)")
+        (hlen,) = struct.unpack("<I", data[4:8])
+        if hlen > 1 << 16:
+            raise ValueError("dump header too large")
+        d = json.loads(data[8 : 8 + hlen].decode("utf-8"))
+        if d.get("v") != 2:
+            raise ValueError(f"unsupported dump version: {d.get('v')}")
+        cls_name = d.get("model_cls")
+        schema = self._RESTORE_SCHEMAS.get(cls_name)
+        if schema is None:
+            raise ValueError(f"unknown model class {cls_name!r}")
+        cls = getattr(golden, cls_name)
+        scalars = d.get("scalars", {})
+        if set(scalars) != set(schema["scalars"]):
+            raise ValueError(f"dump scalar fields {sorted(scalars)} do not "
+                             f"match {cls_name}")
+        for k_, (lo, hi) in schema["scalars"].items():
+            v_ = int(scalars[k_])
+            if not lo <= v_ <= hi:
+                raise ValueError(f"dump field {k_}={v_} out of range")
+            scalars[k_] = v_
+        if list(d.get("arrays", [])) != list(schema["arrays"]):
+            raise ValueError(f"dump array fields {d.get('arrays')} do not "
+                             f"match {cls_name}")
+        model = object.__new__(cls)
+        for k_, v_ in scalars.items():
+            setattr(model, k_, v_)
+        buf = io.BytesIO(data[8 + hlen :])
+        for k_, (want_dtype, want_shape) in schema["arrays"].items():
+            arr = safe_load_npy(buf)
+            if arr.dtype != want_dtype:
+                raise ValueError(f"dump array {k_} has dtype {arr.dtype}")
+            if want_shape is not None and arr.shape != want_shape(scalars):
+                raise ValueError(f"dump array {k_} has shape {arr.shape}")
+            if want_shape is None and (arr.ndim != 1 or arr.size > 1 << 33):
+                raise ValueError(f"dump array {k_} has bad geometry")
+            setattr(model, k_, arr.copy())  # writable (frombuffer is RO)
         with self._lock:
             if self._live(name) is not None:
                 if not replace:
@@ -1329,7 +1429,7 @@ class HostSketchEngine:
             self._guard_foreign(name)
             self._objects[name] = {
                 "kind": d["kind"],
-                "model": d["model"],
+                "model": model,
                 "params": d["params"],
             }
 

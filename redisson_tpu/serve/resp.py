@@ -5,6 +5,7 @@ keyspace and sketch objects without the Python API.
 
 Command surface (the subset the north-star objects + grid need):
   PING ECHO  GET SET DEL EXISTS EXPIRE PEXPIRE TTL PTTL PERSIST
+  TYPE DUMP RESTORE                                 (data-only payloads)
   SETBIT GETBIT BITCOUNT BITPOS
   PFADD PFCOUNT PFMERGE
   BF.RESERVE BF.ADD BF.MADD BF.EXISTS BF.MEXISTS BF.INFO (RedisBloom shape)
@@ -14,7 +15,8 @@ Command surface (the subset the north-star objects + grid need):
   SADD SREM SISMEMBER SCARD SMEMBERS
   ZADD ZSCORE ZRANGE ZCARD ZREM
   INCR INCRBY DECR
-  PUBLISH SUBSCRIBE UNSUBSCRIBE                     (push replies)
+  PUBLISH SUBSCRIBE UNSUBSCRIBE           (push replies; '>' on RESP3)
+  HELLO                                   (RESP2/RESP3 negotiation)
   MULTI EXEC DISCARD                                (contiguous-exec txn)
   KEYS SCAN DBSIZE FLUSHALL
 
@@ -39,7 +41,16 @@ def _encode_simple(s: str) -> bytes:
     return b"+" + s.encode() + b"\r\n"
 
 
+# Error codes that travel verbatim as their own RESP code (Redis sends
+# '-BUSYKEY ...', not '-ERR BUSYKEY ...').  An explicit allowlist — a
+# shape heuristic would hijack messages that merely START with a command
+# name ('EXEC without MULTI' must stay '-ERR EXEC without MULTI').
+_ERROR_CODES = ("BUSYKEY", "NOPROTO", "WRONGTYPE", "NOSCRIPT")
+
+
 def _encode_error(s: str) -> bytes:
+    if s.split(" ", 1)[0] in _ERROR_CODES:
+        return b"-" + s.encode() + b"\r\n"
     return b"-ERR " + s.encode() + b"\r\n"
 
 
@@ -204,6 +215,8 @@ class _ConnCtx:
         self.in_multi = False
         self.queued: list = []  # commands queued since MULTI
         self.in_exec = False  # replaying an EXEC (blocking cmds don't block)
+        self.proto = 2  # RESP protocol version; HELLO 3 upgrades
+        self.client_name: Optional[str] = None
 
     def send(self, frame: bytes) -> None:
         with self.lock:
@@ -517,6 +530,106 @@ class RespServer:
         eng = getattr(self._client._engine, "clear_expire", None)
         return _encode_int(int(grid_ok or (eng is not None and eng(name))))
 
+    # keyspace type / dump / restore (→ RKeys#getType + RObject#dump/
+    # restore riding Redis TYPE / DUMP / RESTORE)
+
+    # Grid KIND -> the type name Redis reports.  Lock/semaphore/counter
+    # objects live in plain string keys upstream; geo is a zset.
+    _TYPE_NAMES = {
+        "bucket": "string", "binarystream": "string",
+        "atomiclong": "string", "atomicdouble": "string",
+        "longadder": "string", "doubleadder": "string",
+        "idgenerator": "string", "lock": "string", "spinlock": "string",
+        "fencedlock": "string", "fairlock": "string", "rwlock": "string",
+        "semaphore": "string", "xsemaphore": "string",
+        "countdownlatch": "string",
+        "list": "list", "queue": "list", "delayedqueue": "list",
+        "priorityqueue": "list", "ringbuffer": "list",
+        "map": "hash", "mapcache": "hash",
+        "listmultimap": "hash", "setmultimap": "hash",
+        "listmultimapcache": "hash", "setmultimapcache": "hash",
+        "set": "set", "setcache": "set",
+        "zset": "zset", "sortedset": "zset", "lexset": "zset",
+        "geo": "zset", "timeseries": "zset",
+        "stream": "stream",
+        # sketch kinds (RedisBloom reports module types; HLL/bitmaps are
+        # strings in Redis)
+        "bloom": "MBbloom--", "cms": "CMSk-TYPE",
+        "hll": "string", "bitset": "string",
+    }
+
+    def _kind_of(self, name: str) -> Optional[str]:
+        eng = self._client._engine
+        reg = getattr(eng, "registry", None)
+        if reg is not None:  # TPU engine
+            if eng.exists(name):
+                e = reg.lookup(name)
+                if e is not None:
+                    return e.kind
+        else:  # host golden engine
+            with eng._lock:
+                o = eng._live(name)
+                if o is not None:
+                    return o["kind"]
+        e = self._client._grid.get_entry(name)
+        return None if e is None else e.kind
+
+    def _cmd_TYPE(self, args):
+        kind = self._kind_of(self._s(args[0]))
+        if kind is None:
+            return _encode_simple("none")
+        return _encode_simple(self._TYPE_NAMES.get(kind, kind))
+
+    def _cmd_DUMP(self, args):
+        """Sketch objects dump their data-only wire blobs (durability
+        format); string keys a tagged raw-bytes payload.  Container grid
+        kinds are NOT dumpable over RESP: their Python dump() is
+        pickle-based, which must never meet an untrusted socket."""
+        name = self._s(args[0])
+        blob = self._client._engine.dump(name)
+        if blob is not None:
+            return _encode_bulk(blob)
+        e = self._client._grid.get_entry(name)
+        if e is None:
+            return _encode_bulk(None)
+        if e.kind == "bucket":
+            v = e.value
+            if isinstance(v, str):
+                v = v.encode()
+            return _encode_bulk(b"RTPS\x00" + v)
+        raise RespError(f"DUMP unsupported for type {e.kind} over RESP")
+
+    def _cmd_RESTORE(self, args):
+        name, ttl_ms, payload = self._s(args[0]), int(args[1]), args[2]
+        replace = any(a.upper() == b"REPLACE" for a in args[3:])
+        # BUSYKEY/REPLACE semantics span BOTH stores (one logical
+        # keyspace): Redis's RESTORE REPLACE deletes the old key whatever
+        # its type, so a sketch blob may replace a grid string and vice
+        # versa — the per-store foreign-key guards must see a free name.
+        if self._exists_any(name):
+            if not replace:
+                raise RespError("BUSYKEY Target key name already exists.")
+            self._client.get_keys().delete(name)
+        if payload.startswith(b"RTPS\x00"):
+            from redisson_tpu.grid.buckets import Bucket
+
+            self._raw(Bucket(name, self._client)).set(payload[5:])
+        else:
+            try:
+                self._client._engine.restore(name, payload)
+            except ValueError as e:
+                if "BUSYKEY" in str(e):  # raced with a concurrent creator
+                    raise RespError("BUSYKEY Target key name already exists.")
+                raise
+        if ttl_ms > 0:
+            self._client.get_keys().expire(name, ttl_ms / 1000.0)
+        return _encode_simple("OK")
+
+    def _exists_any(self, name: str) -> bool:
+        return self._client._grid.exists(name) or self._client._engine.exists(
+            name
+        )
+
     # bitmaps -> BitSet
 
     def _cmd_SETBIT(self, args):
@@ -802,7 +915,66 @@ class RespServer:
         z = self._zset(args[0])
         return _encode_int(sum(int(z.remove(m)) for m in args[1:]))
 
-    # pub/sub (push replies — the SUBSCRIBE protocol shape)
+    # protocol negotiation (→ RESP3's HELLO; the reference speaks
+    # RESP2/RESP3 through Netty — SURVEY.md §2.4 comm row)
+
+    def _cmdctx_HELLO(self, args, ctx: _ConnCtx):
+        # Validate EVERYTHING before mutating ctx: a failed HELLO must
+        # leave the connection on its current protocol (a half-applied
+        # upgrade would desync the client — real Redis switches only on
+        # success).
+        ver = ctx.proto
+        name = ctx.client_name
+        i = 0
+        if args and args[0].isdigit():
+            ver = int(args[0])
+            if ver not in (2, 3):
+                raise RespError(
+                    "NOPROTO unsupported protocol version"
+                )
+            i = 1
+        while i < len(args):
+            opt = args[i].decode().upper()
+            if opt == "AUTH":
+                raise RespError(
+                    "Client sent AUTH, but no password is set."
+                )
+            if opt == "SETNAME":
+                name = self._s(args[i + 1])
+                i += 2
+                continue
+            raise RespError(f"unsupported HELLO option {opt}")
+        ctx.proto = ver
+        ctx.client_name = name
+        pairs = [
+            (b"server", b"redisson-tpu"),
+            (b"version", b"4.0.0"),
+            (b"proto", ctx.proto),
+            (b"id", 1),
+            (b"mode", b"standalone"),
+            (b"role", b"master"),
+            (b"modules", []),
+        ]
+        if ctx.proto == 3:
+            out = b"%" + str(len(pairs)).encode() + b"\r\n"
+        else:
+            out = b"*" + str(len(pairs) * 2).encode() + b"\r\n"
+        for k, v in pairs:
+            out += _encode_bulk(k)
+            if isinstance(v, int):
+                out += _encode_int(v)
+            elif isinstance(v, list):
+                out += _encode_array(v)
+            else:
+                out += _encode_bulk(v)
+        return out
+
+    # pub/sub (push replies — the SUBSCRIBE protocol shape; RESP3
+    # connections get true push frames '>')
+
+    @staticmethod
+    def _push_hdr(ctx: _ConnCtx) -> bytes:
+        return b">3\r\n" if ctx.proto == 3 else b"*3\r\n"
 
     def _cmd_PUBLISH(self, args):
         n = self._client._topic_bus.publish(self._s(args[0]), args[1])
@@ -817,7 +989,7 @@ class RespServer:
             # Ack FIRST, then register: a concurrent PUBLISH must not push
             # its 'message' frame ahead of this channel's 'subscribe' ack.
             ctx.send(
-                b"*3\r\n"
+                self._push_hdr(ctx)
                 + _encode_bulk(b"subscribe")
                 + _encode_bulk(raw)
                 + _encode_int(len(ctx.subs) + (0 if already else 1))
@@ -832,7 +1004,7 @@ class RespServer:
                     else str(message).encode()
                 )
                 ctx.send(
-                    b"*3\r\n"
+                    self._push_hdr(ctx)
                     + _encode_bulk(b"message")
                     + _encode_bulk(_name)
                     + _encode_bulk(payload)
@@ -849,7 +1021,7 @@ class RespServer:
             # Redis replies even when nothing was subscribed — an empty
             # reply would wedge the client waiting forever.
             return (
-                b"*3\r\n"
+                self._push_hdr(ctx)
                 + _encode_bulk(b"unsubscribe")
                 + _encode_bulk(None)
                 + _encode_int(0)
@@ -860,7 +1032,7 @@ class RespServer:
             if lid is not None:
                 self._client._topic_bus.unsubscribe(channel, lid)
             out += (
-                b"*3\r\n"
+                self._push_hdr(ctx)
                 + _encode_bulk(b"unsubscribe")
                 + _encode_bulk(channel.encode())
                 + _encode_int(len(ctx.subs))

@@ -112,6 +112,19 @@ class TestDumpRestore:
         with pytest.raises(RuntimeError):
             bf.dump()
 
+    def test_dump_wire_format_is_data_only(self, client):
+        """ADVICE r3: dump blobs may cross trust boundaries — neither
+        engine may emit (or accept) pickle."""
+        import pickle
+
+        c = client.get_count_min_sketch("dump-cms")
+        c.try_init(4, 1 << 10)
+        c.add(7)
+        blob = c.dump()
+        assert blob[:4] in (b"RTPU", b"RTPH")  # tpu / host magics
+        with pytest.raises(Exception):
+            pickle.loads(blob)  # not a pickle stream
+
 
 class TestSnapshot:
     def test_kill_and_restore_round_trips(self, tmp_path):
@@ -335,3 +348,55 @@ class TestResharding:
                 c2._engine.restore_snapshot(str(tmp_path))
         finally:
             c2.shutdown()
+
+
+class TestForgedDumps:
+    """Dump payloads cross trust boundaries (RESP RESTORE): forged
+    headers must be rejected BEFORE allocation or object creation."""
+
+    def test_forged_giant_npy_shape_rejected(self, client):
+        import io
+        import struct
+
+        c = client.get_bloom_filter("forge-src")
+        c.try_init(1000, 0.01)
+        blob = bytearray(c.dump())
+        # Both wire formats embed a .npy header; forge its shape field to
+        # declare ~1TB and confirm the loader refuses to allocate.
+        i = blob.find(b"'shape': (")
+        assert i > 0
+        j = blob.index(b")", i)
+        forged = bytes(blob[:i]) + b"'shape': (1099511627776,)" + bytes(blob[j + 1:])
+        with pytest.raises(ValueError, match="declares|descr|header"):
+            client._engine.restore("forge-dst", forged)
+
+    def test_host_restore_rejects_mismatched_fields(self):
+        import io
+        import json
+        import struct
+
+        c = make_client(host=True)
+        try:
+            hdr = json.dumps({
+                "v": 2, "kind": "bloom", "params": {},
+                "model_cls": "GoldenBloomFilter",
+                "scalars": {"size": 100, "hash_iterations": 3},
+                "arrays": ["bits"],
+            }).encode()
+            buf = io.BytesIO()
+            np.save(buf, np.zeros(7, bool), allow_pickle=False)  # wrong len
+            blob = b"RTPH" + struct.pack("<I", len(hdr)) + hdr + buf.getvalue()
+            with pytest.raises(ValueError, match="shape"):
+                c._engine.restore("mism", blob)
+            # Unknown scalar fields rejected too.
+            hdr2 = json.dumps({
+                "v": 2, "kind": "bloom", "params": {},
+                "model_cls": "GoldenBloomFilter",
+                "scalars": {"size": 100, "hash_iterations": 3, "evil": 1},
+                "arrays": ["bits"],
+            }).encode()
+            blob2 = b"RTPH" + struct.pack("<I", len(hdr2)) + hdr2 + buf.getvalue()
+            with pytest.raises(ValueError, match="do not match"):
+                c._engine.restore("mism2", blob2)
+        finally:
+            c.shutdown()

@@ -1,0 +1,87 @@
+"""FunctionService — the RFunction analog (org/redisson/api/RFunction.java,
+upstream ≥3.17: FUNCTION LOAD/LIST/DELETE/FLUSH + FCALL/FCALL_RO)."""
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+
+
+@pytest.fixture
+def client():
+    c = redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
+    yield c
+    c.shutdown()
+
+
+def _counter_lib():
+    def incr_twice(client, keys, args):
+        a = client.get_atomic_long(keys[0])
+        a.add_and_get(int(args[0]))
+        return a.add_and_get(int(args[0]))
+
+    def peek(client, keys, args):
+        return client.get_atomic_long(keys[0]).get()
+
+    return {"incr_twice": incr_twice, "peek": peek}
+
+
+def test_load_and_fcall(client):
+    f = client.get_function()
+    f.load("counters", _counter_lib(), no_writes=("peek",))
+    assert f.call("incr_twice", ["c"], [5]) == 10
+    assert f.call("peek", ["c"]) == 10
+    # Atomicity: runs under the grid lock like a script.
+    assert client.get_atomic_long("c").get() == 10
+
+
+def test_fcall_ro_contract(client):
+    f = client.get_function()
+    f.load("counters", _counter_lib(), no_writes=("peek",))
+    assert f.call_ro("peek", ["c"]) == 0
+    with pytest.raises(ValueError, match="fcall_ro"):
+        f.call_ro("incr_twice", ["c"], [1])
+
+
+def test_unknown_function(client):
+    f = client.get_function()
+    with pytest.raises(KeyError):
+        f.call("nope")
+
+
+def test_library_replace_and_global_names(client):
+    f = client.get_function()
+    f.load("libA", {"fn1": lambda c, k, a: 1})
+    with pytest.raises(ValueError, match="already exists"):
+        f.load("libA", {"fn1": lambda c, k, a: 2})
+    # Global function-name namespace across libraries (the Redis rule).
+    with pytest.raises(ValueError, match="already registered"):
+        f.load("libB", {"fn1": lambda c, k, a: 3})
+    f.load("libA", {"fn2": lambda c, k, a: 4}, replace=True)
+    assert f.call("fn2") == 4
+    with pytest.raises(KeyError):
+        f.call("fn1")  # replaced out of the library
+
+
+def test_list_delete_flush(client):
+    f = client.get_function()
+    f.load("alpha", {"a1": lambda c, k, a: 0}, no_writes=("a1",))
+    f.load("beta", {"b1": lambda c, k, a: 0})
+    libs = {d["library_name"]: d for d in f.list()}
+    assert set(libs) == {"alpha", "beta"}
+    assert libs["alpha"]["functions"][0]["flags"] == ["no-writes"]
+    assert [d["library_name"] for d in f.list("al*")] == ["alpha"]
+    f.delete("alpha")
+    with pytest.raises(KeyError):
+        f.call("a1")
+    f.flush()
+    assert f.list() == []
+    # get_function returns the shared instance (FCALL sees prior loads).
+    f.load("gamma", {"g": lambda c, k, a: 7})
+    assert client.get_function().call("g") == 7
+
+
+def test_camel_aliases(client):
+    f = client.get_function()
+    f.load("lib", {"x": lambda c, k, a: 42}, no_writes=("x",))
+    assert f.callRo("x") == 42  # CamelCompatMixin surface

@@ -428,3 +428,135 @@ class TestRespReviewFixesR4:
         assert resp.cmd("CMS.MERGE", "mk-dst", "1", "mk-src") == "OK"
         assert resp.cmd("CMS.QUERY", "mk-dst", "hot") == [9]
         assert resp.cmd("CMS.QUERY", "mk-dst", "stale") == [0]  # overwritten
+
+
+class TestTypeDumpRestore:
+    def test_type_reports_redis_names(self, resp):
+        assert resp.cmd("TYPE", "absent") == "none"
+        resp.cmd("SET", "ts", "v")
+        assert resp.cmd("TYPE", "ts") == "string"
+        resp.cmd("RPUSH", "tl", "a")
+        assert resp.cmd("TYPE", "tl") == "list"
+        resp.cmd("HSET", "th", "f", "v")
+        assert resp.cmd("TYPE", "th") == "hash"
+        resp.cmd("SADD", "tset", "m")
+        assert resp.cmd("TYPE", "tset") == "set"
+        resp.cmd("ZADD", "tz", "1", "m")
+        assert resp.cmd("TYPE", "tz") == "zset"
+        resp.cmd("PFADD", "thll", "x")
+        assert resp.cmd("TYPE", "thll") == "string"  # HLL is a string key
+        resp.cmd("SETBIT", "tbits", "5", "1")
+        assert resp.cmd("TYPE", "tbits") == "string"  # bitmaps too
+        resp.cmd("BF.RESERVE", "tbf", "0.01", "1000")
+        assert resp.cmd("TYPE", "tbf") == "MBbloom--"  # RedisBloom module type
+        resp.cmd("CMS.INITBYDIM", "tcms", "1024", "4")
+        assert resp.cmd("TYPE", "tcms") == "CMSk-TYPE"
+
+    def test_dump_restore_string(self, resp):
+        resp.cmd("SET", "dsrc", b"payload-\x00\xff")
+        blob = resp.cmd("DUMP", "dsrc")
+        assert blob is not None
+        assert resp.cmd("RESTORE", "ddst", "0", blob) == "OK"
+        assert resp.cmd("GET", "ddst") == b"payload-\x00\xff"
+        # BUSYKEY without REPLACE; REPLACE overwrites.
+        with pytest.raises(RuntimeError, match="BUSYKEY"):
+            resp.cmd("RESTORE", "ddst", "0", blob)
+        assert resp.cmd("RESTORE", "ddst", "0", blob, "REPLACE") == "OK"
+
+    def test_dump_restore_bloom_round_trip(self, resp):
+        resp.cmd("BF.RESERVE", "dbf", "0.01", "10000")
+        resp.cmd("BF.MADD", "dbf", "a", "b", "c")
+        blob = resp.cmd("DUMP", "dbf")
+        assert blob is not None
+        assert resp.cmd("RESTORE", "dbf2", "0", blob) == "OK"
+        assert resp.cmd("BF.MEXISTS", "dbf2", "a", "b", "c", "zz") == [1, 1, 1, 0]
+
+    def test_dump_restore_with_ttl(self, resp):
+        resp.cmd("SET", "dttl", "v")
+        blob = resp.cmd("DUMP", "dttl")
+        assert resp.cmd("RESTORE", "dttl2", "60000", blob) == "OK"
+        ttl = resp.cmd("TTL", "dttl2")
+        assert 50 <= ttl <= 60
+
+    def test_dump_absent_and_container_unsupported(self, resp):
+        assert resp.cmd("DUMP", "never-existed") is None
+        resp.cmd("RPUSH", "dlist", "x")
+        with pytest.raises(RuntimeError, match="unsupported"):
+            resp.cmd("DUMP", "dlist")
+
+
+class TestHelloResp3:
+    def test_hello_default_resp2_map_as_flat_array(self, resp):
+        out = resp.cmd("HELLO")
+        assert isinstance(out, list)
+        d = {out[i]: out[i + 1] for i in range(0, len(out), 2)}
+        assert d[b"server"] == b"redisson-tpu"
+        assert d[b"proto"] == 2
+
+    def test_hello_3_upgrades_and_pushes(self, resp):
+        # Raw-socket check: HELLO 3 replies with a RESP3 map (%N) and
+        # subsequent subscribe/message frames use push type '>'.
+        sock = resp._sock
+        resp.cmd("SET", "h3-warm", "x")  # ensure connection healthy
+        sock.sendall(b"*2\r\n$5\r\nHELLO\r\n$1\r\n3\r\n")
+        import time
+
+        time.sleep(0.2)
+        data = sock.recv(65536)
+        assert data.startswith(b"%7\r\n"), data[:20]
+        sock.sendall(b"*2\r\n$9\r\nSUBSCRIBE\r\n$3\r\nch3\r\n")
+        time.sleep(0.2)
+        data = sock.recv(65536)
+        assert data.startswith(b">3\r\n"), data[:20]
+
+    def test_hello_bad_version(self, resp):
+        with pytest.raises(RuntimeError, match="NOPROTO"):
+            resp.cmd("HELLO", "4")
+
+    def test_hello_setname_and_auth(self, resp):
+        out = resp.cmd("HELLO", "2", "SETNAME", "tester")
+        assert isinstance(out, list)
+        with pytest.raises(RuntimeError, match="no password"):
+            resp.cmd("HELLO", "2", "AUTH", "u", "p")
+
+    def test_restore_replace_across_stores(self, resp):
+        # Redis RESTORE REPLACE deletes the old key whatever its type:
+        # a sketch blob may replace a grid string, and vice versa.
+        resp.cmd("BF.RESERVE", "xbf", "0.01", "1000")
+        resp.cmd("BF.ADD", "xbf", "k")
+        blob = resp.cmd("DUMP", "xbf")
+        resp.cmd("SET", "xs", "plain")
+        with pytest.raises(RuntimeError, match="BUSYKEY"):
+            resp.cmd("RESTORE", "xs", "0", blob)
+        assert resp.cmd("RESTORE", "xs", "0", blob, "REPLACE") == "OK"
+        assert resp.cmd("TYPE", "xs") == "MBbloom--"
+        # ...and back: a string payload replaces the sketch.
+        sblob = b"RTPS\x00back"
+        assert resp.cmd("RESTORE", "xs", "0", sblob, "REPLACE") == "OK"
+        assert resp.cmd("GET", "xs") == b"back"
+
+    def test_failed_hello3_keeps_resp2(self, resp):
+        # HELLO 3 with a rejected option must NOT half-upgrade the
+        # connection: subsequent pushes stay RESP2 arrays.
+        with pytest.raises(RuntimeError, match="no password"):
+            resp.cmd("HELLO", "3", "AUTH", "u", "p")
+        sock = resp._sock
+        sock.sendall(b"*2\r\n$9\r\nSUBSCRIBE\r\n$3\r\nchx\r\n")
+        import time
+
+        time.sleep(0.2)
+        data = sock.recv(65536)
+        assert data.startswith(b"*3\r\n"), data[:20]
+
+    def test_error_codes(self, resp):
+        # Own-code errors travel verbatim; generic ones keep ERR.
+        try:
+            resp.cmd("EXEC")
+        except RuntimeError as e:
+            assert str(e).startswith("ERR EXEC without MULTI")
+        resp.cmd("SET", "ec-bk", "v")
+        blob = resp.cmd("DUMP", "ec-bk")
+        try:
+            resp.cmd("RESTORE", "ec-bk", "0", blob)
+        except RuntimeError as e:
+            assert str(e).startswith("BUSYKEY"), e
