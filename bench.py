@@ -47,8 +47,12 @@ def bench_bloom_contains(client):
             for _ in range(iters)
         ]
         t0 = time.perf_counter()
-        results = [bf.contains_all_async(b) for b in batches]
-        n_hits = sum(int(np.sum(r.result())) for r in results)
+        # Pipelined bulk form (the RBatch idiom): all launches dispatch,
+        # results come home in one device-concat mailbox fetch — each
+        # host fetch on this tunnel costs a full round trip, so one
+        # reply flush per pass instead of per batch (PROFILE.md lever 2).
+        results = bf.contains_many(batches)
+        n_hits = sum(int(np.sum(r)) for r in results)
         dt = time.perf_counter() - t0
         assert 0.3 < n_hits / (iters * B) < 0.7, n_hits
         return iters * B / dt
@@ -363,6 +367,46 @@ def bench_full_geometry(make_client):
     return out
 
 
+def measure_device_kernel():
+    """Engine attribution metric: the hot kernel timed with DEVICE-RESIDENT
+    inputs (no H2D, no host round trip per iteration) — what the chip
+    itself sustains.  The gap between this and the headline is, by
+    construction, the link (PROFILE.md: 20-50 µs kernels vs 10-330 ms
+    launch retirement on the tunnel)."""
+    import jax
+    import jax.numpy as jnp
+
+    from redisson_tpu.ops import bitops, bloom as bloom_ops
+
+    B = 1 << 20
+    m = 9_585_059  # config-1 geometry (1M keys @ 1% fpp)
+    k = 7
+    wpr = -(-m // 32)
+    rng = np.random.default_rng(5)
+    state = jax.device_put(jnp.zeros((wpr + 1,), jnp.uint32))
+    rows = jax.device_put(jnp.zeros((B,), jnp.int32))
+    h1 = jax.device_put(jnp.asarray(rng.integers(0, 1 << 32, B, dtype=np.uint64).astype(np.uint32)))
+    h2 = jax.device_put(jnp.asarray(rng.integers(0, 1 << 32, B, dtype=np.uint64).astype(np.uint32)))
+
+    @jax.jit
+    def step(state, rows, h1, h2):
+        return bitops.pack_bool_u32(
+            bloom_ops.bloom_contains(
+                state, rows, h1, h2, m=m, k=k, words_per_row=wpr
+            )
+        )
+
+    step(state, rows, h1, h2).block_until_ready()  # compile
+    iters = 30
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = step(state, rows, h1, h2)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return round(iters * B / dt)
+
+
 def measure_link_calibration():
     """Raw transport capability AT BENCH TIME, reported alongside the
     engine numbers so a BENCH_rN drop is attributable from the JSON alone
@@ -447,6 +491,7 @@ def main():
     # Bulk single-tenant path: device-side hashing, no cross-call coalescing
     # (that serves the mixed multi-tenant QPS config below).
     link = measure_link_calibration()
+    link["device_kernel_contains_ops_per_sec"] = measure_device_kernel()
     client = make_client(exact_add_semantics=False, coalesce=False)
     contains_ops, fpp, headline_passes, headline_B = bench_bloom_contains(client)
     hll_ops = bench_hll_pfadd(client)

@@ -46,6 +46,11 @@ class TpuSketchConfig:
         # retirements are fast.
         self.adaptive_inflight = True
         self.min_inflight = 2
+        # Device-side result mailbox: the completer concatenates pending
+        # launches' packed results on device and fetches them in ONE D2H
+        # (PROFILE.md remaining-lever 2) — each host fetch costs a full
+        # link round trip regardless of size.
+        self.mailbox_collect = True
         # Tenancy.
         self.initial_tenants_per_class = 8  # initial rows per size-class pool
         # Exact intra-batch sequential semantics for bloom add (sort-based

@@ -107,7 +107,8 @@ class BatchCoalescer:
     def __init__(self, *, batch_window_us: int, max_batch: int, metrics=None,
                  max_inflight: int = 8, retry_attempts: int = 3,
                  retry_interval_s: float = 0.05, max_queued_ops: int = 0,
-                 adaptive_inflight: bool = True, min_inflight: int = 2):
+                 adaptive_inflight: bool = True, min_inflight: int = 2,
+                 group_collect: Optional[Callable] = None):
         self.window_s = batch_window_us / 1e6
         self.max_batch = max_batch
         self.metrics = metrics
@@ -155,6 +156,12 @@ class BatchCoalescer:
         self._admit = threading.Condition(self._lock)
         self._inflight = 0  # popped but not yet dispatched
         self._closed = False
+        # Device-side result mailbox (executor.collect_group): when the
+        # completer finds several launches pending, their packed results
+        # concatenate on device and come home in ONE D2H instead of one
+        # fetch per launch — each host fetch costs a full link round trip
+        # on the tunnel, whatever its size.
+        self._group_collect = group_collect
         # Dispatch and completion are decoupled: the flush thread only
         # enqueues device work (cheap), while this thread blocks on result
         # transfers and resolves futures.  Without it every segment's D2H
@@ -391,42 +398,68 @@ class BatchCoalescer:
                     )
 
     def _complete_loop(self) -> None:
-        while True:
+        stop = False
+        while not stop:
             item = self._completions.get()
             if item is None:
                 return
-            seg, lazy, t0 = item
-            # A backlogged completions queue means this launch retired
-            # while we were blocked on an earlier one — its collect time
-            # is not a genuine link-health sample (see _release_launch_slot).
-            genuine = self._completions.qsize() == 0
-            try:
-                t_collect = time.monotonic()
-                res = lazy.result() if lazy is not None else None
-                self._release_launch_slot(
-                    time.monotonic() - t_collect, genuine=genuine
-                )
-                for fut, start, n in seg.futures:
-                    if fut.set_running_or_notify_cancel():
-                        fut.set_result(
-                            None if res is None else res[start : start + n]
-                        )
-            except Exception as e:
-                # Completion-time failure: the device batch died after
-                # donation — NOT retryable; attribute each caller's op
-                # range within the failed launch (partial-batch surface).
-                self._release_launch_slot(None)
-                for fut, start, n in seg.futures:
-                    if fut.set_running_or_notify_cancel():
-                        fut.set_exception(
-                            KernelExecutionError(seg.key, start, n, seg.nops, e)
-                        )
-            if self.metrics is not None:
-                self.metrics.record_batch(
-                    nops=seg.nops,
-                    wait_s=t0 - seg.born,
-                    flush_s=time.monotonic() - t0,
-                )
+            # Mailbox drain: scoop everything already queued behind this
+            # completion so the whole group comes home in one D2H
+            # (collect_group).  A backlog here means those launches
+            # retired while we were busy — their individual collect times
+            # are not genuine link samples either way.
+            group = [item]
+            while self._group_collect is not None and len(group) < 8:
+                try:
+                    nxt = self._completions.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    stop = True
+                    break
+                group.append(nxt)
+            genuine = len(group) == 1 and self._completions.qsize() == 0
+            t_collect = time.monotonic()
+            if len(group) > 1:
+                try:
+                    self._group_collect(
+                        [lazy for _, lazy, _ in group if lazy is not None]
+                    )
+                except Exception:
+                    pass  # per-item .result() below surfaces the failure
+            first = True
+            for seg, lazy, t0 in group:
+                try:
+                    res = lazy.result() if lazy is not None else None
+                    self._release_launch_slot(
+                        time.monotonic() - t_collect if first else None,
+                        genuine=genuine,
+                    )
+                    first = False
+                    for fut, start, n in seg.futures:
+                        if fut.set_running_or_notify_cancel():
+                            fut.set_result(
+                                None if res is None else res[start : start + n]
+                            )
+                except Exception as e:
+                    # Completion-time failure: the device batch died after
+                    # donation — NOT retryable; attribute each caller's op
+                    # range within the failed launch (partial-batch surface).
+                    self._release_launch_slot(None)
+                    first = False
+                    for fut, start, n in seg.futures:
+                        if fut.set_running_or_notify_cancel():
+                            fut.set_exception(
+                                KernelExecutionError(
+                                    seg.key, start, n, seg.nops, e
+                                )
+                            )
+                if self.metrics is not None:
+                    self.metrics.record_batch(
+                        nops=seg.nops,
+                        wait_s=t0 - seg.born,
+                        flush_s=time.monotonic() - t0,
+                    )
 
     def drain(self, timeout: float = 30.0) -> None:
         """Barrier: block until every segment submitted BEFORE this call has

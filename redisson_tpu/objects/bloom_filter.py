@@ -102,6 +102,21 @@ class BloomFilter(RObject):
 
     contains_async = contains_all_async
 
+    def contains_many(self, batches) -> list:
+        """Pipelined bulk membership: dispatch EVERY batch, then collect
+        all results in one reply flush — the RBatch idiom (a Redisson
+        batch of containsAsync calls executes as one pipeline with one
+        reply read, → org/redisson/command/CommandBatchService.java,
+        SURVEY.md §3.4).  On the TPU engine the flush is the device-side
+        result mailbox: G packed result arrays concatenate on device and
+        come home in ONE D2H (each host fetch costs a full link round
+        trip).  Returns one bool array per input batch."""
+        lazies = [self.contains_all_async(b) for b in batches]
+        collect = getattr(self._engine, "collect_results", None)
+        if collect is not None:  # host engine: results are immediate
+            collect(lazies)
+        return [l.result() for l in lazies]
+
     # -- read replication (SURVEY §2.4 replication row) ---------------------
 
     def set_replicated(self) -> bool:

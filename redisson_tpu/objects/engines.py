@@ -196,6 +196,11 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 max_queued_ops=config.tpu_sketch.max_queued_ops,
                 adaptive_inflight=config.tpu_sketch.adaptive_inflight,
                 min_inflight=config.tpu_sketch.min_inflight,
+                group_collect=(
+                    self.executor.collect_group
+                    if config.tpu_sketch.mailbox_collect
+                    else None
+                ),
             )
         # Checkpoint/resume (SURVEY.md §5): restore device state from the
         # configured snapshot dir, then arm periodic snapshots.
@@ -709,6 +714,18 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 )
                 return res
         return self.bloom_add(name, *hashing.hash128_np(blocks, lengths))
+
+    def collect_results(self, lazies) -> None:
+        """Engine-level mailbox collect (policy gate for the bulk APIs):
+        honors ``mailbox_collect`` and never raises — a failed group
+        fetch degrades to per-item ``.result()``, which recovers or
+        attributes each launch individually."""
+        if not self.config.tpu_sketch.mailbox_collect:
+            return
+        try:
+            self.executor.collect_group(lazies)
+        except Exception:
+            pass
 
     def bloom_contains_encoded(self, name, blocks, lengths) -> LazyResult:
         if self.executor.supports_device_hash:
