@@ -433,6 +433,21 @@ class RedissonTpuClient(CamelCompatMixin):
         """→ RedissonClient#getKeys."""
         return Keys(self)
 
+    def collect(self, futures) -> list:
+        """Resolve a group of issued async results with ONE reply flush —
+        the RBatch#execute collection semantics applied to already-
+        dispatched calls (→ org/redisson/command/CommandBatchService.java
+        one-round-trip reply read).  On the TPU engine the flush is the
+        device-side result mailbox (executor.collect_group): each host
+        fetch costs a full link round trip, so G results come home in
+        one.  Works with any mix of sketch async results; degrades to
+        per-item resolution for host-engine/grid futures."""
+        futures = list(futures)
+        collect = getattr(self._engine, "collect_results", None)
+        if collect is not None:
+            collect(futures)
+        return [f.result() for f in futures]
+
     # -- admin -------------------------------------------------------------
 
     def get_sketch_names(self, kind=None) -> list[str]:

@@ -224,6 +224,15 @@ class TpuCommandExecutor:
         G times."""
         by_dtype: dict = {}
         for l in lazies:
+            # Unwrap MappedFuture-style adapters (objects/base.py): the
+            # underlying LazyResult carries the device value; the
+            # wrapper's transform runs at ITS .result() as usual.
+            seen = 0
+            while l is not None and not hasattr(l, "_value") and hasattr(l, "_fut"):
+                l = l._fut
+                seen += 1
+                if seen > 4:  # defensive: no adapter nests this deep
+                    break
             if (
                 l is not None
                 and getattr(l, "_done", 1) is None

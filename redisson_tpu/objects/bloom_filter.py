@@ -111,11 +111,9 @@ class BloomFilter(RObject):
         result mailbox: G packed result arrays concatenate on device and
         come home in ONE D2H (each host fetch costs a full link round
         trip).  Returns one bool array per input batch."""
-        lazies = [self.contains_all_async(b) for b in batches]
-        collect = getattr(self._engine, "collect_results", None)
-        if collect is not None:  # host engine: results are immediate
-            collect(lazies)
-        return [l.result() for l in lazies]
+        return self._client.collect(
+            [self.contains_all_async(b) for b in batches]
+        )
 
     # -- read replication (SURVEY §2.4 replication row) ---------------------
 

@@ -69,8 +69,11 @@ class TestDispatchRetry:
 class TestCompletionFailure:
     def test_per_op_attribution(self):
         """A segment holding two submissions fails at completion: each
-        caller's error names ITS op range within the launch."""
-        c = make_coalescer()
+        caller's error names ITS op range within the launch.  A wide
+        window: both submits MUST share one segment even if the host is
+        loaded (100 us windows flush between adjacent lines under
+        contention — observed flake with a concurrent bench process)."""
+        c = make_coalescer(batch_window_us=50_000)
 
         def dispatch(cols):
             return _Lazy(error=RuntimeError("device died"))
