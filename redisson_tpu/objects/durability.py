@@ -193,6 +193,10 @@ class SketchDurabilityMixin:
                 "kind": entry.kind,
                 "class_key": list(entry.pool.spec.class_key),
                 "params": dict(entry.params),
+                # CMS: the heavy-hitter candidate table travels with the
+                # counters (a restore that kept counts but forgot which
+                # keys were heavy would return an empty top_k()).
+                "topk": self.topk.export_state(name),
             }
         ).encode("utf-8")
         buf = io.BytesIO()
@@ -212,6 +216,9 @@ class SketchDurabilityMixin:
         d["row"] = safe_load_npy(io.BytesIO(data[8 + hlen :]))
         if d.get("v") != _DUMP_VERSION:
             raise ValueError(f"unsupported dump version: {d.get('v')}")
+        # Validate the untrusted candidate table BEFORE any mutation — a
+        # malformed blob must not leave a half-restored object behind.
+        topk_decoded = type(self.topk).decode_state(d.get("topk"), name)
         if self._live_lookup(name) is not None:
             if not replace:
                 raise ValueError(f"BUSYKEY: {name!r} already exists")
@@ -229,6 +236,9 @@ class SketchDurabilityMixin:
                 f"{entry.pool.row_units}"
             )
         self.executor.write_row(entry.pool, entry.row, row)
+        # Unconditional: also CLEARS any ghost table when the dump
+        # carries no candidates.
+        self.topk.import_decoded(topk_decoded, name)
 
     # -- Snapshots (client-side RDB analog) --------------------------------
 
@@ -274,6 +284,10 @@ class SketchDurabilityMixin:
             "version": _DUMP_VERSION,
             "pools": pool_meta,
             "tenants": tenants,
+            # Heavy-hitter candidate tables (engine-shared TopKStore):
+            # without them a restore keeps every CMS counter but forgets
+            # which keys were heavy — top_k() would come back empty.
+            "topk": self.topk.export_state(),
             # Topology stamp: restores onto a DIFFERENT shard count remap
             # row-by-row (the explicit device-array remap that stands in
             # for cluster resharding, SURVEY §2.4).
@@ -305,6 +319,8 @@ class SketchDurabilityMixin:
             return False
         with open(meta_path) as f:
             meta = json.load(f)
+        # Validate candidate tables before any mutation (see restore()).
+        topk_decoded = type(self.topk).decode_state(meta.get("topk"))
         data = np.load(pools_path)
         s_new = getattr(self.executor, "S", 1)
         new_thresh = getattr(self.config.tpu_sketch, "mbit_threshold_words", 0)
@@ -408,6 +424,7 @@ class SketchDurabilityMixin:
                     )
                 if t.get("expire_at") is not None:
                     self._ensure_sweeper()
+        self.topk.import_decoded(topk_decoded)
         return True
 
     # -- Online reshard (SURVEY §2.4 cluster row) --------------------------

@@ -101,6 +101,106 @@ class TopKStore:
             if t is not None:
                 self._tables[new] = t
 
+    # -- durability (data-only: snapshots + CMS dump blobs carry the
+    # candidate tables — losing them on restore would forget every heavy
+    # hitter even though the counters survive) ----------------------------
+
+    # Candidate keys must round-trip with their ORIGINAL scalar type —
+    # the codec encodes np.uint64(5) and 5 to different bytes (see
+    # count_min_sketch.py offer note), so a type-collapsing export would
+    # make restored top_k() re-estimate the wrong cells.
+    _KEY_TAGS = {
+        int: ("i", int),
+        np.uint64: ("u8", int),
+        np.uint32: ("u4", int),
+        np.int64: ("i8", int),
+        np.int32: ("i4", int),
+        str: ("s", str),
+    }
+    _TAG_DECODE = {
+        "i": int,
+        "u8": np.uint64,
+        "u4": np.uint32,
+        "i8": np.int64,
+        "i4": np.int32,
+        "s": str,
+        "b": bytes.fromhex,
+    }
+    MAX_K = 1 << 20  # prune-cap sanity bound for imported tables
+
+    @classmethod
+    def _encode_cands(cls, name: str, t: dict) -> dict:
+        cands = []
+        skipped = set()
+        for k_, v_ in t["cands"].items():
+            enc = cls._KEY_TAGS.get(type(k_))
+            if enc is not None:
+                cands.append([enc[0], enc[1](k_), int(v_)])
+            elif isinstance(k_, bytes):
+                cands.append(["b", k_.hex(), int(v_)])
+            else:
+                skipped.add(type(k_).__name__)
+        if skipped:
+            import warnings
+
+            warnings.warn(
+                f"top-K candidates of {name!r} with non-serializable key "
+                f"types {sorted(skipped)} were not exported; they will "
+                f"re-enter the table from future traffic"
+            )
+        return {"k": int(t["k"]), "cands": cands}
+
+    @classmethod
+    def _decode_cands(cls, d: dict) -> dict:
+        """Strict decode of an UNTRUSTED table blob: unknown tags or
+        malformed values raise ValueError (callers validate BEFORE any
+        state mutation); k is clamped to the prune-cap sanity bound."""
+        cands = {}
+        for entry in d.get("cands", []):
+            if not isinstance(entry, (list, tuple)) or len(entry) != 3:
+                raise ValueError(f"bad topk entry: {entry!r}")
+            tag, val, est = entry
+            dec = cls._TAG_DECODE.get(tag)
+            if dec is None:
+                raise ValueError(f"bad topk key tag: {tag!r}")
+            cands[dec(val)] = int(est)
+        k = int(d.get("k", 0))
+        if not 0 <= k <= cls.MAX_K:
+            raise ValueError(f"topk k={k} out of range")
+        return {"k": k, "cands": cands}
+
+    def export_state(self, name: Optional[str] = None):
+        """JSON-safe copy of one table (or all) for snapshots/dumps."""
+        with self._lock:
+            if name is not None:
+                t = self._tables.get(name)
+                return None if t is None else self._encode_cands(name, t)
+            return {
+                n: self._encode_cands(n, t) for n, t in self._tables.items()
+            }
+
+    @classmethod
+    def decode_state(cls, state, name: Optional[str] = None):
+        """Validate+decode an untrusted blob WITHOUT touching the store —
+        restore paths call this before any state mutation, then install
+        the returned value via import_decoded."""
+        if name is not None:
+            return cls._decode_cands(state) if state else None
+        return {n: cls._decode_cands(d) for n, d in (state or {}).items()}
+
+    def import_decoded(self, decoded, name: Optional[str] = None) -> None:
+        with self._lock:
+            if name is not None:
+                self._tables.pop(name, None)  # never keep a ghost table
+                if decoded:
+                    self._tables[name] = decoded
+                return
+            for n, d in (decoded or {}).items():
+                self._tables[n] = d
+
+    def import_state(self, state, name: Optional[str] = None) -> None:
+        self.import_decoded(self.decode_state(state, name), name)
+
 
 class _ConcatLazy:
     """LazyResult adapter concatenating per-group results in op order —
@@ -1356,6 +1456,7 @@ class HostSketchEngine:
                     "model_cls": type(m).__name__,
                     "scalars": scalars,
                     "arrays": arrays,
+                    "topk": self.topk.export_state(name),
                 }
             ).encode("utf-8")
             buf = io.BytesIO()
@@ -1412,6 +1513,8 @@ class HostSketchEngine:
         schema = self._RESTORE_SCHEMAS.get(cls_name)
         if schema is None:
             raise ValueError(f"unknown model class {cls_name!r}")
+        # Untrusted candidate table: validate BEFORE any mutation.
+        topk_decoded = TopKStore.decode_state(d.get("topk"), name)
         cls = getattr(golden, cls_name)
         scalars = d.get("scalars", {})
         if set(scalars) != set(schema["scalars"]):
@@ -1449,6 +1552,9 @@ class HostSketchEngine:
                 "model": model,
                 "params": d["params"],
             }
+        # Unconditional: replaces (or clears) any previous object's table
+        # so a ghost heavy-hitter set never survives a replace.
+        self.topk.import_decoded(topk_decoded, name)
 
     # -- bloom -------------------------------------------------------------
 

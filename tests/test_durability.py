@@ -400,3 +400,109 @@ class TestForgedDumps:
                 c._engine.restore("mism2", blob2)
         finally:
             c.shutdown()
+
+
+class TestTopKDurability:
+    """The engine-shared heavy-hitter tables must survive durability
+    boundaries: counters without candidates would return empty top_k()."""
+
+    def test_dump_restore_keeps_topk(self, client):
+        c = client.get_count_min_sketch("tk-src")
+        c.try_init(4, 1 << 10, track_top_k=3)
+        for key, n in ((1, 9), (2, 5), (3, 2)):
+            for _ in range(n):
+                c.add(key)
+        blob = c.dump()
+        c2 = client.get_count_min_sketch("tk-dst")
+        c2.restore(blob)
+        assert c2.top_k(2) == c.top_k(2) == [(1, 9), (2, 5)]
+
+    def test_snapshot_restore_keeps_topk(self, tmp_path):
+        d = str(tmp_path / "snap")
+        c1 = make_client(tmp_path)
+        cms = c1.get_count_min_sketch("tk-snap")
+        cms.try_init(4, 1 << 10, track_top_k=3)
+        for key, n in ((7, 11), (8, 4)):
+            for _ in range(n):
+                cms.add(key)
+        c1._engine.snapshot(d)
+        c1.shutdown()
+        c2 = make_client()
+        try:
+            c2._engine.restore_snapshot(d)
+            cms2 = c2.get_count_min_sketch("tk-snap")
+            assert cms2.top_k(2) == [(7, 11), (8, 4)]
+        finally:
+            c2.shutdown()
+
+
+    def test_topk_key_types_survive_round_trip(self):
+        """Candidate keys keep their ORIGINAL scalar type across dump/
+        restore: the codec encodes np.uint64(5) and 5 differently, so a
+        type-collapsing export would re-estimate the wrong cells
+        (count_min_sketch offer note).  Uses the default PickleCodec."""
+        import redisson_tpu as _rt
+
+        c = _rt.create(Config().use_tpu_sketch(min_bucket=64))
+        try:
+            cms = c.get_count_min_sketch("tk-np")
+            cms.try_init(4, 1 << 10, track_top_k=3)
+            keys = np.array([11, 11, 11, 22, 22, 33], dtype=np.uint64)
+            cms.add_all(keys)
+            before = cms.top_k(2)
+            assert before == [(11, 3), (22, 2)]
+            blob = cms.dump()
+            cms2 = c.get_count_min_sketch("tk-np2")
+            cms2.restore(blob)
+            assert cms2.top_k(2) == before
+            # The restored candidates must still be np.uint64.
+            cands = c._engine.topk.candidates("tk-np2")
+            assert all(type(k) is np.uint64 for k in cands), cands
+        finally:
+            c.shutdown()
+
+    def test_topk_ghost_table_cleared_on_replace(self, client):
+        """RESTORE with replace over a tracked CMS from an untracked dump
+        must NOT leave the old object's heavy-hitter ghosts behind."""
+        tracked = client.get_count_min_sketch("tk-ghost")
+        tracked.try_init(4, 1 << 10, track_top_k=3)
+        for _ in range(9):
+            tracked.add(5)
+        assert tracked.top_k(1) == [(5, 9)]
+        plain = client.get_count_min_sketch("tk-plain")
+        plain.try_init(4, 1 << 10)  # no tracking
+        plain.add(7)
+        tracked.restore(plain.dump(), replace=True)
+        assert client._engine.topk.candidates("tk-ghost") == []
+
+    def test_topk_forged_blob_rejected_before_install(self, client):
+        """Malformed candidate tables must fail BEFORE the object is
+        created — no half-restored state."""
+        import json as _json
+
+        src = client.get_count_min_sketch("tk-forge-src")
+        src.try_init(4, 1 << 10, track_top_k=3)
+        src.add(1)
+        blob = bytearray(src.dump())
+        for forged_topk in (
+            '{"k": 1152921504606846976, "cands": []}',   # absurd k
+            '{"k": 3, "cands": [["zz", 1, 2]]}',          # unknown tag
+            '{"k": 3, "cands": [["b", "not-hex", 2]]}',   # bad hex
+        ):
+            raw = bytes(blob)
+            # splice the forged table into the json header
+            import struct as _struct
+
+            (hlen,) = _struct.unpack("<I", raw[4:8])
+            hdr = _json.loads(raw[8 : 8 + hlen].decode())
+            hdr["topk"] = _json.loads(forged_topk)
+            new_hdr = _json.dumps(hdr).encode()
+            forged = (
+                raw[:4]
+                + _struct.pack("<I", len(new_hdr))
+                + new_hdr
+                + raw[8 + hlen :]
+            )
+            with pytest.raises(ValueError):
+                client.get_count_min_sketch("tk-forge-dst").restore(forged)
+            assert not client.get_count_min_sketch("tk-forge-dst").is_exists()
