@@ -5,18 +5,26 @@ keyspace and sketch objects without the Python API.
 
 Command surface (the subset the north-star objects + grid need):
   PING ECHO  GET SET DEL EXISTS EXPIRE PEXPIRE TTL PTTL PERSIST
+  EXPIREAT PEXPIREAT RENAME RENAMENX RANDOMKEY
   TYPE DUMP RESTORE                                 (data-only payloads)
+  MGET MSET SETNX SETEX PSETEX GETSET GETDEL APPEND STRLEN
+  GETRANGE SETRANGE
   SETBIT GETBIT BITCOUNT BITPOS
   PFADD PFCOUNT PFMERGE
   BF.RESERVE BF.ADD BF.MADD BF.EXISTS BF.MEXISTS BF.INFO (RedisBloom shape)
   CMS.INITBYDIM CMS.INCRBY CMS.QUERY CMS.MERGE CMS.INFO  (RedisBloom CMS)
-  LPUSH RPUSH LPOP RPOP LLEN BLPOP BRPOP            (condvar blocking pops)
-  HSET HGET HDEL HLEN
-  SADD SREM SISMEMBER SCARD SMEMBERS
-  ZADD ZSCORE ZRANGE ZCARD ZREM
+  TOPK.RESERVE TOPK.ADD TOPK.INCRBY TOPK.QUERY TOPK.COUNT
+  TOPK.LIST TOPK.INFO            (RedisBloom Top-K over the CMS engine)
+  LPUSH RPUSH LPOP RPOP LLEN LRANGE LINDEX LSET LREM LTRIM RPOPLPUSH
+  BLPOP BRPOP                                       (condvar blocking pops)
+  HSET HGET HDEL HLEN HGETALL HMGET HKEYS HVALS HEXISTS HSETNX HINCRBY
+  SADD SREM SISMEMBER SCARD SMEMBERS SMISMEMBER SPOP SRANDMEMBER SMOVE
+  SINTER SUNION SDIFF
+  ZADD ZSCORE ZRANGE ZCARD ZREM ZINCRBY ZRANK ZCOUNT ZRANGEBYSCORE
+  ZPOPMIN ZPOPMAX
   INCR INCRBY DECR
   PUBLISH SUBSCRIBE UNSUBSCRIBE           (push replies; '>' on RESP3)
-  HELLO                                   (RESP2/RESP3 negotiation)
+  HELLO CLIENT INFO COMMAND               (RESP2/RESP3 negotiation, admin)
   MULTI EXEC DISCARD                                (contiguous-exec txn)
   KEYS SCAN DBSIZE FLUSHALL
 
@@ -495,6 +503,73 @@ class RespServer:
     def _cmd_GET(self, args):
         return _encode_bulk(self._bucket(args[0]).get())
 
+    def _cmd_MGET(self, args):
+        out = []
+        for k in args:
+            try:
+                out.append(self._bucket(k).get())
+            except TypeError:  # WRONGTYPE slot: nil, Redis-style
+                out.append(None)
+        return _encode_array(out)
+
+    def _cmd_MSET(self, args):
+        for i in range(0, len(args), 2):
+            self._bucket(args[i]).set(args[i + 1])
+        return _encode_simple("OK")
+
+    def _cmd_SETNX(self, args):
+        return _encode_int(int(self._bucket(args[0]).set_if_absent(args[1])))
+
+    def _cmd_SETEX(self, args):
+        self._bucket(args[0]).set(args[2], ttl_seconds=float(args[1]))
+        return _encode_simple("OK")
+
+    def _cmd_PSETEX(self, args):
+        self._bucket(args[0]).set(args[2], ttl_seconds=float(args[1]) / 1000)
+        return _encode_simple("OK")
+
+    def _cmd_GETSET(self, args):
+        return _encode_bulk(self._bucket(args[0]).get_and_set(args[1]))
+
+    def _cmd_GETDEL(self, args):
+        b = self._bucket(args[0])
+        with self._client._grid.lock:  # atomic read+delete (RLock)
+            v = b.get()
+            if v is not None:
+                b.delete()
+        return _encode_bulk(v)
+
+    def _cmd_APPEND(self, args):
+        b = self._bucket(args[0])
+        with self._client._grid.lock:  # atomic RMW, Redis APPEND contract
+            v = (b.get() or b"") + args[1]
+            b.set(v)
+        return _encode_int(len(v))
+
+    def _cmd_STRLEN(self, args):
+        v = self._bucket(args[0]).get()
+        return _encode_int(0 if v is None else len(v))
+
+    def _cmd_GETRANGE(self, args):
+        v = self._bucket(args[0]).get() or b""
+        start, end = int(args[1]), int(args[2])
+        if start < 0:
+            start = max(0, len(v) + start)
+        if end < 0:
+            end = max(0, len(v) + end)  # Redis clamps BOTH offsets to 0
+        return _encode_bulk(v[start : end + 1])
+
+    def _cmd_SETRANGE(self, args):
+        b = self._bucket(args[0])
+        off = int(args[1])
+        with self._client._grid.lock:  # atomic RMW
+            v = bytearray(b.get() or b"")
+            if len(v) < off + len(args[2]):
+                v.extend(b"\x00" * (off + len(args[2]) - len(v)))
+            v[off : off + len(args[2])] = args[2]
+            b.set(bytes(v))
+        return _encode_int(len(v))
+
     def _cmd_DEL(self, args):
         return _encode_int(
             self._client.get_keys().delete(*[self._s(a) for a in args])
@@ -529,6 +604,117 @@ class RespServer:
         grid_ok = self._client._grid.clear_expire(name)
         eng = getattr(self._client._engine, "clear_expire", None)
         return _encode_int(int(grid_ok or (eng is not None and eng(name))))
+
+    def _cmd_RENAME(self, args):
+        try:
+            self._client.get_keys().rename(self._s(args[0]), self._s(args[1]))
+        except RuntimeError as e:
+            raise RespError(str(e))
+        return _encode_simple("OK")
+
+    def _cmd_RENAMENX(self, args):
+        if self._exists_any(self._s(args[1])):
+            return _encode_int(0)
+        self._client.get_keys().rename(self._s(args[0]), self._s(args[1]))
+        return _encode_int(1)
+
+    def _cmd_EXPIREAT(self, args):
+        import time as _time
+
+        ttl = float(args[1]) - _time.time()
+        ok = self._client.get_keys().expire(self._s(args[0]), max(ttl, 0.001))
+        return _encode_int(int(ok))
+
+    def _cmd_PEXPIREAT(self, args):
+        import time as _time
+
+        ttl = float(args[1]) / 1000.0 - _time.time()
+        ok = self._client.get_keys().expire(self._s(args[0]), max(ttl, 0.001))
+        return _encode_int(int(ok))
+
+    def _cmd_RANDOMKEY(self, args):
+        return _encode_bulk(self._client.get_keys().random_key())
+
+    # server / connection admin
+
+    def _cmd_INFO(self, args):
+        lines = ["# Server", "redis_version:7.9.9", "redis_mode:standalone",
+                 "run_id:redisson-tpu", "# Keyspace"]
+        n = self._client.get_keys().count()
+        lines.append(f"db0:keys={n},expires=0,avg_ttl=0")
+        return _encode_bulk("\r\n".join(lines) + "\r\n")
+
+    def _cmdctx_CLIENT(self, args, ctx: _ConnCtx):
+        sub = args[0].decode().upper() if args else ""
+        if sub == "SETNAME":
+            ctx.client_name = self._s(args[1])
+            return _encode_simple("OK")
+        if sub == "GETNAME":
+            return _encode_bulk(ctx.client_name)
+        if sub == "ID":
+            return _encode_int(id(ctx) & 0x7FFFFFFF)
+        raise RespError(f"unsupported CLIENT subcommand {sub}")
+
+    def _cmd_COMMAND(self, args):
+        return _encode_array([])  # stock-client handshake stub
+
+    # TOPK.* (RedisBloom Top-K shape) over the CMS heavy-hitter engine:
+    # the candidate-table + device re-estimation design stands in for
+    # RedisBloom's HeavyKeeper — same API, same role (BASELINE config 5).
+
+    def _cms(self, key: bytes):
+        return self._client.get_count_min_sketch(self._s(key))
+
+    def _cmd_TOPK_RESERVE(self, args):
+        k = int(args[1])
+        width = int(args[2]) if len(args) > 2 else max(1 << 10, 8 * k)
+        depth = int(args[3]) if len(args) > 3 else 4
+        # args[4] (decay) accepted, meaningless for exact re-estimation.
+        c = self._cms(args[0])
+        if not c.try_init(depth, width, track_top_k=k):
+            raise RespError("TopK: key already exists")
+        return _encode_simple("OK")
+
+    def _cmd_TOPK_ADD(self, args):
+        c = self._cms(args[0])
+        for item in args[1:]:
+            c.add(item)
+        # RedisBloom returns the dropped item per slot; exact re-
+        # estimation never drops — nil per added item.
+        return _encode_array([None] * (len(args) - 1))
+
+    def _cmd_TOPK_INCRBY(self, args):
+        c = self._cms(args[0])
+        for i in range(1, len(args), 2):
+            c.add(args[i], int(args[i + 1]))
+        return _encode_array([None] * ((len(args) - 1) // 2))
+
+    def _cmd_TOPK_QUERY(self, args):
+        c = self._cms(args[0])
+        top = {m for m, _ in c.top_k()}
+        return _encode_array([int(item in top) for item in args[1:]])
+
+    def _cmd_TOPK_COUNT(self, args):
+        c = self._cms(args[0])
+        return _encode_array([int(c.estimate(item)) for item in args[1:]])
+
+    def _cmd_TOPK_LIST(self, args):
+        c = self._cms(args[0])
+        withcount = any(a.upper() == b"WITHCOUNT" for a in args[1:])
+        out = []
+        for member, count in c.top_k():
+            out.append(member)
+            if withcount:
+                out.append(int(count))
+        return _encode_array(out)
+
+    def _cmd_TOPK_INFO(self, args):
+        c = self._cms(args[0])
+        k = self._client._engine.topk.track(self._s(args[0]))
+        return _encode_array(
+            [b"k", int(k), b"width", int(c.get_width()), b"depth",
+             int(c.get_depth()), b"decay", b"1"]
+        )
 
     # keyspace type / dump / restore (→ RKeys#getType + RObject#dump/
     # restore riding Redis TYPE / DUMP / RESTORE)
@@ -829,6 +1015,75 @@ class RespServer:
     def _cmd_LLEN(self, args):
         return _encode_int(self._list(args[0]).size())
 
+    def _listidx(self, key: bytes):
+        # Index-addressed view of the same "list" store entry (List and
+        # Deque share KIND, → RList over one Redis list key).
+        from redisson_tpu.grid.collections import List_
+
+        return self._raw(List_(self._s(key), self._client))
+
+    def _cmd_LRANGE(self, args):
+        lst = self._listidx(args[0])
+        start, end = int(args[1]), int(args[2])
+        n = lst.size()
+        if start < 0:
+            start = max(0, n + start)
+        end = n + end if end < 0 else end
+        if start > end or start >= n:
+            return _encode_array([])
+        return _encode_array(lst.sub_list(start, min(end, n - 1) + 1))
+
+    def _cmd_LINDEX(self, args):
+        lst = self._listidx(args[0])
+        i = int(args[1])
+        n = lst.size()
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            return _encode_bulk(None)
+        return _encode_bulk(lst.get(i))
+
+    def _cmd_LSET(self, args):
+        lst = self._listidx(args[0])
+        i = int(args[1])
+        if i < 0:
+            i += lst.size()
+        lst.set(i, args[2])
+        return _encode_simple("OK")
+
+    def _cmd_LREM(self, args):
+        lst = self._listidx(args[0])
+        count = int(args[1])
+        with self._client._grid.lock:  # atomic scan+remove
+            vals = lst.sub_list(0, lst.size())
+            idxs = [i for i, v in enumerate(vals) if v == args[2]]
+            if count > 0:
+                idxs = idxs[:count]  # head-first
+            elif count < 0:
+                idxs = idxs[count:]  # tail-first, Redis count<0
+            for i in reversed(idxs):
+                lst.remove_at(i)
+        return _encode_int(len(idxs))
+
+    def _cmd_LTRIM(self, args):
+        lst = self._listidx(args[0])
+        start, end = int(args[1]), int(args[2])
+        n = lst.size()
+        if start < 0:
+            start = max(0, n + start)
+        end = n + end if end < 0 else min(end, n - 1)
+        if start > end:
+            lst.trim(1, 0)  # keep-nothing: Redis empties the list
+        else:
+            lst.trim(start, end)  # grid trim is [from, to] INCLUSIVE
+        return _encode_simple("OK")
+
+    def _cmd_RPOPLPUSH(self, args):
+        v = self._list(args[0]).poll_last_and_offer_first_to(
+            self._s(args[1])
+        )
+        return _encode_bulk(v)
+
     # hashes
 
     def _map(self, key: bytes):
@@ -856,6 +1111,39 @@ class RespServer:
     def _cmd_HLEN(self, args):
         return _encode_int(self._map(args[0]).size())
 
+    def _cmd_HGETALL(self, args):
+        flat = []
+        for k, v in self._map(args[0]).read_all_map().items():
+            flat.extend([k, v])
+        return _encode_array(flat)
+
+    def _cmd_HMGET(self, args):
+        m = self._map(args[0])
+        return _encode_array([m.get(f) for f in args[1:]])
+
+    def _cmd_HKEYS(self, args):
+        return _encode_array(self._map(args[0]).key_set())
+
+    def _cmd_HVALS(self, args):
+        return _encode_array(self._map(args[0]).values())
+
+    def _cmd_HEXISTS(self, args):
+        return _encode_int(int(self._map(args[0]).contains_key(args[1])))
+
+    def _cmd_HSETNX(self, args):
+        m = self._map(args[0])
+        prev = m.put_if_absent(args[1], args[2])
+        return _encode_int(int(prev is None))
+
+    def _cmd_HINCRBY(self, args):
+        m = self._map(args[0])
+        # Stored values are raw bytes over RESP: interpret as integer.
+        with self._client._grid.lock:
+            cur = m.get(args[1])
+            new = (int(cur) if cur is not None else 0) + int(args[2])
+            m.fast_put(args[1], str(new).encode())
+        return _encode_int(new)
+
     # sets
 
     def _set(self, key: bytes):
@@ -879,6 +1167,58 @@ class RespServer:
 
     def _cmd_SMEMBERS(self, args):
         return _encode_array(self._set(args[0]).read_all())
+
+    def _cmd_SMISMEMBER(self, args):
+        s = self._set(args[0])
+        return _encode_array([int(s.contains(v)) for v in args[1:]])
+
+    def _cmd_SPOP(self, args):
+        s = self._set(args[0])
+        if len(args) > 1:
+            return _encode_array(s.remove_random(int(args[1])))
+        out = s.remove_random(1)
+        return _encode_bulk(out[0] if out else None)
+
+    def _cmd_SRANDMEMBER(self, args):
+        s = self._set(args[0])
+        if len(args) > 1:
+            return _encode_array(s.random(int(args[1])))
+        out = s.random(1)
+        return _encode_bulk(out[0] if out else None)
+
+    def _cmd_SMOVE(self, args):
+        # Raw-bytes SMOVE: the grid's move() resolves the destination
+        # through the client codec; RESP values are raw, so move by hand
+        # under the store lock.
+        src, dst = self._set(args[0]), self._set(args[1])
+        with self._client._grid.lock:
+            self._client._grid.get_entry(self._s(args[1]), "set")
+            if not src.remove(args[2]):
+                return _encode_int(0)
+            dst.add(args[2])
+        return _encode_int(1)
+
+    # SINTER/SUNION/SDIFF combine via raw per-set reads: the grid's
+    # read_intersection/read_union resolve other sets through the
+    # CLIENT's codec, but every RESP-stored value is raw bytes.
+
+    def _cmd_SINTER(self, args):
+        sets = [set(self._set(a).read_all()) for a in args]
+        return _encode_array(sorted(set.intersection(*sets)))
+
+    def _cmd_SUNION(self, args):
+        out: set = set()
+        for a in args:
+            out.update(self._set(a).read_all())
+        return _encode_array(sorted(out))
+
+    def _cmd_SDIFF(self, args):
+        first = self._set(args[0])
+        out = first.read_all()
+        others = set()
+        for a in args[1:]:
+            others.update(self._set(a).read_all())
+        return _encode_array([v for v in out if v not in others])
 
     # sorted sets
 
@@ -914,6 +1254,87 @@ class RespServer:
     def _cmd_ZREM(self, args):
         z = self._zset(args[0])
         return _encode_int(sum(int(z.remove(m)) for m in args[1:]))
+
+    def _cmd_ZINCRBY(self, args):
+        new = self._zset(args[0]).add_score(args[2], float(args[1]))
+        return _encode_bulk(_fmt_score(new))
+
+    def _cmd_ZRANK(self, args):
+        r = self._zset(args[0]).rank(args[1])
+        return b"$-1\r\n" if r is None else _encode_int(r)
+
+    @staticmethod
+    def _score_bound(raw: bytes):
+        """Redis score-bound syntax: '(x' exclusive, -inf/+inf."""
+        if raw.startswith(b"("):
+            return float(raw[1:]), False
+        return float(raw), True
+
+    def _score_filtered(self, z, lo_raw: bytes, hi_raw: bytes):
+        lo, lo_inc = self._score_bound(lo_raw)
+        hi, hi_inc = self._score_bound(hi_raw)
+        out = []
+        for m in z.value_range_by_score(lo, hi):
+            s = z.get_score(m)
+            if (s > lo or (lo_inc and s == lo)) and (
+                s < hi or (hi_inc and s == hi)
+            ):
+                out.append((m, s))
+        return out
+
+    def _cmd_ZCOUNT(self, args):
+        return _encode_int(
+            len(self._score_filtered(self._zset(args[0]), args[1], args[2]))
+        )
+
+    def _cmd_ZRANGEBYSCORE(self, args):
+        z = self._zset(args[0])
+        withscores = False
+        offset, count = 0, None
+        i = 3
+        while i < len(args):
+            opt = args[i].upper()
+            if opt == b"WITHSCORES":
+                withscores = True
+                i += 1
+            elif opt == b"LIMIT":
+                offset, count = int(args[i + 1]), int(args[i + 2])
+                i += 3
+            else:
+                raise RespError(f"syntax error near {args[i].decode()!r}")
+        entries = self._score_filtered(z, args[1], args[2])
+        if count is not None:
+            entries = entries[offset : offset + count if count >= 0 else None]
+        elif offset:
+            entries = entries[offset:]
+        if not withscores:
+            return _encode_array([m for m, _ in entries])
+        flat = []
+        for m, s in entries:
+            flat.extend([m, _fmt_score(s)])
+        return _encode_array(flat)
+
+    def _zpop(self, args, first: bool):
+        z = self._zset(args[0])
+        count = int(args[1]) if len(args) > 1 else 1
+        flat = []
+        with self._client._grid.lock:  # atomic peek+remove per entry
+            for _ in range(count):
+                entries = (
+                    z.entry_range(0, 0) if first else z.entry_range(-1, -1)
+                )
+                if not entries:
+                    break
+                member, score = entries[0]
+                z.remove(member)
+                flat.extend([member, _fmt_score(score)])
+        return _encode_array(flat)
+
+    def _cmd_ZPOPMIN(self, args):
+        return self._zpop(args, True)
+
+    def _cmd_ZPOPMAX(self, args):
+        return self._zpop(args, False)
 
     # protocol negotiation (→ RESP3's HELLO; the reference speaks
     # RESP2/RESP3 through Netty — SURVEY.md §2.4 comm row)

@@ -560,3 +560,141 @@ class TestHelloResp3:
             resp.cmd("RESTORE", "ec-bk", "0", blob)
         except RuntimeError as e:
             assert str(e).startswith("BUSYKEY"), e
+
+
+class TestWidenedSurface:
+    def test_string_commands(self, resp):
+        assert resp.cmd("MSET", "w1", "a", "w2", "b") == "OK"
+        assert resp.cmd("MGET", "w1", "w2", "nope") == [b"a", b"b", None]
+        assert resp.cmd("SETNX", "w1", "x") == 0
+        assert resp.cmd("SETNX", "w3", "c") == 1
+        assert resp.cmd("APPEND", "w1", "ppend") == 6
+        assert resp.cmd("GET", "w1") == b"append"
+        assert resp.cmd("STRLEN", "w1") == 6
+        assert resp.cmd("GETRANGE", "w1", "1", "3") == b"ppe"
+        assert resp.cmd("GETRANGE", "w1", "-3", "-1") == b"end"
+        assert resp.cmd("SETRANGE", "w1", "2", "XY") == 6
+        assert resp.cmd("GET", "w1") == b"apXYnd"
+        assert resp.cmd("GETSET", "w1", "new") == b"apXYnd"
+        assert resp.cmd("GETDEL", "w1") == b"new"
+        assert resp.cmd("EXISTS", "w1") == 0
+        assert resp.cmd("SETEX", "w4", "60", "v") == "OK"
+        ttl = resp.cmd("TTL", "w4")
+        assert 50 <= ttl <= 60
+
+    def test_hash_commands(self, resp):
+        resp.cmd("HSET", "wh", "f1", "v1", "f2", "v2")
+        got = resp.cmd("HGETALL", "wh")
+        assert dict(zip(got[::2], got[1::2])) == {b"f1": b"v1", b"f2": b"v2"}
+        assert resp.cmd("HMGET", "wh", "f2", "zz") == [b"v2", None]
+        assert sorted(resp.cmd("HKEYS", "wh")) == [b"f1", b"f2"]
+        assert sorted(resp.cmd("HVALS", "wh")) == [b"v1", b"v2"]
+        assert resp.cmd("HEXISTS", "wh", "f1") == 1
+        assert resp.cmd("HSETNX", "wh", "f1", "zz") == 0
+        assert resp.cmd("HSETNX", "wh", "f3", "v3") == 1
+        assert resp.cmd("HINCRBY", "wh", "ctr", "5") == 5
+        assert resp.cmd("HINCRBY", "wh", "ctr", "-2") == 3
+
+    def test_set_commands(self, resp):
+        resp.cmd("SADD", "ws1", "a", "b", "c")
+        resp.cmd("SADD", "ws2", "b", "c", "d")
+        assert resp.cmd("SMISMEMBER", "ws1", "a", "d") == [1, 0]
+        assert sorted(resp.cmd("SINTER", "ws1", "ws2")) == [b"b", b"c"]
+        assert sorted(resp.cmd("SUNION", "ws1", "ws2")) == [b"a", b"b", b"c", b"d"]
+        assert sorted(resp.cmd("SDIFF", "ws1", "ws2")) == [b"a"]
+        assert resp.cmd("SMOVE", "ws1", "ws2", "a") == 1
+        assert resp.cmd("SISMEMBER", "ws2", "a") == 1
+        popped = resp.cmd("SPOP", "ws2")
+        assert popped in (b"a", b"b", b"c", b"d")
+        r = resp.cmd("SRANDMEMBER", "ws2")
+        assert r is not None and resp.cmd("SISMEMBER", "ws2", r) == 1
+
+    def test_zset_commands(self, resp):
+        resp.cmd("ZADD", "wz", "1", "one", "2", "two", "3", "three")
+        assert resp.cmd("ZINCRBY", "wz", "5", "one") == b"6"
+        assert resp.cmd("ZRANK", "wz", "two") == 0
+        assert resp.cmd("ZCOUNT", "wz", "2", "6") == 3
+        assert resp.cmd("ZRANGEBYSCORE", "wz", "2", "3") == [b"two", b"three"]
+        got = resp.cmd("ZRANGEBYSCORE", "wz", "2", "3", "WITHSCORES")
+        assert got == [b"two", b"2", b"three", b"3"]
+        assert resp.cmd("ZPOPMIN", "wz") == [b"two", b"2"]
+        assert resp.cmd("ZPOPMAX", "wz") == [b"one", b"6"]
+
+    def test_list_commands(self, resp):
+        resp.cmd("RPUSH", "wl", "a", "b", "c", "d")
+        assert resp.cmd("LRANGE", "wl", "0", "-1") == [b"a", b"b", b"c", b"d"]
+        assert resp.cmd("LRANGE", "wl", "1", "2") == [b"b", b"c"]
+        assert resp.cmd("LINDEX", "wl", "-1") == b"d"
+        assert resp.cmd("LSET", "wl", "1", "B") == "OK"
+        assert resp.cmd("LINDEX", "wl", "1") == b"B"
+        resp.cmd("RPUSH", "wl", "B")
+        assert resp.cmd("LREM", "wl", "0", "B") == 2
+        assert resp.cmd("LTRIM", "wl", "1", "-1") == "OK"
+        assert resp.cmd("LRANGE", "wl", "0", "-1") == [b"c", b"d"]
+        assert resp.cmd("RPOPLPUSH", "wl", "wl2") == b"d"
+        assert resp.cmd("LRANGE", "wl2", "0", "-1") == [b"d"]
+
+    def test_key_admin_commands(self, resp):
+        resp.cmd("SET", "wk1", "v")
+        assert resp.cmd("RENAME", "wk1", "wk2") == "OK"
+        assert resp.cmd("GET", "wk2") == b"v"
+        resp.cmd("SET", "wk3", "x")
+        assert resp.cmd("RENAMENX", "wk3", "wk2") == 0
+        assert resp.cmd("RENAMENX", "wk3", "wk4") == 1
+        import time
+
+        assert resp.cmd("EXPIREAT", "wk4", str(int(time.time()) + 60)) == 1
+        assert 50 <= resp.cmd("TTL", "wk4") <= 60
+        assert resp.cmd("RANDOMKEY") is not None
+        info = resp.cmd("INFO")
+        assert b"redis_version" in info
+        assert resp.cmd("CLIENT", "SETNAME", "tester") == "OK"
+        assert resp.cmd("CLIENT", "GETNAME") == b"tester"
+        assert resp.cmd("COMMAND") == []
+
+    def test_topk_commands(self, resp):
+        assert resp.cmd("TOPK.RESERVE", "wt", "3") == "OK"
+        resp.cmd("TOPK.ADD", "wt", "a", "a", "a", "b", "b", "c")
+        assert resp.cmd("TOPK.INCRBY", "wt", "d", "10") == [None]
+        assert resp.cmd("TOPK.QUERY", "wt", "d", "a", "zz") == [1, 1, 0]
+        assert resp.cmd("TOPK.COUNT", "wt", "d", "a", "b") == [10, 3, 2]
+        assert resp.cmd("TOPK.LIST", "wt") == [b"d", b"a", b"b"]
+        got = resp.cmd("TOPK.LIST", "wt", "WITHCOUNT")
+        assert got == [b"d", 10, b"a", 3, b"b", 2]
+        info = resp.cmd("TOPK.INFO", "wt")
+        d = dict(zip(info[::2], info[1::2]))
+        assert d[b"k"] == 3 and d[b"depth"] == 4
+
+    def test_lrem_negative_count_tail_first(self, resp):
+        resp.cmd("RPUSH", "wlr", "a", "x", "b", "x")
+        assert resp.cmd("LREM", "wlr", "-1", "x") == 1
+        assert resp.cmd("LRANGE", "wlr", "0", "-1") == [b"a", b"x", b"b"]
+
+    def test_zcount_exclusive_bounds(self, resp):
+        resp.cmd("ZADD", "wzx", "2", "two", "4", "four", "6", "six")
+        assert resp.cmd("ZCOUNT", "wzx", "(2", "6") == 2
+        assert resp.cmd("ZCOUNT", "wzx", "2", "(6") == 2
+        assert resp.cmd("ZCOUNT", "wzx", "-inf", "+inf") == 3
+        assert resp.cmd("ZRANGEBYSCORE", "wzx", "(2", "(6") == [b"four"]
+
+    def test_zrangebyscore_limit(self, resp):
+        resp.cmd("ZADD", "wzl", *[str(v) for pair in
+                                  ((i, f"m{i}") for i in range(10))
+                                  for v in pair])
+        assert resp.cmd(
+            "ZRANGEBYSCORE", "wzl", "0", "100", "LIMIT", "2", "3"
+        ) == [b"m2", b"m3", b"m4"]
+
+    def test_zpopmin_count(self, resp):
+        resp.cmd("ZADD", "wzp", "1", "a", "2", "b", "3", "c")
+        assert resp.cmd("ZPOPMIN", "wzp", "2") == [b"a", b"1", b"b", b"2"]
+        assert resp.cmd("ZCARD", "wzp") == 1
+
+    def test_mget_wrongtype_slot_is_nil(self, resp):
+        resp.cmd("SET", "wm1", "v")
+        resp.cmd("SADD", "wmset", "m")
+        assert resp.cmd("MGET", "wm1", "wmset", "absent") == [b"v", None, None]
+
+    def test_getrange_negative_end_clamps(self, resp):
+        resp.cmd("SET", "wgr", "abc")
+        assert resp.cmd("GETRANGE", "wgr", "0", "-4") == b"a"
