@@ -302,18 +302,16 @@ def bench_full_geometry(make_client):
     B = 1 << 19
     n = 10_000_000
     h.add_all_async(np.arange(B, dtype=np.uint64)).result()  # warm
-    from collections import deque
-
-    futs = deque()
+    futs = []
     t0 = time.perf_counter()
     for i in range(0, n, B):
         futs.append(
             h.add_all_async(np.arange(i, min(i + B, n), dtype=np.uint64))
         )
-        while len(futs) > 8:
-            futs.popleft().result()
-    while futs:
-        futs.popleft().result()
+        if len(futs) >= 8:
+            client.collect(futs)  # one mailbox flush per window
+            futs = []
+    client.collect(futs)
     dt = time.perf_counter() - t0
     est = h.count()
     out["full_hll_pfadd_ops_per_sec"] = round(n / dt)
@@ -354,14 +352,20 @@ def bench_full_geometry(make_client):
     true_top = set(np.argsort(-true_counts)[:10].tolist())
     got = {int(k) for k, _ in cms.top_k(10)}
     # CMS estimator error over the true top-10 (where estimates matter).
-    est_err = []
+    signed = []
     for k in true_top:
         est = cms.estimate(np.uint64(k))
-        est_err.append(abs(est - true_counts[k]) / max(1, true_counts[k]))
+        signed.append((est - true_counts[k]) / max(1, true_counts[k]))
     out["full_cms_events"] = n_events
     out["full_cms_events_per_sec"] = round((done - chunk) / dt)
     out["full_cms_topk_recall_at_10"] = len(got & true_top) / 10.0
-    out["full_cms_top10_max_rel_est_error"] = round(max(est_err), 5)
+    out["full_cms_top10_max_rel_est_error"] = round(
+        max(abs(s) for s in signed), 5
+    )
+    # CMS NEVER undercounts delivered events: a negative signed minimum
+    # means the ingest pipe LOST events (diagnostic — separates pipeline
+    # loss from the sketch's additive collision overcount).
+    out["full_cms_top10_min_signed_error"] = round(min(signed), 5)
     client.shutdown()
     return out
 

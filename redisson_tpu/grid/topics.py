@@ -33,6 +33,9 @@ class TopicBus:
         # of order by the same listener.
         self._chan_queues: dict[str, list] = {}
         self._chan_active: set[str] = set()
+        # Notified whenever a channel finishes its queued deliveries —
+        # drain() waits here.
+        self._idle_cv = threading.Condition(self._lock)
 
     def subscribe(self, channel: str, listener: Callable) -> int:
         with self._lock:
@@ -88,6 +91,7 @@ class TopicBus:
                 if not queue:
                     self._chan_active.discard(channel)
                     self._chan_queues.pop(channel, None)
+                    self._idle_cv.notify_all()
                     return
                 targets, message = queue.pop(0)
             for pat, fn in targets:
@@ -124,26 +128,40 @@ class TopicBus:
             )
             return n
 
-    def drain(self, timeout: float = 5.0) -> None:
-        """Barrier: wait until every queued delivery has run.  One sentinel
-        is not enough with a multi-worker pool (it can run on an idle
-        worker while another worker is mid-callback) — all workers must
-        rendezvous, which forces each to finish its queued deliveries."""
-        n = max(1, getattr(self._pool, "_max_workers", 1))
-        barrier = threading.Barrier(n + 1)
+    def drain(
+        self, timeout: Optional[float] = None, channel: Optional[str] = None
+    ) -> bool:
+        """Barrier: block until every delivery queued before this call has
+        COMPLETED (queues empty + no channel mid-callback).  Exact, not a
+        pool rendezvous: the old worker-barrier broke silently at its
+        5s timeout when deliveries outlasted it — callers (TopicCmsBridge
+        teardown, the config-5 bench) then closed their listeners with
+        messages still queued, and those events were silently dropped
+        (caught as a NEGATIVE signed CMS estimate error, which a lossless
+        pipe can never produce).  ``channel``: wait only for that
+        channel's deliveries (listener-teardown scope).  Returns False
+        only when ``timeout`` (None = wait indefinitely) elapsed with
+        work still pending."""
+        import time as _time
 
-        def hold():
-            try:
-                barrier.wait(timeout)
-            except threading.BrokenBarrierError:  # pragma: no cover
-                pass
+        def pending() -> bool:
+            if channel is None:
+                return bool(self._chan_queues or self._chan_active)
+            return (
+                channel in self._chan_queues or channel in self._chan_active
+            )
 
-        for _ in range(n):
-            self._pool.submit(hold)
-        try:
-            barrier.wait(timeout)
-        except threading.BrokenBarrierError:  # pragma: no cover
-            pass
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._idle_cv:
+            while pending():
+                if deadline is None:
+                    self._idle_cv.wait(timeout=1.0)
+                else:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    self._idle_cv.wait(timeout=min(1.0, remaining))
+        return True
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)

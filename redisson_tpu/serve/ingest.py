@@ -156,7 +156,16 @@ class TopicCmsBridge:
         return self._events
 
     def close(self) -> None:
+        # Ordering: delist first (new publishes no longer target this
+        # bridge), then wait out the CHANNEL's already-queued deliveries
+        # (their target lists were snapshotted at publish, so
+        # remove_listener does not cancel them — the old close dropped
+        # exactly those), then flush buffered + in-flight batches, and
+        # only then freeze.
+        self._topic.remove_listener(self._listener_id)
+        bus = getattr(self._topic, "_bus", None)
+        if bus is not None:
+            bus.drain(channel=self._topic.get_name())
+        self.flush()
         with self._lock:
             self._closed = True
-        self._topic.remove_listener(self._listener_id)
-        self.flush()

@@ -140,3 +140,69 @@ def test_ttl_expiry_drops_topk_table():
         assert c._engine.topk.candidates("ttl-topk") == []
     finally:
         c.shutdown()
+
+
+class TestDrainExactness:
+    """TopicBus.drain must be EXACT: the old pool-rendezvous barrier broke
+    silently at its timeout, and teardown then dropped queued deliveries —
+    caught in the full-geometry bench as a NEGATIVE signed CMS estimate
+    error (a lossless pipe can never undercount)."""
+
+    def test_no_event_loss_through_bridge_teardown(self, client):
+        from redisson_tpu.serve import TopicCmsBridge
+
+        cms = client.get_count_min_sketch("drain-cms")
+        cms.try_init(4, 1 << 12, track_top_k=5)
+        bridge = TopicCmsBridge(
+            client, "drain-ev", "drain-cms",
+            batch_size=1 << 12, flush_interval_s=0.05,
+        )
+        topic = client.get_topic("drain-ev")
+        rng = np.random.default_rng(4)
+        n, chunk = 120_000, 1 << 12
+        stream = (rng.zipf(1.2, size=n) % 500).astype(np.uint64)
+        for i in range(0, n, chunk):
+            topic.publish(stream[i : i + chunk])
+        assert client._topic_bus.drain() is True
+        bridge.close()
+        true = np.bincount(stream.astype(np.int64), minlength=500)
+        for key in np.argsort(-true)[:5]:
+            est = cms.estimate(np.uint64(key))
+            assert est >= true[key], (key, est, true[key])
+
+    def test_drain_timeout_reports_pending(self, client):
+        import threading
+        import time
+
+        release = threading.Event()
+        topic = client.get_topic("drain-slow")
+        topic.add_listener(lambda ch, m: release.wait(5.0))
+        topic.publish(b"x")
+        t0 = time.monotonic()
+        assert client._topic_bus.drain(timeout=0.3) is False
+        assert time.monotonic() - t0 < 2.0
+        release.set()
+        assert client._topic_bus.drain(timeout=10.0) is True
+
+    def test_close_without_prior_drain_loses_nothing(self, client):
+        # The teardown race the old close() had: deliveries queued on the
+        # bus (targets snapshotted at publish) start AFTER flush() but
+        # BEFORE _closed — close() now waits out its channel first.
+        from redisson_tpu.serve import TopicCmsBridge
+
+        cms = client.get_count_min_sketch("close-cms")
+        cms.try_init(4, 1 << 12)
+        bridge = TopicCmsBridge(
+            client, "close-ev", "close-cms",
+            batch_size=1 << 14, flush_interval_s=5.0,  # no deadline help
+        )
+        topic = client.get_topic("close-ev")
+        n, chunk = 64_000, 1 << 11
+        stream = np.arange(n, dtype=np.uint64) % 97
+        for i in range(0, n, chunk):
+            topic.publish(stream[i : i + chunk])
+        bridge.close()  # deliberately NO bus drain first
+        for key in (0, 1, 96):
+            assert cms.estimate(np.uint64(key)) >= int(
+                np.sum(stream == key)
+            ), key
