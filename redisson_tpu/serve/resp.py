@@ -306,15 +306,39 @@ class RespServer:
                     if ctx.subs and reader.at_frame_boundary():
                         continue
                     return  # reclaim the slot
+                except OSError:
+                    return  # peer reset/aborted: plain disconnect
                 if cmd is None:
                     return
-                try:
-                    reply = self._dispatch(cmd, ctx)
-                except RespError as e:
-                    reply = _encode_error(str(e))
-                except Exception as e:  # command errors never kill the conn
-                    reply = _encode_error(f"{type(e).__name__}: {e}")
-                ctx.send(reply)
+                reply = self._safe_dispatch(cmd, ctx)
+                # Pipelined batch: commands the reader already parsed
+                # ahead reply in ONE sendall (the CommandBatchEncoder
+                # role) — syscall count stops scaling with pipeline
+                # depth.  Bounded so a huge pipeline cannot buffer
+                # unbounded reply bytes.
+                pending = reader._pending
+                if pending:
+                    out = [reply]
+                    size = len(reply)
+                    while pending and len(out) < 1024 and size < (1 << 20):
+                        # Flush buffered replies BEFORE any command that
+                        # blocks (BLPOP would hold earlier replies
+                        # hostage) or whose handler writes to the socket
+                        # ITSELF (SUBSCRIBE's ack would overtake them —
+                        # reply order must be command order).
+                        if pending[0] and pending[0][0].upper() in (
+                            b"BLPOP",
+                            b"BRPOP",
+                            b"SUBSCRIBE",
+                            b"UNSUBSCRIBE",
+                        ):
+                            break
+                        r = self._safe_dispatch(pending.popleft(), ctx)
+                        out.append(r)
+                        size += len(r)
+                    ctx.send(b"".join(out))
+                else:
+                    ctx.send(reply)
         finally:
             # Drop this connection's subscriptions with it.
             for channel, lid in list(ctx.subs.items()):
@@ -331,6 +355,16 @@ class RespServer:
             pass
 
     # -- command dispatch ---------------------------------------------------
+
+    def _safe_dispatch(self, cmd: list[bytes], ctx: "_ConnCtx") -> bytes:
+        """Dispatch with the error-encoding contract: command errors
+        never kill the connection; known codes pass through verbatim."""
+        try:
+            return self._dispatch(cmd, ctx)
+        except RespError as e:
+            return _encode_error(str(e))
+        except Exception as e:
+            return _encode_error(f"{type(e).__name__}: {e}")
 
     def _dispatch(self, cmd: list[bytes], ctx: "_ConnCtx") -> bytes:
         name = cmd[0].decode().upper()
@@ -394,12 +428,7 @@ class RespServer:
         ctx.in_exec = True  # blocking commands act non-blocking (Redis)
         try:
             for c in queued:
-                try:
-                    frames.append(self._dispatch(c, ctx))
-                except RespError as e:
-                    frames.append(_encode_error(str(e)))
-                except Exception as e:
-                    frames.append(_encode_error(f"{type(e).__name__}: {e}"))
+                frames.append(self._safe_dispatch(c, ctx))
         finally:
             ctx.in_exec = False
         return b"*" + str(len(frames)).encode() + b"\r\n" + b"".join(frames)

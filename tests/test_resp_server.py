@@ -698,3 +698,54 @@ class TestWidenedSurface:
     def test_getrange_negative_end_clamps(self, resp):
         resp.cmd("SET", "wgr", "abc")
         assert resp.cmd("GETRANGE", "wgr", "0", "-4") == b"a"
+
+    def test_pipelined_batch_with_blocking_command(self, resp):
+        # Replies buffered for a pipelined batch must FLUSH before a
+        # blocking command parks the connection thread — the GET's reply
+        # arrives while BLPOP is still waiting.
+        import time
+
+        sock = resp._sock
+        resp.cmd("SET", "pb-k", "v")
+        sock.sendall(
+            b"*2\r\n$3\r\nGET\r\n$4\r\npb-k\r\n"
+            b"*3\r\n$5\r\nBLPOP\r\n$5\r\npb-bq\r\n$1\r\n2\r\n"
+        )
+        t0 = time.monotonic()
+        assert resp._read_reply() == b"v"  # arrives BEFORE blpop resolves
+        assert time.monotonic() - t0 < 1.5
+        # feed the queue from the same test client via a second conn
+        import socket as _socket
+
+        s2 = _socket.create_connection((resp._sock.getpeername()[0],
+                                        resp._sock.getpeername()[1]))
+        s2.sendall(b"*3\r\n$5\r\nRPUSH\r\n$5\r\npb-bq\r\n$1\r\nz\r\n")
+        out = resp._read_reply()
+        assert out == [b"pb-bq", b"z"]
+        s2.close()
+
+    def test_deep_pipeline_interleaved_kinds(self, resp):
+        sock = resp._sock
+        n = 500
+        payload = b""
+        for i in range(n):
+            payload += b"*3\r\n$3\r\nSET\r\n$7\r\ndp-%04d\r\n$1\r\nx\r\n" % i
+            payload += b"*2\r\n$6\r\nEXISTS\r\n$7\r\ndp-%04d\r\n" % i
+        sock.sendall(payload)
+        for i in range(n):
+            assert resp._read_reply() == "OK"
+            assert resp._read_reply() == 1
+
+
+    def test_pipelined_ping_then_subscribe_order(self, resp):
+        # SUBSCRIBE's ack writes to the socket from its handler — the
+        # batch loop must flush buffered replies first so the PING reply
+        # is on the wire BEFORE the ack (reply order == command order).
+        sock = resp._sock
+        sock.sendall(
+            b"*1\r\n$4\r\nPING\r\n"
+            b"*2\r\n$9\r\nSUBSCRIBE\r\n$4\r\npbch\r\n"
+        )
+        assert resp._read_reply() == "PONG"
+        ack = resp._read_reply()
+        assert ack[0] == b"subscribe"
