@@ -1,0 +1,102 @@
+"""Standalone server entry point — the redis-server-analog deployment
+shape: ``python -m redisson_tpu [--port P] [--config cfg.yaml] ...``
+boots the engine and serves RESP2/RESP3 over TCP until SIGINT/SIGTERM,
+so foreign clients (redis-cli, redis-py, a stock Redisson) can use the
+framework without any Python embedding.
+
+The reference is a client library; its server is redis-server.  This
+framework carries its own keyspace, so the server role collapses into
+one process: engine + front door (SURVEY.md §2.4 comm row).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m redisson_tpu",
+        description="redisson_tpu standalone RESP server",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=6379)
+    p.add_argument(
+        "--config", help="YAML/JSON config file (Config.from_yaml)"
+    )
+    p.add_argument(
+        "--snapshot-dir",
+        help="restore-on-boot + snapshot-on-shutdown directory",
+    )
+    p.add_argument(
+        "--snapshot-interval-s", type=float, default=0.0,
+        help="arm periodic snapshots (requires --snapshot-dir)",
+    )
+    p.add_argument(
+        "--max-connections", type=int, default=256,
+    )
+    p.add_argument(
+        "--idle-timeout-s", type=float, default=300.0,
+    )
+    p.add_argument(
+        "--platform", default=None,
+        help="jax platform override (e.g. cpu for a host-only server)",
+    )
+    args = p.parse_args(argv)
+
+    import redisson_tpu
+    from redisson_tpu import Config
+    from redisson_tpu.serve.resp import RespServer
+
+    if args.config:
+        import os
+
+        if not os.path.exists(args.config):
+            p.error(f"--config file not found: {args.config}")
+        cfg = Config.from_yaml(args.config)
+    else:
+        cfg = Config().use_tpu_sketch()
+    if args.platform:
+        cfg.tpu_sketch.platform = args.platform
+    if args.snapshot_dir:
+        cfg.snapshot_dir = args.snapshot_dir
+    if args.snapshot_interval_s:
+        # Applies to the EFFECTIVE dir (flag or config file) — silently
+        # dropping the interval would fake-arm periodic snapshots.
+        if not cfg.snapshot_dir:
+            p.error("--snapshot-interval-s requires a snapshot dir "
+                    "(--snapshot-dir or config file)")
+        cfg.snapshot_interval_s = args.snapshot_interval_s
+
+    client = redisson_tpu.create(cfg)
+    server = RespServer(
+        client,
+        host=args.host,
+        port=args.port,
+        max_connections=args.max_connections,
+        idle_timeout_s=args.idle_timeout_s,
+    )
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    print(
+        f"redisson-tpu serving RESP on {server.host}:{server.port} "
+        f"(backend={client._engine.__class__.__name__})",
+        flush=True,
+    )
+    stop.wait()
+    print("shutting down (snapshot-on-shutdown if configured)", flush=True)
+    server.close()
+    client.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -251,6 +251,8 @@ class RespServer:
         self.idle_timeout_s = idle_timeout_s
         self._nconn = 0
         self._conn_lock = threading.Lock()
+        self._conn_idle = threading.Condition(self._conn_lock)
+        self._conns: set = set()  # live sockets, for shutdown drain
         # SCAN resume state: cursor id -> last key returned (see _cmd_SCAN).
         self._scan_states: dict[int, str] = {}
         self._scan_next = 0
@@ -285,6 +287,7 @@ class RespServer:
                         pass
                     continue
                 self._nconn += 1
+                self._conns.add(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,),
                 name="rtpu-resp-conn", daemon=True,
@@ -346,13 +349,34 @@ class RespServer:
             conn.close()
             with self._conn_lock:
                 self._nconn -= 1
+                self._conns.discard(conn)
+                self._conn_idle.notify_all()
 
-    def close(self) -> None:
+    def close(self, drain_timeout_s: float = 10.0) -> None:
+        """Stop accepting, force-close live connections, and wait for
+        their threads to finish the command in flight.  Ordering matters
+        for snapshot-on-shutdown: every reply already on the wire was
+        dispatched before its connection thread exits, so a snapshot
+        taken AFTER this drain contains every acked write."""
         self._closed = True
         try:
             self._sock.close()
         except OSError:
             pass
+        import time as _time
+
+        with self._conn_lock:
+            for c in list(self._conns):
+                try:
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            deadline = _time.monotonic() + drain_timeout_s
+            while self._nconn > 0:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                self._conn_idle.wait(timeout=remaining)
 
     # -- command dispatch ---------------------------------------------------
 

@@ -13,8 +13,8 @@ from redisson_tpu.serve.resp import RespServer
 class RespClient:
     """Minimal RESP2 client (what redis-py does on the wire)."""
 
-    def __init__(self, host, port):
-        self._sock = socket.create_connection((host, port), timeout=10)
+    def __init__(self, host, port, timeout=10):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
         self._buf = b""
 
     def cmd(self, *args):

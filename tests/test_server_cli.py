@@ -1,0 +1,95 @@
+"""`python -m redisson_tpu` — the standalone-server deployment shape
+(redis-server analog).  Boots a real subprocess, drives it over TCP with
+the framing-aware RespClient, restarts it, and verifies
+snapshot-on-shutdown persistence (replies acked before SIGTERM must
+survive — the server drains connections before the final snapshot)."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from test_resp_server import RespClient
+
+REPO = Path(__file__).parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(port, snap_dir):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=2"]
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "redisson_tpu",
+            "--port", str(port),
+            "--snapshot-dir", str(snap_dir),
+            "--platform", "cpu",
+        ],
+        cwd=str(REPO),
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _connect(port, deadline_s=90.0) -> RespClient:
+    t0 = time.monotonic()
+    while True:
+        try:
+            # Generous socket timeout: a cold first JAX compile can stall
+            # the first sketch command well past 10s.
+            return RespClient("127.0.0.1", port, timeout=120)
+        except OSError:
+            if time.monotonic() - t0 > deadline_s:
+                raise
+            time.sleep(0.2)
+
+
+def test_standalone_server_round_trip(tmp_path):
+    port = _free_port()
+    proc = _spawn(port, tmp_path / "snap")
+    try:
+        c = _connect(port)
+        assert c.cmd("PING") == "PONG"
+        assert c.cmd("SET", "cli-k", "v") == "OK"
+        assert c.cmd("BF.RESERVE", "cli-bf", "0.01", "1000") == "OK"
+        assert c.cmd("BF.ADD", "cli-bf", "alpha") == 1
+        assert c.cmd("BF.EXISTS", "cli-bf", "alpha") == 1
+        c.close()
+        # Graceful shutdown drains connections, then snapshots.
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=120)
+        assert proc.returncode == 0, out[-2000:]
+        # Reboot on the same snapshot dir: sketch state survives.
+        port2 = _free_port()
+        proc2 = _spawn(port2, tmp_path / "snap")
+        try:
+            c2 = _connect(port2)
+            assert c2.cmd("BF.EXISTS", "cli-bf", "alpha") == 1
+            assert c2.cmd("BF.EXISTS", "cli-bf", "nope") == 0
+            c2.close()
+        finally:
+            proc2.send_signal(signal.SIGTERM)
+            proc2.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
