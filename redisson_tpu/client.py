@@ -11,6 +11,8 @@ the in-process host data grid (SURVEY.md §7-L6).
 
 from __future__ import annotations
 
+from typing import Optional
+
 from redisson_tpu.config import Config
 from redisson_tpu.objects import BitSet, BloomFilter, CountMinSketch, HyperLogLog
 from redisson_tpu.objects.base import CamelCompatMixin
@@ -80,6 +82,38 @@ class RedissonTpuClient(CamelCompatMixin):
         # deadlock (AB-BA).
         self._engine.foreign_exists = self._grid.probe
         self._grid.foreign_exists = self._engine.probe
+        # Restore-on-create for the HOST keyspace too (the sketch side
+        # restores inside its engine init): one snapshot dir carries the
+        # whole logical keyspace — including through the engine's
+        # PERIODIC snapshotter via the snapshot_extra hook.
+        if config.snapshot_dir:
+            import os
+
+            if not hasattr(self._engine, "snapshot"):
+                import warnings
+
+                warnings.warn(
+                    "snapshot_dir is configured but the host sketch engine "
+                    "has no snapshotter: only the grid keyspace persists "
+                    "across restarts (sketch objects are lost, and "
+                    "snapshot_interval_s is inactive); use use_tpu_sketch() "
+                    "for full-keyspace persistence"
+                )
+            grid_path = os.path.join(config.snapshot_dir, "grid_store.bin")
+            try:
+                self._grid.restore_from(grid_path)
+            except Exception:
+                # The engine is already running (threads, device state,
+                # possibly an armed snapshotter that would overwrite the
+                # files being debugged) — tear it down before failing.
+                if hasattr(self._engine, "shutdown"):
+                    self._engine.shutdown()
+                raise
+            self._engine.snapshot_extra = (
+                lambda d: self._grid.snapshot_to(
+                    os.path.join(d, "grid_store.bin")
+                )
+            )
         self._topic_bus = TopicBus(n_threads=config.threads)
         import threading
 
@@ -464,10 +498,43 @@ class RedissonTpuClient(CamelCompatMixin):
 
         return Profiler()
 
+    def snapshot(self, directory: Optional[str] = None) -> None:
+        """Snapshot the WHOLE logical keyspace (sketch pools + host grid)
+        to ``directory`` (defaults to Config.snapshot_dir)."""
+        import os
+
+        directory = directory or self.config.snapshot_dir
+        if not directory:
+            raise ValueError("no snapshot directory configured")
+        os.makedirs(directory, exist_ok=True)
+        eng_snap = getattr(self._engine, "snapshot", None)
+        if eng_snap is not None:
+            eng_snap(directory)  # writes the grid too via snapshot_extra
+        if eng_snap is None or getattr(self._engine, "snapshot_extra", None) is None:
+            self._grid.snapshot_to(os.path.join(directory, "grid_store.bin"))
+
     def shutdown(self) -> None:
         """→ Redisson#shutdown."""
         if getattr(self, "_failure_monitor", None) is not None:
             self._failure_monitor.stop()
+        if self.config.snapshot_dir and getattr(
+            self._engine, "snapshot_extra", None
+        ) is None:
+            # Host-engine case only: the TPU engine's own shutdown
+            # snapshot writes the grid through the snapshot_extra hook
+            # (a second direct write here would race the snapshotter).
+            import os
+
+            try:  # best-effort persistence, like the engine's own
+                self._grid.snapshot_to(
+                    os.path.join(self.config.snapshot_dir, "grid_store.bin")
+                )
+            except Exception:  # pragma: no cover — persistence must not
+                import logging  # block shutdown, but never fail silently
+
+                logging.getLogger(__name__).exception(
+                    "grid snapshot-on-shutdown failed"
+                )
         if hasattr(self._engine, "shutdown"):
             self._engine.shutdown()
         self._grid.shutdown()

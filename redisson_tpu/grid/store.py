@@ -200,3 +200,233 @@ class GridStore:
 
     def shutdown(self) -> None:
         self._closed = True
+
+    # -- persistence (the RDB-analog for the HOST keyspace; sketch pools
+    # snapshot separately in objects/durability.py).  DATA-ONLY wire
+    # format — no pickle (snapshots may be moved between machines):
+    # RTPG | u32 meta_len | json meta | u32-length-prefixed blobs.
+    # Values reference blobs by index.  Persisted kinds: bucket,
+    # binarystream, set, setcache, zset, lexset, map, mapcache, list
+    # (queues/deques share it), ringbuffer, atomic counters/adders,
+    # idgenerator.  NOT persisted (skipped with a summary warning):
+    # coordination state (locks, latches, semaphores), streams, delayed/
+    # priority queues, geo, timeseries, multimaps, and sortedset (its
+    # in-memory order is codec-decoded, which the store cannot rebuild).
+    # ----------------------------------------------------------------------
+
+    _SNAP_MAGIC = b"RTPG"
+    _SNAP_VERSION = 1
+
+    @staticmethod
+    def _enc_entry(kind: str, value, add_blob):
+        """-> JSON-safe value descriptor, or None if kind unsupported."""
+        if kind in ("bucket", "binarystream"):
+            if value is None:
+                return {"t": "none"}
+            if isinstance(value, str):  # legacy str bucket payloads
+                value = value.encode()
+            if not isinstance(value, bytes):
+                return None
+            return {"t": "b", "v": add_blob(value)}
+        if kind == "set":
+            return {"t": "set", "m": [add_blob(vb) for vb in value]}
+        if kind == "setcache":
+            return {
+                "t": "setc",
+                "m": [[add_blob(vb), exp] for vb, exp in value.data.items()],
+            }
+        if kind == "zset":
+            return {
+                "t": "zset",
+                "m": [[add_blob(vb), s] for vb, s in value.items()],
+            }
+        if kind == "lexset":
+            return {"t": "lex", "m": sorted(value)}
+        if kind in ("map", "mapcache"):
+            now = time.time()
+            rows = []
+            for kb, slot in value.data.items():
+                vb, exp, idle, last = slot
+                elapsed = now - last
+                if idle is not None and elapsed >= idle:
+                    continue  # idle-dead at snapshot time: do not resurrect
+                rows.append(
+                    [add_blob(kb), add_blob(vb), exp, idle, elapsed]
+                )
+            return {"t": "map", "m": rows}
+        if kind == "list":
+            return {"t": "list", "m": [add_blob(vb) for vb in value]}
+        if kind in ("atomiclong", "atomicdouble", "longadder", "doubleadder"):
+            return {"t": "num", "v": value}
+        if kind == "idgenerator":
+            return {"t": "idgen", "next": value["next"], "block": value["block"]}
+        if kind == "ringbuffer":
+            return {
+                "t": "ring",
+                "cap": value["cap"],
+                "m": [add_blob(vb) for vb in value["items"]],
+            }
+        return None
+
+    @staticmethod
+    def _dec_entry(desc: dict, blobs):
+        t = desc["t"]
+        if t == "none":
+            return None
+        if t == "b":
+            return blobs[desc["v"]]
+        if t == "set":
+            return {blobs[i]: None for i in desc["m"]}
+        if t == "setc":
+            from redisson_tpu.grid.collections import SetCache
+
+            v = SetCache._Value()
+            v.data = {blobs[i]: exp for i, exp in desc["m"]}
+            return v
+        if t == "zset":
+            return {blobs[i]: float(s) for i, s in desc["m"]}
+        if t == "lex":
+            return set(desc["m"])
+        if t == "map":
+            from redisson_tpu.grid.maps import _MapValue
+
+            v = _MapValue()
+            now = time.time()
+            # last_access carries over as ELAPSED idle: an entry that had
+            # burned 40s of a 60s max-idle window resumes with 20s left,
+            # not a fresh window (RMapCache max-idle contract).
+            v.data = {
+                blobs[ki]: [blobs[vi], exp, idle, now - elapsed]
+                for ki, vi, exp, idle, elapsed in desc["m"]
+            }
+            return v
+        if t == "list":
+            return [blobs[i] for i in desc["m"]]
+        if t == "num":
+            return desc["v"]
+        if t == "idgen":
+            return {"next": int(desc["next"]), "block": int(desc["block"])}
+        if t == "ring":
+            return {"cap": int(desc["cap"]), "items": [blobs[i] for i in desc["m"]]}
+        raise ValueError(f"unknown grid snapshot value type {t!r}")
+
+    def snapshot_to(self, path: str) -> int:
+        """Write every persistable live entry; returns the count written.
+        Atomic (tmp + rename)."""
+        import io
+        import json
+        import os
+        import struct
+
+        blobs: list[bytes] = []
+
+        def add_blob(b: bytes) -> int:
+            blobs.append(bytes(b))
+            return len(blobs) - 1
+
+        meta = []
+        skipped: dict[str, int] = {}
+        now = time.time()
+        with self.lock:
+            for name, e in self._data.items():
+                if e.expired(now):
+                    continue
+                desc = self._enc_entry(e.kind, e.value, add_blob)
+                if desc is None:
+                    skipped[e.kind] = skipped.get(e.kind, 0) + 1
+                    continue
+                meta.append(
+                    {
+                        "name": name,
+                        "kind": e.kind,
+                        "expire_at": e.expire_at,
+                        "value": desc,
+                    }
+                )
+        if skipped:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "grid snapshot skipped non-persisted kinds: %s", skipped
+            )
+        header = json.dumps({"v": self._SNAP_VERSION, "entries": meta}).encode()
+        buf = io.BytesIO()
+        buf.write(self._SNAP_MAGIC)
+        buf.write(struct.pack("<I", len(header)))
+        buf.write(header)
+        for b in blobs:
+            buf.write(struct.pack("<I", len(b)))
+            buf.write(b)
+        import uuid
+
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        # Unique per WRITER (not just per process): the shutdown writer
+        # and the periodic snapshotter thread may race on the same path;
+        # identical tmp names would truncate each other mid-write.
+        tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as f:
+            f.write(buf.getvalue())
+        os.replace(tmp, path)
+        return len(meta)
+
+    def restore_from(self, path: str) -> bool:
+        """Load a snapshot written by ``snapshot_to``; True if one was
+        found.  Intended at init (empty keyspace); existing names are
+        overwritten (same-name restore-on-boot semantics as the sketch
+        side's empty-keyspace contract, enforced by call order)."""
+        import json
+        import os
+        import struct
+
+        if not os.path.exists(path):
+            return False
+        with open(path, "rb") as f:
+            data = f.read()
+        if data[:4] != self._SNAP_MAGIC:
+            raise ValueError("not a grid snapshot (bad magic)")
+        (hlen,) = struct.unpack("<I", data[4:8])
+        head = json.loads(data[8 : 8 + hlen].decode())
+        if head.get("v") != self._SNAP_VERSION:
+            raise ValueError(f"unsupported grid snapshot v{head.get('v')}")
+        blobs: list[bytes] = []
+        off = 8 + hlen
+        while off < len(data):
+            (n,) = struct.unpack("<I", data[off : off + 4])
+            off += 4
+            if off + n > len(data):
+                raise ValueError("truncated grid snapshot blob")
+            blobs.append(data[off : off + n])
+            off += n
+        now = time.time()
+        clashes = []
+        with self.lock:
+            for ent in head["entries"]:
+                exp = ent.get("expire_at")
+                if exp is not None and now >= exp:
+                    continue  # expired while on disk
+                if self.foreign_exists is not None and self.foreign_exists(
+                    ent["name"]
+                ):
+                    # The sketch and grid halves snapshot at different
+                    # instants; a name that moved between backends in that
+                    # window must not end up live on BOTH (the one-
+                    # logical-keyspace invariant).  Sketch wins: it was
+                    # captured under the engine locks.
+                    clashes.append(ent["name"])
+                    continue
+                ge = GridEntry(ent["kind"], self._dec_entry(ent["value"], blobs))
+                ge.expire_at = exp
+                self._data[ent["name"]] = ge
+                if exp is not None:
+                    self._ensure_sweeper()
+            self.cond.notify_all()
+        if clashes:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "grid restore skipped %d name(s) held by the sketch "
+                "backend (snapshot halves raced): %s",
+                len(clashes), clashes[:5],
+            )
+        return True

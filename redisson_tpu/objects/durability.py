@@ -303,6 +303,12 @@ class SketchDurabilityMixin:
             json.dump(meta, f)
         os.replace(tmp_npz, os.path.join(directory, _SNAP_POOLS))
         os.replace(tmp_meta, os.path.join(directory, _SNAP_META))
+        # Companion-state hook (the client wires the grid keyspace here):
+        # runs outside the engine locks, so periodic snapshots persist
+        # the WHOLE logical keyspace, not just sketch pools.
+        hook = getattr(self, "snapshot_extra", None)
+        if hook is not None:
+            hook(directory)
 
     def restore_snapshot(self, directory: str) -> bool:
         """Load a snapshot written by ``snapshot``; True if one was found.
@@ -660,4 +666,9 @@ class SketchDurabilityMixin:
         sn = getattr(self, "_snapshotter", None)
         if sn is not None:
             sn[1].set()
+            # Join: a snapshot may be mid-write; the shutdown path's own
+            # final snapshot must not interleave with it on the same
+            # files (tmp names are unique, but last-writer-wins on the
+            # rename — the FINAL snapshot must be the final state).
+            sn[0].join(timeout=30.0)
             self._snapshotter = None

@@ -85,6 +85,8 @@ def test_standalone_server_round_trip(tmp_path):
             c2 = _connect(port2)
             assert c2.cmd("BF.EXISTS", "cli-bf", "alpha") == 1
             assert c2.cmd("BF.EXISTS", "cli-bf", "nope") == 0
+            # The HOST keyspace persists too (grid_store.bin).
+            assert c2.cmd("GET", "cli-k") == b"v"
             c2.close()
         finally:
             proc2.send_signal(signal.SIGTERM)
