@@ -15,14 +15,15 @@ Command surface (the subset the north-star objects + grid need):
   CMS.INITBYDIM CMS.INCRBY CMS.QUERY CMS.MERGE CMS.INFO  (RedisBloom CMS)
   TOPK.RESERVE TOPK.ADD TOPK.INCRBY TOPK.QUERY TOPK.COUNT
   TOPK.LIST TOPK.INFO            (RedisBloom Top-K over the CMS engine)
-  LPUSH RPUSH LPOP RPOP LLEN LRANGE LINDEX LSET LREM LTRIM RPOPLPUSH
+  LPUSH RPUSH LPUSHX RPUSHX LPOP RPOP LLEN LRANGE LINDEX LSET LREM
+  LTRIM RPOPLPUSH
   BLPOP BRPOP                                       (condvar blocking pops)
   HSET HGET HDEL HLEN HGETALL HMGET HKEYS HVALS HEXISTS HSETNX HINCRBY
   SADD SREM SISMEMBER SCARD SMEMBERS SMISMEMBER SPOP SRANDMEMBER SMOVE
-  SINTER SUNION SDIFF
+  SINTER SUNION SDIFF SINTERSTORE SUNIONSTORE SDIFFSTORE
   ZADD ZSCORE ZRANGE ZCARD ZREM ZINCRBY ZRANK ZCOUNT ZRANGEBYSCORE
-  ZPOPMIN ZPOPMAX
-  INCR INCRBY DECR
+  ZPOPMIN ZPOPMAX ZREVRANGE ZREVRANK ZREMRANGEBYSCORE
+  INCR INCRBY DECR INCRBYFLOAT
   PUBLISH SUBSCRIBE UNSUBSCRIBE           (push replies; '>' on RESP3)
   HELLO CLIENT INFO COMMAND               (RESP2/RESP3 negotiation, admin)
   MULTI EXEC DISCARD                                (contiguous-exec txn)
@@ -1022,6 +1023,18 @@ class RespServer:
             lst.add_first(v)
         return _encode_int(lst.size())
 
+    def _cmd_LPUSHX(self, args):
+        with self._client._grid.lock:
+            if not self._client._grid.exists(self._s(args[0])):
+                return _encode_int(0)
+            return self._cmd_LPUSH(args)
+
+    def _cmd_RPUSHX(self, args):
+        with self._client._grid.lock:
+            if not self._client._grid.exists(self._s(args[0])):
+                return _encode_int(0)
+            return self._cmd_RPUSH(args)
+
     def _cmd_LPOP(self, args):
         return _encode_bulk(self._list(args[0]).poll_first())
 
@@ -1273,6 +1286,34 @@ class RespServer:
             others.update(self._set(a).read_all())
         return _encode_array([v for v in out if v not in others])
 
+    def _store_set(self, dest: bytes, members) -> bytes:
+        with self._client._grid.lock:
+            if not members:
+                # Redis deletes the destination on an empty result.
+                self._client._grid.delete(self._s(dest))
+            else:
+                self._client._grid.put_entry(
+                    self._s(dest), "set", {vb: None for vb in members}
+                )
+        return _encode_int(len(members))
+
+    def _cmd_SINTERSTORE(self, args):
+        sets = [set(self._set(a).read_all()) for a in args[1:]]
+        return self._store_set(args[0], sorted(set.intersection(*sets)))
+
+    def _cmd_SUNIONSTORE(self, args):
+        out: set = set()
+        for a in args[1:]:
+            out.update(self._set(a).read_all())
+        return self._store_set(args[0], sorted(out))
+
+    def _cmd_SDIFFSTORE(self, args):
+        first = self._set(args[1]).read_all()
+        others: set = set()
+        for a in args[2:]:
+            others.update(self._set(a).read_all())
+        return self._store_set(args[0], [v for v in first if v not in others])
+
     # sorted sets
 
     def _zset(self, key: bytes):
@@ -1388,6 +1429,45 @@ class RespServer:
 
     def _cmd_ZPOPMAX(self, args):
         return self._zpop(args, False)
+
+    def _cmd_ZREVRANGE(self, args):
+        z = self._zset(args[0])
+        withscores = any(a.upper() == b"WITHSCORES" for a in args[3:])
+        start, end = int(args[1]), int(args[2])
+        # rev-range indexes count from the HIGHEST score; n derives from
+        # the ONE snapshot (a second size() call could race a mutation).
+        entries = list(reversed(z.entry_range(0, -1)))
+        n = len(entries)
+        if start < 0:
+            start = max(0, n + start)
+        if end < 0:
+            end = n + end
+            if end < 0:
+                return _encode_array([])  # beyond-left end: empty, Redis
+        entries = entries[start : end + 1]
+        if not withscores:
+            return _encode_array([m for m, _ in entries])
+        flat = []
+        for m, sc in entries:
+            flat.extend([m, _fmt_score(sc)])
+        return _encode_array(flat)
+
+    def _cmd_ZREVRANK(self, args):
+        z = self._zset(args[0])
+        r = z.rank(args[1])
+        if r is None:
+            return b"$-1\r\n"
+        return _encode_int(z.size() - 1 - r)
+
+    def _cmd_ZREMRANGEBYSCORE(self, args):
+        z = self._zset(args[0])
+        with self._client._grid.lock:  # atomic filter+remove (RLock)
+            members = [
+                m for m, _ in self._score_filtered(z, args[1], args[2])
+            ]
+            for m in members:
+                z.remove(m)
+        return _encode_int(len(members))
 
     # protocol negotiation (→ RESP3's HELLO; the reference speaks
     # RESP2/RESP3 through Netty — SURVEY.md §2.4 comm row)
@@ -1513,21 +1593,46 @@ class RespServer:
             )
         return out
 
-    # counters
+    # counters — one NUMERIC key per name: Redis INCR/INCRBYFLOAT share a
+    # string key, so the int and float forms here must interoperate (the
+    # entry's kind converts with the operation; INCR on a non-integral
+    # value errors like Redis's "not an integer").
+
+    def _numeric_incr(self, key: bytes, delta, is_float: bool):
+        grid = self._client._grid
+        name = self._s(key)
+        with grid.lock:
+            e = grid.get_entry(name)
+            if e is None:
+                cur = 0
+            elif e.kind in ("atomiclong", "atomicdouble"):
+                cur = e.value
+            else:
+                raise TypeError(
+                    f"object {name!r} holds a {e.kind}, not a counter"
+                )
+            if is_float:
+                new = float(cur) + float(delta)
+                grid.put_entry(name, "atomicdouble", new)
+            else:
+                if float(cur) != int(cur):
+                    raise RespError(
+                        "value is not an integer or out of range"
+                    )
+                new = int(cur) + int(delta)
+                grid.put_entry(name, "atomiclong", new)
+            return new
 
     def _cmd_INCR(self, args):
-        return _encode_int(
-            self._client.get_atomic_long(self._s(args[0])).increment_and_get()
+        return _encode_int(self._numeric_incr(args[0], 1, False))
+
+    def _cmd_INCRBYFLOAT(self, args):
+        return _encode_bulk(
+            _fmt_score(self._numeric_incr(args[0], float(args[1]), True))
         )
 
     def _cmd_INCRBY(self, args):
-        return _encode_int(
-            self._client.get_atomic_long(self._s(args[0])).add_and_get(
-                int(args[1])
-            )
-        )
+        return _encode_int(self._numeric_incr(args[0], int(args[1]), False))
 
     def _cmd_DECR(self, args):
-        return _encode_int(
-            self._client.get_atomic_long(self._s(args[0])).add_and_get(-1)
-        )
+        return _encode_int(self._numeric_incr(args[0], -1, False))

@@ -749,3 +749,49 @@ class TestWidenedSurface:
         assert resp._read_reply() == "PONG"
         ack = resp._read_reply()
         assert ack[0] == b"subscribe"
+
+    def test_zrev_and_remrange(self, resp):
+        resp.cmd("ZADD", "zr", "1", "a", "2", "b", "3", "c")
+        assert resp.cmd("ZREVRANGE", "zr", "0", "-1") == [b"c", b"b", b"a"]
+        assert resp.cmd("ZREVRANGE", "zr", "0", "1", "WITHSCORES") == [
+            b"c", b"3", b"b", b"2"]
+        assert resp.cmd("ZREVRANK", "zr", "c") == 0
+        assert resp.cmd("ZREMRANGEBYSCORE", "zr", "2", "(3") == 1
+        assert resp.cmd("ZCARD", "zr") == 2
+
+    def test_set_store_variants(self, resp):
+        resp.cmd("SADD", "ss1", "a", "b", "c")
+        resp.cmd("SADD", "ss2", "b", "c", "d")
+        assert resp.cmd("SINTERSTORE", "ssd", "ss1", "ss2") == 2
+        assert sorted(resp.cmd("SMEMBERS", "ssd")) == [b"b", b"c"]
+        assert resp.cmd("SUNIONSTORE", "ssu", "ss1", "ss2") == 4
+        assert resp.cmd("SDIFFSTORE", "ssx", "ss1", "ss2") == 1
+        assert resp.cmd("SMEMBERS", "ssx") == [b"a"]
+        assert resp.cmd("TYPE", "ssd") == "set"
+
+    def test_pushx_and_incrbyfloat(self, resp):
+        assert resp.cmd("LPUSHX", "nolist", "x") == 0
+        resp.cmd("RPUSH", "plist", "a")
+        assert resp.cmd("RPUSHX", "plist", "b") == 2
+        assert resp.cmd("LPUSHX", "plist", "z") == 3
+        assert resp.cmd("INCRBYFLOAT", "fctr", "1.5") == b"1.5"
+        assert resp.cmd("INCRBYFLOAT", "fctr", "2.5") == b"4"
+
+    def test_numeric_int_float_interop(self, resp):
+        assert resp.cmd("INCRBY", "nk", "1") == 1
+        assert resp.cmd("INCRBYFLOAT", "nk", "0.5") == b"1.5"
+        with pytest.raises(RuntimeError, match="not an integer"):
+            resp.cmd("INCR", "nk")  # non-integral value, Redis error
+        assert resp.cmd("INCRBYFLOAT", "nk", "0.5") == b"2"
+        assert resp.cmd("INCR", "nk") == 3  # integral again: int ops resume
+
+    def test_store_empty_result_deletes_dest(self, resp):
+        resp.cmd("SADD", "se1", "x")
+        resp.cmd("SADD", "se2", "y")
+        resp.cmd("SET", "sed", "old")
+        assert resp.cmd("SINTERSTORE", "sed", "se1", "se2") == 0
+        assert resp.cmd("EXISTS", "sed") == 0
+
+    def test_zrevrange_beyond_left_end(self, resp):
+        resp.cmd("ZADD", "zb", "1", "a", "2", "b", "3", "c")
+        assert resp.cmd("ZREVRANGE", "zb", "0", "-5") == []
