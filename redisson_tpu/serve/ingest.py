@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Optional
 
+import numpy as np
+
 
 class TopicCmsBridge:
     """Subscribes to a topic and streams every message into a
@@ -35,7 +37,9 @@ class TopicCmsBridge:
         flush_interval_s: float = 0.005,
         weight_fn=None,
         max_inflight: int = 8,
+        max_launch_events: int = 1 << 18,
     ):
+        self._client = client
         self._cms = client.get_count_min_sketch(cms_name)
         self._topic = client.get_topic(topic_name)
         self._batch_size = batch_size
@@ -46,6 +50,17 @@ class TopicCmsBridge:
         self._active = 0  # _on_message calls currently executing
         self._buf: list = []
         self._weights: Optional[list] = [] if weight_fn else None
+        # Array messages coalesce here up to max_launch_events before one
+        # device launch: per-launch cost on the bench link is latency-
+        # dominated, so a 32k-event message per launch caps throughput at
+        # ~launch-rate; 8 messages per launch is ~8x in slow phases.
+        # Entries are (array, weights|None) pairs; only SAME-dtype
+        # messages coalesce (concatenating mixed dtypes would upcast and
+        # change codec encodings — the count_min_sketch offer hazard).
+        self._abuf: list = []
+        self._abuf_n = 0
+        self._last_aflush = time.monotonic()
+        self._max_launch_events = max_launch_events
         self._inflight: list = []
         self._max_inflight = max_inflight
         self._events = 0
@@ -63,21 +78,30 @@ class TopicCmsBridge:
         """One message = one event, or — the high-throughput shape — an
         ndarray of events batched at the producer (the Kafka-style
         pattern; per-event Python dispatch tops out ~200k events/s).
-        Array messages are already batches: they dispatch directly,
-        skipping the per-event buffer; ``weight_fn`` then receives the
-        whole array and may return per-event weights."""
-        import numpy as np
-
+        Array messages coalesce into up-to-``max_launch_events`` device
+        launches (per-launch cost dominates on a latency-bound link);
+        ``weight_fn`` receives each whole array and may return per-event
+        weights."""
         with self._lock:
             if self._closed:
                 return
             self._active += 1
         try:
             if isinstance(message, np.ndarray):
+                w = self._weight_fn(message) if self._weight_fn else None
+                pre = post = None
                 with self._lock:
                     self._events += len(message)
-                w = self._weight_fn(message) if self._weight_fn else None
-                self._dispatch(message, w)
+                    if self._abuf and self._abuf[0][0].dtype != message.dtype:
+                        pre = self._take_arrays_locked()  # dtype boundary
+                    self._abuf.append((message, w))
+                    self._abuf_n += len(message)
+                    if self._abuf_n >= self._max_launch_events:
+                        post = self._take_arrays_locked()
+                if pre is not None:
+                    self._dispatch(*self._concat_arrays(pre))
+                if post is not None:
+                    self._dispatch(*self._concat_arrays(post))
                 return
             flush_now = None
             with self._lock:
@@ -104,6 +128,37 @@ class TopicCmsBridge:
         self._last_flush = time.monotonic()
         return buf, w
 
+    def _take_arrays_locked(self):
+        """Detach the coalesced (array, weights) pairs — concatenation
+        happens OUTSIDE the lock (multi-MB copies must not serialize
+        listener delivery)."""
+        pairs, self._abuf = self._abuf, []
+        self._abuf_n = 0
+        self._last_aflush = time.monotonic()
+        return (pairs,)
+
+    @staticmethod
+    def _concat_arrays(taken):
+        (pairs,) = taken
+        arrays = [a for a, _ in pairs]
+        buf = arrays[0] if len(arrays) == 1 else np.concatenate(arrays)
+        ws = [w for _, w in pairs]
+        if all(w is None for w in ws):
+            return buf, None
+        # Mixed per-message weights: None means "count 1 per event";
+        # scalars broadcast — normalize each to a per-event array so the
+        # concatenation stays aligned with its events.
+        full = []
+        for a, w in pairs:
+            if w is None:
+                full.append(np.ones(len(a), np.int64))
+            else:
+                w = np.asarray(w)
+                full.append(
+                    np.full(len(a), int(w), np.int64) if w.ndim == 0 else w
+                )
+        return buf, np.concatenate(full)
+
     def _dispatch(self, buf, weights) -> None:
         fut = self._cms.add_all_async(buf, weights)
         with self._lock:
@@ -114,8 +169,10 @@ class TopicCmsBridge:
                 else []
             )
             self._inflight = self._inflight[len(drain):]
-        for f in drain:
-            f.result()
+        if drain:
+            # One mailbox flush for the whole drained window (each host
+            # fetch costs a link round trip — the slow-phase killer).
+            self._client.collect(drain)
 
     def _deadline_loop(self) -> None:
         while True:
@@ -123,13 +180,29 @@ class TopicCmsBridge:
             with self._lock:
                 if self._closed:
                     return
-                due = (
-                    self._buf
-                    and time.monotonic() - self._last_flush >= self._interval
+                now = time.monotonic()
+                pending = (
+                    self._take_locked()
+                    if (
+                        self._buf
+                        and now - self._last_flush >= self._interval
+                    )
+                    else None
                 )
-                pending = self._take_locked() if due else None
+                # Separate staleness clock: scalar-path flushes must not
+                # keep resetting the array buffer's deadline (starvation).
+                apending = (
+                    self._take_arrays_locked()
+                    if (
+                        self._abuf
+                        and now - self._last_aflush >= self._interval
+                    )
+                    else None
+                )
             if pending is not None:
                 self._dispatch(*pending)
+            if apending is not None:
+                self._dispatch(*self._concat_arrays(apending))
 
     # -- control -----------------------------------------------------------
 
@@ -142,14 +215,17 @@ class TopicCmsBridge:
                 self._idle.wait(timeout=5.0)
         with self._lock:
             pending = self._take_locked() if self._buf else None
+            apending = self._take_arrays_locked() if self._abuf else None
         if pending is not None:
             self._dispatch(*pending)
+        if apending is not None:
+            self._dispatch(*self._concat_arrays(apending))
         while True:
             with self._lock:
-                if not self._inflight:
-                    return
-                fut = self._inflight.pop(0)
-            fut.result()
+                batch, self._inflight = self._inflight, []
+            if not batch:
+                return
+            self._client.collect(batch)  # one flush, not N fetches
 
     @property
     def events_ingested(self) -> int:

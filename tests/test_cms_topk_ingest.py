@@ -206,3 +206,84 @@ class TestDrainExactness:
             assert cms.estimate(np.uint64(key)) >= int(
                 np.sum(stream == key)
             ), key
+
+
+class TestArrayCoalescing:
+    """Array-message launch coalescing (max_launch_events): weights stay
+    aligned, dtype boundaries split launches, sub-threshold buffers
+    flush on their own deadline clock."""
+
+    def test_weights_align_across_mixed_messages(self, client):
+        from redisson_tpu.serve import TopicCmsBridge
+
+        cms = client.get_count_min_sketch("co-cms")
+        cms.try_init(4, 1 << 12)
+        # weight_fn: None for the first array (default-1), per-event for
+        # the second, scalar for the third.
+        state = {"n": 0}
+
+        def wf(arr):
+            state["n"] += 1
+            if state["n"] == 1:
+                return None
+            if state["n"] == 2:
+                return np.full(len(arr), 3, np.int64)
+            return np.int64(5)
+
+        bridge = TopicCmsBridge(
+            client, "co-ev", "co-cms", weight_fn=wf,
+            flush_interval_s=0.05, max_launch_events=1 << 20,
+        )
+        topic = client.get_topic("co-ev")
+        topic.publish(np.array([1, 1], dtype=np.uint64))       # w=1 each
+        topic.publish(np.array([2, 2], dtype=np.uint64))       # w=3 each
+        topic.publish(np.array([3], dtype=np.uint64))          # w=5
+        client._topic_bus.drain()
+        bridge.close()
+        assert cms.estimate(np.uint64(1)) == 2
+        assert cms.estimate(np.uint64(2)) == 6
+        assert cms.estimate(np.uint64(3)) == 5
+
+    def test_subthreshold_arrays_flush_on_deadline(self, client):
+        import time as _time
+
+        from redisson_tpu.serve import TopicCmsBridge
+
+        cms = client.get_count_min_sketch("dl-cms")
+        cms.try_init(4, 1 << 12)
+        bridge = TopicCmsBridge(
+            client, "dl-ev", "dl-cms",
+            flush_interval_s=0.05, max_launch_events=1 << 20,
+        )
+        topic = client.get_topic("dl-ev")
+        topic.publish(np.array([9, 9, 9], dtype=np.uint64))
+        client._topic_bus.drain()
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            if cms.estimate(np.uint64(9)) == 3:
+                break
+            _time.sleep(0.05)
+        assert cms.estimate(np.uint64(9)) == 3, "deadline flush starved"
+        bridge.close()
+
+    def test_dtype_boundary_splits_launches(self, client):
+        from redisson_tpu.serve import TopicCmsBridge
+
+        cms = client.get_count_min_sketch("dt-cms")
+        cms.try_init(4, 1 << 12)
+        bridge = TopicCmsBridge(
+            client, "dt-ev", "dt-cms",
+            flush_interval_s=0.05, max_launch_events=1 << 20,
+        )
+        topic = client.get_topic("dt-ev")
+        # uint64 then uint32: concatenating would upcast the uint32 keys
+        # and change their codec encoding — they must launch separately.
+        topic.publish(np.array([7, 7], dtype=np.uint64))
+        topic.publish(np.array([7, 7, 7], dtype=np.uint32))
+        client._topic_bus.drain()
+        bridge.close()
+        # Each dtype keeps ITS OWN codec encoding (np.uint32(7) and
+        # np.uint64(7) are distinct keys under the default codec) — an
+        # upcasting concat would have merged them into one phantom key.
+        assert cms.estimate(np.uint64(7)) == 2
+        assert cms.estimate(np.uint32(7)) == 3
