@@ -26,6 +26,12 @@
 
 #include <stdint.h>
 
+/* The build probes cc/gcc/g++/clang in order; under a C++ compiler the
+ * symbols must not mangle (ctypes looks them up by C name). */
+#ifdef __cplusplus
+extern "C" {
+#endif
+
 long rtpu_resp_parse(const unsigned char *buf, long len,
                      long max_frames, long max_args_total,
                      long *counts, long *offs, long *lens,
@@ -153,3 +159,7 @@ long rtpu_resp_encode_ints(const long *vals, long n, unsigned char *out,
     }
     return w;
 }
+
+#ifdef __cplusplus
+}
+#endif

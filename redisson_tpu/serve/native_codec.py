@@ -135,7 +135,10 @@ def get_parser() -> Optional[NativeRespParser]:
                 return None
             lib = ctypes.CDLL(_SO)
             _parser = NativeRespParser(lib)
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: the .so built but exports mangled/missing
+            # symbols (e.g. compiled as C++ without extern "C") — degrade
+            # to the Python parser instead of crashing every connection.
             _load_failed = True
             return None
     return NativeRespParser(_parser._lib)

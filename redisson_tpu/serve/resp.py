@@ -46,6 +46,11 @@ class RespError(Exception):
     pass
 
 
+class ProtocolError(Exception):
+    """Unrecoverable wire-format violation: reply once, then close (the
+    Redis 'Protocol error' behavior)."""
+
+
 def _encode_simple(s: str) -> bytes:
     return b"+" + s.encode() + b"\r\n"
 
@@ -54,7 +59,7 @@ def _encode_simple(s: str) -> bytes:
 # '-BUSYKEY ...', not '-ERR BUSYKEY ...').  An explicit allowlist — a
 # shape heuristic would hijack messages that merely START with a command
 # name ('EXEC without MULTI' must stay '-ERR EXEC without MULTI').
-_ERROR_CODES = ("BUSYKEY", "NOPROTO", "WRONGTYPE", "NOSCRIPT")
+_ERROR_CODES = ("BUSYKEY", "NOPROTO", "WRONGTYPE", "NOSCRIPT", "EXECABORT")
 
 
 def _encode_error(s: str) -> bytes:
@@ -197,13 +202,19 @@ class _Reader:
             # inline command (redis-cli fallback)
             self.frame_started = False
             return line.split()
-        n = int(line[1:])
+        try:
+            n = int(line[1:])
+        except ValueError:
+            raise ProtocolError("invalid multibulk length")
         args = []
         for _ in range(n):
             hdr = self._read_line()
             if hdr is None or not hdr.startswith(b"$"):
                 return None
-            size = int(hdr[1:])
+            try:
+                size = int(hdr[1:])
+            except ValueError:
+                raise ProtocolError("invalid bulk length")
             data = self._read_exact(size)
             if data is None:
                 return None
@@ -232,7 +243,14 @@ class _ConnCtx:
             try:
                 self.sock.sendall(frame)
             except OSError:
-                pass  # peer gone; the read loop will notice
+                # Includes socket.timeout: the connection's timeout covers
+                # sendall too, and a timed-out/failed send may have written
+                # a PARTIAL frame — continuing would desync the reply
+                # stream.  Kill the socket; the read loop reclaims the slot.
+                try:
+                    self.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
 
 
 class RespServer:
@@ -295,8 +313,17 @@ class RespServer:
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        reader = _Reader(conn)
-        ctx = _ConnCtx(conn)
+        try:
+            reader = _Reader(conn)
+            ctx = _ConnCtx(conn)
+        except Exception:
+            # Constructor failure must not leak the connection slot.
+            conn.close()
+            with self._conn_lock:
+                self._nconn -= 1
+                self._conns.discard(conn)
+                self._conn_idle.notify_all()
+            raise
         if self.idle_timeout_s:
             conn.settimeout(self.idle_timeout_s)
         try:
@@ -312,6 +339,9 @@ class RespServer:
                     return  # reclaim the slot
                 except OSError:
                     return  # peer reset/aborted: plain disconnect
+                except ProtocolError as e:
+                    ctx.send(_encode_error(f"Protocol error: {e}"))
+                    return  # desynced stream: close, Redis-style
                 if cmd is None:
                     return
                 reply = self._safe_dispatch(cmd, ctx)
@@ -388,6 +418,13 @@ class RespServer:
             return self._dispatch(cmd, ctx)
         except RespError as e:
             return _encode_error(str(e))
+        except TypeError as e:
+            # Kind guards raise TypeError — clients key on the WRONGTYPE
+            # code (redis-py maps it to a dedicated exception class).
+            return _encode_error(
+                "WRONGTYPE Operation against a key holding the wrong kind "
+                f"of value ({e})"
+            )
         except Exception as e:
             return _encode_error(f"{type(e).__name__}: {e}")
 
@@ -448,7 +485,9 @@ class RespServer:
             raise RespError("EXEC without MULTI")
         queued, ctx.queued, ctx.in_multi = ctx.queued, [], False
         if queued is None:  # a queue-time error poisons the transaction
-            raise RespError("Transaction discarded because of previous errors")
+            raise RespError(
+                "EXECABORT Transaction discarded because of previous errors"
+            )
         frames = []
         ctx.in_exec = True  # blocking commands act non-blocking (Redis)
         try:
@@ -616,6 +655,8 @@ class RespServer:
     def _cmd_SETRANGE(self, args):
         b = self._bucket(args[0])
         off = int(args[1])
+        if off < 0:
+            raise RespError("offset is out of range")
         with self._client._grid.lock:  # atomic RMW
             v = bytearray(b.get() or b"")
             if len(v) < off + len(args[2]):
@@ -1112,8 +1153,11 @@ class RespServer:
     def _cmd_LSET(self, args):
         lst = self._listidx(args[0])
         i = int(args[1])
+        n = lst.size()
         if i < 0:
-            i += lst.size()
+            i += n
+        if not 0 <= i < n:
+            raise RespError("index out of range")
         lst.set(i, args[2])
         return _encode_simple("OK")
 
@@ -1241,14 +1285,26 @@ class RespServer:
     def _cmd_SPOP(self, args):
         s = self._set(args[0])
         if len(args) > 1:
-            return _encode_array(s.remove_random(int(args[1])))
+            count = int(args[1])
+            if count < 0:
+                raise RespError("value is out of range, must be positive")
+            return _encode_array(s.remove_random(min(count, s.size())))
         out = s.remove_random(1)
         return _encode_bulk(out[0] if out else None)
 
     def _cmd_SRANDMEMBER(self, args):
         s = self._set(args[0])
         if len(args) > 1:
-            return _encode_array(s.random(int(args[1])))
+            count = int(args[1])
+            if count < 0:
+                # Redis: |count| members, duplicates allowed.
+                import random as _random
+
+                vals = s.read_all()
+                if not vals:
+                    return _encode_array([])
+                return _encode_array(_random.choices(vals, k=-count))
+            return _encode_array(s.random(min(count, s.size())))
         out = s.random(1)
         return _encode_bulk(out[0] if out else None)
 
@@ -1605,22 +1661,42 @@ class RespServer:
             e = grid.get_entry(name)
             if e is None:
                 cur = 0
+            elif e.kind == "bucket":  # Redis counters ARE string keys
+                raw = e.value
+                if isinstance(raw, str):
+                    raw = raw.encode()
+                try:
+                    cur = int(raw)
+                except (TypeError, ValueError):
+                    try:
+                        cur = float(raw)
+                    except (TypeError, ValueError):
+                        raise RespError(
+                            "value is not a valid float"
+                            if is_float
+                            else "value is not an integer or out of range"
+                        )
             elif e.kind in ("atomiclong", "atomicdouble"):
-                cur = e.value
+                cur = e.value  # pre-existing counter kinds stay readable
             else:
                 raise TypeError(
-                    f"object {name!r} holds a {e.kind}, not a counter"
+                    f"object {name!r} holds a {e.kind}, not a string"
                 )
             if is_float:
                 new = float(cur) + float(delta)
-                grid.put_entry(name, "atomicdouble", new)
+                stored = _fmt_score(new).encode()
             else:
-                if float(cur) != int(cur):
-                    raise RespError(
-                        "value is not an integer or out of range"
-                    )
+                # Exact-int check (float(cur)==int(cur) loses precision
+                # past 2**53; Redis counters span full signed 64-bit).
+                if isinstance(cur, float) and not cur.is_integer():
+                    raise RespError("value is not an integer or out of range")
                 new = int(cur) + int(delta)
-                grid.put_entry(name, "atomiclong", new)
+                stored = str(new).encode()
+            # Stored as a plain string key: SET/GET/INCR/INCRBYFLOAT all
+            # interoperate on one key, and TYPE reports "string".
+            ttl = e.expire_at if e is not None else None
+            ne = grid.put_entry(name, "bucket", stored)
+            ne.expire_at = ttl
             return new
 
     def _cmd_INCR(self, args):

@@ -181,7 +181,10 @@ def test_reader_parity_native_vs_python(parser, chunks):
 
 def test_reader_fallback_on_malformed(parser):
     # A malformed frame must produce the same outcome on both paths:
-    # the Python slow path raises ValueError (int(b'x')) in both cases.
+    # a typed ProtocolError (the serve loop replies '-ERR Protocol
+    # error' and closes — never an unhandled thread crash).
+    from redisson_tpu.serve.resp import ProtocolError
+
     payload = _wire([b"PING"]) + b"*1\r\n$x\r\n"
     for native in (True, False):
         if native:
@@ -195,7 +198,7 @@ def test_reader_fallback_on_malformed(parser):
         b.sendall(payload)
         b.shutdown(socket.SHUT_WR)
         assert reader.read_command() == [b"PING"]
-        with pytest.raises(ValueError):
+        with pytest.raises(ProtocolError):
             reader.read_command()
         a.close()
         b.close()

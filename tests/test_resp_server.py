@@ -795,3 +795,63 @@ class TestWidenedSurface:
     def test_zrevrange_beyond_left_end(self, resp):
         resp.cmd("ZADD", "zb", "1", "a", "2", "b", "3", "c")
         assert resp.cmd("ZREVRANGE", "zb", "0", "-5") == []
+
+    def test_protocol_error_replies_then_closes(self, resp):
+        sock = resp._sock
+        sock.sendall(b"*abc\r\n")
+        import time
+
+        time.sleep(0.2)
+        data = sock.recv(4096)
+        assert data.startswith(b"-ERR Protocol error"), data
+        assert sock.recv(4096) == b""  # server closed the connection
+
+    def test_numeric_on_string_keys_interop(self, resp):
+        # Redis counters ARE string keys: SET/INCR/GET on one key.
+        resp.cmd("SET", "snum", "5")
+        assert resp.cmd("INCR", "snum") == 6
+        assert resp.cmd("GET", "snum") == b"6"
+        assert resp.cmd("TYPE", "snum") == "string"
+        assert resp.cmd("INCRBYFLOAT", "snum", "0.25") == b"6.25"
+        assert resp.cmd("GET", "snum") == b"6.25"
+        with pytest.raises(RuntimeError, match="not an integer"):
+            resp.cmd("INCR", "snum")
+        # Precision: values past 2^53 keep exact int arithmetic.
+        resp.cmd("SET", "big", "9007199254740993")
+        assert resp.cmd("INCR", "big") == 9007199254740994
+
+    def test_wrongtype_and_execabort_codes(self, resp):
+        resp.cmd("SADD", "wtset", "m")
+        try:
+            resp.cmd("GET", "wtset")
+            assert False, "expected WRONGTYPE"
+        except RuntimeError as e:
+            assert str(e).startswith("WRONGTYPE"), e
+        resp.cmd("MULTI")
+        try:
+            resp.cmd("NOSUCHCMD")
+        except RuntimeError:
+            pass
+        try:
+            resp.cmd("EXEC")
+            assert False, "expected EXECABORT"
+        except RuntimeError as e:
+            assert str(e).startswith("EXECABORT"), e
+
+    def test_setrange_lset_bounds(self, resp):
+        resp.cmd("SET", "srk", "hello")
+        with pytest.raises(RuntimeError, match="offset is out of range"):
+            resp.cmd("SETRANGE", "srk", "-1", "ZZ")
+        assert resp.cmd("GET", "srk") == b"hello"  # untouched
+        resp.cmd("RPUSH", "lsk", "a", "b", "c")
+        with pytest.raises(RuntimeError, match="index out of range"):
+            resp.cmd("LSET", "lsk", "-5", "X")
+        assert resp.cmd("LRANGE", "lsk", "0", "-1") == [b"a", b"b", b"c"]
+
+    def test_srandmember_negative_count(self, resp):
+        resp.cmd("SADD", "srs", "a", "b")
+        out = resp.cmd("SRANDMEMBER", "srs", "-5")
+        assert len(out) == 5 and set(out) <= {b"a", b"b"}
+        with pytest.raises(RuntimeError, match="out of range"):
+            resp.cmd("SPOP", "srs", "-1")
+        assert len(resp.cmd("SPOP", "srs", "10")) == 2  # oversized: all
