@@ -109,11 +109,16 @@ class RedissonTpuClient(CamelCompatMixin):
                 if hasattr(self._engine, "shutdown"):
                     self._engine.shutdown()
                 raise
-            self._engine.snapshot_extra = (
-                lambda d: self._grid.snapshot_to(
-                    os.path.join(d, "grid_store.bin")
+            if hasattr(self._engine, "snapshot"):
+                # Hooked through the engine snapshotter (periodic + its
+                # shutdown snapshot).  NOT set on the host engine — its
+                # shutdown never snapshots, so client.shutdown's direct
+                # grid write (gated on this attr being absent) must run.
+                self._engine.snapshot_extra = (
+                    lambda d: self._grid.snapshot_to(
+                        os.path.join(d, "grid_store.bin")
+                    )
                 )
-            )
         self._topic_bus = TopicBus(n_threads=config.threads)
         import threading
 
@@ -475,7 +480,13 @@ class RedissonTpuClient(CamelCompatMixin):
         device-side result mailbox (executor.collect_group): each host
         fetch costs a full link round trip, so G results come home in
         one.  Works with any mix of sketch async results; degrades to
-        per-item resolution for host-engine/grid futures."""
+        per-item resolution for host-engine/grid futures.
+
+        Coalesced engines: ops grouped by the COMPLETER already come
+        home through the mailbox (its drain batches pending launches);
+        this method's explicit grouping applies to direct-dispatch
+        results (coalesce=False), where the caller holds the
+        LazyResults."""
         futures = list(futures)
         collect = getattr(self._engine, "collect_results", None)
         if collect is not None:

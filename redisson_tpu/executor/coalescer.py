@@ -46,6 +46,7 @@ import numpy as np
 from redisson_tpu.executor.failures import (
     DispatchTimeoutError,
     KernelExecutionError,
+    NonRetryableDispatchError,
     RetryExhaustedError,
 )
 
@@ -152,8 +153,12 @@ class BatchCoalescer:
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         # Producers blocked on the queue bound wait here; notified as
-        # segments pop for dispatch.
+        # segments pop for dispatch.  FIFO tickets: without ordering, a
+        # bulk submit larger than the bound only admits at an EMPTY
+        # queue, and a stream of small submits can refill it forever
+        # (livelock); with tickets, later submits queue behind it.
         self._admit = threading.Condition(self._lock)
+        self._admit_q: deque = deque()
         self._inflight = 0  # popped but not yet dispatched
         self._closed = False
         # Device-side result mailbox (executor.collect_group): when the
@@ -200,15 +205,31 @@ class BatchCoalescer:
                 raise RuntimeError("coalescer is shut down")
             # Backpressure: block while the queue is at capacity (an
             # oversize single submit is admitted when the queue is empty,
-            # so it can never deadlock).  The flush thread only ever
-            # REMOVES queued ops, so this wait cannot starve.
-            while (
-                self._queued_ops > 0
-                and self._queued_ops + nops > self.max_queued_ops
-                and not self._closed
-            ):
-                self._wake.notify()
-                self._admit.wait(timeout=1.0)
+            # so it can never deadlock).  FIFO: later submits wait behind
+            # an already-blocked one, so sustained small traffic cannot
+            # starve a bulk submit.  The flush thread only ever REMOVES
+            # queued ops, so this wait cannot starve globally.
+            def _full() -> bool:
+                return (
+                    self._queued_ops > 0
+                    and self._queued_ops + nops > self.max_queued_ops
+                )
+
+            if _full() and not self._closed:
+                ticket = object()
+                self._admit_q.append(ticket)
+                try:
+                    while not self._closed and (
+                        self._admit_q[0] is not ticket or _full()
+                    ):
+                        self._wake.notify()
+                        self._admit.wait(timeout=1.0)
+                finally:
+                    try:
+                        self._admit_q.remove(ticket)
+                    except ValueError:  # pragma: no cover
+                        pass
+                    self._admit.notify_all()  # next ticket re-checks
             if self._closed:
                 raise RuntimeError("coalescer is shut down")
             seg = self._open.get(key)
@@ -369,6 +390,12 @@ class BatchCoalescer:
                     else:
                         lazy = seg.dispatch(cols)
                     last_err = None
+                    break
+                except NonRetryableDispatchError as e:
+                    # Part of the launch already applied (compound dispatch
+                    # split by a mid-segment migration): re-dispatch would
+                    # double-apply the committed part.
+                    last_err = e
                     break
                 except Exception as e:
                     # Dispatch-time failure: pool state not consumed (the

@@ -29,6 +29,13 @@ class DispatchTimeoutError(RedissonTpuError, TimeoutError):
     """A blocking result wait exceeded its deadline."""
 
 
+class NonRetryableDispatchError(RedissonTpuError):
+    """Dispatch failed AFTER part of its device state was already applied
+    (e.g. the first group of a migration-split compound launch succeeded,
+    donating state).  A blind re-dispatch would apply the committed part
+    twice — the coalescer's retry loop must not retry these."""
+
+
 class RetryExhaustedError(RedissonTpuError):
     """Dispatch kept failing after the configured retry budget."""
 

@@ -222,7 +222,7 @@ class TpuCommandExecutor:
         per-fetch ROUND TRIP this path eliminates — redundant but
         harmless next to the 0.2ms-2.5s fetch RT they avoid paying
         G times."""
-        by_dtype: dict = {}
+        by_sig: dict = {}
         for l in lazies:
             # Unwrap MappedFuture-style adapters (objects/base.py): the
             # underlying LazyResult carries the device value; the
@@ -238,28 +238,39 @@ class TpuCommandExecutor:
                 and getattr(l, "_done", 1) is None
                 and isinstance(getattr(l, "_value", None), jax.Array)
             ):
-                by_dtype.setdefault(l._value.dtype, []).append(l)
-        for group in by_dtype.values():
+                # Group by EXACT (dtype, shape): results are bucketed to
+                # pow-2 sizes already, so same-sig groups are the common
+                # case, and the concat program's cache key stays a small
+                # (dtype, shape, count) space — a per-ordered-shape-tuple
+                # key would compile combinatorially many executables
+                # (30-60s each on the tunnel, never evicted).
+                by_sig.setdefault((l._value.dtype, l._value.shape), []).append(l)
+        for (dtype, shape), group in by_sig.items():
             if len(group) < 2:
                 continue  # a lone result fetches itself at .result() time
-            vals = [l._value for l in group]
-            key = ("mailbox", vals[0].dtype.name, tuple(v.shape for v in vals))
+            # Cap the arity so the compile space is (dtype, shape, ≤8).
+            for start in range(0, len(group), 8):
+                chunk = group[start : start + 8]
+                if len(chunk) < 2:
+                    break
+                vals = [l._value for l in chunk]
+                key = ("mailbox", dtype.name, shape, len(chunk))
 
-            def build():
-                def f(*xs):
-                    return jnp.concatenate([x.reshape(-1) for x in xs])
+                def build():
+                    def f(*xs):
+                        return jnp.concatenate([x.reshape(-1) for x in xs])
 
-                return f
+                    return f
 
-            fn = self._jit(key, build, donate=False)
-            flat = np.asarray(ensure_addressable(fn(*vals)))
-            off = 0
-            for l, v in zip(group, vals):
-                n = int(np.prod(v.shape))
-                # .copy(): a view would pin the whole group's concat
-                # buffer for as long as any ONE result is retained.
-                l.resolve_from(flat[off : off + n].reshape(v.shape).copy())
-                off += n
+                fn = self._jit(key, build, donate=False)
+                flat = np.asarray(ensure_addressable(fn(*vals)))
+                off = 0
+                n = int(np.prod(shape))
+                for l in chunk:
+                    # .copy(): a view would pin the whole group's concat
+                    # buffer for as long as any ONE result is retained.
+                    l.resolve_from(flat[off : off + n].reshape(shape).copy())
+                    off += n
 
     @staticmethod
     def _pad(arr: np.ndarray, n_pad: int, fill=0):

@@ -286,6 +286,12 @@ class TpuSketchEngine(SketchDurabilityMixin):
         self.foreign_exists = None
         self.coalescer = None
         if config.tpu_sketch.coalesce:
+            import jax
+
+            # Mailbox drains group launches by each controller's OWN
+            # completion timing — divergent concat programs across
+            # processes would break multi-controller lockstep, same as
+            # the periodic snapshotter below.
             self.coalescer = BatchCoalescer(
                 batch_window_us=config.tpu_sketch.batch_window_us,
                 max_batch=config.tpu_sketch.max_batch,
@@ -299,6 +305,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 group_collect=(
                     self.executor.collect_group
                     if config.tpu_sketch.mailbox_collect
+                    and jax.process_count() == 1
                     else None
                 ),
             )
@@ -372,6 +379,12 @@ class TpuSketchEngine(SketchDurabilityMixin):
         # call, expiry sweeper, or lazy-expiry reader) wins the pop, and
         # the row is reusable only after it is zeroed — a stale deleter
         # can never zero a row already reallocated to a new object.
+        # Epoch BEFORE detach: a change_topology completing between
+        # detach and the epoch read would return this entry's rows to the
+        # rebuilt free list AND bump the epoch — reading the bumped value
+        # would defeat _reap_rows' stale-topology guard and double-free.
+        pre_pool = self.registry.lookup(name)
+        pre_epoch = pre_pool.pool.topology_epoch if pre_pool else 0
         entry = self.registry.detach(name)
         if entry is None:
             return False
@@ -381,7 +394,8 @@ class TpuSketchEngine(SketchDurabilityMixin):
         was_expired = (
             entry.expire_at is not None and _time.time() >= entry.expire_at
         )
-        epoch = entry.pool.topology_epoch
+        epoch = pre_epoch if pre_pool and pre_pool.pool is entry.pool \
+            else entry.pool.topology_epoch
         self._drain()
         self._reap_rows(entry.pool, self._entry_rows(entry), epoch)
         self.topk.drop(name)
@@ -951,17 +965,27 @@ class TpuSketchEngine(SketchDurabilityMixin):
             old_pool, old_row = entry.pool, entry.row
             epoch_old = old_pool.topology_epoch
             new_pool = self.registry.pool_for(PoolKind.BITSET, (need_words,))
-            epoch_new = new_pool.topology_epoch
-            new_row = new_pool.alloc_row()
             with old_pool._dispatch_lock:
                 if (
                     old_pool.topology_epoch != epoch_old
-                    or new_pool.topology_epoch != epoch_new
+                    or entry.pool is not old_pool
+                    or entry.row != old_row
                 ):
-                    # A topology swap rebuilt the free lists (new_row is
-                    # back in _free — do NOT free it again); retry against
-                    # the fresh layout.
+                    # Stale view: a topology swap rebuilt layouts, or a
+                    # CONCURRENT grow already migrated this entry (same
+                    # destination class → committing here would copy the
+                    # zeroed old row over live data and double-free it).
+                    # Nothing allocated yet — safe to re-evaluate.
+                    if entry.pool.row_units >= need_words:
+                        return  # the other grow already got us there
                     continue
+                # Allocate INSIDE the dispatch lock: change_topology holds
+                # this lock for its swap, so no free-list rebuild can
+                # interleave between this alloc and the commit below (the
+                # old alloc-before-lock ordering leaked or double-freed
+                # the new row depending on which side of the swap the
+                # alloc landed).
+                new_row = new_pool.alloc_row()
                 # Read INSIDE the lock: the copy and the commit are atomic
                 # vs concurrent flushes applying ops to the old row.
                 data = self.executor.read_row(old_pool, old_row)
@@ -976,6 +1000,25 @@ class TpuSketchEngine(SketchDurabilityMixin):
     def bitset_capacity_bits(self, name) -> int:
         entry = self._lookup_kind(name, PoolKind.BITSET)
         return 0 if entry is None else entry.pool.row_units * 32
+
+    def _bitset_dispatch_group(self, pool, gidx, runs):
+        """One resolved-placement group of a mixed-bit segment → one
+        device launch (runs-metadata form when the executor supports it)."""
+        if getattr(self.executor, "supports_runs_metadata", False):
+            run_rows = np.array([r for _, r, _ in runs], np.int32)
+            run_ops = np.array([o for _, _, o in runs], np.uint32)
+            starts = np.zeros(len(runs) + 1, np.int32)
+            starts[1:] = np.cumsum([n for n, _, _ in runs])
+            return self.executor.bitset_mixed_runs(
+                pool, gidx, run_rows, run_ops, starts
+            )
+        rows = np.concatenate(
+            [np.full(n, r, np.int32) for n, r, _ in runs]
+        )
+        ops_col = np.concatenate(
+            [np.full(n, o, np.uint32) for n, _, o in runs]
+        )
+        return self.executor.bitset_mixed(pool, rows, gidx, ops_col)
 
     def _bitset_submit_mixed(self, entry, idx, opcode: int):
         """Coalesced path: every single-bit opcode rides ONE segment per
@@ -1007,28 +1050,29 @@ class TpuSketchEngine(SketchDurabilityMixin):
                         groups.append([pool, [(nops, row, op)], off, off + nops])
                     off += nops
                 results = []
-                for pool, runs, lo, hi in groups:
+                for gi, (pool, runs, lo, hi) in enumerate(groups):
                     gidx = cols[0][lo:hi]
-                    if getattr(self.executor, "supports_runs_metadata", False):
-                        run_rows = np.array([r for _, r, _ in runs], np.int32)
-                        run_ops = np.array([o for _, _, o in runs], np.uint32)
-                        starts = np.zeros(len(runs) + 1, np.int32)
-                        starts[1:] = np.cumsum([n for n, _, _ in runs])
-                        results.append(
-                            self.executor.bitset_mixed_runs(
-                                pool, gidx, run_rows, run_ops, starts
+                    if gi > 0:
+                        # Earlier groups already mutated device state: a
+                        # failure from here on must NOT be blind-retried
+                        # (double-applying OP_FLIP/OP_SET of group 0).
+                        try:
+                            results.append(
+                                self._bitset_dispatch_group(
+                                    pool, gidx, runs
+                                )
                             )
-                        )
-                    else:
-                        rows = np.concatenate(
-                            [np.full(n, r, np.int32) for n, r, _ in runs]
-                        )
-                        ops_col = np.concatenate(
-                            [np.full(n, o, np.uint32) for n, _, o in runs]
-                        )
-                        results.append(
-                            self.executor.bitset_mixed(pool, rows, gidx, ops_col)
-                        )
+                        except Exception as exc:
+                            from redisson_tpu.executor.failures import (
+                                NonRetryableDispatchError,
+                            )
+
+                            raise NonRetryableDispatchError(
+                                f"group {gi} of a migration-split launch "
+                                f"failed after earlier groups applied"
+                            ) from exc
+                        continue
+                    results.append(self._bitset_dispatch_group(pool, gidx, runs))
                 return results[0] if len(results) == 1 else _ConcatLazy(results)
 
         return self._submit(
@@ -1513,6 +1557,21 @@ class HostSketchEngine:
         schema = self._RESTORE_SCHEMAS.get(cls_name)
         if schema is None:
             raise ValueError(f"unknown model class {cls_name!r}")
+        # kind must agree with the model class — a forged blob pairing
+        # kind='cms' with a bloom model would create an object whose every
+        # later op feeds the wrong model the wrong arguments.
+        expected_kind = {
+            "GoldenBloomFilter": PoolKind.BLOOM,
+            "GoldenHyperLogLog": PoolKind.HLL,
+            "GoldenCountMinSketch": PoolKind.CMS,
+            "GoldenBitSet": PoolKind.BITSET,
+        }[cls_name]
+        if d.get("kind") != expected_kind:
+            raise ValueError(
+                f"dump kind {d.get('kind')!r} does not match {cls_name}"
+            )
+        if not isinstance(d.get("params"), dict):
+            raise ValueError("dump params must be a dict")
         # Untrusted candidate table: validate BEFORE any mutation.
         topk_decoded = TopKStore.decode_state(d.get("topk"), name)
         cls = getattr(golden, cls_name)

@@ -146,3 +146,54 @@ def test_unpaced_producer_bounded_latency_end_to_end():
         assert m["ops_total"] == 400 * 256
     finally:
         cl.shutdown()
+
+
+def test_bulk_submit_not_starved_by_small_stream():
+    """FIFO admission: a submit larger than max_queued_ops must admit
+    even while other threads stream small ops (the old empty-queue-only
+    rule livelocked it)."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from redisson_tpu.executor.coalescer import BatchCoalescer
+
+    done = []
+
+    def dispatch(cols):
+        class _L:
+            def result(self):
+                return np.zeros(sum(len(c) for c in cols[:1]), bool)
+
+        time.sleep(0.002)
+        return _L()
+
+    c = BatchCoalescer(batch_window_us=100, max_batch=256,
+                       max_queued_ops=512)
+    stop = threading.Event()
+
+    def small_stream():
+        while not stop.is_set():
+            c.submit("k", dispatch, (np.zeros(64, np.uint32),), 64)
+            time.sleep(0.0005)
+
+    streamers = [threading.Thread(target=small_stream) for _ in range(3)]
+    for t in streamers:
+        t.start()
+    time.sleep(0.1)  # queue saturated by the small stream
+
+    def bulk():
+        fut = c.submit("k", dispatch, (np.zeros(2048, np.uint32),), 2048)
+        fut.result(timeout=30)
+        done.append(True)
+
+    b = threading.Thread(target=bulk)
+    b.start()
+    b.join(timeout=20)
+    stop.set()
+    for t in streamers:
+        t.join(timeout=5)
+    alive = b.is_alive()
+    c.shutdown()
+    assert not alive and done, "bulk submit starved behind small stream"

@@ -154,3 +154,25 @@ def test_periodic_snapshot_covers_grid(tmp_path):
         c2.shutdown()
         c1.config.snapshot_dir = None
         c1.shutdown()
+
+
+def test_host_engine_shutdown_persists_grid(tmp_path):
+    """Host-engine clients (no sketch snapshotter) must still write the
+    grid snapshot at shutdown — the snapshot_extra hook is only wired
+    when an engine snapshotter exists to fire it."""
+    import warnings
+
+    cfg = Config()
+    cfg.snapshot_dir = str(tmp_path / "snap")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        c1 = redisson_tpu.create(cfg)
+    c1.get_bucket("hk").set(b"hv")
+    c1.shutdown()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        c2 = redisson_tpu.create(cfg)
+    try:
+        assert c2.get_bucket("hk").get() == b"hv"
+    finally:
+        c2.shutdown()

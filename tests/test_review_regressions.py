@@ -307,3 +307,77 @@ class TestRound3AdviceFixes:
                 c._engine.rename("shadow-src", "shadow-dst")
         finally:
             c.shutdown()
+
+
+def test_concurrent_bitset_grow_no_double_free():
+    """Two threads growing the same bitset concurrently: exactly one
+    migration wins, data survives, and no pool row is double-freed
+    (a duplicate free hands one device row to two future tenants)."""
+    import threading
+
+    import numpy as np
+
+    import redisson_tpu
+    from redisson_tpu import Config
+
+    c = redisson_tpu.create(
+        Config().use_tpu_sketch(min_bucket=64, coalesce=False)
+    )
+    try:
+        for round_ in range(6):
+            name = f"growrace{round_}"
+            bs = c.get_bit_set(name)
+            bs.set_many(np.arange(0, 1024, 3, dtype=np.uint32))
+            barrier = threading.Barrier(2)
+            errs = []
+
+            def grower(hi):
+                try:
+                    barrier.wait(5)
+                    c.get_bit_set(name).set(hi)  # forces a size-class grow
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+
+            t1 = threading.Thread(target=grower, args=(200_000,))
+            t2 = threading.Thread(target=grower, args=(250_000,))
+            t1.start(); t2.start(); t1.join(10); t2.join(10)
+            assert not errs, errs
+            got = c.get_bit_set(name)
+            assert bool(np.all(got.get_many(
+                np.arange(0, 1024, 3, dtype=np.uint32)
+            ))), "pre-grow bits lost in concurrent migration"
+            assert got.get(200_000) and got.get(250_000)
+            # No pool free-list may contain duplicates (double-free).
+            for pool in c._engine.registry.pools():
+                assert len(pool._free) == len(set(pool._free)), (
+                    "double-freed row in pool free list"
+                )
+    finally:
+        c.shutdown()
+
+
+def test_host_restore_rejects_kind_model_mismatch():
+    import redisson_tpu
+    from redisson_tpu import Config
+
+    c = redisson_tpu.create(Config())
+    try:
+        cms = c.get_count_min_sketch("kmm")
+        cms.try_init(4, 1 << 10)
+        cms.add(1)
+        import json as _json
+        import struct as _struct
+
+        raw = cms.dump()
+        (hlen,) = _struct.unpack("<I", raw[4:8])
+        hdr = _json.loads(raw[8 : 8 + hlen].decode())
+        assert hdr["kind"] == "cms"
+        hdr["kind"] = "bloom"  # forged: kind disagrees with model_cls
+        nh = _json.dumps(hdr).encode()
+        forged = raw[:4] + _struct.pack("<I", len(nh)) + nh + raw[8 + hlen :]
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="does not match"):
+            c._engine.restore("kmm2", forged)
+    finally:
+        c.shutdown()
