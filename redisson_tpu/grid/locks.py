@@ -61,14 +61,27 @@ class Lock(GridObject):
             st["expire_at"] = None
         return st
 
+    def _tokens(self) -> dict:
+        """Fencing-token counters survive OUTSIDE the keyspace entry (per
+        store, keyed by name): the entry itself is deleted on full release
+        — in Redis an unheld lock key does not exist — but fencing tokens
+        must stay monotonic across acquire/release cycles."""
+        return self._store.__dict__.setdefault("_lock_tokens", {})
+
     def _try_take(self, lease_seconds: Optional[float]) -> bool:
-        st = self._live_state()
         me = self._me()
+        # Contended probe: do NOT materialize an entry for a lock someone
+        # else holds (failed try_lock calls must not leak keyspace names).
+        ro = self._live_state_ro()
+        if ro is not None and ro["owner"] is not None and ro["owner"] != me:
+            return False
+        st = self._live_state()
         if st["owner"] is None:
             st["owner"] = me
             st["count"] = 1
             st["expire_at"] = None if lease_seconds is None else _now() + lease_seconds
-            st["token"] += 1
+            toks = self._tokens()
+            toks[self._name] = st["token"] = toks.get(self._name, 0) + 1
             return True
         if st["owner"] == me:
             st["count"] += 1  # reentrancy (→ RedissonLock hash-incr)
@@ -95,8 +108,8 @@ class Lock(GridObject):
 
     def _wait_slice(self) -> float:
         """Cap waits so lease expiry is noticed without an unlock signal."""
-        st = self._entry().value
-        if st["expire_at"] is None:
+        st = self._live_state_ro()
+        if st is None or st["expire_at"] is None:
             return 1.0
         return max(0.01, min(1.0, st["expire_at"] - _now()))
 
@@ -109,18 +122,22 @@ class Lock(GridObject):
                 )
             st["count"] -= 1
             if st["count"] <= 0:
-                st["owner"] = None
-                st["count"] = 0
-                st["expire_at"] = None
+                # Full release DELETES the key (Redis unlock semantics:
+                # an unheld lock does not exist in the keyspace).
+                self._release_entry()
                 self._store.cond.notify_all()  # the unlock-channel PUBLISH
+
+    def _release_entry(self) -> None:
+        """Remove the keyspace entry on full release.  Subclasses with
+        extra durable state (fair queue) override to decide."""
+        self._store.delete(self._name)
 
     def force_unlock(self) -> bool:
         with self._store.cond:
-            st = self._live_state()
-            held = st["owner"] is not None
-            st["owner"] = None
-            st["count"] = 0
-            st["expire_at"] = None
+            st = self._live_state_ro()
+            held = st is not None and st["owner"] is not None
+            if st is not None:
+                self._release_entry()
             self._store.cond.notify_all()
             return held
 
@@ -185,6 +202,9 @@ class FencedLock(Lock):
                 return None
             return st["token"] if st["owner"] == self._me() else None
 
+    # token counters live in Lock._tokens() (store-side), so fencing
+    # monotonicity survives the entry's deletion on release.
+
 
 class FairLock(Lock):
     """→ RedissonFairLock: FIFO handoff — waiters queue and only the head
@@ -215,11 +235,25 @@ class FairLock(Lock):
         got = super().try_lock(wait_seconds, lease_seconds)
         if not got:
             with self._store.lock:  # leave the queue on timeout
-                st = self._entry().value
+                st = self._live_state_ro()
                 me = self._me()
-                if me in st["queue"]:
+                if st is not None and me in st["queue"]:
                     st["queue"].remove(me)
+                    if st["owner"] is None and not st["queue"]:
+                        self._release_entry()  # nothing left to preserve
         return got
+
+    def _release_entry(self) -> None:
+        # The FIFO queue must survive a release while waiters are parked
+        # (deleting it would lose their positions); the entry goes away
+        # only once the queue is empty too.
+        st = self._live_state_ro()
+        if st is None or not st["queue"]:
+            self._store.delete(self._name)
+        else:
+            st["owner"] = None
+            st["count"] = 0
+            st["expire_at"] = None
 
 
 class ReadWriteLock(GridObject):

@@ -16,12 +16,28 @@ from typing import Any, Iterable, Optional
 from redisson_tpu.grid.base import GridObject
 
 
+# "No element" marker distinct from a stored None: codecs encode None
+# (b'null' / pickle) as a perfectly valid element, so blocking consumers
+# must not use None to mean "queue empty" — that would silently destroy
+# a popped None and park forever.
+_EMPTY = object()
+
+
 class Queue(GridObject):
     KIND = "list"  # queues are lists in Redis; share the kind (RQueue over RList)
 
     @staticmethod
     def _new_value():
         return []
+
+    def _poll_raw(self, last: bool = False):
+        """Pop one ENCODED element, or _EMPTY when none — the primitive
+        every blocking consumer builds on (None-element safe)."""
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None or not e.value:
+                return _EMPTY
+            return e.value.pop(-1 if last else 0)
 
     def offer(self, value: Any) -> bool:
         with self._store.lock:
@@ -148,9 +164,9 @@ class BlockingQueue(Queue):
         deadline = time.monotonic() + timeout_seconds
         with self._store.cond:
             while True:
-                v = super().poll()
-                if v is not None:
-                    return v
+                vb = self._poll_raw()
+                if vb is not _EMPTY:
+                    return self._dec(vb)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
@@ -159,9 +175,9 @@ class BlockingQueue(Queue):
     def take(self) -> Any:
         with self._store.cond:
             while True:
-                v = super().poll()
-                if v is not None:
-                    return v
+                vb = self._poll_raw()
+                if vb is not _EMPTY:
+                    return self._dec(vb)
                 self._store.cond.wait(timeout=1.0)
 
     def put(self, value: Any) -> None:
@@ -171,10 +187,10 @@ class BlockingQueue(Queue):
         with self._store.lock:
             n = 0
             while max_elements is None or n < max_elements:
-                v = super().poll()
-                if v is None:
+                vb = self._poll_raw()
+                if vb is _EMPTY:
                     break
-                collection.append(v)
+                collection.append(self._dec(vb))
                 n += 1
             return n
 
@@ -185,9 +201,9 @@ class BlockingQueue(Queue):
         with self._store.cond:
             while True:
                 for q in queues:
-                    v = Queue.poll(q)
-                    if v is not None:
-                        return v
+                    vb = q._poll_raw()
+                    if vb is not _EMPTY:
+                        return q._dec(vb)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
@@ -206,9 +222,9 @@ class BlockingDeque(BlockingQueue, Deque):
         deadline = time.monotonic() + timeout_seconds
         with self._store.cond:
             while True:
-                v = Deque.poll_last(self)
-                if v is not None:
-                    return v
+                vb = self._poll_raw(last=True)
+                if vb is not _EMPTY:
+                    return self._dec(vb)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
@@ -224,6 +240,17 @@ class DelayedQueue(GridObject):
 
     def __init__(self, name: str, client, destination: Queue):
         super().__init__(name, client)
+        # The transfer task appends raw encoded bytes into the
+        # destination's backing LIST — only plain list-backed queues
+        # qualify (a RingBuffer's dict value or a PriorityQueue's tuple
+        # list would crash the timer thread or corrupt the structure).
+        if not isinstance(destination, Queue) or not isinstance(
+            destination._new_value(), list
+        ):
+            raise TypeError(
+                "DelayedQueue destination must be a plain list-backed "
+                f"queue, not {type(destination).__name__}"
+            )
         self._dest = destination
         self._timer: Optional[threading.Timer] = None
 
@@ -306,12 +333,16 @@ class PriorityQueue(GridObject):
 
     add = offer
 
-    def poll(self) -> Any:
+    def _poll_raw(self, last: bool = False):
         with self._store.lock:
             e = self._entry(create=False)
             if e is None or not e.value:
-                return None
-            return self._dec(e.value.pop(0)[1])
+                return _EMPTY
+            return e.value.pop(-1 if last else 0)[1]
+
+    def poll(self) -> Any:
+        vb = self._poll_raw()
+        return None if vb is _EMPTY else self._dec(vb)
 
     def peek(self) -> Any:
         with self._store.lock:
@@ -458,9 +489,9 @@ class PriorityBlockingQueue(PriorityQueue):
         deadline = time.monotonic() + timeout_seconds
         with self._store.cond:
             while True:
-                v = super().poll()
-                if v is not None:
-                    return v
+                vb = self._poll_raw()
+                if vb is not _EMPTY:
+                    return self._dec(vb)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
@@ -469,9 +500,9 @@ class PriorityBlockingQueue(PriorityQueue):
     def take(self) -> Any:
         with self._store.cond:
             while True:
-                v = super().poll()
-                if v is not None:
-                    return v
+                vb = self._poll_raw()
+                if vb is not _EMPTY:
+                    return self._dec(vb)
                 self._store.cond.wait(timeout=1.0)
 
     def put(self, value: Any) -> None:
@@ -504,38 +535,47 @@ class PriorityDeque(PriorityQueue):
                 return None
             return self._dec(e.value[-1][1])
 
-
 class TransferQueue(BlockingQueue):
-    """→ RedissonTransferQueue: ``transfer`` blocks the producer until a
-    consumer takes the element (the handoff contract); plain offer/poll
-    still behave like a queue.  Backing container is the SAME list shape
-    as Queue (inherited drain_to/read_all and friends must keep working);
-    pending transfers ride [bytes, marker] slots that every read path
-    decodes through ``_decode_slot``."""
+    """→ RTransferQueue (java.util.concurrent.TransferQueue semantics):
+    ``transfer`` blocks until a consumer takes the element; plain
+    offer/poll still behave like a queue.
 
-    KIND = "queue"
+    Elements are PLAIN encoded bytes in the same list shape as every
+    other queue (KIND "list" — one namespace with RQueue/RList, so
+    RPOPLPUSH/poll_from_any/RESP LPOP all interoperate).  A pending
+    transfer is tracked by the IDENTITY of its bytes object: the
+    transferer waits until that exact object leaves the queue's CURRENT
+    backing list — any consumer path that removes it (poll, take, LPOP,
+    a move to another queue, remove(), even DEL of the whole key)
+    completes the handoff."""
 
     def _transfer_locked(self, value: Any, deadline: Optional[float]) -> bool:
         """Caller holds the store cond.  Appends the offer, waits for a
         consumer to take it; withdraws on timeout."""
-        e = self._entry()
-        slot = [self._enc(value), object()]
-        e.value.append(slot)
+        vb = self._enc(value)
+        if isinstance(vb, str):  # identity tracking needs a fresh object
+            vb = vb.encode()
+        self._entry().value.append(vb)
         self._store.cond.notify_all()
-        while any(s is slot for s in e.value if isinstance(s, list)):
+        while True:
+            # Re-resolve the entry EVERY iteration: clear()/DEL swaps the
+            # backing list, and a stale reference would strand this wait
+            # forever on an orphaned list no consumer can reach.
+            e = self._entry(create=False)
+            if e is None or not any(s is vb for s in e.value):
+                return True  # consumed (or the key itself was consumed)
             remaining = (
                 None if deadline is None else deadline - time.monotonic()
             )
             if remaining is not None and remaining <= 0:
-                try:
-                    e.value.remove(slot)  # withdraw the offer
-                except ValueError:
-                    return True  # taken between checks
-                return False
+                for i, s in enumerate(e.value):
+                    if s is vb:  # identity, not equality: duplicates of
+                        del e.value[i]  # the same VALUE must survive
+                        return False
+                return True  # taken between checks
             self._store.cond.wait(
                 timeout=1.0 if remaining is None else min(1.0, remaining)
             )
-        return True
 
     def transfer(self, value: Any, timeout_seconds: Optional[float] = None) -> bool:
         """Blocks until a consumer removes the element; False on timeout
@@ -568,9 +608,6 @@ class TransferQueue(BlockingQueue):
                 value, time.monotonic() + 1.0
             )
 
-    def _decode_slot(self, raw):
-        return self._dec(raw[0] if isinstance(raw, list) else raw)
-
     def poll(self, timeout_seconds: Optional[float] = None) -> Any:
         deadline = (
             None
@@ -581,11 +618,10 @@ class TransferQueue(BlockingQueue):
             self._waiting_count(+1)
             try:
                 while True:
-                    e = self._entry(create=False)
-                    if e is not None and e.value:
-                        raw = e.value.pop(0)
+                    vb = self._poll_raw()
+                    if vb is not _EMPTY:
                         self._store.cond.notify_all()  # wake transferers
-                        return self._decode_slot(raw)
+                        return self._dec(vb)
                     if deadline is None:
                         return None
                     remaining = deadline - time.monotonic()
@@ -600,71 +636,30 @@ class TransferQueue(BlockingQueue):
             self._waiting_count(+1)
             try:
                 while True:
-                    e = self._entry(create=False)
-                    if e is not None and e.value:
-                        raw = e.value.pop(0)
+                    vb = self._poll_raw()
+                    if vb is not _EMPTY:
                         self._store.cond.notify_all()
-                        return self._decode_slot(raw)
+                        return self._dec(vb)
                     self._store.cond.wait(timeout=1.0)
             finally:
                 self._waiting_count(-1)
 
-    def peek(self) -> Any:
-        with self._store.lock:
-            e = self._entry(create=False)
-            if e is None or not e.value:
-                return None
-            return self._decode_slot(e.value[0])
-
-    def read_all(self) -> list:
-        with self._store.lock:
-            e = self._entry(create=False)
-            if e is None:
-                return []
-            return [self._decode_slot(raw) for raw in e.value]
-
     def drain_to(self, collection: list, max_elements: Optional[int] = None) -> int:
-        with self._store.lock:
-            e = self._entry(create=False)
-            if e is None:
-                return 0
-            n = len(e.value) if max_elements is None else min(
-                max_elements, len(e.value)
-            )
-            for _ in range(n):
-                collection.append(self._decode_slot(e.value.pop(0)))
-            if n:
-                self._store.cond.notify_all()
-            return n
+        n = super().drain_to(collection, max_elements)
+        if n:
+            with self._store.cond:
+                self._store.cond.notify_all()  # wake transferers
+        return n
 
     def has_waiting_consumer(self) -> bool:
         with self._store.lock:
             return self._waiting_count() > 0
 
-    def contains(self, value: Any) -> bool:
-        """Sees pending-transfer slots too (an element mid-handoff IS in
-        the queue — inherited byte-compare would miss the slot shape)."""
-        with self._store.lock:
-            e = self._entry(create=False)
-            if e is None:
-                return False
-            vb = self._enc(value)
-            return any(
-                (raw[0] if isinstance(raw, list) else raw) == vb
-                for raw in e.value
-            )
-
     def remove(self, value: Any) -> bool:
         """Removing a pending-transfer element counts as consuming it —
         the blocked transferer resolves True."""
         with self._store.cond:
-            e = self._entry(create=False)
-            if e is None:
-                return False
-            vb = self._enc(value)
-            for i, raw in enumerate(e.value):
-                if (raw[0] if isinstance(raw, list) else raw) == vb:
-                    del e.value[i]
-                    self._store.cond.notify_all()
-                    return True
-            return False
+            ok = super().remove(value)
+            if ok:
+                self._store.cond.notify_all()
+            return ok

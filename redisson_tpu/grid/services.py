@@ -230,7 +230,16 @@ class ExecutorService(CamelCompatMixin):
                 task_id, fn, args, kwargs = self._tasks.pop(0)
             fut = self._futures.get(task_id)
             if fut is not None and fut.cancelled():
-                self._futures.pop(task_id, None)
+                with self._cond:
+                    # Purge EVERY trace of the task: dropping only the
+                    # future would let the timer loop re-arm the periodic
+                    # entry (its cancelled-check reads _futures) — an
+                    # uncancellable task running forever.
+                    self._futures.pop(task_id, None)
+                    self._periodic.discard(task_id)
+                    self._scheduled = [
+                        ent for ent in self._scheduled if ent[2][0] != task_id
+                    ]
                 continue
             # Periodic tasks keep their future OPEN (it exists for
             # cancel(), like the reference's scheduled future).
@@ -282,11 +291,17 @@ class RemoteService(CamelCompatMixin):
         self._lock = threading.Lock()
 
     def register(self, iface: str, impl: Any, workers: int = 1) -> None:
-        """→ RRemoteService#register(Class, T, workers)."""
+        """→ RRemoteService#register(Class, T, workers).  Re-registering
+        an iface replaces the implementation and shuts down the previous
+        registration's worker pool (it would otherwise leak its threads
+        for the process lifetime)."""
         ex = ExecutorService(f"{self._name}:{iface}:workers", self._client)
         ex.register_workers(workers)
         with self._lock:
+            prev = self._impls.get(iface)
             self._impls[iface] = (impl, ex)
+        if prev is not None:
+            prev[1].shutdown()
 
     def deregister(self, iface: str) -> None:
         with self._lock:
@@ -430,8 +445,26 @@ class Transaction(CamelCompatMixin):
                     raise TransactionException(
                         f"read of {name!r} invalidated by a concurrent write"
                     )
-            for apply_fn in self._writes:
-                apply_fn()
+            # Pre-validate EVERY write target's kind BEFORE applying any
+            # (write-only keys are not in the read-validation set): a
+            # WRONGTYPE surfacing mid-apply would leave the log half-
+            # committed — the atomicity contract this method documents.
+            for name, kind, _fn in self._writes:
+                if kind is None:
+                    continue
+                e = self._store.get_entry(name)
+                if e is not None and e.kind != kind:
+                    raise TransactionException(
+                        f"WRONGTYPE: {name!r} holds a {e.kind}, "
+                        f"transaction writes a {kind}"
+                    )
+            try:
+                for _name, _kind, apply_fn in self._writes:
+                    apply_fn()
+            except BaseException as e:  # pragma: no cover — applies are
+                raise TransactionException(  # pre-validated; belt+braces
+                    f"transaction partially applied: {e!r}"
+                ) from e
             self._store.cond.notify_all()
 
     def rollback(self) -> None:
@@ -480,13 +513,13 @@ class _TxBucket:
         def apply():
             store.put_entry(name, "bucket", vb)
 
-        self._tx._writes.append(apply)
+        self._tx._writes.append((name, "bucket", apply))
 
     def delete(self) -> None:
         self._tx._check_open()
         self._local = None
         store, name = self._tx._store, self._name
-        self._tx._writes.append(lambda: store.delete(name))
+        self._tx._writes.append((name, None, lambda: store.delete(name)))
 
 
 class _TxMap:
@@ -520,7 +553,7 @@ class _TxMap:
             e = tx._store.ensure_entry(name, "map", _MapValue)
             e.value.data[kb] = [vb, None, None, time.time()]
 
-        self._tx._writes.append(apply)
+        self._tx._writes.append((name, "map", apply))
 
     def remove(self, key) -> None:
         self._tx._check_open()
@@ -533,7 +566,7 @@ class _TxMap:
             if e is not None:
                 e.value.data.pop(kb, None)
 
-        self._tx._writes.append(apply)
+        self._tx._writes.append((name, "map", apply))
 
 
 class _TxSet:
@@ -567,7 +600,7 @@ class _TxSet:
             e = tx._store.ensure_entry(name, "set", dict)
             e.value[kb] = None
 
-        tx._writes.append(apply)
+        tx._writes.append((name, "set", apply))
         return added
 
     def remove(self, value) -> bool:
@@ -581,7 +614,7 @@ class _TxSet:
             if e is not None:
                 e.value.pop(kb, None)
 
-        tx._writes.append(apply)
+        tx._writes.append((name, "set", apply))
         return removed
 
 

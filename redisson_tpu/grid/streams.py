@@ -11,6 +11,7 @@ round-trip semantics match the reference's codec behavior.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Iterable, Optional
 
@@ -439,7 +440,6 @@ class ReliableTopic(GridObject):
         return 0 if e is None else e.value.added
 
     def add_listener(self, listener) -> int:
-        import threading
         import uuid
 
         with self._store.lock:
@@ -458,17 +458,28 @@ class ReliableTopic(GridObject):
         return lid
 
     def remove_listener(self, listener_id: int) -> None:
-        with self._store.lock:
+        with self._store.cond:
             got = self._listeners.pop(listener_id, None)
             if got is not None:
                 try:
                     self._stream.remove_group(got[0])
                 except Exception:
                     pass
+            if not self._listeners:
+                # Last listener gone: the pump loop exits on its next
+                # wake (it would otherwise spin for the process lifetime)
+                # and a future add_listener starts a fresh one.
+                self._store.cond.notify_all()
 
     def _pump_loop(self) -> None:
         while True:
             with self._store.lock:
+                if not self._listeners:
+                    # No subscribers: terminate instead of idling forever;
+                    # add_listener re-arms a fresh pump.
+                    if self._pump is threading.current_thread():
+                        self._pump = None
+                    return
                 subs = list(self._listeners.items())
                 seen = self._added_count()
             delivered = False

@@ -1,0 +1,170 @@
+"""Regressions for the high-effort coordination-grid review: scheduler
+cancellation, transaction atomicity, None elements, TransferQueue
+interop/lifecycle, delayed-queue destinations, remote re-registration,
+lock keyspace hygiene, reliable-topic pump lifetime."""
+
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.grid import TransactionException
+
+
+@pytest.fixture
+def client():
+    c = redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
+    yield c
+    c.shutdown()
+
+
+def test_cancelled_periodic_never_resurrects(client):
+    ex = client.get_executor_service("cxl")
+    ex.register_workers(1)
+    runs = []
+    fut = ex.schedule_at_fixed_rate(lambda: runs.append(1), 0.0, 0.05)
+    deadline = time.monotonic() + 3.0
+    while not runs and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert runs, "task never fired"
+    fut.cancel()
+    time.sleep(0.2)  # let any queued instance drain + purge
+    count = len(runs)
+    time.sleep(0.4)  # several periods: a resurrected task would refire
+    assert len(runs) == count, "cancelled periodic task kept running"
+
+
+def test_transaction_wrongtype_write_applies_nothing(client):
+    client.get_bucket("txw-b").set(b"string!")  # 'txw-b' is a bucket
+    tx = client.create_transaction()
+    tx.get_map("txw-a").put("k", "v")
+    tx.get_map("txw-b").put("k", "v")  # WRONGTYPE target
+    with pytest.raises(TransactionException, match="WRONGTYPE"):
+        tx.commit()
+    # Atomicity: the valid write must NOT have been applied either.
+    assert client.get_map("txw-a").get("k") is None
+    assert client.get_bucket("txw-b").get() == b"string!"
+
+
+def test_blocking_queue_none_element(client):
+    q = client.get_blocking_queue("noneq")
+    q.put(None)
+    got = []
+    t = threading.Thread(target=lambda: got.append(q.take()))
+    t.start()
+    t.join(timeout=5)
+    assert not t.is_alive(), "take() hung on a stored None element"
+    assert got == [None]
+    # poll(timeout) path too
+    q.put(None)
+    assert q.poll(2.0) is None and q.size() == 0
+
+
+def test_transfer_queue_shares_list_namespace(client):
+    q = client.get_queue("tqns")
+    q.offer(b"x")
+    tq = client.get_transfer_queue("tqns")  # same key, same kind
+    assert tq.poll() == b"x"
+
+
+def test_transfer_completes_via_any_consumer_path(client):
+    tq = client.get_transfer_queue("tqmove")
+    done = []
+
+    def xfer():
+        done.append(tq.transfer(b"item", timeout_seconds=10))
+
+    t = threading.Thread(target=xfer)
+    t.start()
+    time.sleep(0.15)
+    # Consume via a PLAIN queue handle (RPOPLPUSH-style move).
+    moved = client.get_queue("tqmove").poll_last_and_offer_first_to("tqdest")
+    assert moved == b"item"
+    t.join(timeout=5)
+    assert not t.is_alive() and done == [True]
+    assert client.get_queue("tqdest").poll() == b"item"
+
+
+def test_transfer_not_stranded_by_clear(client):
+    tq = client.get_transfer_queue("tqclear")
+    done = []
+
+    def xfer():
+        done.append(tq.transfer(b"item", timeout_seconds=10))
+
+    t = threading.Thread(target=xfer)
+    t.start()
+    time.sleep(0.15)
+    tq.clear()  # deletes the backing entry while the transfer waits
+    t.join(timeout=5)
+    assert not t.is_alive(), "transfer stranded after clear()"
+
+
+def test_delayed_queue_rejects_non_list_destination(client):
+    rb = client.get_ring_buffer("dlq-rb")
+    with pytest.raises(TypeError, match="list-backed"):
+        client.get_delayed_queue(rb)
+
+
+def test_remote_reregister_shuts_down_previous_workers(client):
+    svc = client.get_remote_service("rsvc")
+
+    class A:
+        def ping(self):
+            return "a"
+
+    class B:
+        def ping(self):
+            return "b"
+
+    svc.register("Svc", A())
+    prev_ex = svc._impls["Svc"][1]
+    svc.register("Svc", B())
+    assert prev_ex.is_shutdown(), "replaced registration leaked workers"
+    assert svc.get("Svc").ping() == "b"
+
+
+def test_lock_keyspace_hygiene(client):
+    keys = client.get_keys()
+    holder = client.get_lock("lk-h")
+    holder.lock()
+    # A failed probe from another 'thread' must not materialize a key...
+    # (the holder's key exists while held)
+    assert keys.count_exists("lk-h") == 1
+    holder.unlock()
+    # ...and full release deletes the key (Redis unlock semantics).
+    assert keys.count_exists("lk-h") == 0
+    probe = client.get_lock("lk-p")
+    assert probe.try_lock(0.0) is True
+    probe.unlock()
+    assert keys.count_exists("lk-p") == 0
+
+
+def test_fencing_tokens_survive_release(client):
+    fl = client.get_fenced_lock("fl")
+    t1 = fl.lock_and_get_token()
+    fl.unlock()
+    t2 = fl.lock_and_get_token()
+    fl.unlock()
+    assert t2 > t1, "fencing token must stay monotonic across releases"
+
+
+def test_reliable_topic_pump_exits_with_last_listener(client):
+    t = client.get_reliable_topic("rt-pump")
+    lid = t.add_listener(lambda ch, m: None)
+    assert t._pump is not None
+    t.remove_listener(lid)
+    deadline = time.monotonic() + 5.0
+    while t._pump is not None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert t._pump is None, "pump thread survived the last listener"
+    # Re-arm works.
+    got = []
+    t.add_listener(lambda ch, m: got.append(m))
+    t.publish(b"x")
+    deadline = time.monotonic() + 5.0
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert got == [b"x"]
