@@ -42,7 +42,11 @@ class Set_(GridObject):
             e = self._entry(create=False)
             if e is None:
                 return False
-            return e.value.pop(self._enc(value), 0) is None
+            vb = self._enc(value)
+            if vb in e.value:  # explicit membership — the old
+                del e.value[vb]  # pop(...)-is-None trick silently
+                return True  # inverts if stored markers ever change
+            return False
 
     def contains(self, value: Any) -> bool:
         with self._store.lock:
@@ -78,9 +82,11 @@ class Set_(GridObject):
     def move(self, dest_name: str, value: Any) -> bool:
         """→ RSet#move (SMOVE)."""
         with self._store.lock:
-            # WRONGTYPE-check the destination BEFORE removing, so a kind
-            # mismatch cannot lose the element.
+            # WRONGTYPE-check the destination BEFORE removing — including
+            # the FOREIGN backend (a sketch object under dest_name would
+            # make add() raise after remove() succeeded: element lost).
             self._store.get_entry(dest_name, self.KIND)
+            self._store._guard_foreign(dest_name)
             if not self.remove(value):
                 return False
             self._client.get_set(dest_name).add(value)
@@ -296,10 +302,22 @@ class List_(GridObject):
             return [] if e is None else [self._dec(vb) for vb in e.value[from_index:to_index]]
 
     def trim(self, from_index: int, to_index: int) -> None:
-        """LTRIM: keep [from, to] inclusive (Redis convention)."""
+        """LTRIM: keep [from, to] inclusive (Redis convention).  Negative
+        indexes count from the tail — to=-1 keeps through the LAST element
+        (the naive to+1 slice wiped the whole list on exactly that, the
+        most common negative form); from > to empties the list."""
         with self._store.lock:
             e = self._entry(create=False)
-            if e is not None:
+            if e is None:
+                return
+            n = len(e.value)
+            if from_index < 0:
+                from_index = max(0, n + from_index)
+            if to_index < 0:
+                to_index = n + to_index
+            if from_index > to_index or to_index < 0:
+                e.value[:] = []
+            else:
                 e.value[:] = e.value[from_index : to_index + 1]
 
     def __getitem__(self, index):

@@ -20,7 +20,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, Optional
 
-from redisson_tpu.grid.maps import Map
+from redisson_tpu.grid.maps import Map, _MISSING
 
 INVALIDATE = "invalidate"
 UPDATE = "update"
@@ -118,8 +118,11 @@ class LocalCachedMap(Map):
         self._publish(kb, self._enc(value) if self._sync == UPDATE else None)
         return created
 
-    def remove(self, key: Any, expected: Any = None) -> Any:
-        if expected is None:
+    def remove(self, key: Any, expected: Any = _MISSING) -> Any:
+        # _MISSING sentinel, NOT None: remove(key, None) is a CONDITIONAL
+        # remove expecting a stored None — collapsing it to unconditional
+        # deleted data the caller meant to protect.
+        if expected is _MISSING:
             prev = super().remove(key)
         else:
             prev = super().remove(key, expected)
@@ -128,6 +131,31 @@ class LocalCachedMap(Map):
             self._cache.pop(kb, None)
         self._publish(kb, None)
         return prev
+
+    def replace(self, key: Any, value: Any, new_value: Any = _MISSING):
+        out = super().replace(key, value, new_value)
+        kb = self._enc_key(key)
+        with self._cache_lock:
+            self._cache.pop(kb, None)
+        self._publish(kb, None)
+        return out
+
+    def put_if_absent(self, key: Any, value: Any):
+        out = super().put_if_absent(key, value)
+        if out is None:  # stored: peers must drop any stale negative
+            kb = self._enc_key(key)
+            with self._cache_lock:
+                self._cache.pop(kb, None)
+            self._publish(kb, None)
+        return out
+
+    def delete(self) -> bool:
+        out = super().delete()
+        with self._cache_lock:
+            self._cache.clear()
+        # Whole-map invalidation: peers drop EVERYTHING (kb=None marker).
+        self._publish(None, None)
+        return out
 
     def fast_remove(self, *keys: Any) -> int:
         n = super().fast_remove(*keys)
@@ -167,7 +195,7 @@ class LocalCachedMap(Map):
     def pre_load_cache(self) -> None:
         """→ RLocalCachedMap#preloadCache: warm the near cache with the
         whole backing map."""
-        for k, v in self.entries():
+        for k, v in self.read_all_map().items():
             self._cache_put(self._enc_key(k), v)
 
     def destroy(self) -> None:

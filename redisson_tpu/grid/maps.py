@@ -355,6 +355,22 @@ class MapCache(Map):
         self._emit(self._EVENT_CREATED, key, value)
         return None
 
+    def add_and_get(self, key: Any, delta) -> Any:
+        """→ RMapCache#addAndGet: the numeric update must PRESERVE the
+        entry's TTL/max-idle (the inherited path rewrote the slot with
+        fresh None timeouts — a 10s-TTL counter became immortal)."""
+        with self._store.lock:
+            e = self._entry()
+            kb = self._enc_key(key)
+            slot = e.value.live(kb)
+            cur = 0 if slot is None else self._dec(slot[0])
+            new = (cur or 0) + delta
+            if slot is None:
+                e.value.data[kb] = [self._enc(new), None, None, __import__("time").time()]
+            else:
+                slot[0] = self._enc(new)  # timeouts untouched
+            return new
+
     def _put_slot(self, key, value, ttl_s, idle_s) -> None:
         e = self._entry()
         now = time.time()

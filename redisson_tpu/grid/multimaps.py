@@ -167,7 +167,18 @@ class _BaseMultimap(GridObject):
             ]
 
     def key_size(self) -> int:
-        return len(self.key_set())
+        # Count live keys WITHOUT decoding them (a decode per key just to
+        # take a len() pays full codec cost under the store lock).
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return 0
+            now = time.time()
+            return sum(
+                1
+                for kb in list(e.value.data.keys())
+                if e.value.live(kb, now) is not None
+            )
 
     def values(self) -> list:
         with self._store.lock:
@@ -197,8 +208,19 @@ class _BaseMultimap(GridObject):
             return out
 
     def size(self) -> int:
-        """→ RMultimap#size: total number of (key, value) pairs."""
-        return len(self.values())
+        """→ RMultimap#size: total number of (key, value) pairs —
+        counted from the slots directly (decoding every value only to
+        discard it paid full codec cost under the store lock)."""
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return 0
+            now = time.time()
+            return sum(
+                len(e.value.live(kb, now)["vals"])
+                for kb in list(e.value.data.keys())
+                if e.value.live(kb, now) is not None
+            )
 
     def fast_remove(self, *keys: Any) -> int:
         """→ RMultimap#fastRemove(K...): number of keys dropped."""

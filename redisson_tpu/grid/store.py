@@ -86,7 +86,11 @@ class GridStore:
 
     def put_entry(self, name: str, kind: str, value: Any) -> GridEntry:
         with self.lock:
-            if name not in self._data:
+            prior = self._data.get(name)
+            if prior is None or prior.expired(time.time()):
+                # An expired-unswept entry confers NO ownership: probe()
+                # already reports the name absent to the sketch side,
+                # which may have legitimately created it meanwhile.
                 self._guard_foreign(name)
             e = GridEntry(kind, value)
             self._data[name] = e
@@ -119,6 +123,9 @@ class GridStore:
                 return False
             if old == new:
                 return True  # RENAME key key succeeds when the key exists
+            # One logical keyspace: renaming ONTO a sketch-held name would
+            # leave it live on both backends.
+            self._guard_foreign(new)
             del self._data[old]
             self._data[new] = e
             return True

@@ -168,3 +168,70 @@ def test_reliable_topic_pump_exits_with_last_listener(client):
     while not got and time.monotonic() < deadline:
         time.sleep(0.05)
     assert got == [b"x"]
+
+
+def test_ltrim_keep_all_negative_end(client):
+    lst = client.get_list("lt")
+    for v in (b"a", b"b", b"c"):
+        lst.add(v)
+    lst.trim(0, -1)  # Redis 'keep everything'
+    assert lst.read_all() == [b"a", b"b", b"c"]
+    lst.trim(1, -1)
+    assert lst.read_all() == [b"b", b"c"]
+    lst.trim(1, 0)  # from > to: empties
+    assert lst.read_all() == []
+
+
+def test_set_move_to_sketch_held_name_loses_nothing(client):
+    bf = client.get_bloom_filter("smv-dest")
+    bf.try_init(1000, 0.01)  # sketch backend holds the destination name
+    s = client.get_set("smv-src")
+    s.add(b"x")
+    with pytest.raises(TypeError):
+        s.move("smv-dest", b"x")
+    assert s.contains(b"x"), "element lost in failed cross-backend move"
+
+
+def test_local_cached_map_conditional_remove_none(client):
+    m = client.get_local_cached_map("lcm-rm")
+    m.put("k", "x")
+    # Conditional remove expecting None must NOT delete 'x'.
+    assert m.remove("k", None) is False
+    assert m.get("k") == "x"
+
+
+def test_local_cached_map_replace_invalidates_peers(client):
+    a = client.get_local_cached_map("lcm-rep")
+    b = client.get_local_cached_map("lcm-rep")
+    a.put("k", 1)
+    assert b.get("k") == 1  # b caches 1
+    b_replaced = a.replace("k", 2)
+    assert b_replaced == 1
+    deadline = time.monotonic() + 5.0
+    while b.get("k") != 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert b.get("k") == 2, "peer cache served stale value after replace"
+
+
+def test_local_cached_map_preload(client):
+    m = client.get_local_cached_map("lcm-pre")
+    m.put("a", 1)
+    m.pre_load_cache()  # must not raise (used a nonexistent API before)
+    assert m.get("a") == 1
+
+
+def test_mapcache_add_and_get_preserves_ttl(client):
+    mc = client.get_map_cache("mc-ttl")
+    mc.put("cnt", 5, ttl_seconds=300.0)
+    assert mc.add_and_get("cnt", 1) == 6
+    ttl = mc.remain_time_to_live_entry("cnt")
+    assert 0 < ttl <= 300_000, "add_and_get wiped the entry TTL"
+
+
+def test_grid_rename_onto_sketch_name_rejected(client):
+    bf = client.get_bloom_filter("rn-sk")
+    bf.try_init(1000, 0.01)
+    client.get_bucket("rn-src").set(b"v")
+    with pytest.raises(TypeError):
+        client.get_keys().rename("rn-src", "rn-sk")
+    assert client.get_bucket("rn-src").get() == b"v"
