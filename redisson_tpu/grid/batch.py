@@ -109,6 +109,7 @@ class Batch:
             raise RuntimeError("batch was already executed")
         self._executed = True
         from redisson_tpu.grid.base import GridObject
+        from redisson_tpu.objects.base import camel_to_snake
 
         serial = None  # per-execute single worker: grid ops leave the
         # caller thread but keep submission order (the one-connection
@@ -116,6 +117,12 @@ class Batch:
         staged: list[tuple] = []  # (pending_future_or_None, BatchFuture)
         try:
             for obj, meth, args, kwargs, fut in self._ops:
+                # Normalize camelCase alias spellings FIRST: without it,
+                # 'incrementAndGetAsync' matches neither the _DEFERRED
+                # table nor endswith('_async'), and the batch resolved to
+                # a raw future handle instead of the value.
+                if not hasattr(type(obj), meth):
+                    meth = camel_to_snake(meth)
                 # Sync-named sketch calls ride their deferred (async)
                 # forms so the whole batch coalesces into few device
                 # dispatches — the reference batch pipelines everything
@@ -127,11 +134,19 @@ class Batch:
                         (getattr(obj, deferred)(*args, **kwargs), fut)
                     )
                     continue
-                if isinstance(obj, GridObject) and not meth.endswith("_async"):
-                    # Grid ops pipeline too: off the caller thread (so
-                    # interleaved sketch submits keep coalescing without
-                    # waiting on host work), strictly ordered by the
-                    # single worker.
+                if isinstance(obj, GridObject):
+                    # ALL grid ops — sync- and async-named — run on ONE
+                    # serial worker in submission order (a per-call
+                    # thread for async names raced the serial stream:
+                    # a get could observe the map before an earlier
+                    # fast_put_async).  For *_async names, call the
+                    # underlying sync form: the batch pipeline itself is
+                    # the asynchrony.  Blocking ops act at execute() like
+                    # commands in a Redis MULTI — don't queue them.
+                    if meth.endswith("_async"):
+                        sync_meth = meth[: -len("_async")]
+                        if hasattr(obj, sync_meth):
+                            meth = sync_meth
                     if serial is None:
                         from concurrent.futures import ThreadPoolExecutor
 

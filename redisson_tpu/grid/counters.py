@@ -57,10 +57,11 @@ class AtomicLong(GridObject):
 
     def compare_and_set(self, expect: int, update: int) -> bool:
         with self._store.lock:
-            e = self._entry()
-            if int(e.value) != int(expect):
-                return False
-            e.value = int(update)
+            e = self._entry(create=False)
+            cur = 0 if e is None else int(e.value)  # absent reads as 0
+            if cur != int(expect):
+                return False  # failed CAS must NOT materialize the key
+            self._store.put_entry(self._name, self.KIND, int(update))
             return True
 
     def get_and_delete(self) -> int:
@@ -108,10 +109,11 @@ class AtomicDouble(AtomicLong):
 
     def compare_and_set(self, expect: float, update: float) -> bool:
         with self._store.lock:
-            e = self._entry()
-            if float(e.value) != float(expect):
-                return False
-            e.value = float(update)
+            e = self._entry(create=False)
+            cur = 0.0 if e is None else float(e.value)
+            if cur != float(expect):
+                return False  # failed CAS must NOT materialize the key
+            self._store.put_entry(self._name, self.KIND, float(update))
             return True
 
 
@@ -181,6 +183,10 @@ class IdGenerator(GridObject):
         return {"next": 0, "block": 5000}
 
     def try_init(self, start: int, allocation_size: int) -> bool:
+        if allocation_size < 1:
+            raise ValueError(  # a zero-width block would hand out the
+                "allocation_size must be >= 1"  # same id forever
+            )
         with self._store.lock:
             if self._store.exists(self._name):
                 return False

@@ -80,8 +80,17 @@ class Geo(GridObject):
             return int(new)
 
     def add_entries(self, *entries: tuple) -> int:
-        """add((lon, lat, member), ...) — returns count of new members."""
-        return sum(self.add(lon, lat, m) for lon, lat, m in entries)
+        """add((lon, lat, member), ...) — returns count of new members.
+        All-or-nothing like GEOADD: every coordinate validates BEFORE any
+        member is inserted (a mid-list range error used to leave a
+        partial mutation)."""
+        for lon, lat, _m in entries:
+            if not (
+                -180.0 <= lon <= 180.0 and -85.05112878 <= lat <= 85.05112878
+            ):
+                raise ValueError("coordinates out of range (GEOADD limits)")
+        with self._store.lock:
+            return sum(self.add(lon, lat, m) for lon, lat, m in entries)
 
     def remove(self, member: Any) -> bool:
         with self._store.lock:

@@ -100,9 +100,15 @@ class CacheManager:
         return cache
 
     def get_cache(self, name: str) -> Optional[JCache]:
+        """JSR-107 getCache: None when the cache does not exist (silently
+        creating one dropped the original configuration — a destroyed
+        30s-TTL cache came back immortal)."""
+        return self._caches.get(name)
+
+    def get_or_create_cache(self, name: str, **config) -> JCache:
         if name in self._caches:
             return self._caches[name]
-        return self.create_cache(name)
+        return self.create_cache(name, **config)
 
     def destroy_cache(self, name: str) -> None:
         cache = self._caches.pop(name, None)

@@ -427,13 +427,20 @@ class ReliableTopic(GridObject):
         self._pump: Optional[Any] = None
 
     def publish(self, message: Any) -> int:
-        """Appends to the stream; returns subscriber count.  Delivery is
-        signal-driven: Stream.add notifies the SHARED store condition, so
-        the pump wakes for publishes from ANY handle of this topic (not
-        just this one) — no poll tax, no per-handle wakeup gap."""
+        """Appends to the stream; returns subscriber count across EVERY
+        handle of this topic (the shared stream's listener groups are the
+        truth — this handle's _listeners alone reported 0 when the
+        subscribers lived on another handle).  Delivery is signal-driven:
+        Stream.add notifies the SHARED store condition, so the pump wakes
+        for publishes from ANY handle."""
         self._stream.add({"m": message})
         with self._store.lock:
-            return len(self._listeners)
+            e = self._stream._entry(create=False)
+            if e is None:
+                return 0
+            return sum(
+                1 for g in e.value.groups if g.startswith("listener:")
+            )
 
     def _added_count(self) -> int:
         e = self._stream._entry(create=False)

@@ -153,6 +153,8 @@ class TimeSeries(GridObject):
             return [self._dec(r["v"]) for r in v.rows[:count]]
 
     def last(self, count: int = 1) -> list:
+        if count <= 0:  # [-0:] is the WHOLE list, not none of it
+            return []
         with self._store.lock:
             v = self._live()
             if v is None:
@@ -180,6 +182,8 @@ class TimeSeries(GridObject):
             return out
 
     def poll_last(self, count: int = 1) -> list:
+        if count <= 0:  # [-0:] slices destroyed the ENTIRE series
+            return []
         with self._store.lock:
             v = self._live()
             if v is None or not v.ts:

@@ -19,6 +19,13 @@ loop (default executor), results resolve into the coroutine.
 Like the reference's reactive wrappers this is a REFLECTIVE facade over
 the sync objects: the full method surface (camelCase aliases included)
 is available without per-object adapter code.
+
+Cancellation caveat (shared with the reference's reactive wrappers over
+blocking drivers): cancelling/timing out an await abandons the result
+but cannot interrupt the underlying worker thread — a parked blocking
+call (queue take, lock wait) runs to completion off-loop.  Prefer the
+timeout-taking method variants (poll(timeout), try_lock(wait)) over
+asyncio.wait_for for operations that can block indefinitely.
 """
 
 from __future__ import annotations
@@ -55,10 +62,14 @@ class ReactiveProxy:
                 _spawn_future(target, args, kwargs)._fut
             )
             # Awaiting an already-async method (fooAsync / *_async)
-            # must yield the VALUE, not a future handle: resolve
-            # future-likes off-loop too.
+            # must yield the VALUE, not a future handle.  Only the
+            # framework's OWN future types unwrap — duck-typing on
+            # result()/done() corrupted legitimate return values (a
+            # queue holding concurrent.futures.Future objects would have
+            # its elements awaited instead of returned).
             if (
-                hasattr(res, "result")
+                type(res).__module__.startswith("redisson_tpu")
+                and hasattr(res, "result")
                 and callable(getattr(res, "result"))
                 and hasattr(res, "done")
             ):

@@ -235,3 +235,106 @@ def test_grid_rename_onto_sketch_name_rejected(client):
     with pytest.raises(TypeError):
         client.get_keys().rename("rn-src", "rn-sk")
     assert client.get_bucket("rn-src").get() == b"v"
+
+
+def test_timeseries_zero_count_is_empty(client):
+    ts = client.get_time_series("ts0")
+    for i in range(5):
+        ts.add(i, f"v{i}")
+    assert ts.last(0) == []
+    assert ts.poll_last(0) == []  # used to DESTROY the whole series
+    assert ts.size() == 5
+
+
+def test_batch_camel_async_resolves_value(client):
+    b = client.create_batch()
+    b.getAtomicLong("bc").incrementAndGetAsync()
+    b.get_atomic_long("bc").increment_and_get_async()
+    out = b.execute()
+    assert list(out) == [1, 2], "camelCase Async batch call must resolve"
+
+
+def test_batch_mixed_async_sync_ordered(client):
+    b = client.create_batch()
+    m = b.get_map("bord")
+    m.fast_put_async("k", b"1")
+    m.get("k")
+    out = b.execute()
+    assert out[1] == b"1", "get must observe the earlier queued put"
+
+
+class _QuacksLikeFuture:
+    """Picklable user value with result()/done() methods."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def result(self):
+        return self.inner
+
+    def done(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, _QuacksLikeFuture) and other.inner == self.inner
+
+
+def test_reactive_returns_plain_future_objects():
+    import asyncio
+
+    c = redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
+    try:
+        rc = c.reactive()
+        q = c.get_queue("rfq")
+        q.offer(_QuacksLikeFuture("payload"))
+
+        async def main():
+            return await rc.get_queue("rfq").poll()
+
+        got = asyncio.run(main())
+        # The USER object must come back intact — duck-typed unwrapping
+        # returned got == "payload" before.
+        assert isinstance(got, _QuacksLikeFuture) and got.inner == "payload"
+    finally:
+        c.shutdown()
+
+
+def test_reliable_publish_counts_cross_handle(client):
+    a = client.get_reliable_topic("rtc")
+    b = client.get_reliable_topic("rtc")
+    a.add_listener(lambda ch, m: None)
+    assert b.publish(b"x") == 1, "publish must count other handles' listeners"
+
+
+def test_idgen_rejects_zero_allocation(client):
+    gen = client.get_id_generator("idz")
+    with pytest.raises(ValueError, match="allocation_size"):
+        gen.try_init(0, 0)
+
+
+def test_cas_on_absent_key_does_not_materialize(client):
+    al = client.get_atomic_long("casx")
+    assert al.compare_and_set(5, 6) is False
+    assert client.get_keys().count_exists("casx") == 0
+    assert al.compare_and_set(0, 1) is True  # absent reads as 0, like Redis
+    assert al.get() == 1
+
+
+def test_geo_add_entries_atomic(client):
+    g = client.get_geo("gatomic")
+    with pytest.raises(ValueError):
+        g.add_entries((13.36, 38.11, "a"), (200.0, 0.0, "b"))
+    assert g.pos("a") == {}, "partial GEOADD mutation"
+
+
+def test_jcache_get_cache_none_when_absent(client):
+    mgr = client.get_cache_manager() if hasattr(client, "get_cache_manager") else None
+    if mgr is None:
+        from redisson_tpu.grid.jcache import CacheManager
+
+        mgr = CacheManager(client)
+    cache = mgr.create_cache("jc1", default_ttl_seconds=30)
+    assert mgr.get_cache("jc1") is cache
+    mgr.destroy_cache("jc1")
+    assert mgr.get_cache("jc1") is None
+    assert mgr.get_or_create_cache("jc1") is not None
