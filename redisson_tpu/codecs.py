@@ -66,7 +66,12 @@ class LongCodec(Codec):
     (layout chosen to match the vectorized uint64 fast path)."""
 
     def encode(self, obj: Any) -> bytes:
-        return struct.pack("<q", int(obj))
+        v = int(obj)
+        # Full uint64 range: the ndarray fast path accepts np.uint64 keys
+        # >= 2**63, and the per-element path must produce the SAME
+        # little-endian bytes ('<q' raised struct.error there, crashing
+        # top_k()/estimate() for keys add() had accepted).
+        return struct.pack("<Q", v) if v >= 1 << 63 else struct.pack("<q", v)
 
     def decode(self, data: bytes) -> Any:
         return struct.unpack("<q", data)[0]

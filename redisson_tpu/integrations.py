@@ -36,7 +36,11 @@ def cached(client, cache_name: str, *, ttl_seconds: Optional[float] = None,
         def make_key(args, kwargs):
             if key_fn is not None:
                 return key_fn(*args, **kwargs)
-            return pickle.dumps((args, tuple(sorted(kwargs.items()))))
+            # Function identity in the default key: two functions
+            # memoized into one cache_name must not collide on equal
+            # arguments (f(1) returning g's cached result).
+            ident = (fn.__module__, fn.__qualname__)
+            return pickle.dumps((ident, args, tuple(sorted(kwargs.items()))))
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):

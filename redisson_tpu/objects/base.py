@@ -106,7 +106,11 @@ class RObject(CamelCompatMixin):
         return self._engine.delete(self._name)
 
     def rename(self, new_name: str) -> None:
-        self._engine.rename(self._name, new_name)
+        if not self._engine.rename(self._name, new_name):
+            # Failed rename (missing/expired source) must NOT repoint the
+            # handle — it would silently start mutating whatever already
+            # lives under new_name.
+            raise RuntimeError(f"object {self._name!r} does not exist")
         self._name = new_name
 
     # -- expiry (→ org/redisson/RedissonExpirable.java) --------------------

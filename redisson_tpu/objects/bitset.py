@@ -37,8 +37,10 @@ class BitSet(RObject):
             return bool(
                 self._engine.bitset_set(self._name, [int(index)], value).result()[0]
             )
-        self._engine.bitset_set(self._name, np.asarray(index), value).result()
-        return True
+        # Array argument: same contract as set_many — the PREVIOUS value
+        # per index (the old branch fetched them and returned a constant
+        # True).
+        return self.set_many(np.asarray(index), value)
 
     def set_many(self, indexes, value: bool = True) -> np.ndarray:
         """Vectorized SETBIT: previous value per index."""

@@ -72,8 +72,11 @@ class BloomFilter(RObject):
     # -- data path ---------------------------------------------------------
 
     def add(self, obj) -> bool:
-        """→ RBloomFilter#add(T): True iff at least one bit was newly set."""
-        return bool(self.add_async(obj).result()[0])
+        """→ RBloomFilter#add(T): True iff at least one bit was newly set.
+        ``obj`` is ONE key (wrapped explicitly — a tuple/list argument is
+        a legal single key under pickle-style codecs; the batch forms
+        would have hashed its ELEMENTS as separate keys)."""
+        return bool(self.add_all_async([obj]).result()[0])
 
     def add_all(self, objs) -> int:
         """→ RBloomFilter#add(Collection): number of newly-added elements."""
@@ -85,7 +88,8 @@ class BloomFilter(RObject):
     add_async = add_all_async
 
     def contains(self, obj) -> bool:
-        return bool(self.contains_async(obj).result()[0])
+        """One key, explicitly wrapped (see add)."""
+        return bool(self.contains_all_async([obj]).result()[0])
 
     def contains_all(self, objs) -> int:
         """→ RBloomFilter#contains(Collection): how many are (probably)

@@ -55,7 +55,10 @@ class CountMinSketch(RObject):
         top-K candidate table updated on every add (shared across every
         handle to this name)."""
         created = self._engine.cms_try_init(self._name, int(depth), int(width))
-        if track_top_k:
+        if track_top_k and created:
+            # Only the CREATING init arms tracking: tryInit on an existing
+            # object must change nothing regardless of params (a failed
+            # init silently enabling tracking taxed every handle's adds).
             self._engine.topk.configure(self._name, int(track_top_k))
         return created
 
@@ -95,6 +98,11 @@ class CountMinSketch(RObject):
         return self.add_all_async(objs, counts).result()
 
     def add_all_async(self, objs, counts=None):
+        # Materialize FIRST: a generator would be exhausted by the hash
+        # pass, leaving _make_offer an empty key list (counters updated,
+        # top-K candidates silently never recorded).
+        if not isinstance(objs, np.ndarray):
+            objs = list(objs)
         H1, H2 = self._hash128(objs)
         if counts is None:
             counts = np.ones(len(H1), np.uint32)
@@ -151,6 +159,8 @@ class CountMinSketch(RObject):
         the batch excluded (five adds of one key return 1,2,3,4,5).
         add_all's vectorized path instead returns post-whole-batch
         estimates (5,5,5,5,5); the final table is identical either way."""
+        if not isinstance(objs, np.ndarray):
+            objs = list(objs)  # generators: see add_all_async
         H1, H2 = self._hash128(objs)
         if counts is None:
             counts = np.ones(len(H1), np.uint32)
@@ -188,7 +198,9 @@ class CountMinSketch(RObject):
         if not cands:
             return []
         ests = self.estimate_all(cands)
-        order = np.argsort(-ests, kind="stable")[:k]
+        # int64 BEFORE negation: -uint32 wraps, ranking zero-count stale
+        # candidates as the heaviest hitters.
+        order = np.argsort(-ests.astype(np.int64), kind="stable")[:k]
         return [(cands[i], int(ests[i])) for i in order]
 
 

@@ -33,8 +33,10 @@ class HyperLogLog(RObject):
 
     def add(self, obj) -> bool:
         """→ RHyperLogLog#add: True iff the estimate changed (a register
-        grew)."""
-        return bool(self.add_async(obj).result())
+        grew).  ``obj`` is ONE key, wrapped explicitly — a tuple/list
+        argument is a legal single key under pickle-style codecs (the
+        batch form would hash its ELEMENTS as separate keys)."""
+        return bool(self.add_all_async([obj]).result())
 
     def add_all(self, objs) -> bool:
         """→ RHyperLogLog#addAll(Collection)."""

@@ -25,6 +25,15 @@ class Node:
         instead of hanging the health check."""
         import threading
 
+        # One probe thread per NODE, reused across pings: a wedged device
+        # parks its probe forever, and spawning a fresh thread per call
+        # leaked one stuck thread per monitor sweep (unbounded on a
+        # long-running server).  While the previous probe is still
+        # parked, the device is by definition not answering — report
+        # down WITHOUT stacking another probe behind it.
+        prev = getattr(self, "_probe_thread", None)
+        if prev is not None and prev.is_alive():
+            return False
         result = [False]
 
         def probe():
@@ -38,6 +47,7 @@ class Node:
                 result[0] = False
 
         t = threading.Thread(target=probe, daemon=True)
+        self._probe_thread = t
         t.start()
         t.join(timeout_seconds)
         return result[0] and not t.is_alive()

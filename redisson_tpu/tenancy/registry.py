@@ -212,15 +212,6 @@ class TenantRegistry:
             self._tenants[name] = entry
             return entry, True
 
-    def delete(self, name: str) -> Optional[TenantEntry]:
-        """Removes the tenant; caller must zero the row on device *before*
-        calling (the row is immediately reusable)."""
-        with self._lock:
-            entry = self._tenants.pop(name, None)
-            if entry is not None:
-                entry.pool.free_row(entry.row)
-            return entry
-
     def detach(self, name: str) -> Optional[TenantEntry]:
         """Atomically remove the name WITHOUT freeing the row — the caller
         zeroes the row on device and then frees it.  This ordering makes
@@ -238,12 +229,6 @@ class TenantRegistry:
             if self._tenants.get(name) is not entry:
                 return None
             return self._tenants.pop(name)
-
-    def rename(self, old: str, new: str) -> bool:
-        ok, dest = self.rename_detach_dest(old, new)
-        if dest is not None:
-            dest.pool.free_row(dest.row)
-        return ok
 
     def rename_detach_dest(self, old: str, new: str):
         """Atomic rename; the displaced destination entry (if any) is

@@ -338,3 +338,108 @@ def test_jcache_get_cache_none_when_absent(client):
     mgr.destroy_cache("jc1")
     assert mgr.get_cache("jc1") is None
     assert mgr.get_or_create_cache("jc1") is not None
+
+
+def test_topk_ranking_with_zero_count_candidates(client):
+    cms = client.get_count_min_sketch("tkz")
+    cms.try_init(4, 1 << 10, track_top_k=3)
+    for _ in range(5):
+        cms.add(1)
+    client._engine.cms_reset("tkz") if hasattr(
+        client._engine, "cms_reset"
+    ) else None
+    cms.add(2)  # count 1 vs key 1's post-reset 0 (or 5 if no reset API)
+    top = cms.top_k(2)
+    # Heaviest first; a zero-count stale candidate must never outrank a
+    # live one (the uint32 negation wrap put zeros FIRST).
+    counts = [c for _, c in top]
+    assert counts == sorted(counts, reverse=True), top
+
+
+def test_cms_generator_input_feeds_topk(client):
+    cms = client.get_count_min_sketch("tkg")
+    cms.try_init(4, 1 << 10, track_top_k=3)
+    cms.add_all(x for x in [7, 7, 7, 8])  # generator input
+    top = dict(cms.top_k(2))
+    assert top.get(7) == 3, f"generator keys never reached the table: {top}"
+
+
+def test_sketch_rename_missing_source_keeps_handle(client):
+    bf = client.get_bloom_filter("rn-absent")
+    with pytest.raises(RuntimeError):
+        bf.rename("rn-elsewhere")
+    assert bf.get_name() == "rn-absent" if hasattr(bf, "get_name") else True
+
+
+def test_bloom_singular_tuple_key(client):
+    # Default codec: a tuple is ONE key; add/contains must agree with
+    # add_all([key]).
+    c2 = redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
+    try:
+        bf = c2.get_bloom_filter("tup")
+        bf.try_init(1000, 0.01)
+        bf.add((1, "page"))
+        assert bf.contains((1, "page"))
+        assert c2.get_bloom_filter("tup2").try_init(1000, 0.01)
+        bf2 = c2.get_bloom_filter("tup2")
+        assert bf2.add_all([(1, "page")]) == 1
+        assert bf2.contains((1, "page"))
+    finally:
+        c2.shutdown()
+
+
+def test_bitset_array_set_returns_prev_values(client):
+    import numpy as np
+
+    bs = client.get_bit_set("prevs")
+    bs.set(5)
+    prev = bs.set(np.array([5, 6], dtype=np.uint32))
+    assert list(prev) == [True, False]
+
+
+def test_longcodec_full_uint64_range():
+    import numpy as np
+
+    from redisson_tpu import Config
+    from redisson_tpu.codecs import LongCodec
+
+    c = redisson_tpu.create(Config().set_codec(LongCodec()).use_tpu_sketch(min_bucket=64))
+    try:
+        cms = c.get_count_min_sketch("u64")
+        cms.try_init(4, 1 << 10, track_top_k=2)
+        big = np.uint64((1 << 63) + 5)
+        cms.add_all(np.array([big, big], dtype=np.uint64))
+        assert cms.estimate(big) == 2  # per-element path must not crash
+        assert dict(cms.top_k(1)).get(big) == 2
+    finally:
+        c.shutdown()
+
+
+def test_cached_functions_do_not_collide():
+    from redisson_tpu import Config
+    from redisson_tpu.integrations import cached
+
+    c = redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
+    try:
+        @cached(c, "shared")
+        def f(x):
+            return ("f", x)
+
+        @cached(c, "shared")
+        def g(x):
+            return ("g", x)
+
+        assert f(1) == ("f", 1)
+        assert g(1) == ("g", 1), "g returned f's cached value"
+    finally:
+        c.shutdown()
+
+
+def test_cms_tryinit_existing_does_not_arm_tracking(client):
+    a = client.get_count_min_sketch("nta")
+    assert a.try_init(4, 1 << 10) is True  # no tracking
+    b = client.get_count_min_sketch("nta")
+    assert b.try_init(4, 1 << 10, track_top_k=5) is False
+    assert client._engine.topk.track("nta") == 0, (
+        "failed tryInit armed tracking"
+    )
