@@ -504,10 +504,18 @@ class RedissonTpuClient(CamelCompatMixin):
         return {} if m is None else m.snapshot()
 
     def get_profiler(self):
-        """→ jax.profiler device-trace capture (SURVEY.md §5 tracing row)."""
+        """→ jax.profiler device-trace capture (SURVEY.md §5 tracing
+        row).  ONE shared instance per client: start() on one
+        get_profiler() call and stop() on another must pair up (fresh
+        instances silently left the trace running forever)."""
         from redisson_tpu.serve.metrics import Profiler
 
-        return Profiler()
+        with self._services_lock:
+            prof = getattr(self, "_profiler", None)
+            if prof is None:
+                prof = Profiler()
+                self._profiler = prof
+            return prof
 
     def snapshot(self, directory: Optional[str] = None) -> None:
         """Snapshot the WHOLE logical keyspace (sketch pools + host grid)

@@ -183,13 +183,26 @@ class Config:
         if os.path.exists(text_or_path):
             with open(text_or_path) as f:
                 text = f.read()
+        elif "\n" not in text_or_path and (
+            text_or_path.endswith((".yml", ".yaml", ".json"))
+            or "/" in text_or_path
+        ):
+            # Clearly a PATH that doesn't exist — feeding it to the YAML
+            # parser produced a baffling dict-update ValueError.
+            raise FileNotFoundError(f"config file not found: {text_or_path}")
         try:
             import yaml  # type: ignore
 
             data = yaml.safe_load(text)
         except ImportError:
             data = json.loads(text)
-        return cls.from_dict(data or {})
+        if data is None:
+            data = {}
+        if not isinstance(data, dict):
+            raise ValueError(
+                f"config must parse to a mapping, got {type(data).__name__}"
+            )
+        return cls.from_dict(data)
 
     @classmethod
     def from_json(cls, text: str) -> "Config":

@@ -543,7 +543,11 @@ class TpuSketchEngine(SketchDurabilityMixin):
     # -- bloom -------------------------------------------------------------
 
     def bloom_try_init(self, name, expected_insertions, false_probability) -> bool:
-        m = golden.optimal_num_of_bits(expected_insertions, false_probability)
+        m = golden.optimal_num_of_bits(
+            expected_insertions, false_probability,
+            max_bits=getattr(self.config.tpu_sketch, "max_bloom_bits",
+                             golden.MAX_BLOOM_BITS),
+        )
         k = golden.optimal_num_of_hash_functions(expected_insertions, m)
         params = {
             "size": m,
@@ -1618,7 +1622,11 @@ class HostSketchEngine:
     # -- bloom -------------------------------------------------------------
 
     def bloom_try_init(self, name, expected_insertions, false_probability) -> bool:
-        m = golden.optimal_num_of_bits(expected_insertions, false_probability)
+        m = golden.optimal_num_of_bits(
+            expected_insertions, false_probability,
+            max_bits=getattr(self.config.tpu_sketch, "max_bloom_bits",
+                             golden.MAX_BLOOM_BITS),
+        )
         k = golden.optimal_num_of_hash_functions(expected_insertions, m)
         with self._lock:
             if self._lookup_kind(name, PoolKind.BLOOM) is not None:

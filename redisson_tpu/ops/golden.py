@@ -26,18 +26,20 @@ import numpy as np
 MAX_BLOOM_BITS = 1 << 31  # device kernels require m <= 2**31 (uint32 index math)
 
 
-def optimal_num_of_bits(expected_insertions: int, false_probability: float) -> int:
+def optimal_num_of_bits(expected_insertions: int, false_probability: float,
+                        max_bits: int = MAX_BLOOM_BITS) -> int:
     """→ RedissonBloomFilter#optimalNumOfBits (standard formula)."""
     if false_probability <= 0 or false_probability >= 1:
         raise ValueError("falseProbability must be in (0, 1)")
     n = max(1, expected_insertions)
     m = math.ceil(-n * math.log(false_probability) / (math.log(2) ** 2))
-    if m > MAX_BLOOM_BITS:
+    max_bits = min(int(max_bits), MAX_BLOOM_BITS)
+    if m > max_bits:
         # The reference rejects oversized filters rather than silently
         # degrading FPP (RedissonBloomFilter caps size, SURVEY.md §2.2).
         raise ValueError(
             f"bloom filter needs {m} bits for n={expected_insertions}, "
-            f"p={false_probability}; max is {MAX_BLOOM_BITS}"
+            f"p={false_probability}; max is {max_bits}"
         )
     return max(m, 16)
 

@@ -185,8 +185,10 @@ def sharded_mbit_set(ctx: MeshContext, *, words_local: int):
         if valid is not None:
             own = own & valid
         local_word = gword - my.astype(jnp.uint32) * np.uint32(words_local)
+        # route_invalid_to_scratch overwrites every ~own entry itself —
+        # no pre-select needed.
         local_word = bitops.route_invalid_to_scratch(
-            jnp.where(own, local_word, 0), own, words_local + 1
+            local_word, own, words_local + 1
         )
         new_local, prev = bitops.scatter_set_bits(local, local_word, bit)
         prev = lax.psum(jnp.where(own, prev, 0).astype(jnp.int32), "shard")

@@ -23,15 +23,27 @@ class _Reservoir:
         if len(self.values) < self.cap:
             self.values.append(v)
         else:
-            # Deterministic decimated replacement (no RNG needed).
+            # Sliding ring: keeps the most recent ``cap`` samples (NOT a
+            # uniform sample of the whole run — steady-state windows are
+            # what the percentiles describe).
             self.values[self.n % self.cap] = v
 
-    def percentile(self, p: float) -> float:
+    def percentiles(self, ps) -> list:
+        """Nearest-rank percentiles from ONE sort (int(p/100*n) overshot
+        by a rank: p50 of [1,2,3,4] must be 2, not 3)."""
+        import math
+
         if not self.values:
-            return 0.0
+            return [0.0 for _ in ps]
         vals = sorted(self.values)
-        idx = min(len(vals) - 1, int(p / 100.0 * len(vals)))
-        return vals[idx]
+        n = len(vals)
+        return [
+            vals[min(n - 1, max(0, math.ceil(p / 100.0 * n) - 1))]
+            for p in ps
+        ]
+
+    def percentile(self, p: float) -> float:
+        return self.percentiles([p])[0]
 
 
 class Metrics:
@@ -40,7 +52,6 @@ class Metrics:
         self.started = time.monotonic()
         self.ops_total = 0
         self.batches_total = 0
-        self.batch_occupancy_sum = 0
         self.wait = _Reservoir()
         self.flush = _Reservoir()
 
@@ -51,7 +62,6 @@ class Metrics:
             self.started = time.monotonic()
             self.ops_total = 0
             self.batches_total = 0
-            self.batch_occupancy_sum = 0
             self.wait = _Reservoir()
             self.flush = _Reservoir()
 
@@ -59,24 +69,33 @@ class Metrics:
         with self._lock:
             self.ops_total += nops
             self.batches_total += 1
-            self.batch_occupancy_sum += nops
             self.wait.add(wait_s)
             self.flush.add(flush_s)
 
     def snapshot(self) -> dict:
+        # Copy under the lock (it contends with the hot flush path), sort
+        # OUTSIDE it — and only once per reservoir for both percentiles.
         with self._lock:
             elapsed = max(time.monotonic() - self.started, 1e-9)
             batches = max(self.batches_total, 1)
-            return {
-                "ops_total": self.ops_total,
-                "batches_total": self.batches_total,
-                "ops_per_sec": self.ops_total / elapsed,
-                "mean_batch_occupancy": self.batch_occupancy_sum / batches,
-                "p50_wait_ms": self.wait.percentile(50) * 1e3,
-                "p99_wait_ms": self.wait.percentile(99) * 1e3,
-                "p50_flush_ms": self.flush.percentile(50) * 1e3,
-                "p99_flush_ms": self.flush.percentile(99) * 1e3,
-            }
+            ops_total = self.ops_total
+            batches_total = self.batches_total
+            wait = _Reservoir()
+            wait.values = list(self.wait.values)
+            flush = _Reservoir()
+            flush.values = list(self.flush.values)
+        w50, w99 = wait.percentiles([50, 99])
+        f50, f99 = flush.percentiles([50, 99])
+        return {
+            "ops_total": ops_total,
+            "batches_total": batches_total,
+            "ops_per_sec": ops_total / elapsed,
+            "mean_batch_occupancy": ops_total / batches,
+            "p50_wait_ms": w50 * 1e3,
+            "p99_wait_ms": w99 * 1e3,
+            "p50_flush_ms": f50 * 1e3,
+            "p99_flush_ms": f99 * 1e3,
+        }
 
     def render_prometheus(self) -> str:
         """Plain Prometheus text exposition (SURVEY.md §5 metrics row)."""
@@ -104,23 +123,37 @@ class Profiler:
     """
 
     def __init__(self):
+        import threading
+
         self._active = False
+        self._plock = threading.Lock()
 
     def start(self, log_dir: str) -> None:
         import jax
 
-        if self._active:
-            raise RuntimeError("a profiler trace is already active")
-        jax.profiler.start_trace(log_dir)
-        self._active = True
+        with self._plock:
+            if self._active:
+                raise RuntimeError("a profiler trace is already active")
+            self._active = True
+        try:
+            jax.profiler.start_trace(log_dir)
+        except BaseException:
+            with self._plock:
+                self._active = False
+            raise
+        return
 
     def stop(self) -> None:
         import jax
 
-        if not self._active:
-            return
+        with self._plock:
+            if not self._active:
+                # Calling stop on an inactive profiler is a caller bug
+                # (e.g. a FRESH instance where the active one is lost) —
+                # silently no-opping left the jax trace running forever.
+                raise RuntimeError("no active profiler trace to stop")
+            self._active = False
         jax.profiler.stop_trace()
-        self._active = False
 
     def trace(self, log_dir: str):
         from contextlib import contextmanager
