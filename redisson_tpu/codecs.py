@@ -63,7 +63,18 @@ class ByteArrayCodec(Codec):
 
 class LongCodec(Codec):
     """→ org/redisson/client/codec/LongCodec.java; 8-byte little-endian
-    (layout chosen to match the vectorized uint64 fast path)."""
+    (layout chosen to match the vectorized uint64 fast path).
+
+    Encode accepts the full -2**63 .. 2**64-1 range.  The halves
+    [-2**63, 0) and [2**63, 2**64) share byte patterns, so decode must
+    know which interpretation the caller wants: the default round-trips
+    SIGNED int64 (grid storage paths); ``LongCodec(unsigned=True)``
+    round-trips uint64 (the sketch hash fast path, whose np.uint64 keys
+    may exceed 2**63 — storing those through the default codec would
+    silently come back negative)."""
+
+    def __init__(self, unsigned: bool = False):
+        self.unsigned = unsigned
 
     def encode(self, obj: Any) -> bytes:
         v = int(obj)
@@ -74,7 +85,10 @@ class LongCodec(Codec):
         return struct.pack("<Q", v) if v >= 1 << 63 else struct.pack("<q", v)
 
     def decode(self, data: bytes) -> Any:
-        return struct.unpack("<q", data)[0]
+        v = struct.unpack("<q", data)[0]
+        if self.unsigned and v < 0:
+            v += 1 << 64  # symmetric with the '<Q' encode branch
+        return v
 
 
 class JsonCodec(Codec):

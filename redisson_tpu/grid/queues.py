@@ -555,6 +555,13 @@ class TransferQueue(BlockingQueue):
         vb = self._enc(value)
         if isinstance(vb, str):  # identity tracking needs a fresh object
             vb = vb.encode()
+        else:
+            # ByteArrayCodec.encode returns its input unchanged (bytes(b)
+            # is b), so two concurrent transfer()s of the same bytes
+            # object would alias ONE identity — the first transferer
+            # would only release when every aliased copy drained.  Force
+            # a distinct object per call.
+            vb = bytes(bytearray(vb))
         self._entry().value.append(vb)
         self._store.cond.notify_all()
         while True:

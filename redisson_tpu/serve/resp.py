@@ -206,6 +206,10 @@ class _Reader:
             n = int(line[1:])
         except ValueError:
             raise ProtocolError("invalid multibulk length")
+        if n < 0:
+            # '*-1' etc. would silently desync the stream (mirrors the
+            # native parser, which already rejects negative counts).
+            raise ProtocolError("invalid multibulk length")
         args = []
         for _ in range(n):
             hdr = self._read_line()
@@ -214,6 +218,10 @@ class _Reader:
             try:
                 size = int(hdr[1:])
             except ValueError:
+                raise ProtocolError("invalid bulk length")
+            if size < 0:
+                # '$-1' reaching _read_exact(-1) would slice buf[:-1]
+                # and desync the connection into parsing garbage.
                 raise ProtocolError("invalid bulk length")
             data = self._read_exact(size)
             if data is None:
@@ -344,6 +352,11 @@ class RespServer:
                     return  # desynced stream: close, Redis-style
                 if cmd is None:
                     return
+                if not cmd:
+                    # Empty multibulk ('*0\r\n') / blank inline line:
+                    # Redis silently skips these with NO reply — emitting
+                    # one would desync a pipelining client's reply count.
+                    continue
                 reply = self._safe_dispatch(cmd, ctx)
                 # Pipelined batch: commands the reader already parsed
                 # ahead reply in ONE sendall (the CommandBatchEncoder
@@ -360,7 +373,11 @@ class RespServer:
                         # hostage) or whose handler writes to the socket
                         # ITSELF (SUBSCRIBE's ack would overtake them —
                         # reply order must be command order).
-                        if pending[0] and pending[0][0].upper() in (
+                        if not pending[0]:
+                            # Empty frame in a pipeline: skip, no reply.
+                            pending.popleft()
+                            continue
+                        if pending[0][0].upper() in (
                             b"BLPOP",
                             b"BRPOP",
                             b"SUBSCRIBE",
@@ -1693,9 +1710,20 @@ class RespServer:
                 new = int(cur) + int(delta)
                 stored = str(new).encode()
             # Stored as a plain string key: SET/GET/INCR/INCRBYFLOAT all
-            # interoperate on one key, and TYPE reports "string".
+            # interoperate on one key, and TYPE reports "string" — EXCEPT
+            # when the entry was created via the Python AtomicLong/Double
+            # API: rewriting those as "bucket" would make every later
+            # Python-API call on the live handle raise WRONGTYPE, so the
+            # counter kind is preserved (value stays numeric, not bytes).
             ttl = e.expire_at if e is not None else None
-            ne = grid.put_entry(name, "bucket", stored)
+            if e is not None and e.kind in ("atomiclong", "atomicdouble"):
+                kind = e.kind
+                if kind == "atomiclong" and is_float and not new.is_integer():
+                    kind = "atomicdouble"  # int kind can't hold a fraction
+                val = int(new) if kind == "atomiclong" else float(new)
+                ne = grid.put_entry(name, kind, val)
+            else:
+                ne = grid.put_entry(name, "bucket", stored)
             ne.expire_at = ttl
             return new
 

@@ -1,0 +1,194 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md):
+
+1. Python RESP parser must reject negative multibulk/bulk lengths
+   (they silently desynced the connection).
+2. TransferQueue must not alias two concurrent transfers of the SAME
+   bytes object under one identity.
+3. RESP INCR on a Python-API AtomicLong/AtomicDouble must preserve the
+   counter kind (it rewrote them as 'bucket', breaking the live handle).
+4. LongCodec decode must be symmetric with its uint64 encode branch.
+5. An empty multibulk frame ('*0\\r\\n') must be skipped with NO reply.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.codecs import LongCodec
+from redisson_tpu.serve.resp import RespServer
+
+from test_resp_server import RespClient
+
+
+@pytest.fixture
+def stack():
+    client = redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
+    server = RespServer(client)
+    yield client, server
+    server.close()
+    client.shutdown()
+
+
+class TestNegativeLengths:
+    def _raw(self, server, payload: bytes) -> bytes:
+        s = socket.create_connection((server.host, server.port), timeout=5)
+        try:
+            s.sendall(payload)
+            out = b""
+            while True:
+                try:
+                    data = s.recv(65536)
+                except socket.timeout:
+                    break
+                if not data:
+                    break
+                out += data
+            return out
+        finally:
+            s.close()
+
+    def test_negative_bulk_len_closes_with_protocol_error(self, stack):
+        _, server = stack
+        out = self._raw(server, b"*1\r\n$-1\r\n")
+        assert b"Protocol error" in out
+
+    def test_negative_multibulk_len_closes_with_protocol_error(self, stack):
+        _, server = stack
+        out = self._raw(server, b"*-3\r\nPING\r\n")
+        assert b"Protocol error" in out
+
+    def test_server_still_healthy_after_bad_frames(self, stack):
+        _, server = stack
+        self._raw(server, b"*1\r\n$-5\r\n")
+        conn = RespClient(server.host, server.port)
+        try:
+            assert conn.cmd("PING") == "PONG"
+        finally:
+            conn.close()
+
+
+class TestEmptyMultibulk:
+    def test_empty_frame_skipped_without_reply(self, stack):
+        """'*0\\r\\n' between two pipelined commands must produce exactly
+        two replies — a third would desync the client's reply counting."""
+        _, server = stack
+        s = socket.create_connection((server.host, server.port), timeout=5)
+        try:
+            s.sendall(
+                b"*1\r\n$4\r\nPING\r\n"
+                b"*0\r\n"
+                b"*2\r\n$4\r\nECHO\r\n$2\r\nhi\r\n"
+            )
+            s.settimeout(2)
+            out = b""
+            deadline = time.monotonic() + 5
+            while b"hi" not in out and time.monotonic() < deadline:
+                try:
+                    data = s.recv(65536)
+                except socket.timeout:
+                    break
+                if not data:
+                    break
+                out += data
+            assert out == b"+PONG\r\n$2\r\nhi\r\n"
+        finally:
+            s.close()
+
+
+class TestTransferQueueAliasing:
+    def test_same_bytes_object_two_transfers(self, stack):
+        """Two concurrent transfer() calls with the SAME bytes object:
+        the first consumer take must release exactly one transferer (it
+        used to release neither until both copies drained)."""
+        client, _ = stack
+        q = client.get_transfer_queue("advice5-tq")
+        payload = b"shared-payload"
+        done = []
+
+        def xfer():
+            ok = q.transfer(payload, timeout_seconds=20)
+            done.append(ok)
+
+        t1 = threading.Thread(target=xfer)
+        t2 = threading.Thread(target=xfer)
+        t1.start()
+        t2.start()
+        deadline = time.monotonic() + 5
+        while q.size() < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert q.size() == 2
+
+        assert q.poll() == payload
+        deadline = time.monotonic() + 10
+        while len(done) < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(done) == 1 and done[0] is True
+
+        assert q.poll() == payload
+        t1.join(10)
+        t2.join(10)
+        assert done == [True, True]
+
+
+class TestIncrKindPreservation:
+    def test_incr_preserves_atomiclong(self, stack):
+        client, server = stack
+        al = client.get_atomic_long("advice5-counter")
+        al.set(41)
+        conn = RespClient(server.host, server.port)
+        try:
+            assert conn.cmd("INCR", "advice5-counter") == 42
+        finally:
+            conn.close()
+        # The live Python handle must still work — the old behavior
+        # rewrote the kind to 'bucket' and every later call raised.
+        assert al.get() == 42
+        assert al.increment_and_get() == 43
+
+    def test_incrbyfloat_preserves_atomicdouble(self, stack):
+        client, server = stack
+        ad = client.get_atomic_double("advice5-double")
+        ad.set(1.5)
+        conn = RespClient(server.host, server.port)
+        try:
+            raw = conn.cmd("INCRBYFLOAT", "advice5-double", "2.25")
+            assert float(raw) == 3.75
+        finally:
+            conn.close()
+        assert ad.get() == 3.75
+
+    def test_plain_string_counters_still_bucket(self, stack):
+        """SET+INCR (no Python counter involved) keeps Redis semantics:
+        the key stays a string, TYPE says 'string'."""
+        client, server = stack
+        conn = RespClient(server.host, server.port)
+        try:
+            assert conn.cmd("SET", "advice5-str", "7") == "OK"
+            assert conn.cmd("INCR", "advice5-str") == 8
+            assert conn.cmd("TYPE", "advice5-str") == "string"
+            assert conn.cmd("GET", "advice5-str") == b"8"
+        finally:
+            conn.close()
+
+
+class TestLongCodecSymmetry:
+    def test_signed_roundtrip(self):
+        c = LongCodec()
+        for v in (0, 1, -1, 2**63 - 1, -(2**63)):
+            assert c.decode(c.encode(v)) == v
+
+    def test_unsigned_roundtrip(self):
+        c = LongCodec(unsigned=True)
+        for v in (0, 7, 2**63, 2**63 + 7, 2**64 - 1):
+            assert c.decode(c.encode(v)) == v
+
+    def test_default_documents_signed_view(self):
+        # The ambiguous half: a uint64 >= 2**63 stored through the
+        # DEFAULT codec decodes as its signed reinterpretation (the two
+        # ranges share byte patterns; unsigned=True selects the other).
+        c = LongCodec()
+        assert c.decode(c.encode(2**64 - 1)) == -1
