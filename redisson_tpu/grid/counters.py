@@ -9,16 +9,42 @@ from __future__ import annotations
 from redisson_tpu.grid.base import GridObject
 
 
+def _as_int(v) -> int:
+    """Strict integer view of a counter value.  A fractional value (left
+    by a RESP INCRBYFLOAT on this key) raises like Java's
+    NumberFormatException in RedissonAtomicLong — silently truncating
+    would lose the fraction on the next write."""
+    if isinstance(v, int):
+        return v  # no float round-trip: ints past 2**53 (or 10**400)
+    if not float(v).is_integer():
+        raise ValueError(f"counter value {v!r} is not an integer")
+    return int(v)
+
+
 class AtomicLong(GridObject):
     KIND = "atomiclong"
+    # One counter FAMILY on read: RESP INCR/INCRBYFLOAT may legitimately
+    # flip an entry between the two kinds (serve/resp.py _numeric_incr),
+    # and a live handle of either class must keep working across that.
+    _FAMILY = ("atomiclong", "atomicdouble")
 
     @staticmethod
     def _new_value():
         return 0
 
+    def _entry(self, create: bool = True):
+        e = self._store.get_entry(self._name)
+        if e is not None and e.kind not in self._FAMILY:
+            raise TypeError(
+                f"object {self._name!r} holds a {e.kind}, not a {self.KIND}"
+            )
+        if e is None and create:
+            e = self._store.ensure_entry(self._name, self.KIND, self._new_value)
+        return e
+
     def get(self) -> int:
         e = self._entry(create=False)
-        return 0 if e is None else e.value
+        return 0 if e is None else _as_int(e.value)
 
     def set(self, value: int) -> None:
         self._store.put_entry(self._name, self.KIND, int(value))
@@ -26,14 +52,16 @@ class AtomicLong(GridObject):
     def add_and_get(self, delta: int) -> int:
         with self._store.lock:
             e = self._entry()
-            e.value = int(e.value) + int(delta)
+            e.value = _as_int(e.value) + int(delta)
+            e.kind = self.KIND  # an integer write re-claims the int kind
             return e.value
 
     def get_and_add(self, delta: int) -> int:
         with self._store.lock:
             e = self._entry()
-            old = int(e.value)
+            old = _as_int(e.value)
             e.value = old + int(delta)
+            e.kind = self.KIND
             return old
 
     def increment_and_get(self) -> int:
@@ -51,14 +79,15 @@ class AtomicLong(GridObject):
     def get_and_set(self, value: int) -> int:
         with self._store.lock:
             e = self._entry()
-            old = int(e.value)
+            old = _as_int(e.value)
             e.value = int(value)
+            e.kind = self.KIND
             return old
 
     def compare_and_set(self, expect: int, update: int) -> bool:
         with self._store.lock:
             e = self._entry(create=False)
-            cur = 0 if e is None else int(e.value)  # absent reads as 0
+            cur = 0 if e is None else _as_int(e.value)  # absent reads as 0
             if cur != int(expect):
                 return False  # failed CAS must NOT materialize the key
             self._store.put_entry(self._name, self.KIND, int(update))
@@ -82,7 +111,7 @@ class AtomicDouble(AtomicLong):
 
     def get(self) -> float:
         e = self._entry(create=False)
-        return 0.0 if e is None else e.value
+        return 0.0 if e is None else float(e.value)
 
     def set(self, value: float) -> None:
         self._store.put_entry(self._name, self.KIND, float(value))
@@ -91,6 +120,7 @@ class AtomicDouble(AtomicLong):
         with self._store.lock:
             e = self._entry()
             e.value = float(e.value) + float(delta)
+            e.kind = self.KIND  # a float write claims the double kind
             return e.value
 
     def get_and_add(self, delta: float) -> float:
@@ -98,6 +128,7 @@ class AtomicDouble(AtomicLong):
             e = self._entry()
             old = float(e.value)
             e.value = old + float(delta)
+            e.kind = self.KIND
             return old
 
     def get_and_set(self, value: float) -> float:
@@ -105,6 +136,7 @@ class AtomicDouble(AtomicLong):
             e = self._entry()
             old = float(e.value)
             e.value = float(value)
+            e.kind = self.KIND
             return old
 
     def compare_and_set(self, expect: float, update: float) -> bool:

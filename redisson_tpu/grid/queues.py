@@ -535,6 +535,13 @@ class PriorityDeque(PriorityQueue):
                 return None
             return self._dec(e.value[-1][1])
 
+class _TransferHandle(bytes):
+    """bytes subclass used only for its guaranteed-fresh identity (the
+    constructor can never return an interned builtin-bytes singleton)."""
+
+    __slots__ = ()
+
+
 class TransferQueue(BlockingQueue):
     """→ RTransferQueue (java.util.concurrent.TransferQueue semantics):
     ``transfer`` blocks until a consumer takes the element; plain
@@ -553,15 +560,16 @@ class TransferQueue(BlockingQueue):
         """Caller holds the store cond.  Appends the offer, waits for a
         consumer to take it; withdraws on timeout."""
         vb = self._enc(value)
-        if isinstance(vb, str):  # identity tracking needs a fresh object
+        if isinstance(vb, str):
             vb = vb.encode()
-        else:
-            # ByteArrayCodec.encode returns its input unchanged (bytes(b)
-            # is b), so two concurrent transfer()s of the same bytes
-            # object would alias ONE identity — the first transferer
-            # would only release when every aliased copy drained.  Force
-            # a distinct object per call.
-            vb = bytes(bytearray(vb))
+        # Identity tracking needs a DISTINCT object per transfer call:
+        # ByteArrayCodec.encode returns its input unchanged, and CPython
+        # interns empty/1-byte bytes (bytes(bytearray(b'a')) is b'a'), so
+        # any plain-bytes copy can still alias two concurrent transfers
+        # of the same value under one identity.  A bytes-subclass
+        # instance is never the cached singleton, behaves as bytes
+        # everywhere else, and decodes identically for consumers.
+        vb = _TransferHandle(vb)
         self._entry().value.append(vb)
         self._store.cond.notify_all()
         while True:

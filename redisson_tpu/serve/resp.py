@@ -593,6 +593,22 @@ class RespServer:
 
         return self._raw(Bucket(self._s(key), self._client))
 
+    def _str_get(self, key: bytes) -> Optional[bytes]:
+        """String-view read: Redis counters ARE string keys, so the
+        string read commands must serve atomiclong/atomicdouble entries
+        (created via the Python counter API) in their string form
+        rather than raising WRONGTYPE — TYPE already reports them as
+        "string" (see _cmd_TYPE)."""
+        grid = self._client._grid
+        with grid.lock:
+            e = grid.get_entry(self._s(key))
+            if e is not None and e.kind in ("atomiclong", "atomicdouble"):
+                v = e.value
+                return (
+                    _fmt_score(v) if isinstance(v, float) else str(int(v))
+                ).encode()
+        return self._bucket(key).get()
+
     def _cmd_SET(self, args):
         key, value = args[0], args[1]
         ttl = None
@@ -611,13 +627,13 @@ class RespServer:
         return _encode_simple("OK")
 
     def _cmd_GET(self, args):
-        return _encode_bulk(self._bucket(args[0]).get())
+        return _encode_bulk(self._str_get(args[0]))
 
     def _cmd_MGET(self, args):
         out = []
         for k in args:
             try:
-                out.append(self._bucket(k).get())
+                out.append(self._str_get(k))
             except TypeError:  # WRONGTYPE slot: nil, Redis-style
                 out.append(None)
         return _encode_array(out)
@@ -639,29 +655,31 @@ class RespServer:
         return _encode_simple("OK")
 
     def _cmd_GETSET(self, args):
-        return _encode_bulk(self._bucket(args[0]).get_and_set(args[1]))
+        with self._client._grid.lock:  # atomic RMW (RLock)
+            v = self._str_get(args[0])
+            self._bucket(args[0]).set(args[1])
+        return _encode_bulk(v)
 
     def _cmd_GETDEL(self, args):
-        b = self._bucket(args[0])
         with self._client._grid.lock:  # atomic read+delete (RLock)
-            v = b.get()
+            v = self._str_get(args[0])
             if v is not None:
-                b.delete()
+                self._client._grid.delete(self._s(args[0]))
         return _encode_bulk(v)
 
     def _cmd_APPEND(self, args):
         b = self._bucket(args[0])
         with self._client._grid.lock:  # atomic RMW, Redis APPEND contract
-            v = (b.get() or b"") + args[1]
-            b.set(v)
+            v = (self._str_get(args[0]) or b"") + args[1]
+            b.set(v)  # no longer numeric: the bucket kind is honest now
         return _encode_int(len(v))
 
     def _cmd_STRLEN(self, args):
-        v = self._bucket(args[0]).get()
+        v = self._str_get(args[0])
         return _encode_int(0 if v is None else len(v))
 
     def _cmd_GETRANGE(self, args):
-        v = self._bucket(args[0]).get() or b""
+        v = self._str_get(args[0]) or b""
         start, end = int(args[1]), int(args[2])
         if start < 0:
             start = max(0, len(v) + start)
@@ -675,7 +693,7 @@ class RespServer:
         if off < 0:
             raise RespError("offset is out of range")
         with self._client._grid.lock:  # atomic RMW
-            v = bytearray(b.get() or b"")
+            v = bytearray(self._str_get(args[0]) or b"")
             if len(v) < off + len(args[2]):
                 v.extend(b"\x00" * (off + len(args[2]) - len(v)))
             v[off : off + len(args[2])] = args[2]
@@ -1701,14 +1719,12 @@ class RespServer:
                 )
             if is_float:
                 new = float(cur) + float(delta)
-                stored = _fmt_score(new).encode()
             else:
                 # Exact-int check (float(cur)==int(cur) loses precision
                 # past 2**53; Redis counters span full signed 64-bit).
                 if isinstance(cur, float) and not cur.is_integer():
                     raise RespError("value is not an integer or out of range")
                 new = int(cur) + int(delta)
-                stored = str(new).encode()
             # Stored as a plain string key: SET/GET/INCR/INCRBYFLOAT all
             # interoperate on one key, and TYPE reports "string" — EXCEPT
             # when the entry was created via the Python AtomicLong/Double
@@ -1723,6 +1739,9 @@ class RespServer:
                 val = int(new) if kind == "atomiclong" else float(new)
                 ne = grid.put_entry(name, kind, val)
             else:
+                stored = (
+                    _fmt_score(new) if is_float else str(new)
+                ).encode()
                 ne = grid.put_entry(name, "bucket", stored)
             ne.expire_at = ttl
             return new

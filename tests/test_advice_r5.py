@@ -84,9 +84,10 @@ class TestEmptyMultibulk:
                 b"*2\r\n$4\r\nECHO\r\n$2\r\nhi\r\n"
             )
             s.settimeout(2)
+            expected = b"+PONG\r\n$2\r\nhi\r\n"
             out = b""
             deadline = time.monotonic() + 5
-            while b"hi" not in out and time.monotonic() < deadline:
+            while len(out) < len(expected) and time.monotonic() < deadline:
                 try:
                     data = s.recv(65536)
                 except socket.timeout:
@@ -94,7 +95,7 @@ class TestEmptyMultibulk:
                 if not data:
                     break
                 out += data
-            assert out == b"+PONG\r\n$2\r\nhi\r\n"
+            assert out == expected
         finally:
             s.close()
 
@@ -134,6 +135,39 @@ class TestTransferQueueAliasing:
         assert done == [True, True]
 
 
+    def test_interned_one_byte_value_two_transfers(self, stack):
+        """CPython interns empty/1-byte bytes: a plain copy of b'a' IS
+        b'a', so without a fresh-identity wrapper two transfers of the
+        same tiny value alias one identity and neither releases until
+        both copies drain."""
+        client, _ = stack
+        q = client.get_transfer_queue("advice5-tq-tiny")
+        done = []
+
+        def xfer():
+            done.append(q.transfer(b"a", timeout_seconds=20))
+
+        t1 = threading.Thread(target=xfer)
+        t2 = threading.Thread(target=xfer)
+        t1.start()
+        t2.start()
+        deadline = time.monotonic() + 5
+        while q.size() < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert q.size() == 2
+
+        assert q.poll() == b"a"
+        deadline = time.monotonic() + 10
+        while len(done) < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(done) == 1 and done[0] is True
+
+        assert q.poll() == b"a"
+        t1.join(10)
+        t2.join(10)
+        assert done == [True, True]
+
+
 class TestIncrKindPreservation:
     def test_incr_preserves_atomiclong(self, stack):
         client, server = stack
@@ -160,6 +194,53 @@ class TestIncrKindPreservation:
         finally:
             conn.close()
         assert ad.get() == 3.75
+
+    def test_incrbyfloat_fractional_keeps_long_handle_alive(self, stack):
+        """A fractional INCRBYFLOAT flips the entry to the sibling
+        counter kind — the live AtomicLong handle must NOT raise
+        WRONGTYPE: fractional reads raise ValueError (the Java
+        NumberFormatException analog) and integral reads keep working."""
+        client, server = stack
+        al = client.get_atomic_long("advice5-frac")
+        al.set(1)
+        conn = RespClient(server.host, server.port)
+        try:
+            conn.cmd("INCRBYFLOAT", "advice5-frac", "0.5")
+            with pytest.raises(ValueError):
+                al.get()  # fractional: value error, never WRONGTYPE
+            assert client.get_atomic_double("advice5-frac").get() == 1.5
+            conn.cmd("INCRBYFLOAT", "advice5-frac", "0.5")
+        finally:
+            conn.close()
+        assert al.get() == 2
+        assert al.increment_and_get() == 3
+
+    def test_string_reads_serve_counter_kinds(self, stack):
+        """GET/MGET/STRLEN/GETRANGE on a preserved counter kind must
+        serve the string view (TYPE says 'string'), not WRONGTYPE."""
+        client, server = stack
+        al = client.get_atomic_long("advice5-read")
+        al.set(41)
+        conn = RespClient(server.host, server.port)
+        try:
+            assert conn.cmd("INCR", "advice5-read") == 42
+            assert conn.cmd("GET", "advice5-read") == b"42"
+            assert conn.cmd("STRLEN", "advice5-read") == 2
+            assert conn.cmd("GETRANGE", "advice5-read", 0, 0) == b"4"
+            assert conn.cmd("MGET", "advice5-read") == [b"42"]
+            assert conn.cmd("TYPE", "advice5-read") == "string"
+        finally:
+            conn.close()
+        assert al.get() == 42
+
+    def test_huge_int_counter_no_float_roundtrip(self, stack):
+        """_as_int must not route ints through float(): 10**400
+        overflows float64."""
+        client, _ = stack
+        al = client.get_atomic_long("advice5-big")
+        al.set(10**400)
+        assert al.get() == 10**400
+        assert al.increment_and_get() == 10**400 + 1
 
     def test_plain_string_counters_still_bucket(self, stack):
         """SET+INCR (no Python counter involved) keeps Redis semantics:
