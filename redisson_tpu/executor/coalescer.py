@@ -381,14 +381,27 @@ class BatchCoalescer:
                 c[0] if len(c) == 1 else np.concatenate(c)
                 for c in zip(*seg.chunks)
             ]
+            # Mailbox engines: skip the per-launch eager D2H prefetch —
+            # the completer resolves results through collect_group's ONE
+            # grouped fetch, and on the tunnel each extra host-bound
+            # transfer costs a full round trip in slow phases.
+            from redisson_tpu.executor.tpu_executor import defer_host_fetch
+            import contextlib
+
+            fetch_ctx = (
+                defer_host_fetch()
+                if self._group_collect is not None
+                else contextlib.nullcontext()
+            )
             lazy = None
             last_err: Optional[BaseException] = None
             for attempt in range(self.retry_attempts):
                 try:
-                    if seg.metas is not None:
-                        lazy = seg.dispatch(cols, seg.metas)
-                    else:
-                        lazy = seg.dispatch(cols)
+                    with fetch_ctx:
+                        if seg.metas is not None:
+                            lazy = seg.dispatch(cols, seg.metas)
+                        else:
+                            lazy = seg.dispatch(cols)
                     last_err = None
                     break
                 except NonRetryableDispatchError as e:
@@ -435,8 +448,11 @@ class BatchCoalescer:
             # (collect_group).  A backlog here means those launches
             # retired while we were busy — their individual collect times
             # are not genuine link samples either way.
+            # Scoop bound: max_inflight caps pending completions well
+            # below this; collect_group's multi-round concat tree makes
+            # ANY group size one fetch, so bigger scoops only help.
             group = [item]
-            while self._group_collect is not None and len(group) < 8:
+            while self._group_collect is not None and len(group) < 64:
                 try:
                     nxt = self._completions.get_nowait()
                 except queue.Empty:

@@ -39,6 +39,40 @@ from redisson_tpu.tenancy import SizeClassPool
 
 _REPLICATORS: dict = {}
 
+# Device-side scan chunking: ONE launch for arbitrarily large batches
+# with bounded kernel intermediates.  XLA's fused device-hash contains
+# path materializes a ~(B*k, 128)-lane u32 buffer — an 8M-op launch
+# failed compile with a 30 GB allocation on 16 GB HBM — so huge batches
+# lax.scan the same per-chunk kernel sequentially on device: one H2D,
+# one launch, one mailbox fetch, whatever the batch size.  This is what
+# keeps the client path a-handful-of-round-trips per tens of millions
+# of ops in link phases that charge ~an RT per TRANSFER.
+_SCAN_CHUNK = 1 << 20
+
+# Per-thread switch suppressing LazyResult's eager per-launch D2H
+# prefetch inside a bulk dispatch region whose results come home
+# through the mailbox (collect_group).  On the tunneled link every
+# host-bound transfer costs a full round trip regardless of size, so a
+# group of G launches each issuing its own fire-and-forget
+# copy_to_host_async can serialize into G round trips in slow phases —
+# the exact cost the mailbox's single grouped fetch exists to avoid.
+_fetch_ctl = threading.local()
+
+
+class defer_host_fetch:
+    """Context manager: LazyResults created inside skip their eager
+    copy_to_host_async (their values resolve via collect_group's ONE
+    grouped fetch, or a synchronous np.asarray at .result())."""
+
+    def __enter__(self):
+        self._prev = getattr(_fetch_ctl, "defer", False)
+        _fetch_ctl.defer = True
+        return self
+
+    def __exit__(self, *exc):
+        _fetch_ctl.defer = self._prev
+        return False
+
 
 def ensure_addressable(arr):
     """Multi-host (docs/MULTIHOST.md): a result sharded over a mesh that
@@ -71,9 +105,13 @@ class LazyResult:
         self._n = n
         self._transform = transform
         self._done = None
-        if isinstance(value, jax.Array):
+        if isinstance(value, jax.Array) and not getattr(
+            _fetch_ctl, "defer", False
+        ):
             # Start the D2H transfer immediately so .result() overlaps with
             # subsequent dispatches (hides the per-roundtrip link latency).
+            # Suppressed inside defer_host_fetch regions — bulk groups
+            # resolve through ONE mailbox fetch instead.
             try:
                 value.copy_to_host_async()
             except Exception:
@@ -248,29 +286,46 @@ class TpuCommandExecutor:
         for (dtype, shape), group in by_sig.items():
             if len(group) < 2:
                 continue  # a lone result fetches itself at .result() time
-            # Cap the arity so the compile space is (dtype, shape, ≤8).
-            for start in range(0, len(group), 8):
-                chunk = group[start : start + 8]
-                if len(chunk) < 2:
-                    break
-                vals = [l._value for l in chunk]
-                key = ("mailbox", dtype.name, shape, len(chunk))
+            # Multi-round device-side concat tree: rounds of ≤8-ary
+            # concats collapse the WHOLE group to one flat array, so a
+            # group of ANY size costs exactly ONE D2H fetch — ops-per-
+            # sync scales with the caller's group, not with a fixed
+            # concat arity (a 32-launch pass used to take 4 fetches;
+            # at 263 ms/fetch RT that alone capped the headline).  Each
+            # round's compile key is the tuple of its operand shapes:
+            # round 1 sees one (dtype, shape, ≤8) combo, later rounds a
+            # couple of grown shapes — the cached-program space stays
+            # small while arity is unbounded.
+            vals = [l._value for l in group]
+            while len(vals) > 1:
+                nxt = []
+                for start in range(0, len(vals), 8):
+                    chunk = vals[start : start + 8]
+                    if len(chunk) == 1:
+                        nxt.append(chunk[0])
+                        continue
+                    key = (
+                        "mailbox",
+                        dtype.name,
+                        tuple(tuple(map(int, x.shape)) for x in chunk),
+                    )
 
-                def build():
-                    def f(*xs):
-                        return jnp.concatenate([x.reshape(-1) for x in xs])
+                    def build():
+                        def f(*xs):
+                            return jnp.concatenate([x.reshape(-1) for x in xs])
 
-                    return f
+                        return f
 
-                fn = self._jit(key, build, donate=False)
-                flat = np.asarray(ensure_addressable(fn(*vals)))
-                off = 0
-                n = int(np.prod(shape))
-                for l in chunk:
-                    # .copy(): a view would pin the whole group's concat
-                    # buffer for as long as any ONE result is retained.
-                    l.resolve_from(flat[off : off + n].reshape(shape).copy())
-                    off += n
+                    nxt.append(self._jit(key, build, donate=False)(*chunk))
+                vals = nxt
+            flat = np.asarray(ensure_addressable(vals[0]))
+            off = 0
+            n = int(np.prod(shape))
+            for l in group:
+                # .copy(): a view would pin the whole group's concat
+                # buffer for as long as any ONE result is retained.
+                l.resolve_from(flat[off : off + n].reshape(shape).copy())
+                off += n
 
     @staticmethod
     def _pad(arr: np.ndarray, n_pad: int, fill=0):
@@ -580,12 +635,41 @@ class TpuCommandExecutor:
         key = ("bloom_add_keys", wpr, pool.state.shape[0], Bp, k, L, Lt, const_len)
 
         def build():
-            def f(state, row, blocks, lengths, m, valid):
+            def one(state, row, blocks, lengths, m, valid):
                 new, newly = fastpath.bloom_add_keys_st(
                     state, row, blocks, lengths, m, valid,
                     k=k, words_per_row=wpr, target_lanes=L,
                 )
                 return new, bitops.pack_bool_u32(newly)
+
+            if Bp <= _SCAN_CHUNK or Bp % _SCAN_CHUNK:
+                # Non-multiple buckets (a custom min_bucket need not be a
+                # power of two) cannot reshape into chunks: single launch.
+                return one
+
+            nc = Bp // _SCAN_CHUNK
+
+            def f(state, row, blocks, lengths, m, valid):
+                blocks_c = blocks.reshape(nc, _SCAN_CHUNK, blocks.shape[1])
+                valid_c = valid.reshape(nc, _SCAN_CHUNK)
+                if const_len:
+                    def body(st, xs):
+                        return one(st, row, xs[0], lengths, m, xs[1])
+
+                    new_state, outs = jax.lax.scan(
+                        body, state, (blocks_c, valid_c)
+                    )
+                else:
+                    def body(st, xs):
+                        return one(st, row, xs[0], xs[2], m, xs[1])
+
+                    new_state, outs = jax.lax.scan(
+                        body, state,
+                        (blocks_c, valid_c,
+                         lengths.reshape(nc, _SCAN_CHUNK)),
+                    )
+                return new_state, outs.reshape(-1)
+
             return f
 
         fn = self._jit(key, build, donate=True)
@@ -619,11 +703,36 @@ class TpuCommandExecutor:
         key = ("bloom_contains_keys", wpr, pool.state.shape[0], Bp, k, L, Lt, const_len)
 
         def build():
-            def f(state, row, blocks, lengths, m):
+            def one(state, row, blocks, lengths, m):
                 return bitops.pack_bool_u32(fastpath.bloom_contains_keys_st(
                     state, row, blocks, lengths, m,
                     k=k, words_per_row=wpr, target_lanes=L,
                 ))
+
+            if Bp <= _SCAN_CHUNK or Bp % _SCAN_CHUNK:
+                # Non-multiple buckets (a custom min_bucket need not be a
+                # power of two) cannot reshape into chunks: single launch.
+                return one
+
+            nc = Bp // _SCAN_CHUNK
+
+            def f(state, row, blocks, lengths, m):
+                blocks_c = blocks.reshape(nc, _SCAN_CHUNK, blocks.shape[1])
+                if const_len:
+                    def body(c, bl):
+                        return c, one(state, row, bl, lengths, m)
+
+                    _, outs = jax.lax.scan(body, 0, blocks_c)
+                else:
+                    def body(c, xs):
+                        return c, one(state, row, xs[0], xs[1], m)
+
+                    _, outs = jax.lax.scan(
+                        body, 0,
+                        (blocks_c, lengths.reshape(nc, _SCAN_CHUNK)),
+                    )
+                return outs.reshape(-1)
+
             return f
 
         fn = self._jit(key, build, donate=False)
@@ -649,10 +758,39 @@ class TpuCommandExecutor:
         key = ("hll_add_keys", pool.state.shape[0], Bp, L, Lt, const_len)
 
         def build():
-            def f(state, row, blocks, lengths, valid):
+            def one(state, row, blocks, lengths, valid):
                 return fastpath.hll_add_keys_single(
                     state, row, blocks, lengths, valid, target_lanes=L
                 )
+
+            if Bp <= _SCAN_CHUNK or Bp % _SCAN_CHUNK:
+                # Non-multiple buckets (a custom min_bucket need not be a
+                # power of two) cannot reshape into chunks: single launch.
+                return one
+
+            nc = Bp // _SCAN_CHUNK
+
+            def f(state, row, blocks, lengths, valid):
+                blocks_c = blocks.reshape(nc, _SCAN_CHUNK, blocks.shape[1])
+                valid_c = valid.reshape(nc, _SCAN_CHUNK)
+                if const_len:
+                    def body(st, xs):
+                        return one(st, row, xs[0], lengths, xs[1])
+
+                    new_state, ch = jax.lax.scan(
+                        body, state, (blocks_c, valid_c)
+                    )
+                else:
+                    def body(st, xs):
+                        return one(st, row, xs[0], xs[2], xs[1])
+
+                    new_state, ch = jax.lax.scan(
+                        body, state,
+                        (blocks_c, valid_c,
+                         lengths.reshape(nc, _SCAN_CHUNK)),
+                    )
+                return new_state, ch.any()
+
             return f
 
         fn = self._jit(key, build, donate=True)

@@ -26,6 +26,25 @@ def _haversine_m(lon1, lat1, lon2, lat2) -> float:
     return 2 * _EARTH_M * math.asin(math.sqrt(a))
 
 
+_LAT_MAX = 85.05112878  # Redis's geohash latitude clamp (web-mercator)
+
+
+def _geohash_int52(lon: float, lat: float) -> int:
+    """52-bit interleaved geohash cell id — the score Redis stores in
+    the zset behind a geo key (26 lon bits + 26 lat bits, lon first),
+    and the WITHHASH reply value.  Uses Redis's ±85.05112878° latitude
+    range, NOT ±90 — the standard constants a client decodes with."""
+    lat_off = (lat + _LAT_MAX) / (2 * _LAT_MAX)
+    lon_off = (lon + 180.0) / 360.0
+    ilat = min(int(lat_off * (1 << 26)), (1 << 26) - 1)
+    ilon = min(int(lon_off * (1 << 26)), (1 << 26) - 1)
+    out = 0
+    for i in range(26):
+        out |= ((ilon >> i) & 1) << (2 * i + 1)
+        out |= ((ilat >> i) & 1) << (2 * i)
+    return out
+
+
 def _geohash(lon: float, lat: float, precision: int = 11) -> str:
     """Standard base32 geohash (the GEOHASH reply shape)."""
     lat_r = [-90.0, 90.0]
@@ -173,6 +192,100 @@ class Geo(GridObject):
         return self.search_radius(
             origin[0], origin[1], radius, unit, count, with_dist
         )
+
+    def search(self, *, member: Any = None, longitude: Optional[float] = None,
+               latitude: Optional[float] = None, radius: Optional[float] = None,
+               width: Optional[float] = None, height: Optional[float] = None,
+               unit: str = "m", count: Optional[int] = None,
+               count_any: bool = False, order: Optional[str] = None,
+               with_coord: bool = False, with_dist: bool = False,
+               with_hash: bool = False):
+        """→ RGeo#search(GeoSearchArgs) / GEOSEARCH: origin is FROMMEMBER
+        (``member``) or FROMLONLAT (``longitude``/``latitude``); shape is
+        BYRADIUS (``radius``) or BYBOX (``width``×``height``, box
+        half-extents measured along the lon/lat axes through the center,
+        the Redis box test); ``order`` is "asc"/"desc"/None, ``count``
+        with ``count_any`` stops at the first COUNT matches unsorted
+        (COUNT n ANY).  Plain member list without with-flags; with any
+        WITH* flag, a list of dicts {member, dist?, coord?, hash?}.
+        ``dist`` is in ``unit`` like GEOSEARCH replies."""
+        scale = _UNITS[unit]
+        if (radius is None) == (width is None or height is None):
+            raise ValueError("search needs exactly one of radius or width+height")
+        with self._store.lock:
+            e = self._entry(create=False)
+            if e is None:
+                return []
+            if member is not None:
+                origin = e.value.get(self._enc(member))
+                if origin is None:
+                    raise ValueError(f"member {member!r} has no position")
+                lon_c, lat_c = origin
+            else:
+                if longitude is None or latitude is None:
+                    raise ValueError("search needs a member or lon/lat origin")
+                lon_c, lat_c = float(longitude), float(latitude)
+            hits = []
+            for mb, (lon, lat) in e.value.items():
+                d = _haversine_m(lon_c, lat_c, lon, lat)
+                if radius is not None:
+                    if d > radius * scale:
+                        continue
+                else:
+                    # BYBOX: per-axis great-circle distances from the
+                    # center must fit the half-extents (Redis's box test).
+                    dx = _haversine_m(lon_c, lat_c, lon, lat_c)
+                    dy = _haversine_m(lon_c, lat_c, lon_c, lat)
+                    if dx > width * scale / 2 or dy > height * scale / 2:
+                        continue
+                hits.append((d, mb, lon, lat))
+                if count_any and count is not None and len(hits) >= count:
+                    break  # COUNT n ANY: first n matches, no sort
+        if order is not None or (count is not None and not count_any):
+            # A plain COUNT (no ANY) implies nearest-first, like Redis.
+            hits.sort(key=lambda t: t[0], reverse=(order == "desc"))
+        if count is not None:
+            hits = hits[:count]
+        if not (with_coord or with_dist or with_hash):
+            return [self._dec(mb) for _, mb, _, _ in hits]
+        out = []
+        for d, mb, lon, lat in hits:
+            row = {"member": self._dec(mb)}
+            if with_dist:
+                row["dist"] = d / scale
+            if with_coord:
+                row["coord"] = (lon, lat)
+            if with_hash:
+                row["hash"] = _geohash_int52(lon, lat)
+            out.append(row)
+        return out
+
+    def search_and_store(self, dest_name: str, *, store_dist: bool = False,
+                         unit: str = "m", **kw) -> int:
+        """→ GEOSEARCHSTORE: run :meth:`search` and store the result into
+        the ScoredSortedSet ``dest_name`` — score is the 52-bit geohash
+        cell id (the Redis zset-backed geo encoding), or the distance in
+        ``unit`` with ``store_dist`` (STOREDIST).  Replaces the
+        destination like Redis does; returns the stored count."""
+        from redisson_tpu.grid.collections import ScoredSortedSet
+
+        dest = ScoredSortedSet(dest_name, self._client)
+        # Members must land in the destination under the SAME byte
+        # encoding this geo set uses (the RESP front door runs raw-codec
+        # handles; re-encoding through the client default would store
+        # different bytes than ZRANGE returns).
+        dest._enc = self._enc
+        dest._dec = self._dec
+        with self._store.lock:  # atomic search+replace (RLock re-entry)
+            rows = self.search(
+                unit=unit, with_dist=True, with_coord=True, with_hash=True,
+                **kw
+            )
+            dest.delete()
+            for row in rows:
+                score = row["dist"] if store_dist else float(row["hash"])
+                dest.add(score, row["member"])
+            return len(rows)
 
     def size(self) -> int:
         with self._store.lock:

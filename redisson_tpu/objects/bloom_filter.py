@@ -114,10 +114,40 @@ class BloomFilter(RObject):
         SURVEY.md §3.4).  On the TPU engine the flush is the device-side
         result mailbox: G packed result arrays concatenate on device and
         come home in ONE D2H (each host fetch costs a full link round
-        trip).  Returns one bool array per input batch."""
-        return self._client.collect(
-            [self.contains_all_async(b) for b in batches]
-        )
+        trip).  Returns one bool array per input batch.
+
+        Same-dtype integer ndarray batches additionally coalesce into a
+        SINGLE launch (host concat → one H2D → one scan-chunked kernel →
+        one fetch): membership is read-only, so splitting the result
+        back per batch is exact, and the whole group costs three link
+        transfers however many batches ride it."""
+        import numpy as np
+
+        from redisson_tpu.executor.tpu_executor import defer_host_fetch
+
+        batches = list(batches)
+        if (
+            len(batches) > 1
+            and all(
+                isinstance(b, np.ndarray)
+                and b.ndim == 1
+                and b.dtype.kind in "iu"
+                for b in batches
+            )
+            and len({b.dtype for b in batches}) == 1
+        ):
+            flat = self.contains_all_async(
+                np.concatenate(batches)
+            ).result()
+            out = []
+            off = 0
+            for b in batches:
+                out.append(flat[off : off + len(b)])
+                off += len(b)
+            return out
+        with defer_host_fetch():  # no per-launch D2H: ONE grouped fetch
+            futs = [self.contains_all_async(b) for b in batches]
+        return self._client.collect(futs)
 
     # -- read replication (SURVEY §2.4 replication row) ---------------------
 

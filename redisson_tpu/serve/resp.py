@@ -118,6 +118,43 @@ def _encode_array(items) -> bytes:
     return out
 
 
+def _decode_reply(frame: bytes):
+    """Parse ONE RESP reply frame into a Python value (the redis.call
+    bridge decoding half: scripts see values, not wire bytes).  Error
+    replies raise RespError."""
+    if not frame:
+        return None  # handler wrote its reply itself (push paths)
+    val, _ = _decode_reply_at(frame, 0)
+    return val
+
+
+def _decode_reply_at(buf: bytes, i: int):
+    j = buf.index(b"\r\n", i)
+    t, body = buf[i : i + 1], buf[i + 1 : j]
+    i = j + 2
+    if t == b"+":
+        return body.decode(), i
+    if t == b"-":
+        raise RespError(body.decode())
+    if t == b":":
+        return int(body), i
+    if t == b"$":
+        n = int(body)
+        if n < 0:
+            return None, i
+        return buf[i : i + n], i + n + 2
+    if t in (b"*", b">"):
+        n = int(body)
+        if n < 0:
+            return None, i
+        out = []
+        for _ in range(n):
+            v, i = _decode_reply_at(buf, i)
+            out.append(v)
+        return out, i
+    raise RespError(f"unparseable reply type {t!r}")
+
+
 class _Reader:
     def __init__(self, sock: socket.socket):
         self._sock = sock
@@ -1759,3 +1796,729 @@ class RespServer:
 
     def _cmd_DECR(self, args):
         return _encode_int(self._numeric_incr(args[0], -1, False))
+
+    # streams (→ the reference's RStream command surface over
+    # grid/streams.py; reply shapes follow Redis XADD/XRANGE/XREAD/
+    # XREADGROUP/XACK/XPENDING/XCLAIM/XAUTOCLAIM)
+
+    def _stream(self, key: bytes):
+        from redisson_tpu.grid.streams import Stream
+
+        s = self._raw(Stream(self._s(key), self._client))
+        s._enc_key = s._enc
+        s._dec_key = s._dec
+        return s
+
+    @staticmethod
+    def _stream_entries_reply(entries) -> bytes:
+        """[(id, {field: value})] → RESP [[id, [f1, v1, ...]], ...]."""
+        out = b"*" + str(len(entries)).encode() + b"\r\n"
+        for eid, fields in entries:
+            flat = []
+            for f, v in fields.items():
+                flat.extend([f, v])
+            out += b"*2\r\n" + _encode_bulk(eid) + _encode_array(flat)
+        return out
+
+    def _cmd_XADD(self, args):
+        key = args[0]
+        i = 1
+        nomkstream = False
+        maxlen = None
+        while i < len(args):
+            opt = args[i].decode().upper()
+            if opt == "NOMKSTREAM":
+                nomkstream = True
+                i += 1
+            elif opt == "MAXLEN":
+                i += 1
+                if args[i] in (b"~", b"="):  # approximate trim: exact here
+                    i += 1
+                maxlen = int(args[i])
+                i += 1
+            else:
+                break
+        entry_id = self._s(args[i])
+        i += 1
+        if (len(args) - i) % 2 != 0 or len(args) == i:
+            raise RespError("wrong number of arguments for 'xadd' command")
+        fields = {args[j]: args[j + 1] for j in range(i, len(args), 2)}
+        try:
+            new_id = self._stream(key).add(
+                fields, entry_id, maxlen=maxlen, nomkstream=nomkstream
+            )
+        except ValueError as e:
+            # Distinguish ordering violations from unparseable ids — a
+            # client debugging 'notanid' must not be pointed at ordering.
+            if "greater than" in str(e):
+                raise RespError(
+                    "The ID specified in XADD is equal or smaller than "
+                    "the target stream top item"
+                ) from e
+            raise RespError(
+                "Invalid stream ID specified as stream command argument"
+            ) from e
+        return _encode_bulk(None if new_id is None else new_id)
+
+    def _cmd_XLEN(self, args):
+        return _encode_int(self._stream(args[0]).size())
+
+    def _cmd_XRANGE(self, args):
+        count = None
+        if len(args) >= 5 and args[3].decode().upper() == "COUNT":
+            count = int(args[4])
+        entries = self._stream(args[0]).range(
+            self._s(args[1]), self._s(args[2]), count
+        )
+        return self._stream_entries_reply(entries)
+
+    def _cmd_XREVRANGE(self, args):
+        count = None
+        if len(args) >= 5 and args[3].decode().upper() == "COUNT":
+            count = int(args[4])
+        entries = self._stream(args[0]).rev_range(
+            self._s(args[1]), self._s(args[2]), count
+        )
+        return self._stream_entries_reply(entries)
+
+    def _cmd_XDEL(self, args):
+        return _encode_int(
+            self._stream(args[0]).remove(*[self._s(a) for a in args[1:]])
+        )
+
+    def _cmd_XTRIM(self, args):
+        i = 1
+        if args[i].decode().upper() != "MAXLEN":
+            raise RespError("syntax error")
+        i += 1
+        if args[i] in (b"~", b"="):
+            i += 1
+        return _encode_int(self._stream(args[0]).trim(int(args[i])))
+
+    @staticmethod
+    def _parse_xread_opts(args, want_group: bool):
+        """Shared XREAD/XREADGROUP option walk → (group, consumer,
+        count, block_s, keys, ids)."""
+        group = consumer = None
+        count = block_s = None
+        i = 0
+        if want_group:
+            if args[i].decode().upper() != "GROUP":
+                raise RespError("syntax error")
+            group, consumer = args[i + 1].decode(), args[i + 2].decode()
+            i += 3
+        while i < len(args):
+            opt = args[i].decode().upper()
+            if opt == "COUNT":
+                count = int(args[i + 1])
+                i += 2
+            elif opt == "BLOCK":
+                # BLOCK 0 = wait indefinitely (the Redis contract); the
+                # wait loop still wakes each second, so a closed server
+                # unsticks at shutdown.
+                block_s = int(args[i + 1]) / 1000.0 or float("inf")
+                i += 2
+            elif opt == "NOACK":
+                i += 1  # delivered entries skip the PEL: accepted, minor
+            elif opt == "STREAMS":
+                i += 1
+                break
+            else:
+                raise RespError("syntax error")
+        rest = args[i:]
+        if not rest or len(rest) % 2 != 0:
+            raise RespError(
+                "Unbalanced XREAD list of streams: for each stream key "
+                "an ID or '$' must be specified."
+            )
+        half = len(rest) // 2
+        return group, consumer, count, block_s, rest[:half], rest[half:]
+
+    def _cmdctx_XREAD(self, args, ctx: _ConnCtx):
+        _, _, count, block_s, keys, ids = self._parse_xread_opts(args, False)
+        if ctx.in_exec:
+            block_s = None  # like Redis: no blocking inside MULTI/EXEC
+        out = []
+        for k, start in zip(keys, ids):
+            entries = self._stream(k).read(
+                self._s(start), count,
+                block_seconds=block_s if len(keys) == 1 else None,
+            )
+            if entries:
+                out.append((k, entries))
+        if not out:
+            return b"*-1\r\n"  # nil: nothing new
+        reply = b"*" + str(len(out)).encode() + b"\r\n"
+        for k, entries in out:
+            reply += (
+                b"*2\r\n" + _encode_bulk(k)
+                + self._stream_entries_reply(entries)
+            )
+        return reply
+
+    def _cmdctx_XREADGROUP(self, args, ctx: _ConnCtx):
+        group, consumer, count, block_s, keys, ids = self._parse_xread_opts(
+            args, True
+        )
+        if ctx.in_exec:
+            block_s = None
+        out = []
+        for k, start in zip(keys, ids):
+            try:
+                entries = self._stream(k).read_group(
+                    group, consumer, count, self._s(start),
+                    block_seconds=block_s if len(keys) == 1 else None,
+                )
+            except ValueError as e:
+                if "NOGROUP" not in str(e):
+                    # e.g. an unparseable start id — not a missing group
+                    raise RespError(
+                        "Invalid stream ID specified as stream command "
+                        "argument"
+                    ) from e
+                raise RespError(
+                    f"NOGROUP No such consumer group '{group}' for key "
+                    f"name '{self._s(k)}'"
+                ) from e
+            out.append((k, entries))
+        if not any(entries for _, entries in out):
+            return b"*-1\r\n"
+        reply = b"*" + str(len(out)).encode() + b"\r\n"
+        for k, entries in out:
+            reply += (
+                b"*2\r\n" + _encode_bulk(k)
+                + self._stream_entries_reply(entries)
+            )
+        return reply
+
+    def _cmd_XGROUP(self, args):
+        sub = args[0].decode().upper()
+        if sub == "CREATE":
+            key, group, from_id = args[1], args[2].decode(), self._s(args[3])
+            mkstream = any(
+                a.decode().upper() == "MKSTREAM" for a in args[4:]
+            )
+            try:
+                self._stream(key).create_group(
+                    group, from_id, mkstream=mkstream
+                )
+            except ValueError as e:
+                raise RespError(
+                    "BUSYGROUP Consumer Group name already exists"
+                ) from e
+            except RuntimeError as e:
+                raise RespError(
+                    "The XGROUP subcommand requires the key to exist. Note "
+                    "that for CREATE you may want to use the MKSTREAM "
+                    "option to create an empty stream automatically."
+                ) from e
+            return _encode_simple("OK")
+        if sub == "DESTROY":
+            return _encode_int(
+                int(self._stream(args[1]).remove_group(args[2].decode()))
+            )
+        raise RespError(f"Unknown XGROUP subcommand {sub}")
+
+    def _cmd_XACK(self, args):
+        try:
+            return _encode_int(
+                self._stream(args[0]).ack(
+                    args[1].decode(), *[self._s(a) for a in args[2:]]
+                )
+            )
+        except ValueError:
+            return _encode_int(0)  # Redis: XACK on a missing group is 0
+
+    def _cmd_XPENDING(self, args):
+        s = self._stream(args[0])
+        group = args[1].decode()
+        try:
+            if len(args) == 2:  # summary form
+                p = s.pending(group)
+                consumers = [
+                    [c.encode(), str(n).encode()]
+                    for c, n in p["consumers"].items()
+                ]
+                out = (
+                    b"*4\r\n" + _encode_int(p["total"])
+                    + _encode_bulk(p["lowest_id"])
+                    + _encode_bulk(p["highest_id"])
+                )
+                if consumers:
+                    out += b"*" + str(len(consumers)).encode() + b"\r\n"
+                    for pair in consumers:
+                        out += _encode_array(pair)
+                else:
+                    out += b"*-1\r\n"
+                return out
+            # range form: [IDLE ms] start end count [consumer]
+            i = 2
+            if args[i].decode().upper() == "IDLE":
+                i += 2  # minimum idle filter: accepted, applied as 0
+            start, end, count = self._s(args[i]), self._s(args[i + 1]), int(args[i + 2])
+            consumer = args[i + 3].decode() if len(args) > i + 3 else None
+            rows = s.pending_range(group, start, end, count, consumer)
+        except ValueError as e:
+            raise RespError(
+                f"NOGROUP No such consumer group '{group}' for key name "
+                f"'{self._s(args[0])}'"
+            ) from e
+        out = b"*" + str(len(rows)).encode() + b"\r\n"
+        for r in rows:
+            out += (
+                b"*4\r\n" + _encode_bulk(r["id"])
+                + _encode_bulk(r["consumer"].encode())
+                + _encode_int(int(r["idle_ms"]))
+                + _encode_int(r["delivered"])
+            )
+        return out
+
+    def _cmd_XCLAIM(self, args):
+        s = self._stream(args[0])
+        claimed = s.claim(
+            args[1].decode(), args[2].decode(), int(args[3]),
+            *[self._s(a) for a in args[4:]],
+        )
+        return self._stream_entries_reply(claimed)
+
+    def _cmd_XAUTOCLAIM(self, args):
+        s = self._stream(args[0])
+        count = 100
+        justid = False
+        i = 5
+        while i < len(args):
+            opt = args[i].decode().upper()
+            if opt == "COUNT":
+                count = int(args[i + 1])
+                i += 2
+            elif opt == "JUSTID":
+                justid = True
+                i += 1
+            else:
+                raise RespError("syntax error")
+        claimed = s.auto_claim(
+            args[1].decode(), args[2].decode(), int(args[3]),
+            self._s(args[4]), count,
+        )
+        # 7.0 reply: [next-cursor, entries, deleted-ids] — the scan is
+        # exhaustive here, so the next cursor is always the terminal 0-0.
+        body = (
+            _encode_array([eid for eid, _ in claimed])
+            if justid  # bare ids, per the JUSTID contract
+            else self._stream_entries_reply(claimed)
+        )
+        return b"*3\r\n" + _encode_bulk(b"0-0") + body + b"*0\r\n"
+
+    def _cmd_XINFO(self, args):
+        sub = args[0].decode().upper()
+        s = self._stream(args[1])
+        if sub == "STREAM":
+            flat = [
+                b"length", s.size(),
+                b"last-generated-id", s.last_id().encode(),
+                b"groups", len(s.list_groups()),
+            ]
+            return _encode_array(flat)
+        if sub == "GROUPS":
+            groups = s.list_groups()
+            out = b"*" + str(len(groups)).encode() + b"\r\n"
+            for g in groups:
+                out += _encode_array([
+                    b"name", g["name"].encode(),
+                    b"consumers", g["consumers"],
+                    b"pending", g["pending"],
+                    b"last-delivered-id", g["last_delivered_id"].encode(),
+                ])
+            return out
+        if sub == "CONSUMERS":
+            rows = s.list_consumers(args[2].decode())
+            out = b"*" + str(len(rows)).encode() + b"\r\n"
+            for r in rows:
+                out += _encode_array([
+                    b"name", r["name"].encode(), b"pending", r["pending"],
+                ])
+            return out
+        raise RespError(f"Unknown XINFO subcommand {sub}")
+
+    # geo (→ RGeo over grid/geo.py; GEOSEARCH option grammar follows
+    # Redis 6.2)
+
+    def _geo(self, key: bytes):
+        from redisson_tpu.grid.geo import Geo
+
+        return self._raw(Geo(self._s(key), self._client))
+
+    def _cmd_GEOADD(self, args):
+        entries = [
+            (float(args[i]), float(args[i + 1]), args[i + 2])
+            for i in range(1, len(args), 3)
+        ]
+        try:
+            return _encode_int(self._geo(args[0]).add_entries(*entries))
+        except ValueError as e:
+            raise RespError(f"invalid longitude,latitude pair ({e})") from e
+
+    def _cmd_GEOPOS(self, args):
+        pos = self._geo(args[0]).pos(*args[1:])
+        out = b"*" + str(len(args) - 1).encode() + b"\r\n"
+        for m in args[1:]:
+            p = pos.get(m)
+            if p is None:
+                out += b"*-1\r\n"
+            else:
+                out += _encode_array([
+                    f"{p[0]:.17g}".encode(), f"{p[1]:.17g}".encode(),
+                ])
+        return out
+
+    def _cmd_GEODIST(self, args):
+        unit = args[3].decode().lower() if len(args) > 3 else "m"
+        d = self._geo(args[0]).dist(args[1], args[2], unit)
+        return _encode_bulk(None if d is None else f"{d:.4f}".encode())
+
+    def _cmd_GEOHASH(self, args):
+        hashes = self._geo(args[0]).hash(*args[1:])
+        return _encode_array([
+            hashes.get(m, "").encode() or None for m in args[1:]
+        ])
+
+    @staticmethod
+    def _parse_geosearch(args, i, allow_storedist: bool = False):
+        """GEOSEARCH option walk from index ``i`` → (search kwargs,
+        with-flags).  Option words are only recognized at option
+        POSITIONS — operand slots (the FROMMEMBER member) are consumed
+        raw, so a member whose bytes spell an option name stays a
+        member."""
+        kw = {}
+        with_coord = with_dist = with_hash = False
+        n = len(args)
+        while i < n:
+            try:
+                opt = args[i].decode().upper()
+            except UnicodeDecodeError:
+                raise RespError("syntax error")  # binary junk in options
+            if opt == "FROMMEMBER":
+                kw["member"] = args[i + 1]
+                i += 2
+            elif opt == "FROMLONLAT":
+                kw["longitude"] = float(args[i + 1])
+                kw["latitude"] = float(args[i + 2])
+                i += 3
+            elif opt == "BYRADIUS":
+                kw["radius"] = float(args[i + 1])
+                kw["unit"] = args[i + 2].decode().lower()
+                i += 3
+            elif opt == "BYBOX":
+                kw["width"] = float(args[i + 1])
+                kw["height"] = float(args[i + 2])
+                kw["unit"] = args[i + 3].decode().lower()
+                i += 4
+            elif opt in ("ASC", "DESC"):
+                kw["order"] = opt.lower()
+                i += 1
+            elif opt == "COUNT":
+                kw["count"] = int(args[i + 1])
+                i += 2
+                if i < n and args[i].decode().upper() == "ANY":
+                    kw["count_any"] = True
+                    i += 1
+            elif opt == "WITHCOORD":
+                with_coord = True
+                i += 1
+            elif opt == "WITHDIST":
+                with_dist = True
+                i += 1
+            elif opt == "WITHHASH":
+                with_hash = True
+                i += 1
+            elif allow_storedist and opt == "STOREDIST":
+                kw["storedist"] = True
+                i += 1
+            else:
+                raise RespError("syntax error")
+        return kw, with_coord, with_dist, with_hash
+
+    def _cmd_GEOSEARCH(self, args):
+        kw, wc, wd, wh = self._parse_geosearch(args, 1)
+        try:
+            rows = self._geo(args[0]).search(
+                with_coord=wc, with_dist=wd, with_hash=wh, **kw
+            )
+        except ValueError as e:
+            raise RespError(str(e)) from e
+        if not (wc or wd or wh):
+            return _encode_array(rows)
+        out = b"*" + str(len(rows)).encode() + b"\r\n"
+        for r in rows:
+            parts = [_encode_bulk(r["member"])]
+            if wd:
+                parts.append(_encode_bulk(f"{r['dist']:.4f}".encode()))
+            if wh:
+                parts.append(_encode_int(r["hash"]))
+            if wc:
+                parts.append(_encode_array([
+                    f"{r['coord'][0]:.17g}".encode(),
+                    f"{r['coord'][1]:.17g}".encode(),
+                ]))
+            out += b"*" + str(len(parts)).encode() + b"\r\n" + b"".join(parts)
+        return out
+
+    def _cmd_GEOSEARCHSTORE(self, args):
+        dest, src = self._s(args[0]), args[1]
+        # STOREDIST parses POSITIONALLY inside the option walk (a member
+        # named 'storedist' must stay a member, not become the flag).
+        kw, _, _, _ = self._parse_geosearch(args, 2, allow_storedist=True)
+        store_dist = kw.pop("storedist", False)
+        unit = kw.pop("unit", "m")
+        try:
+            n = self._geo(src).search_and_store(
+                dest, store_dist=store_dist, unit=unit, **kw
+            )
+        except ValueError as e:
+            raise RespError(str(e)) from e
+        return _encode_int(n)
+
+    # scripting (→ RScript/RFunction over grid/services.py).  Script
+    # bodies are PYTHON source — there is deliberately no Lua VM
+    # (ScriptService's design note): scripts see KEYS (str list), ARGV
+    # (bytes list) and ``redis.call(...)``, which dispatches through this
+    # server's own command table and decodes the reply.  A script runs
+    # under the grid lock — the Lua-script atomicity contract.
+
+    class _ScriptCtx:
+        """Connection-independent ctx for redis.call dispatch: scripts
+        cannot touch connection state (no MULTI, no pub/sub pushes —
+        the Lua rules), and blocking commands run non-blocking."""
+
+        in_multi = False
+        in_exec = True
+        proto = 2
+        client_name = None
+
+        def __init__(self):
+            self.subs = {}
+
+    def _run_script(self, source: str, keys: list, argv: list):
+        server = self
+        sctx = self._ScriptCtx()
+
+        class _Bridge:
+            @staticmethod
+            def call(*parts):
+                cmd = [
+                    p if isinstance(p, bytes) else str(p).encode()
+                    for p in parts
+                ]
+                return _decode_reply(server._dispatch(cmd, sctx))
+
+            # redis.pcall: errors come back as values, not raises
+            @staticmethod
+            def pcall(*parts):
+                try:
+                    return _Bridge.call(*parts)
+                except Exception as e:
+                    return e
+
+        ns = {"KEYS": list(keys), "ARGV": list(argv), "redis": _Bridge}
+        with self._client._grid.lock:  # Lua atomicity contract
+            try:
+                code = compile(source, "<eval>", "eval")
+            except SyntaxError:
+                code = compile(source, "<eval>", "exec")
+                exec(code, ns)
+                out = ns.get("result")
+            else:
+                out = eval(code, ns)
+            self._client._grid.cond.notify_all()
+        return out
+
+    @staticmethod
+    def _script_reply(v) -> bytes:
+        """Python script result → RESP (the Lua conversion table shape:
+        int → integer, str/bytes → bulk, list → array, None → nil,
+        True → 1, False → nil; floats travel as bulk strings — a
+        documented deviation from Lua's truncation)."""
+        if v is None or v is False:
+            return _encode_bulk(None)
+        if v is True:
+            return _encode_int(1)
+        if isinstance(v, int):
+            return _encode_int(v)
+        if isinstance(v, float):
+            return _encode_bulk(_fmt_score(v).encode())
+        if isinstance(v, (bytes, str)):
+            return _encode_bulk(v if isinstance(v, bytes) else v.encode())
+        if isinstance(v, (list, tuple)):
+            return b"*" + str(len(v)).encode() + b"\r\n" + b"".join(
+                RespServer._script_reply(x) for x in v
+            )
+        if isinstance(v, dict):
+            flat = []
+            for k2, v2 in v.items():
+                flat.extend([k2, v2])
+            return RespServer._script_reply(flat)
+        if isinstance(v, Exception):
+            return _encode_error(str(v))
+        return _encode_bulk(str(v).encode())
+
+    def _eval_common(self, source: str, args):
+        numkeys = int(args[0])
+        keys = [self._s(a) for a in args[1 : 1 + numkeys]]
+        argv = list(args[1 + numkeys :])
+        return self._script_reply(self._run_script(source, keys, argv))
+
+    def _cmd_EVAL(self, args):
+        return self._eval_common(args[0].decode(), args[1:])
+
+    def _cmd_EVALSHA(self, args):
+        sha = args[0].decode().lower()
+        svc = self._client.get_script()
+        src = getattr(svc, "_sources", {}).get(sha)
+        if src is None:
+            raise RespError(
+                "NOSCRIPT No matching script. Please use EVAL."
+            )
+        return self._eval_common(src, args[1:])
+
+    def _cmd_SCRIPT(self, args):
+        import hashlib
+
+        sub = args[0].decode().upper()
+        svc = self._client.get_script()
+        if not hasattr(svc, "_sources"):
+            svc._sources = {}
+        if sub == "LOAD":
+            source = args[1].decode()
+            sha = hashlib.sha1(args[1]).hexdigest()
+            svc._sources[sha] = source
+            # Mapped onto ScriptService: Python API callers can invoke
+            # the same script via script_service.eval(sha, keys, args).
+            svc.register(
+                sha,
+                lambda client, keys, a, _src=source: self._run_script(
+                    _src, keys, a
+                ),
+            )
+            return _encode_bulk(sha.encode())
+        if sub == "EXISTS":
+            return _encode_array([
+                int(a.decode().lower() in svc._sources) for a in args[1:]
+            ])
+        if sub == "FLUSH":
+            svc._sources.clear()
+            return _encode_simple("OK")
+        raise RespError(f"Unknown SCRIPT subcommand {sub}")
+
+    def _cmd_FUNCTION(self, args):
+        sub = args[0].decode().upper()
+        svc = self._client.get_function()
+        if sub == "LOAD":
+            i = 1
+            replace = False
+            if args[i].decode().upper() == "REPLACE":
+                replace = True
+                i += 1
+            source = args[i].decode()
+            first, _, body = source.partition("\n")
+            if not first.startswith("#!python"):
+                raise RespError(
+                    "Missing library metadata: the engine runs PYTHON "
+                    "libraries — start with '#!python name=<library>' "
+                    "(there is deliberately no Lua VM)"
+                )
+            lib = None
+            for tok in first.split():
+                if tok.startswith("name="):
+                    lib = tok[5:]
+            if not lib:
+                raise RespError("Missing library name")
+            collected: dict = {}
+            ro_names: list = []
+
+            def register_function(name, fn, flags=()):
+                collected[name] = (
+                    lambda client, keys, a, _fn=fn: _fn(keys, a)
+                )
+                if "no-writes" in flags:
+                    ro_names.append(name)
+
+            server = self
+
+            class _Bridge:
+                @staticmethod
+                def call(*parts):
+                    cmd = [
+                        p if isinstance(p, bytes) else str(p).encode()
+                        for p in parts
+                    ]
+                    return _decode_reply(
+                        server._dispatch(cmd, server._ScriptCtx())
+                    )
+
+            ns = {"register_function": register_function, "redis": _Bridge}
+            exec(compile(body, f"<function:{lib}>", "exec"), ns)
+            if not collected:
+                raise RespError(
+                    "No functions registered: call "
+                    "register_function(name, fn) in the library body"
+                )
+            try:
+                svc.load(
+                    lib, collected, replace=replace, no_writes=tuple(ro_names)
+                )
+            except ValueError as e:
+                raise RespError(str(e)) from e
+            return _encode_bulk(lib.encode())
+        if sub == "DELETE":
+            try:
+                svc.delete(args[1].decode())
+            except KeyError as e:
+                raise RespError(str(e)) from e
+            return _encode_simple("OK")
+        if sub == "FLUSH":
+            svc.flush()
+            return _encode_simple("OK")
+        if sub == "LIST":
+            pat = None
+            if len(args) >= 3 and args[1].decode().upper() == "LIBRARYNAME":
+                pat = args[2].decode()
+            libs = svc.list(pat)
+            out = b"*" + str(len(libs)).encode() + b"\r\n"
+            for lib in libs:
+                out += (
+                    b"*6\r\n"
+                    + _encode_bulk(b"library_name")
+                    + _encode_bulk(lib["library_name"].encode())
+                    + _encode_bulk(b"engine")
+                    + _encode_bulk(b"PYTHON")
+                    + _encode_bulk(b"functions")
+                    + _encode_array(
+                        [f["name"].encode() for f in lib["functions"]]
+                    )
+                )
+            return out
+        raise RespError(f"Unknown FUNCTION subcommand {sub}")
+
+    def _fcall(self, args, readonly: bool):
+        svc = self._client.get_function()
+        name = args[0].decode()
+        numkeys = int(args[1])
+        keys = [self._s(a) for a in args[2 : 2 + numkeys]]
+        argv = list(args[2 + numkeys :])
+        try:
+            out = (
+                svc.call_ro(name, keys, argv)
+                if readonly
+                else svc.call(name, keys, argv)
+            )
+        except KeyError as e:
+            raise RespError(f"Function not found ({e})") from e
+        except ValueError as e:
+            raise RespError(str(e)) from e
+        return self._script_reply(out)
+
+    def _cmd_FCALL(self, args):
+        return self._fcall(args, False)
+
+    def _cmd_FCALL_RO(self, args):
+        return self._fcall(args, True)
