@@ -45,6 +45,11 @@ def main(argv=None) -> int:
         "--platform", default=None,
         help="jax platform override (e.g. cpu for a host-only server)",
     )
+    p.add_argument(
+        "--requirepass", default=None,
+        help="require AUTH before any command (also settable via the "
+        "config file's requirepass key)",
+    )
     args = p.parse_args(argv)
 
     import redisson_tpu
@@ -70,6 +75,9 @@ def main(argv=None) -> int:
             p.error("--snapshot-interval-s requires a snapshot dir "
                     "(--snapshot-dir or config file)")
         cfg.snapshot_interval_s = args.snapshot_interval_s
+
+    if args.requirepass:
+        cfg.requirepass = args.requirepass
 
     client = redisson_tpu.create(cfg)
     server = RespServer(

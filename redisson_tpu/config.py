@@ -110,6 +110,11 @@ class Config:
         # Snapshot/restore (checkpoint row, SURVEY.md §5).
         self.snapshot_dir: Optional[str] = None
         self.snapshot_interval_s: float = 0.0  # 0 → no periodic snapshots
+        # Front-door auth (→ the reference server configs' `password`
+        # key, org/redisson/config/BaseConfig#setPassword): when set,
+        # every RESP connection must AUTH (or HELLO ... AUTH) before any
+        # other command.  None = open, the redis-server default.
+        self.requirepass: Optional[str] = None
 
     # -- fluent setters, mirroring the Java builder idiom ------------------
 
@@ -119,6 +124,12 @@ class Config:
 
     def set_threads(self, n: int) -> "Config":
         self.threads = n
+        return self
+
+    def set_requirepass(self, password: Optional[str]) -> "Config":
+        """→ BaseConfig#setPassword: require AUTH on the RESP front
+        door."""
+        self.requirepass = password
         return self
 
     def use_tpu_sketch(self, **kwargs) -> "Config":
@@ -138,6 +149,7 @@ class Config:
         "timeout_ms",
         "snapshot_dir",
         "snapshot_interval_s",
+        "requirepass",
     )
 
     def to_dict(self) -> dict:

@@ -113,13 +113,15 @@ class GridObject(CamelCompatMixin):
     def __getattr__(self, item):
         # RFuture idiom parity (→ every reference object's *Async twin):
         # ``fooAsync``/``foo_async`` works for EVERY grid method, running
-        # off the caller thread on a dedicated thread per call.  Per-call
-        # threads (not a bounded pool) because grid ops may legitimately
-        # BLOCK (queue take/poll, lock waits) — a shared bounded pool
-        # deadlocks once blocked ops occupy every worker and the op that
-        # would unblock them queues behind.  Like the reference's async
-        # facade, ordering across independent async calls is not
-        # guaranteed; Batch provides the ordered pipeline.
+        # off the caller thread.  Methods whose NAME can block (queue
+        # take/poll, lock waits — _may_block) get a dedicated thread per
+        # call, because on a shared bounded pool blocked ops occupy every
+        # worker and the op that would unblock them queues behind
+        # (deadlock); everything else runs on ONE bounded shared pool so
+        # thousands of concurrent async gets cost pool-width threads,
+        # not one thread each.  Like the reference's async facade,
+        # ordering across independent async calls is not guaranteed;
+        # Batch provides the ordered pipeline.
         if item.endswith("_async") and not item.startswith("_"):
             sync = getattr(self, item[: -len("_async")], None)
             if callable(sync):
@@ -131,12 +133,59 @@ class GridObject(CamelCompatMixin):
         return super().__getattr__(item)
 
 
+# Method-name tokens that can legitimately BLOCK (waiting on another
+# grid op to unblock them): these MUST run on dedicated threads — on a
+# shared bounded pool they occupy every worker and the op that would
+# release them queues behind (classic pool deadlock).  False positives
+# (a non-blocking 'put') merely cost one extra thread; a false NEGATIVE
+# deadlocks, so the list errs broad.
+_BLOCKING_TOKENS = (
+    "take", "poll", "lock", "acquire", "wait", "await", "transfer",
+    "offer", "put", "pop", "read", "drain", "subscribe", "listen",
+    "publish", "invoke", "remove",
+)
+
+
+def _may_block(name: str) -> bool:
+    n = name.lower()
+    return any(t in n for t in _BLOCKING_TOKENS)
+
+
+import threading as _threading
+
+_shared_pool = None
+# Module-scope lock: creating it lazily raced — two first callers could
+# each install a different lock and build two executors.
+_shared_pool_lock = _threading.Lock()
+
+
+def _get_shared_pool():
+    """ONE bounded pool per process for non-blocking async twins (the
+    reference's shared executor role)."""
+    global _shared_pool
+    with _shared_pool_lock:
+        if _shared_pool is None:
+            import concurrent.futures
+            import os
+
+            _shared_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(32, (os.cpu_count() or 4) + 4),
+                thread_name_prefix="rtpu-async-pool",
+            )
+        return _shared_pool
+
+
 def _spawn_future(fn, args, kwargs):
-    """Run ``fn`` on its own daemon thread; returns a concurrent-style
-    future (result/get/done).  Unbounded by construction — blocking grid
-    ops cannot starve each other."""
+    """Run ``fn`` off-thread; returns a concurrent-style future
+    (result/get/done).  Possibly-blocking methods (by name — see
+    _may_block) get a dedicated daemon thread so they can never starve
+    each other; everything else shares one bounded pool, so 5k
+    concurrent async map gets cost pool-width threads, not 5k."""
     import concurrent.futures
     import threading
+
+    if not _may_block(getattr(fn, "__name__", "")):
+        return _PoolFuture(_get_shared_pool().submit(fn, *args, **kwargs))
 
     fut: "concurrent.futures.Future" = concurrent.futures.Future()
 

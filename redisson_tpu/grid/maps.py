@@ -19,12 +19,22 @@ _MISSING = object()
 
 class _MapValue:
     """dict: key bytes -> (value bytes, expire_at|None, max_idle_s|None,
-    last_access)."""
+    last_access).  ``on_expire`` (not persisted — see __getstate__) is
+    an optional callback fired when lazy expiry reaps a slot, so cache
+    layers can surface JSR-107 Expired events."""
 
-    __slots__ = ("data",)
+    __slots__ = ("data", "on_expire")
 
     def __init__(self):
         self.data: dict[bytes, list] = {}
+        self.on_expire = None
+
+    def __getstate__(self):
+        return self.data  # callbacks are process-local, never persisted
+
+    def __setstate__(self, data):
+        self.data = data
+        self.on_expire = None
 
     def live(self, kb: bytes, now: Optional[float] = None, touch: bool = False):
         """Liveness check with lazy expiry.  ``touch`` refreshes the
@@ -38,9 +48,13 @@ class _MapValue:
         vb, exp, idle, last = slot
         if exp is not None and now >= exp:
             del self.data[kb]
+            if self.on_expire is not None:
+                self.on_expire(kb, vb)
             return None
         if idle is not None and now - last >= idle:
             del self.data[kb]
+            if self.on_expire is not None:
+                self.on_expire(kb, vb)
             return None
         if touch:
             slot[3] = now

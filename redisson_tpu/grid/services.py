@@ -409,6 +409,9 @@ class Transaction(CamelCompatMixin):
         # 'entry exists, member absent' are the same observation (False),
         # unlike bucket/map reads where None is a distinct value.
         self._set_reads: dict[tuple, bool] = {}
+        # Zset score reads validate by VALUE (score-or-None), distinct
+        # from set membership booleans.
+        self._score_reads: dict[tuple, Any] = {}
         self._writes: list[tuple] = []  # (apply_fn,)
         self._done = False
 
@@ -423,6 +426,14 @@ class Transaction(CamelCompatMixin):
     def get_set(self, name: str):
         """→ RTransaction#getSet (upstream transactions cover sets too)."""
         return _TxSet(self, name)
+
+    def get_list(self, name: str):
+        """→ RTransaction-scoped list (upstream transactional breadth)."""
+        return _TxList(self, name)
+
+    def get_scored_sorted_set(self, name: str):
+        """→ RedissonTransactionalSet's scored sibling."""
+        return _TxScoredSortedSet(self, name)
 
     # -- commit/rollback -----------------------------------------------------
 
@@ -442,6 +453,11 @@ class Transaction(CamelCompatMixin):
                     )
             for (name, kb), member in self._set_reads.items():
                 if bool(self._current(name, kb)) != member:
+                    raise TransactionException(
+                        f"read of {name!r} invalidated by a concurrent write"
+                    )
+            for (name, kb), score in self._score_reads.items():
+                if self._current_score(name, kb) != score:
                     raise TransactionException(
                         f"read of {name!r} invalidated by a concurrent write"
                     )
@@ -478,6 +494,11 @@ class Transaction(CamelCompatMixin):
         if e is None:
             return None
         if kb is None:
+            if isinstance(e.value, list):
+                # Whole-list reads snapshot CONTENTS (a tuple copy) —
+                # the live list object always equals itself, which would
+                # make validation vacuous.
+                return tuple(e.value)
             return e.value
         if hasattr(e.value, "live"):  # map: per-key live slot
             slot = e.value.live(kb)
@@ -485,6 +506,14 @@ class Transaction(CamelCompatMixin):
         if isinstance(e.value, dict):  # set: membership snapshot
             return kb in e.value
         return None
+
+    def _current_score(self, name: str, kb: bytes):
+        """Zset score-or-None (distinct from set membership: a set's
+        dict values are all None, so .get() cannot express membership)."""
+        e = self._store.get_entry(name)
+        if e is None or not isinstance(e.value, dict):
+            return None
+        return e.value.get(kb)
 
 
 class _TxBucket:
@@ -616,6 +645,138 @@ class _TxSet:
 
         tx._writes.append((name, "set", apply))
         return removed
+
+
+class _TxList:
+    """Transactional list facade (→ org/redisson/transaction breadth):
+    reads snapshot the WHOLE list contents for commit-time validation
+    (list positions shift under concurrent writes, so per-index
+    validation would be unsound).  Staged ops replay over the snapshot
+    for reads (read-your-writes AND read-your-removes) and over the live
+    list at commit — ONE apply closure registered on first mutation."""
+
+    def __init__(self, tx: Transaction, name: str):
+        self._tx = tx
+        self._name = name
+        self._codec = tx._client.config.codec
+        self._ops: list[tuple] = []  # ("add"|"remove", value_bytes)
+        self._registered = False
+
+    def _snapshot(self) -> tuple:
+        with self._tx._store.lock:
+            cur = self._tx._current(self._name, None)
+            cur = cur if isinstance(cur, tuple) else ()
+            self._tx._reads[(self._name, None)] = cur
+            return cur
+
+    def _view(self) -> list:
+        """Snapshot with this tx's staged ops replayed — what reads see."""
+        out = list(self._snapshot())
+        for op, vb in self._ops:
+            if op == "add":
+                out.append(vb)
+            elif vb in out:
+                out.remove(vb)
+        return out
+
+    def _ensure_apply(self) -> None:
+        if self._registered:
+            return
+        self._registered = True
+        tx, name, ops = self._tx, self._name, self._ops
+
+        def apply():
+            e = tx._store.ensure_entry(name, "list", list)
+            for op, vb in ops:
+                if op == "add":
+                    e.value.append(vb)
+                elif vb in e.value:
+                    e.value.remove(vb)
+
+        tx._writes.append((name, "list", apply))
+
+    def read_all(self) -> list:
+        self._tx._check_open()
+        return [self._codec.decode(vb) for vb in self._view()]
+
+    def size(self) -> int:
+        self._tx._check_open()
+        return len(self._view())
+
+    def get(self, index: int):
+        self._tx._check_open()
+        return self.read_all()[index]
+
+    def contains(self, value) -> bool:
+        self._tx._check_open()
+        return self._codec.encode(value) in self._view()
+
+    def add(self, value) -> bool:
+        self._tx._check_open()
+        self._ops.append(("add", self._codec.encode(value)))
+        self._ensure_apply()
+        return True
+
+    def remove(self, value) -> bool:
+        self._tx._check_open()
+        vb = self._codec.encode(value)
+        present = vb in self._view()
+        if present:
+            self._ops.append(("remove", vb))
+            self._ensure_apply()
+        return present
+
+
+class _TxScoredSortedSet:
+    """Transactional scored-sorted-set facade: score reads validate by
+    value at commit (see Transaction._score_reads); add/remove buffer."""
+
+    def __init__(self, tx: Transaction, name: str):
+        self._tx = tx
+        self._name = name
+        self._codec = tx._client.config.codec
+        self._local: dict[bytes, Any] = {}  # staged member -> score|None
+
+    def get_score(self, member):
+        self._tx._check_open()
+        kb = self._codec.encode(member)
+        if kb in self._local:
+            return self._local[kb]
+        with self._tx._store.lock:
+            cur = self._tx._current_score(self._name, kb)
+            self._tx._score_reads[(self._name, kb)] = cur
+            return cur
+
+    def contains(self, member) -> bool:
+        return self.get_score(member) is not None
+
+    def add(self, score: float, member) -> bool:
+        fresh = not self.contains(member)
+        kb = self._codec.encode(member)
+        self._local[kb] = float(score)
+        tx, name = self._tx, self._name
+        sc = float(score)
+
+        def apply():
+            e = tx._store.ensure_entry(name, "zset", dict)
+            e.value[kb] = sc
+
+        tx._writes.append((name, "zset", apply))
+        return fresh
+
+    def remove(self, member) -> bool:
+        present = self.contains(member)
+        kb = self._codec.encode(member)
+        self._local[kb] = None
+        tx, name = self._tx, self._name
+
+        def apply():
+            e = tx._store.get_entry(name, "zset")
+            if e is not None:
+                e.value.pop(kb, None)
+
+        tx._writes.append((name, "zset", apply))
+        return present
 
 
 class ScriptService(CamelCompatMixin):
@@ -759,7 +920,14 @@ class FunctionService(CamelCompatMixin):
 class LiveObjectService(CamelCompatMixin):
     """→ RLiveObjectService: instances whose attributes live in an RMap
     named ``{class}:{id}`` — every attribute read/write is a map op, so
-    state is shared across handles (the @REntity/@RId proxy pattern)."""
+    state is shared across handles (the @REntity/@RId proxy pattern).
+
+    Index/search (→ org/redisson/liveobject/ @RIndex machinery): fields
+    named in ``persist(..., index=(...))`` maintain per-(class, field,
+    value) index sets, so ``find_by_field`` resolves indexed queries as
+    one set read; non-indexed fields fall back to scanning the class's
+    id registry (upstream requires the annotation; the scan fallback is
+    a convenience)."""
 
     def __init__(self, client):
         self._client = client
@@ -767,37 +935,95 @@ class LiveObjectService(CamelCompatMixin):
     def _map_for(self, cls_name: str, rid) -> Any:
         return self._client.get_map(f"live:{cls_name}:{rid}")
 
-    def persist(self, obj: Any, rid=None) -> "LiveProxy":
-        """Store a plain object's __dict__ and return its live proxy."""
+    def _registry(self, cls_name: str):
+        return self._client.get_set(f"live:{cls_name}:__ids__")
+
+    def _indexed_fields(self, cls_name: str):
+        return self._client.get_set(f"live:{cls_name}:__indexed__")
+
+    def _index_set(self, cls_name: str, field: str, value):
+        return self._client.get_set(
+            f"live-idx:{cls_name}:{field}:{value!r}"
+        )
+
+    def persist(self, obj: Any, rid=None, index: tuple = ()) -> "LiveProxy":
+        """Store a plain object's __dict__ and return its live proxy.
+        ``index`` names fields to index (the @RIndex analog); indexed
+        fields stay maintained through proxy writes."""
         cls_name = type(obj).__name__
         rid = rid if rid is not None else getattr(obj, "id", None)
         if rid is None:
             raise ValueError("live object needs an 'id' attribute or rid=")
         m = self._map_for(cls_name, rid)
+        indexed = self._indexed_fields(cls_name)
+        for f in index:
+            indexed.add(f)
+        idx_fields = set(indexed.read_all())
         for k, v in vars(obj).items():
             m.fast_put(k, v)
-        return LiveProxy(self._client, cls_name, rid)
+            if k in idx_fields:
+                self._index_set(cls_name, k, v).add(rid)
+        self._registry(cls_name).add(rid)
+        return LiveProxy(self._client, cls_name, rid, self)
 
     def get(self, cls_or_name, rid) -> "LiveProxy":
         name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
-        return LiveProxy(self._client, name, rid)
+        return LiveProxy(self._client, name, rid, self)
 
     def delete(self, cls_or_name, rid) -> bool:
         name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
-        return self._map_for(name, rid).delete()
+        m = self._map_for(name, rid)
+        # Drop this instance from every index it occupies.
+        idx_fields = set(self._indexed_fields(name).read_all())
+        for f in idx_fields:
+            v = m.get(f)
+            if v is not None:
+                self._index_set(name, f, v).remove(rid)
+        self._registry(name).remove(rid)
+        return m.delete()
 
     def exists(self, cls_or_name, rid) -> bool:
         name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
         return self._map_for(name, rid).is_exists()
 
+    # -- find/search (→ RLiveObjectService#find + Conditions.eq) -----------
+
+    def find_by_field(self, cls_or_name, field: str, value) -> list:
+        """All live proxies of the class whose ``field`` equals
+        ``value`` — one index-set read when the field is indexed, a
+        registry scan otherwise."""
+        name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
+        if field in set(self._indexed_fields(name).read_all()):
+            rids = self._index_set(name, field, value).read_all()
+        else:
+            rids = [
+                rid for rid in self._registry(name).read_all()
+                if self._map_for(name, rid).get(field) == value
+            ]
+        return [LiveProxy(self._client, name, rid, self) for rid in rids]
+
+    find = find_by_field  # upstream-shaped alias (Conditions.eq analog)
+
+    def count(self, cls_or_name) -> int:
+        name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
+        return self._registry(name).size()
+
+    def list_ids(self, cls_or_name) -> list:
+        name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
+        return self._registry(name).read_all()
+
 
 class LiveProxy:
-    """Attribute-mapped live object (the ByteBuddy proxy analog)."""
+    """Attribute-mapped live object (the ByteBuddy proxy analog).
+    Writes to indexed fields keep the class's index sets current."""
 
-    def __init__(self, client, cls_name: str, rid):
+    def __init__(self, client, cls_name: str, rid, service=None):
         object.__setattr__(self, "_map", client.get_map(f"live:{cls_name}:{rid}"))
         object.__setattr__(self, "_cls_name", cls_name)
         object.__setattr__(self, "_rid", rid)
+        object.__setattr__(
+            self, "_svc", service or LiveObjectService(client)
+        )
 
     def __getattr__(self, item):
         if item.startswith("_"):
@@ -805,9 +1031,20 @@ class LiveProxy:
         return self._map.get(item)
 
     def __setattr__(self, item, value):
+        svc, cls_name, rid = self._svc, self._cls_name, self._rid
+        if item in set(svc._indexed_fields(cls_name).read_all()):
+            old = self._map.get(item)
+            if old is not None and old != value:
+                svc._index_set(cls_name, item, old).remove(rid)
+            svc._index_set(cls_name, item, value).add(rid)
         self._map.fast_put(item, value)
 
     def __delattr__(self, item):
+        svc, cls_name, rid = self._svc, self._cls_name, self._rid
+        if item in set(svc._indexed_fields(cls_name).read_all()):
+            old = self._map.get(item)
+            if old is not None:
+                svc._index_set(cls_name, item, old).remove(rid)
         self._map.fast_remove(item)
 
 

@@ -53,11 +53,11 @@ class ReactiveProxy:
 
             from redisson_tpu.grid.base import _spawn_future
 
-            # Per-call threads, NOT the bounded default executor: grid
-            # ops may legitimately block (queue take, lock waits) — on a
-            # shared bounded pool, blocked ops occupy every worker and
-            # the op that would unblock them queues behind (the same
-            # deadlock grid/base.py's async facade documents).
+            # _spawn_future classifies by method name: possibly-blocking
+            # ops (take/poll/lock/acquire/...) get dedicated threads so
+            # they can never starve each other; everything else rides
+            # ONE bounded pool — 5k concurrent awaits of map gets cost
+            # pool-width threads, not 5k (grid/base.py _may_block).
             res = await asyncio.wrap_future(
                 _spawn_future(target, args, kwargs)._fut
             )

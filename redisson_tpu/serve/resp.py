@@ -59,7 +59,10 @@ def _encode_simple(s: str) -> bytes:
 # '-BUSYKEY ...', not '-ERR BUSYKEY ...').  An explicit allowlist — a
 # shape heuristic would hijack messages that merely START with a command
 # name ('EXEC without MULTI' must stay '-ERR EXEC without MULTI').
-_ERROR_CODES = ("BUSYKEY", "NOPROTO", "WRONGTYPE", "NOSCRIPT", "EXECABORT")
+_ERROR_CODES = (
+    "BUSYKEY", "NOPROTO", "WRONGTYPE", "NOSCRIPT", "EXECABORT",
+    "NOAUTH", "WRONGPASS", "NOGROUP", "BUSYGROUP",
+)
 
 
 def _encode_error(s: str) -> bytes:
@@ -277,6 +280,7 @@ class _ConnCtx:
         self.sock = sock
         self.lock = threading.Lock()
         self.subs: dict[str, int] = {}  # channel -> bus listener id
+        self.authed = True  # server flips to False when requirepass set
         self.in_multi = False
         self.queued: list = []  # commands queued since MULTI
         self.in_exec = False  # replaying an EXEC (blocking cmds don't block)
@@ -309,8 +313,17 @@ class RespServer:
     subscribed clients."""
 
     def __init__(self, client, host: str = "127.0.0.1", port: int = 0,
-                 max_connections: int = 256, idle_timeout_s: float = 300.0):
+                 max_connections: int = 256, idle_timeout_s: float = 300.0,
+                 requirepass: Optional[str] = None):
         self._client = client
+        # Auth (SURVEY §2.1 config row): explicit arg wins, else the
+        # client Config's requirepass key.  A network-exposed server
+        # with FLUSHALL and no auth is not shippable — set one.
+        self._requirepass = (
+            requirepass
+            if requirepass is not None
+            else getattr(client.config, "requirepass", None)
+        )
         self.max_connections = max_connections
         self.idle_timeout_s = idle_timeout_s
         self._nconn = 0
@@ -361,6 +374,8 @@ class RespServer:
         try:
             reader = _Reader(conn)
             ctx = _ConnCtx(conn)
+            if self._requirepass:
+                ctx.authed = False
         except Exception:
             # Constructor failure must not leak the connection slot.
             conn.close()
@@ -484,6 +499,9 @@ class RespServer:
 
     def _dispatch(self, cmd: list[bytes], ctx: "_ConnCtx") -> bytes:
         name = cmd[0].decode().upper()
+        if not ctx.authed and name not in ("AUTH", "HELLO", "QUIT"):
+            # Pre-auth surface is AUTH/HELLO/QUIT only, like Redis.
+            raise RespError("NOAUTH Authentication required.")
         if ctx.in_multi and name not in ("EXEC", "DISCARD", "MULTI"):
             # Redis MULTI semantics: commands queue (validated for
             # existence only) and run contiguously at EXEC.  Pub/sub
@@ -563,6 +581,11 @@ class RespServer:
     def _cmd_PING(self, args):
         return _encode_simple("PONG") if not args else _encode_bulk(args[0])
 
+    def _cmd_QUIT(self, args):
+        # +OK then the read loop closes on the peer's FIN; also legal
+        # pre-auth (part of the Redis unauthenticated surface).
+        return _encode_simple("OK")
+
     def _cmd_SCAN(self, args):
         """Cursor iteration with the Redis SCAN guarantee (keys present
         for the whole iteration are returned): the integer cursor maps to
@@ -587,8 +610,10 @@ class RespServer:
                 raise RespError("syntax error")
         with self._scan_lock:
             after = None if cursor == 0 else self._scan_states.pop(cursor, None)
-            if cursor != 0 and after is None:
-                # Unknown/evicted cursor: Redis treats it as terminated.
+            if cursor != 0 and (after is None or not isinstance(after, str)):
+                # Unknown/evicted cursor — or one minted by a COLLECTION
+                # scan (HSCAN/SSCAN/ZSCAN states are tagged tuples):
+                # Redis treats it as terminated.
                 return b"*2\r\n" + _encode_bulk("0") + _encode_array([])
         keys = sorted(self._client.get_keys().get_keys(pattern))
         if after is not None:
@@ -608,6 +633,198 @@ class RespServer:
         else:
             nxt = 0
         return b"*2\r\n" + _encode_bulk(str(nxt)) + _encode_array(page)
+
+    @staticmethod
+    def _parse_scan_opts(args, i):
+        pattern, count, novalues = None, 10, False
+        while i < len(args):
+            opt = args[i].decode().upper()
+            if opt == "MATCH":
+                pattern = args[i + 1].decode("latin-1")
+                i += 2
+            elif opt == "COUNT":
+                count = int(args[i + 1])
+                if count < 1:
+                    raise RespError("syntax error")
+                i += 2
+            elif opt == "NOVALUES":
+                novalues = True
+                i += 1
+            else:
+                raise RespError("syntax error")
+        return pattern, count, novalues
+
+    def _collection_scan(self, tag: str, key: bytes, cursor: int,
+                         items: list, pattern, count: int):
+        """Shared HSCAN/SSCAN/ZSCAN cursor engine (SURVEY §2.1 iterators
+        row): ``items`` is [(sort_bytes, reply_items_tuple)]; resume
+        state holds the LAST sort key returned, so members present for
+        the whole iteration are always returned even across concurrent
+        deletes.  States live in the same LRU table as SCAN's, tagged
+        with (command, key) so a cursor replayed against a different
+        command or key terminates instead of desyncing."""
+        import bisect
+        import fnmatch
+
+        with self._scan_lock:
+            state = None if cursor == 0 else self._scan_states.pop(cursor, None)
+            if cursor != 0 and (
+                not isinstance(state, tuple)
+                or state[:2] != (tag, key)
+            ):
+                return b"*2\r\n" + _encode_bulk("0") + _encode_array([])
+            after = None if state is None else state[2]
+        if pattern is not None:
+            items = [
+                it for it in items
+                if fnmatch.fnmatch(it[0].decode("latin-1"), pattern)
+            ]
+        items.sort(key=lambda it: it[0])
+        start = (
+            0 if after is None
+            else bisect.bisect_right([it[0] for it in items], after)
+        )
+        page = items[start : start + count]
+        if start + count < len(items):
+            with self._scan_lock:
+                self._scan_next += 1
+                nxt = self._scan_next
+                self._scan_states[nxt] = (tag, key, page[-1][0])
+                while len(self._scan_states) > 1024:  # LRU cap
+                    self._scan_states.pop(next(iter(self._scan_states)))
+        else:
+            nxt = 0
+        flat = [x for _, reply in page for x in reply]
+        return b"*2\r\n" + _encode_bulk(str(nxt)) + _encode_array(flat)
+
+    def _cmd_HSCAN(self, args):
+        pattern, count, novalues = self._parse_scan_opts(args, 2)
+        m = self._map(args[0])
+        items = [
+            (k, (k,) if novalues else (k, v))
+            for k, v in m.entry_set()
+        ]
+        return self._collection_scan(
+            "HSCAN", args[0], int(args[1]), items, pattern, count
+        )
+
+    def _cmd_SSCAN(self, args):
+        pattern, count, _ = self._parse_scan_opts(args, 2)
+        s = self._set(args[0])
+        items = [(v, (v,)) for v in s.read_all()]
+        return self._collection_scan(
+            "SSCAN", args[0], int(args[1]), items, pattern, count
+        )
+
+    def _cmd_ZSCAN(self, args):
+        pattern, count, _ = self._parse_scan_opts(args, 2)
+        z = self._zset(args[0])
+        items = [
+            (m, (m, _fmt_score(sc).encode()))
+            for m, sc in z.entry_range(0, -1)
+        ]
+        return self._collection_scan(
+            "ZSCAN", args[0], int(args[1]), items, pattern, count
+        )
+
+    def _zstore(self, args, intersect: bool):
+        """ZUNIONSTORE/ZINTERSTORE dest numkeys key... [WEIGHTS w...]
+        [AGGREGATE SUM|MIN|MAX] — atomic replace of dest, returns the
+        stored cardinality."""
+        dest = args[0]
+        numkeys = int(args[1])
+        keys = args[2 : 2 + numkeys]
+        weights = [1.0] * numkeys
+        agg = "SUM"
+        i = 2 + numkeys
+        while i < len(args):
+            opt = args[i].decode().upper()
+            if opt == "WEIGHTS":
+                ws = args[i + 1 : i + 1 + numkeys]
+                if len(ws) != numkeys:
+                    # zip() would silently drop the unweighted keys
+                    raise RespError("syntax error")
+                weights = [float(a) for a in ws]
+                i += 1 + numkeys
+            elif opt == "AGGREGATE":
+                agg = args[i + 1].decode().upper()
+                if agg not in ("SUM", "MIN", "MAX"):
+                    raise RespError("syntax error")
+                i += 2
+            else:
+                raise RespError("syntax error")
+        with self._client._grid.lock:  # atomic multi-key read + replace
+            maps = []
+            for k, w in zip(keys, weights):
+                entries = {
+                    m: sc * w for m, sc in self._zset(k).entry_range(0, -1)
+                }
+                maps.append(entries)
+            if intersect:
+                members = set(maps[0]) if maps else set()
+                for d in maps[1:]:
+                    members &= set(d)
+            else:
+                members = set()
+                for d in maps:
+                    members |= set(d)
+            out = {}
+            for m in members:
+                vals = [d[m] for d in maps if m in d]
+                out[m] = (
+                    sum(vals) if agg == "SUM"
+                    else min(vals) if agg == "MIN" else max(vals)
+                )
+            dz = self._zset(dest)
+            dz.delete()
+            for m, sc in out.items():
+                dz.add(sc, m)
+            return _encode_int(len(out))
+
+    def _cmd_ZUNIONSTORE(self, args):
+        return self._zstore(args, intersect=False)
+
+    def _cmd_ZINTERSTORE(self, args):
+        return self._zstore(args, intersect=True)
+
+    def _cmd_ZRANGEBYLEX(self, args):
+        """Lexicographic range over same-score members: '[m' inclusive,
+        '(m' exclusive, '-'/'+' unbounded; LIMIT offset count."""
+        lo, hi = args[1], args[2]
+        offset, count = 0, None
+        if len(args) >= 6 and args[3].decode().upper() == "LIMIT":
+            offset, count = int(args[4]), int(args[5])
+
+        def bound(b):
+            if b in (b"-", b"+"):
+                return None, True
+            if b[:1] == b"[":
+                return b[1:], True
+            if b[:1] == b"(":
+                return b[1:], False
+            raise RespError("min or max not valid string range item")
+
+        lo_v, lo_inc = bound(lo)
+        hi_v, hi_inc = bound(hi)
+
+        def in_range(m):
+            if lo == b"+" or hi == b"-":
+                return False  # inverted/empty ranges match nothing
+            if lo != b"-" and (m < lo_v or (m == lo_v and not lo_inc)):
+                return False
+            if hi != b"+" and (m > hi_v or (m == hi_v and not hi_inc)):
+                return False
+            return True
+
+        members = sorted(
+            m for m, _ in self._zset(args[0]).entry_range(0, -1)
+        )
+        out = [m for m in members if in_range(m)]
+        if count is None or count < 0:
+            out = out[offset:]  # Redis: negative count = all remaining
+        else:
+            out = out[offset : offset + count]
+        return _encode_array(out)
 
     def _cmd_ECHO(self, args):
         return _encode_bulk(args[0])
@@ -1600,6 +1817,38 @@ class RespServer:
     # protocol negotiation (→ RESP3's HELLO; the reference speaks
     # RESP2/RESP3 through Netty — SURVEY.md §2.4 comm row)
 
+    def _check_password(self, username: Optional[bytes], password: bytes) -> None:
+        """Constant-time password check; only the 'default' user exists
+        (the single-password requirepass model, like redis-server
+        without ACLs)."""
+        import hmac
+
+        if self._requirepass is None:
+            raise RespError(
+                "Client sent AUTH, but no password is set. Did you mean "
+                "AUTH <username> <password>?"
+            )
+        if username is not None and username != b"default":
+            raise RespError(
+                "WRONGPASS invalid username-password pair or user is "
+                "disabled."
+            )
+        if not hmac.compare_digest(password, self._requirepass.encode()):
+            raise RespError(
+                "WRONGPASS invalid username-password pair or user is "
+                "disabled."
+            )
+
+    def _cmdctx_AUTH(self, args, ctx: _ConnCtx):
+        if len(args) == 1:
+            self._check_password(None, args[0])
+        elif len(args) == 2:
+            self._check_password(args[0], args[1])
+        else:
+            raise RespError("wrong number of arguments for 'auth' command")
+        ctx.authed = True
+        return _encode_simple("OK")
+
     def _cmdctx_HELLO(self, args, ctx: _ConnCtx):
         # Validate EVERYTHING before mutating ctx: a failed HELLO must
         # leave the connection on its current protocol (a half-applied
@@ -1615,19 +1864,31 @@ class RespServer:
                     "NOPROTO unsupported protocol version"
                 )
             i = 1
+        authed = ctx.authed
         while i < len(args):
             opt = args[i].decode().upper()
             if opt == "AUTH":
-                raise RespError(
-                    "Client sent AUTH, but no password is set."
-                )
+                # HELLO ... AUTH <username> <password>: raises on a bad
+                # pair BEFORE any ctx mutation (validate-then-commit).
+                self._check_password(args[i + 1], args[i + 2])
+                authed = True
+                i += 3
+                continue
             if opt == "SETNAME":
                 name = self._s(args[i + 1])
                 i += 2
                 continue
             raise RespError(f"unsupported HELLO option {opt}")
+        if not authed:
+            # HELLO without credentials on a locked server: refused like
+            # every other pre-auth command (Redis behavior for HELLO is
+            # to answer, but answering leaks server metadata; AUTH-first
+            # is the safe strictening and stock clients send AUTH here).
+            raise RespError("NOAUTH HELLO must include AUTH when "
+                            "requirepass is set.")
         ctx.proto = ver
         ctx.client_name = name
+        ctx.authed = authed
         pairs = [
             (b"server", b"redisson-tpu"),
             (b"version", b"4.0.0"),

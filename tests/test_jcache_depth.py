@@ -1,0 +1,235 @@
+"""JSR-107 depth (round-5 VERDICT item 5): entry listeners incl.
+expired, CacheLoader/CacheWriter read/write-through, per-cache
+statistics, access/update ExpiryPolicy."""
+
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.grid.jcache import CacheManager, ExpiryPolicy, JCache
+
+
+@pytest.fixture
+def client():
+    c = redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture
+def manager(client):
+    return CacheManager(client)
+
+
+def _drain(client):
+    client._topic_bus.drain()
+
+
+class TestEntryListeners:
+    def test_created_updated_removed(self, manager, client):
+        cache = manager.create_cache("jl")
+        events = []
+        lid = cache.register_cache_entry_listener(
+            lambda ev, k, v: events.append((ev, k, v))
+        )
+        cache.put("a", 1)
+        cache.put("a", 2)
+        cache.remove("a")
+        _drain(client)
+        assert events == [
+            ("created", "a", 1), ("updated", "a", 2), ("removed", "a", 2),
+        ]
+        cache.deregister_cache_entry_listener(lid)
+        cache.put("b", 1)
+        _drain(client)
+        assert len(events) == 3  # deregistered: no further events
+
+    def test_event_filter(self, manager, client):
+        cache = manager.create_cache("jl2")
+        removed = []
+        cache.register_cache_entry_listener(
+            lambda ev, k, v: removed.append(k), event=JCache.EVENT_REMOVED
+        )
+        cache.put("x", 1)
+        cache.remove("x")
+        _drain(client)
+        assert removed == ["x"]
+
+    def test_expired_event_fires_on_lazy_reap(self, manager, client):
+        cache = manager.create_cache(
+            "jexp", expiry_policy=ExpiryPolicy(creation_ttl=0.1)
+        )
+        events = []
+        cache.register_cache_entry_listener(
+            lambda ev, k, v: events.append((ev, k, v)),
+            event=JCache.EVENT_EXPIRED,
+        )
+        cache.put("gone", 41)
+        time.sleep(0.25)
+        assert cache.get("gone") is None  # lazy reap fires the event
+        _drain(client)
+        assert events == [("expired", "gone", 41)]
+
+
+class TestReadWriteThrough:
+    def test_read_through_loads_on_miss(self, manager):
+        loads = []
+
+        def loader(k):
+            loads.append(k)
+            return f"db:{k}"
+
+        cache = manager.create_cache(
+            "jrt", cache_loader=loader, read_through=True,
+            statistics_enabled=True,
+        )
+        assert cache.get("k1") == "db:k1"
+        assert cache.statistics.misses == 1  # a LOAD is a miss (JSR)
+        assert loads == ["k1"]
+        assert cache.get("k1") == "db:k1"  # now cached: no second load
+        assert loads == ["k1"]
+
+    def test_read_through_get_all(self, manager):
+        cache = manager.create_cache(
+            "jrt2", cache_loader=lambda k: k.upper(), read_through=True
+        )
+        cache.put("a", "cached")
+        out = cache.get_all(["a", "b"])
+        assert out == {"a": "cached", "b": "B"}
+
+    def test_load_all(self, manager):
+        cache = manager.create_cache("jla", cache_loader=lambda k: k * 2)
+        cache.put("x", "keep")
+        assert cache.load_all(["x", "y"]) == 1  # x kept, y loaded
+        assert cache.get("x") == "keep"
+        assert cache.get("y") == "yy"
+        assert cache.load_all(["x"], replace_existing=True) == 1
+        assert cache.get("x") == "xx"
+
+    def test_write_through_mirrors_puts_and_removes(self, manager):
+        backing = {}
+
+        class Writer:
+            def write(self, k, v):
+                backing[k] = v
+
+            def delete(self, k):
+                backing.pop(k, None)
+
+        cache = manager.create_cache(
+            "jwt", cache_writer=Writer(), write_through=True
+        )
+        cache.put("a", 1)
+        cache.get_and_put("b", 2)
+        assert backing == {"a": 1, "b": 2}
+        cache.remove("a")
+        assert backing == {"b": 2}
+        cache.remove_all(["b"])
+        assert backing == {}
+
+    def test_failing_writer_leaves_cache_unchanged(self, manager):
+        class Writer:
+            def write(self, k, v):
+                raise IOError("db down")
+
+            def delete(self, k):
+                raise IOError("db down")
+
+        cache = manager.create_cache(
+            "jwf", cache_writer=Writer(), write_through=True
+        )
+        with pytest.raises(IOError):
+            cache.put("a", 1)
+        assert cache.get("a") is None  # JSR: writer runs FIRST
+
+
+class TestStatistics:
+    def test_hits_misses_puts_removals(self, manager):
+        cache = manager.create_cache("jst", statistics_enabled=True)
+        cache.put("a", 1)
+        cache.put("b", 2)
+        assert cache.get("a") == 1
+        assert cache.get("ghost") is None
+        cache.remove("a")
+        s = cache.statistics
+        assert (s.puts, s.hits, s.misses, s.removals) == (2, 1, 1, 1)
+        assert s.gets == 2 and s.hit_percentage == 50.0
+        s.reset()
+        assert (s.puts, s.hits, s.misses, s.removals) == (0, 0, 0, 0)
+
+    def test_statistics_disabled_by_default(self, manager):
+        assert manager.create_cache("jsd").statistics is None
+
+
+class TestExpiryPolicy:
+    def test_creation_ttl(self, manager):
+        cache = manager.create_cache(
+            "jec", expiry_policy=ExpiryPolicy(creation_ttl=0.15)
+        )
+        cache.put("k", 1)
+        assert cache.get("k") == 1
+        time.sleep(0.25)
+        assert cache.get("k") is None
+
+    def test_access_ttl_refreshes_on_get(self, manager):
+        cache = manager.create_cache(
+            "jea", expiry_policy=ExpiryPolicy(access_ttl=0.3)
+        )
+        cache.put("k", 1)
+        for _ in range(3):
+            time.sleep(0.15)
+            assert cache.get("k") == 1  # touches keep it alive
+        time.sleep(0.45)
+        assert cache.get("k") is None  # idle past the access TTL
+
+    def test_update_ttl_on_replace(self, manager):
+        cache = manager.create_cache(
+            "jeu",
+            expiry_policy=ExpiryPolicy(creation_ttl=10.0, update_ttl=0.15),
+        )
+        cache.put("k", 1)
+        assert cache.replace("k", 2) is True
+        time.sleep(0.3)
+        assert cache.get("k") is None  # replace re-armed the short TTL
+
+    def test_default_ttl_seconds_back_compat(self, manager):
+        cache = manager.create_cache("jbc", default_ttl_seconds=0.15)
+        cache.put("k", 1)
+        time.sleep(0.3)
+        assert cache.get("k") is None
+
+
+class TestReviewFixes:
+    def test_failed_conditional_remove_keeps_writer_row(self, manager):
+        backing = {}
+
+        class Writer:
+            def write(self, k, v):
+                backing[k] = v
+
+            def delete(self, k):
+                backing.pop(k, None)
+
+        cache = manager.create_cache(
+            "jcr", cache_writer=Writer(), write_through=True
+        )
+        cache.put("k", "v1")
+        assert cache.remove("k", "wrong") is False
+        assert backing == {"k": "v1"}  # failed compare: writer untouched
+        assert cache.get("k") == "v1"
+        assert cache.remove("k", "v1") is True
+        assert backing == {}
+
+    def test_update_ttl_applies_on_plain_put(self, manager):
+        cache = manager.create_cache(
+            "jup", expiry_policy=ExpiryPolicy(update_ttl=0.15)
+        )
+        cache.put("k", 1)   # creation: no TTL
+        cache.put("k", 2)   # update: re-armed under update_ttl
+        time.sleep(0.3)
+        assert cache.get("k") is None
+        cache.put("fresh", 1)  # creation path: still immortal
+        time.sleep(0.2)
+        assert cache.get("fresh") == 1

@@ -1,0 +1,158 @@
+"""Round-5 VERDICT item 7: LiveObject @RId index/find machinery and
+transactional List / ScoredSortedSet breadth."""
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.grid.services import TransactionException
+
+
+@pytest.fixture
+def client():
+    c = redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
+    yield c
+    c.shutdown()
+
+
+class Person:
+    def __init__(self, id, name, city):
+        self.id = id
+        self.name = name
+        self.city = city
+
+
+class TestLiveObjectFind:
+    def test_find_by_indexed_field(self, client):
+        svc = client.get_live_object_service()
+        for i, city in enumerate(["rome", "oslo", "rome", "kyiv", "rome"]):
+            svc.persist(Person(i, f"p{i}", city), index=("city",))
+        hits = svc.find_by_field(Person, "city", "rome")
+        assert sorted(p._rid for p in hits) == [0, 2, 4]
+        assert all(p.city == "rome" for p in hits)
+        assert svc.count(Person) == 5
+
+    def test_index_maintained_through_proxy_writes(self, client):
+        svc = client.get_live_object_service()
+        p = svc.persist(Person(1, "ann", "rome"), index=("city",))
+        p.city = "oslo"  # move between index sets
+        assert svc.find_by_field(Person, "city", "rome") == []
+        assert [q._rid for q in svc.find_by_field(Person, "city", "oslo")] == [1]
+
+    def test_delete_removes_from_index_and_registry(self, client):
+        svc = client.get_live_object_service()
+        svc.persist(Person(1, "ann", "rome"), index=("city",))
+        svc.persist(Person(2, "bob", "rome"), index=("city",))
+        assert svc.delete(Person, 1) is True
+        assert [q._rid for q in svc.find_by_field(Person, "city", "rome")] == [2]
+        assert svc.count(Person) == 1
+        assert sorted(svc.list_ids(Person)) == [2]
+
+    def test_find_unindexed_field_scans(self, client):
+        svc = client.get_live_object_service()
+        svc.persist(Person(1, "ann", "rome"))
+        svc.persist(Person(2, "bob", "oslo"))
+        hits = svc.find_by_field(Person, "name", "bob")
+        assert [p._rid for p in hits] == [2]
+
+
+class TestTxList:
+    def test_commit_and_rollback(self, client):
+        lst = client.get_list("txl")
+        lst.add_all(["a", "b"])
+        tx = client.create_transaction()
+        tl = tx.get_list("txl")
+        assert tl.read_all() == ["a", "b"]
+        tl.add("c")
+        assert tl.size() == 3 and tl.get(2) == "c"
+        assert lst.read_all() == ["a", "b"]  # not yet visible
+        tx.commit()
+        assert lst.read_all() == ["a", "b", "c"]
+
+        tx2 = client.create_transaction()
+        tl2 = tx2.get_list("txl")
+        tl2.add("d")
+        tx2.rollback()
+        assert lst.read_all() == ["a", "b", "c"]
+
+    def test_remove_and_contains(self, client):
+        lst = client.get_list("txl2")
+        lst.add_all(["x", "y"])
+        tx = client.create_transaction()
+        tl = tx.get_list("txl2")
+        assert tl.contains("x") is True
+        assert tl.remove("x") is True
+        tx.commit()
+        assert lst.read_all() == ["y"]
+
+    def test_concurrent_write_invalidates_read(self, client):
+        lst = client.get_list("txl3")
+        lst.add("a")
+        tx = client.create_transaction()
+        tl = tx.get_list("txl3")
+        assert tl.read_all() == ["a"]
+        lst.add("intruder")  # concurrent writer
+        tl.add("mine")
+        with pytest.raises(TransactionException, match="invalidated"):
+            tx.commit()
+        assert lst.read_all() == ["a", "intruder"]  # log NOT applied
+
+
+class TestTxScoredSortedSet:
+    def test_commit_scores(self, client):
+        z = client.get_scored_sorted_set("txz")
+        z.add(1.0, "a")
+        tx = client.create_transaction()
+        tz = tx.get_scored_sorted_set("txz")
+        assert tz.get_score("a") == 1.0
+        assert tz.contains("ghost") is False
+        tz.add(2.5, "b")
+        assert tz.get_score("b") == 2.5  # read-your-writes
+        assert z.get_score("b") is None  # not yet visible
+        tx.commit()
+        assert z.get_score("b") == 2.5
+
+    def test_remove_and_rollback(self, client):
+        z = client.get_scored_sorted_set("txz2")
+        z.add(1.0, "a")
+        tx = client.create_transaction()
+        tz = tx.get_scored_sorted_set("txz2")
+        assert tz.remove("a") is True
+        tx.rollback()
+        assert z.get_score("a") == 1.0
+
+    def test_score_read_invalidated_by_concurrent_change(self, client):
+        z = client.get_scored_sorted_set("txz3")
+        z.add(1.0, "a")
+        tx = client.create_transaction()
+        tz = tx.get_scored_sorted_set("txz3")
+        assert tz.get_score("a") == 1.0
+        z.add(9.0, "a")  # concurrent score change
+        tz.add(5.0, "b")
+        with pytest.raises(TransactionException, match="invalidated"):
+            tx.commit()
+        assert z.get_score("b") is None
+
+
+class TestTxListReadYourRemoves:
+    def test_remove_masks_later_reads(self, client):
+        lst = client.get_list("txl4")
+        lst.add("x")
+        tx = client.create_transaction()
+        tl = tx.get_list("txl4")
+        assert tl.remove("x") is True
+        assert tl.contains("x") is False
+        assert tl.read_all() == [] and tl.size() == 0
+        assert tl.remove("x") is False  # already removed in this tx
+        tx.commit()
+        assert lst.read_all() == []
+
+    def test_add_then_remove_cancels(self, client):
+        lst = client.get_list("txl5")
+        lst.add("keep")
+        tx = client.create_transaction()
+        tl = tx.get_list("txl5")
+        tl.add("temp")
+        assert tl.remove("temp") is True
+        tx.commit()
+        assert lst.read_all() == ["keep"]

@@ -1,0 +1,115 @@
+"""Front-door auth (round-5 VERDICT item 4): requirepass config key,
+AUTH + HELLO AUTH enforcement, pre-auth command rejection."""
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.serve.resp import RespServer
+
+from test_resp_server import RespClient
+
+PW = "sekret-pw"
+
+
+@pytest.fixture
+def locked():
+    client = redisson_tpu.create(
+        Config().use_tpu_sketch(min_bucket=64).set_requirepass(PW)
+    )
+    server = RespServer(client)
+    yield server
+    server.close()
+    client.shutdown()
+
+
+class TestRequirepass:
+    def test_pre_auth_commands_refused(self, locked):
+        c = RespClient(locked.host, locked.port)
+        try:
+            for cmd in (("PING",), ("GET", "k"), ("SET", "k", "v"),
+                        ("FLUSHALL",), ("SUBSCRIBE", "ch"), ("DBSIZE",)):
+                with pytest.raises(RuntimeError, match="NOAUTH"):
+                    c.cmd(*cmd)
+        finally:
+            c.close()
+
+    def test_wrong_password(self, locked):
+        c = RespClient(locked.host, locked.port)
+        try:
+            with pytest.raises(RuntimeError, match="WRONGPASS"):
+                c.cmd("AUTH", "nope")
+            with pytest.raises(RuntimeError, match="NOAUTH"):
+                c.cmd("PING")  # still locked after the failed attempt
+        finally:
+            c.close()
+
+    def test_right_password_unlocks(self, locked):
+        c = RespClient(locked.host, locked.port)
+        try:
+            assert c.cmd("AUTH", PW) == "OK"
+            assert c.cmd("PING") == "PONG"
+            assert c.cmd("SET", "k", "v") == "OK"
+            assert c.cmd("GET", "k") == b"v"
+        finally:
+            c.close()
+
+    def test_two_arg_auth_default_user(self, locked):
+        c = RespClient(locked.host, locked.port)
+        try:
+            with pytest.raises(RuntimeError, match="WRONGPASS"):
+                c.cmd("AUTH", "admin", PW)  # only 'default' exists
+            assert c.cmd("AUTH", "default", PW) == "OK"
+            assert c.cmd("PING") == "PONG"
+        finally:
+            c.close()
+
+    def test_hello_auth(self, locked):
+        c = RespClient(locked.host, locked.port)
+        try:
+            with pytest.raises(RuntimeError, match="NOAUTH"):
+                c.cmd("HELLO", "2")  # HELLO without AUTH: refused
+            with pytest.raises(RuntimeError, match="WRONGPASS"):
+                c.cmd("HELLO", "2", "AUTH", "default", "bad")
+            reply = c.cmd("HELLO", "2", "AUTH", "default", PW)
+            assert b"server" in reply
+            assert c.cmd("PING") == "PONG"
+        finally:
+            c.close()
+
+    def test_quit_allowed_pre_auth(self, locked):
+        c = RespClient(locked.host, locked.port)
+        try:
+            assert c.cmd("QUIT") == "OK"
+        finally:
+            c.close()
+
+    def test_auth_is_per_connection(self, locked):
+        c1 = RespClient(locked.host, locked.port)
+        c2 = RespClient(locked.host, locked.port)
+        try:
+            assert c1.cmd("AUTH", PW) == "OK"
+            with pytest.raises(RuntimeError, match="NOAUTH"):
+                c2.cmd("PING")  # c1's auth must not leak to c2
+        finally:
+            c1.close()
+            c2.close()
+
+
+class TestOpenServer:
+    def test_no_password_auth_errors_like_redis(self):
+        client = redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
+        server = RespServer(client)
+        c = RespClient(server.host, server.port)
+        try:
+            assert c.cmd("PING") == "PONG"  # open server: no gate
+            with pytest.raises(RuntimeError, match="no password is set"):
+                c.cmd("AUTH", "whatever")
+        finally:
+            c.close()
+            server.close()
+            client.shutdown()
+
+    def test_requirepass_roundtrips_through_config_dict(self):
+        cfg = Config().set_requirepass("p1")
+        assert Config.from_dict(cfg.to_dict()).requirepass == "p1"
