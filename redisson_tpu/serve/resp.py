@@ -23,9 +23,16 @@ Command surface (the subset the north-star objects + grid need):
   SINTER SUNION SDIFF SINTERSTORE SUNIONSTORE SDIFFSTORE
   ZADD ZSCORE ZRANGE ZCARD ZREM ZINCRBY ZRANK ZCOUNT ZRANGEBYSCORE
   ZPOPMIN ZPOPMAX ZREVRANGE ZREVRANK ZREMRANGEBYSCORE
+  ZUNIONSTORE ZINTERSTORE ZRANGEBYLEX        (weights/aggregate; lex)
+  HSCAN SSCAN ZSCAN                  (tagged resume cursors, MATCH/COUNT)
   INCR INCRBY DECR INCRBYFLOAT
+  XADD XLEN XRANGE XREVRANGE XDEL XTRIM XREAD XREADGROUP XGROUP XACK
+  XPENDING XCLAIM XAUTOCLAIM XINFO                 (streams + groups/PEL)
+  GEOADD GEOPOS GEODIST GEOHASH GEOSEARCH GEOSEARCHSTORE
+  EVAL EVALSHA SCRIPT FCALL FCALL_RO FUNCTION  (PYTHON script bodies — no
+                                          Lua VM; redis.call bridge)
   PUBLISH SUBSCRIBE UNSUBSCRIBE           (push replies; '>' on RESP3)
-  HELLO CLIENT INFO COMMAND               (RESP2/RESP3 negotiation, admin)
+  AUTH HELLO CLIENT INFO COMMAND QUIT     (RESP2/RESP3, requirepass auth)
   MULTI EXEC DISCARD                                (contiguous-exec txn)
   KEYS SCAN DBSIZE FLUSHALL
 
