@@ -2562,6 +2562,9 @@ class RespServer:
         in_exec = True
         proto = 2
         client_name = None
+        # Scripts run server-side: the CONNECTION that invoked EVAL was
+        # already auth-gated, so the bridge context is always authed.
+        authed = True
 
         def __init__(self):
             self.subs = {}

@@ -113,3 +113,19 @@ class TestOpenServer:
     def test_requirepass_roundtrips_through_config_dict(self):
         cfg = Config().set_requirepass("p1")
         assert Config.from_dict(cfg.to_dict()).requirepass == "p1"
+
+
+class TestScriptsOnLockedServer:
+    def test_eval_bridge_works_after_auth(self, locked):
+        """The script bridge's internal ctx must count as authed — the
+        invoking connection already passed the gate (regression: the
+        NOAUTH gate briefly broke every redis.call)."""
+        c = RespClient(locked.host, locked.port)
+        try:
+            assert c.cmd("AUTH", PW) == "OK"
+            c.cmd("SET", "sk", "sv")
+            assert c.cmd(
+                "EVAL", "redis.call('GET', KEYS[0])", 1, "sk"
+            ) == b"sv"
+        finally:
+            c.close()
