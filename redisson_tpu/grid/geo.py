@@ -45,6 +45,19 @@ def _geohash_int52(lon: float, lat: float) -> int:
     return out
 
 
+def _geohash_int52_decode(cell: int) -> tuple:
+    """Center coordinates of a 52-bit cell (inverse of _geohash_int52)
+    — the ≤2.7e-6° round-trip error is Redis's own precision class
+    (GEOPOS there also returns cell centers, not the added values)."""
+    ilon = ilat = 0
+    for i in range(26):
+        ilon |= ((cell >> (2 * i + 1)) & 1) << i
+        ilat |= ((cell >> (2 * i)) & 1) << i
+    lon = (ilon + 0.5) / (1 << 26) * 360.0 - 180.0
+    lat = (ilat + 0.5) / (1 << 26) * (2 * _LAT_MAX) - _LAT_MAX
+    return lon, lat
+
+
 def _geohash(lon: float, lat: float, precision: int = 11) -> str:
     """Standard base32 geohash (the GEOHASH reply shape)."""
     lat_r = [-90.0, 90.0]
@@ -79,11 +92,21 @@ def _geohash(lon: float, lat: float, precision: int = 11) -> str:
 
 
 class Geo(GridObject):
-    KIND = "geo"
+    """A geo key IS a zset whose scores are 52-bit geohash cell ids —
+    the Redis representation, verbatim: TYPE reports zset, ZSCORE/ZRANGE
+    work on geo keys, GEOSEARCHSTORE destinations are readable by geo
+    commands, and positions round-trip through the cell center (the same
+    ≤1 m precision class as Redis GEOPOS)."""
+
+    KIND = "zset"
 
     @staticmethod
     def _new_value():
-        return {}  # member bytes -> (lon, lat)
+        return {}  # member bytes -> float(52-bit cell id)
+
+    @staticmethod
+    def _coords(score: float) -> tuple:
+        return _geohash_int52_decode(int(score))
 
     # -- writes ------------------------------------------------------------
 
@@ -95,7 +118,7 @@ class Geo(GridObject):
             e = self._entry()
             mb = self._enc(member)
             new = mb not in e.value
-            e.value[mb] = (float(longitude), float(latitude))
+            e.value[mb] = float(_geohash_int52(longitude, latitude))
             return int(new)
 
     def add_entries(self, *entries: tuple) -> int:
@@ -128,7 +151,7 @@ class Geo(GridObject):
             for m in members:
                 got = e.value.get(self._enc(m))
                 if got is not None:
-                    out[m] = got
+                    out[m] = self._coords(got)
             return out
 
     def dist(self, a: Any, b: Any, unit: str = "m") -> Optional[float]:
@@ -142,7 +165,7 @@ class Geo(GridObject):
             pb = e.value.get(self._enc(b))
             if pa is None or pb is None:
                 return None
-            return _haversine_m(*pa, *pb) / scale
+            return _haversine_m(*self._coords(pa), *self._coords(pb)) / scale
 
     def hash(self, *members: Any) -> dict:
         """→ RGeo#hash (GEOHASH)."""
@@ -154,7 +177,7 @@ class Geo(GridObject):
             for m in members:
                 got = e.value.get(self._enc(m))
                 if got is not None:
-                    out[m] = _geohash(*got)
+                    out[m] = _geohash(*self._coords(got))
             return out
 
     # -- search (GEOSEARCH) -------------------------------------------------
@@ -169,7 +192,8 @@ class Geo(GridObject):
             if e is None:
                 return []
             hits = []
-            for mb, (lon, lat) in e.value.items():
+            for mb, score in e.value.items():
+                lon, lat = self._coords(score)
                 d = _haversine_m(longitude, latitude, lon, lat)
                 if d <= limit_m:
                     hits.append((d, mb))
@@ -189,9 +213,8 @@ class Geo(GridObject):
             origin = None if e is None else e.value.get(self._enc(member))
         if origin is None:
             raise ValueError(f"member {member!r} has no position")
-        return self.search_radius(
-            origin[0], origin[1], radius, unit, count, with_dist
-        )
+        lon0, lat0 = self._coords(origin)
+        return self.search_radius(lon0, lat0, radius, unit, count, with_dist)
 
     def search(self, *, member: Any = None, longitude: Optional[float] = None,
                latitude: Optional[float] = None, radius: Optional[float] = None,
@@ -220,13 +243,14 @@ class Geo(GridObject):
                 origin = e.value.get(self._enc(member))
                 if origin is None:
                     raise ValueError(f"member {member!r} has no position")
-                lon_c, lat_c = origin
+                lon_c, lat_c = self._coords(origin)
             else:
                 if longitude is None or latitude is None:
                     raise ValueError("search needs a member or lon/lat origin")
                 lon_c, lat_c = float(longitude), float(latitude)
             hits = []
-            for mb, (lon, lat) in e.value.items():
+            for mb, score in e.value.items():
+                lon, lat = self._coords(score)
                 d = _haversine_m(lon_c, lat_c, lon, lat)
                 if radius is not None:
                     if d > radius * scale:

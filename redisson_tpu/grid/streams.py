@@ -255,10 +255,13 @@ class Stream(GridObject):
 
     def read_group(self, group: str, consumer: str,
                    count: Optional[int] = None, ids: str = ">",
-                   block_seconds: Optional[float] = None) -> list:
+                   block_seconds: Optional[float] = None,
+                   noack: bool = False) -> list:
         """→ XREADGROUP: ``ids=">"`` delivers NEW entries (advancing the
-        group cursor and adding to the consumer's PEL); an explicit id
-        re-reads this consumer's pending entries after it."""
+        group cursor and adding to the consumer's PEL — unless ``noack``,
+        the XREADGROUP NOACK contract: delivered entries skip the PEL
+        entirely); an explicit id re-reads this consumer's pending
+        entries after it."""
         deadline = (
             None if block_seconds is None else time.monotonic() + block_seconds
         )
@@ -274,11 +277,12 @@ class Stream(GridObject):
                     for t, f in st.entries.items():
                         if t > g["last_delivered"]:
                             out.append((_fmt_id(t), self._decode(f)))
-                            g["pending"][t] = {
-                                "consumer": consumer,
-                                "time_ms": now_ms,
-                                "count": 1,
-                            }
+                            if not noack:
+                                g["pending"][t] = {
+                                    "consumer": consumer,
+                                    "time_ms": now_ms,
+                                    "count": 1,
+                                }
                             g["last_delivered"] = t
                             if count is not None and len(out) >= count:
                                 break
@@ -380,11 +384,16 @@ class Stream(GridObject):
             return out
 
     def auto_claim(self, group: str, consumer: str, min_idle_ms: int,
-                   start: str = "0-0", count: int = 100) -> list:
+                   start: str = "0-0", count: int = 100,
+                   with_cursor: bool = False):
         """→ XAUTOCLAIM: claim up to ``count`` idle entries from ``start``.
         Ownership transfers ONLY for entries actually returned — claiming
         is done under one lock pass that stops at ``count``, so no entry
-        is silently reassigned (and its idle clock reset) invisibly."""
+        is silently reassigned (and its idle clock reset) invisibly.
+        ``with_cursor`` additionally returns the Redis next-cursor: the
+        id to continue from when COUNT truncated the sweep, '0-0' when
+        the whole PEL was examined (callers looping until 0-0 must not
+        be told a truncated sweep was exhaustive)."""
         now_ms = int(time.time() * 1000)
         lo = _parse_id(start)
         with self._store.lock:
@@ -393,7 +402,9 @@ class Stream(GridObject):
             st: _StreamValue = e.value
             g["consumers"].add(consumer)
             out = []
-            for t in sorted(g["pending"]):
+            next_cursor = "0-0"
+            pending_sorted = sorted(g["pending"])
+            for i, t in enumerate(pending_sorted):
                 if t < lo:
                     continue
                 p = g["pending"][t]
@@ -407,7 +418,14 @@ class Stream(GridObject):
                 p["count"] += 1
                 out.append((_fmt_id(t), self._decode(f)))
                 if len(out) >= count:
+                    # Truncated: continue from the id AFTER this one.
+                    later = [u for u in pending_sorted[i + 1:]
+                             if u in g["pending"]]
+                    if later:
+                        next_cursor = _fmt_id(later[0])
                     break
+            if with_cursor:
+                return next_cursor, out
             return out
 
 

@@ -439,6 +439,8 @@ class RespServer:
                         if pending[0][0].upper() in (
                             b"BLPOP",
                             b"BRPOP",
+                            b"XREAD",       # BLOCK would hold earlier
+                            b"XREADGROUP",  # replies hostage
                             b"SUBSCRIBE",
                             b"UNSUBSCRIBE",
                         ):
@@ -2166,9 +2168,10 @@ class RespServer:
     @staticmethod
     def _parse_xread_opts(args, want_group: bool):
         """Shared XREAD/XREADGROUP option walk → (group, consumer,
-        count, block_s, keys, ids)."""
+        count, block_s, keys, ids, noack)."""
         group = consumer = None
         count = block_s = None
+        noack = False
         i = 0
         if want_group:
             if args[i].decode().upper() != "GROUP":
@@ -2187,7 +2190,8 @@ class RespServer:
                 block_s = int(args[i + 1]) / 1000.0 or float("inf")
                 i += 2
             elif opt == "NOACK":
-                i += 1  # delivered entries skip the PEL: accepted, minor
+                noack = True
+                i += 1
             elif opt == "STREAMS":
                 i += 1
                 break
@@ -2200,64 +2204,105 @@ class RespServer:
                 "an ID or '$' must be specified."
             )
         half = len(rest) // 2
-        return group, consumer, count, block_s, rest[:half], rest[half:]
+        return group, consumer, count, block_s, rest[:half], rest[half:], noack
 
-    def _cmdctx_XREAD(self, args, ctx: _ConnCtx):
-        _, _, count, block_s, keys, ids = self._parse_xread_opts(args, False)
-        if ctx.in_exec:
-            block_s = None  # like Redis: no blocking inside MULTI/EXEC
-        out = []
-        for k, start in zip(keys, ids):
-            entries = self._stream(k).read(
-                self._s(start), count,
-                block_seconds=block_s if len(keys) == 1 else None,
-            )
-            if entries:
-                out.append((k, entries))
+    @staticmethod
+    def _xread_reply(out) -> bytes:
         if not out:
             return b"*-1\r\n"  # nil: nothing new
         reply = b"*" + str(len(out)).encode() + b"\r\n"
         for k, entries in out:
             reply += (
                 b"*2\r\n" + _encode_bulk(k)
-                + self._stream_entries_reply(entries)
+                + RespServer._stream_entries_reply(entries)
             )
         return reply
 
+    def _cmdctx_XREAD(self, args, ctx: _ConnCtx):
+        import time as _time
+
+        _, _, count, block_s, keys, ids, _ = self._parse_xread_opts(
+            args, False
+        )
+        if ctx.in_exec:
+            block_s = None  # like Redis: no blocking inside MULTI/EXEC
+        # Resolve '$' ONCE, before any waiting: a blocked read must see
+        # entries added after THIS call, not chase the advancing tail.
+        starts = []
+        for k, sid in zip(keys, ids):
+            s_ = self._s(sid)
+            if s_ == "$":
+                s_ = self._stream(k).last_id()
+            starts.append(s_)
+        deadline = (
+            None if block_s is None else _time.monotonic() + block_s
+        )
+        grid = self._client._grid
+        while True:
+            out = []
+            for k, start in zip(keys, starts):
+                entries = self._stream(k).read(start, count)
+                if entries:
+                    out.append((k, entries))
+            if out or deadline is None:
+                return self._xread_reply(out)
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                return self._xread_reply([])
+            with grid.cond:  # woken by any XADD (store-wide notify)
+                grid.cond.wait(timeout=min(remaining, 1.0))
+
     def _cmdctx_XREADGROUP(self, args, ctx: _ConnCtx):
-        group, consumer, count, block_s, keys, ids = self._parse_xread_opts(
-            args, True
+        import time as _time
+
+        group, consumer, count, block_s, keys, ids, noack = (
+            self._parse_xread_opts(args, True)
         )
         if ctx.in_exec:
             block_s = None
-        out = []
-        for k, start in zip(keys, ids):
-            try:
-                entries = self._stream(k).read_group(
-                    group, consumer, count, self._s(start),
-                    block_seconds=block_s if len(keys) == 1 else None,
-                )
-            except ValueError as e:
-                if "NOGROUP" not in str(e):
-                    # e.g. an unparseable start id — not a missing group
+        starts = [self._s(sid) for sid in ids]
+        # Redis shape rules: '>' streams with nothing new are OMITTED;
+        # explicit-id streams always appear (possibly with an empty
+        # array) and make the command non-blocking.
+        any_explicit = any(s_ != ">" for s_ in starts)
+        deadline = (
+            None
+            if block_s is None or any_explicit
+            else _time.monotonic() + block_s
+        )
+        grid = self._client._grid
+        while True:
+            out = []
+            got_new = False
+            for k, start in zip(keys, starts):
+                try:
+                    entries = self._stream(k).read_group(
+                        group, consumer, count, start, noack=noack
+                    )
+                except ValueError as e:
+                    if "NOGROUP" not in str(e):
+                        # e.g. an unparseable start id, not a missing group
+                        raise RespError(
+                            "Invalid stream ID specified as stream "
+                            "command argument"
+                        ) from e
                     raise RespError(
-                        "Invalid stream ID specified as stream command "
-                        "argument"
+                        f"NOGROUP No such consumer group '{group}' for "
+                        f"key name '{self._s(k)}'"
                     ) from e
-                raise RespError(
-                    f"NOGROUP No such consumer group '{group}' for key "
-                    f"name '{self._s(k)}'"
-                ) from e
-            out.append((k, entries))
-        if not any(entries for _, entries in out):
-            return b"*-1\r\n"
-        reply = b"*" + str(len(out)).encode() + b"\r\n"
-        for k, entries in out:
-            reply += (
-                b"*2\r\n" + _encode_bulk(k)
-                + self._stream_entries_reply(entries)
-            )
-        return reply
+                if start == ">":
+                    if entries:
+                        out.append((k, entries))
+                        got_new = True
+                else:
+                    out.append((k, entries))
+            if got_new or any_explicit or deadline is None:
+                return self._xread_reply(out)
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                return self._xread_reply([])
+            with grid.cond:
+                grid.cond.wait(timeout=min(remaining, 1.0))
 
     def _cmd_XGROUP(self, args):
         sub = args[0].decode().upper()
@@ -2271,6 +2316,14 @@ class RespServer:
                     group, from_id, mkstream=mkstream
                 )
             except ValueError as e:
+                if "already exists" not in str(e):
+                    # unparseable start id — NOT a duplicate group (a
+                    # client treating BUSYGROUP as 'proceed' would then
+                    # hit NOGROUP, a state impossible on real Redis)
+                    raise RespError(
+                        "Invalid stream ID specified as stream command "
+                        "argument"
+                    ) from e
                 raise RespError(
                     "BUSYGROUP Consumer Group name already exists"
                 ) from e
@@ -2300,37 +2353,45 @@ class RespServer:
     def _cmd_XPENDING(self, args):
         s = self._stream(args[0])
         group = args[1].decode()
-        try:
-            if len(args) == 2:  # summary form
+        if len(args) == 2:  # summary form
+            try:
                 p = s.pending(group)
-                consumers = [
-                    [c.encode(), str(n).encode()]
-                    for c, n in p["consumers"].items()
-                ]
-                out = (
-                    b"*4\r\n" + _encode_int(p["total"])
-                    + _encode_bulk(p["lowest_id"])
-                    + _encode_bulk(p["highest_id"])
-                )
-                if consumers:
-                    out += b"*" + str(len(consumers)).encode() + b"\r\n"
-                    for pair in consumers:
-                        out += _encode_array(pair)
-                else:
-                    out += b"*-1\r\n"
-                return out
-            # range form: [IDLE ms] start end count [consumer]
-            i = 2
-            if args[i].decode().upper() == "IDLE":
-                i += 2  # minimum idle filter: accepted, applied as 0
-            start, end, count = self._s(args[i]), self._s(args[i + 1]), int(args[i + 2])
-            consumer = args[i + 3].decode() if len(args) > i + 3 else None
+            except ValueError as e:
+                raise self._nogroup(args[0], group, e) from e
+            consumers = [
+                [c.encode(), str(n).encode()]
+                for c, n in p["consumers"].items()
+            ]
+            out = (
+                b"*4\r\n" + _encode_int(p["total"])
+                + _encode_bulk(p["lowest_id"])
+                + _encode_bulk(p["highest_id"])
+            )
+            if consumers:
+                out += b"*" + str(len(consumers)).encode() + b"\r\n"
+                for pair in consumers:
+                    out += _encode_array(pair)
+            else:
+                out += b"*-1\r\n"
+            return out
+        # range form: [IDLE ms] start end count [consumer] — the int
+        # parses stay OUTSIDE the NOGROUP mapping (a malformed count on
+        # a live group is a value error, not a missing group).
+        i = 2
+        min_idle_ms = 0
+        if args[i].decode().upper() == "IDLE":
+            min_idle_ms = int(args[i + 1])
+            i += 2
+        start, end, count = (
+            self._s(args[i]), self._s(args[i + 1]), int(args[i + 2])
+        )
+        consumer = args[i + 3].decode() if len(args) > i + 3 else None
+        try:
             rows = s.pending_range(group, start, end, count, consumer)
         except ValueError as e:
-            raise RespError(
-                f"NOGROUP No such consumer group '{group}' for key name "
-                f"'{self._s(args[0])}'"
-            ) from e
+            raise self._nogroup(args[0], group, e) from e
+        if min_idle_ms:
+            rows = [r for r in rows if r["idle_ms"] >= min_idle_ms]
         out = b"*" + str(len(rows)).encode() + b"\r\n"
         for r in rows:
             out += (
@@ -2341,12 +2402,25 @@ class RespServer:
             )
         return out
 
+    def _nogroup(self, key: bytes, group: str, e: Exception) -> RespError:
+        """Map the grid's NOGROUP ValueError to the -NOGROUP code every
+        stock client keys on (the create-group-on-NOGROUP pattern)."""
+        if "NOGROUP" not in str(e):
+            raise e
+        return RespError(
+            f"NOGROUP No such consumer group '{group}' for key name "
+            f"'{self._s(key)}'"
+        )
+
     def _cmd_XCLAIM(self, args):
         s = self._stream(args[0])
-        claimed = s.claim(
-            args[1].decode(), args[2].decode(), int(args[3]),
-            *[self._s(a) for a in args[4:]],
-        )
+        try:
+            claimed = s.claim(
+                args[1].decode(), args[2].decode(), int(args[3]),
+                *[self._s(a) for a in args[4:]],
+            )
+        except ValueError as e:
+            raise self._nogroup(args[0], args[1].decode(), e) from e
         return self._stream_entries_reply(claimed)
 
     def _cmd_XAUTOCLAIM(self, args):
@@ -2364,18 +2438,22 @@ class RespServer:
                 i += 1
             else:
                 raise RespError("syntax error")
-        claimed = s.auto_claim(
-            args[1].decode(), args[2].decode(), int(args[3]),
-            self._s(args[4]), count,
-        )
-        # 7.0 reply: [next-cursor, entries, deleted-ids] — the scan is
-        # exhaustive here, so the next cursor is always the terminal 0-0.
+        try:
+            cursor, claimed = s.auto_claim(
+                args[1].decode(), args[2].decode(), int(args[3]),
+                self._s(args[4]), count, with_cursor=True,
+            )
+        except ValueError as e:
+            raise self._nogroup(args[0], args[1].decode(), e) from e
+        # 7.0 reply: [next-cursor, entries, deleted-ids].  The cursor is
+        # '0-0' only when the whole PEL was examined — a COUNT-truncated
+        # sweep returns the id to continue from (clients loop until 0-0).
         body = (
             _encode_array([eid for eid, _ in claimed])
             if justid  # bare ids, per the JUSTID contract
             else self._stream_entries_reply(claimed)
         )
-        return b"*3\r\n" + _encode_bulk(b"0-0") + body + b"*0\r\n"
+        return b"*3\r\n" + _encode_bulk(cursor.encode()) + body + b"*0\r\n"
 
     def _cmd_XINFO(self, args):
         sub = args[0].decode().upper()
@@ -2399,7 +2477,10 @@ class RespServer:
                 ])
             return out
         if sub == "CONSUMERS":
-            rows = s.list_consumers(args[2].decode())
+            try:
+                rows = s.list_consumers(args[2].decode())
+            except ValueError as e:
+                raise self._nogroup(args[1], args[2].decode(), e) from e
             out = b"*" + str(len(rows)).encode() + b"\r\n"
             for r in rows:
                 out += _encode_array([
@@ -2417,12 +2498,46 @@ class RespServer:
         return self._raw(Geo(self._s(key), self._client))
 
     def _cmd_GEOADD(self, args):
+        # [NX|XX] [CH] flags precede the lon/lat/member triples — a
+        # coordinate position can never BE a flag, so this walk is safe.
+        i = 1
+        nx = xx = ch = False
+        while i < len(args):
+            opt = args[i].decode("latin-1").upper()
+            if opt == "NX":
+                nx = True
+            elif opt == "XX":
+                xx = True
+            elif opt == "CH":
+                ch = True
+            else:
+                break
+            i += 1
+        if nx and xx:
+            raise RespError(
+                "XX and NX options at the same time are not compatible"
+            )
+        if (len(args) - i) % 3 != 0 or len(args) == i:
+            raise RespError("syntax error")
         entries = [
-            (float(args[i]), float(args[i + 1]), args[i + 2])
-            for i in range(1, len(args), 3)
+            (float(args[j]), float(args[j + 1]), args[j + 2])
+            for j in range(i, len(args), 3)
         ]
+        geo = self._geo(args[0])
         try:
-            return _encode_int(self._geo(args[0]).add_entries(*entries))
+            if not (nx or xx or ch):
+                return _encode_int(geo.add_entries(*entries))
+            added = changed = 0
+            with self._client._grid.lock:
+                for lon, lat, m in entries:
+                    existed = geo.pos(m) != {}
+                    if (nx and existed) or (xx and not existed):
+                        continue
+                    before = geo.pos(m).get(m)
+                    added += geo.add(lon, lat, m)
+                    if before != geo.pos(m).get(m):
+                        changed += 1
+            return _encode_int(changed if ch else added)
         except ValueError as e:
             raise RespError(f"invalid longitude,latitude pair ({e})") from e
 
@@ -2486,6 +2601,9 @@ class RespServer:
                 i += 1
             elif opt == "COUNT":
                 kw["count"] = int(args[i + 1])
+                if kw["count"] <= 0:
+                    # hits[:0] / hits[:-n] would silently drop members
+                    raise RespError("COUNT must be > 0")
                 i += 2
                 if i < n and args[i].decode().upper() == "ANY":
                     kw["count_any"] = True
@@ -2632,8 +2750,19 @@ class RespServer:
             return _encode_error(str(v))
         return _encode_bulk(str(v).encode())
 
+    @staticmethod
+    def _check_numkeys(numkeys: int, available: int) -> None:
+        if numkeys < 0:
+            raise RespError("Number of keys can't be negative")
+        if numkeys > available:
+            # a silent truncation would shift every ARGV by the deficit
+            raise RespError(
+                "Number of keys can't be greater than number of args"
+            )
+
     def _eval_common(self, source: str, args):
         numkeys = int(args[0])
+        self._check_numkeys(numkeys, len(args) - 1)
         keys = [self._s(a) for a in args[1 : 1 + numkeys]]
         argv = list(args[1 + numkeys :])
         return self._script_reply(self._run_script(source, keys, argv))
@@ -2676,6 +2805,11 @@ class RespServer:
                 int(a.decode().lower() in svc._sources) for a in args[1:]
             ])
         if sub == "FLUSH":
+            # Unregister from the ScriptService too — a flushed sha must
+            # not stay invokable through the Python API.
+            with svc._lock:
+                for sha in list(svc._sources):
+                    svc._fns.pop(sha, None)
             svc._sources.clear()
             return _encode_simple("OK")
         raise RespError(f"Unknown SCRIPT subcommand {sub}")
@@ -2774,6 +2908,7 @@ class RespServer:
         svc = self._client.get_function()
         name = args[0].decode()
         numkeys = int(args[1])
+        self._check_numkeys(numkeys, len(args) - 2)
         keys = [self._s(a) for a in args[2 : 2 + numkeys]]
         argv = list(args[2 + numkeys :])
         try:

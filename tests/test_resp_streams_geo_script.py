@@ -153,7 +153,7 @@ class TestRespGeo:
         _, c = stack
         self._load(c)
         pos = c.cmd("GEOPOS", "Sicily", "Palermo", "ghost")
-        assert abs(float(pos[0][0]) - self.PALERMO[0]) < 1e-6
+        assert abs(float(pos[0][0]) - self.PALERMO[0]) < 1e-4
         assert pos[1] is None
         d_m = float(c.cmd("GEODIST", "Sicily", "Palermo", "Catania"))
         d_km = float(c.cmd("GEODIST", "Sicily", "Palermo", "Catania", "km"))
@@ -182,7 +182,7 @@ class TestRespGeo:
         assert rows[0][0] == b"Catania"
         assert float(rows[0][1]) < 60  # ~56 km
         assert isinstance(rows[0][2], int)  # 52-bit hash
-        assert abs(float(rows[0][3][0]) - self.CATANIA[0]) < 1e-6
+        assert abs(float(rows[0][3][0]) - self.CATANIA[0]) < 1e-4
 
     def test_geosearchstore(self, stack):
         _, c = stack
@@ -370,3 +370,140 @@ class TestRespScripting:
             t.join()
         assert c.cmd("GET", "bal") == b"0"
         assert sorted(results) == list(range(0, 100))
+
+
+class TestHighSweepFixes:
+    """Regressions for the round-5 high-effort review sweep."""
+
+    def test_xread_block_multiple_streams(self, stack):
+        """BLOCK must work across >1 stream (it silently returned nil)."""
+        import threading
+        _, c = stack
+        got = []
+
+        def reader():
+            got.append(c.cmd("XREAD", "BLOCK", 5000, "STREAMS",
+                             "ms1", "ms2", "$", "$"))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        t.join(0.4)
+        assert t.is_alive()  # parked, not instant-nil
+        c2 = RespClient(c._sock.getpeername()[0], c._sock.getpeername()[1])
+        try:
+            c2.cmd("XADD", "ms2", "*", "f", "v")
+        finally:
+            c2.close()
+        t.join(10)
+        assert not t.is_alive()
+        assert got[0][0][0] == b"ms2"
+
+    def test_xreadgroup_noack_skips_pel(self, stack):
+        _, c = stack
+        c.cmd("XGROUP", "CREATE", "na", "g", "0", "MKSTREAM")
+        c.cmd("XADD", "na", "*", "f", "v")
+        out = c.cmd("XREADGROUP", "GROUP", "g", "w", "NOACK",
+                    "STREAMS", "na", ">")
+        assert out[0][0] == b"na" and len(out[0][1]) == 1
+        assert c.cmd("XPENDING", "na", "g")[0] == 0  # PEL stayed empty
+
+    def test_xreadgroup_explicit_id_empty_is_array_not_nil(self, stack):
+        _, c = stack
+        c.cmd("XGROUP", "CREATE", "ei", "g", "0", "MKSTREAM")
+        out = c.cmd("XREADGROUP", "GROUP", "g", "w", "STREAMS", "ei", "0")
+        assert out == [[b"ei", []]]  # Redis: array with empty list, not nil
+
+    def test_xautoclaim_cursor_continues_on_truncation(self, stack):
+        _, c = stack
+        c.cmd("XGROUP", "CREATE", "ac", "g", "0", "MKSTREAM")
+        ids = [c.cmd("XADD", "ac", "*", "i", str(i)) for i in range(5)]
+        c.cmd("XREADGROUP", "GROUP", "g", "w1", "STREAMS", "ac", ">")
+        cur, entries, _ = c.cmd("XAUTOCLAIM", "ac", "g", "w2", 0, "0-0",
+                                "COUNT", 2)
+        assert [e[0] for e in entries] == ids[:2]
+        assert cur != b"0-0"  # truncated sweep: NOT the terminal cursor
+        cur2, entries2, _ = c.cmd("XAUTOCLAIM", "ac", "g", "w2", 0, cur,
+                                  "COUNT", 10)
+        assert cur2 == b"0-0"
+        assert [e[0] for e in entries2] == ids[2:]
+
+    def test_xgroup_create_bad_id_not_busygroup(self, stack):
+        _, c = stack
+        c.cmd("XADD", "bg", "1-1", "f", "v")
+        with pytest.raises(RuntimeError, match="Invalid stream ID"):
+            c.cmd("XGROUP", "CREATE", "bg", "g", "notanid")
+
+    def test_xclaim_missing_group_is_nogroup_code(self, stack):
+        _, c = stack
+        c.cmd("XADD", "ng", "1-1", "f", "v")
+        with pytest.raises(RuntimeError, match="^NOGROUP"):
+            c.cmd("XCLAIM", "ng", "ghostgroup", "w", 0, "1-1")
+        with pytest.raises(RuntimeError, match="^NOGROUP"):
+            c.cmd("XAUTOCLAIM", "ng", "ghostgroup", "w", 0, "0-0")
+        with pytest.raises(RuntimeError, match="^NOGROUP"):
+            c.cmd("XINFO", "CONSUMERS", "ng", "ghostgroup")
+
+    def test_xpending_idle_filter_and_bad_count(self, stack):
+        _, c = stack
+        c.cmd("XGROUP", "CREATE", "pi", "g", "0", "MKSTREAM")
+        c.cmd("XADD", "pi", "*", "f", "v")
+        c.cmd("XREADGROUP", "GROUP", "g", "w", "STREAMS", "pi", ">")
+        # IDLE larger than elapsed: filtered out
+        assert c.cmd("XPENDING", "pi", "g", "IDLE", 60000, "-", "+", 10) == []
+        assert len(c.cmd("XPENDING", "pi", "g", "IDLE", 0, "-", "+", 10)) == 1
+        # malformed count on a LIVE group: not NOGROUP
+        with pytest.raises(RuntimeError) as ei:
+            c.cmd("XPENDING", "pi", "g", "-", "+", "notanum")
+        assert "NOGROUP" not in str(ei.value)
+
+    def test_eval_numkeys_validation(self, stack):
+        _, c = stack
+        with pytest.raises(RuntimeError, match="negative"):
+            c.cmd("EVAL", "1", -1, "a")
+        with pytest.raises(RuntimeError, match="greater"):
+            c.cmd("EVAL", "1", 3, "a")
+
+    def test_geoadd_nx_xx_ch(self, stack):
+        _, c = stack
+        assert c.cmd("GEOADD", "gf", "13.36", "38.11", "m1") == 1
+        # NX: existing member untouched
+        assert c.cmd("GEOADD", "gf", "NX", "15.08", "37.50", "m1") == 0
+        pos = c.cmd("GEOPOS", "gf", "m1")
+        assert abs(float(pos[0][0]) - 13.36) < 1e-4
+        # XX: new member not created
+        assert c.cmd("GEOADD", "gf", "XX", "15.08", "37.50", "m2") == 0
+        assert c.cmd("GEOPOS", "gf", "m2") == [None]
+        # CH counts coordinate changes
+        assert c.cmd("GEOADD", "gf", "CH", "15.08", "37.50", "m1") == 1
+        with pytest.raises(RuntimeError, match="not compatible"):
+            c.cmd("GEOADD", "gf", "NX", "XX", "1", "1", "m3")
+
+    def test_geosearch_nonpositive_count_errors(self, stack):
+        _, c = stack
+        c.cmd("GEOADD", "gc", "13.36", "38.11", "m1")
+        with pytest.raises(RuntimeError, match="COUNT"):
+            c.cmd("GEOSEARCH", "gc", "FROMLONLAT", "13", "38",
+                  "BYRADIUS", "500", "km", "COUNT", 0)
+
+    def test_script_flush_unregisters_python_side(self, stack):
+        client, c = stack
+        sha = c.cmd("SCRIPT", "LOAD", "7").decode()
+        assert client.get_script().eval(sha, [], []) == 7
+        c.cmd("SCRIPT", "FLUSH")
+        with pytest.raises(KeyError):
+            client.get_script().eval(sha, [], [])
+
+    def test_geo_key_is_a_zset(self, stack):
+        """Redis representation: geo keys ARE zsets with 52-bit cell
+        scores — ZRANGE/ZSCORE work on them, GEOSEARCHSTORE destinations
+        answer GEO reads."""
+        _, c = stack
+        c.cmd("GEOADD", "gz", "13.361389", "38.115556", "Palermo")
+        assert c.cmd("TYPE", "gz") == "zset"
+        assert int(float(c.cmd("ZSCORE", "gz", "Palermo"))) == 3479099956230698
+        c.cmd("GEOSEARCHSTORE", "gzd", "gz", "FROMLONLAT", "13.36", "38.11",
+              "BYRADIUS", "50", "km")
+        # the destination answers GEO reads (it used to WRONGTYPE)
+        out = c.cmd("GEOSEARCH", "gzd", "FROMLONLAT", "13.36", "38.11",
+                    "BYRADIUS", "50", "km")
+        assert out == [b"Palermo"]
