@@ -32,7 +32,9 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Iterable, Optional
 
-from redisson_tpu.grid.maps import MapCache
+from redisson_tpu.grid.maps import Map, MapCache
+
+_MISSING = object()
 
 
 class ExpiryPolicy:
@@ -180,13 +182,15 @@ class JCache(MapCache):
         if self._write_through:
             self._writer.write(key, value)
         with self._store.lock:
+            # STATIC Map.get: MapCache.put's `self.get` would dispatch
+            # to JCache.get — firing the CacheLoader (JSR forbids loads
+            # on getAndPut), returning the loaded value instead of None
+            # for absent keys, and counting phantom statistics.
+            prev = Map.get(self, key)
             kw = self._ttl_kwargs()
-            if (
-                self._expiry.update_ttl is not None
-                and super().contains_key(key)
-            ):
+            if self._expiry.update_ttl is not None and prev is not None:
                 kw["ttl_seconds"] = self._expiry.update_ttl
-            prev = super().put(key, value, **kw)
+            super().fast_put(key, value, **kw)
         if self.statistics is not None:
             self.statistics._put()
         return prev
@@ -201,12 +205,20 @@ class JCache(MapCache):
 
     def get_all(self, keys: Iterable[Any]) -> dict:
         keys = list(keys)
-        out = super().get_all(keys)
+        # STATIC Map.get per key: Map.get_all's `self.get` would
+        # dispatch to JCache.get, double-counting statistics and running
+        # the loader under the store lock.
+        out = {}
+        with self._store.lock:
+            for k in keys:
+                v = Map.get(self, k)
+                if v is not None:
+                    out[k] = v
         cached = set(out)  # stats: read-through loads count as misses
         if self._read_through:
             for k in keys:
                 if k not in out:
-                    v = self._loader(k)
+                    v = self._loader(k)  # outside the lock (slow I/O)
                     if v is not None:
                         super().fast_put(k, v, **self._ttl_kwargs())
                         out[k] = v
@@ -250,16 +262,28 @@ class JCache(MapCache):
         if self._write_through:
             self._writer.delete(key)
         with self._store.lock:
-            prev = super().get(key)
-            super().fast_remove(key)
+            # Map.remove (static): the removed EVENT must carry the old
+            # value, like JCache.remove — fast_remove would emit None.
+            prev = Map.remove(self, key)
         if prev is not None and self.statistics is not None:
             self.statistics._removal()
         return prev
 
-    def replace(self, key: Any, value: Any) -> bool:
-        """JSR-107: True iff the key existed."""
+    def replace(self, key: Any, *vals) -> bool:
+        """JSR-107 replace: ``replace(k, v)`` = True iff the key
+        existed; ``replace(k, old, new)`` = compare-and-replace (the
+        three-arg Cache contract — shadowing Map.replace with only the
+        two-arg form broke callers written against either surface)."""
+        if len(vals) == 1:
+            old, value = _MISSING, vals[0]
+        elif len(vals) == 2:
+            old, value = vals
+        else:
+            raise TypeError("replace(key, value) or replace(key, old, new)")
         with self._store.lock:
             if not super().contains_key(key):
+                return False
+            if old is not _MISSING and Map.get(self, key) != old:
                 return False
             kw = self._ttl_kwargs()
             if self._expiry.update_ttl is not None:

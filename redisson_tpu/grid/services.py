@@ -489,6 +489,18 @@ class Transaction(CamelCompatMixin):
         self._reads.clear()
         self._writes.clear()
 
+    def _register_read(self, table: dict, key: tuple, current_fn):
+        """First-read-wins snapshot registration: repeated reads of the
+        same key return the FIRST observation (repeatable reads within
+        the tx) and commit validates against it — re-registering on
+        every read would validate only the LAST observation, silently
+        accepting a concurrent write between two in-tx reads."""
+        if key in table:
+            return table[key]
+        cur = current_fn()
+        table[key] = cur
+        return cur
+
     def _current(self, name: str, kb: Optional[bytes]):
         e = self._store.get_entry(name)
         if e is None:
@@ -528,9 +540,12 @@ class _TxBucket:
         if self._local is not _MISSING:
             return None if self._local is None else self._codec.decode(self._local)
         with self._tx._store.lock:
-            e = self._tx._store.get_entry(self._name, "bucket")
-            snapshot = None if e is None else e.value
-            self._tx._reads[(self._name, None)] = snapshot
+            def current():
+                e = self._tx._store.get_entry(self._name, "bucket")
+                return None if e is None else e.value
+            snapshot = self._tx._register_read(
+                self._tx._reads, (self._name, None), current
+            )
             return None if snapshot is None else self._codec.decode(snapshot)
 
     def set(self, value) -> None:
@@ -565,8 +580,10 @@ class _TxMap:
             vb = self._local[kb]
             return None if vb is None else self._codec.decode(vb)
         with self._tx._store.lock:
-            cur = self._tx._current(self._name, kb)
-            self._tx._reads[(self._name, kb)] = cur
+            cur = self._tx._register_read(
+                self._tx._reads, (self._name, kb),
+                lambda: self._tx._current(self._name, kb),
+            )
             return None if cur is None else self._codec.decode(cur)
 
     def put(self, key, value) -> None:
@@ -615,9 +632,10 @@ class _TxSet:
         if kb in self._local:
             return self._local[kb]
         with self._tx._store.lock:
-            cur = bool(self._tx._current(self._name, kb))
-            self._tx._set_reads[(self._name, kb)] = cur
-            return cur
+            return self._tx._register_read(
+                self._tx._set_reads, (self._name, kb),
+                lambda: bool(self._tx._current(self._name, kb)),
+            )
 
     def add(self, value) -> bool:
         added = not self.contains(value)
@@ -664,10 +682,14 @@ class _TxList:
 
     def _snapshot(self) -> tuple:
         with self._tx._store.lock:
-            cur = self._tx._current(self._name, None)
-            cur = cur if isinstance(cur, tuple) else ()
-            self._tx._reads[(self._name, None)] = cur
-            return cur
+            # Snapshot None for an ABSENT key (commit-time _current also
+            # yields None there — storing () made every read of a
+            # not-yet-existing list fail validation spuriously).
+            cur = self._tx._register_read(
+                self._tx._reads, (self._name, None),
+                lambda: self._tx._current(self._name, None),
+            )
+            return cur if isinstance(cur, tuple) else ()
 
     def _view(self) -> list:
         """Snapshot with this tx's staged ops replayed — what reads see."""
@@ -743,9 +765,10 @@ class _TxScoredSortedSet:
         if kb in self._local:
             return self._local[kb]
         with self._tx._store.lock:
-            cur = self._tx._current_score(self._name, kb)
-            self._tx._score_reads[(self._name, kb)] = cur
-            return cur
+            return self._tx._register_read(
+                self._tx._score_reads, (self._name, kb),
+                lambda: self._tx._current_score(self._name, kb),
+            )
 
     def contains(self, member) -> bool:
         return self.get_score(member) is not None
@@ -941,29 +964,56 @@ class LiveObjectService(CamelCompatMixin):
     def _indexed_fields(self, cls_name: str):
         return self._client.get_set(f"live:{cls_name}:__indexed__")
 
+    def _value_key(self, value) -> str:
+        """Deterministic index-set key component: the CODEC bytes of the
+        value (repr() embedded memory addresses for objects with the
+        default repr, so removal/lookup could never find the add-time
+        set)."""
+        return self._client.config.codec.encode(value).hex()
+
     def _index_set(self, cls_name: str, field: str, value):
         return self._client.get_set(
-            f"live-idx:{cls_name}:{field}:{value!r}"
+            f"live-idx:{cls_name}:{field}:{self._value_key(value)}"
         )
 
     def persist(self, obj: Any, rid=None, index: tuple = ()) -> "LiveProxy":
         """Store a plain object's __dict__ and return its live proxy.
         ``index`` names fields to index (the @RIndex analog); indexed
-        fields stay maintained through proxy writes."""
+        fields stay maintained through proxy writes.  Marking a field
+        indexed BACKFILLS its index sets from every already-registered
+        instance, so the fast path never hides pre-index objects."""
         cls_name = type(obj).__name__
         rid = rid if rid is not None else getattr(obj, "id", None)
         if rid is None:
             raise ValueError("live object needs an 'id' attribute or rid=")
         m = self._map_for(cls_name, rid)
         indexed = self._indexed_fields(cls_name)
-        for f in index:
-            indexed.add(f)
-        idx_fields = set(indexed.read_all())
-        for k, v in vars(obj).items():
-            m.fast_put(k, v)
-            if k in idx_fields:
-                self._index_set(cls_name, k, v).add(rid)
-        self._registry(cls_name).add(rid)
+        with self._client._grid.lock:  # index + map mutate atomically
+            newly_indexed = [
+                f for f in index if not indexed.contains(f)
+            ]
+            for f in index:
+                indexed.add(f)
+            for f in newly_indexed:
+                # Backfill from the registry: objects persisted BEFORE
+                # the field became indexed must be findable too.
+                for other in self._registry(cls_name).read_all():
+                    if other == rid:
+                        continue
+                    v = self._map_for(cls_name, other).get(f)
+                    if v is not None:
+                        self._index_set(cls_name, f, v).add(other)
+            idx_fields = set(indexed.read_all())
+            for k, v in vars(obj).items():
+                if k in idx_fields:
+                    # Re-persist: drop the rid from the OLD value's set
+                    # first, or a changed field leaves a stale entry.
+                    old = m.get(k)
+                    if old is not None and old != v:
+                        self._index_set(cls_name, k, old).remove(rid)
+                    self._index_set(cls_name, k, v).add(rid)
+                m.fast_put(k, v)
+            self._registry(cls_name).add(rid)
         return LiveProxy(self._client, cls_name, rid, self)
 
     def get(self, cls_or_name, rid) -> "LiveProxy":
@@ -1032,20 +1082,25 @@ class LiveProxy:
 
     def __setattr__(self, item, value):
         svc, cls_name, rid = self._svc, self._cls_name, self._rid
-        if item in set(svc._indexed_fields(cls_name).read_all()):
-            old = self._map.get(item)
-            if old is not None and old != value:
-                svc._index_set(cls_name, item, old).remove(rid)
-            svc._index_set(cls_name, item, value).add(rid)
-        self._map.fast_put(item, value)
+        # One lock hold across read-old/move-index/write: two racing
+        # writers would otherwise both read the same old value and leave
+        # the rid ghost-indexed under both new values.
+        with self._map._store.lock:
+            if item in set(svc._indexed_fields(cls_name).read_all()):
+                old = self._map.get(item)
+                if old is not None and old != value:
+                    svc._index_set(cls_name, item, old).remove(rid)
+                svc._index_set(cls_name, item, value).add(rid)
+            self._map.fast_put(item, value)
 
     def __delattr__(self, item):
         svc, cls_name, rid = self._svc, self._cls_name, self._rid
-        if item in set(svc._indexed_fields(cls_name).read_all()):
-            old = self._map.get(item)
-            if old is not None:
-                svc._index_set(cls_name, item, old).remove(rid)
-        self._map.fast_remove(item)
+        with self._map._store.lock:
+            if item in set(svc._indexed_fields(cls_name).read_all()):
+                old = self._map.get(item)
+                if old is not None:
+                    svc._index_set(cls_name, item, old).remove(rid)
+            self._map.fast_remove(item)
 
 
 class MapReduce(CamelCompatMixin):

@@ -156,3 +156,107 @@ class TestTxListReadYourRemoves:
         assert tl.remove("temp") is True
         tx.commit()
         assert lst.read_all() == ["keep"]
+
+
+class TestGridSweepFixes:
+    """Regressions for the round-5 grid-side high-effort sweep."""
+
+    def test_txlist_on_absent_key_commits(self, client):
+        tx = client.create_transaction()
+        tl = tx.get_list("ghost-list")
+        assert tl.read_all() == [] and tl.size() == 0
+        tl.add("first")
+        tx.commit()  # used to abort spuriously: () vs None snapshot
+        assert client.get_list("ghost-list").read_all() == ["first"]
+
+    def test_txlist_repeatable_reads(self, client):
+        """The FIRST read is the validation snapshot — a concurrent
+        write between two in-tx reads must still abort the commit."""
+        from redisson_tpu.grid.services import TransactionException
+        lst = client.get_list("rr-list")
+        lst.add("a")
+        tx = client.create_transaction()
+        tl = tx.get_list("rr-list")
+        assert tl.read_all() == ["a"]
+        lst.add("intruder")
+        assert tl.read_all() == ["a"]  # repeatable: first snapshot view
+        tl.add("mine")
+        with pytest.raises(TransactionException, match="invalidated"):
+            tx.commit()
+
+    def test_txmap_repeatable_reads(self, client):
+        from redisson_tpu.grid.services import TransactionException
+        m = client.get_map("rr-map")
+        m.put("k", 1)
+        tx = client.create_transaction()
+        tm = tx.get_map("rr-map")
+        assert tm.get("k") == 1
+        m.put("k", 99)  # concurrent write between the two in-tx reads
+        assert tm.get("k") == 1  # repeatable
+        tm.put("other", 2)
+        with pytest.raises(TransactionException, match="invalidated"):
+            tx.commit()
+
+    def test_persist_repersist_moves_index(self, client):
+        svc = client.get_live_object_service()
+        p = Person(1, "ann", "NY")
+        svc.persist(p, index=("city",))
+        p.city = "LA"
+        svc.persist(p)  # re-persist with changed indexed field
+        assert svc.find_by_field(Person, "city", "NY") == []
+        assert [q._rid for q in svc.find_by_field(Person, "city", "LA")] == [1]
+
+    def test_index_backfills_preexisting_objects(self, client):
+        svc = client.get_live_object_service()
+        svc.persist(Person(10, "a", "SF"))          # not yet indexed
+        svc.persist(Person(11, "b", "SF"), index=("city",))  # now indexed
+        hits = sorted(q._rid for q in svc.find_by_field(Person, "city", "SF"))
+        assert hits == [10, 11]  # the pre-index object is found too
+
+
+class TestJCacheSweepFixes:
+    def test_get_and_put_never_loads(self, client):
+        from redisson_tpu.grid.jcache import CacheManager
+        loads = []
+        cache = CacheManager(client).create_cache(
+            "gp", cache_loader=lambda k: loads.append(k) or f"db:{k}",
+            read_through=True,
+        )
+        assert cache.get_and_put("k", "v") is None  # absent -> None
+        assert loads == []  # JSR: getAndPut must NOT load
+        assert cache.get_and_put("k", "v2") == "v"
+
+    def test_get_all_stats_counted_once(self, client):
+        from redisson_tpu.grid.jcache import CacheManager
+        cache = CacheManager(client).create_cache(
+            "ga", cache_loader=lambda k: k.upper(), read_through=True,
+            statistics_enabled=True,
+        )
+        cache.put("a", "cached")
+        cache.statistics.reset()
+        out = cache.get_all(["a", "b"])
+        assert out == {"a": "cached", "b": "B"}
+        s = cache.statistics
+        assert (s.hits, s.misses) == (1, 1)  # once each; load = miss
+
+    def test_three_arg_replace(self, client):
+        from redisson_tpu.grid.jcache import CacheManager
+        cache = CacheManager(client).create_cache("r3")
+        cache.put("k", "v1")
+        assert cache.replace("k", "wrong", "v2") is False
+        assert cache.get("k") == "v1"
+        assert cache.replace("k", "v1", "v2") is True
+        assert cache.get("k") == "v2"
+        assert cache.replace("k", "v3") is True  # 2-arg form still works
+
+    def test_get_and_remove_event_carries_value(self, client):
+        from redisson_tpu.grid.jcache import CacheManager
+        cache = CacheManager(client).create_cache("gr")
+        events = []
+        cache.register_cache_entry_listener(
+            lambda ev, k, v: events.append((ev, k, v)), event="removed"
+        )
+        cache.put("r1", "val1")
+        assert cache.get_and_remove("r1") == "val1"
+        client._topic_bus.drain()
+        assert events == [("removed", "r1", "val1")]
