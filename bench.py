@@ -105,7 +105,7 @@ def bench_bloom_contains(client):
     # an interleaved RT sample per pass: per-pass numbers + same-window
     # RT travel in extra so a drop is attributable (engine regression vs
     # link phase) from the JSON alone.
-    TOTAL = max(1 << 24, 4 * B)
+    TOTAL = 1 << 24  # flat: a deep-slow phase must not blow wall-clock
     iters = max(2, TOTAL // B)
     passes = []
     pass_rt_ms = []
@@ -415,8 +415,15 @@ def measure_device_kernel():
     """Engine attribution metric: the hot kernel timed with DEVICE-RESIDENT
     inputs (no H2D, no host round trip per iteration) — what the chip
     itself sustains.  The gap between this and the headline is, by
-    construction, the link (PROFILE.md: 20-50 µs kernels vs 10-330 ms
-    launch retirement on the tunnel)."""
+    construction, the link.
+
+    Iterations are CHAINED (each step's inputs derive from the previous
+    step's output) — repeated identical launches on this tunnel can be
+    memoized somewhere in the stack and report fictional throughput
+    (PROFILE.md r5: 10 identical 1M-op launches "completed" in 0.4 ms);
+    the data dependency forces genuine sequential execution.  Measured
+    honestly the kernel is GATHER-bound (k random word reads into the
+    38 MB row per key); the in-kernel murmur hash is nearly free."""
     import jax
     import jax.numpy as jnp
 
@@ -429,25 +436,38 @@ def measure_device_kernel():
     rng = np.random.default_rng(5)
     state = jax.device_put(jnp.zeros((wpr + 1,), jnp.uint32))
     rows = jax.device_put(jnp.zeros((B,), jnp.int32))
-    h1 = jax.device_put(jnp.asarray(rng.integers(0, 1 << 32, B, dtype=np.uint64).astype(np.uint32)))
-    h2 = jax.device_put(jnp.asarray(rng.integers(0, 1 << 32, B, dtype=np.uint64).astype(np.uint32)))
+    h1 = jax.device_put(jnp.asarray(rng.integers(0, m, B).astype(np.uint32)))
+    h2 = jax.device_put(jnp.asarray(rng.integers(0, m, B).astype(np.uint32)))
 
     @jax.jit
     def step(state, rows, h1, h2):
-        return bitops.pack_bool_u32(
+        out = bitops.pack_bool_u32(
             bloom_ops.bloom_contains(
                 state, rows, h1, h2, m=m, k=k, words_per_row=wpr
             )
         )
+        # Next inputs depend on THIS output: un-memoizable chain.
+        bump = (out[0] & jnp.uint32(1)) + jnp.uint32(1)
+        h1n = jnp.where(h1 + bump >= m, jnp.uint32(0), h1 + bump)
+        h2n = jnp.where(h2 + jnp.uint32(1) >= m, jnp.uint32(1),
+                        h2 + jnp.uint32(1))
+        return out, h1n, h2n
 
-    step(state, rows, h1, h2).block_until_ready()  # compile
-    iters = 30
+    out, h1, h2 = step(state, rows, h1, h2)
+    np.asarray(out)  # compile + settle (a FETCH forces real execution)
+    rt0 = measure_rt_sample() / 1000.0
+    iters = 10
     t0 = time.perf_counter()
-    out = None
     for _ in range(iters):
-        out = step(state, rows, h1, h2)
-    out.block_until_ready()
+        out, h1, h2 = step(state, rows, h1, h2)
+    # block_until_ready can return without real execution on this tunnel
+    # (even chained launches reported 38B ops/s) — only fetching result
+    # BYTES forces materialization of the whole chain.  One fetch per
+    # measurement; its round trip is subtracted using the same-window
+    # RT sample (floored at half, in case the phase shifted mid-run).
+    np.asarray(out)
     dt = time.perf_counter() - t0
+    dt = max(dt - rt0, dt / 2)
     return round(iters * B / dt)
 
 

@@ -33,6 +33,8 @@ Command surface (the subset the north-star objects + grid need):
                                           Lua VM; redis.call bridge)
   PUBLISH SUBSCRIBE UNSUBSCRIBE           (push replies; '>' on RESP3)
   AUTH HELLO CLIENT INFO COMMAND QUIT     (RESP2/RESP3, requirepass auth)
+  SELECT RESET CONFIG WAIT OBJECT DEBUG       (stock-client handshakes)
+  GETEX COPY LMOVE SINTERCARD LPOS HRANDFIELD ZRANDMEMBER
   MULTI EXEC DISCARD                                (contiguous-exec txn)
   KEYS SCAN DBSIZE FLUSHALL
 
@@ -508,10 +510,11 @@ class RespServer:
 
     def _dispatch(self, cmd: list[bytes], ctx: "_ConnCtx") -> bytes:
         name = cmd[0].decode().upper()
-        if not ctx.authed and name not in ("AUTH", "HELLO", "QUIT"):
-            # Pre-auth surface is AUTH/HELLO/QUIT only, like Redis.
+        if not ctx.authed and name not in ("AUTH", "HELLO", "QUIT", "RESET"):
+            # Pre-auth surface is AUTH/HELLO/QUIT/RESET, like Redis
+            # (pooled clients RESET connections before authenticating).
             raise RespError("NOAUTH Authentication required.")
-        if ctx.in_multi and name not in ("EXEC", "DISCARD", "MULTI"):
+        if ctx.in_multi and name not in ("EXEC", "DISCARD", "MULTI", "RESET"):
             # Redis MULTI semantics: commands queue (validated for
             # existence only) and run contiguously at EXEC.  Pub/sub
             # commands are rejected like Redis does — their push replies
@@ -594,6 +597,127 @@ class RespServer:
         # +OK then the read loop closes on the peer's FIN; also legal
         # pre-auth (part of the Redis unauthenticated surface).
         return _encode_simple("OK")
+
+    def _cmd_SELECT(self, args):
+        """One logical database (the engine's keyspace is flat): SELECT 0
+        succeeds for stock-client handshakes, other indexes error like a
+        databases=1 redis-server."""
+        if int(args[0]) != 0:
+            raise RespError("DB index is out of range")
+        return _encode_simple("OK")
+
+    def _cmdctx_RESET(self, args, ctx: _ConnCtx):
+        """→ Redis RESET: abort MULTI, drop subscriptions, revert to
+        RESP2 defaults, and de-authenticate when a password is set."""
+        ctx.in_multi = False
+        ctx.queued = []
+        for channel, lid in list(ctx.subs.items()):
+            self._client._topic_bus.unsubscribe(channel, lid)
+        ctx.subs.clear()
+        ctx.proto = 2
+        ctx.client_name = None
+        if self._requirepass:
+            ctx.authed = False
+        return _encode_simple("RESET")
+
+    # CONFIG: the handful of keys stock clients interrogate on connect.
+    # GET answers from this table; SET round-trips into it for the SAME
+    # keys only (anything else errors — silently acking unknown tunables
+    # would fake capabilities the engine does not have).
+    _CONFIG_KEYS = {
+        "maxmemory": "0",
+        "maxmemory-policy": "noeviction",
+        "save": "",
+        "appendonly": "no",
+        "databases": "1",
+        "timeout": "0",
+        "proto-max-bulk-len": "536870912",
+    }
+
+    def _cmd_CONFIG(self, args):
+        import fnmatch
+
+        sub = args[0].decode().upper()
+        if not hasattr(self, "_config_table"):
+            self._config_table = dict(self._CONFIG_KEYS)
+        if sub == "GET":
+            pat = args[1].decode().lower()
+            flat = []
+            for k, v in sorted(self._config_table.items()):
+                if fnmatch.fnmatch(k, pat):
+                    flat.extend([k.encode(), v.encode()])
+            return _encode_array(flat)
+        if sub == "SET":
+            pairs = args[1:]
+            if not pairs or len(pairs) % 2 != 0:
+                raise RespError(
+                    "wrong number of arguments for 'config|set' command"
+                )
+            # Validate EVERY pair before applying any (Redis 7 multi-pair
+            # form; acking while silently dropping pairs would fake
+            # capabilities).
+            for i in range(0, len(pairs), 2):
+                key = pairs[i].decode().lower()
+                if key not in self._config_table:
+                    raise RespError(
+                        f"Unknown option or number of arguments for "
+                        f"CONFIG SET - '{key}'"
+                    )
+            for i in range(0, len(pairs), 2):
+                self._config_table[pairs[i].decode().lower()] = (
+                    pairs[i + 1].decode()
+                )
+            return _encode_simple("OK")
+        if sub == "RESETSTAT":
+            return _encode_simple("OK")
+        raise RespError(f"Unknown CONFIG subcommand {sub}")
+
+    def _cmd_WAIT(self, args):
+        # Standalone server, no replicas: 0 acknowledged replicas is the
+        # honest Redis answer (writes are already locally durable).
+        return _encode_int(0)
+
+    def _cmd_DEBUG(self, args):
+        sub = args[0].decode().upper()
+        if sub == "SLEEP":
+            import time as _time
+
+            _time.sleep(float(args[1]))
+            return _encode_simple("OK")
+        raise RespError(f"unsupported DEBUG subcommand {sub}")
+
+    def _cmd_OBJECT(self, args):
+        """Minimal OBJECT surface (clients probe ENCODING for display):
+        one in-memory representation per kind, reported with the closest
+        Redis encoding name."""
+        sub = args[0].decode().upper()
+        if sub == "HELP":
+            return _encode_array([
+                b"OBJECT ENCODING|REFCOUNT|IDLETIME|FREQ <key>",
+            ])
+        if sub not in ("ENCODING", "REFCOUNT", "IDLETIME", "FREQ"):
+            raise RespError(f"Unknown OBJECT subcommand {sub}")
+        if len(args) < 2:
+            raise RespError(
+                "wrong number of arguments for 'object' command"
+            )
+        kind = self._kind_of(self._s(args[1]))
+        if kind is None:
+            raise RespError("no such key")
+        if sub == "ENCODING":
+            enc = {
+                "string": "embstr", "list": "quicklist",
+                "hash": "hashtable", "set": "hashtable",
+                "zset": "skiplist", "stream": "stream",
+            }.get(self._TYPE_NAMES.get(kind, kind), "embstr")
+            return _encode_bulk(enc.encode())
+        if sub == "REFCOUNT":
+            return _encode_int(1)
+        if sub == "IDLETIME":
+            return _encode_int(0)
+        if sub == "FREQ":
+            return _encode_int(0)
+        raise RespError(f"Unknown OBJECT subcommand {sub}")
 
     def _cmd_SCAN(self, args):
         """Cursor iteration with the Redis SCAN guarantee (keys present
@@ -838,6 +962,203 @@ class RespServer:
     def _cmd_ECHO(self, args):
         return _encode_bulk(args[0])
 
+    def _cmd_GETEX(self, args):
+        """GET that also adjusts the key's TTL (exactly ONE of
+        EX/PX/EXAT/PXAT/PERSIST; no option = plain GET without touching
+        expiry).  TTL mutation rides the GridStore expire helpers — the
+        same path EXPIRE/EXPIREAT/PERSIST use."""
+        if len(args) > 3:
+            raise RespError("syntax error")  # at most one expiry option
+        opt = args[1].decode().upper() if len(args) > 1 else None
+        operand = args[2] if len(args) > 2 else None
+        if opt in ("EX", "PX", "EXAT", "PXAT"):
+            if operand is None:
+                raise RespError("syntax error")
+        elif opt == "PERSIST":
+            if operand is not None:
+                raise RespError("syntax error")
+        elif opt is not None:
+            raise RespError("syntax error")
+        import time as _time
+
+        grid = self._client._grid
+        name = self._s(args[0])
+        with grid.lock:
+            v = self._str_get(args[0])
+            if v is None:
+                return _encode_bulk(None)
+            if opt == "EX":
+                grid.expire(name, float(operand))
+            elif opt == "PX":
+                grid.expire(name, float(operand) / 1000.0)
+            elif opt == "EXAT":
+                grid.expire_at(name, float(operand))
+            elif opt == "PXAT":
+                grid.expire_at(name, float(operand) / 1000.0)
+            elif opt == "PERSIST":
+                grid.clear_expire(name)
+        return _encode_bulk(v)
+
+    def _cmd_COPY(self, args):
+        """Grid-keyspace COPY (sketch-backend keys report 0 — their
+        state lives in device pools, not copyable entries).  Deep-copies
+        the value so the two keys never alias mutations."""
+        import copy as _copy
+
+        src, dst = self._s(args[0]), self._s(args[1])
+        if src == dst:
+            raise RespError(
+                "source and destination objects are the same"
+            )
+        replace = any(a.decode().upper() == "REPLACE" for a in args[2:])
+        grid = self._client._grid
+        with grid.lock:
+            e = grid.get_entry(src)
+            if e is None:
+                return _encode_int(0)
+            if not replace and grid.get_entry(dst) is not None:
+                return _encode_int(0)
+            ne = grid.put_entry(dst, e.kind, _copy.deepcopy(e.value))
+            ne.expire_at = e.expire_at
+            return _encode_int(1)
+
+    def _cmd_LMOVE(self, args):
+        """LMOVE src dst LEFT|RIGHT LEFT|RIGHT — the RPOPLPUSH
+        generalization, atomic under the grid lock."""
+        wherefrom = args[2].decode().upper()
+        whereto = args[3].decode().upper()
+        if wherefrom not in ("LEFT", "RIGHT") or whereto not in ("LEFT", "RIGHT"):
+            raise RespError("syntax error")
+        src, dst = self._list(args[0]), self._list(args[1])
+        grid = self._client._grid
+        with grid.lock:
+            # Destination kind check BEFORE popping (the pattern
+            # poll_last_and_offer_first_to uses): a WRONGTYPE destination
+            # discovered after the pop would lose the element.
+            de = grid.get_entry(self._s(args[1]))
+            if de is not None and de.kind not in ("list", "queue"):
+                raise TypeError(
+                    f"object {self._s(args[1])!r} holds a {de.kind}, "
+                    f"not a list"
+                )
+            v = (
+                src.poll_first() if wherefrom == "LEFT" else src.poll_last()
+            )
+            if v is None:
+                return _encode_bulk(None)
+            if whereto == "LEFT":
+                dst.add_first(v)
+            else:
+                dst.add_last(v)
+        return _encode_bulk(v)
+
+    def _cmd_SINTERCARD(self, args):
+        numkeys = int(args[0])
+        keys = args[1 : 1 + numkeys]
+        limit = None
+        if len(args) > 1 + numkeys:
+            if args[1 + numkeys].decode().upper() != "LIMIT":
+                raise RespError("syntax error")
+            limit = int(args[2 + numkeys])
+            if limit < 0:
+                raise RespError("LIMIT can't be negative")
+        with self._client._grid.lock:
+            acc = None
+            for k in keys:
+                members = set(self._set(k).read_all())
+                acc = members if acc is None else (acc & members)
+                if not acc:
+                    break
+        n = 0 if acc is None else len(acc)
+        if limit:  # LIMIT 0 = unlimited, like Redis
+            n = min(n, limit)
+        return _encode_int(n)
+
+    def _cmd_LPOS(self, args):
+        """LPOS key element [RANK r] [COUNT c]."""
+        rank, count = 1, None
+        i = 2
+        while i < len(args):
+            opt = args[i].decode().upper()
+            if opt == "RANK":
+                rank = int(args[i + 1])
+                if rank == 0:
+                    raise RespError("RANK can't be zero")
+                i += 2
+            elif opt == "COUNT":
+                count = int(args[i + 1])
+                if count < 0:
+                    raise RespError("COUNT can't be negative")
+                i += 2
+            else:
+                raise RespError("syntax error")
+        items = self._listidx(args[0]).read_all()
+        target = args[1]
+        matches = [ix for ix, v in enumerate(items) if v == target]
+        if rank < 0:
+            matches = list(reversed(matches))[(-rank - 1):]
+        else:
+            matches = matches[(rank - 1):]
+        if count is None:
+            return (
+                _encode_int(matches[0]) if matches else _encode_bulk(None)
+            )
+        if count == 0:
+            return _encode_array(matches)
+        return _encode_array(matches[:count])
+
+    def _cmd_HRANDFIELD(self, args):
+        import random
+
+        entries = self._map(args[0]).entry_set()
+        if len(args) == 1:
+            if not entries:
+                return _encode_bulk(None)
+            return _encode_bulk(random.choice(entries)[0])
+        count = int(args[1])
+        withvalues = (
+            len(args) > 2 and args[2].decode().upper() == "WITHVALUES"
+        )
+        if count >= 0:  # distinct fields, up to the hash size
+            picked = random.sample(entries, min(count, len(entries)))
+        else:  # negative: repeats allowed, exactly |count| results
+            picked = (
+                [random.choice(entries) for _ in range(-count)]
+                if entries else []
+            )
+        flat = []
+        for f, v in picked:
+            flat.append(f)
+            if withvalues:
+                flat.append(v)
+        return _encode_array(flat)
+
+    def _cmd_ZRANDMEMBER(self, args):
+        import random
+
+        entries = self._zset(args[0]).entry_range(0, -1)
+        if len(args) == 1:
+            if not entries:
+                return _encode_bulk(None)
+            return _encode_bulk(random.choice(entries)[0])
+        count = int(args[1])
+        withscores = (
+            len(args) > 2 and args[2].decode().upper() == "WITHSCORES"
+        )
+        if count >= 0:
+            picked = random.sample(entries, min(count, len(entries)))
+        else:
+            picked = (
+                [random.choice(entries) for _ in range(-count)]
+                if entries else []
+            )
+        flat = []
+        for m, sc in picked:
+            flat.append(m)
+            if withscores:
+                flat.append(_fmt_score(sc).encode())
+        return _encode_array(flat)
+
     def _cmd_KEYS(self, args):
         pattern = self._s(args[0]) if args else "*"
         return _encode_array(self._client.get_keys().get_keys(pattern))
@@ -1046,6 +1367,18 @@ class RespServer:
             return _encode_bulk(ctx.client_name)
         if sub == "ID":
             return _encode_int(id(ctx) & 0x7FFFFFFF)
+        if sub == "SETINFO":
+            # redis-py 5.x sends CLIENT SETINFO lib-name/lib-ver on every
+            # connect; acknowledge (the metadata has no server-side use).
+            return _encode_simple("OK")
+        if sub == "INFO":
+            name = ctx.client_name or ""
+            return _encode_bulk(
+                f"id={id(ctx) & 0x7FFFFFFF} name={name} "
+                f"resp={ctx.proto}".encode()
+            )
+        if sub == "NO-EVICT" or sub == "NO-TOUCH":
+            return _encode_simple("OK")
         raise RespError(f"unsupported CLIENT subcommand {sub}")
 
     def _cmd_COMMAND(self, args):

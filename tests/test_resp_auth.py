@@ -129,3 +129,23 @@ class TestScriptsOnLockedServer:
             ) == b"sv"
         finally:
             c.close()
+
+    def test_reset_allowed_pre_auth(self, locked):
+        c = RespClient(locked.host, locked.port)
+        try:
+            assert c.cmd("RESET") == "RESET"  # pooled-client pattern
+            with pytest.raises(RuntimeError, match="NOAUTH"):
+                c.cmd("PING")
+        finally:
+            c.close()
+
+    def test_reset_deauthenticates(self, locked):
+        c = RespClient(locked.host, locked.port)
+        try:
+            assert c.cmd("AUTH", PW) == "OK"
+            assert c.cmd("PING") == "PONG"
+            assert c.cmd("RESET") == "RESET"
+            with pytest.raises(RuntimeError, match="NOAUTH"):
+                c.cmd("PING")  # RESET dropped the auth
+        finally:
+            c.close()

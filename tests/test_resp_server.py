@@ -855,3 +855,131 @@ class TestWidenedSurface:
         with pytest.raises(RuntimeError, match="out of range"):
             resp.cmd("SPOP", "srs", "-1")
         assert len(resp.cmd("SPOP", "srs", "10")) == 2  # oversized: all
+
+
+class TestHandshakeAndModernCommands:
+    """Round-5: handshake commands stock clients send on connect
+    (SELECT/CONFIG/RESET/WAIT) + the modern command set."""
+
+    def test_select_only_db0(self, resp):
+        assert resp.cmd("SELECT", 0) == "OK"
+        with pytest.raises(RuntimeError, match="out of range"):
+            resp.cmd("SELECT", 3)
+
+    def test_config_get_set(self, resp):
+        rows = resp.cmd("CONFIG", "GET", "maxmemory")
+        assert rows == [b"maxmemory", b"0"]
+        assert resp.cmd("CONFIG", "SET", "maxmemory", "100mb") == "OK"
+        assert resp.cmd("CONFIG", "GET", "maxmemory") == [b"maxmemory", b"100mb"]
+        rows = resp.cmd("CONFIG", "GET", "maxmemory*")
+        assert b"maxmemory-policy" in rows
+        with pytest.raises(RuntimeError, match="Unknown option"):
+            resp.cmd("CONFIG", "SET", "bogus-key", "1")
+
+    def test_reset(self, resp):
+        assert resp.cmd("MULTI") == "OK"
+        assert resp.cmd("RESET") == "RESET"
+        # MULTI state discarded: EXEC now errors
+        with pytest.raises(RuntimeError, match="without MULTI"):
+            resp.cmd("EXEC")
+
+    def test_wait_standalone(self, resp):
+        assert resp.cmd("WAIT", 0, 100) == 0
+
+    def test_object_encoding(self, resp):
+        resp.cmd("SET", "oe", "v")
+        assert resp.cmd("OBJECT", "ENCODING", "oe") == b"embstr"
+        resp.cmd("RPUSH", "ol", "a")
+        assert resp.cmd("OBJECT", "ENCODING", "ol") == b"quicklist"
+        assert resp.cmd("OBJECT", "REFCOUNT", "oe") == 1
+
+    def test_getex(self, resp):
+        resp.cmd("SET", "ge", "v")
+        assert resp.cmd("GETEX", "ge", "EX", 100) == b"v"
+        assert 0 < resp.cmd("TTL", "ge") <= 100
+        assert resp.cmd("GETEX", "ge", "PERSIST") == b"v"
+        assert resp.cmd("TTL", "ge") == -1
+        assert resp.cmd("GETEX", "missing") is None
+
+    def test_copy(self, resp):
+        resp.cmd("SET", "c1", "v1")
+        assert resp.cmd("COPY", "c1", "c2") == 1
+        assert resp.cmd("GET", "c2") == b"v1"
+        resp.cmd("SET", "c1", "v2")
+        assert resp.cmd("GET", "c2") == b"v1"  # deep copy: no aliasing
+        assert resp.cmd("COPY", "c1", "c2") == 0  # dest exists
+        assert resp.cmd("COPY", "c1", "c2", "REPLACE") == 1
+        assert resp.cmd("GET", "c2") == b"v2"
+
+    def test_lmove(self, resp):
+        resp.cmd("RPUSH", "lsrc", "a", "b", "c")
+        assert resp.cmd("LMOVE", "lsrc", "ldst", "LEFT", "RIGHT") == b"a"
+        assert resp.cmd("LMOVE", "lsrc", "ldst", "RIGHT", "LEFT") == b"c"
+        assert resp.cmd("LRANGE", "ldst", 0, -1) == [b"c", b"a"]
+        assert resp.cmd("LRANGE", "lsrc", 0, -1) == [b"b"]
+        assert resp.cmd("LMOVE", "empty", "ldst", "LEFT", "LEFT") is None
+
+    def test_sintercard(self, resp):
+        resp.cmd("SADD", "si1", "a", "b", "c")
+        resp.cmd("SADD", "si2", "b", "c", "d")
+        assert resp.cmd("SINTERCARD", 2, "si1", "si2") == 2
+        assert resp.cmd("SINTERCARD", 2, "si1", "si2", "LIMIT", 1) == 1
+
+    def test_lpos(self, resp):
+        resp.cmd("RPUSH", "lp", "a", "b", "c", "b", "b")
+        assert resp.cmd("LPOS", "lp", "b") == 1
+        assert resp.cmd("LPOS", "lp", "b", "RANK", 2) == 3
+        assert resp.cmd("LPOS", "lp", "b", "RANK", -1) == 4
+        assert resp.cmd("LPOS", "lp", "b", "COUNT", 0) == [1, 3, 4]
+        assert resp.cmd("LPOS", "lp", "zz") is None
+
+    def test_hrandfield_zrandmember(self, resp):
+        resp.cmd("HSET", "hr", "f1", "v1", "f2", "v2")
+        assert resp.cmd("HRANDFIELD", "hr") in (b"f1", b"f2")
+        got = resp.cmd("HRANDFIELD", "hr", 2, "WITHVALUES")
+        assert len(got) == 4
+        assert len(resp.cmd("HRANDFIELD", "hr", -5)) == 5  # repeats ok
+        resp.cmd("ZADD", "zr", 1, "m1", 2, "m2")
+        assert resp.cmd("ZRANDMEMBER", "zr") in (b"m1", b"m2")
+        got = resp.cmd("ZRANDMEMBER", "zr", 2, "WITHSCORES")
+        assert len(got) == 4
+
+    def test_lmove_wrongtype_dest_keeps_element(self, resp):
+        resp.cmd("RPUSH", "lmsrc", "a")
+        resp.cmd("HSET", "lmdst", "f", "v")
+        with pytest.raises(RuntimeError, match="WRONGTYPE"):
+            resp.cmd("LMOVE", "lmsrc", "lmdst", "LEFT", "RIGHT")
+        assert resp.cmd("LRANGE", "lmsrc", 0, -1) == [b"a"]  # not lost
+
+    def test_sintercard_negative_limit_errors(self, resp):
+        resp.cmd("SADD", "snl", "a")
+        with pytest.raises(RuntimeError, match="negative"):
+            resp.cmd("SINTERCARD", 1, "snl", "LIMIT", -1)
+
+    def test_config_set_multi_pair(self, resp):
+        assert resp.cmd("CONFIG", "SET", "maxmemory", "1mb",
+                        "appendonly", "yes") == "OK"
+        assert resp.cmd("CONFIG", "GET", "appendonly") == [b"appendonly", b"yes"]
+        with pytest.raises(RuntimeError, match="Unknown option"):
+            resp.cmd("CONFIG", "SET", "maxmemory", "2mb", "bogus", "1")
+        # all-or-nothing: the valid pair before the bogus one not applied
+        assert resp.cmd("CONFIG", "GET", "maxmemory") == [b"maxmemory", b"1mb"]
+
+    def test_getex_strict_options(self, resp):
+        resp.cmd("SET", "gx", "v")
+        with pytest.raises(RuntimeError, match="syntax"):
+            resp.cmd("GETEX", "gx", "EX", 10, "PERSIST")
+        with pytest.raises(RuntimeError, match="syntax"):
+            resp.cmd("GETEX", "gx", "EX")
+        with pytest.raises(RuntimeError, match="syntax"):
+            resp.cmd("GETEX", "gx", "BOGUS")
+
+    def test_object_help_and_unknown(self, resp):
+        assert isinstance(resp.cmd("OBJECT", "HELP"), list)
+        with pytest.raises(RuntimeError, match="Unknown OBJECT"):
+            resp.cmd("OBJECT", "BOGUS", "k")
+
+    def test_copy_same_key_errors(self, resp):
+        resp.cmd("SET", "cs", "v")
+        with pytest.raises(RuntimeError, match="same"):
+            resp.cmd("COPY", "cs", "cs", "REPLACE")
