@@ -34,10 +34,13 @@ the batch's LazyResult.
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
 import queue
 import threading
 import time
 from collections import deque
+
+from redisson_tpu.executor.tpu_executor import defer_host_fetch
 from concurrent.futures import Future
 from typing import Callable, Optional
 
@@ -381,16 +384,19 @@ class BatchCoalescer:
                 c[0] if len(c) == 1 else np.concatenate(c)
                 for c in zip(*seg.chunks)
             ]
-            # Mailbox engines: skip the per-launch eager D2H prefetch —
-            # the completer resolves results through collect_group's ONE
-            # grouped fetch, and on the tunnel each extra host-bound
-            # transfer costs a full round trip in slow phases.
-            from redisson_tpu.executor.tpu_executor import defer_host_fetch
-            import contextlib
-
+            # Mailbox engines: skip the per-launch eager D2H prefetch
+            # when a completion BACKLOG exists (the completer will scoop
+            # a group and fetch once) — each extra host-bound transfer
+            # costs a full round trip in slow phases.  With an empty
+            # completion queue no group will form, and the eager copy is
+            # exactly the overlap that hides the fetch RT for the lone
+            # result, so keep it then.
             fetch_ctx = (
                 defer_host_fetch()
-                if self._group_collect is not None
+                if (
+                    self._group_collect is not None
+                    and self._completions.qsize() > 0
+                )
                 else contextlib.nullcontext()
             )
             lazy = None

@@ -254,12 +254,13 @@ class TpuCommandExecutor:
         Falls back silently per-item for results that are not device
         arrays (host engine, None payloads).
 
-        Note: each LazyResult still issued its own fire-and-forget
-        ``copy_to_host_async`` at creation; those transfers are packed
-        result bits (~1 bit/op, KBs) and cost link BYTES, not the
-        per-fetch ROUND TRIP this path eliminates — redundant but
-        harmless next to the 0.2ms-2.5s fetch RT they avoid paying
-        G times."""
+        Note on eager prefetches: a LazyResult created OUTSIDE a
+        defer_host_fetch region issued its own fire-and-forget
+        ``copy_to_host_async`` at creation (redundant but harmless
+        here); one created INSIDE such a region deferred it — grouped
+        members resolve via the single fetch below, and singleton-sig
+        stragglers get their async copy kicked off in the loop so they
+        overlap instead of serializing one round trip each."""
         by_sig: dict = {}
         for l in lazies:
             # Unwrap MappedFuture-style adapters (objects/base.py): the
@@ -285,19 +286,35 @@ class TpuCommandExecutor:
                 by_sig.setdefault((l._value.dtype, l._value.shape), []).append(l)
         for (dtype, shape), group in by_sig.items():
             if len(group) < 2:
-                continue  # a lone result fetches itself at .result() time
+                # A lone result fetches itself at .result() time — but
+                # its eager D2H may have been SUPPRESSED (defer_host_
+                # fetch), so start the transfer now: with several
+                # singleton sigs in one collect call, the async copies
+                # overlap instead of serializing one round trip each.
+                for l in group:
+                    try:
+                        l._value.copy_to_host_async()
+                    except Exception:
+                        pass
+                continue
             # Multi-round device-side concat tree: rounds of ≤8-ary
             # concats collapse the WHOLE group to one flat array, so a
             # group of ANY size costs exactly ONE D2H fetch — ops-per-
             # sync scales with the caller's group, not with a fixed
             # concat arity (a 32-launch pass used to take 4 fetches;
-            # at 263 ms/fetch RT that alone capped the headline).  Each
-            # round's compile key is the tuple of its operand shapes:
-            # round 1 sees one (dtype, shape, ≤8) combo, later rounds a
-            # couple of grown shapes — the cached-program space stays
-            # small while arity is unbounded.
+            # at 263 ms/fetch RT that alone capped the headline).
+            # Compile-key discipline: a round longer than 8 pads itself
+            # to a MULTIPLE of 8 by repeating the last value, so every
+            # non-final concat is exactly 8-ary over one uniform shape —
+            # the cached-program space is (dtype, level_shape, 8) plus a
+            # ≤7-ary final concat per level, NOT one program per
+            # ordered-shape-tuple (those compile 30-60s each on the
+            # tunnel, never evicted).  Duplicated pad results are
+            # sliced off at resolution.
             vals = [l._value for l in group]
             while len(vals) > 1:
+                if len(vals) > 8 and len(vals) % 8:
+                    vals = vals + [vals[-1]] * (8 - len(vals) % 8)
                 nxt = []
                 for start in range(0, len(vals), 8):
                     chunk = vals[start : start + 8]
@@ -307,7 +324,8 @@ class TpuCommandExecutor:
                     key = (
                         "mailbox",
                         dtype.name,
-                        tuple(tuple(map(int, x.shape)) for x in chunk),
+                        tuple(map(int, chunk[0].shape)),
+                        len(chunk),
                     )
 
                     def build():
@@ -625,13 +643,27 @@ class TpuCommandExecutor:
     def bloom_add_keys_st(self, pool, row: int, m: int, k: int, blocks, lengths) -> LazyResult:
         """Single-tenant add from raw codec lanes — murmur + 64-bit mod run
         in-kernel (ops/fastpath.py device-hash path), so the host ships only
-        the key bytes."""
+        the key bytes.
+
+        ``newly`` semantics on this fast (non-exact) path are
+        snapshot-vs-pre-batch for batches within one scan chunk; across
+        chunks of a huge batch they become chunk-sequential (a duplicate
+        in a LATER chunk observes the earlier chunk's bits and reports
+        False) — strictly MORE accurate, and within the fast path's
+        documented approximation.  ``exact_add_semantics`` remains the
+        mode for exact per-op sequential results."""
         B = blocks.shape[0]
         Bp = self._bucket(B)
         blocks, L = self._trim_lanes(blocks)
         Lt = blocks.shape[1]
         wpr = pool.row_units
         const_len = bool(B == 0 or np.all(lengths == lengths[0]))
+        if Bp > _SCAN_CHUNK and Bp % _SCAN_CHUNK:
+            # Round huge buckets UP to a chunk multiple (a custom
+            # min_bucket need not be a power of two): the scan guarantee
+            # must hold for EVERY huge launch — un-chunked multi-million
+            # -op device-hash kernels fail compile on HBM.
+            Bp = ((Bp // _SCAN_CHUNK) + 1) * _SCAN_CHUNK
         key = ("bloom_add_keys", wpr, pool.state.shape[0], Bp, k, L, Lt, const_len)
 
         def build():
@@ -642,9 +674,7 @@ class TpuCommandExecutor:
                 )
                 return new, bitops.pack_bool_u32(newly)
 
-            if Bp <= _SCAN_CHUNK or Bp % _SCAN_CHUNK:
-                # Non-multiple buckets (a custom min_bucket need not be a
-                # power of two) cannot reshape into chunks: single launch.
+            if Bp <= _SCAN_CHUNK:
                 return one
 
             nc = Bp // _SCAN_CHUNK
@@ -700,6 +730,12 @@ class TpuCommandExecutor:
         Lt = blocks.shape[1]
         wpr = pool.row_units
         const_len = bool(B == 0 or np.all(lengths == lengths[0]))
+        if Bp > _SCAN_CHUNK and Bp % _SCAN_CHUNK:
+            # Round huge buckets UP to a chunk multiple (a custom
+            # min_bucket need not be a power of two): the scan guarantee
+            # must hold for EVERY huge launch — un-chunked multi-million
+            # -op device-hash kernels fail compile on HBM.
+            Bp = ((Bp // _SCAN_CHUNK) + 1) * _SCAN_CHUNK
         key = ("bloom_contains_keys", wpr, pool.state.shape[0], Bp, k, L, Lt, const_len)
 
         def build():
@@ -709,9 +745,7 @@ class TpuCommandExecutor:
                     k=k, words_per_row=wpr, target_lanes=L,
                 ))
 
-            if Bp <= _SCAN_CHUNK or Bp % _SCAN_CHUNK:
-                # Non-multiple buckets (a custom min_bucket need not be a
-                # power of two) cannot reshape into chunks: single launch.
+            if Bp <= _SCAN_CHUNK:
                 return one
 
             nc = Bp // _SCAN_CHUNK
@@ -755,6 +789,12 @@ class TpuCommandExecutor:
         blocks, L = self._trim_lanes(blocks)
         Lt = blocks.shape[1]
         const_len = bool(B == 0 or np.all(lengths == lengths[0]))
+        if Bp > _SCAN_CHUNK and Bp % _SCAN_CHUNK:
+            # Round huge buckets UP to a chunk multiple (a custom
+            # min_bucket need not be a power of two): the scan guarantee
+            # must hold for EVERY huge launch — un-chunked multi-million
+            # -op device-hash kernels fail compile on HBM.
+            Bp = ((Bp // _SCAN_CHUNK) + 1) * _SCAN_CHUNK
         key = ("hll_add_keys", pool.state.shape[0], Bp, L, Lt, const_len)
 
         def build():
@@ -763,9 +803,7 @@ class TpuCommandExecutor:
                     state, row, blocks, lengths, valid, target_lanes=L
                 )
 
-            if Bp <= _SCAN_CHUNK or Bp % _SCAN_CHUNK:
-                # Non-multiple buckets (a custom min_bucket need not be a
-                # power of two) cannot reshape into chunks: single launch.
+            if Bp <= _SCAN_CHUNK:
                 return one
 
             nc = Bp // _SCAN_CHUNK

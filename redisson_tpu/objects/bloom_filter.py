@@ -120,14 +120,28 @@ class BloomFilter(RObject):
         SINGLE launch (host concat → one H2D → one scan-chunked kernel →
         one fetch): membership is read-only, so splitting the result
         back per batch is exact, and the whole group costs three link
-        transfers however many batches ride it."""
-        import numpy as np
-
+        transfers however many batches ride it.  The single-launch form
+        requires a route to the scan-chunked ``*_keys_st`` kernels (or
+        the host engine) — the coalesced/replicated ``bloom_mixed_keys``
+        path has no scan chunking, and a multi-million-op un-chunked
+        device-hash launch fails compile on HBM — so those engines keep
+        the per-batch pipelined form."""
         from redisson_tpu.executor.tpu_executor import defer_host_fetch
 
         batches = list(batches)
+        eng = self._engine
+        executor = getattr(eng, "executor", None)
+        single_launch_ok = (
+            getattr(eng, "coalescer", None) is None
+            and not self.is_replicated()
+            and (
+                executor is None  # host engine: one vectorized call
+                or getattr(executor, "supports_device_hash", False)
+            )
+        )
         if (
-            len(batches) > 1
+            single_launch_ok
+            and len(batches) > 1
             and all(
                 isinstance(b, np.ndarray)
                 and b.ndim == 1
@@ -142,7 +156,9 @@ class BloomFilter(RObject):
             out = []
             off = 0
             for b in batches:
-                out.append(flat[off : off + len(b)])
+                # .copy(): a view would pin the whole flat result for as
+                # long as any ONE batch's slice is retained.
+                out.append(flat[off : off + len(b)].copy())
                 off += len(b)
             return out
         with defer_host_fetch():  # no per-launch D2H: ONE grouped fetch

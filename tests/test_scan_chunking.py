@@ -119,3 +119,82 @@ class TestScanChunkedHll:
             assert h2.count() == est_chunked
         finally:
             tpu_executor._SCAN_CHUNK = 1 << 12
+
+
+class TestExecutorSweepFixes:
+    """Regressions for the round-5 executor high-effort sweep."""
+
+    def test_contains_many_on_coalescing_engine(self, small_chunks):
+        """The host-concat single-launch path must NOT engage on a
+        coalescing engine (its mixed-keys kernel has no scan chunking);
+        the pipelined per-batch form must still produce exact results."""
+        c = redisson_tpu.create(
+            Config().use_tpu_sketch(min_bucket=64, coalesce=True,
+                                    batch_window_us=200)
+        )
+        try:
+            bf = c.get_bloom_filter("cm-coal")
+            bf.try_init(50_000, 0.01)
+            keys = np.arange(4096, dtype=np.uint64)
+            bf.add_all(keys)
+            batches = [keys[i : i + 512] for i in range(0, 4096, 512)]
+            results = bf.contains_many(batches)
+            assert all(bool(np.all(r)) for r in results)
+        finally:
+            c.shutdown()
+
+    def test_non_multiple_min_bucket_rounds_to_chunk(self, small_chunks):
+        """A custom min_bucket that is not a multiple of the scan chunk
+        must still take the chunked path (rounded UP), never the giant
+        single launch."""
+        c = redisson_tpu.create(
+            Config().use_tpu_sketch(min_bucket=(1 << 12) + 96,
+                                    coalesce=False,
+                                    exact_add_semantics=False)
+        )
+        try:
+            bf = c.get_bloom_filter("cm-odd")
+            bf.try_init(50_000, 0.01)
+            keys = np.arange(1 << 13, dtype=np.uint64)
+            bf.add_all(keys)
+            assert bool(np.all(bf.contains_each(keys)))
+        finally:
+            c.shutdown()
+
+    def test_collect_group_odd_sizes_resolve_exact(self, client):
+        """Groups whose size is not a power of 8 exercise the padded
+        concat tree (duplicated pad results sliced off at resolution)."""
+        bf = client.get_bloom_filter("cg-odd")
+        bf.try_init(50_000, 0.01)
+        loaded = np.arange(10_000, dtype=np.uint64)
+        bf.add_all(loaded)
+        from redisson_tpu.executor.tpu_executor import defer_host_fetch
+
+        for g in (2, 3, 7, 9, 10, 17):
+            batches = [
+                np.arange(i * 256, (i + 1) * 256, dtype=np.uint64)
+                for i in range(g)
+            ]
+            with defer_host_fetch():
+                futs = [bf.contains_all_async(b) for b in batches]
+            results = client.collect(futs)
+            assert len(results) == g
+            for b, r in zip(batches, results):
+                want = b < 10_000
+                np.testing.assert_array_equal(np.asarray(r), want)
+
+    def test_collect_mixed_sizes_singleton_sigs(self, client):
+        """Different batch sizes -> singleton signature groups: collect
+        must still resolve every future correctly (async prefetch path)."""
+        bf = client.get_bloom_filter("cg-mixed")
+        bf.try_init(50_000, 0.01)
+        bf.add_all(np.arange(5000, dtype=np.uint64))
+        from redisson_tpu.executor.tpu_executor import defer_host_fetch
+
+        sizes = [64, 200, 700, 1500]
+        batches = [np.arange(s, dtype=np.uint64) for s in sizes]
+        with defer_host_fetch():
+            futs = [bf.contains_all_async(b) for b in batches]
+        results = client.collect(futs)
+        for b, r in zip(batches, results):
+            np.testing.assert_array_equal(np.asarray(r), b < 5000)
