@@ -660,6 +660,21 @@ def main():
                     "p50_batch_ms": metrics.get("p50_wait_ms"),
                     "p99_batch_ms": metrics.get("p99_wait_ms"),
                     "p99_flush_ms": metrics.get("p99_flush_ms"),
+                    # In-framework observability snapshot (ISSUE 1): the
+                    # perf trajectory carries latency BREAKDOWNS, not
+                    # just throughput — per-command p50/p99 from the
+                    # lifecycle-span histograms plus batch occupancy, so
+                    # a BENCH_rN drop is attributable to a specific op
+                    # path from the JSON alone.
+                    "metrics_snapshot": {
+                        "per_command": metrics.get("ops"),
+                        "mean_batch_occupancy": metrics.get(
+                            "mean_batch_occupancy"
+                        ),
+                        "p50_wait_ms": metrics.get("p50_wait_ms"),
+                        "p99_wait_ms": metrics.get("p99_wait_ms"),
+                        "tenants_tracked": len(metrics.get("tenants", {})),
+                    },
                     "measured_fpp": round(fpp, 5),
                     "host_engine_ops_per_sec": (
                         None if host_ops is None else round(host_ops)

@@ -50,6 +50,11 @@ def main(argv=None) -> int:
         help="require AUTH before any command (also settable via the "
         "config file's requirepass key)",
     )
+    p.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve the Prometheus text exposition on this port at "
+        "/metrics (docs/observability.md); omitted = no endpoint",
+    )
     args = p.parse_args(argv)
 
     import redisson_tpu
@@ -87,6 +92,15 @@ def main(argv=None) -> int:
         max_connections=args.max_connections,
         idle_timeout_s=args.idle_timeout_s,
     )
+    metrics_srv = None
+    if args.metrics_port is not None:
+        metrics_srv = client.start_metrics_endpoint(
+            host=args.host, port=args.metrics_port
+        )
+        print(
+            f"metrics on http://{metrics_srv.host}:{metrics_srv.port}/metrics",
+            flush=True,
+        )
     stop = threading.Event()
 
     def on_signal(signum, frame):

@@ -74,6 +74,11 @@ class RedissonTpuClient(CamelCompatMixin):
             self._engine = TpuSketchEngine(config)
         else:
             self._engine = HostSketchEngine(config)
+        # Observability bundle (obs package): OWNED by the engine (its
+        # coalescer/executor instrumentation must work standalone),
+        # referenced here so the RESP front door and the Prometheus
+        # endpoint record into / render from the same registry.
+        self.obs = getattr(self._engine, "obs", None)
         self._grid = GridStore()
         # One logical keyspace across both backends (ADVICE r2): creating
         # an object under a name the other backend holds is WRONGTYPE.
@@ -516,9 +521,70 @@ class RedissonTpuClient(CamelCompatMixin):
         return self._engine.names(kind)
 
     def get_metrics(self) -> dict:
-        """Coalescer/batch metrics snapshot (SURVEY.md §5 metrics row)."""
+        """Coalescer/batch metrics snapshot (SURVEY.md §5 metrics row).
+
+        The original flat keys (ops_total, p99_wait_ms, ...) are
+        unchanged; ISSUE 1 grows the dict with nested views:
+
+        - ``ops``: per engine-op-type latency/throughput (p50/p99 from
+          the lifecycle-span histograms);
+        - ``commands``: per RESP command calls/usec (populated when a
+          RespServer fronts this client);
+        - ``tenants``: ops submitted per named sketch object;
+        - ``slowlog_len``: current slow-op ring occupancy.
+        """
         m = getattr(self._engine, "metrics", None)
-        return {} if m is None else m.snapshot()
+        out = {} if m is None else m.snapshot()
+        obs = self.obs
+        if obs is not None:
+            out["ops"] = obs.op_stats()
+            out["commands"] = obs.command_stats()
+            out["tenants"] = obs.tenant_stats()
+            out["slowlog_len"] = len(obs.slowlog)
+        return out
+
+    def render_prometheus(self) -> str:
+        """Full Prometheus text exposition: the legacy aggregate metrics
+        (typed counter/gauge) plus every labeled family and health gauge
+        in the obs registry."""
+        parts = []
+        m = getattr(self._engine, "metrics", None)
+        if m is not None:
+            parts.append(m.render_prometheus())
+        if self.obs is not None:
+            parts.append(self.obs.registry.render_prometheus())
+        return "".join(parts)
+
+    def start_metrics_endpoint(self, host: str = "127.0.0.1", port: int = 0):
+        """Start (or return the already-running) Prometheus scrape
+        endpoint serving :meth:`render_prometheus` at ``/metrics``."""
+        from redisson_tpu.obs.promhttp import MetricsHTTPServer
+
+        with self._services_lock:
+            srv = getattr(self, "_metrics_http", None)
+            if srv is not None:
+                # Never silently hand back a server bound elsewhere than
+                # the caller asked for — the requested scrape target
+                # would not exist and nothing would surface the mismatch.
+                # Compared against BOTH the resolved bind address and the
+                # originally requested host, so repeating the same
+                # unresolved name ("localhost") is not a conflict.
+                req_host, _ = self._metrics_http_req
+                if port not in (0, srv.port) or host not in (
+                    srv.host, req_host
+                ):
+                    raise RuntimeError(
+                        "metrics endpoint already running on "
+                        f"{srv.host}:{srv.port}; close it before "
+                        f"rebinding to {host}:{port}"
+                    )
+                return srv
+            srv = MetricsHTTPServer(
+                self.render_prometheus, host=host, port=port
+            )
+            self._metrics_http = srv
+            self._metrics_http_req = (host, port)
+            return srv
 
     def get_profiler(self):
         """→ jax.profiler device-trace capture (SURVEY.md §5 tracing
@@ -553,6 +619,9 @@ class RedissonTpuClient(CamelCompatMixin):
         """→ Redisson#shutdown."""
         if getattr(self, "_failure_monitor", None) is not None:
             self._failure_monitor.stop()
+        if getattr(self, "_metrics_http", None) is not None:
+            self._metrics_http.close()
+            self._metrics_http = None
         if self.config.snapshot_dir and getattr(
             self._engine, "snapshot_extra", None
         ) is None:

@@ -44,6 +44,7 @@ from redisson_tpu.executor.tpu_executor import defer_host_fetch
 from concurrent.futures import Future
 from typing import Callable, Optional
 
+import jax  # already a transitive import (tpu_executor): free here
 import numpy as np
 
 from redisson_tpu.executor.failures import (
@@ -54,10 +55,18 @@ from redisson_tpu.executor.failures import (
 )
 
 
+def _op_label(key) -> str:
+    """Human label for a segment key (keys are tuples whose first element
+    names the op path, e.g. ("bloom_mix", id(pool), k))."""
+    if isinstance(key, tuple) and key and isinstance(key[0], str):
+        return key[0]
+    return "op"
+
+
 class _Segment:
     __slots__ = (
         "key", "pool_key", "dispatch", "chunks", "metas", "futures",
-        "nops", "born",
+        "nops", "born", "span",
     )
 
     def __init__(self, key, pool_key, dispatch):
@@ -70,9 +79,15 @@ class _Segment:
         # key length) travel ONCE per chunk instead of once per op — the
         # dispatch expands them device-side.  None for plain segments.
         self.metas: Optional[list] = None
-        self.futures: list[tuple[Future, int, int]] = []  # (future, start, n)
+        # (future, start, n, tenant): tenant rides the tuple the submit
+        # path already appends — zero extra hot-path work; the completer
+        # turns it into per-tenant counters (obs.tenant_ops).
+        self.futures: list[tuple] = []
         self.nops = 0
         self.born = time.monotonic()
+        # Lifecycle span (obs/spans.py): one per LAUNCH, not per op, so
+        # the producer-side submit path pays one object per segment.
+        self.span = None
 
 
 class HintedFuture:
@@ -112,10 +127,14 @@ class BatchCoalescer:
                  max_inflight: int = 8, retry_attempts: int = 3,
                  retry_interval_s: float = 0.05, max_queued_ops: int = 0,
                  adaptive_inflight: bool = True, min_inflight: int = 2,
-                 group_collect: Optional[Callable] = None):
+                 group_collect: Optional[Callable] = None, obs=None):
         self.window_s = batch_window_us / 1e6
         self.max_batch = max_batch
         self.metrics = metrics
+        # Observability bundle (obs/__init__.py): per-launch lifecycle
+        # spans (submit -> coalesce-wait -> device-dispatch -> D2H-fetch)
+        # and the TraceAnnotation that correlates them with device traces.
+        self.obs = obs
         # RedisExecutor-style retry budget for dispatch-time failures
         # (executor/failures.py): state is not consumed when the executor
         # method raises synchronously, so re-dispatch is safe.
@@ -187,7 +206,8 @@ class BatchCoalescer:
 
     # -- producer side -----------------------------------------------------
 
-    def submit(self, key, dispatch: Callable, arrays: tuple, nops: int, pool_key=None, meta=None) -> Future:
+    def submit(self, key, dispatch: Callable, arrays: tuple, nops: int,
+               pool_key=None, meta=None, tenant=None) -> Future:
         """Queue ``nops`` ops (column arrays in ``arrays``) for the segment
         identified by ``key``; returns a Future of the per-op result slice.
 
@@ -242,6 +262,8 @@ class BatchCoalescer:
                 or seg.nops + nops > self.max_batch
             ):
                 seg = _Segment(key, pool_key, dispatch)
+                if self.obs is not None:
+                    seg.span = self.obs.spans.start(_op_label(key))
                 if meta is not None:
                     seg.metas = []
                 self._open[key] = seg
@@ -253,7 +275,7 @@ class BatchCoalescer:
             seg.chunks.append(arrays)
             if meta is not None:
                 seg.metas.append((nops, meta))
-            seg.futures.append((fut, seg.nops, nops))
+            seg.futures.append((fut, seg.nops, nops, tenant))
             seg.nops += nops
             self._queued_ops += nops
             if seg.nops >= self.max_batch:
@@ -294,11 +316,13 @@ class BatchCoalescer:
                 break
             self._pop_locked()
             self._inflight -= 1  # merged segs dispatch as one launch
+            if nxt.span is not None:
+                nxt.span.abandon()  # its ops ride the head's span
             head.chunks.extend(nxt.chunks)
             if head.metas is not None:
                 head.metas.extend(nxt.metas)
-            for fut, start, n in nxt.futures:
-                head.futures.append((fut, head.nops + start, n))
+            for fut, start, n, tenant in nxt.futures:
+                head.futures.append((fut, head.nops + start, n, tenant))
             head.nops += nxt.nops
         return head
 
@@ -376,10 +400,12 @@ class BatchCoalescer:
             if seg.dispatch is None:  # barrier segment (drain)
                 with self._lock:
                     self._inflight -= 1
-                for fut, _, _ in seg.futures:
+                for fut, _, _, _ in seg.futures:
                     if fut.set_running_or_notify_cancel():
                         fut.set_result(None)
                 return
+            if seg.span is not None:
+                seg.span.stamp("coalesce_wait")  # queue time ends here
             cols = [
                 c[0] if len(c) == 1 else np.concatenate(c)
                 for c in zip(*seg.chunks)
@@ -399,11 +425,22 @@ class BatchCoalescer:
                 )
                 else contextlib.nullcontext()
             )
+            if self.obs is not None:
+                # Correlates the host span's device-dispatch phase with
+                # the device trace: the annotation names the region in a
+                # jax.profiler capture (docs/observability.md).  A fresh
+                # annotation per attempt — the name is built once.
+                ann_name = "rtpu:dispatch:" + _op_label(seg.key)
+
+                def _ann():
+                    return jax.profiler.TraceAnnotation(ann_name)
+            else:
+                _ann = contextlib.nullcontext
             lazy = None
             last_err: Optional[BaseException] = None
             for attempt in range(self.retry_attempts):
                 try:
-                    with fetch_ctx:
+                    with fetch_ctx, _ann():
                         if seg.metas is not None:
                             lazy = seg.dispatch(cols, seg.metas)
                         else:
@@ -425,6 +462,8 @@ class BatchCoalescer:
                         time.sleep(self.retry_interval_s * (attempt + 1))
             if last_err is not None:
                 raise RetryExhaustedError(self.retry_attempts, last_err)
+            if seg.span is not None:
+                seg.span.stamp("device_dispatch")  # enqueue done, async
             with self._lock:
                 # Dispatched (device-ordered): drain() may proceed even
                 # though result transfer is still in flight.
@@ -435,7 +474,11 @@ class BatchCoalescer:
                 if self._inflight > 0:
                     self._inflight -= 1
             self._release_launch_slot(None)
-            for fut, start, n in seg.futures:
+            if seg.span is not None:
+                seg.span.nops = seg.nops
+                seg.span.stamp("device_dispatch")
+                seg.span.finish(error=True)
+            for fut, start, n, _ in seg.futures:
                 if fut.set_running_or_notify_cancel():
                     fut.set_exception(
                         e
@@ -485,7 +528,18 @@ class BatchCoalescer:
                         genuine=genuine,
                     )
                     first = False
-                    for fut, start, n in seg.futures:
+                    if seg.span is not None:
+                        seg.span.nops = seg.nops
+                        seg.span.stamp("d2h_fetch")
+                        seg.span.finish()
+                    if self.obs is not None:
+                        # Per-tenant accounting, deferred from submit to
+                        # HERE so producers never pay the counter lock.
+                        op = _op_label(seg.key)
+                        for _, _, n, tenant in seg.futures:
+                            if tenant is not None:
+                                self.obs.tenant_ops.inc((tenant, op), n)
+                    for fut, start, n, _ in seg.futures:
                         if fut.set_running_or_notify_cancel():
                             fut.set_result(
                                 None if res is None else res[start : start + n]
@@ -496,7 +550,11 @@ class BatchCoalescer:
                     # range within the failed launch (partial-batch surface).
                     self._release_launch_slot(None)
                     first = False
-                    for fut, start, n in seg.futures:
+                    if seg.span is not None:
+                        seg.span.nops = seg.nops
+                        seg.span.stamp("d2h_fetch")
+                        seg.span.finish(error=True)
+                    for fut, start, n, _ in seg.futures:
                         if fut.set_running_or_notify_cancel():
                             fut.set_exception(
                                 KernelExecutionError(
@@ -524,7 +582,7 @@ class BatchCoalescer:
                 return
             barrier = object()  # unique key: never merged into
             seg = _Segment(barrier, barrier, None)
-            seg.futures.append((fut, 0, 0))
+            seg.futures.append((fut, 0, 0, None))
             self._order.append(seg)
             self._hurry = True  # the caller is about to block on it
             self._wake.notify()

@@ -56,7 +56,8 @@ class _Partition:
     for tenant-sharded pools (see ``from_rows``), word-block for m-sharded
     bitmaps."""
 
-    __slots__ = ("S", "B", "Bp", "order", "sh_sorted", "slot", "lrows", "valid")
+    __slots__ = ("S", "B", "Bp", "order", "sh_sorted", "slot", "lrows",
+                 "valid", "counts")
 
     @classmethod
     def from_rows(cls, S: int, rows, bucket_fn) -> "_Partition":
@@ -72,6 +73,7 @@ class _Partition:
         self.lrows = None
         self.order = np.argsort(shard, kind="stable")
         counts = np.bincount(shard, minlength=S)
+        self.counts = counts  # per-shard op counts (obs shard dimension)
         self.Bp = bucket_fn(int(counts.max()) if self.B else 1)
         self.sh_sorted = shard[self.order]
         offsets = np.zeros(S, np.int64)
@@ -185,7 +187,10 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         return fn
 
     def _part(self, rows) -> _Partition:
-        return _Partition.from_rows(self.S, rows, self._bucket)
+        p = _Partition.from_rows(self.S, rows, self._bucket)
+        if self.obs is not None:  # per-shard routing counts (obs registry)
+            self.obs.record_shard_counts(p.counts)
+        return p
 
     # -- m-sharded bitset pools (config 3): rows at/above the word
     # threshold split their words contiguously across shards ---------------
@@ -210,6 +215,8 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         idx = np.asarray(idx, np.int64)
         shard = (idx >> 5) // WL
         p = _Partition(self.S, shard, self._bucket)
+        if self.obs is not None:
+            self.obs.record_shard_counts(p.counts)
         lidx = (idx - shard * (WL * 32)).astype(np.uint32)
         return p, lidx
 

@@ -21,6 +21,7 @@ the same dispatch methods.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -171,6 +172,14 @@ class TpuCommandExecutor:
     # Single-device layout supports the *_keys_st device-hash kernels; the
     # sharded executor routes encoded batches through the host hash instead.
     supports_device_hash = True
+    # Observability wiring (engine sets these): ``metrics`` is the legacy
+    # Metrics aggregate, set ONLY when no coalescer fronts this executor
+    # (the coalescer records the same ops_total/batches_total itself —
+    # both recording would double-count); ``obs`` is the labeled
+    # registry bundle, always set, recording per-method dispatch
+    # counts/latency that are distinct from the coalescer's series.
+    metrics = None
+    obs = None
     # Run-length segment metadata (bloom_mixed_keys_runs): single-device
     # only — the sharded executor's partition-by-owner dispatch reorders
     # ops before expansion, so it keeps the per-op-array path.
@@ -1229,10 +1238,34 @@ class TpuCommandExecutor:
         pool.state = fn(pool.state, row, jnp.asarray(data))
 
 
+def _nops_of(name: str, args) -> int:
+    """Best-effort op count of a dispatch call: the longest sized
+    operand after the pool (the per-op column — rows for multi-tenant
+    methods, hash/key columns for the *_st fast paths whose args[1] is
+    a scalar row).  str/bytes args (opcode names) never count, and
+    write_row's data payload is a row image, not an op batch."""
+    if name == "write_row":
+        return 1
+    best = 1
+    for a in args[1:]:
+        if isinstance(a, (str, bytes)):
+            continue
+        try:
+            n = len(a)
+        except TypeError:
+            continue
+        if n > best:
+            best = n
+    return best
+
+
 def _locked(fn):
     import functools
 
     from redisson_tpu.executor.failures import ExecutorRetiredError
+
+    name = fn.__name__
+    annotation = "rtpu:" + name  # device-trace label (one str, not per call)
 
     @functools.wraps(fn)
     def wrapper(self, *args, **kwargs):
@@ -1251,14 +1284,43 @@ def _locked(fn):
             if getattr(self, "_retired", False):
                 succ = getattr(self, "_successor", None)
                 if succ is not None and not (
-                    fn.__name__.endswith("_runs")
+                    name.endswith("_runs")
                     and not getattr(succ, "supports_runs_metadata", False)
                 ):
-                    return getattr(succ, fn.__name__)(*args, **kwargs)
+                    # The successor's own wrapper records its metrics.
+                    return getattr(succ, name)(*args, **kwargs)
                 raise ExecutorRetiredError(
                     f"{type(self).__name__} was retired by a topology change"
                 )
-            return fn(self, *args, **kwargs)
+            obs, metrics = self.obs, self.metrics
+            if obs is None and metrics is None:
+                return fn(self, *args, **kwargs)
+            if getattr(self, "_dispatch_recording", False):
+                # Nested wrapped call (an *_st fast path delegating to
+                # bloom_add, zero_row -> write_row, ...): the OUTERMOST
+                # wrapper records; recording here too would double-count
+                # launches and ops.  Safe as a plain attribute — we hold
+                # the reentrant dispatch lock on this thread.
+                return fn(self, *args, **kwargs)
+            self._dispatch_recording = True
+            t0 = time.monotonic()
+            try:
+                # Named region in a jax.profiler capture: device trace
+                # rows correlate with host spans/histograms by op name.
+                with jax.profiler.TraceAnnotation(annotation):
+                    out = fn(self, *args, **kwargs)
+            finally:
+                self._dispatch_recording = False
+            dur = time.monotonic() - t0
+            nops = _nops_of(name, args)
+            if metrics is not None:
+                # Direct-dispatch path (no coalescer in front): this is
+                # the only recorder, so sharded/coalesce=False runs no
+                # longer report zero ops (ISSUE 1 satellite).
+                metrics.record_dispatch(nops=nops, enqueue_s=dur)
+            if obs is not None:
+                obs.record_dispatch(name, nops, dur)
+            return out
 
     return wrapper
 

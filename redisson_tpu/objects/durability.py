@@ -504,6 +504,11 @@ class SketchDurabilityMixin:
                 # this object — swapping it would split the mutual
                 # exclusion domain.
                 new_exec._dispatch_lock = old_exec._dispatch_lock
+                # Observability continuity: the successor keeps recording
+                # into the same registry/aggregate (a reshard must not
+                # silently zero the op counters).
+                new_exec.obs = old_exec.obs
+                new_exec.metrics = old_exec.metrics
                 entries = self.registry.entries()
                 # Phase 1 — PURE: compose every pool's new-layout array and
                 # free list host-side; nothing is mutated until all pools

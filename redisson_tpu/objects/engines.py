@@ -280,6 +280,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
             dispatch_lock=self.executor._dispatch_lock,
         )
         self.metrics = Metrics()
+        # Labeled observability bundle (obs package): per-tenant/op
+        # counters, lifecycle spans, slowlog, health gauges.  Shared by
+        # the coalescer, the executor, the client facade, and any RESP
+        # server fronting this client.
+        from redisson_tpu.obs import Observability
+
+        self.obs = Observability()
+        self.executor.obs = self.obs
         self.topk = TopKStore()
         # Wired by the client to the grid store's ``exists`` — one logical
         # keyspace across both backends (WRONGTYPE on cross-backend reuse).
@@ -308,7 +316,15 @@ class TpuSketchEngine(SketchDurabilityMixin):
                     and jax.process_count() == 1
                     else None
                 ),
+                obs=self.obs,
             )
+        else:
+            # Direct-dispatch mode: the executor is the only recorder of
+            # ops_total/batches_total (with a coalescer in front, the
+            # coalescer records them — both would double-count).  Fixes
+            # sharded/coalesce=False runs reporting zero ops.
+            self.executor.metrics = self.metrics
+        self._register_health_gauges()
         # Checkpoint/resume (SURVEY.md §5): restore device state from the
         # configured snapshot dir, then arm periodic snapshots.
         if config.snapshot_dir:
@@ -335,6 +351,72 @@ class TpuSketchEngine(SketchDurabilityMixin):
                         config.snapshot_dir, config.snapshot_interval_s
                     )
 
+    def _register_health_gauges(self) -> None:
+        """Executor-health gauges, sampled at scrape/snapshot time (ISSUE
+        1 tentpole part 4): queue depth, in-flight window, completion
+        backlog, tenant/pool occupancy, per-device memory."""
+        reg = self.obs.registry
+        c = self.coalescer
+        if c is not None:
+            reg.gauge_callback(
+                "rtpu_coalescer_queued_ops",
+                "ops queued ahead of the flush thread",
+                lambda: c._queued_ops,
+            )
+            reg.gauge_callback(
+                "rtpu_inflight_launches",
+                "dispatched-but-uncollected launches",
+                lambda: c._uncollected,
+            )
+            reg.gauge_callback(
+                "rtpu_inflight_limit",
+                "adaptive (AIMD) in-flight launch window",
+                lambda: c._inflight_limit,
+            )
+            reg.gauge_callback(
+                "rtpu_completion_backlog",
+                "launches awaiting the completer thread",
+                lambda: c._completions.qsize(),
+            )
+
+        def _tenant_counts():
+            return {
+                (k,): v
+                for k, v in self.registry.stats()["tenants_by_kind"].items()
+            }
+
+        def _pool_rows():
+            out = {}
+            for key, st in self.registry.stats()["pools"].items():
+                kind = key[0]
+                cls = "x".join(str(x) for x in key[1:]) or "-"
+                out[(kind, cls, "used")] = st["used_rows"]
+                out[(kind, cls, "capacity")] = st["capacity"]
+            return out
+
+        def _devmem():
+            from redisson_tpu.serve.metrics import Profiler
+
+            out = {}
+            for dev, stats in Profiler.device_memory().items():
+                for stat, v in (stats or {}).items():
+                    if v is not None:
+                        out[(dev, stat)] = v
+            return out
+
+        reg.gauge_callback(
+            "rtpu_tenants", "registered sketch tenants by kind",
+            _tenant_counts, labelnames=("kind",),
+        )
+        reg.gauge_callback(
+            "rtpu_pool_rows", "size-class pool rows by kind/class/state",
+            _pool_rows, labelnames=("kind", "class", "state"),
+        )
+        reg.gauge_callback(
+            "rtpu_device_memory_bytes", "per-device memory stats",
+            _devmem, labelnames=("device", "stat"),
+        )
+
     def shutdown(self) -> None:
         self._stop_snapshotter()
         self._stop_sweeper()
@@ -359,11 +441,17 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if self.coalescer is not None:
             self.coalescer.drain()
 
-    def _submit(self, key, dispatch, arrays, nops, pool_key=None, meta=None):
+    def _submit(self, key, dispatch, arrays, nops, pool_key=None, meta=None,
+                tenant=None):
         from redisson_tpu.executor.coalescer import HintedFuture
 
+        # ``tenant`` rides the segment as an appended (tenant, nops)
+        # tuple; the coalescer's COMPLETER thread turns it into the
+        # per-tenant counters, so this producer path pays no counter
+        # lock (the ≤10% submit-overhead guard in test_observability.py).
         fut = self.coalescer.submit(
-            key, dispatch, arrays, nops, pool_key=pool_key, meta=meta
+            key, dispatch, arrays, nops, pool_key=pool_key, meta=meta,
+            tenant=tenant,
         )
         return HintedFuture(fut, self.coalescer)
 
@@ -433,6 +521,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         entry = self._lookup_kind(name, kind)
         if entry is None:
             raise RuntimeError(f"{kind} object {name!r} is not initialized")
+        # Per-tenant call counter: covers every op path (coalesced or
+        # direct) at one inc per API call.
+        self.obs.tenant_calls.inc((name, kind))
         return entry
 
     def _lookup_kind(self, name: str, kind: str):
@@ -607,6 +698,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 (rows, m_arr, h1m, h2m, is_add),
                 len(rows),
                 pool_key=id(pool),
+                tenant=entry.name,
             )
         else:
             fut = self.executor.bloom_mixed(
@@ -762,6 +854,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 B,
                 pool_key=id(pool),
                 meta=(entry.row, m, is_add, len_meta),
+                tenant=entry.name,
             )
             if is_add:
                 self._replication_fence(
@@ -795,6 +888,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 (rows, m_arr, blocks, lengths, flags),
                 len(rows),
                 pool_key=id(pool),
+                tenant=entry.name,
             )
         else:
             m_arr = np.full(len(rows), m, np.uint32)
@@ -877,6 +971,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 (rows, c0, c1, c2),
                 len(c0),
                 pool_key=id(pool),
+                tenant=entry.name,
             )
             # addAll boolean: did anything change?
             return _MappedFuture(fut, lambda v: bool(np.any(v)))
@@ -1086,6 +1181,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
             len(idx),
             pool_key=id(entry.pool),
             meta=(entry, opcode),
+            tenant=entry.name,
         )
 
     def _bitset_rw(self, opcode: int, method, entry, idx):
@@ -1255,6 +1351,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 (rows, h1w, h2w, wts),
                 len(H1),
                 pool_key=id(pool),
+                tenant=entry.name,
             )
         return self.executor.cms_update_estimate(
             entry.pool, rows, h1w, h2w, wts, d, w
@@ -1276,6 +1373,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 (rows, h1w, h2w, zeros),
                 len(H1),
                 pool_key=id(pool),
+                tenant=entry.name,
             )
         return self.executor.cms_estimate(entry.pool, rows, h1w, h2w, d, w)
 
@@ -1344,9 +1442,15 @@ class HostSketchEngine:
     TTL/dump/restore surface as the TPU engine."""
 
     def __init__(self, config):
+        from redisson_tpu.obs import Observability
+
         self.config = config
         self._lock = threading.RLock()
         self._objects: dict[str, dict] = {}
+        # Same observability surface as the TPU engine (so a RESP server
+        # or client fronting either backend finds one bundle to record
+        # into); the host engine has no coalescer/executor to instrument.
+        self.obs = Observability()
         self.topk = TopKStore()
         # Wired by the client to the grid store's lock-free ``probe`` (one
         # logical keyspace — same contract as TpuSketchEngine).  Called

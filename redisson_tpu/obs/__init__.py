@@ -1,0 +1,152 @@
+"""Unified observability subsystem (ISSUE 1 tentpole).
+
+One ``Observability`` bundle per engine/client ties together:
+
+- ``registry`` — labeled counters / gauges / log2 histograms
+  (obs/registry.py) rendered as typed Prometheus text;
+- ``spans`` — per-launch lifecycle spans feeding phase histograms
+  (obs/spans.py);
+- ``slowlog`` — the SLOWLOG-compatible slow-op ring (obs/slowlog.py),
+  surfaced over RESP by serve/resp.py.
+
+The pre-built families below are the instrumentation points the rest of
+the codebase uses; everything is lazy-cheap when nothing reads it.
+"""
+
+from __future__ import annotations
+
+from redisson_tpu.obs.registry import Family, MetricsRegistry
+from redisson_tpu.obs.slowlog import SlowLog, SlowLogEntry
+from redisson_tpu.obs.spans import OpSpan, SpanRecorder
+
+
+class Observability:
+    def __init__(self, slowlog_max_len: int = 128,
+                 slowlog_threshold_us: int = 10_000):
+        r = MetricsRegistry()
+        self.registry = r
+        self.spans = SpanRecorder(r)
+        self.slowlog = SlowLog(slowlog_max_len, slowlog_threshold_us)
+        # RESP front door (per-command dimension).
+        self.resp_commands = r.counter(
+            "rtpu_resp_commands", "RESP commands processed", ("cmd",))
+        self.resp_errors = r.counter(
+            "rtpu_resp_errors", "RESP commands that returned an error",
+            ("cmd",))
+        self.resp_latency = r.histogram(
+            "rtpu_resp_command_seconds", "RESP command execution time",
+            ("cmd",))
+        # Engine submit (per-tenant / per-object-type dimensions).
+        self.tenant_ops = r.counter(
+            "rtpu_tenant_ops", "sketch ops submitted, by tenant and op",
+            ("tenant", "op"), max_children=2048)
+        self.tenant_calls = r.counter(
+            "rtpu_tenant_calls", "sketch API calls, by tenant and kind",
+            ("tenant", "kind"), max_children=2048)
+        # Executor dispatch (per-method; per-shard in sharded mode).
+        self.dispatches = r.counter(
+            "rtpu_dispatches", "executor dispatches, by method", ("method",))
+        self.dispatch_ops = r.counter(
+            "rtpu_dispatch_ops", "ops dispatched, by executor method",
+            ("method",))
+        self.dispatch_seconds = r.histogram(
+            "rtpu_dispatch_enqueue_seconds",
+            "host-side dispatch enqueue time, by method", ("method",))
+        self.shard_ops = r.counter(
+            "rtpu_shard_ops", "ops routed to each mesh shard", ("shard",))
+
+    # -- instrumentation helpers (one call per batch, never per op) --------
+
+    def record_resp_command(self, cmd: str, duration_s: float,
+                            error: bool) -> None:
+        self.resp_commands.inc((cmd,))
+        if error:
+            self.resp_errors.inc((cmd,))
+        self.resp_latency.observe((cmd,), duration_s)
+
+    def record_dispatch(self, method: str, nops: int, dur_s: float) -> None:
+        self.dispatches.inc((method,))
+        self.dispatch_ops.inc((method,), nops)
+        self.dispatch_seconds.observe((method,), dur_s)
+
+    def record_shard_counts(self, counts) -> None:
+        for s, c in enumerate(counts):
+            if c:
+                self.shard_ops.inc((str(s),), int(c))
+
+    def reset_command_stats(self) -> None:
+        """CONFIG RESETSTAT: zero the RESP per-command families."""
+        self.resp_commands.reset()
+        self.resp_errors.reset()
+        self.resp_latency.reset()
+
+    # -- snapshot views ----------------------------------------------------
+
+    def command_stats(self) -> dict:
+        """{cmd: {calls, errors, usec, usec_per_call}} for INFO
+        commandstats and client.get_metrics()."""
+        out = {}
+        errs = {lv: c.value for lv, c in self.resp_errors.items()}
+        lat = dict(self.resp_latency.items())
+        for (cmd,), c in self.resp_commands.items():
+            calls = int(c.value)
+            h = lat.get((cmd,))
+            usec = int((h.sum if h is not None else 0.0) * 1e6)
+            out[cmd] = {
+                "calls": calls,
+                "errors": int(errs.get((cmd,), 0)),
+                "usec": usec,
+                "usec_per_call": round(usec / calls, 2) if calls else 0.0,
+            }
+        return out
+
+    def latency_stats(self) -> dict:
+        """{cmd: {p50_us, p99_us, p999_us}} for INFO latencystats."""
+        out = {}
+        for (cmd,), c in self.resp_latency.items():
+            if c.count == 0:
+                continue
+            p50, p99, p999 = self.resp_latency.percentiles(
+                (cmd,), (50, 99, 99.9))
+            out[cmd] = {
+                "p50_us": p50 * 1e6,
+                "p99_us": p99 * 1e6,
+                "p999_us": p999 * 1e6,
+            }
+        return out
+
+    def op_stats(self) -> dict:
+        """{op: {ops, launches, p50_ms, p99_ms}} from the span
+        histograms — the per-command latency view of the ENGINE (bench
+        snapshots report this one)."""
+        out = {}
+        ops = {lv: c.value for lv, c in self.spans._ops.items()}
+        for (op,), c in self.spans._total_hist.items():
+            if c.count == 0:
+                continue
+            p50, p99 = self.spans._total_hist.percentiles((op,), (50, 99))
+            out[op] = {
+                "ops": int(ops.get((op,), 0)),
+                "launches": int(c.count),
+                "p50_ms": p50 * 1e3,
+                "p99_ms": p99 * 1e3,
+            }
+        return out
+
+    def tenant_stats(self) -> dict:
+        """{tenant: ops} aggregated over op types."""
+        out: dict = {}
+        for (tenant, _op), c in self.tenant_ops.items():
+            out[tenant] = out.get(tenant, 0) + int(c.value)
+        return out
+
+
+__all__ = [
+    "Family",
+    "MetricsRegistry",
+    "Observability",
+    "OpSpan",
+    "SlowLog",
+    "SlowLogEntry",
+    "SpanRecorder",
+]

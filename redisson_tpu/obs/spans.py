@@ -1,0 +1,109 @@
+"""Op-lifecycle spans (ISSUE 1 tentpole part 2).
+
+One span per coalesced *launch* (segment), not per op — span cost
+amortizes over the whole batch, so the producer-side submit path pays
+nothing.  Phases are stamped as consecutive timestamps:
+
+    submit ──(coalesce_wait)── dispatch start ──(device_dispatch)──
+    dispatched ──(d2h_fetch)── done
+
+so the phase durations partition the end-to-end latency EXACTLY
+(tests/test_observability.py asserts sum(phases) == end_to_end).  The
+device-dispatch phase additionally runs under a
+``jax.profiler.TraceAnnotation`` (see executor/coalescer.py), so a
+captured device trace correlates with these host spans by name.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+PHASES = ("coalesce_wait", "device_dispatch", "d2h_fetch")
+
+
+class OpSpan:
+    __slots__ = ("op", "nops", "t0", "stamps", "error", "_rec")
+
+    def __init__(self, op: str, nops: int, recorder: "SpanRecorder"):
+        self.op = op
+        self.nops = nops
+        self.t0 = time.monotonic()
+        self.stamps: list[tuple[str, float]] = []
+        self.error = False
+        self._rec = recorder
+
+    def stamp(self, phase: str) -> None:
+        """End the current phase NOW (phases are consecutive intervals:
+        each stamp's duration runs from the previous stamp — or t0)."""
+        self.stamps.append((phase, time.monotonic()))
+
+    def add_ops(self, nops: int) -> None:
+        self.nops += nops
+
+    def phases(self) -> dict:
+        out = {}
+        prev = self.t0
+        for name, t in self.stamps:
+            out[name] = out.get(name, 0.0) + (t - prev)
+            prev = t
+        return out
+
+    def end_to_end(self) -> float:
+        return (self.stamps[-1][1] - self.t0) if self.stamps else 0.0
+
+    def finish(self, error: bool = False) -> None:
+        if self._rec is None:  # abandoned or already finished: no-op
+            return
+        self.error = error
+        self._rec._finish(self)
+
+    def abandon(self) -> None:
+        """Merged-away segment: its ops ride another span — record nothing."""
+        self._rec = None
+
+
+class SpanRecorder:
+    """Feeds finished spans into the registry's phase histograms and keeps
+    the last ``keep`` spans for inspection (client.get_metrics views and
+    the span-sum sanity test)."""
+
+    def __init__(self, registry, keep: int = 256):
+        self._registry = registry
+        self._phase_hist = registry.histogram(
+            "rtpu_op_phase_seconds",
+            "per-launch lifecycle phase durations", ("op", "phase"),
+        )
+        self._total_hist = registry.histogram(
+            "rtpu_op_seconds", "per-launch end-to-end latency", ("op",),
+        )
+        self._ops = registry.counter(
+            "rtpu_ops", "ops completed, by op type", ("op",),
+        )
+        self._errors = registry.counter(
+            "rtpu_op_errors", "launches failed, by op type", ("op",),
+        )
+        self._recent: deque[OpSpan] = deque(maxlen=keep)
+        self._lock = threading.Lock()
+
+    def start(self, op: str, nops: int = 0) -> OpSpan:
+        return OpSpan(op, nops, self)
+
+    def _finish(self, span: OpSpan) -> None:
+        span._rec = None
+        for phase, dur in span.phases().items():
+            self._phase_hist.observe((span.op, phase), dur)
+        self._total_hist.observe((span.op,), span.end_to_end())
+        if span.error:
+            self._errors.inc((span.op,))
+        else:
+            self._ops.inc((span.op,), max(1, span.nops))
+        with self._lock:
+            self._recent.append(span)
+
+    def recent(self, op: Optional[str] = None) -> list[OpSpan]:
+        with self._lock:
+            spans = list(self._recent)
+        return spans if op is None else [s for s in spans if s.op == op]

@@ -33,6 +33,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from redisson_tpu.ops import bitops, bloom, hll as hll_ops
 
+# jax.shard_map graduated from jax.experimental in newer releases; the
+# keyword call shape (f, mesh=, in_specs=, out_specs=) is identical in
+# both homes, so bind whichever this jax provides.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pre-graduation jax
+    from jax.experimental.shard_map import shard_map
+
 
 class MeshContext:
     """Owns the device mesh and sharding specs (the ConnectionManager-role
@@ -89,7 +97,7 @@ def sharded_bloom_add(ctx: MeshContext, *, k: int, words_per_row: int, pack_resu
             out = bitops.pack_bool_u32(out)
         return new_local[None], out
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner,
         mesh=ctx.mesh,
         in_specs=(P("shard"), P(), P(), P(), P(), P()),
@@ -114,7 +122,7 @@ def sharded_bloom_contains(ctx: MeshContext, *, k: int, words_per_row: int, pack
             out = bitops.pack_bool_u32(out)
         return out
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner,
         mesh=ctx.mesh,
         in_specs=(P("shard"), P(), P(), P(), P(), P()),
@@ -138,7 +146,7 @@ def sharded_hll_add(ctx: MeshContext):
         new_local = hll_ops.hll_add(local, safe_rows, c0, c1, c2, valid=own)
         return new_local[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner,
         mesh=ctx.mesh,
         in_specs=(P("shard"), P(), P(), P(), P(), P()),
@@ -159,7 +167,7 @@ def sharded_hll_histogram(ctx: MeshContext):
         hist = lax.psum(jnp.where(own, hist, 0), "shard")
         return hist
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner, mesh=ctx.mesh, in_specs=(P("shard"), P()), out_specs=P()
     )
     return jax.jit(fn)
@@ -194,7 +202,7 @@ def sharded_mbit_set(ctx: MeshContext, *, words_local: int):
         prev = lax.psum(jnp.where(own, prev, 0).astype(jnp.int32), "shard")
         return new_local[None], prev > 0
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner,
         mesh=ctx.mesh,
         in_specs=(P("shard"), P(), P()),
@@ -219,7 +227,7 @@ def sharded_mbit_get(ctx: MeshContext, *, words_local: int):
         res = lax.psum(jnp.where(own, res, 0).astype(jnp.int32), "shard")
         return res > 0
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner, mesh=ctx.mesh, in_specs=(P("shard"), P()), out_specs=P()
     )
     return jax.jit(fn)
@@ -248,7 +256,7 @@ def _psharded(ctx: MeshContext, inner, n_op_args: int, *, out_state: bool, donat
         return inner(local, *cols)
 
     out_specs = (P("shard"), P("shard")) if out_state else P("shard")
-    fn = jax.shard_map(
+    fn = shard_map(
         wrapped,
         mesh=ctx.mesh,
         in_specs=(P("shard"),) + (P("shard"),) * n_op_args,
@@ -376,7 +384,7 @@ def psharded_cms_update_estimate(ctx: MeshContext, *, d: int, w: int, cells_per_
     if update_only:
         def wrapped(state, *ops):
             return inner(state[0], *[o[0] for o in ops])
-        fn = jax.shard_map(
+        fn = shard_map(
             wrapped,
             mesh=ctx.mesh,
             in_specs=(P("shard"),) * 6,
@@ -407,7 +415,7 @@ def msharded_row_map(ctx: MeshContext, fn_local):
         v = jnp.asarray(fn_local(state[0], row))
         return v[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner, mesh=ctx.mesh, in_specs=(P("shard"), P()), out_specs=P("shard")
     )
     return jax.jit(fn)
@@ -420,7 +428,7 @@ def msharded_row_write(ctx: MeshContext, *, words_local: int):
         local = state[0]
         return bitops.row_update(local, row, data[0], words_local)[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner,
         mesh=ctx.mesh,
         in_specs=(P("shard"), P(), P("shard")),
@@ -440,7 +448,7 @@ def msharded_set_range(ctx: MeshContext, *, words_local: int, value: bool):
         new_row = (cur | mask) if value else (cur & ~mask)
         return bitops.row_update(local, row, new_row, words_local)[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner,
         mesh=ctx.mesh,
         in_specs=(P("shard"), P(), P("shard"), P("shard")),
@@ -464,7 +472,7 @@ def msharded_bitop(ctx: MeshContext, *, words_local: int, op: str, n_src: int, m
             n_src=n_src, limit_bits=limit[0] if masked else None,
         )[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner,
         mesh=ctx.mesh,
         in_specs=(P("shard"), P(), P(), P("shard")),
@@ -505,7 +513,7 @@ def sharded_hll_merge(ctx: MeshContext):
         new_local = bitops.row_update(local, dst_local, new_row, HLL_M)
         return new_local[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner, mesh=ctx.mesh, in_specs=(P("shard"), P(), P()), out_specs=P("shard")
     )
     return jax.jit(fn, donate_argnums=(0,))
@@ -553,7 +561,7 @@ def sharded_bitop(ctx: MeshContext, *, words_per_row: int, op: str, n_src: int, 
         new_local = bitops.row_update(local, dst_local, new_row, words_per_row)
         return new_local[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner,
         mesh=ctx.mesh,
         in_specs=(P("shard"), P(), P(), P()),
@@ -583,7 +591,7 @@ def sharded_bitset_set_range(ctx: MeshContext, *, words_per_row: int, value: boo
         new_row = jnp.where(own, new_row, cur)
         return bitops.row_update(local, lrow, new_row, words_per_row)[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner,
         mesh=ctx.mesh,
         in_specs=(P("shard"), P(), P(), P()),
@@ -606,7 +614,7 @@ def sharded_row_reduce(ctx: MeshContext, fn_local):
         v = fn_local(local, row // S)
         return lax.psum(jnp.where(own, v, 0), "shard")
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner, mesh=ctx.mesh, in_specs=(P("shard"), P()), out_specs=P()
     )
     return jax.jit(fn)
@@ -626,7 +634,7 @@ def sharded_row_read(ctx: MeshContext, *, row_units: int):
         # exact broadcast (no overflow possible).
         return lax.psum(jnp.where(own, v, jnp.zeros_like(v)), "shard")
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner, mesh=ctx.mesh, in_specs=(P("shard"), P()), out_specs=P()
     )
     return jax.jit(fn)
@@ -645,7 +653,7 @@ def sharded_row_write(ctx: MeshContext, *, row_units: int):
         new_row = jnp.where(own, data, cur)
         return bitops.row_update(local, lrow, new_row, row_units)[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner,
         mesh=ctx.mesh,
         in_specs=(P("shard"), P(), P()),
@@ -675,7 +683,7 @@ def sharded_cms_merge(ctx: MeshContext, *, cells_per_row: int):
         new_row = jnp.where(own_dst, cur + summed, cur)
         return bitops.row_update(local, dst_local, new_row, cells_per_row)[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner, mesh=ctx.mesh, in_specs=(P("shard"), P(), P()), out_specs=P("shard")
     )
     return jax.jit(fn, donate_argnums=(0,))

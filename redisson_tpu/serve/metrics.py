@@ -72,6 +72,16 @@ class Metrics:
             self.wait.add(wait_s)
             self.flush.add(flush_s)
 
+    def record_dispatch(self, *, nops: int, enqueue_s: float) -> None:
+        """Direct-dispatch recording (no coalescer in front): counts ops
+        and the host-side enqueue latency.  The wait reservoir stays
+        untouched — nothing queued, so there is no queueing delay to
+        report (zeros would fake a perfect p99)."""
+        with self._lock:
+            self.ops_total += nops
+            self.batches_total += 1
+            self.flush.add(enqueue_s)
+
     def snapshot(self) -> dict:
         # Copy under the lock (it contends with the hot flush path), sort
         # OUTSIDE it — and only once per reservoir for both percentiles.
@@ -97,12 +107,20 @@ class Metrics:
             "p99_flush_ms": f99 * 1e3,
         }
 
+    # Monotonic snapshot keys: exported as Prometheus counters (they
+    # already carry the required ``_total`` suffix).  Everything else in
+    # the snapshot is a point-in-time/derived value -> gauge.  rate()
+    # over a counter mis-typed as gauge silently yields garbage, so the
+    # split is semantic, not cosmetic.
+    _COUNTER_KEYS = ("ops_total", "batches_total")
+
     def render_prometheus(self) -> str:
         """Plain Prometheus text exposition (SURVEY.md §5 metrics row)."""
         s = self.snapshot()
         lines = []
         for k, v in s.items():
-            lines.append(f"# TYPE redisson_tpu_{k} gauge")
+            kind = "counter" if k in self._COUNTER_KEYS else "gauge"
+            lines.append(f"# TYPE redisson_tpu_{k} {kind}")
             lines.append(f"redisson_tpu_{k} {v}")
         return "\n".join(lines) + "\n"
 
@@ -177,16 +195,26 @@ class Profiler:
 
     @staticmethod
     def device_memory() -> dict:
-        """Current device memory stats (bytes), when the backend exposes
-        them."""
+        """Current memory stats (bytes) for EVERY device, keyed by
+        ``platform:id`` (the Node.address form) — a multi-chip run must
+        not be blind on 7 of 8 chips.  Devices whose backend exposes no
+        memory_stats() report an empty dict under their key."""
         import jax
 
+        out: dict = {}
         try:
-            stats = jax.devices()[0].memory_stats() or {}
-            return {
-                "bytes_in_use": stats.get("bytes_in_use"),
-                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
-                "bytes_limit": stats.get("bytes_limit"),
-            }
+            devices = jax.devices()
         except Exception:
-            return {}
+            return out
+        for d in devices:
+            key = f"{d.platform}:{d.id}"
+            try:
+                stats = d.memory_stats() or {}
+                out[key] = {
+                    "bytes_in_use": stats.get("bytes_in_use"),
+                    "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                    "bytes_limit": stats.get("bytes_limit"),
+                }
+            except Exception:
+                out[key] = {}
+        return out

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Optional
 
 
@@ -288,6 +289,10 @@ class _ConnCtx:
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self.lock = threading.Lock()
+        try:  # for SLOWLOG entries; the peer may already be gone
+            self.addr = "%s:%d" % sock.getpeername()[:2]
+        except OSError:
+            self.addr = ""
         self.subs: dict[str, int] = {}  # channel -> bus listener id
         self.authed = True  # server flips to False when requirepass set
         self.in_multi = False
@@ -335,6 +340,17 @@ class RespServer:
         )
         self.max_connections = max_connections
         self.idle_timeout_s = idle_timeout_s
+        # Observability (ISSUE 1): per-command stats + SLOWLOG record
+        # into the CLIENT's bundle (shared with the engine's registry,
+        # so one Prometheus endpoint exposes both); a bare client
+        # without one gets a private bundle.
+        self.obs = getattr(client, "obs", None)
+        if self.obs is None:
+            from redisson_tpu.obs import Observability
+
+            self.obs = Observability()
+        self._started = time.monotonic()
+        self._conns_accepted = 0
         self._nconn = 0
         self._conn_lock = threading.Lock()
         self._conn_idle = threading.Condition(self._conn_lock)
@@ -373,6 +389,7 @@ class RespServer:
                         pass
                     continue
                 self._nconn += 1
+                self._conns_accepted += 1
                 self._conns.add(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,),
@@ -493,23 +510,97 @@ class RespServer:
 
     def _safe_dispatch(self, cmd: list[bytes], ctx: "_ConnCtx") -> bytes:
         """Dispatch with the error-encoding contract: command errors
-        never kill the connection; known codes pass through verbatim."""
+        never kill the connection; known codes pass through verbatim.
+
+        Every dispatch is timed here — ONE clock read pair per command
+        feeds the per-command counters/latency histogram (INFO
+        commandstats / latencystats, the Prometheus families) and the
+        SLOWLOG ring when the duration meets the configured threshold.
+
+        Commands merely QUEUED under MULTI are not recorded (EXEC's
+        replay re-enters here and records the real execution — counting
+        the queue step too would double calls and drag latencystats
+        toward the ~microsecond queue time)."""
+        t0 = time.perf_counter()
+        err = False
+        name = cmd[0].decode("latin-1", "replace").upper()
+        queueing = ctx.in_multi and name not in (
+            "EXEC", "DISCARD", "MULTI", "RESET",
+        )
         try:
-            return self._dispatch(cmd, ctx)
+            reply = self._dispatch(cmd, ctx, name)
         except RespError as e:
-            return _encode_error(str(e))
+            err = True
+            reply = _encode_error(str(e))
         except TypeError as e:
             # Kind guards raise TypeError — clients key on the WRONGTYPE
             # code (redis-py maps it to a dedicated exception class).
-            return _encode_error(
+            err = True
+            reply = _encode_error(
                 "WRONGTYPE Operation against a key holding the wrong kind "
                 f"of value ({e})"
             )
         except Exception as e:
-            return _encode_error(f"{type(e).__name__}: {e}")
+            err = True
+            reply = _encode_error(f"{type(e).__name__}: {e}")
+        dt = time.perf_counter() - t0
+        obs = self.obs
+        if obs is not None and not queueing:
+            if self._blocked(name, cmd, ctx):
+                # Condvar-parked wait is not execution time: a routine
+                # `BLPOP q 30` would otherwise file a 30s SLOWLOG entry
+                # and drive latencystats to +Inf (Redis also excludes
+                # blocked time).  Calls/errors still count.
+                obs.resp_commands.inc((name,))
+                if err:
+                    obs.resp_errors.inc((name,))
+            else:
+                obs.record_resp_command(name, dt, err)
+                sl = obs.slowlog
+                if 0 <= sl.threshold_us <= dt * 1e6:
+                    # Sanitize only for entries that will be kept.
+                    sl.maybe_add(
+                        dt, self._slowlog_sanitize(name, cmd), ctx.addr,
+                        ctx.client_name or "",
+                    )
+        return reply
 
-    def _dispatch(self, cmd: list[bytes], ctx: "_ConnCtx") -> bytes:
-        name = cmd[0].decode().upper()
+    @staticmethod
+    def _blocked(name: str, cmd: list, ctx: "_ConnCtx") -> bool:
+        """True when this invocation may have parked waiting for data —
+        its wall time is wait, not work, so it must not feed latency
+        histograms or the slowlog.  Inside EXEC every command runs
+        non-blocking (recorded normally), and XREAD/XREADGROUP block
+        only with an explicit BLOCK option."""
+        if ctx.in_exec:
+            return False
+        if name in ("BLPOP", "BRPOP"):
+            return True
+        if name in ("XREAD", "XREADGROUP"):
+            return any(a.upper() == b"BLOCK" for a in cmd[1:])
+        return False
+
+    @staticmethod
+    def _slowlog_sanitize(name: str, cmd: list) -> list:
+        """Credentials must never sit in the slow-op ring (Redis
+        obfuscates these the same way): AUTH's arguments and the two
+        args after a HELLO ... AUTH token are replaced."""
+        if name == "AUTH":
+            return [cmd[0]] + [b"(redacted)"] * (len(cmd) - 1)
+        if name == "HELLO":
+            out = list(cmd)
+            for i, a in enumerate(out):
+                if i > 0 and a.upper() == b"AUTH":
+                    for j in range(i + 1, min(i + 3, len(out))):
+                        out[j] = b"(redacted)"
+                    break
+            return out
+        return cmd
+
+    def _dispatch(self, cmd: list[bytes], ctx: "_ConnCtx",
+                  name: Optional[str] = None) -> bytes:
+        if name is None:  # _safe_dispatch passes the decoded name along
+            name = cmd[0].decode().upper()
         if not ctx.authed and name not in ("AUTH", "HELLO", "QUIT", "RESET"):
             # Pre-auth surface is AUTH/HELLO/QUIT/RESET, like Redis
             # (pooled clients RESET connections before authenticating).
@@ -632,6 +723,10 @@ class RespServer:
         "databases": "1",
         "timeout": "0",
         "proto-max-bulk-len": "536870912",
+        # Applied to the live slowlog ring on CONFIG SET (obs/slowlog.py;
+        # same defaults as redis-server).
+        "slowlog-log-slower-than": "10000",
+        "slowlog-max-len": "128",
     }
 
     def _cmd_CONFIG(self, args):
@@ -663,12 +758,27 @@ class RespServer:
                         f"Unknown option or number of arguments for "
                         f"CONFIG SET - '{key}'"
                     )
+                if key.startswith("slowlog-"):
+                    try:
+                        int(pairs[i + 1])
+                    except ValueError:
+                        raise RespError(
+                            f"Invalid argument '{pairs[i + 1].decode()}' "
+                            f"for CONFIG SET '{key}'"
+                        )
             for i in range(0, len(pairs), 2):
-                self._config_table[pairs[i].decode().lower()] = (
-                    pairs[i + 1].decode()
-                )
+                key = pairs[i].decode().lower()
+                val = pairs[i + 1].decode()
+                self._config_table[key] = val
+                # Live-apply the slowlog tunables (validated above).
+                if key == "slowlog-log-slower-than":
+                    self.obs.slowlog.set_threshold_us(int(val))
+                elif key == "slowlog-max-len":
+                    self.obs.slowlog.set_max_len(int(val))
             return _encode_simple("OK")
         if sub == "RESETSTAT":
+            # Zero the commandstats/latencystats families, like Redis.
+            self.obs.reset_command_stats()
             return _encode_simple("OK")
         raise RespError(f"Unknown CONFIG subcommand {sub}")
 
@@ -1351,12 +1461,121 @@ class RespServer:
 
     # server / connection admin
 
+    # Default INFO excludes commandstats/latencystats, like redis-server
+    # (they can be wide); 'INFO all'/'everything' or the explicit section
+    # name includes them.
+    _INFO_DEFAULT = ("server", "clients", "memory", "stats", "keyspace")
+
     def _cmd_INFO(self, args):
-        lines = ["# Server", "redis_version:7.9.9", "redis_mode:standalone",
-                 "run_id:redisson-tpu", "# Keyspace"]
-        n = self._client.get_keys().count()
-        lines.append(f"db0:keys={n},expires=0,avg_ttl=0")
+        section = args[0].decode().lower() if args else "default"
+        if section == "default":
+            sections = self._INFO_DEFAULT
+        elif section in ("all", "everything"):
+            sections = self._INFO_DEFAULT + ("commandstats", "latencystats")
+        else:
+            sections = (section,)
+        obs = self.obs
+        lines: list[str] = []
+        for s in sections:
+            if s == "server":
+                lines += [
+                    "# Server", "redis_version:7.9.9",
+                    "redis_mode:standalone", "run_id:redisson-tpu",
+                    f"uptime_in_seconds:{int(time.monotonic() - self._started)}",
+                ]
+            elif s == "clients":
+                lines += [
+                    "# Clients",
+                    f"connected_clients:{self._nconn}",
+                    f"maxclients:{self.max_connections}",
+                ]
+            elif s == "memory":
+                from redisson_tpu.serve.metrics import Profiler
+
+                total = sum(
+                    (v or {}).get("bytes_in_use") or 0
+                    for v in Profiler.device_memory().values()
+                )
+                lines += [
+                    "# Memory",
+                    f"used_memory:{total}",  # device-resident pool bytes
+                    "maxmemory:0",
+                    "maxmemory_policy:noeviction",
+                ]
+            elif s == "stats":
+                total_cmds = (
+                    sum(int(c.value) for _, c in obs.resp_commands.items())
+                    if obs is not None else 0
+                )
+                lines += [
+                    "# Stats",
+                    f"total_connections_received:{self._conns_accepted}",
+                    f"total_commands_processed:{total_cmds}",
+                    f"slowlog_len:{0 if obs is None else len(obs.slowlog)}",
+                ]
+            elif s == "commandstats" and obs is not None:
+                lines.append("# Commandstats")
+                for cmd, st in sorted(obs.command_stats().items()):
+                    lines.append(
+                        f"cmdstat_{cmd.lower()}:calls={st['calls']},"
+                        f"usec={st['usec']},"
+                        f"usec_per_call={st['usec_per_call']},"
+                        f"rejected_calls=0,failed_calls={st['errors']}"
+                    )
+            elif s == "latencystats" and obs is not None:
+                lines.append("# Latencystats")
+                for cmd, st in sorted(obs.latency_stats().items()):
+                    lines.append(
+                        f"latency_percentiles_usec_{cmd.lower()}:"
+                        f"p50={st['p50_us']:g},p99={st['p99_us']:g},"
+                        f"p99.9={st['p999_us']:g}"
+                    )
+            elif s == "keyspace":
+                n = self._client.get_keys().count()
+                lines += ["# Keyspace", f"db0:keys={n},expires=0,avg_ttl=0"]
         return _encode_bulk("\r\n".join(lines) + "\r\n")
+
+    # SLOWLOG (→ redis-server slowlog.c command surface): entries are
+    # recorded by _safe_dispatch against the shared obs bundle.
+
+    def _cmd_SLOWLOG(self, args):
+        if not args:
+            raise RespError(
+                "wrong number of arguments for 'slowlog' command"
+            )
+        sub = args[0].decode().upper()
+        sl = self.obs.slowlog
+        if sub == "GET":
+            count = int(args[1]) if len(args) > 1 else 10
+            entries = sl.entries(count)
+            out = b"*" + str(len(entries)).encode() + b"\r\n"
+            for e in entries:
+                out += (
+                    b"*6\r\n"
+                    + _encode_int(e.id)
+                    + _encode_int(e.unix_ts)
+                    + _encode_int(e.duration_us)
+                    + _encode_array(list(e.args))
+                    + _encode_bulk(e.client_addr)
+                    + _encode_bulk(e.client_name)
+                )
+            return out
+        if sub == "RESET":
+            sl.reset()
+            return _encode_simple("OK")
+        if sub == "LEN":
+            return _encode_int(len(sl))
+        if sub == "HELP":
+            return _encode_array([
+                b"SLOWLOG GET [<count>|-1]",
+                b"SLOWLOG LEN",
+                b"SLOWLOG RESET",
+                b"SLOWLOG HELP",
+            ])
+        raise RespError(
+            f"Unknown SLOWLOG subcommand or wrong number of arguments "
+            f"for '{sub.lower()}'"
+        )
 
     def _cmdctx_CLIENT(self, args, ctx: _ConnCtx):
         sub = args[0].decode().upper() if args else ""

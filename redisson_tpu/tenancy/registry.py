@@ -256,6 +256,20 @@ class TenantRegistry:
         with self._lock:
             return list(self._pools.values())
 
+    def stats(self) -> dict:
+        """Occupancy snapshot for the observability gauges (obs package):
+        tenant counts by kind and per-pool row capacity/usage.  One lock
+        hold, no device access — safe to call from a scrape handler."""
+        with self._lock:
+            tenants: dict[str, int] = {}
+            for e in self._tenants.values():
+                tenants[e.kind] = tenants.get(e.kind, 0) + 1
+            pools = {
+                key: {"capacity": p.capacity, "used_rows": p.used_rows()}
+                for key, p in self._pools.items()
+            }
+        return {"tenants_by_kind": tenants, "pools": pools}
+
     def entries(self) -> list[TenantEntry]:
         with self._lock:
             return list(self._tenants.values())
