@@ -55,6 +55,12 @@ def main(argv=None) -> int:
         help="serve the Prometheus text exposition on this port at "
         "/metrics (docs/observability.md); omitted = no endpoint",
     )
+    p.add_argument(
+        "--enable-python-scripts", action="store_true",
+        help="allow RESP EVAL/EVALSHA/SCRIPT/FUNCTION/FCALL (script "
+        "bodies are Python — RCE for anyone who can reach the socket; "
+        "refused unless --requirepass is set or the bind is loopback)",
+    )
     args = p.parse_args(argv)
 
     import redisson_tpu
@@ -83,6 +89,8 @@ def main(argv=None) -> int:
 
     if args.requirepass:
         cfg.requirepass = args.requirepass
+    if args.enable_python_scripts:
+        cfg.enable_python_scripts = True
 
     client = redisson_tpu.create(cfg)
     server = RespServer(

@@ -520,6 +520,15 @@ class RedissonTpuClient(CamelCompatMixin):
     def get_sketch_names(self, kind=None) -> list[str]:
         return self._engine.names(kind)
 
+    def prewarm_wait(self, timeout=None) -> bool:
+        """Block until AOT bucket pre-warming (use_tpu_sketch(
+        prewarm=True)) has compiled every scheduled (opcode, bucket)
+        ladder, so no subsequent serving-path op pays a first-touch
+        compile.  True when drained (trivially so when pre-warm is off
+        or the engine is the host engine)."""
+        wait = getattr(self._engine, "prewarm_wait", None)
+        return True if wait is None else wait(timeout)
+
     def get_metrics(self) -> dict:
         """Coalescer/batch metrics snapshot (SURVEY.md §5 metrics row).
 
@@ -540,6 +549,7 @@ class RedissonTpuClient(CamelCompatMixin):
             out["ops"] = obs.op_stats()
             out["commands"] = obs.command_stats()
             out["tenants"] = obs.tenant_stats()
+            out["phases"] = obs.phase_stats()
             out["slowlog_len"] = len(obs.slowlog)
         return out
 

@@ -46,6 +46,25 @@ class TpuSketchConfig:
         # retirements are fast.
         self.adaptive_inflight = True
         self.min_inflight = 2
+        # Adaptive flush window (warm-path dispatch): batch_window_us is
+        # the BASE; an EWMA-of-arrival-rate + queue-pressure controller
+        # moves the live window inside [min_window_us, max_window_us] —
+        # small under light load (latency), toward the max under pressure
+        # (segments fill toward max_batch).  0 → auto bounds
+        # (base/2 .. base*8).
+        self.adaptive_window = True
+        self.min_window_us = 0
+        self.max_window_us = 0
+        # AOT bucket pre-warming: a background thread compiles the
+        # (opcode, bucket) jit ladder up to max_batch on pool attach, so
+        # no serving-path op pays a first-touch compile (the config-4
+        # cold-pass cliff).  Off by default: every client would otherwise
+        # spend background CPU compiling ladders it may never serve —
+        # serving deployments and the bench turn it on.
+        self.prewarm = False
+        # Pools whose state exceeds this are not pre-warmed (a warm pass
+        # needs a scratch state of the same shape on device).
+        self.prewarm_max_state_bytes = 1 << 28
         # Device-side result mailbox: the completer concatenates pending
         # launches' packed results on device and fetches them in ONE D2H
         # (PROFILE.md remaining-lever 2) — each host fetch costs a full
@@ -115,6 +134,13 @@ class Config:
         # every RESP connection must AUTH (or HELLO ... AUTH) before any
         # other command.  None = open, the redis-server default.
         self.requirepass: Optional[str] = None
+        # RESP scripting (EVAL/EVALSHA/SCRIPT/FUNCTION/FCALL): script
+        # bodies are arbitrary PYTHON, i.e. remote code execution for
+        # anyone who can reach the socket — OFF by default, and the
+        # RespServer refuses to enable it unless requirepass is set or
+        # the bind is loopback.  (The in-process Python ScriptService is
+        # unaffected: in-process callers can run code anyway.)
+        self.enable_python_scripts = False
 
     # -- fluent setters, mirroring the Java builder idiom ------------------
 
@@ -130,6 +156,13 @@ class Config:
         """→ BaseConfig#setPassword: require AUTH on the RESP front
         door."""
         self.requirepass = password
+        return self
+
+    def set_enable_python_scripts(self, enabled: bool) -> "Config":
+        """Allow RESP EVAL/FUNCTION (Python bodies — RCE for anyone who
+        can reach the socket; the server refuses unless requirepass is
+        set or the bind is loopback)."""
+        self.enable_python_scripts = enabled
         return self
 
     def use_tpu_sketch(self, **kwargs) -> "Config":
@@ -150,6 +183,7 @@ class Config:
         "snapshot_dir",
         "snapshot_interval_s",
         "requirepass",
+        "enable_python_scripts",
     )
 
     def to_dict(self) -> dict:

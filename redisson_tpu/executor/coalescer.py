@@ -127,9 +127,29 @@ class BatchCoalescer:
                  max_inflight: int = 8, retry_attempts: int = 3,
                  retry_interval_s: float = 0.05, max_queued_ops: int = 0,
                  adaptive_inflight: bool = True, min_inflight: int = 2,
+                 adaptive_window: bool = True, min_window_us: int = 0,
+                 max_window_us: int = 0,
                  group_collect: Optional[Callable] = None, obs=None):
         self.window_s = batch_window_us / 1e6
         self.max_batch = max_batch
+        # Adaptive flush window: ``batch_window_us`` is the BASE; an
+        # EWMA-of-arrival-rate + queue-pressure controller moves the live
+        # window inside [min_window, max_window] — shrinking it under
+        # light load (nothing to coalesce: flush for latency) and growing
+        # it toward max_window under pressure (let segments approach
+        # max_batch: throughput), which bounds p99 batch wait on both
+        # sides.  0 → auto bounds derived from the base window.
+        self.base_window_s = self.window_s
+        self._adaptive_window = adaptive_window
+        self.min_window_s = (
+            min_window_us if min_window_us > 0 else batch_window_us / 2
+        ) / 1e6
+        self.max_window_s = (
+            max_window_us if max_window_us > 0 else batch_window_us * 8
+        ) / 1e6
+        self._rate_ewma = 0.0
+        self._ops_seen = 0  # monotonic submitted-op counter (under _lock)
+        self._rate_mark = (time.monotonic(), 0)
         self.metrics = metrics
         # Observability bundle (obs/__init__.py): per-launch lifecycle
         # spans (submit -> coalesce-wait -> device-dispatch -> D2H-fetch)
@@ -278,6 +298,7 @@ class BatchCoalescer:
             seg.futures.append((fut, seg.nops, nops, tenant))
             seg.nops += nops
             self._queued_ops += nops
+            self._ops_seen += nops  # feeds the adaptive-window EWMA
             if seg.nops >= self.max_batch:
                 self._wake.notify()
         return fut
@@ -326,6 +347,34 @@ class BatchCoalescer:
             head.nops += nxt.nops
         return head
 
+    def _update_window_locked(self) -> None:
+        """Adaptive flush window (called from the flush loop, under the
+        lock): EWMA the arrival rate (~50 ms time constant), map rate +
+        queue backlog to a pressure score in [0, 1], and set the live
+        window inside [min_window, max_window].  Light load → min window
+        (an op that won't be joined should not wait); pressure → max
+        window (let segments fill toward max_batch)."""
+        if not self._adaptive_window:
+            return
+        now = time.monotonic()
+        t0, seen0 = self._rate_mark
+        dt = now - t0
+        if dt < 0.002:  # sub-controller-tick: keep the current estimate
+            return
+        inst = (self._ops_seen - seen0) / dt
+        self._rate_mark = (now, self._ops_seen)
+        a = min(1.0, dt / 0.05)
+        self._rate_ewma += a * (inst - self._rate_ewma)
+        # Pressure: how much of max_batch the current rate would supply
+        # within the max window, plus admission-queue backlog (a backlog
+        # means dispatch is the bottleneck — bigger launches help).
+        fill = self._rate_ewma * self.max_window_s / self.max_batch
+        backlog = self._queued_ops / max(1, self.max_queued_ops)
+        p = min(1.0, fill + backlog)
+        self.window_s = (
+            self.min_window_s + (self.max_window_s - self.min_window_s) * p
+        )
+
     def _run(self) -> None:
         while True:
             with self._lock:
@@ -336,6 +385,7 @@ class BatchCoalescer:
                     return
                 if not self._order:
                     continue
+                self._update_window_locked()
                 head = self._order[0]
                 age = time.monotonic() - head.born
                 if (
@@ -352,12 +402,37 @@ class BatchCoalescer:
                 seg = self._pop_locked()
                 if seg.dispatch is not None:
                     seg = self._merge_consecutive_locked(seg)
+            cols = stage_exc = None
             if seg.dispatch is not None:
-                # Throttle BEFORE the flush work: keeps the transport's
-                # in-flight window shallow (fast retirement regime) and
-                # lets the queue behind us keep merging while we wait.
-                self._acquire_launch_slot()
-            self._flush(seg)
+                # Stage FIRST (host-side pad/concat of the segment's
+                # chunks), THEN wait for a launch slot: while prior
+                # launches execute on device, this thread is packing the
+                # next block — H2D staging and device compute pipeline
+                # instead of serializing.  The slot wait still precedes
+                # dispatch, keeping the transport's in-flight window
+                # shallow and letting the queue behind us keep merging.
+                try:
+                    cols = self._stage(seg)
+                except Exception as e:
+                    stage_exc = e
+                if stage_exc is None:
+                    self._acquire_launch_slot()
+            self._flush(seg, cols, stage_exc)
+
+    def _stage(self, seg: _Segment) -> list:
+        """Host staging: concatenate the segment's per-submit chunks into
+        flush columns.  Runs BEFORE the launch-slot wait (see _run) so it
+        overlaps with in-flight device execution; the span's host_stage
+        phase measures exactly this work."""
+        if seg.span is not None:
+            seg.span.stamp("coalesce_wait")  # queue time ends here
+        cols = [
+            c[0] if len(c) == 1 else np.concatenate(c)
+            for c in zip(*seg.chunks)
+        ]
+        if seg.span is not None:
+            seg.span.stamp("host_stage")
+        return cols
 
     def _acquire_launch_slot(self) -> None:
         with self._inflight_cv:
@@ -394,7 +469,7 @@ class BatchCoalescer:
                         self._good_streak = 0
             self._inflight_cv.notify_all()
 
-    def _flush(self, seg: _Segment) -> None:
+    def _flush(self, seg: _Segment, cols=None, stage_exc=None) -> None:
         t0 = time.monotonic()
         try:
             if seg.dispatch is None:  # barrier segment (drain)
@@ -404,12 +479,11 @@ class BatchCoalescer:
                     if fut.set_running_or_notify_cancel():
                         fut.set_result(None)
                 return
-            if seg.span is not None:
-                seg.span.stamp("coalesce_wait")  # queue time ends here
-            cols = [
-                c[0] if len(c) == 1 else np.concatenate(c)
-                for c in zip(*seg.chunks)
-            ]
+            if stage_exc is not None:
+                # Staging failed before a launch slot was taken: surface
+                # through the shared error path below, which skips the
+                # slot release for this case.
+                raise stage_exc
             # Mailbox engines: skip the per-launch eager D2H prefetch
             # when a completion BACKLOG exists (the completer will scoop
             # a group and fetch once) — each extra host-bound transfer
@@ -473,7 +547,11 @@ class BatchCoalescer:
             with self._lock:
                 if self._inflight > 0:
                     self._inflight -= 1
-            self._release_launch_slot(None)
+            if stage_exc is None:
+                # A slot was acquired in _run only when staging succeeded;
+                # releasing one that was never taken would hand another
+                # launch's slot back early.
+                self._release_launch_slot(None)
             if seg.span is not None:
                 seg.span.nops = seg.nops
                 seg.span.stamp("device_dispatch")

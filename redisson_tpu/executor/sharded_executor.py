@@ -43,6 +43,7 @@ from redisson_tpu.executor.tpu_executor import (
     LazyResult,
     TpuCommandExecutor,
     _locked,
+    _put_staged,
     bloom_count_from_bitcount,
     ensure_addressable,
 )
@@ -192,6 +193,28 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
             self.obs.record_shard_counts(p.counts)
         return p
 
+    def _scatter_put(self, p: _Partition, col, fill=0):
+        """``p.scatter`` into a reusable pinned staging buffer + one
+        device_put — the sharded twin of the single-device fused staging
+        path: per-dispatch [S, Bp] np.full allocations become buffer
+        reuse, and the transfer's host block is pinned across flushes."""
+        col = np.asarray(col)
+        shape = (p.S, p.Bp) + col.shape[1:]
+        count = int(np.prod(shape))
+        nwords = -(-count * col.dtype.itemsize // 4)
+        # Depth 2 for lane blocks ([S, Bp, L] — tens of MB on big keyed
+        # batches): a deep ring would pin 8x that in host RAM, same
+        # reasoning as the single-device _staged_blocks.
+        key = ("scatter", col.dtype.str, shape)
+        if col.ndim > 1:
+            slot = self._staging.acquire(key, nwords, depth=2)
+        else:
+            slot = self._staging.acquire(key, nwords)
+        view = slot.buf[:nwords].view(col.dtype)[:count].reshape(shape)
+        view[...] = fill
+        view[p.sh_sorted, p.slot] = col[p.order]
+        return _put_staged(slot, view)
+
     # -- m-sharded bitset pools (config 3): rows at/above the word
     # threshold split their words contiguously across shards ---------------
 
@@ -232,11 +255,11 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         p = self._part(rows)
         pool.state, packed = fn(
             pool.state,
-            jnp.asarray(p.scatter(p.lrows)),
-            jnp.asarray(p.scatter(np.asarray(h1m, np.uint32))),
-            jnp.asarray(p.scatter(np.asarray(h2m, np.uint32))),
-            jnp.asarray(p.scatter(np.asarray(m_arr, np.uint32), fill=1)),
-            jnp.asarray(p.scatter(np.asarray(is_add, bool))),
+            self._scatter_put(p, p.lrows),
+            self._scatter_put(p, np.asarray(h1m, np.uint32)),
+            self._scatter_put(p, np.asarray(h2m, np.uint32)),
+            self._scatter_put(p, np.asarray(m_arr, np.uint32), fill=1),
+            self._scatter_put(p, np.asarray(is_add, bool)),
             jnp.asarray(p.valid),
         )
         return LazyResult(packed, transform=p.unpack_bools)
@@ -273,11 +296,11 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
             lengths = np.full(len(rows), lengths, np.uint32)
         pool.state, packed = fn(
             pool.state,
-            jnp.asarray(p.scatter(p.lrows)),
-            jnp.asarray(p.scatter(blocks_t)),
-            jnp.asarray(p.scatter(lengths)),
-            jnp.asarray(p.scatter(np.asarray(m_arr, np.uint32), fill=1)),
-            jnp.asarray(p.scatter(np.asarray(is_add, bool))),
+            self._scatter_put(p, p.lrows),
+            self._scatter_put(p, blocks_t),
+            self._scatter_put(p, lengths),
+            self._scatter_put(p, np.asarray(m_arr, np.uint32), fill=1),
+            self._scatter_put(p, np.asarray(is_add, bool)),
             jnp.asarray(p.valid),
         )
         return LazyResult(packed, transform=p.unpack_bools)
@@ -341,10 +364,10 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         p = self._part(rows)
         pool.state, packed = fn(
             pool.state,
-            jnp.asarray(p.scatter(p.lrows)),
-            jnp.asarray(p.scatter(np.asarray(c0, np.uint32))),
-            jnp.asarray(p.scatter(np.asarray(c1, np.uint32))),
-            jnp.asarray(p.scatter(np.asarray(c2, np.uint32))),
+            self._scatter_put(p, p.lrows),
+            self._scatter_put(p, np.asarray(c0, np.uint32)),
+            self._scatter_put(p, np.asarray(c1, np.uint32)),
+            self._scatter_put(p, np.asarray(c2, np.uint32)),
             jnp.asarray(p.valid),
         )
         return packed, p
@@ -379,9 +402,9 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
             lengths = np.full(B, lengths, np.uint32)
         pool.state, packed = fn(
             pool.state,
-            jnp.asarray(p.scatter(p.lrows)),
-            jnp.asarray(p.scatter(blocks_t)),
-            jnp.asarray(p.scatter(lengths)),
+            self._scatter_put(p, p.lrows),
+            self._scatter_put(p, blocks_t),
+            self._scatter_put(p, lengths),
             jnp.asarray(p.valid),
         )
         return LazyResult(
@@ -421,10 +444,10 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
             )
             pool.state, packed = fn(
                 pool.state,
-                jnp.asarray(p.scatter(np.asarray(rows, np.int32))),
-                jnp.asarray(p.scatter(lidx)),
-                jnp.asarray(
-                    p.scatter(np.asarray(opcodes, np.uint32), fill=bitset_ops.OP_GET)
+                self._scatter_put(p, np.asarray(rows, np.int32)),
+                self._scatter_put(p, lidx),
+                self._scatter_put(
+                    p, np.asarray(opcodes, np.uint32), fill=bitset_ops.OP_GET
                 ),
                 jnp.asarray(p.valid),
             )
@@ -437,10 +460,10 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         p = self._part(rows)
         pool.state, packed = fn(
             pool.state,
-            jnp.asarray(p.scatter(p.lrows)),
-            jnp.asarray(p.scatter(np.asarray(idx, np.uint32))),
-            jnp.asarray(
-                p.scatter(np.asarray(opcodes, np.uint32), fill=bitset_ops.OP_GET)
+            self._scatter_put(p, p.lrows),
+            self._scatter_put(p, np.asarray(idx, np.uint32)),
+            self._scatter_put(
+                p, np.asarray(opcodes, np.uint32), fill=bitset_ops.OP_GET
             ),
             jnp.asarray(p.valid),
         )
@@ -456,8 +479,8 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
             )
             pool.state, packed = fn(
                 pool.state,
-                jnp.asarray(p.scatter(np.asarray(rows, np.int32))),
-                jnp.asarray(p.scatter(lidx)),
+                self._scatter_put(p, np.asarray(rows, np.int32)),
+                self._scatter_put(p, lidx),
                 jnp.asarray(p.valid),
             )
             return LazyResult(packed, transform=p.unpack_bools)
@@ -469,8 +492,8 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         p = self._part(rows)
         pool.state, packed = fn(
             pool.state,
-            jnp.asarray(p.scatter(p.lrows)),
-            jnp.asarray(p.scatter(np.asarray(idx, np.uint32))),
+            self._scatter_put(p, p.lrows),
+            self._scatter_put(p, np.asarray(idx, np.uint32)),
             jnp.asarray(p.valid),
         )
         return LazyResult(packed, transform=p.unpack_bools)
@@ -494,8 +517,8 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
             )
             packed = fn(
                 pool.state,
-                jnp.asarray(p.scatter(np.asarray(rows, np.int32))),
-                jnp.asarray(p.scatter(lidx)),
+                self._scatter_put(p, np.asarray(rows, np.int32)),
+                self._scatter_put(p, lidx),
                 jnp.asarray(p.valid),
             )
             return LazyResult(packed, transform=p.unpack_bools)
@@ -507,8 +530,8 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         p = self._part(rows)
         packed = fn(
             pool.state,
-            jnp.asarray(p.scatter(p.lrows)),
-            jnp.asarray(p.scatter(np.asarray(idx, np.uint32))),
+            self._scatter_put(p, p.lrows),
+            self._scatter_put(p, np.asarray(idx, np.uint32)),
             jnp.asarray(p.valid),
         )
         return LazyResult(packed, transform=p.unpack_bools)
@@ -680,10 +703,10 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         p = self._part(rows)
         args = (
             pool.state,
-            jnp.asarray(p.scatter(p.lrows)),
-            jnp.asarray(p.scatter(np.asarray(h1w, np.uint32))),
-            jnp.asarray(p.scatter(np.asarray(h2w, np.uint32))),
-            jnp.asarray(p.scatter(np.asarray(weights, np.uint32))),
+            self._scatter_put(p, p.lrows),
+            self._scatter_put(p, np.asarray(h1w, np.uint32)),
+            self._scatter_put(p, np.asarray(h2w, np.uint32)),
+            self._scatter_put(p, np.asarray(weights, np.uint32)),
             jnp.asarray(p.valid),
         )
         if mode == "est":

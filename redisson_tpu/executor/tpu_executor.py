@@ -152,6 +152,120 @@ def _pow2ceil(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+# -- pinned host staging (warm-path dispatch) -------------------------------
+#
+# Every flush used to allocate fresh np.full arrays per column and ship
+# them as 4-6 separate jnp.asarray H2D transfers.  Both costs scale with
+# flush RATE, not op count, and on the tunneled link some phases charge a
+# full round trip per TRANSFER.  The staging rings below keep reusable
+# pinned host buffers per (layout key); the hot coalesced methods pack a
+# whole op batch into ONE contiguous uint32 block and ship it with a
+# single jax.device_put, slicing columns back out INSIDE the jit (free —
+# XLA fuses the slices into the kernel).
+#
+# Reuse safety: device_put's host buffer is immutable-until-transfer-
+# completes, and the transfer may be async.  Each slot remembers the
+# device array it last fed; re-acquiring the slot waits on that array
+# (a no-op once the transfer retired — with ring depth 8 the wait is
+# almost never hit in steady state) before the buffer is overwritten.
+#
+# CPU-backend caveat: there device_put ZERO-COPIES a suitably aligned
+# numpy buffer — the jax.Array WRAPS the staging memory instead of
+# copying it, so ring reuse would corrupt in-flight launches (measured:
+# 20/20 aliased for 64-byte-aligned buffers).  On that backend the ship
+# helpers hand jax a private copy of the packed block; the pinned
+# buffers still serve as the packing arena (one allocation+transfer per
+# flush instead of one np.full + transfer per column).
+
+_STAGING_DEPTH = 8
+
+_HOST_MAY_ALIAS = None
+
+
+def _host_may_alias() -> bool:
+    global _HOST_MAY_ALIAS
+    if _HOST_MAY_ALIAS is None:
+        _HOST_MAY_ALIAS = jax.default_backend() == "cpu"
+    return _HOST_MAY_ALIAS
+
+
+def _put_staged(slot: "_StagingSlot", view):
+    """Ship a packed staging view: direct (pinned, pending-tracked) on
+    accelerators; via a private copy on the zero-copy CPU backend."""
+    if _host_may_alias():
+        return jax.device_put(view.copy())
+    dev = jax.device_put(view)
+    slot.pending = dev
+    return dev
+
+
+class _StagingSlot:
+    __slots__ = ("buf", "pending")
+
+    def __init__(self):
+        self.buf = None
+        self.pending = None
+
+
+class _StagingRings(threading.local):
+    """Per-thread staging-buffer rings (thread-local: the coalescer flush
+    thread, direct-dispatch callers, and the pre-warm thread each get
+    private buffers, so no cross-thread write races on reused memory)."""
+
+    def __init__(self):
+        self.rings: dict = {}
+
+    def acquire(self, key, nwords: int, depth: int = _STAGING_DEPTH) -> _StagingSlot:
+        ring = self.rings.get(key)
+        if ring is None:
+            ring = self.rings[key] = [0, [_StagingSlot() for _ in range(depth)]]
+        slots = ring[1]
+        slot = slots[ring[0]]
+        ring[0] = (ring[0] + 1) % len(slots)
+        if slot.pending is not None:
+            try:
+                slot.pending.block_until_ready()
+            except Exception:
+                pass
+            slot.pending = None
+        if slot.buf is None or slot.buf.shape[0] < nwords:
+            slot.buf = np.empty(_pow2ceil(max(64, nwords)), np.uint32)
+        return slot
+
+
+def _fill_words(buf, off: int, n_pad: int, arr, dtype, fill=0) -> int:
+    """Write ``arr`` into buf[off:off+n_pad] viewed as a 4-byte ``dtype``,
+    padding the tail with ``fill``; returns the next offset."""
+    view = buf[off : off + n_pad].view(dtype)
+    n = arr.shape[0]
+    view[:n] = arr
+    if n < n_pad:
+        view[n:] = fill
+    return off + n_pad
+
+
+def _fill_bits(buf, off: int, n_pad: int, flags) -> int:
+    """Pack a bool column into buf[off : off + n_pad//32] at 1 bit/op
+    (little-endian, the device unpacks with bitops.unpack_bool_u32_dev);
+    returns the next offset."""
+    nw = n_pad >> 5
+    words = bitops.host_pack_bool_u32(np.asarray(flags, bool))
+    view = buf[off : off + nw]
+    view[: words.shape[0]] = words
+    view[words.shape[0]:] = 0
+    return off + nw
+
+
+def _fill_blocks(buf, off: int, n_pad: int, blocks) -> int:
+    """Write a [B, L] uint32 lane block into buf, zero-padding to
+    [n_pad, L]; returns the next offset."""
+    B, L = blocks.shape
+    view = buf[off : off + n_pad * L].reshape(n_pad, L)
+    view[:B] = blocks
+    view[B:] = 0
+    return off + n_pad * L
+
+
 def bloom_count_from_bitcount(x, m: int, k: int) -> int:
     """BITCOUNT inversion n ≈ -m/k·ln(1 - X/m) (→ RedissonBloomFilter#count);
     shared by the single-device and sharded executors."""
@@ -190,6 +304,10 @@ class TpuCommandExecutor:
         self._jit_cache: dict[tuple, object] = {}
         self._lock = threading.Lock()
         self._dispatch_lock = threading.RLock()
+        # Pinned host staging buffers (per-thread rings, see module
+        # comment): the hot coalesced methods pack whole batches into one
+        # block here; everything else pads into reusable column buffers.
+        self._staging = _StagingRings()
 
     # -- pool-state factory (the executor owns array layout; pools only
     # hand out row numbers) ------------------------------------------------
@@ -360,11 +478,49 @@ class TpuCommandExecutor:
         out[: arr.shape[0]] = arr
         return out
 
+    def _ship(self, slot: _StagingSlot, nwords: int):
+        """One fused H2D for a packed staging block; the slot remembers
+        the device array so a later reuse waits out the transfer."""
+        return _put_staged(slot, slot.buf[:nwords])
+
+    def _staged_put(self, arr, n_pad: int, fill=0, dtype=None, depth=_STAGING_DEPTH):
+        """Pad a column into a reusable pinned staging buffer and ship it
+        (replaces the per-flush np.full + jnp.asarray allocation pair for
+        methods that keep per-column transfers)."""
+        arr = np.asarray(arr) if dtype is None else np.asarray(arr, dtype)
+        dt = arr.dtype
+        nwords = -(-n_pad * dt.itemsize // 4)
+        slot = self._staging.acquire(("pad", dt.str, n_pad), nwords, depth)
+        view = slot.buf[:nwords].view(dt)[:n_pad]
+        n = arr.shape[0]
+        view[:n] = arr
+        if n < n_pad:
+            view[n:] = fill
+        return _put_staged(slot, view)
+
+    def _staged_blocks(self, blocks, n_pad: int):
+        """[B, L] uint32 lane block padded to [n_pad, L] in a reusable
+        staging buffer (the big per-call np.zeros on the *_keys paths)."""
+        B, L = blocks.shape
+        nwords = n_pad * L
+        # Depth 2: key blocks can be tens of MB (8M-op launches); a deep
+        # ring would pin 8x that in host RAM for no extra overlap.
+        slot = self._staging.acquire(("blocks", L, n_pad), nwords, depth=2)
+        view = slot.buf[:nwords].reshape(n_pad, L)
+        view[:B] = blocks
+        view[B:] = 0
+        return _put_staged(slot, view)
+
+    def _staged_valid(self, n: int, n_pad: int):
+        slot = self._staging.acquire(("valid", n_pad), -(-n_pad // 4))
+        view = slot.buf[: -(-n_pad // 4)].view(bool)[:n_pad]
+        view[:n] = True
+        view[n:] = False
+        return _put_staged(slot, view)
+
     def _pad_ops(self, n_pad: int, *arrays):
-        padded = [jnp.asarray(self._pad(a, n_pad)) for a in arrays]
-        valid = np.zeros(n_pad, bool)
-        valid[: arrays[0].shape[0]] = True
-        return padded, jnp.asarray(valid)
+        padded = [self._staged_put(a, n_pad) for a in arrays]
+        return padded, self._staged_valid(arrays[0].shape[0], n_pad)
 
     @staticmethod
     def _trim_lanes(blocks):
@@ -396,7 +552,7 @@ class TpuCommandExecutor:
         fn = self._jit(key, build, donate=True)
         # Padded m must be nonzero (mod arithmetic); 1 is harmless.
         (rows_p, h1_p, h2_p), valid = self._pad_ops(Bp, rows, h1m, h2m)
-        m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
+        m_p = self._staged_put(m_arr, Bp, fill=1)
         pool.state, newly = fn(pool.state, rows_p, h1_p, h2_p, m_p, valid)
         return LazyResult(newly, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
@@ -415,21 +571,41 @@ class TpuCommandExecutor:
 
         fn = self._jit(key, build, donate=False)
         (rows_p, h1_p, h2_p), _ = self._pad_ops(Bp, rows, h1m, h2m)
-        m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
+        m_p = self._staged_put(m_arr, Bp, fill=1)
         out = fn(pool.state, rows_p, h1_p, h2_p, m_p)
         return LazyResult(out, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bloom_mixed(self, pool, rows, m_arr, k: int, h1m, h2m, is_add) -> LazyResult:
         """Combined add+contains batch (ops/bloom.bloom_mixed): the
         coalescer's hot path — mixed multi-tenant traffic stays in ONE
-        segment per (pool, k)."""
+        segment per (pool, k).
+
+        Fused H2D: the whole batch (rows, m, h1, h2, bit-packed is_add,
+        real-op count in word 0) ships as ONE contiguous staging block →
+        one device_put per flush instead of 6 transfers; the jit slices
+        columns back out (free — XLA fuses the slices into the kernel)
+        and rebuilds valid as ``iota < n``."""
         B = h1m.shape[0]
         Bp = self._bucket(B)
         wpr = pool.row_units
+        Wb = Bp >> 5
         key = ("bloom_mixed", wpr, pool.state.shape[0], Bp, k)
 
         def build():
-            def f(state, rows, h1m, h2m, m_arr, is_add, valid):
+            def f(state, packed):
+                n = jax.lax.bitcast_convert_type(packed[0], jnp.int32)
+                o = 1
+                rows = jax.lax.bitcast_convert_type(
+                    packed[o : o + Bp], jnp.int32)
+                o += Bp
+                m_arr = packed[o : o + Bp]
+                o += Bp
+                h1m = packed[o : o + Bp]
+                o += Bp
+                h2m = packed[o : o + Bp]
+                o += Bp
+                is_add = bitops.unpack_bool_u32_dev(packed[o : o + Wb], Bp)
+                valid = jnp.arange(Bp, dtype=jnp.int32) < n
                 new, res = bloom_ops.bloom_mixed(
                     state, rows, h1m, h2m, is_add,
                     m=m_arr, k=k, words_per_row=wpr, valid=valid,
@@ -438,24 +614,46 @@ class TpuCommandExecutor:
             return f
 
         fn = self._jit(key, build, donate=True)
-        (rows_p, h1_p, h2_p), valid = self._pad_ops(Bp, rows, h1m, h2m)
-        m_p = jnp.asarray(self._pad(m_arr, Bp, fill=1))
-        add_p = jnp.asarray(self._pad(np.asarray(is_add, bool), Bp))
-        pool.state, res = fn(pool.state, rows_p, h1_p, h2_p, m_p, add_p, valid)
+        total = 1 + 4 * Bp + Wb
+        slot = self._staging.acquire(("bloom_mixed", Bp), total)
+        buf = slot.buf
+        buf[0] = B
+        o = _fill_words(buf, 1, Bp, np.asarray(rows, np.int32), np.int32)
+        # Padded m must be nonzero (mod arithmetic); 1 is harmless.
+        o = _fill_words(buf, o, Bp, np.asarray(m_arr, np.uint32), np.uint32, 1)
+        o = _fill_words(buf, o, Bp, np.asarray(h1m, np.uint32), np.uint32)
+        o = _fill_words(buf, o, Bp, np.asarray(h2m, np.uint32), np.uint32)
+        _fill_bits(buf, o, Bp, is_add)
+        pool.state, res = fn(pool.state, self._ship(slot, total))
         return LazyResult(res, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bloom_mixed_keys(self, pool, rows, m_arr, k: int, blocks, lengths, is_add) -> LazyResult:
         """Combined add+contains from raw codec lanes — device-side murmur
-        + 64-bit mod (ops/fastpath.py), multi-tenant rows/m as arrays."""
+        + 64-bit mod (ops/fastpath.py), multi-tenant rows/m as arrays.
+        Fused H2D: one packed staging block per flush (see bloom_mixed)."""
         B = blocks.shape[0]
         Bp = self._bucket(B)
         blocks, L = self._trim_lanes(blocks)
         Lt = blocks.shape[1]
         wpr = pool.row_units
+        Wb = Bp >> 5
         key = ("bloom_mixed_keys", wpr, pool.state.shape[0], Bp, k, L, Lt)
 
         def build():
-            def f(state, rows, blocks, lengths, m_arr, is_add, valid):
+            def f(state, packed):
+                n = jax.lax.bitcast_convert_type(packed[0], jnp.int32)
+                o = 1
+                rows = jax.lax.bitcast_convert_type(
+                    packed[o : o + Bp], jnp.int32)
+                o += Bp
+                lengths = packed[o : o + Bp]
+                o += Bp
+                m_arr = packed[o : o + Bp]
+                o += Bp
+                is_add = bitops.unpack_bool_u32_dev(packed[o : o + Wb], Bp)
+                o += Wb
+                blocks = packed[o : o + Bp * Lt].reshape(Bp, Lt)
+                valid = jnp.arange(Bp, dtype=jnp.int32) < n
                 new, res = fastpath.bloom_mixed_keys(
                     state, rows, blocks, lengths, m_arr, is_add, valid,
                     k=k, words_per_row=wpr, target_lanes=L,
@@ -464,19 +662,18 @@ class TpuCommandExecutor:
             return f
 
         fn = self._jit(key, build, donate=True)
-        blocks_p = np.zeros((Bp, Lt), np.uint32)
-        blocks_p[:B] = blocks
-        valid = np.zeros(Bp, bool)
-        valid[:B] = True
-        pool.state, res = fn(
-            pool.state,
-            jnp.asarray(self._pad(np.asarray(rows, np.int32), Bp)),
-            jnp.asarray(blocks_p),
-            jnp.asarray(self._pad(np.asarray(lengths, np.uint32), Bp)),
-            jnp.asarray(self._pad(np.asarray(m_arr, np.uint32), Bp, fill=1)),
-            jnp.asarray(self._pad(np.asarray(is_add, bool), Bp)),
-            jnp.asarray(valid),
-        )
+        total = 1 + 3 * Bp + Wb + Bp * Lt
+        slot = self._staging.acquire(("bloom_mixed_keys", Bp, Lt), total)
+        buf = slot.buf
+        buf[0] = B
+        o = _fill_words(buf, 1, Bp, np.asarray(rows, np.int32), np.int32)
+        o = _fill_words(
+            buf, o, Bp, np.asarray(lengths, np.uint32), np.uint32)
+        o = _fill_words(
+            buf, o, Bp, np.asarray(m_arr, np.uint32), np.uint32, 1)
+        o = _fill_bits(buf, o, Bp, is_add)
+        _fill_blocks(buf, o, Bp, blocks)
+        pool.state, res = fn(pool.state, self._ship(slot, total))
         return LazyResult(res, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bloom_mixed_keys_runs(self, pool, k: int, blocks, lengths, run_rows, run_m, run_flags, run_starts) -> LazyResult:
@@ -499,11 +696,33 @@ class TpuCommandExecutor:
         # the bucket rather than fail.
         Cp = max(1024, _pow2ceil(C))
         wpr = pool.row_units
+        Wc = Cp >> 5
         const_len = np.ndim(lengths) == 0
         key = ("bloom_mixk_runs", wpr, pool.state.shape[0], Bp, k, L, Lt, Cp, const_len)
 
         def build():
-            def f(state, blocks, lengths, rr, rm, rf, starts, n_ops):
+            def f(state, packed):
+                # Packed layout (one fused H2D per flush): [0]=n_ops,
+                # [1]=const key length, then starts/rr/rm/rf-bits
+                # [/lengths]/blocks at the static offsets below.
+                n_ops = jax.lax.bitcast_convert_type(packed[0], jnp.int32)
+                o = 2
+                starts = jax.lax.bitcast_convert_type(
+                    packed[o : o + Cp + 1], jnp.int32)
+                o += Cp + 1
+                rr = jax.lax.bitcast_convert_type(
+                    packed[o : o + Cp], jnp.int32)
+                o += Cp
+                rm = packed[o : o + Cp]
+                o += Cp
+                rf = bitops.unpack_bool_u32_dev(packed[o : o + Wc], Cp)
+                o += Wc
+                if const_len:
+                    lengths = packed[1]
+                else:
+                    lengths = packed[o : o + Bp]
+                    o += Bp
+                blocks = packed[o : o + Bp * Lt].reshape(Bp, Lt)
                 iota = jax.lax.iota(jnp.int32, Bp)
                 # Run index of op i = #(run ends ≤ i); padded ends equal
                 # n_ops, so tail ops clip to the last run (valid=False
@@ -519,25 +738,25 @@ class TpuCommandExecutor:
             return f
 
         fn = self._jit(key, build, donate=True)
-        blocks_p = np.zeros((Bp, Lt), np.uint32)
-        blocks_p[:B] = blocks
-        starts_p = np.full(Cp + 1, B, np.int32)
-        starts_p[: C + 1] = run_starts
-        len_arg = (
-            np.uint32(lengths)
-            if const_len
-            else jnp.asarray(self._pad(np.asarray(lengths, np.uint32), Bp))
-        )
-        pool.state, res = fn(
-            pool.state,
-            jnp.asarray(blocks_p),
-            len_arg,
-            jnp.asarray(self._pad(np.asarray(run_rows, np.int32), Cp)),
-            jnp.asarray(self._pad(np.asarray(run_m, np.uint32), Cp, fill=1)),
-            jnp.asarray(self._pad(np.asarray(run_flags, bool), Cp)),
-            jnp.asarray(starts_p),
-            np.int32(B),
-        )
+        total = 2 + (Cp + 1) + 2 * Cp + Wc + (0 if const_len else Bp) + Bp * Lt
+        slot = self._staging.acquire(
+            ("bloom_mixk_runs", Bp, Lt, Cp, const_len), total)
+        buf = slot.buf
+        buf[0] = B
+        buf[1] = np.uint32(lengths) if const_len else 0
+        o = 2
+        sview = buf[o : o + Cp + 1].view(np.int32)
+        sview[: C + 1] = run_starts
+        sview[C + 1 :] = B
+        o += Cp + 1
+        o = _fill_words(buf, o, Cp, np.asarray(run_rows, np.int32), np.int32)
+        o = _fill_words(buf, o, Cp, np.asarray(run_m, np.uint32), np.uint32, 1)
+        o = _fill_bits(buf, o, Cp, run_flags)
+        if not const_len:
+            o = _fill_words(
+                buf, o, Bp, np.asarray(lengths, np.uint32), np.uint32)
+        _fill_blocks(buf, o, Bp, blocks)
+        pool.state, res = fn(pool.state, self._ship(slot, total))
         return LazyResult(res, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bitset_mixed_runs(self, pool, idx, run_rows, run_ops, run_starts) -> LazyResult:
@@ -553,7 +772,19 @@ class TpuCommandExecutor:
         key = ("bs_mixed_runs", wpr, pool.state.shape[0], Bp, Cp)
 
         def build():
-            def f(state, idx, rr, ro, starts, n_ops):
+            def f(state, packed):
+                # Packed layout: [0]=n_ops, idx, starts, rr, ro.
+                n_ops = jax.lax.bitcast_convert_type(packed[0], jnp.int32)
+                o = 1
+                idx = packed[o : o + Bp]
+                o += Bp
+                starts = jax.lax.bitcast_convert_type(
+                    packed[o : o + Cp + 1], jnp.int32)
+                o += Cp + 1
+                rr = jax.lax.bitcast_convert_type(
+                    packed[o : o + Cp], jnp.int32)
+                o += Cp
+                ro = packed[o : o + Cp]
                 iota = jax.lax.iota(jnp.int32, Bp)
                 seg = jnp.minimum(
                     jnp.searchsorted(starts[1:], iota, side="right"), Cp - 1
@@ -566,32 +797,41 @@ class TpuCommandExecutor:
             return f
 
         fn = self._jit(key, build, donate=True)
-        starts_p = np.full(Cp + 1, B, np.int32)
-        starts_p[: len(run_starts)] = run_starts
-        pool.state, obs = fn(
-            pool.state,
-            jnp.asarray(self._pad(np.asarray(idx, np.uint32), Bp)),
-            jnp.asarray(self._pad(np.asarray(run_rows, np.int32), Cp)),
-            jnp.asarray(
-                self._pad(
-                    np.asarray(run_ops, np.uint32), Cp, fill=bitset_ops.OP_GET
-                )
-            ),
-            jnp.asarray(starts_p),
-            np.int32(B),
-        )
+        total = 1 + Bp + (Cp + 1) + 2 * Cp
+        slot = self._staging.acquire(("bs_mixed_runs", Bp, Cp), total)
+        buf = slot.buf
+        buf[0] = B
+        o = _fill_words(buf, 1, Bp, np.asarray(idx, np.uint32), np.uint32)
+        sview = buf[o : o + Cp + 1].view(np.int32)
+        sview[: len(run_starts)] = run_starts
+        sview[len(run_starts) :] = B
+        o += Cp + 1
+        o = _fill_words(buf, o, Cp, np.asarray(run_rows, np.int32), np.int32)
+        _fill_words(buf, o, Cp, np.asarray(run_ops, np.uint32), np.uint32,
+                    bitset_ops.OP_GET)
+        pool.state, obs = fn(pool.state, self._ship(slot, total))
         return LazyResult(obs, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bitset_mixed(self, pool, rows, idx, opcodes) -> LazyResult:
         """Unified set/clear/flip/get batch (ops/bitset.bitset_mixed) —
-        one segment per bitset pool under interleaved opcodes."""
+        one segment per bitset pool under interleaved opcodes.  Fused
+        H2D: one packed staging block per flush (see bloom_mixed)."""
         B = idx.shape[0]
         Bp = self._bucket(B)
         wpr = pool.row_units
         key = ("bs_mixed", wpr, pool.state.shape[0], Bp)
 
         def build():
-            def f(state, rows, idx, opcodes, valid):
+            def f(state, packed):
+                n = jax.lax.bitcast_convert_type(packed[0], jnp.int32)
+                o = 1
+                rows = jax.lax.bitcast_convert_type(
+                    packed[o : o + Bp], jnp.int32)
+                o += Bp
+                idx = packed[o : o + Bp]
+                o += Bp
+                opcodes = packed[o : o + Bp]
+                valid = jnp.arange(Bp, dtype=jnp.int32) < n
                 new, obs = bitset_ops.bitset_mixed(
                     state, rows, idx, opcodes, words_per_row=wpr, valid=valid
                 )
@@ -599,12 +839,16 @@ class TpuCommandExecutor:
             return f
 
         fn = self._jit(key, build, donate=True)
-        (rows_p, idx_p), valid = self._pad_ops(Bp, rows, idx)
+        total = 1 + 3 * Bp
+        slot = self._staging.acquire(("bs_mixed", Bp), total)
+        buf = slot.buf
+        buf[0] = B
+        o = _fill_words(buf, 1, Bp, np.asarray(rows, np.int32), np.int32)
+        o = _fill_words(buf, o, Bp, np.asarray(idx, np.uint32), np.uint32)
         # Padded ops are routed to scratch; OP_GET keeps them write-free.
-        ops_p = jnp.asarray(
-            self._pad(np.asarray(opcodes, np.uint32), Bp, fill=bitset_ops.OP_GET)
-        )
-        pool.state, obs = fn(pool.state, rows_p, idx_p, ops_p, valid)
+        _fill_words(buf, o, Bp, np.asarray(opcodes, np.uint32), np.uint32,
+                    bitset_ops.OP_GET)
+        pool.state, obs = fn(pool.state, self._ship(slot, total))
         return LazyResult(obs, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def bloom_add_fast_st(self, pool, row: int, m: int, k: int, h1m, h2m) -> LazyResult:
@@ -712,22 +956,18 @@ class TpuCommandExecutor:
             return f
 
         fn = self._jit(key, build, donate=True)
-        blocks_p = np.zeros((Bp, Lt), np.uint32)
-        blocks_p[:B] = blocks
-        valid = np.zeros(Bp, bool)
-        valid[:B] = True
         len_arg = (
             np.uint32(lengths[0] if B else 0)
             if const_len
-            else jnp.asarray(self._pad(np.asarray(lengths, np.uint32), Bp))
+            else self._staged_put(lengths, Bp, dtype=np.uint32)
         )
         pool.state, newly = fn(
             pool.state,
             np.int32(row),
-            jnp.asarray(blocks_p),
+            self._staged_blocks(blocks, Bp),
             len_arg,
             np.uint32(m),
-            jnp.asarray(valid),
+            self._staged_valid(B, Bp),
         )
         return LazyResult(newly, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
@@ -779,15 +1019,14 @@ class TpuCommandExecutor:
             return f
 
         fn = self._jit(key, build, donate=False)
-        blocks_p = np.zeros((Bp, Lt), np.uint32)
-        blocks_p[:B] = blocks
         len_arg = (
             np.uint32(lengths[0] if B else 0)
             if const_len
-            else jnp.asarray(self._pad(np.asarray(lengths, np.uint32), Bp))
+            else self._staged_put(lengths, Bp, dtype=np.uint32)
         )
         out = fn(
-            pool.state, np.int32(row), jnp.asarray(blocks_p), len_arg, np.uint32(m)
+            pool.state, np.int32(row), self._staged_blocks(blocks, Bp),
+            len_arg, np.uint32(m)
         )
         return LazyResult(out, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
@@ -841,21 +1080,17 @@ class TpuCommandExecutor:
             return f
 
         fn = self._jit(key, build, donate=True)
-        blocks_p = np.zeros((Bp, Lt), np.uint32)
-        blocks_p[:B] = blocks
-        valid = np.zeros(Bp, bool)
-        valid[:B] = True
         len_arg = (
             np.uint32(lengths[0] if B else 0)
             if const_len
-            else jnp.asarray(self._pad(np.asarray(lengths, np.uint32), Bp))
+            else self._staged_put(lengths, Bp, dtype=np.uint32)
         )
         pool.state, changed = fn(
             pool.state,
             np.int32(row),
-            jnp.asarray(blocks_p),
+            self._staged_blocks(blocks, Bp),
             len_arg,
-            jnp.asarray(valid),
+            self._staged_valid(B, Bp),
         )
         return LazyResult(changed, transform=bool)
 
@@ -893,20 +1128,39 @@ class TpuCommandExecutor:
 
     def hll_add_changed(self, pool, rows, c0, c1, c2) -> LazyResult:
         """Multi-tenant PFADD with exact per-op changed flags (coalesced
-        path)."""
+        path).  Fused H2D: one packed staging block per flush."""
         B = c0.shape[0]
         Bp = self._bucket(B)
         key = ("hll_add_changed", pool.state.shape[0], Bp)
 
         def build():
-            def f(state, rows, c0, c1, c2, valid):
-                new, changed = hll_ops.hll_add_changed(state, rows, c0, c1, c2, valid=valid)
+            def f(state, packed):
+                n = jax.lax.bitcast_convert_type(packed[0], jnp.int32)
+                o = 1
+                rows = jax.lax.bitcast_convert_type(
+                    packed[o : o + Bp], jnp.int32)
+                o += Bp
+                c0 = packed[o : o + Bp]
+                o += Bp
+                c1 = packed[o : o + Bp]
+                o += Bp
+                c2 = packed[o : o + Bp]
+                valid = jnp.arange(Bp, dtype=jnp.int32) < n
+                new, changed = hll_ops.hll_add_changed(
+                    state, rows, c0, c1, c2, valid=valid)
                 return new, bitops.pack_bool_u32(changed)
             return f
 
         fn = self._jit(key, build, donate=True)
-        (rows_p, c0p, c1p, c2p), valid = self._pad_ops(Bp, rows, c0, c1, c2)
-        pool.state, changed = fn(pool.state, rows_p, c0p, c1p, c2p, valid)
+        total = 1 + 4 * Bp
+        slot = self._staging.acquire(("hll_add_changed", Bp), total)
+        buf = slot.buf
+        buf[0] = B
+        o = _fill_words(buf, 1, Bp, np.asarray(rows, np.int32), np.int32)
+        o = _fill_words(buf, o, Bp, np.asarray(c0, np.uint32), np.uint32)
+        o = _fill_words(buf, o, Bp, np.asarray(c1, np.uint32), np.uint32)
+        _fill_words(buf, o, Bp, np.asarray(c2, np.uint32), np.uint32)
+        pool.state, changed = fn(pool.state, self._ship(slot, total))
         return LazyResult(changed, transform=lambda v: bitops.unpack_bool_u32(v, B))
 
     def hll_add_single(self, pool, row: int, c0, c1, c2) -> LazyResult:
@@ -1122,21 +1376,39 @@ class TpuCommandExecutor:
         return LazyResult(out, B)
 
     def cms_update_estimate(self, pool, rows, h1w, h2w, weights, d: int, w: int) -> LazyResult:
+        """Coalesced CMS path (updates + estimates share one segment).
+        Fused H2D: one packed staging block per flush — padded ops carry
+        weight 0 (the scatter-add identity), so no valid mask ships."""
         B = h1w.shape[0]
         Bp = self._bucket(B)
         u = pool.row_units
         key = ("cms_updest", pool.state.shape[0], Bp, d, w)
 
         def build():
-            def f(state, rows, h1w, h2w, weights):
+            def f(state, packed):
+                o = 0
+                rows = jax.lax.bitcast_convert_type(
+                    packed[o : o + Bp], jnp.int32)
+                o += Bp
+                h1w = packed[o : o + Bp]
+                o += Bp
+                h2w = packed[o : o + Bp]
+                o += Bp
+                weights = packed[o : o + Bp]
                 return cms_ops.cms_update_and_estimate(
                     state, rows, h1w, h2w, weights, d=d, w=w, cells_per_row=u
                 )
             return f
 
         fn = self._jit(key, build, donate=True)
-        (rows_p, h1p, h2p, w_p), _ = self._pad_ops(Bp, rows, h1w, h2w, weights)
-        pool.state, est = fn(pool.state, rows_p, h1p, h2p, w_p)
+        total = 4 * Bp
+        slot = self._staging.acquire(("cms_updest", Bp), total)
+        buf = slot.buf
+        o = _fill_words(buf, 0, Bp, np.asarray(rows, np.int32), np.int32)
+        o = _fill_words(buf, o, Bp, np.asarray(h1w, np.uint32), np.uint32)
+        o = _fill_words(buf, o, Bp, np.asarray(h2w, np.uint32), np.uint32)
+        _fill_words(buf, o, Bp, np.asarray(weights, np.uint32), np.uint32)
+        pool.state, est = fn(pool.state, self._ship(slot, total))
         return LazyResult(est, B)
 
     # Pallas heavy-hitter path (BASELINE config 5): SEQUENTIAL streaming
@@ -1177,9 +1449,9 @@ class TpuCommandExecutor:
         pool.state, est = fn(
             pool.state,
             np.int32(row),
-            jnp.asarray(self._pad(np.asarray(h1w, np.uint32), Bp)),
-            jnp.asarray(self._pad(np.asarray(h2w, np.uint32), Bp)),
-            jnp.asarray(self._pad(np.asarray(weights, np.uint32), Bp)),
+            self._staged_put(h1w, Bp, dtype=np.uint32),
+            self._staged_put(h2w, Bp, dtype=np.uint32),
+            self._staged_put(weights, Bp, dtype=np.uint32),
         )
         return LazyResult(est, B)
 

@@ -610,6 +610,15 @@ class SketchDurabilityMixin:
                     e.replica_rows = None  # quarantined, not freed
                 self.registry._factory = new_exec
                 self.executor = new_exec
+                pw = getattr(self, "prewarmer", None)
+                if pw is not None:
+                    # Rebind the pre-warmer to the successor and re-run
+                    # every registered ladder against the new layout —
+                    # without this it would hold the retired executor
+                    # forever, silently skipping warm tasks while
+                    # prewarm_wait still reported a warmed cache (the
+                    # compile cliff would return after any reshard).
+                    pw.rebind_executor(new_exec)
                 # Retire the old executor LAST: a caller that read
                 # engine.executor before this swap and is blocked on the
                 # dispatch lock gets FORWARDED to the successor when it

@@ -310,6 +310,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 max_queued_ops=config.tpu_sketch.max_queued_ops,
                 adaptive_inflight=config.tpu_sketch.adaptive_inflight,
                 min_inflight=config.tpu_sketch.min_inflight,
+                adaptive_window=config.tpu_sketch.adaptive_window,
+                min_window_us=config.tpu_sketch.min_window_us,
+                max_window_us=config.tpu_sketch.max_window_us,
                 group_collect=(
                     self.executor.collect_group
                     if config.tpu_sketch.mailbox_collect
@@ -324,6 +327,20 @@ class TpuSketchEngine(SketchDurabilityMixin):
             # coalescer records them — both would double-count).  Fixes
             # sharded/coalesce=False runs reporting zero ops.
             self.executor.metrics = self.metrics
+        # AOT bucket pre-warming (executor/prewarm.py): a background
+        # thread compiles the (opcode, bucket) jit ladder on pool attach
+        # so serving-path ops never pay a first-touch compile.
+        self.prewarmer = None
+        self._prewarm_seen: set = set()
+        if config.tpu_sketch.prewarm:
+            from redisson_tpu.executor.prewarm import BucketPrewarmer
+
+            self.prewarmer = BucketPrewarmer(
+                self.executor,
+                max_batch=config.tpu_sketch.max_batch,
+                max_state_bytes=config.tpu_sketch.prewarm_max_state_bytes,
+                obs=self.obs,
+            )
         self._register_health_gauges()
         # Checkpoint/resume (SURVEY.md §5): restore device state from the
         # configured snapshot dir, then arm periodic snapshots.
@@ -378,16 +395,41 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 "launches awaiting the completer thread",
                 lambda: c._completions.qsize(),
             )
+            reg.gauge_callback(
+                "rtpu_flush_window_us",
+                "live adaptive flush window",
+                lambda: c.window_s * 1e6,
+            )
+        if self.prewarmer is not None:
+            reg.gauge_callback(
+                "rtpu_prewarm_pending",
+                "bucket warm tasks not yet compiled",
+                self.prewarmer.pending,
+            )
+
+        # One registry.stats() snapshot serves BOTH gauges per scrape:
+        # stats() holds the tenancy lock (contended by the serving
+        # path's try_create/lookup) while building the full dict, so the
+        # short-TTL memo halves the scrape-time lock hold.
+        import time as _time
+
+        stats_memo = {"t": -1.0, "v": None}
+
+        def _stats():
+            now = _time.monotonic()
+            if stats_memo["v"] is None or now - stats_memo["t"] > 0.2:
+                stats_memo["v"] = self.registry.stats()
+                stats_memo["t"] = now
+            return stats_memo["v"]
 
         def _tenant_counts():
             return {
-                (k,): v
-                for k, v in self.registry.stats()["tenants_by_kind"].items()
+                (k,): v for k, v in _stats()["tenants_by_kind"].items()
             }
 
         def _pool_rows():
             out = {}
-            for key, st in self.registry.stats()["pools"].items():
+            for key, st in _stats()["pools"].items():
                 kind = key[0]
                 cls = "x".join(str(x) for x in key[1:]) or "-"
                 out[(kind, cls, "used")] = st["used_rows"]
@@ -425,6 +467,8 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 self.snapshot(self.config.snapshot_dir)
             except Exception:  # pragma: no cover — best-effort persistence
                 pass
+        if self.prewarmer is not None:
+            self.prewarmer.shutdown()
         if self.coalescer is not None:
             self.coalescer.shutdown()
         if self._dist_initialized:  # pair with jax.distributed.initialize
@@ -441,6 +485,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if self.coalescer is not None:
             self.coalescer.drain()
 
+    def prewarm_wait(self, timeout=None) -> bool:
+        """Block until the AOT bucket pre-warmer has compiled every
+        scheduled ladder (True on drained; trivially True when pre-warm
+        is off)."""
+        if self.prewarmer is None:
+            return True
+        return self.prewarmer.wait_idle(timeout)
+
     def _submit(self, key, dispatch, arrays, nops, pool_key=None, meta=None,
                 tenant=None):
         from redisson_tpu.executor.coalescer import HintedFuture
@@ -454,6 +506,26 @@ class TpuSketchEngine(SketchDurabilityMixin):
             tenant=tenant,
         )
         return HintedFuture(fut, self.coalescer)
+
+    def _prewarm_keyed(self, pool, k: int, L: int, blocks, lengths) -> None:
+        """Register device-hash warm ladders for an observed codec
+        signature (lane count L + trim depth Lt + const-length flag are
+        jit-key components only real key bytes reveal).  Called once per
+        coarse (pool, k, L) signature — the caller's seen-set gate keeps
+        the trim/const scans below off the per-submit hot path."""
+        from redisson_tpu.executor import prewarm
+
+        Lt = self.executor._trim_lanes(blocks)[0].shape[1]
+        const = lengths.ndim == 0 or bool(np.all(lengths == lengths[0]))
+        if getattr(self.executor, "supports_runs_metadata", False):
+            self.prewarmer.register(
+                pool, ("bloom_mixkr", k, L, Lt, const),
+                prewarm.warm_bloom_mixed_keys_runs(k, L, Lt, const),
+            )
+        self.prewarmer.register(
+            pool, ("bloom_mixk", k, L, Lt),
+            prewarm.warm_bloom_mixed_keys(k, L, Lt),
+        )
 
     # -- generic -----------------------------------------------------------
 
@@ -648,9 +720,18 @@ class TpuSketchEngine(SketchDurabilityMixin):
         }
         self._live_lookup(name)  # reap an expired holder before tryInit
         self._guard_foreign(name)
-        _, created = self.registry.try_create(
+        entry, created = self.registry.try_create(
             name, PoolKind.BLOOM, (class_words_for_bits(m),), params
         )
+        if self.prewarmer is not None:
+            from redisson_tpu.executor import prewarm
+
+            # Pool attach → compile the hashed mixed-kernel ladder in the
+            # background (the keyed/device-hash ladders register on first
+            # sight of a codec signature, _bloom_submit_mixed_keys).
+            self.prewarmer.register(
+                entry.pool, ("bloom_mixed", k), prewarm.warm_bloom_mixed(k)
+            )
         return created
 
     def _bloom_reduce(self, entry, H1, H2):
@@ -800,12 +881,21 @@ class TpuSketchEngine(SketchDurabilityMixin):
                         for nops, (_, _, _, ln) in metas
                     ]
                 )
-            if not getattr(self.executor, "supports_runs_metadata", False):
-                # The executor changed under a queued segment (live
-                # change_topology swaps in a sharded executor, which has
-                # no runs kernel): expand the runs host-side and take the
-                # per-op-array path — rows are topology-stable, so the
-                # queued ops stay valid verbatim.
+            if (
+                not getattr(self.executor, "supports_runs_metadata", False)
+                or C > 1024
+            ):
+                # Two reasons to expand the runs host-side and take the
+                # per-op-array path: (1) the executor changed under a
+                # queued segment (live change_topology swaps in a
+                # sharded executor, which has no runs kernel) — rows are
+                # topology-stable, so the queued ops stay valid
+                # verbatim; (2) a degenerate many-tiny-chunk segment
+                # with >1024 runs — capping C here pins the runs
+                # kernel's compiled Cp space to exactly {1024}, which is
+                # what the AOT pre-warmer compiles (a bigger Cp would be
+                # a first-touch compile ON the serving path after
+                # prewarm_wait reported a warmed cache).
                 B = int(starts[-1])
                 rows = np.repeat(run_rows, np.diff(starts))
                 m_arr = np.repeat(run_m, np.diff(starts))
@@ -833,6 +923,17 @@ class TpuSketchEngine(SketchDurabilityMixin):
         L = blocks.shape[1]
         lengths = np.asarray(lengths, np.uint32)
         saw_replicas = bool(entry.replica_rows)
+        if self.prewarmer is not None and B:
+            # Keyed (codec-shaped) signatures can't be known at pool
+            # attach — the lane count and trim depth come from real key
+            # bytes.  First sight of a COARSE (pool, k, L) signature
+            # schedules the whole bucket ladder in the background; the
+            # coarse gate keeps the O(B) trim/const scans off every
+            # subsequent submit (this producer path is the hot path).
+            coarse = (id(pool), k, L)
+            if coarse not in self._prewarm_seen:
+                self._prewarm_seen.add(coarse)
+                self._prewarm_keyed(pool, k, L, blocks, lengths)
         if (
             self.coalescer is not None
             and not saw_replicas
@@ -956,6 +1057,18 @@ class TpuSketchEngine(SketchDurabilityMixin):
         self._live_lookup(name)  # reap an expired holder first
         self._guard_foreign(name)
         entry, _ = self.registry.try_create(name, PoolKind.HLL, (), {})
+        if self.prewarmer is not None:
+            # Seen-set gate: hll_ensure runs on EVERY op — the closure
+            # build + prewarmer lock belong off the hot path (register
+            # itself dedupes, but not for free).
+            coarse = (id(entry.pool), "hll")
+            if coarse not in self._prewarm_seen:
+                self._prewarm_seen.add(coarse)
+                from redisson_tpu.executor import prewarm
+
+                self.prewarmer.register(
+                    entry.pool, ("hll_add",), prewarm.warm_hll_add_changed()
+                )
         return entry
 
     def hll_add(self, name, c0, c1, c2) -> LazyResult:
@@ -1041,6 +1154,22 @@ class TpuSketchEngine(SketchDurabilityMixin):
         # Logical size tracking = Redis string-length semantics (SETBIT
         # grows the value to cover the highest index ever touched).
         entry.params["nbits"] = max(entry.params.get("nbits", 0), int(min_bits))
+        if self.prewarmer is not None:
+            # Seen-set gate: bitset_ensure runs on EVERY op (see
+            # hll_ensure) — register once per pool, off the hot path.
+            coarse = (id(entry.pool), "bitset")
+            if coarse not in self._prewarm_seen:
+                self._prewarm_seen.add(coarse)
+                from redisson_tpu.executor import prewarm
+
+                if getattr(self.executor, "supports_runs_metadata", False):
+                    self.prewarmer.register(
+                        entry.pool, ("bs_mixed_runs",),
+                        prewarm.warm_bitset_mixed_runs(),
+                    )
+                self.prewarmer.register(
+                    entry.pool, ("bs_mixed",), prewarm.warm_bitset_mixed()
+                )
         return entry
 
     def _bitset_grow(self, entry, min_bits: int) -> None:
@@ -1102,8 +1231,13 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def _bitset_dispatch_group(self, pool, gidx, runs):
         """One resolved-placement group of a mixed-bit segment → one
-        device launch (runs-metadata form when the executor supports it)."""
-        if getattr(self.executor, "supports_runs_metadata", False):
+        device launch (runs-metadata form when the executor supports it;
+        >1024 runs expand to per-op arrays so the runs kernel's Cp
+        compile space stays the single pre-warmed 1024 bucket)."""
+        if (
+            getattr(self.executor, "supports_runs_metadata", False)
+            and len(runs) <= 1024
+        ):
             run_rows = np.array([r for _, r, _ in runs], np.int32)
             run_ops = np.array([o for _, _, o in runs], np.uint32)
             starts = np.zeros(len(runs) + 1, np.int32)
@@ -1309,9 +1443,16 @@ class TpuSketchEngine(SketchDurabilityMixin):
         params = {"depth": depth, "width": width}
         self._live_lookup(name)  # reap an expired holder before tryInit
         self._guard_foreign(name)
-        _, created = self.registry.try_create(
+        entry, created = self.registry.try_create(
             name, PoolKind.CMS, (depth, width), params
         )
+        if self.prewarmer is not None:
+            from redisson_tpu.executor import prewarm
+
+            self.prewarmer.register(
+                entry.pool, ("cms_updest", depth, width),
+                prewarm.warm_cms_update_estimate(depth, width),
+            )
         return created
 
     def cms_total(self, name) -> int:

@@ -80,6 +80,19 @@ class Observability:
         self.resp_errors.reset()
         self.resp_latency.reset()
 
+    def reset_op_stats(self) -> None:
+        """Zero the span-derived families — benches call this after
+        warmup so compile-era samples don't pollute the warm-path
+        evidence view (op_stats / phase_stats).  Counters reset with the
+        histograms: a snapshot mixing all-time op counts with
+        reset-window percentiles would misstate ops-per-launch."""
+        self.spans._phase_hist.reset()
+        self.spans._total_hist.reset()
+        self.spans._ops.reset()
+        self.spans._errors.reset()
+        with self.spans._lock:
+            self.spans._recent.clear()
+
     # -- snapshot views ----------------------------------------------------
 
     def command_stats(self) -> dict:
@@ -130,6 +143,25 @@ class Observability:
                 "launches": int(c.count),
                 "p50_ms": p50 * 1e3,
                 "p99_ms": p99 * 1e3,
+            }
+        return out
+
+    def phase_stats(self) -> dict:
+        """{op: {phase: {launches, p50_ms, p99_ms}}} from the
+        lifecycle-span phase histograms (coalesce_wait / host_stage /
+        device_dispatch / d2h_fetch) — the warm-path evidence view:
+        BENCH snapshots embed it so a latency regression is attributable
+        to a specific phase from the JSON alone."""
+        out: dict = {}
+        h = self.spans._phase_hist
+        for (op, phase), c in h.items():
+            if c.count == 0:
+                continue
+            p50, p99 = h.percentiles((op, phase), (50, 99))
+            out.setdefault(op, {})[phase] = {
+                "launches": int(c.count),
+                "p50_ms": round(p50 * 1e3, 3),
+                "p99_ms": round(p99 * 1e3, 3),
             }
         return out
 

@@ -4,11 +4,15 @@ One span per coalesced *launch* (segment), not per op — span cost
 amortizes over the whole batch, so the producer-side submit path pays
 nothing.  Phases are stamped as consecutive timestamps:
 
-    submit ──(coalesce_wait)── dispatch start ──(device_dispatch)──
-    dispatched ──(d2h_fetch)── done
+    submit ──(coalesce_wait)── stage start ──(host_stage)──
+    staged ──(device_dispatch)── dispatched ──(d2h_fetch)── done
 
 so the phase durations partition the end-to-end latency EXACTLY
-(tests/test_observability.py asserts sum(phases) == end_to_end).  The
+(tests/test_observability.py asserts sum(phases) == end_to_end).
+``host_stage`` covers the host-side pad/concat of the flush block,
+which runs BEFORE the launch-slot wait so it overlaps in-flight device
+execution (executor/coalescer.py _stage); ``device_dispatch`` therefore
+includes any launch-slot wait plus the enqueue itself.  The
 device-dispatch phase additionally runs under a
 ``jax.profiler.TraceAnnotation`` (see executor/coalescer.py), so a
 captured device trace correlates with these host spans by name.
@@ -21,7 +25,7 @@ import time
 from collections import deque
 from typing import Optional
 
-PHASES = ("coalesce_wait", "device_dispatch", "d2h_fetch")
+PHASES = ("coalesce_wait", "host_stage", "device_dispatch", "d2h_fetch")
 
 
 class OpSpan:

@@ -168,6 +168,25 @@ def unpack_bool_u32(words, n: int) -> np.ndarray:
     return b[:n].astype(bool)
 
 
+def host_pack_bool_u32(flags: np.ndarray) -> np.ndarray:
+    """Host twin of pack_bool_u32 for the H2D direction: bool[N]
+    (N % 32 == 0) -> uint32[N/32], same little-endian bit order.  Boolean
+    op columns (is_add, opcode flags) ship packed inside the fused
+    staging block at 1 bit/op instead of 1 byte/op."""
+    by = np.packbits(np.ascontiguousarray(flags), bitorder="little")
+    if by.shape[0] % 4:
+        by = np.concatenate([by, np.zeros(4 - by.shape[0] % 4, np.uint8)])
+    return by.view(np.uint32)
+
+
+def unpack_bool_u32_dev(words, n: int):
+    """Device twin of unpack_bool_u32 for use INSIDE a jit: uint32[n/32]
+    -> bool[n] (little-endian bit order, matching host_pack_bool_u32)."""
+    idx = jnp.arange(n, dtype=jnp.int32)
+    w = words[idx >> 5]
+    return ((w >> (idx & 31).astype(jnp.uint32)) & _ONE).astype(jnp.bool_)
+
+
 def route_invalid_to_scratch(gword, valid, flat_len: int):
     """Send padded ops to the trailing scratch word so they can't perturb
     run-detection or results of real ops (see module docstring)."""

@@ -74,6 +74,12 @@ _ERROR_CODES = (
     "NOAUTH", "WRONGPASS", "NOGROUP", "BUSYGROUP",
 )
 
+# Commands whose bodies execute arbitrary Python server-side; gated
+# behind enable_python_scripts (see RespServer.__init__ / _dispatch).
+_SCRIPT_CMDS = frozenset(
+    ("EVAL", "EVALSHA", "SCRIPT", "FCALL", "FCALL_RO", "FUNCTION")
+)
+
 
 def _encode_error(s: str) -> bytes:
     if s.split(" ", 1)[0] in _ERROR_CODES:
@@ -328,7 +334,8 @@ class RespServer:
 
     def __init__(self, client, host: str = "127.0.0.1", port: int = 0,
                  max_connections: int = 256, idle_timeout_s: float = 300.0,
-                 requirepass: Optional[str] = None):
+                 requirepass: Optional[str] = None,
+                 enable_python_scripts: Optional[bool] = None):
         self._client = client
         # Auth (SURVEY §2.1 config row): explicit arg wins, else the
         # client Config's requirepass key.  A network-exposed server
@@ -338,6 +345,25 @@ class RespServer:
             if requirepass is not None
             else getattr(client.config, "requirepass", None)
         )
+        # Scripting (EVAL/EVALSHA/SCRIPT/FUNCTION/FCALL): script bodies
+        # are arbitrary PYTHON — remote code execution for anyone who can
+        # reach the socket.  OFF unless explicitly enabled, and enabling
+        # REFUSES unless the server authenticates (requirepass) or binds
+        # loopback-only: an open 0.0.0.0 server with EVAL is an
+        # unauthenticated RCE, not a configuration choice.
+        want_scripts = (
+            enable_python_scripts
+            if enable_python_scripts is not None
+            else getattr(client.config, "enable_python_scripts", False)
+        )
+        if want_scripts and not (
+            self._requirepass or self._is_loopback(host)
+        ):
+            raise ValueError(
+                "enable_python_scripts on a non-loopback bind requires "
+                "requirepass: RESP scripts are arbitrary Python (RCE)"
+            )
+        self._scripts_enabled = bool(want_scripts)
         self.max_connections = max_connections
         self.idle_timeout_s = idle_timeout_s
         # Observability (ISSUE 1): per-command stats + SLOWLOG record
@@ -371,6 +397,10 @@ class RespServer:
         self._accept_thread.start()
 
     # -- plumbing ----------------------------------------------------------
+
+    @staticmethod
+    def _is_loopback(host: str) -> bool:
+        return host in ("localhost", "::1") or host.startswith("127.")
 
     def _accept_loop(self) -> None:
         while not self._closed:
@@ -605,6 +635,15 @@ class RespServer:
             # Pre-auth surface is AUTH/HELLO/QUIT/RESET, like Redis
             # (pooled clients RESET connections before authenticating).
             raise RespError("NOAUTH Authentication required.")
+        if name in _SCRIPT_CMDS and not self._scripts_enabled:
+            # Script bodies are Python: gated off by default (see
+            # __init__).  Checked at dispatch so MULTI-queued scripts hit
+            # the same wall at EXEC.
+            raise RespError(
+                "scripting is disabled (script bodies are Python; enable "
+                "with enable_python_scripts=True — requires requirepass "
+                "or a loopback bind)"
+            )
         if ctx.in_multi and name not in ("EXEC", "DISCARD", "MULTI", "RESET"):
             # Redis MULTI semantics: commands queue (validated for
             # existence only) and run contiguously at EXEC.  Pub/sub
@@ -3319,7 +3358,31 @@ class RespServer:
         argv = list(args[1 + numkeys :])
         return self._script_reply(self._run_script(source, keys, argv))
 
+    def _register_script(self, body: bytes) -> str:
+        """Cache a script body under sha1(body) — shared by EVAL (Redis
+        registers on first EVAL) and SCRIPT LOAD.  The script also
+        becomes invokable via script_service.eval(sha, ...)."""
+        import hashlib
+
+        source = body.decode()
+        sha = hashlib.sha1(body).hexdigest()
+        svc = self._client.get_script()
+        if not hasattr(svc, "_sources"):
+            svc._sources = {}
+        if sha not in svc._sources:
+            svc._sources[sha] = source
+            svc.register(
+                sha,
+                lambda client, keys, a, _src=source: self._run_script(
+                    _src, keys, a
+                ),
+            )
+        return sha
+
     def _cmd_EVAL(self, args):
+        # Register sha1(body) BEFORE executing, like redis-server: EVAL
+        # followed by EVALSHA of the same body must hit.
+        self._register_script(args[0])
         return self._eval_common(args[0].decode(), args[1:])
 
     def _cmd_EVALSHA(self, args):
@@ -3333,25 +3396,12 @@ class RespServer:
         return self._eval_common(src, args[1:])
 
     def _cmd_SCRIPT(self, args):
-        import hashlib
-
         sub = args[0].decode().upper()
         svc = self._client.get_script()
         if not hasattr(svc, "_sources"):
             svc._sources = {}
         if sub == "LOAD":
-            source = args[1].decode()
-            sha = hashlib.sha1(args[1]).hexdigest()
-            svc._sources[sha] = source
-            # Mapped onto ScriptService: Python API callers can invoke
-            # the same script via script_service.eval(sha, keys, args).
-            svc.register(
-                sha,
-                lambda client, keys, a, _src=source: self._run_script(
-                    _src, keys, a
-                ),
-            )
-            return _encode_bulk(sha.encode())
+            return _encode_bulk(self._register_script(args[1]).encode())
         if sub == "EXISTS":
             return _encode_array([
                 int(a.decode().lower() in svc._sources) for a in args[1:]

@@ -99,6 +99,10 @@ class SizeClassPool:
         )
         self._free: list[int] = list(range(self.capacity - 1, -1, -1))
         self.generation = 0  # bumped on every growth (jit cache key part)
+        # Optional growth callback (set by the engine's BucketPrewarmer):
+        # growth changes the state shape and with it every jit key, so
+        # the warm ladder must re-run against the new layout.
+        self.on_grow = None
         # Bumped (under the dispatch lock) by a live change_topology,
         # which rebuilds the free list wholesale: reap sequences that
         # detached an entry BEFORE the swap must not zero/free the row
@@ -143,6 +147,12 @@ class SizeClassPool:
         self.capacity = new_cap
         self.generation += 1
         self._free.extend(range(new_cap - 1, old_cap - 1, -1))
+        cb = self.on_grow
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:  # pragma: no cover — warm-path best effort
+                pass
 
     def used_rows(self) -> int:
         return self.capacity - len(self._free)

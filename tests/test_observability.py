@@ -155,9 +155,9 @@ def test_span_phase_sum_matches_end_to_end(tpu_client):
     assert spans, "coalesced launches must leave spans"
     for s in spans:
         phases = s.phases()
-        # The three lifecycle phases partition the end-to-end latency.
+        # The four lifecycle phases partition the end-to-end latency.
         assert set(phases) == {
-            "coalesce_wait", "device_dispatch", "d2h_fetch"
+            "coalesce_wait", "host_stage", "device_dispatch", "d2h_fetch"
         }
         assert sum(phases.values()) == pytest.approx(
             s.end_to_end(), rel=1e-6, abs=1e-6

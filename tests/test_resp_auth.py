@@ -17,7 +17,9 @@ def locked():
     client = redisson_tpu.create(
         Config().use_tpu_sketch(min_bucket=64).set_requirepass(PW)
     )
-    server = RespServer(client)
+    # Scripting enabled: requirepass is set (TestScriptsOnLockedServer
+    # exercises EVAL through the auth gate).
+    server = RespServer(client, enable_python_scripts=True)
     yield server
     server.close()
     client.shutdown()

@@ -14,7 +14,9 @@ from test_resp_server import RespClient
 @pytest.fixture
 def stack():
     client = redisson_tpu.create(Config().use_tpu_sketch(min_bucket=64))
-    server = RespServer(client)
+    # Scripting: loopback bind, so enabling is permitted (the gating
+    # itself is covered by tests/test_script_gating.py).
+    server = RespServer(client, enable_python_scripts=True)
     conn = RespClient(server.host, server.port)
     yield client, conn
     conn.close()

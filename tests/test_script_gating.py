@@ -1,0 +1,120 @@
+"""RESP scripting gate (ISSUE 2 satellites): EVAL/EVALSHA/SCRIPT/
+FUNCTION/FCALL are Python-RCE surfaces — disabled by default, enable
+refuses without requirepass or a loopback bind, and EVAL registers
+sha1(body) so EVALSHA works as in Redis."""
+
+import hashlib
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.serve.resp import RespServer
+
+from test_resp_server import RespClient
+
+
+@pytest.fixture
+def client():
+    cl = redisson_tpu.create(Config())
+    yield cl
+    cl.shutdown()
+
+
+def test_scripts_disabled_by_default(client):
+    srv = RespServer(client)
+    c = RespClient(srv.host, srv.port)
+    try:
+        assert c.cmd("PING") == "PONG"
+        for cmd in (
+            ("EVAL", "1 + 1", 0),
+            ("EVALSHA", "f" * 40, 0),
+            ("SCRIPT", "LOAD", "1"),
+            ("FUNCTION", "LIST"),
+            ("FCALL", "nope", 0),
+            ("FCALL_RO", "nope", 0),
+        ):
+            with pytest.raises(RuntimeError, match="scripting is disabled"):
+                c.cmd(*cmd)
+    finally:
+        c.close()
+        srv.close()
+
+
+def test_scripts_disabled_inside_multi(client):
+    """The gate fires at queue time (the _dispatch check precedes the
+    MULTI branch), so a disabled EVAL can never ride a transaction."""
+    srv = RespServer(client)
+    c = RespClient(srv.host, srv.port)
+    try:
+        assert c.cmd("MULTI") == "OK"
+        with pytest.raises(RuntimeError, match="scripting is disabled"):
+            c.cmd("EVAL", "1", 0)
+        assert c.cmd("DISCARD") == "OK"
+    finally:
+        c.close()
+        srv.close()
+
+
+def test_enable_on_loopback_without_password_is_allowed(client):
+    srv = RespServer(client, enable_python_scripts=True)  # 127.0.0.1
+    c = RespClient(srv.host, srv.port)
+    try:
+        assert c.cmd("EVAL", "1 + 2", 0) == 3
+    finally:
+        c.close()
+        srv.close()
+
+
+def test_enable_on_open_bind_without_password_refuses(client):
+    with pytest.raises(ValueError, match="requirepass"):
+        RespServer(client, host="0.0.0.0", enable_python_scripts=True)
+
+
+def test_enable_on_open_bind_with_password_is_allowed(client):
+    srv = RespServer(
+        client, host="0.0.0.0", requirepass="pw",
+        enable_python_scripts=True,
+    )
+    c = RespClient("127.0.0.1", srv.port)
+    try:
+        assert c.cmd("AUTH", "pw") == "OK"
+        assert c.cmd("EVAL", "2 + 2", 0) == 4
+    finally:
+        c.close()
+        srv.close()
+
+
+def test_config_flag_enables_scripts(client):
+    client.config.enable_python_scripts = True
+    srv = RespServer(client)
+    c = RespClient(srv.host, srv.port)
+    try:
+        assert c.cmd("EVAL", "len(ARGV)", 0, "a", "b") == 2
+    finally:
+        c.close()
+        srv.close()
+        client.config.enable_python_scripts = False
+
+
+def test_eval_registers_sha_for_evalsha(client):
+    """EVAL then EVALSHA of the same body must hit, like redis-server
+    (EVAL caches the script under sha1(body))."""
+    srv = RespServer(client, enable_python_scripts=True)
+    c = RespClient(srv.host, srv.port)
+    try:
+        body = b"int(ARGV[0]) * 3"
+        sha = hashlib.sha1(body).hexdigest()
+        assert c.cmd("SCRIPT", "EXISTS", sha) == [0]
+        assert c.cmd("EVAL", body, 0, "5") == 15
+        assert c.cmd("SCRIPT", "EXISTS", sha) == [1]
+        assert c.cmd("EVALSHA", sha, 0, "7") == 21
+        # Registered on the Python-side ScriptService too.
+        assert client.get_script().eval(sha, [], [b"2"]) == 6
+        # SCRIPT FLUSH still clears EVAL-registered scripts.
+        assert c.cmd("SCRIPT", "FLUSH") == "OK"
+        with pytest.raises(RuntimeError, match="NOSCRIPT"):
+            c.cmd("EVALSHA", sha, 0, "1")
+    finally:
+        c.close()
+        srv.close()
