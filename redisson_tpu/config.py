@@ -65,6 +65,20 @@ class TpuSketchConfig:
         # Pools whose state exceeds this are not pre-warmed (a warm pass
         # needs a scratch state of the same shape on device).
         self.prewarm_max_state_bytes = 1 << 28
+        # Self-healing dispatch (ISSUE 3): per-(shard, opcode) circuit
+        # breakers over the coalescer's dispatch failures —
+        # ``breaker_failure_threshold`` consecutive failures OPEN the
+        # circuit (affected sketches fail over to the host golden
+        # mirror); after ``breaker_open_ms`` a probe dispatch tests the
+        # device and, on success, mirrored state reconciles back.
+        self.breaker_failure_threshold = 5
+        self.breaker_open_ms = 1000
+        # Dispatch retry backoff: the coalescer re-enqueues a failed
+        # segment with a jittered exponential deadline (base =
+        # retry_interval doubling per attempt, capped here; jitter is a
+        # uniform ±fraction) instead of sleeping the flush thread.
+        self.retry_max_backoff_ms = 2000
+        self.retry_jitter = 0.2
         # Device-side result mailbox: the completer concatenates pending
         # launches' packed results on device and fetches them in ONE D2H
         # (PROFILE.md remaining-lever 2) — each host fetch costs a full
@@ -134,6 +148,12 @@ class Config:
         # every RESP connection must AUTH (or HELLO ... AUTH) before any
         # other command.  None = open, the redis-server default.
         self.requirepass: Optional[str] = None
+        # RESP script execution watchdog (the busy-reply-threshold
+        # analog): a script running longer than this makes the server
+        # answer other connections with BUSY (SCRIPT KILL remains
+        # available) instead of silently queueing them behind the grid
+        # lock.  0 disables the BUSY surface (scripts may block forever).
+        self.script_timeout_ms = 5000
         # RESP scripting (EVAL/EVALSHA/SCRIPT/FUNCTION/FCALL): script
         # bodies are arbitrary PYTHON, i.e. remote code execution for
         # anyone who can reach the socket — OFF by default, and the
@@ -184,6 +204,7 @@ class Config:
         "snapshot_interval_s",
         "requirepass",
         "enable_python_scripts",
+        "script_timeout_ms",
     )
 
     def to_dict(self) -> dict:

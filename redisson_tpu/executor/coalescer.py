@@ -36,6 +36,7 @@ from __future__ import annotations
 import concurrent.futures
 import contextlib
 import queue
+import random
 import threading
 import time
 from collections import deque
@@ -66,13 +67,20 @@ def _op_label(key) -> str:
 class _Segment:
     __slots__ = (
         "key", "pool_key", "dispatch", "chunks", "metas", "futures",
-        "nops", "born", "span",
+        "nops", "born", "span", "not_before", "attempts",
     )
 
     def __init__(self, key, pool_key, dispatch):
         self.key = key
         self.pool_key = pool_key
         self.dispatch = dispatch  # fn(list_of_chunk_arrays) -> LazyResult
+        # Retry state (self-healing dispatch, ISSUE 3): a segment whose
+        # dispatch failed transiently is PARKED — re-enqueued with a
+        # ``not_before`` deadline (jittered exponential backoff) instead
+        # of sleeping the flush thread, so healthy pools keep flushing
+        # while this one backs off.
+        self.not_before = None
+        self.attempts = 0
         self.chunks: list[tuple] = []  # per-submit tuples of op arrays
         # Per-submit metadata (parallel to chunks) for run-length dispatch:
         # values constant across one submit (tenant row, m, op flag, const
@@ -129,7 +137,9 @@ class BatchCoalescer:
                  adaptive_inflight: bool = True, min_inflight: int = 2,
                  adaptive_window: bool = True, min_window_us: int = 0,
                  max_window_us: int = 0,
-                 group_collect: Optional[Callable] = None, obs=None):
+                 group_collect: Optional[Callable] = None, obs=None,
+                 retry_max_backoff_s: float = 2.0,
+                 retry_jitter: float = 0.2, health=None):
         self.window_s = batch_window_us / 1e6
         self.max_batch = max_batch
         # Adaptive flush window: ``batch_window_us`` is the BASE; an
@@ -157,9 +167,17 @@ class BatchCoalescer:
         self.obs = obs
         # RedisExecutor-style retry budget for dispatch-time failures
         # (executor/failures.py): state is not consumed when the executor
-        # method raises synchronously, so re-dispatch is safe.
+        # method raises synchronously, so re-dispatch is safe.  Retries
+        # back off EXPONENTIALLY with jitter and park the segment in the
+        # queue (not the flush thread) — see _flush / _next_locked.
         self.retry_attempts = max(1, retry_attempts)
         self.retry_interval_s = retry_interval_s
+        self.retry_max_backoff_s = max(retry_interval_s, retry_max_backoff_s)
+        self.retry_jitter = max(0.0, min(1.0, retry_jitter))
+        self._rng = random.Random(0x5EEDBACC)  # jitter only — not fairness
+        # Optional DispatchHealth (executor/health.py): per-(shard, op)
+        # circuit breakers.  None → standalone coalescer, retry-only.
+        self._health = health
         # Engine-side backpressure (the pooled-acquire role): submit()
         # blocks while this many ops sit queued ahead of the flush thread.
         self.max_queued_ops = max_queued_ops if max_queued_ops > 0 else 8 * max_batch
@@ -311,32 +329,57 @@ class BatchCoalescer:
 
     # -- flush thread ------------------------------------------------------
 
-    def _pop_locked(self) -> _Segment:
-        seg = self._order.popleft()
+    def _detach_locked(self, seg: _Segment) -> None:
+        """Remove a segment from the queue bookkeeping (it is no longer
+        joinable and no longer counts toward backpressure)."""
         if self._open.get(seg.key) is seg:
             del self._open[seg.key]
         if self._pool_tail.get(seg.pool_key) is seg:
             del self._pool_tail[seg.pool_key]
-        if not self._order:
-            self._hurry = False
-        self._inflight += 1
         if seg.nops:
             self._queued_ops -= seg.nops
             self._admit.notify_all()
+
+    def _pop_seg_locked(self, seg: _Segment) -> _Segment:
+        self._order.remove(seg)
+        self._detach_locked(seg)
+        seg.not_before = None
+        if not self._order:
+            self._hurry = False
+        self._inflight += 1
         return seg
 
-    def _merge_consecutive_locked(self, head: _Segment) -> _Segment:
-        """Fold consecutive queued segments with the same key into ``head``
-        (up to max_batch): a backlog becomes one larger launch instead of a
-        deep dispatch queue.  Only the immediate run at the front is
-        merged, so per-pool arrival order is trivially preserved (any
-        same-pool segment is same-key here — segment keys embed the pool)."""
-        while self._order:
-            nxt = self._order[0]
-            if nxt.key != head.key or head.nops + nxt.nops > self.max_batch:
+    def _requeue_locked(self, seg: _Segment, not_before: float) -> None:
+        """Park a transiently-failed segment back at the FRONT of the
+        queue with a backoff deadline.  Front keeps it ahead of every
+        later segment of its own pool (arrival order); other pools skip
+        past it via the parked-pool scan in _next_locked, so one failing
+        pool never stalls healthy traffic (ISSUE 3 satellite: the old
+        in-place ``time.sleep`` blocked EVERY queue)."""
+        seg.not_before = not_before
+        self._inflight -= 1
+        if seg.nops:
+            self._queued_ops += seg.nops
+        self._order.appendleft(seg)
+        self._wake.notify()
+
+    def _merge_consecutive_locked(self, head: _Segment, i: int) -> _Segment:
+        """Fold queued segments with the same key immediately FOLLOWING
+        ``head``'s old position into it (up to max_batch): a backlog
+        becomes one larger launch instead of a deep dispatch queue.  Only
+        the consecutive run is merged — a different-key segment (possibly
+        the same pool on another op path) acts as an order fence, so
+        per-pool arrival order is preserved."""
+        while i < len(self._order):
+            nxt = self._order[i]
+            if (
+                nxt.key != head.key
+                or head.nops + nxt.nops > self.max_batch
+                or nxt.not_before is not None
+            ):
                 break
-            self._pop_locked()
-            self._inflight -= 1  # merged segs dispatch as one launch
+            del self._order[i]
+            self._detach_locked(nxt)
             if nxt.span is not None:
                 nxt.span.abandon()  # its ops ride the head's span
             head.chunks.extend(nxt.chunks)
@@ -345,7 +388,46 @@ class BatchCoalescer:
             for fut, start, n, tenant in nxt.futures:
                 head.futures.append((fut, head.nops + start, n, tenant))
             head.nops += nxt.nops
+        if not self._order:
+            self._hurry = False
         return head
+
+    def _next_locked(self, now: float):
+        """(segment, index, deadline): the next dispatchable segment
+        honoring per-pool FIFO around PARKED (retry-backoff) segments.
+        A parked segment blocks its own pool's later segments (read-your-
+        writes) but nothing else; a barrier never overtakes a parked
+        segment submitted before it.  Returns (None, -1, deadline) when
+        nothing is ready — ``deadline`` is the earliest instant something
+        becomes actionable (backoff expiry or flush-window maturity)."""
+        parked: set = set()
+        deadline = None
+        for i, seg in enumerate(self._order):
+            if seg.dispatch is None:  # barrier
+                if parked:
+                    break  # waits for parked segments ahead of it
+                return seg, i, None
+            if seg.pool_key in parked:
+                continue
+            nb = seg.not_before
+            if nb is not None and nb > now and not self._closed:
+                parked.add(seg.pool_key)
+                deadline = nb if deadline is None else min(deadline, nb)
+                continue
+            if (
+                seg.nops >= self.max_batch
+                or seg.attempts > 0
+                or self._closed
+                or self._hurry
+                or now - seg.born >= self.window_s
+            ):
+                return seg, i, None
+            # Young and small: it keeps absorbing ops until the window
+            # matures.  Later segments are younger still — stop scanning.
+            d = seg.born + self.window_s
+            deadline = d if deadline is None else min(deadline, d)
+            break
+        return None, -1, deadline
 
     def _update_window_locked(self) -> None:
         """Adaptive flush window (called from the flush loop, under the
@@ -386,22 +468,21 @@ class BatchCoalescer:
                 if not self._order:
                     continue
                 self._update_window_locked()
-                head = self._order[0]
-                age = time.monotonic() - head.born
-                if (
-                    head.nops < self.max_batch
-                    and age < self.window_s
-                    and not self._closed
-                    and not self._hurry
-                ):
-                    # Young and small: wait out the window (or a notify from
-                    # a full batch / a blocking caller's hint).  The head
-                    # keeps absorbing ops while it waits.
-                    self._wake.wait(timeout=self.window_s - age)
+                now = time.monotonic()
+                seg, idx, deadline = self._next_locked(now)
+                if seg is None:
+                    # Everything queued is parked (backoff) or young:
+                    # wait until the earliest deadline or a notify from a
+                    # full batch / a blocking caller's hint.
+                    timeout = (
+                        0.05 if deadline is None
+                        else min(max(deadline - now, 0.0005), 0.05)
+                    )
+                    self._wake.wait(timeout=timeout)
                     continue
-                seg = self._pop_locked()
+                self._pop_seg_locked(seg)
                 if seg.dispatch is not None:
-                    seg = self._merge_consecutive_locked(seg)
+                    seg = self._merge_consecutive_locked(seg, idx)
             cols = stage_exc = None
             if seg.dispatch is not None:
                 # Stage FIRST (host-side pad/concat of the segment's
@@ -469,6 +550,32 @@ class BatchCoalescer:
                         self._good_streak = 0
             self._inflight_cv.notify_all()
 
+    def _backoff_s(self, attempts: int) -> float:
+        """Jittered exponential backoff for dispatch retries: base grows
+        2x per attempt, capped at retry_max_backoff_s, scaled by a
+        uniform ±retry_jitter factor (decorrelates a fleet of retrying
+        segments so they never thundering-herd the device)."""
+        base = min(
+            self.retry_interval_s * (2 ** max(0, attempts - 1)),
+            self.retry_max_backoff_s,
+        )
+        if self.retry_jitter:
+            base *= 1.0 + self.retry_jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, base)
+
+    def _fail_futures(self, seg: _Segment, e: BaseException) -> None:
+        if seg.span is not None:
+            seg.span.nops = seg.nops
+            seg.span.stamp("device_dispatch")
+            seg.span.finish(error=True)
+        for fut, start, n, _ in seg.futures:
+            if fut.set_running_or_notify_cancel():
+                fut.set_exception(
+                    e
+                    if isinstance(e, RetryExhaustedError)
+                    else KernelExecutionError(seg.key, start, n, seg.nops, e)
+                )
+
     def _flush(self, seg: _Segment, cols=None, stage_exc=None) -> None:
         t0 = time.monotonic()
         try:
@@ -510,32 +617,57 @@ class BatchCoalescer:
                     return jax.profiler.TraceAnnotation(ann_name)
             else:
                 _ann = contextlib.nullcontext
+            op = _op_label(seg.key)
+            h = self._health
+            if h is not None and not h.allow_dispatch(op):
+                # Circuit OPEN for this op path: fail fast — the device
+                # is not touched, callers get the typed retry surface
+                # with the breaker as cause (the engine's degraded-mode
+                # failover keeps NEW ops off this path entirely).
+                from redisson_tpu.executor.health import CircuitOpenError
+
+                raise RetryExhaustedError(
+                    seg.attempts + 1, CircuitOpenError(0, op)
+                )
             lazy = None
-            last_err: Optional[BaseException] = None
-            for attempt in range(self.retry_attempts):
-                try:
-                    with fetch_ctx, _ann():
-                        if seg.metas is not None:
-                            lazy = seg.dispatch(cols, seg.metas)
-                        else:
-                            lazy = seg.dispatch(cols)
-                    last_err = None
-                    break
-                except NonRetryableDispatchError as e:
-                    # Part of the launch already applied (compound dispatch
-                    # split by a mid-segment migration): re-dispatch would
-                    # double-apply the committed part.
-                    last_err = e
-                    break
-                except Exception as e:
-                    # Dispatch-time failure: pool state not consumed (the
-                    # executor method raised before returning) — retry
-                    # with backoff, the RedisExecutor loop shape.
-                    last_err = e
-                    if attempt + 1 < self.retry_attempts:
-                        time.sleep(self.retry_interval_s * (attempt + 1))
-            if last_err is not None:
-                raise RetryExhaustedError(self.retry_attempts, last_err)
+            try:
+                with fetch_ctx, _ann():
+                    if seg.metas is not None:
+                        lazy = seg.dispatch(cols, seg.metas)
+                    else:
+                        lazy = seg.dispatch(cols)
+            except NonRetryableDispatchError as e:
+                # Part of the launch already applied (compound dispatch
+                # split by a mid-segment migration): re-dispatch would
+                # double-apply the committed part.
+                if h is not None:
+                    h.record_failure(op, e)
+                raise RetryExhaustedError(seg.attempts + 1, e)
+            except Exception as e:
+                # Dispatch-time failure: pool state not consumed (the
+                # executor method raised before returning) — safe to
+                # re-dispatch.  Instead of sleeping HERE (which would
+                # stall every queue behind one failing segment), park the
+                # segment with a jittered-exponential-backoff deadline
+                # and return the flush thread to healthy traffic.
+                if h is not None:
+                    h.record_failure(op, e)
+                seg.attempts += 1
+                if seg.attempts >= self.retry_attempts or (
+                    h is not None and not h.allow_dispatch(op)
+                ):
+                    raise RetryExhaustedError(seg.attempts, e)
+                backoff = self._backoff_s(seg.attempts)
+                with self._lock:
+                    self._requeue_locked(seg, time.monotonic() + backoff)
+                self._release_launch_slot(None)
+                return
+            # NOTE: no record_success here — a dispatch enqueue proving
+            # anything would let a device whose every RESULT fetch fails
+            # reset the breaker's consecutive-failure count each launch
+            # (enqueue-ok/fetch-fail alternation never opens the
+            # circuit).  Success is only proven at COMPLETION; the
+            # completer records it.
             if seg.span is not None:
                 seg.span.stamp("device_dispatch")  # enqueue done, async
             with self._lock:
@@ -552,17 +684,7 @@ class BatchCoalescer:
                 # releasing one that was never taken would hand another
                 # launch's slot back early.
                 self._release_launch_slot(None)
-            if seg.span is not None:
-                seg.span.nops = seg.nops
-                seg.span.stamp("device_dispatch")
-                seg.span.finish(error=True)
-            for fut, start, n, _ in seg.futures:
-                if fut.set_running_or_notify_cancel():
-                    fut.set_exception(
-                        e
-                        if isinstance(e, RetryExhaustedError)
-                        else KernelExecutionError(seg.key, start, n, seg.nops, e)
-                    )
+            self._fail_futures(seg, e)
 
     def _complete_loop(self) -> None:
         stop = False
@@ -606,6 +728,8 @@ class BatchCoalescer:
                         genuine=genuine,
                     )
                     first = False
+                    if self._health is not None:
+                        self._health.record_success(_op_label(seg.key))
                     if seg.span is not None:
                         seg.span.nops = seg.nops
                         seg.span.stamp("d2h_fetch")
@@ -626,6 +750,8 @@ class BatchCoalescer:
                     # Completion-time failure: the device batch died after
                     # donation — NOT retryable; attribute each caller's op
                     # range within the failed launch (partial-batch surface).
+                    if self._health is not None:
+                        self._health.record_failure(_op_label(seg.key), e)
                     self._release_launch_slot(None)
                     first = False
                     if seg.span is not None:

@@ -38,6 +38,8 @@ import queue
 import threading
 from typing import Callable, Optional
 
+from redisson_tpu import chaos as _chaos
+
 import numpy as np
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
@@ -308,6 +310,8 @@ class BucketPrewarmer:
                 return
             pool, warm_fn, bucket = task
             try:
+                if _chaos.ENABLED:  # prewarm-compile fault point (ISSUE 3)
+                    _chaos.fire("prewarm")
                 if not getattr(self._executor, "_retired", False):
                     warm_fn(self._executor, self._warm_pool_for(pool), bucket)
                     self.warmed += 1

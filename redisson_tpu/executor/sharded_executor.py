@@ -35,6 +35,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from redisson_tpu import chaos as _chaos
 from redisson_tpu.ops import bitops
 from redisson_tpu.ops import bitset as bitset_ops
 from redisson_tpu.ops import golden
@@ -172,7 +173,15 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         return jax.device_put(new_state, self.ctx.state_sharding)
 
     def state_from_host(self, pool, arr: np.ndarray) -> None:
-        pool.state = jax.device_put(jnp.asarray(arr), self.ctx.state_sharding)
+        dev = jnp.asarray(arr)
+        from redisson_tpu.executor.tpu_executor import _host_may_alias
+
+        if _host_may_alias():
+            # Same CPU zero-copy hazard as the base class: donated state
+            # must never wrap host-owned memory (see the single-device
+            # state_from_host).
+            dev = jnp.copy(dev)
+        pool.state = jax.device_put(dev, self.ctx.state_sharding)
 
     # -- builder cache (mesh.py builders are already jitted; jax handles
     # shape polymorphism internally, so keys don't need batch sizes) -------
@@ -198,6 +207,8 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
         device_put — the sharded twin of the single-device fused staging
         path: per-dispatch [S, Bp] np.full allocations become buffer
         reuse, and the transfer's host block is pinned across flushes."""
+        if _chaos.ENABLED:  # sharded scatter-staging fault point (ISSUE 3)
+            _chaos.fire("h2d.scatter", data=col)
         col = np.asarray(col)
         shape = (p.S, p.Bp) + col.shape[1:]
         count = int(np.prod(shape))

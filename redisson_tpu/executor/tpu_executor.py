@@ -28,6 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from redisson_tpu import chaos as _chaos
 from redisson_tpu.ops import bitops
 from redisson_tpu.ops import bitset as bitset_ops
 from redisson_tpu.ops import bloom as bloom_ops
@@ -122,6 +123,12 @@ class LazyResult:
         if self._done is None:
             v = self._value
             if isinstance(v, jax.Array):
+                # Completion/D2H fault point (ISSUE 3): only a REAL
+                # device fetch can fault here — host-materialized
+                # results (ImmediateResult, degraded-mirror answers)
+                # have no transfer to break.
+                if _chaos.ENABLED:
+                    _chaos.fire("fetch")
                 v = np.asarray(v)
             self.resolve_from(v)
         return self._done
@@ -192,6 +199,8 @@ def _host_may_alias() -> bool:
 def _put_staged(slot: "_StagingSlot", view):
     """Ship a packed staging view: direct (pinned, pending-tracked) on
     accelerators; via a private copy on the zero-copy CPU backend."""
+    if _chaos.ENABLED:  # staged-H2D fault point (ISSUE 3)
+        _chaos.fire("h2d.staging", data=view)
     if _host_may_alias():
         return jax.device_put(view.copy())
     dev = jax.device_put(view)
@@ -347,7 +356,18 @@ class TpuCommandExecutor:
         return np.asarray(st)
 
     def state_from_host(self, pool, arr: np.ndarray) -> None:
-        pool.state = jnp.asarray(arr)
+        dev = jnp.asarray(arr)
+        if _host_may_alias():
+            # CPU backend: jnp.asarray ZERO-COPIES a suitably aligned
+            # numpy buffer — the jax.Array WRAPS host memory (verified:
+            # writes through the numpy array appear in the device view).
+            # Pool state is consumed by DONATING kernels, so it must be
+            # an XLA-owned buffer: a snapshot-restored state that aliased
+            # the np.load scratch produced wholesale garbage rows on the
+            # first donated dispatch (flaky pre-ISSUE-3; timing-dependent
+            # via the host allocator).  jnp.copy materializes ownership.
+            dev = jnp.copy(dev)
+        pool.state = dev
 
     # -- jit plumbing ------------------------------------------------------
 
@@ -1538,10 +1558,16 @@ def _locked(fn):
 
     name = fn.__name__
     annotation = "rtpu:" + name  # device-trace label (one str, not per call)
+    # Chaos fault point, one interned string per method (zero per-call
+    # allocation): rules can target one method ("dispatch.bloom_mixed")
+    # or the whole boundary ("dispatch").
+    fault_point = "dispatch." + name
 
     @functools.wraps(fn)
     def wrapper(self, *args, **kwargs):
         with self._dispatch_lock:
+            if _chaos.ENABLED:
+                _chaos.fire(fault_point)
             # A live change_topology may have swapped this executor out
             # while the caller was blocked on the lock (callers read
             # ``engine.executor`` BEFORE acquiring it).  Running the old

@@ -385,15 +385,17 @@ class Stream(GridObject):
 
     def auto_claim(self, group: str, consumer: str, min_idle_ms: int,
                    start: str = "0-0", count: int = 100,
-                   with_cursor: bool = False):
+                   with_cursor: bool = False, justid: bool = False):
         """→ XAUTOCLAIM: claim up to ``count`` idle entries from ``start``.
         Ownership transfers ONLY for entries actually returned — claiming
         is done under one lock pass that stops at ``count``, so no entry
         is silently reassigned (and its idle clock reset) invisibly.
-        ``with_cursor`` additionally returns the Redis next-cursor: the
+        ``with_cursor`` additionally returns the Redis next-cursor — the
         id to continue from when COUNT truncated the sweep, '0-0' when
         the whole PEL was examined (callers looping until 0-0 must not
-        be told a truncated sweep was exhaustive)."""
+        be told a truncated sweep was exhaustive) — plus the ids DELETED
+        from the PEL during the sweep (entries removed from the stream
+        since delivery), the third element of the XAUTOCLAIM reply."""
         now_ms = int(time.time() * 1000)
         lo = _parse_id(start)
         with self._store.lock:
@@ -402,6 +404,7 @@ class Stream(GridObject):
             st: _StreamValue = e.value
             g["consumers"].add(consumer)
             out = []
+            deleted = []
             next_cursor = "0-0"
             pending_sorted = sorted(g["pending"])
             for i, t in enumerate(pending_sorted):
@@ -413,9 +416,14 @@ class Stream(GridObject):
                 f = st.entries.get(t)
                 if f is None:  # deleted entry: drop from PEL (Redis 6.2+)
                     del g["pending"][t]
+                    deleted.append(_fmt_id(t))
                     continue
                 p.update(consumer=consumer, time_ms=now_ms)
-                p["count"] += 1
+                if not justid:
+                    # JUSTID leaves the delivery counter untouched (Redis
+                    # contract): an inspection sweep must not push entries
+                    # toward dead-letter thresholds keyed on the count.
+                    p["count"] += 1
                 out.append((_fmt_id(t), self._decode(f)))
                 if len(out) >= count:
                     # Truncated: continue from the id AFTER this one.
@@ -425,7 +433,7 @@ class Stream(GridObject):
                         next_cursor = _fmt_id(later[0])
                     break
             if with_cursor:
-                return next_cursor, out
+                return next_cursor, out, deleted
             return out
 
 

@@ -28,6 +28,8 @@ from typing import Optional
 
 import numpy as np
 
+from redisson_tpu import chaos as _chaos
+
 _DUMP_VERSION = 2
 _DUMP_MAGIC = b"RTPU"
 _SNAP_META = "sketch_meta.json"
@@ -63,7 +65,10 @@ def safe_load_npy(buf: io.BytesIO) -> np.ndarray:
 
 
 class SketchDurabilityMixin:
-    """Requires: self.registry, self.executor, self._drain(), self.delete().
+    """Requires: self.registry, self.executor, self._drain(), self.delete(),
+    and the degraded-mirror surface (self._mirrors, self._mirror_lock,
+    self._host_row — engines.py): persistence taken while a breaker is
+    open must capture mirror-acked writes, not the stale device rows.
     """
 
     @staticmethod
@@ -185,8 +190,12 @@ class SketchDurabilityMixin:
         entry = self._live_lookup(name)
         if entry is None:
             return None
-        self._drain()
-        row = self.executor.read_row(entry.pool, entry.row)
+        if _chaos.ENABLED:  # snapshot-I/O fault point (ISSUE 3)
+            _chaos.fire("snapshot.save")
+        # Mirror-aware: a degraded entry's truth is its host mirror —
+        # dumping the device row would roll back every mirror-acked
+        # write on a later RESTORE.
+        row = self._host_row(entry)
         header = json.dumps(
             {
                 "v": _DUMP_VERSION,
@@ -208,6 +217,8 @@ class SketchDurabilityMixin:
     def restore(self, name: str, data: bytes, replace: bool = False) -> None:
         """Recreate an object from ``dump`` bytes.  BUSYKEY analog: raises
         if the name exists and ``replace`` is False."""
+        if _chaos.ENABLED:  # snapshot-I/O fault point (ISSUE 3)
+            _chaos.fire("snapshot.load", data=data)
         if len(data) < 8 or data[:4] != _DUMP_MAGIC:
             raise ValueError("not a sketch dump (bad magic)")
         (hlen,) = struct.unpack("<I", data[4:8])
@@ -246,15 +257,21 @@ class SketchDurabilityMixin:
         """Atomic full-state snapshot: every pool array D2H + registry
         metadata.  Written to tmp files then renamed, so a concurrent
         restore never sees a torn snapshot."""
+        if _chaos.ENABLED:  # snapshot-I/O fault point (ISSUE 3)
+            _chaos.fire("snapshot.save")
         os.makedirs(directory, exist_ok=True)
         self._drain()
-        # Lock ORDER: registry._lock strictly before the dispatch lock —
-        # the same order try_create/bloom_replicate use (registry then
-        # pool.alloc_row).  Taking them inverted here deadlocked a periodic
-        # snapshot against any concurrent object creation (ADVICE r3 high).
-        # Holding both also makes the capture point-in-time consistent:
-        # no tenant create/delete/grow can interleave with the D2H reads.
-        with self.registry._lock, self.executor._dispatch_lock:
+        # Lock ORDER: mirror lock, then registry._lock, then the dispatch
+        # lock — the registry/dispatch order is what try_create/
+        # bloom_replicate use (registry then pool.alloc_row; inverting
+        # deadlocked a periodic snapshot against object creation, ADVICE
+        # r3 high), and _reconcile_kind establishes mirror BEFORE both
+        # (it holds the mirror lock across registry.lookup + write_row).
+        # Holding all three makes the capture point-in-time consistent:
+        # no tenant create/delete/grow, no mirror op or reconcile, can
+        # interleave with the D2H reads.
+        with self._mirror_lock, self.registry._lock, \
+                self.executor._dispatch_lock:
             pools = self.registry.pools()
             arrays = {}
             pool_meta = []
@@ -268,6 +285,32 @@ class SketchDurabilityMixin:
                         "capacity": pool.capacity,
                     }
                 )
+            if self._mirrors:
+                # Degraded overlay: a mirrored entry's truth lives host-
+                # side — patch its rows (primary + replicas) into the
+                # captured arrays so a snapshot taken mid-degradation
+                # keeps mirror-acked writes instead of the stale device
+                # state.
+                s_cur = getattr(self.executor, "S", 1)
+                thresh = getattr(
+                    self.config.tpu_sketch, "mbit_threshold_words", 0
+                )
+                pool_idx = {id(p): i for i, p in enumerate(pools)}
+                for e in self.registry.entries():
+                    mirror = self._mirrors.get(e.name)
+                    if mirror is None:
+                        continue
+                    i = pool_idx[id(e.pool)]
+                    if not arrays[f"pool_{i}"].flags.writeable:
+                        # state_to_host returns a read-only view of the
+                        # device buffer — copy before patching.
+                        arrays[f"pool_{i}"] = arrays[f"pool_{i}"].copy()
+                    data = np.asarray(mirror.encode(e.pool.row_units))
+                    for r in self._entry_rows(e):
+                        self._overlay_row(
+                            arrays[f"pool_{i}"], pool_meta[i],
+                            s_cur, thresh, r, data,
+                        )
             tenants = [
                 {
                     "name": e.name,
@@ -323,6 +366,8 @@ class SketchDurabilityMixin:
         pools_path = os.path.join(directory, _SNAP_POOLS)
         if not (os.path.exists(meta_path) and os.path.exists(pools_path)):
             return False
+        if _chaos.ENABLED:  # snapshot-I/O fault point (ISSUE 3)
+            _chaos.fire("snapshot.load")
         with open(meta_path) as f:
             meta = json.load(f)
         # Validate candidate tables before any mutation (see restore()).
@@ -661,6 +706,40 @@ class SketchDurabilityMixin:
             local = row // s_old
             return arr[row % s_old, local * u : (local + 1) * u]
         return get
+
+    @staticmethod
+    def _overlay_row(
+        arr: np.ndarray, pm: dict, s: int, mbit_thresh: int,
+        row: int, data: np.ndarray,
+    ) -> None:
+        """Inverse of ``_extract_rows`` for ONE row: write ``data`` into
+        a captured host pool array at ``row``'s position in the CURRENT
+        executor layout (flat single-device, row-sharded, or m-sharded).
+        Used by snapshot() to overlay degraded-mirror state."""
+        from redisson_tpu.tenancy import PoolKind
+        from redisson_tpu.tenancy.registry import spec_for
+
+        spec = spec_for(pm["kind"], tuple(pm["class_key"]))
+        u = spec.row_units
+        data = np.asarray(data)[:u]
+        if s == 1:
+            arr[row * u : (row + 1) * u] = data
+            return
+        mbit = (
+            pm["kind"] == PoolKind.BITSET
+            and mbit_thresh
+            and u >= mbit_thresh
+            and u % s == 0
+        )
+        if mbit:
+            wl = u // s
+            for sh in range(s):
+                arr[sh, row * wl : (row + 1) * wl] = (
+                    data[sh * wl : (sh + 1) * wl]
+                )
+            return
+        local = row // s
+        arr[row % s, local * u : (local + 1) * u] = data
 
     def _start_snapshotter(self, directory: str, interval_s: float) -> None:
         stop = threading.Event()

@@ -288,6 +288,34 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
         self.obs = Observability()
         self.executor.obs = self.obs
+        # Self-healing dispatch (ISSUE 3): per-(shard, opcode) circuit
+        # breakers + per-executor health machine.  When a breaker opens,
+        # affected sketches fail over to host golden mirrors
+        # (objects/degraded.py) and reconcile back on close.
+        from redisson_tpu.executor.health import DispatchHealth
+
+        self.health = DispatchHealth(
+            failure_threshold=config.tpu_sketch.breaker_failure_threshold,
+            open_s=config.tpu_sketch.breaker_open_ms / 1000.0,
+        )
+        self.health.reconcile_cb = self._reconcile_kind
+        self._mirrors: dict = {}  # name -> degraded-mode mirror
+        self._mirror_lock = threading.RLock()
+        # Bumped (under the lock) whenever reconcile writes mirrors back
+        # to the device: a seed row read before the bump may predate the
+        # write-back and must be discarded (see _degraded).
+        self._mirror_epoch = 0
+        # Chaos-injection accounting lands in this engine's registry
+        # (module-level engine: the most recent engine owns the counter).
+        # The closure is remembered so shutdown() can unhook it — a
+        # module-global observer would otherwise pin this engine (and
+        # its device pools) past shutdown.
+        from redisson_tpu import chaos as _chaos
+
+        self._chaos_observer = (
+            lambda point, kind: self.obs.faults_injected.inc((point, kind))
+        )
+        _chaos.set_observer(self._chaos_observer)
         self.topk = TopKStore()
         # Wired by the client to the grid store's ``exists`` — one logical
         # keyspace across both backends (WRONGTYPE on cross-backend reuse).
@@ -320,6 +348,11 @@ class TpuSketchEngine(SketchDurabilityMixin):
                     else None
                 ),
                 obs=self.obs,
+                retry_max_backoff_s=(
+                    config.tpu_sketch.retry_max_backoff_ms / 1000.0
+                ),
+                retry_jitter=config.tpu_sketch.retry_jitter,
+                health=self.health,
             )
         else:
             # Direct-dispatch mode: the executor is the only recorder of
@@ -406,6 +439,18 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 "bucket warm tasks not yet compiled",
                 self.prewarmer.pending,
             )
+        # Self-healing dispatch (ISSUE 3): breaker + degradation gauges.
+        reg.gauge_callback(
+            "rtpu_breaker_state",
+            "circuit state by shard/op (0 closed, 1 open, 2 half-open)",
+            self.health.board.state_codes,
+            labelnames=("shard", "op"),
+        )
+        reg.gauge_callback(
+            "rtpu_degraded_objects",
+            "sketches currently serving from the host golden mirror",
+            lambda: len(self._mirrors),
+        )
 
         # One registry.stats() snapshot serves BOTH gauges per scrape:
         # stats() holds the tenancy lock (contended by the serving
@@ -460,6 +505,10 @@ class TpuSketchEngine(SketchDurabilityMixin):
         )
 
     def shutdown(self) -> None:
+        from redisson_tpu import chaos as _chaos
+
+        _chaos.unset_observer(self._chaos_observer)
+        self.health.shutdown()
         self._stop_snapshotter()
         self._stop_sweeper()
         if self.config.snapshot_dir:
@@ -492,6 +541,149 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if self.prewarmer is None:
             return True
         return self.prewarmer.wait_idle(timeout)
+
+    # -- graceful degradation (ISSUE 3): host golden-mirror failover -------
+
+    def _degraded(self, entry) -> bool:
+        """True when ``entry`` must serve from its host mirror.  Healthy
+        fast path is two attribute reads and a branch — no lock, no dict
+        probe — until the first breaker ever opens.
+
+        Seeding a missing mirror runs OUTSIDE the mirror lock: the seed's
+        drain barrier can wait out parked-segment backoffs and its
+        read_row retries traverse the failing dispatch path (seconds),
+        and every degraded op of every kind serializes on the one mirror
+        lock — seeding under it turned a single-op-path failure into an
+        engine-wide stall.  The install re-checks under the lock: a
+        racing seeder's mirror wins, a reconcile that cleared the kind
+        mid-seed routes back to the device, and a reconcile that WROTE
+        mirrors back mid-seed (epoch bump) discards the possibly-stale
+        row and retries — installing it would resurrect pre-reconcile
+        state and lose acked writes on the next write-back."""
+        if not self._mirrors and not self.health.any_degraded:
+            return False
+        for _ in range(4):
+            with self._mirror_lock:
+                if entry.name in self._mirrors:
+                    return True
+                if not self.health.degraded_kind(entry.kind):
+                    return False
+                epoch = self._mirror_epoch
+            row = self._seed_row(entry)
+            with self._mirror_lock:
+                if entry.name in self._mirrors:
+                    return True
+                if not self.health.degraded_kind(entry.kind):
+                    return False
+                if self._mirror_epoch != epoch:
+                    continue  # reconciled mid-seed: row may be stale
+                if row is None:
+                    return False
+                self._install_mirror(entry, row)
+                return True
+        return False  # flapping hard: let the device surface the failure
+
+    def _seed_row(self, entry):
+        """Fetch the entry's device row for mirror seeding (no lock
+        held).  Seeding itself needs a working read dispatch; under a
+        partial fault schedule a few retries ride it out — if the device
+        is truly unreachable, returns None and the op proceeds to the
+        device (surfacing the typed failure instead of silently serving
+        empty state)."""
+        try:
+            self._drain()
+        except Exception:
+            pass  # queued segments fail typed on their own futures
+        for _ in range(4):
+            try:
+                return self.executor.read_row(entry.pool, entry.row)
+            except Exception:
+                continue
+        return None
+
+    def _install_mirror(self, entry, row):
+        """Install ``entry``'s mirror from ``row`` (under the mirror
+        lock) and register the kind's recovery probe: a real read
+        dispatch against the degraded pool (exercises the full _locked
+        path, chaos points included), driven by the health monitor while
+        the breaker is open."""
+        from redisson_tpu.objects.degraded import mirror_for_entry
+
+        self._mirrors[entry.name] = mirror_for_entry(entry, row)
+        pool, prow = entry.pool, entry.row
+        self.health.ensure_probe(
+            entry.kind,
+            lambda: self.executor.read_row(pool, prow),
+        )
+
+    def _mirror_call(self, entry, nops: int, fn):
+        """Apply a degraded-mode op to the entry's mirror (serialized by
+        the mirror lock) and account it; returns an ImmediateResult."""
+        with self._mirror_lock:
+            mirror = self._mirrors.get(entry.name)
+            if mirror is None:  # reconciled between check and apply: retry
+                return None
+            out = fn(mirror)
+        self.obs.degraded_ops.inc((entry.kind,), nops)
+        return ImmediateResult(out)
+
+    def _serve_degraded(self, entry, nops: int, fn):
+        """The failover boundary every engine method crosses: the
+        mirror's ImmediateResult when ``entry`` serves degraded, else
+        None (the op proceeds to the device).  One helper, so a missing
+        failover is a greppable hole, not a silent one — every method
+        that touches ``entry``'s row must call this (or _host_row) first
+        or acked state diverges from what reconcile writes back."""
+        if self._degraded(entry):
+            return self._mirror_call(entry, nops, fn)
+        return None
+
+    def _host_row(self, entry) -> np.ndarray:
+        """``entry``'s current truth in device-row layout: its mirror's
+        encoding while one is live (the device row is stale during
+        degradation), else the device row itself.  Serves merge sources
+        and DUMP during degradation."""
+        if self._mirrors:
+            with self._mirror_lock:
+                mirror = self._mirrors.get(entry.name)
+                if mirror is not None:
+                    return np.asarray(mirror.encode(entry.pool.row_units))
+        self._drain()
+        return np.asarray(self.executor.read_row(entry.pool, entry.row))
+
+    def _reconcile_kind(self, kind: str) -> bool:
+        """Breaker-close hook (health.reconcile_cb): write every mirrored
+        row of ``kind`` back to the device, then drop the mirrors — the
+        device resumes from exactly the state the mirror served.  False
+        (stay degraded, breaker re-opens) if any write fails."""
+        with self._mirror_lock:
+            names = [
+                n for n, m in self._mirrors.items() if m.kind == kind
+            ]
+            for n in names:
+                mirror = self._mirrors[n]
+                entry = self.registry.lookup(n)
+                if entry is None:  # deleted while degraded
+                    del self._mirrors[n]
+                    continue
+                # Size to the entry's CURRENT pool: a degraded-window
+                # bitset grow may have migrated it to a larger class.
+                row = mirror.encode(entry.pool.row_units)
+                try:
+                    for r in self._entry_rows(entry):
+                        self.executor.write_row(entry.pool, r, row)
+                except Exception:
+                    return False
+                del self._mirrors[n]
+            # Device rows changed under any in-flight seeder: its row
+            # snapshot may predate the write-backs above (see _degraded).
+            self._mirror_epoch += 1
+            # Still under the mirror lock: drop the degraded flag
+            # atomically with the mirrors, so no serving thread can see
+            # "kind degraded, mirror missing" and seed an orphan mirror
+            # that outlives the recovery (permanent split-brain).
+            self.health.clear_degraded(kind)
+        return True
 
     def _submit(self, key, dispatch, arrays, nops, pool_key=None, meta=None,
                 tenant=None):
@@ -559,6 +751,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         self._drain()
         self._reap_rows(entry.pool, self._entry_rows(entry), epoch)
         self.topk.drop(name)
+        if self._mirrors:
+            with self._mirror_lock:
+                self._mirrors.pop(name, None)
         return not was_expired
 
     def rename(self, old: str, new: str) -> bool:
@@ -577,6 +772,12 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 dest.pool, self._entry_rows(dest), dest.pool.topology_epoch
             )
         self.topk.rename(old, new)
+        if self._mirrors:
+            with self._mirror_lock:
+                self._mirrors.pop(new, None)
+                m = self._mirrors.pop(old, None)
+                if m is not None:
+                    self._mirrors[new] = m
         return True
 
     def names(self, kind=None):
@@ -756,6 +957,11 @@ class TpuSketchEngine(SketchDurabilityMixin):
         m, k = entry.params["size"], entry.params["hash_iterations"]
         B = len(h1m)
         is_add = np.asarray(is_add, bool)
+        res = self._serve_degraded(
+            entry, B, lambda mir: mir.mixed(h1m, h2m, is_add)
+        )
+        if res is not None:
+            return res
         orig = (h1m, h2m, is_add)
         saw_replicas = bool(entry.replica_rows)
         if saw_replicas:
@@ -797,7 +1003,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
         entry = self._require(name, PoolKind.BLOOM)
         h1m, h2m = self._bloom_reduce(entry, H1, H2)
         m, k = entry.params["size"], entry.params["hash_iterations"]
-        if not self.config.tpu_sketch.exact_add_semantics and not entry.replica_rows:
+        if (
+            not self.config.tpu_sketch.exact_add_semantics
+            and not entry.replica_rows
+            # Degraded: route through the hashed path's mirror failover
+            # instead of hitting the dead device via the fast-add st
+            # dispatch.
+            and not self._degraded(entry)
+        ):
             # Fast single-tenant bulk path dispatches immediately — but only
             # after queued coalesced ops flush, so a contains submitted
             # *before* this add can never observe its writes (arrival-order
@@ -822,7 +1035,11 @@ class TpuSketchEngine(SketchDurabilityMixin):
         entry = self._require(name, PoolKind.BLOOM)
         h1m, h2m = self._bloom_reduce(entry, H1, H2)
         m, k = entry.params["size"], entry.params["hash_iterations"]
-        if self.coalescer is not None or entry.replica_rows:
+        if (
+            self.coalescer is not None
+            or entry.replica_rows
+            or self._degraded(entry)  # hashed path serves the mirror
+        ):
             return self._bloom_dispatch_hashed(
                 entry, h1m, h2m, np.zeros(len(H1), bool)
             )
@@ -832,6 +1049,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def bloom_count(self, name) -> LazyResult:
         entry = self._require(name, PoolKind.BLOOM)
+        res = self._serve_degraded(entry, 1, lambda mir: mir.count())
+        if res is not None:
+            return res
         self._drain()
         return self.executor.bloom_count(
             entry.pool, entry.row, entry.params["size"], entry.params["hash_iterations"]
@@ -922,6 +1142,23 @@ class TpuSketchEngine(SketchDurabilityMixin):
         B = blocks.shape[0]
         L = blocks.shape[1]
         lengths = np.asarray(lengths, np.uint32)
+        if self._degraded(entry):
+            # Degraded: hash host-side (the mirror consumes reduced
+            # hashes) and serve from the golden mirror.
+            lens = (
+                np.full(B, lengths, np.uint32)
+                if lengths.ndim == 0 else lengths
+            )
+            h1m, h2m = self._bloom_reduce(
+                entry, *hashing.hash128_np(blocks, lens)
+            )
+            flags = np.full(B, bool(is_add), bool)
+            res = self._mirror_call(
+                entry, B, lambda mir: mir.mixed(h1m, h2m, flags)
+            )
+            if res is not None:
+                return res
+            # mirror reconciled mid-call: fall through to the device
         saw_replicas = bool(entry.replica_rows)
         if self.prewarmer is not None and B:
             # Keyed (codec-shaped) signatures can't be known at pool
@@ -1010,7 +1247,8 @@ class TpuSketchEngine(SketchDurabilityMixin):
             if (
                 self.coalescer is not None
                 and self.config.tpu_sketch.exact_add_semantics
-            ) or entry.replica_rows:
+            ) or entry.replica_rows or self._degraded(entry):
+                # The mixed-keys path owns the degraded-mirror failover.
                 return self._bloom_submit_mixed_keys(entry, blocks, lengths, True)
             if not self.config.tpu_sketch.exact_add_semantics:
                 m, k = entry.params["size"], entry.params["hash_iterations"]
@@ -1043,7 +1281,11 @@ class TpuSketchEngine(SketchDurabilityMixin):
     def bloom_contains_encoded(self, name, blocks, lengths) -> LazyResult:
         if self.executor.supports_device_hash:
             entry = self._require(name, PoolKind.BLOOM)
-            if self.coalescer is not None or entry.replica_rows:
+            if (
+                self.coalescer is not None
+                or entry.replica_rows
+                or self._degraded(entry)  # mixed-keys path serves mirror
+            ):
                 return self._bloom_submit_mixed_keys(entry, blocks, lengths, False)
             m, k = entry.params["size"], entry.params["hash_iterations"]
             return self.executor.bloom_contains_keys_st(
@@ -1073,6 +1315,12 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def hll_add(self, name, c0, c1, c2) -> LazyResult:
         entry = self.hll_ensure(name)
+        res = self._serve_degraded(
+            entry, len(c0),
+            lambda mir: bool(np.any(mir.add_changed(c0, c1, c2))),
+        )
+        if res is not None:
+            return res
         if self.coalescer is not None:
             pool = entry.pool
             rows = np.full(len(c0), entry.row, np.int32)
@@ -1093,9 +1341,10 @@ class TpuSketchEngine(SketchDurabilityMixin):
     def hll_add_encoded(self, name, blocks, lengths) -> LazyResult:
         if self.coalescer is None and self.executor.supports_device_hash:
             entry = self.hll_ensure(name)
-            return self.executor.hll_add_keys_single(
-                entry.pool, entry.row, blocks, lengths
-            )
+            if not self._degraded(entry):
+                return self.executor.hll_add_keys_single(
+                    entry.pool, entry.row, blocks, lengths
+                )
         c0, c1, c2, _ = hashing.murmur3_x86_128(blocks, lengths)
         return self.hll_add(name, c0, c1, c2)
 
@@ -1103,6 +1352,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         entry = self._lookup_kind(name, PoolKind.HLL)
         if entry is None:
             return ImmediateResult(0)
+        res = self._serve_degraded(entry, 1, lambda mir: mir.count())
+        if res is not None:
+            return res
         self._drain()
         return self.executor.hll_count(entry.pool, entry.row)
 
@@ -1115,24 +1367,50 @@ class TpuSketchEngine(SketchDurabilityMixin):
             return 0
         self._drain()
         # All HLL tenants share one pool; union via host max of rows is
-        # small (16KB/row) — fine for a count call.
+        # small (16KB/row) — fine for a count call.  Degraded entries
+        # contribute their MIRROR registers (the device row is stale
+        # while a breaker is open).
         regs = None
         for e in entries:
-            r = self.executor.read_row(e.pool, e.row)
+            r = None
+            if self._mirrors:
+                # Snapshot under the mirror lock (degraded.py's
+                # external-synchronization contract): a concurrent
+                # add_changed or reconcile must not tear the read.
+                with self._mirror_lock:
+                    mir = self._mirrors.get(e.name)
+                    if mir is not None and mir.kind == PoolKind.HLL:
+                        r = mir.regs.copy()
+            if r is None:
+                r = self.executor.read_row(e.pool, e.row)
             regs = r if regs is None else np.maximum(regs, r)
         hist = np.bincount(regs, minlength=golden.HLL_Q + 2)
         return int(round(golden.ertl_estimate(hist)))
 
     def hll_merge_with(self, name, other_names) -> None:
         entry = self.hll_ensure(name)
-        srcs = []
-        for n in other_names:
-            e = self._lookup_kind(n, PoolKind.HLL)
-            if e is not None:
-                srcs.append(e.row)
-        if srcs:
-            self._drain()
-            self.executor.hll_merge(entry.pool, entry.row, srcs)
+        src_entries = [
+            e
+            for e in (self._lookup_kind(n, PoolKind.HLL) for n in other_names)
+            if e is not None
+        ]
+        if not src_entries:
+            return
+        if self._degraded(entry):
+            # Merge golden-side: each source contributes its CURRENT
+            # truth (its own mirror if degraded, else its device row) —
+            # source rows gathered before the dest's mirror lock is
+            # taken (lock order: one _mirror_lock acquisition at a time).
+            rows = [self._host_row(e) for e in src_entries]
+            res = self._mirror_call(
+                entry, 1, lambda mir: mir.merge_rows(rows)
+            )
+            if res is not None:
+                return
+        self._drain()
+        self.executor.hll_merge(
+            entry.pool, entry.row, [e.row for e in src_entries]
+        )
 
     # -- bitset ------------------------------------------------------------
 
@@ -1319,6 +1597,13 @@ class TpuSketchEngine(SketchDurabilityMixin):
         )
 
     def _bitset_rw(self, opcode: int, method, entry, idx):
+        res = self._serve_degraded(
+            entry, len(idx), lambda mir: mir.mixed(
+                idx, np.full(len(idx), opcode, np.uint32)
+            )
+        )
+        if res is not None:
+            return res
         if self.coalescer is not None:
             return self._bitset_submit_mixed(entry, idx, opcode)
         # Resolve placement and dispatch atomically vs a concurrent
@@ -1359,6 +1644,13 @@ class TpuSketchEngine(SketchDurabilityMixin):
         cap = entry.pool.row_units * 32
         in_range = idx < cap
         safe_idx = np.where(in_range, idx, 0).astype(np.uint32)
+        res = self._serve_degraded(
+            entry, len(idx), lambda mir: mir.mixed(
+                safe_idx, np.full(len(idx), bitset_ops.OP_GET, np.uint32)
+            ) & in_range
+        )
+        if res is not None:
+            return res
         if self.coalescer is not None:
             fut = self._bitset_submit_mixed(entry, safe_idx, bitset_ops.OP_GET)
             return _MappedFuture(fut, lambda v: v & in_range)
@@ -1368,6 +1660,12 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def bitset_set_range(self, name, from_bit, to_bit, value: bool) -> LazyResult:
         entry = self.bitset_ensure(name, int(to_bit))
+        res = self._serve_degraded(
+            entry, 1,
+            lambda mir: mir.set_range(int(from_bit), int(to_bit), bool(value)),
+        )
+        if res is not None:
+            return res
         self._drain()
         return self.executor.bitset_set_range(
             entry.pool, entry.row, int(from_bit), int(to_bit), value
@@ -1377,6 +1675,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         entry = self._lookup_kind(name, PoolKind.BITSET)
         if entry is None:
             return 0
+        res = self._serve_degraded(entry, 1, lambda mir: mir.cardinality())
+        if res is not None:
+            return res.result()
         self._drain()
         return self.executor.bitset_cardinality(entry.pool, entry.row).result()
 
@@ -1384,6 +1685,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         entry = self._lookup_kind(name, PoolKind.BITSET)
         if entry is None:
             return 0
+        res = self._serve_degraded(entry, 1, lambda mir: mir.length())
+        if res is not None:
+            return res.result()
         self._drain()
         return self.executor.bitset_length(entry.pool, entry.row).result()
 
@@ -1391,6 +1695,11 @@ class TpuSketchEngine(SketchDurabilityMixin):
         entry = self._lookup_kind(name, PoolKind.BITSET)
         if entry is None:
             return -1 if target_bit else 0
+        res = self._serve_degraded(
+            entry, 1, lambda mir: mir.bitpos(int(target_bit))
+        )
+        if res is not None:
+            return res.result()
         self._drain()
         return self.executor.bitset_bitpos(entry.pool, entry.row, target_bit).result()
 
@@ -1412,14 +1721,46 @@ class TpuSketchEngine(SketchDurabilityMixin):
             default=0,
         ) or 32 * 32
         dst = self._bitset_entry_with_capacity(dest, max_bits)
-        srcs, src_nbits = [], []
+        srcs, src_nbits, src_entries = [], [], []
         for n in src_names:
             e = self._bitset_entry_with_capacity(n, max_bits)
             srcs.append(e.row)
             src_nbits.append(e.params.get("nbits", 0))
+            src_entries.append(e)
         nbits = (
             -(-src_nbits[0] // 8) * 8 if op == "not" else max(src_nbits, default=0)
         )
+        if self._degraded(dst):
+            # Golden-side BITOP: decode every source's current truth
+            # (mirror or device row — all operands were grown into one
+            # size class above, so rows share one physical width),
+            # combine host-side, and REPLACE the dest mirror (Redis
+            # semantics: dest's prior value never leaks into the result).
+            from redisson_tpu.objects.degraded import _bits_from_words
+
+            nb_phys = dst.pool.row_units * 32
+            srcs_bits = [
+                _bits_from_words(self._host_row(e), nb_phys)
+                for e in src_entries
+            ]
+            if op == "not":
+                out = np.zeros(nb_phys, bool)
+                out[:nbits] = ~srcs_bits[0][:nbits]
+            else:
+                fn = {
+                    "and": np.logical_and,
+                    "or": np.logical_or,
+                    "xor": np.logical_xor,
+                }[op]
+                out = srcs_bits[0].copy()
+                for b in srcs_bits[1:]:
+                    out = fn(out, b)
+            res = self._mirror_call(
+                dst, 1, lambda mir: mir.replace_bits(out)
+            )
+            if res is not None:
+                dst.params["nbits"] = nbits
+                return
         self._drain()
         self.executor.bitset_bitop(
             dst.pool, dst.row, srcs, op,
@@ -1433,8 +1774,16 @@ class TpuSketchEngine(SketchDurabilityMixin):
         entry = self._lookup_kind(name, PoolKind.BITSET)
         if entry is None:
             return b""
-        self._drain()
         nbytes = -(-entry.params.get("nbits", 0) // 8)
+        res = self._serve_degraded(
+            entry, 1,
+            lambda mir: np.packbits(
+                mir.bits, bitorder="little"
+            ).tobytes()[:nbytes],
+        )
+        if res is not None:
+            return res.result()
+        self._drain()
         return self.executor.read_row(entry.pool, entry.row).tobytes()[:nbytes]
 
     # -- cms ---------------------------------------------------------------
@@ -1461,6 +1810,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         the total."""
         entry = self._require(name, PoolKind.CMS)
         w = entry.params["width"]
+        res = self._serve_degraded(entry, 1, lambda mir: mir.total())
+        if res is not None:
+            return res.result()
         self._drain()
         row = self.executor.read_row(entry.pool, entry.row)
         return int(np.asarray(row[:w], np.uint64).sum())
@@ -1469,6 +1821,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         """Zero a CMS's counters in place (CMS.MERGE overwrite semantics)
         — the registry entry and any top-K configuration survive."""
         entry = self._require(name, PoolKind.CMS)
+        res = self._serve_degraded(entry, 1, lambda mir: mir.reset())
+        if res is not None:
+            return
         self._drain()
         self.executor.zero_row(entry.pool, entry.row)
 
@@ -1478,6 +1833,12 @@ class TpuSketchEngine(SketchDurabilityMixin):
         h1w, h2w = hashing.km_reduce_mod(H1, H2, w)
         rows = np.full(len(H1), entry.row, np.int32)
         wts = np.asarray(weights, np.uint32)
+        res = self._serve_degraded(
+            entry, len(H1),
+            lambda mir: mir.update_estimate(h1w, h2w, wts),
+        )
+        if res is not None:
+            return res
         if self.coalescer is not None:
             # Updates and estimates share one segment per (pool, d, w):
             # estimate ops ride with weight 0 (the scatter-add identity).
@@ -1503,6 +1864,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
         d, w = entry.params["depth"], entry.params["width"]
         h1w, h2w = hashing.km_reduce_mod(H1, H2, w)
         rows = np.full(len(H1), entry.row, np.int32)
+        res = self._serve_degraded(
+            entry, len(H1),
+            lambda mir: mir.update_estimate(
+                h1w, h2w, np.zeros(len(H1), np.uint32)
+            ),
+        )
+        if res is not None:
+            return res
         if self.coalescer is not None:
             pool = entry.pool
             zeros = np.zeros(len(H1), np.uint32)
@@ -1532,6 +1901,10 @@ class TpuSketchEngine(SketchDurabilityMixin):
         lane blocks; the fallback's estimates include the whole batch."""
         entry = self._require(name, PoolKind.CMS)
         d, w = entry.params["depth"], entry.params["width"]
+        if self._degraded(entry):
+            # Mirror fallback has whole-batch (vectorized) semantics,
+            # like the non-Pallas fallback below.
+            return self.cms_add(name, H1, H2, weights)
         if (
             not getattr(self.executor, "supports_pallas_cms", False)
             or (d * w) % 128 != 0  # VMEM lane-block geometry
@@ -1563,7 +1936,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def cms_merge(self, name, other_names) -> None:
         entry = self._require(name, PoolKind.CMS)
-        srcs = []
+        src_entries = []
         for n in other_names:
             e = self._require(n, PoolKind.CMS)
             if (
@@ -1571,10 +1944,23 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 or e.params["width"] != entry.params["width"]
             ):
                 raise ValueError("cannot merge CMS with different geometry")
-            srcs.append(e.row)
-        if srcs:
-            self._drain()
-            self.executor.cms_merge(entry.pool, entry.row, srcs)
+            src_entries.append(e)
+        if not src_entries:
+            return
+        if self._degraded(entry):
+            # Golden-side CMS.MERGE: sum each source's current truth
+            # (its mirror if degraded, else its device row) into the
+            # dest mirror — see hll_merge_with.
+            rows = [self._host_row(e) for e in src_entries]
+            res = self._mirror_call(
+                entry, 1, lambda mir: mir.merge_rows(rows)
+            )
+            if res is not None:
+                return
+        self._drain()
+        self.executor.cms_merge(
+            entry.pool, entry.row, [e.row for e in src_entries]
+        )
 
 
 class HostSketchEngine:

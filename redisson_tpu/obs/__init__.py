@@ -54,6 +54,17 @@ class Observability:
             "host-side dispatch enqueue time, by method", ("method",))
         self.shard_ops = r.counter(
             "rtpu_shard_ops", "ops routed to each mesh shard", ("shard",))
+        # Robustness (ISSUE 3): degraded-mode serving + chaos injection.
+        # Breaker state itself is a render-time gauge (rtpu_breaker_state,
+        # registered by the engine's health-gauge wiring).
+        self.degraded_ops = r.counter(
+            "rtpu_degraded_ops",
+            "ops served from the host golden mirror while a breaker was "
+            "open, by op kind", ("op",))
+        self.faults_injected = r.counter(
+            "rtpu_faults_injected",
+            "chaos faults injected, by fault point and kind",
+            ("point", "kind"))
 
     # -- instrumentation helpers (one call per batch, never per op) --------
 

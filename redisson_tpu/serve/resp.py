@@ -61,6 +61,12 @@ class ProtocolError(Exception):
     Redis 'Protocol error' behavior)."""
 
 
+class ScriptKilledError(BaseException):
+    """Raised asynchronously INTO a running script's thread by SCRIPT
+    KILL.  BaseException, so a script's blanket ``except Exception``
+    cannot swallow the kill."""
+
+
 def _encode_simple(s: str) -> bytes:
     return b"+" + s.encode() + b"\r\n"
 
@@ -71,7 +77,7 @@ def _encode_simple(s: str) -> bytes:
 # name ('EXEC without MULTI' must stay '-ERR EXEC without MULTI').
 _ERROR_CODES = (
     "BUSYKEY", "NOPROTO", "WRONGTYPE", "NOSCRIPT", "EXECABORT",
-    "NOAUTH", "WRONGPASS", "NOGROUP", "BUSYGROUP",
+    "NOAUTH", "WRONGPASS", "NOGROUP", "BUSYGROUP", "BUSY", "NOTBUSY",
 )
 
 # Commands whose bodies execute arbitrary Python server-side; gated
@@ -364,6 +370,22 @@ class RespServer:
                 "requirepass: RESP scripts are arbitrary Python (RCE)"
             )
         self._scripts_enabled = bool(want_scripts)
+        # DEBUG INJECT (chaos fault injection) shares the scripting gate
+        # exactly: a fault injector on an open unauthenticated socket is
+        # a denial-of-service surface, not a debugging convenience.
+        self._inject_allowed = bool(
+            self._requirepass or self._is_loopback(host)
+        )
+        # Script watchdog (the busy-reply-threshold analog): while a
+        # script has been running longer than script_timeout_ms, other
+        # connections get BUSY instead of queueing behind the grid lock;
+        # SCRIPT KILL stops the runaway (docs/observability.md hazard).
+        self._script_timeout_ms = getattr(
+            client.config, "script_timeout_ms", 5000
+        )
+        self._script_lock = threading.Lock()
+        self._script_run = None  # (thread, started_monotonic) while running
+        self._script_kill = None  # run record a SCRIPT KILL is targeting
         self.max_connections = max_connections
         self.idle_timeout_s = idle_timeout_s
         # Observability (ISSUE 1): per-command stats + SLOWLOG record
@@ -562,6 +584,16 @@ class RespServer:
         except RespError as e:
             err = True
             reply = _encode_error(str(e))
+        except ScriptKilledError:
+            # SCRIPT KILL's async exception can land AFTER the script
+            # body left its guarded block (next bytecode boundary):
+            # absorb it here so a completed script's connection survives
+            # with an error reply instead of the thread dying
+            # (ScriptKilledError is a BaseException on purpose — scripts
+            # can't swallow it — so the generic handler below misses it).
+            self._script_unregister()  # the clear itself may have died
+            err = True
+            reply = _encode_error("Script killed by user with SCRIPT KILL...")
         except TypeError as e:
             # Kind guards raise TypeError — clients key on the WRONGTYPE
             # code (redis-py maps it to a dedicated exception class).
@@ -631,6 +663,19 @@ class RespServer:
                   name: Optional[str] = None) -> bytes:
         if name is None:  # _safe_dispatch passes the decoded name along
             name = cmd[0].decode().upper()
+        kill = self._script_kill
+        if kill is not None and kill[0] is threading.current_thread():
+            # Cooperative SCRIPT KILL boundary: async-exception delivery
+            # (PyThreadState_SetAsyncExc) is LOSSY — an exception that
+            # materializes inside a weakref/__del__ callback is reported
+            # as "unraisable" and swallowed, never reaching the script.
+            # A killed script that issues redis.call dies HERE instead,
+            # synchronously and reliably (pure-Python loops are covered
+            # by the re-posting reaper in _cmd_SCRIPT).
+            with self._script_lock:
+                if self._script_kill is kill:
+                    self._script_kill = None
+                    raise ScriptKilledError()
         if not ctx.authed and name not in ("AUTH", "HELLO", "QUIT", "RESET"):
             # Pre-auth surface is AUTH/HELLO/QUIT/RESET, like Redis
             # (pooled clients RESET connections before authenticating).
@@ -643,6 +688,16 @@ class RespServer:
                 "scripting is disabled (script bodies are Python; enable "
                 "with enable_python_scripts=True — requires requirepass "
                 "or a loopback bind)"
+            )
+        if name not in (
+            "SCRIPT", "SHUTDOWN", "AUTH", "HELLO", "QUIT", "RESET",
+        ) and self._script_busy():
+            # A script has exceeded script_timeout_ms on another
+            # connection: Redis's busy-script contract — refuse rather
+            # than queue invisibly behind the grid lock.
+            raise RespError(
+                "BUSY Redis is busy running a script. You can only call "
+                "SCRIPT KILL or SHUTDOWN NOSAVE."
             )
         if ctx.in_multi and name not in ("EXEC", "DISCARD", "MULTI", "RESET"):
             # Redis MULTI semantics: commands queue (validated for
@@ -826,12 +881,148 @@ class RespServer:
         # honest Redis answer (writes are already locally durable).
         return _encode_int(0)
 
+    # -- script watchdog helpers (ISSUE 3 satellite) -----------------------
+
+    def _script_busy(self) -> bool:
+        """True while a script on ANOTHER connection has been running
+        longer than script_timeout_ms (its own redis.call dispatches
+        must keep flowing)."""
+        run = self._script_run
+        if run is None:
+            return False
+        thread, started = run
+        if threading.current_thread() is thread:
+            return False
+        t = self._script_timeout_ms
+        return t > 0 and (time.monotonic() - started) * 1000.0 >= t
+
+    def _script_register(self) -> bool:
+        """Claim the watchdog slot for the current thread; False when a
+        script on this thread already owns it (nested redis.call)."""
+        with self._script_lock:
+            if self._script_run is None:
+                self._script_run = (
+                    threading.current_thread(), time.monotonic()
+                )
+                # Any kill flag here is stale (its target run is gone —
+                # e.g. the killed thread died without unwinding through
+                # _script_unregister): it must not fell the new script.
+                self._script_kill = None
+                return True
+            return False
+
+    def _script_unregister(self) -> None:
+        """Release the slot if the CURRENT thread owns it.  Also the
+        defensive path for a SCRIPT KILL whose async exception landed
+        inside the normal clearing code — without it the stale record
+        would report BUSY forever and target an innocent later command."""
+        with self._script_lock:
+            run = self._script_run
+            if run is not None and run[0] is threading.current_thread():
+                self._script_run = None
+                if self._script_kill is run:
+                    self._script_kill = None
+
+    def _script_claim(self) -> bool:
+        """Claim the watchdog slot BEFORE acquiring the grid lock; True
+        when this frame now owns it, False for a nested call whose outer
+        frame on this thread already does.  When ANOTHER connection's
+        script owns the slot, wait for it rather than run unregistered:
+        the caller would serialize on the grid lock anyway, and an
+        unregistered runaway would be invisible to BUSY, report NOTBUSY
+        to SCRIPT KILL — and leave SCRIPT KILL aimed at the slot owner,
+        an innocent thread still queued on the grid lock.  Claiming
+        before the lock means the BUSY clock may include queue wait,
+        which only makes BUSY (slightly) early, never absent.  Claim
+        order (slot, then grid lock) is the same in every script path,
+        so the wait cannot deadlock: the slot owner never waits on the
+        slot, and nested same-thread frames break out immediately."""
+        while True:
+            if self._script_register():
+                return True
+            run = self._script_run
+            if run is None:
+                continue  # slot freed between register and read: retry
+            if run[0] is threading.current_thread():
+                return False  # nested call: the outer frame owns the slot
+            if not run[0].is_alive():  # owner died mid-script: reclaim
+                with self._script_lock:
+                    if self._script_run is run:
+                        self._script_run = None
+                continue
+            time.sleep(0.001)
+
+    def _script_reaper(self, run) -> None:
+        """Drive one SCRIPT KILL home.  Re-posts the async exception on
+        a short period until the target run exits (slot cleared / thread
+        dead) or the cooperative dispatch-boundary check consumed the
+        kill flag first.  The grace before the first post gives a
+        redis.call-ing script time to die cleanly at its next dispatch,
+        so the async path (whose landing site is uncontrollable) only
+        fires for scripts that spin without calling back in."""
+        import ctypes
+
+        while True:
+            time.sleep(0.02)
+            with self._script_lock:
+                if (
+                    self._script_kill is not run
+                    or self._script_run is not run
+                    or not run[0].is_alive()
+                ):
+                    return
+                n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(run[0].ident),
+                    ctypes.py_object(ScriptKilledError),
+                )
+                if n > 1:  # pragma: no cover — CPython contract: undo
+                    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                        ctypes.c_ulong(run[0].ident), None
+                    )
+                    return
+
     def _cmd_DEBUG(self, args):
         sub = args[0].decode().upper()
         if sub == "SLEEP":
             import time as _time
 
             _time.sleep(float(args[1]))
+            return _encode_simple("OK")
+        if sub == "INJECT":
+            # DEBUG INJECT <point> <kind> <rate> [seed] | DEBUG INJECT OFF
+            # — the chaos engine's RESP admin surface (docs/robustness.md),
+            # gated exactly like scripting (loopback-or-requirepass).
+            if not self._inject_allowed:
+                raise RespError(
+                    "DEBUG INJECT on a non-loopback bind requires "
+                    "requirepass (fault injection is an admin surface)"
+                )
+            from redisson_tpu import chaos
+
+            if len(args) >= 2 and args[1].decode().upper() == "OFF":
+                chaos.clear()
+                return _encode_simple("OK")
+            if len(args) == 2 and args[1].decode().upper() == "LIST":
+                flat = []
+                for point, (kind, rate, seed) in sorted(
+                    chaos.active().items()
+                ):
+                    flat.append(
+                        f"{point} {kind} {rate:g} seed={seed}".encode()
+                    )
+                return _encode_array(flat)
+            if len(args) < 4:
+                raise RespError(
+                    "DEBUG INJECT <point> <kind> <rate> [seed] | OFF | LIST"
+                )
+            point = args[1].decode()
+            kind = args[2].decode().lower()
+            try:
+                rate = float(args[3])
+                seed = int(args[4]) if len(args) > 4 else 0
+                chaos.inject(point, kind=kind, rate=rate, seed=seed)
+            except ValueError as e:
+                raise RespError(str(e)) from e
             return _encode_simple("OK")
         raise RespError(f"unsupported DEBUG subcommand {sub}")
 
@@ -1552,6 +1743,20 @@ class RespServer:
                     f"total_commands_processed:{total_cmds}",
                     f"slowlog_len:{0 if obs is None else len(obs.slowlog)}",
                 ]
+                # Self-healing dispatch (ISSUE 3): the degraded flag —
+                # sketches serving from the host golden mirror while a
+                # circuit breaker is open.
+                health = getattr(
+                    getattr(self._client, "_engine", None), "health", None
+                )
+                if health is not None:
+                    mirrors = getattr(self._client._engine, "_mirrors", {})
+                    lines += [
+                        f"degraded:{1 if health.any_degraded else 0}",
+                        f"degraded_objects:{len(mirrors)}",
+                        f"breakers_open:{health.board.open_count()}",
+                        f"executor_health:{health.state()}",
+                    ]
             elif s == "commandstats" and obs is not None:
                 lines.append("# Commandstats")
                 for cmd, st in sorted(obs.command_stats().items()):
@@ -3030,21 +3235,26 @@ class RespServer:
             else:
                 raise RespError("syntax error")
         try:
-            cursor, claimed = s.auto_claim(
+            cursor, claimed, deleted = s.auto_claim(
                 args[1].decode(), args[2].decode(), int(args[3]),
-                self._s(args[4]), count, with_cursor=True,
+                self._s(args[4]), count, with_cursor=True, justid=justid,
             )
         except ValueError as e:
             raise self._nogroup(args[0], args[1].decode(), e) from e
         # 7.0 reply: [next-cursor, entries, deleted-ids].  The cursor is
         # '0-0' only when the whole PEL was examined — a COUNT-truncated
         # sweep returns the id to continue from (clients loop until 0-0).
+        # The third element names the ids the sweep dropped from the PEL
+        # because their entries were deleted from the stream.
         body = (
             _encode_array([eid for eid, _ in claimed])
             if justid  # bare ids, per the JUSTID contract
             else self._stream_entries_reply(claimed)
         )
-        return b"*3\r\n" + _encode_bulk(cursor.encode()) + body + b"*0\r\n"
+        return (
+            b"*3\r\n" + _encode_bulk(cursor.encode()) + body
+            + _encode_array([d.encode() for d in deleted])
+        )
 
     def _cmd_XINFO(self, args):
         sub = args[0].decode().upper()
@@ -3300,16 +3510,50 @@ class RespServer:
                     return e
 
         ns = {"KEYS": list(keys), "ARGV": list(argv), "redis": _Bridge}
-        with self._client._grid.lock:  # Lua atomicity contract
-            try:
-                code = compile(source, "<eval>", "eval")
-            except SyntaxError:
-                code = compile(source, "<eval>", "exec")
-                exec(code, ns)
-                out = ns.get("result")
-            else:
-                out = eval(code, ns)
-            self._client._grid.cond.notify_all()
+        # Compile BEFORE taking the grid lock (ISSUE 3 satellite): a slow
+        # or malformed compile must not stall every other connection
+        # behind the Lua-atomicity lock.
+        try:
+            code = compile(source, "<eval>", "eval")
+            is_expr = True
+        except SyntaxError:
+            code = compile(source, "<eval>", "exec")
+            is_expr = False
+        # Claim the watchdog slot BEFORE the grid lock (see _script_claim
+        # — registering after the lock let an EVAL that won the lock race
+        # against a slot-holding FCALL run unregistered, with SCRIPT KILL
+        # aimed at the FCALL thread still queued on the lock).  The
+        # OUTERMOST script on this thread owns the record (a script
+        # EVALing another via redis.call re-enters here).
+        started_here = self._script_claim()
+        try:
+            with self._client._grid.lock:  # Lua atomicity contract
+                try:
+                    if is_expr:
+                        out = eval(code, ns)
+                    else:
+                        exec(code, ns)
+                        out = ns.get("result")
+                    self._client._grid.cond.notify_all()
+                finally:
+                    if started_here:
+                        self._script_unregister()
+        except ScriptKilledError:
+            # Only the OUTERMOST frame converts the kill to a (catchable)
+            # RespError: converting in a nested frame would let the outer
+            # script's blanket `except Exception` swallow the kill and
+            # keep looping — the BaseException must ride through script
+            # code until the frame that owns the watchdog slot.
+            if not started_here:
+                raise
+            # The kill may have landed INSIDE the finally above, aborting
+            # the clear — release the slot defensively or every later
+            # connection sees BUSY forever.
+            self._script_unregister()
+            # _encode_error prepends the ERR code for unknown tokens.
+            raise RespError(
+                "Script killed by user with SCRIPT KILL..."
+            ) from None
         return out
 
     @staticmethod
@@ -3414,6 +3658,30 @@ class RespServer:
                     svc._fns.pop(sha, None)
             svc._sources.clear()
             return _encode_simple("OK")
+        if sub == "KILL":
+            # Stop a runaway script.  Delivery is two-pronged because a
+            # single PyThreadState_SetAsyncExc is LOSSY (an exception
+            # materializing inside a weakref/__del__ callback is
+            # swallowed as "unraisable"): (1) a kill flag the script
+            # thread checks synchronously at every redis.call dispatch
+            # boundary, and (2) a reaper that re-posts the async
+            # ScriptKilledError until the script actually exits —
+            # covering tight pure-Python loops that never call redis.
+            # Unlike Redis we cannot tell read-only scripts from
+            # writers, so KILL is always permitted — the hazard is
+            # documented in docs/observability.md.
+            with self._script_lock:
+                run = self._script_run
+                if run is None or not run[0].is_alive():
+                    raise RespError(
+                        "NOTBUSY No scripts in execution right now."
+                    )
+                self._script_kill = run
+            threading.Thread(
+                target=self._script_reaper, args=(run,),
+                name="rtpu-script-kill", daemon=True,
+            ).start()
+            return _encode_simple("OK")
         raise RespError(f"Unknown SCRIPT subcommand {sub}")
 
     def _cmd_FUNCTION(self, args):
@@ -3513,16 +3781,34 @@ class RespServer:
         self._check_numkeys(numkeys, len(args) - 2)
         keys = [self._s(a) for a in args[2 : 2 + numkeys]]
         argv = list(args[2 + numkeys :])
+        # Function bodies are the same RCE-gated Python family as EVAL
+        # and run under the grid lock (FunctionService takes it
+        # internally) — claim the script watchdog slot so a runaway
+        # function surfaces BUSY and is SCRIPT KILLable too.
+        started_here = self._script_claim()
         try:
             out = (
                 svc.call_ro(name, keys, argv)
                 if readonly
                 else svc.call(name, keys, argv)
             )
+        except ScriptKilledError:
+            # Nested frame (function called from a script): re-raise the
+            # BaseException so the outer script cannot catch it — only
+            # the outermost frame converts (see _run_script).  The gated
+            # finally below releases the slot either way.
+            if not started_here:
+                raise
+            raise RespError(
+                "Script killed by user with SCRIPT KILL..."
+            ) from None
         except KeyError as e:
             raise RespError(f"Function not found ({e})") from e
         except ValueError as e:
             raise RespError(str(e)) from e
+        finally:
+            if started_here:
+                self._script_unregister()
         return self._script_reply(out)
 
     def _cmd_FCALL(self, args):

@@ -125,6 +125,177 @@ class TestCompletionFailure:
         c.shutdown()
 
 
+class TestBackoffNonBlocking:
+    def test_healthy_pool_flushes_during_backoff(self):
+        """ISSUE 3 satellite: a failing segment PARKS with a backoff
+        deadline instead of sleeping the flush thread — a healthy pool's
+        segment submitted behind it must resolve while the failing one
+        is still backing off."""
+        c = make_coalescer(retry_attempts=5, retry_interval_s=0.25)
+        block = {"on": True}
+
+        def failing(cols):
+            if block["on"]:
+                raise RuntimeError("device busy")
+            return _Lazy(np.zeros(len(cols[0]), bool))
+
+        def healthy(cols):
+            return _Lazy(np.zeros(len(cols[0]), bool))
+
+        f_bad = c.submit("bad", failing, (np.arange(2),), 2, pool_key="A")
+        t0 = time.monotonic()
+        f_ok = c.submit("ok", healthy, (np.arange(2),), 2, pool_key="B")
+        out = HintedFuture(f_ok, c).result(5.0)
+        waited = time.monotonic() - t0
+        assert list(out) == [False, False]
+        # The healthy segment resolved well inside the failing one's
+        # first 250 ms backoff window (the old in-place sleep serialized
+        # them: >= one full retry interval).
+        assert waited < 0.2, f"healthy pool stalled {waited:.3f}s"
+        assert not f_bad.done()  # still parked, not failed
+        block["on"] = False
+        assert list(HintedFuture(f_bad, c).result(10.0)) == [False, False]
+        c.shutdown()
+
+    def test_backoff_is_exponential_and_capped(self):
+        c = make_coalescer(
+            retry_attempts=8, retry_interval_s=0.01,
+        )
+        c.retry_jitter = 0.0
+        assert c._backoff_s(1) == pytest.approx(0.01)
+        assert c._backoff_s(2) == pytest.approx(0.02)
+        assert c._backoff_s(3) == pytest.approx(0.04)
+        assert c._backoff_s(100) == pytest.approx(c.retry_max_backoff_s)
+        c.retry_jitter = 0.5
+        vals = {round(c._backoff_s(1), 6) for _ in range(32)}
+        assert len(vals) > 1  # jitter decorrelates
+        assert all(0.005 <= v <= 0.015 for v in vals)
+        c.shutdown()
+
+    def test_same_pool_order_preserved_across_backoff(self):
+        """A later same-pool segment must NOT overtake a parked earlier
+        one (read-your-writes at flush granularity)."""
+        order = []
+        c = make_coalescer(retry_attempts=4, retry_interval_s=0.05)
+        state = {"fail_first": True}
+
+        def d1(cols):
+            if state["fail_first"]:
+                state["fail_first"] = False
+                raise RuntimeError("transient")
+            order.append("first")
+            return _Lazy(np.zeros(len(cols[0]), bool))
+
+        def d2(cols):
+            order.append("second")
+            return _Lazy(np.zeros(len(cols[0]), bool))
+
+        f1 = c.submit("k1", d1, (np.arange(1),), 1, pool_key="P")
+        f2 = c.submit("k2", d2, (np.arange(1),), 1, pool_key="P")
+        HintedFuture(f2, c).result(10.0)
+        HintedFuture(f1, c).result(10.0)
+        assert order == ["first", "second"]
+        c.shutdown()
+
+
+class TestCoalescerBreaker:
+    def _health(self, **kw):
+        from redisson_tpu.executor.health import DispatchHealth
+
+        kw.setdefault("failure_threshold", 2)
+        kw.setdefault("open_s", 0.15)
+        return DispatchHealth(**kw)
+
+    def test_breaker_opens_then_fails_fast(self):
+        from redisson_tpu.executor.health import CircuitOpenError
+
+        h = self._health()
+        c = make_coalescer(retry_attempts=1, health=h)
+
+        def always_fails(cols):
+            raise RuntimeError("dead device")
+
+        for _ in range(2):
+            fut = c.submit(("bloom_mix",), always_fails, (np.arange(1),), 1)
+            with pytest.raises(RetryExhaustedError):
+                HintedFuture(fut, c).result(5.0)
+        assert h.board.states()[(0, "bloom_mix")] == "open"
+        # Next segment is refused WITHOUT calling dispatch.
+        calls = []
+
+        def counting(cols):
+            calls.append(1)
+            raise RuntimeError("unreachable")
+
+        fut = c.submit(("bloom_mix",), counting, (np.arange(1),), 1)
+        with pytest.raises(RetryExhaustedError) as ei:
+            HintedFuture(fut, c).result(5.0)
+        assert isinstance(ei.value.__cause__, CircuitOpenError)
+        assert calls == []
+        c.shutdown()
+        h.shutdown()
+
+    def test_completion_failures_open_breaker(self):
+        """A device whose dispatch ENQUEUE succeeds but every result
+        fetch fails must still open the circuit — recording success at
+        enqueue time would reset the failure streak each launch."""
+        h = self._health(failure_threshold=2, open_s=60.0)
+        c = make_coalescer(retry_attempts=1, health=h)
+
+        def dispatch(cols):
+            return _Lazy(error=RuntimeError("fetch died"))
+
+        for _ in range(2):
+            fut = c.submit(("bloom_mix",), dispatch, (np.arange(1),), 1)
+            with pytest.raises(KernelExecutionError):
+                HintedFuture(fut, c).result(5.0)
+        assert h.board.states()[(0, "bloom_mix")] == "open"
+        c.shutdown()
+        h.shutdown()
+
+    def test_half_open_probe_closes_breaker(self):
+        h = self._health(failure_threshold=2, open_s=0.1)
+        c = make_coalescer(retry_attempts=1, health=h)
+        state = {"fail": True}
+
+        def flaky(cols):
+            if state["fail"]:
+                raise RuntimeError("down")
+            return _Lazy(np.zeros(len(cols[0]), bool))
+
+        for _ in range(2):
+            fut = c.submit(("bloom_mix",), flaky, (np.arange(1),), 1)
+            with pytest.raises(RetryExhaustedError):
+                HintedFuture(fut, c).result(5.0)
+        assert h.board.states()[(0, "bloom_mix")] == "open"
+        state["fail"] = False
+        time.sleep(0.15)  # open window elapses -> next dispatch probes
+        fut = c.submit(("bloom_mix",), flaky, (np.arange(2),), 2)
+        assert list(HintedFuture(fut, c).result(5.0)) == [False, False]
+        assert h.board.states()[(0, "bloom_mix")] == "closed"
+        c.shutdown()
+        h.shutdown()
+
+    def test_probe_failure_reopens(self):
+        h = self._health(failure_threshold=1, open_s=0.05)
+        c = make_coalescer(retry_attempts=1, health=h)
+
+        def always_fails(cols):
+            raise RuntimeError("still dead")
+
+        fut = c.submit(("cms_mix",), always_fails, (np.arange(1),), 1)
+        with pytest.raises(RetryExhaustedError):
+            HintedFuture(fut, c).result(5.0)
+        assert h.board.states()[(0, "cms_mix")] == "open"
+        time.sleep(0.08)
+        fut = c.submit(("cms_mix",), always_fails, (np.arange(1),), 1)
+        with pytest.raises(RetryExhaustedError):
+            HintedFuture(fut, c).result(5.0)
+        assert h.board.states()[(0, "cms_mix")] == "open"
+        c.shutdown()
+        h.shutdown()
+
+
 class TestTimeout:
     def test_result_timeout_is_typed(self):
         c = make_coalescer()
