@@ -298,6 +298,95 @@ def bench_config4_mixed(make_client):
     return warm_ops, snap, cold_ops
 
 
+def measure_pass_link_sample():
+    """Both link-regime probes in ONE window (per-pass attribution,
+    ISSUE 4 satellite): ``link_h2d_put_rt_ms`` is the per-transfer-RT
+    regime's tell (small device_put), ``link_resident_rt_ms`` the
+    fetch-RT regime's (resident-array fetch).  A stalled pass travels
+    with the RT evidence that explains it."""
+    import jax
+
+    small = np.ones(1024, np.uint32)
+    t0 = time.perf_counter()
+    for _ in range(4):
+        jax.device_put(small).block_until_ready()
+    return {
+        "link_h2d_put_rt_ms": round((time.perf_counter() - t0) * 250, 2),
+        "link_resident_rt_ms": measure_rt_sample(),
+    }
+
+
+def bench_nearcache_hotkeys(make_client):
+    """ISSUE 4 tentpole evidence: a zipf-skewed HOT-KEY read pass in the
+    near cache's regime — INDIVIDUAL ``contains()`` calls (the
+    SISMEMBER/GETBIT serving shape the tentpole names), hot keys
+    dominating — run twice with identical traffic, nearcache on vs off.
+    Every uncached single-key read pays a coalesce window plus a launch
+    retirement that the tunnel prices at 10-350 ms per round trip; a hit
+    answers from host memory in microseconds.  The ratio is attributable
+    to the tier independently of link phase (the off pass rides the same
+    phase and is capped at N_OFF ops so a slow phase can't blow the
+    bench wall-clock — per-op means make the two counts comparable).
+    Reports ops/s both ways + the measured hit rate from the engine's
+    epoch-aware counters."""
+    N_KEYS = 100_000
+    WARM = 4096   # cache-seeding prefix — DISJOINT from the measured reads
+    N_ON = 4096   # measured single-key reads, cache on (hits are µs)
+    N_OFF = 512   # cache off: each op costs a real link round trip
+    rng = np.random.default_rng(21)
+    # Zipf-skewed key stream: a small hot set dominates (the workload
+    # shape that motivates a near cache, SURVEY §2 RLocalCachedMap).
+    # The ON pass warms on the PREFIX and measures the SUFFIX: the
+    # published hit rate is the zipf locality the tier actually captures
+    # (hot keys recur across the split, the cold tail misses and pays
+    # the link).  Warming with the measured keys themselves would pin
+    # the hit rate at 1.0 for ANY key distribution — true by
+    # construction, measuring nothing.
+    stream = (rng.zipf(1.3, size=WARM + N_ON) % N_KEYS).astype(np.uint64)
+    out = {}
+    for label, enabled, n_meas in (("on", True, N_ON),
+                                   ("off", False, N_OFF)):
+        # Fixed flush window, both passes: the adaptive controller tunes
+        # for BATCH throughput and inflates the window around the ON
+        # pass's lone misses (arrival gaps the hit bursts create — a
+        # penalty the OFF pass's steady single-op stream never sees),
+        # skewing the ratio away from what it claims to measure.
+        client = make_client(coalesce=True, nearcache=enabled,
+                             batch_window_us=200, adaptive_window=False)
+        bf = client.get_bloom_filter("nc-bf")
+        bf.try_init(N_KEYS, 0.01)
+        bf.add_all_async(
+            np.arange(0, N_KEYS, 2, dtype=np.uint64)
+        ).result(timeout=600.0)
+        # Warm-up: the ON pass seeds the cache with the disjoint
+        # prefix's hot set (steady-state hot-key serving); the OFF pass
+        # only needs the single-op compile bucket warm — a full uncached
+        # replay would cost 2x the capped measured work in link round
+        # trips, the very wall-clock blowup N_OFF exists to bound.
+        for k in stream[: WARM if enabled else 32]:
+            bf.contains(k)
+        nc = getattr(client._engine, "nearcache", None)
+        if nc is not None:
+            nc.hits = nc.misses = 0
+        t0 = time.perf_counter()
+        for k in stream[WARM : WARM + n_meas]:
+            bf.contains(k)
+        dt = time.perf_counter() - t0
+        out[f"nearcache_{label}_ops_per_sec"] = round(n_meas / dt)
+        out[f"nearcache_{label}_ops_measured"] = n_meas
+        if enabled and nc is not None:
+            st = nc.stats()
+            out["nearcache_hit_rate"] = st["hit_rate"]
+            out["nearcache_bytes"] = st["bytes"]
+        client.shutdown()
+    out["nearcache_speedup"] = round(
+        out["nearcache_on_ops_per_sec"]
+        / max(1, out["nearcache_off_ops_per_sec"]), 2
+    )
+    out["nearcache_pass_link"] = measure_pass_link_sample()
+    return out
+
+
 def bench_config3_bitset(client):
     """Config 3: 2^30-bit RBitSet, batched get/set (raw bitmap path).
 
@@ -613,35 +702,48 @@ def main():
     hll_ops = bench_hll_pfadd(client)
     bitset_ops = bench_config3_bitset(client)
     stream_eps, topk_recall = bench_config5_stream_topk(client)
-    # Config 4 is best-of-2 full runs: like the headline, the tunnel's
-    # throughput swings >2x between minutes — keep the pass with the
-    # higher throughput (its latency numbers travel with it); both passes
-    # are reported, each with the link RT sampled in ITS window, so a
-    # drop (and whether the 25 ms p99 target was physical in that phase)
-    # is checkable from the JSON alone.
-    rt_a = measure_rt_sample()
-    mixed_ops, metrics, cold_ops = bench_config4_mixed(make_client)
-    rt_b = measure_rt_sample()
-    mixed_ops2, metrics2, cold_ops2 = bench_config4_mixed(make_client)
-    rt_c = measure_rt_sample()
-    config4_passes = [round(mixed_ops), round(mixed_ops2)]
-    config4_cold_passes = [round(cold_ops), round(cold_ops2)]
+    # Config 4 runs THREE full passes and publishes the MEDIAN (ISSUE 4
+    # satellite): r05's best-of-2 recorded [1105792, 9933] — a single
+    # link-stall pass poisons a 2-sample aggregate, while a median of 3
+    # sheds one stall.  Each pass travels with BOTH link probes sampled
+    # in its bracketing windows (small-put RT for per-transfer-RT phases,
+    # resident RT for fetch-RT phases), so a stalled pass is attributable
+    # from the JSON alone.
+    config4_runs = []
+    bracket = measure_pass_link_sample()
+    for _ in range(3):
+        ops, m, cold = bench_config4_mixed(make_client)
+        post = measure_pass_link_sample()
+        config4_runs.append({
+            "ops": ops, "metrics": m, "cold": cold,
+            "link": {
+                k: [bracket[k], post[k]]
+                for k in ("link_h2d_put_rt_ms", "link_resident_rt_ms")
+            },
+        })
+        bracket = post
+    config4_passes = [round(r["ops"]) for r in config4_runs]
+    config4_cold_passes = [round(r["cold"]) for r in config4_runs]
+    config4_pass_link = [r["link"] for r in config4_runs]
     config4_pass_rt_ms = [
-        round((rt_a + rt_b) / 2, 2),
-        round((rt_b + rt_c) / 2, 2),
+        round(sum(r["link"]["link_resident_rt_ms"]) / 2, 2)
+        for r in config4_runs
     ]
     # Phase-conditional p99: the r3 target (<=25 ms at 1M QPS) is only
     # physical when the link RT is small in the SAME window — report the
     # p99 of any pass whose bracketing RT samples averaged < 5 ms.
     fast_p99s = [
-        m.get("p99_wait_ms")
-        for m, rt in ((metrics, config4_pass_rt_ms[0]),
-                      (metrics2, config4_pass_rt_ms[1]))
-        if rt < 5.0 and m.get("p99_wait_ms") is not None
+        r["metrics"].get("p99_wait_ms")
+        for r, rt in zip(config4_runs, config4_pass_rt_ms)
+        if rt < 5.0 and r["metrics"].get("p99_wait_ms") is not None
     ]
     p99_fast_phase = min(fast_p99s) if fast_p99s else None
-    if mixed_ops2 > mixed_ops:
-        mixed_ops, metrics = mixed_ops2, metrics2
+    # Published number = the median pass; its own metrics travel with it.
+    median_run = sorted(config4_runs, key=lambda r: r["ops"])[1]
+    mixed_ops, metrics = median_run["ops"], median_run["metrics"]
+    # Near-cache hot-key pass (ISSUE 4 tentpole evidence): same traffic
+    # with the tier on vs off + measured hit rate.
+    nearcache_stats = bench_nearcache_hotkeys(make_client)
     host_ops = measure_host_baseline()
 
     # vs_baseline: the bench env ships no redis-server, so the Redis-backed
@@ -673,10 +775,18 @@ def main():
                     "config4_cold_pass": max(config4_cold_passes),
                     "config4_warm_pass": max(config4_passes),
                     "config4_pass_rt_ms": config4_pass_rt_ms,
+                    # Per-pass bracketing link probes ([pre, post] per
+                    # pass, both regimes): a stalled pass carries its
+                    # own attribution (ISSUE 4 satellite).
+                    "config4_pass_link": config4_pass_link,
                     "p99_batch_ms_fast_phase": p99_fast_phase,
                     "config4_median": round(
                         float(np.median(config4_passes))
                     ),
+                    # Near cache (ISSUE 4): zipf hot-key pass, on vs off
+                    # + epoch-aware hit rate — the host-tier win measured
+                    # independently of tunnel phase.
+                    **nearcache_stats,
                     "hll_pfadd_ops_per_sec": round(hll_ops),
                     "config3_bitset_ops_per_sec": round(bitset_ops),
                     "config4_mixed_ops_per_sec": round(mixed_ops),

@@ -1,0 +1,14 @@
+"""Host-side caching tier (ISSUE 4 tentpole).
+
+- ``ShardedLRUStore`` — the one bounded, multi-tenant, sharded LRU
+  eviction implementation (shared by the sketch near cache AND the grid
+  ``LocalCachedMap`` near cache).
+- ``SketchNearCache`` — the epoch-guarded read tier threaded through the
+  sketch engines: monotone positives cache structural-epoch-free, every
+  other result class is write-epoch-tagged.
+"""
+
+from redisson_tpu.cache.lru import MISS, ShardedLRUStore
+from redisson_tpu.cache.nearcache import SketchNearCache
+
+__all__ = ["MISS", "ShardedLRUStore", "SketchNearCache"]

@@ -79,6 +79,26 @@ class TpuSketchConfig:
         # uniform ±fraction) instead of sleeping the flush thread.
         self.retry_max_backoff_ms = 2000
         self.retry_jitter = 0.2
+        # Near cache (ISSUE 4): the epoch-guarded host read tier — hot
+        # single-key reads (contains/GETBIT/PFCOUNT/CMS estimate) answer
+        # from host memory in microseconds regardless of link phase.
+        # Coherence is host-side epoch bookkeeping (zero device traffic):
+        # monotone positives (Bloom/bitset membership) cache until a
+        # structural change; everything else is write-epoch-tagged and
+        # served only while the tag matches.  Forced off under
+        # multi-host (process_count > 1): a hit skips a device dispatch,
+        # which would break multi-controller lockstep (same gate as
+        # mailbox_collect).
+        self.nearcache = True
+        self.nearcache_max_bytes = 64 << 20
+        # Per-tenant byte quota (fairness: one hot tenant can never
+        # evict everyone).  0 → max_bytes / 8.
+        self.nearcache_tenant_quota_bytes = 0
+        self.nearcache_shards = 8
+        # Batches larger than this bypass the cache entirely: bulk
+        # passes belong to the three-transfer link path, and per-op key
+        # materialization would tax them for nothing.
+        self.nearcache_max_batch = 1024
         # Device-side result mailbox: the completer concatenates pending
         # launches' packed results on device and fetches them in ONE D2H
         # (PROFILE.md remaining-lever 2) — each host fetch costs a full

@@ -119,7 +119,11 @@ class LazyResult:
             except Exception:
                 pass
 
-    def result(self):
+    def result(self, timeout=None):
+        # ``timeout`` accepted (and ignored) for signature parity with
+        # the coalescer's HintedFuture: callers treat the two
+        # interchangeably, and a LazyResult's fetch is synchronous — by
+        # the time it could "time out" it has already completed.
         if self._done is None:
             v = self._value
             if isinstance(v, jax.Array):

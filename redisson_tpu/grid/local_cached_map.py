@@ -4,11 +4,16 @@ strategies).
 
 The reference keeps a near cache in each client and invalidates peers
 through a topic; writes publish the touched key hashes.  Here the shared
-state is the grid Map entry, the near cache is a per-HANDLE dict (bounded
-LRU), and invalidation rides the client's TopicBus on the map's own
-``{name}:topic`` channel — every handle (including other handles in this
-process, the reference's multi-client analog) subscribes and drops
-invalidated keys.
+state is the grid Map entry, the near cache is a per-HANDLE
+``ShardedLRUStore`` (the ONE eviction implementation, shared with the
+sketch near cache — redisson_tpu/cache/lru.py), and invalidation rides
+the client's TopicBus on the map's own ``{name}:topic`` channel — every
+handle (including other handles in this process, the reference's
+multi-client analog) subscribes and drops invalidated keys.
+
+Riding the shared store buys what the private OrderedDict never had:
+byte-quota accounting (``cache_max_bytes``) on top of the entry bound,
+and hit/miss/eviction stats (``cache_stats()``) for free.
 
 Sync strategies (→ SyncStrategy): INVALIDATE (default) clears peer cache
 entries on write; UPDATE pushes the new value; NONE publishes nothing.
@@ -17,9 +22,9 @@ entries on write; UPDATE pushes the new value; NONE publishes nothing.
 from __future__ import annotations
 
 import threading
-from collections import OrderedDict
 from typing import Any, Optional
 
+from redisson_tpu.cache import MISS, ShardedLRUStore
 from redisson_tpu.grid.maps import Map, _MISSING
 
 INVALIDATE = "invalidate"
@@ -27,17 +32,40 @@ UPDATE = "update"
 NONE = "none"
 
 
+def _approx_nbytes(kb: bytes, value: Any) -> int:
+    """Caller-estimated entry size for the byte quota: key bytes + a flat
+    per-entry overhead + the value's obvious payload (sized types only —
+    arbitrary objects count a constant; the bound is a budget, not an
+    audit)."""
+    if isinstance(value, (bytes, bytearray, str)):
+        vb = len(value)
+    else:
+        vb = 64
+    return 96 + len(kb) + vb
+
+
 class LocalCachedMap(Map):
     KIND = "map"  # shares the backing Map keyspace entry
 
     def __init__(self, name, client, *, cache_size: int = 4096,
+                 cache_max_bytes: int = 64 << 20,
                  sync_strategy: str = INVALIDATE):
         import uuid
 
         super().__init__(name, client)
         if sync_strategy not in (INVALIDATE, UPDATE, NONE):
             raise ValueError(f"unknown sync strategy: {sync_strategy}")
-        self._cache: OrderedDict[bytes, Any] = OrderedDict()
+        # One shard: a handle's near cache is touched by one user thread
+        # plus the TopicBus pool — exact (not approximate) LRU matters
+        # more than lock spread at that concurrency.  The single tenant
+        # owns the WHOLE byte budget (the store's default per-tenant
+        # quota is budget/8, sized for many concurrent sketch tenants —
+        # here there is exactly one).
+        self._cache = ShardedLRUStore(
+            max_bytes=int(cache_max_bytes), nshards=1,
+            tenant_quota_bytes=int(cache_max_bytes),
+        )
+        self._cache.set_tenant_limits(name, max_entries=int(cache_size))
         self._cache_size = cache_size
         self._sync = sync_strategy
         self._bus = client._topic_bus
@@ -47,7 +75,9 @@ class LocalCachedMap(Map):
         # the reference's excludedId on LocalCachedMapInvalidate.
         self._cache_id = uuid.uuid4().hex
         # The near cache is touched by user threads AND the TopicBus
-        # delivery pool (_on_sync) — one lock guards every mutation.
+        # delivery pool (_on_sync) — the store's own locks guard entries;
+        # this lock guards the generation counter's read-then-install
+        # window.
         self._cache_lock = threading.Lock()
         self._inval_gen = 0
         self._listener_id = self._bus.subscribe(self._channel, self._on_sync)
@@ -64,22 +94,25 @@ class LocalCachedMap(Map):
             # install its (possibly stale) value afterwards.
             self._inval_gen += 1
             if kb is None:  # full clear
-                self._cache.clear()
+                self._cache.invalidate_tenant(self._name)
                 return
             if op == UPDATE and vb is not None:
                 self._cache_put_locked(kb, self._dec(vb))
             else:
-                self._cache.pop(kb, None)
+                self._cache.discard(self._name, kb)
 
     def _cache_put(self, kb: bytes, value: Any) -> None:
         with self._cache_lock:
             self._cache_put_locked(kb, value)
 
     def _cache_put_locked(self, kb: bytes, value: Any) -> None:
-        self._cache[kb] = value
-        self._cache.move_to_end(kb)
-        while len(self._cache) > self._cache_size:
-            self._cache.popitem(last=False)  # LRU eviction
+        # cache_size<=0 DISABLES the near cache (the pre-PR-4 OrderedDict
+        # evicted down to the bound after every put, leaving it
+        # permanently empty) — the store's own 0 means "unbounded entry
+        # count", the exact inversion of what the caller asked for.
+        if self._cache_size <= 0:
+            return
+        self._cache.put(self._name, kb, value, _approx_nbytes(kb, value))
 
     def _publish(self, kb: Optional[bytes], vb: Optional[bytes]) -> None:
         if self._sync == NONE:
@@ -90,10 +123,10 @@ class LocalCachedMap(Map):
 
     def get(self, key: Any) -> Any:
         kb = self._enc_key(key)
+        cached = self._cache.get(self._name, kb)
+        if cached is not MISS:
+            return cached
         with self._cache_lock:
-            if kb in self._cache:
-                self._cache.move_to_end(kb)
-                return self._cache[kb]
             gen = self._inval_gen
         val = super().get(key)
         if val is not None:
@@ -127,16 +160,14 @@ class LocalCachedMap(Map):
         else:
             prev = super().remove(key, expected)
         kb = self._enc_key(key)
-        with self._cache_lock:
-            self._cache.pop(kb, None)
+        self._cache.discard(self._name, kb)
         self._publish(kb, None)
         return prev
 
     def replace(self, key: Any, value: Any, new_value: Any = _MISSING):
         out = super().replace(key, value, new_value)
         kb = self._enc_key(key)
-        with self._cache_lock:
-            self._cache.pop(kb, None)
+        self._cache.discard(self._name, kb)
         self._publish(kb, None)
         return out
 
@@ -144,15 +175,13 @@ class LocalCachedMap(Map):
         out = super().put_if_absent(key, value)
         if out is None:  # stored: peers must drop any stale negative
             kb = self._enc_key(key)
-            with self._cache_lock:
-                self._cache.pop(kb, None)
+            self._cache.discard(self._name, kb)
             self._publish(kb, None)
         return out
 
     def delete(self) -> bool:
         out = super().delete()
-        with self._cache_lock:
-            self._cache.clear()
+        self._cache.invalidate_tenant(self._name)
         # Whole-map invalidation: peers drop EVERYTHING (kb=None marker).
         self._publish(None, None)
         return out
@@ -161,16 +190,14 @@ class LocalCachedMap(Map):
         n = super().fast_remove(*keys)
         for k in keys:
             kb = self._enc_key(k)
-            with self._cache_lock:
-                self._cache.pop(kb, None)
+            self._cache.discard(self._name, kb)
             self._publish(kb, None)
         return n
 
     def clear(self) -> bool:
         """→ RLocalCachedMap: clears backing map + every near cache."""
         existed = self.delete()
-        with self._cache_lock:
-            self._cache.clear()
+        self._cache.invalidate_tenant(self._name)
         if self._sync != NONE:
             self._bus.publish(
                 self._channel, (self._cache_id, INVALIDATE, None, None)
@@ -180,17 +207,23 @@ class LocalCachedMap(Map):
     # -- cache introspection (→ RLocalCachedMap#cachedEntrySet etc.) -------
 
     def cached_size(self) -> int:
-        with self._cache_lock:
-            return len(self._cache)
+        return self._cache.tenant_entry_count(self._name)
 
     def cached_key_set(self) -> list:
-        with self._cache_lock:
-            return [self._dec_key(kb) for kb in self._cache]
+        return [self._dec_key(kb) for kb in self._cache.tenant_keys(self._name)]
+
+    def cache_stats(self) -> dict:
+        """Near-cache occupancy/effectiveness (the shared LRU store's
+        hit/miss/eviction/byte accounting — the OrderedDict this cache
+        rode before PR 4 had none)."""
+        st = self._cache.stats()
+        st["tenant_bytes"] = self._cache.tenant_bytes(self._name)
+        st["max_entries"] = self._cache_size
+        return st
 
     def clear_local_cache(self) -> None:
         """→ RLocalCachedMap#clearLocalCache (this handle only)."""
-        with self._cache_lock:
-            self._cache.clear()
+        self._cache.invalidate_tenant(self._name)
 
     def pre_load_cache(self) -> None:
         """→ RLocalCachedMap#preloadCache: warm the near cache with the
@@ -201,5 +234,4 @@ class LocalCachedMap(Map):
     def destroy(self) -> None:
         """Unsubscribe this handle's invalidation listener."""
         self._bus.unsubscribe(self._channel, self._listener_id)
-        with self._cache_lock:
-            self._cache.clear()
+        self._cache.invalidate_tenant(self._name)

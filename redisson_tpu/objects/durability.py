@@ -110,6 +110,12 @@ class SketchDurabilityMixin:
                     # Shared heavy-hitter table dies with the object (a
                     # successor under this name must not inherit ghosts).
                     self.topk.drop(entry.name)
+                    # Near-cache entries die with it too (structural
+                    # epoch advance — a successor continues the
+                    # sequence, see cache/nearcache.py).
+                    nc = getattr(self, "nearcache", None)
+                    if nc is not None:
+                        nc.drop_object(entry.name)
                 return True
         return False
 
@@ -250,6 +256,11 @@ class SketchDurabilityMixin:
         # Unconditional: also CLEARS any ghost table when the dump
         # carries no candidates.
         self.topk.import_decoded(topk_decoded, name)
+        # RESTORE replaces readable state wholesale: retire every cached
+        # read of this name (structural epoch advance).
+        nc = getattr(self, "nearcache", None)
+        if nc is not None:
+            nc.drop_object(name)
 
     # -- Snapshots (client-side RDB analog) --------------------------------
 
@@ -476,6 +487,12 @@ class SketchDurabilityMixin:
                 if t.get("expire_at") is not None:
                     self._ensure_sweeper()
         self.topk.import_decoded(topk_decoded)
+        # Whole-keyspace event: every cached read predates the restored
+        # state (nearcache may be absent: engine init builds it AFTER
+        # restore_snapshot runs).
+        nc = getattr(self, "nearcache", None)
+        if nc is not None:
+            nc.invalidate_all()
         return True
 
     # -- Online reshard (SURVEY §2.4 cluster row) --------------------------
@@ -671,6 +688,13 @@ class SketchDurabilityMixin:
                 # dispatches that can't forward raise retryable into the
                 # coalescer's retry loop instead.
                 old_exec._successor = new_exec
+                # Topology changed under every cached read: whole-
+                # keyspace near-cache invalidation (defensive — values
+                # are layout-independent, but a mid-swap read may have
+                # raced the install).
+                nc = getattr(self, "nearcache", None)
+                if nc is not None:
+                    nc.invalidate_all()
                 old_exec._retired = True
         return True
 

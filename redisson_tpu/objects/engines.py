@@ -212,7 +212,10 @@ class _ConcatLazy:
         self._parts = parts
         self._done = None
 
-    def result(self):
+    def result(self, timeout=None):
+        # ``timeout`` accepted for signature parity with HintedFuture /
+        # LazyResult (callers treat the future types interchangeably);
+        # the per-part fetches are synchronous, so it is ignored.
         if self._done is None:
             self._done = np.concatenate([p.result() for p in self._parts])
             self._parts = None
@@ -223,6 +226,27 @@ class _ConcatLazy:
 
     def done(self) -> bool:
         return self._done is not None
+
+
+class _EpochGuard:
+    """Entry+exit write-epoch bump around a mutating engine call (see
+    cache/nearcache.py module doc: the entry bump retires stale serving
+    the moment the write is in flight; the exit bump retires installs
+    whose reads were captured inside the entry→submit window)."""
+
+    __slots__ = ("_bump", "_name")
+
+    def __init__(self, bump, name):
+        self._bump = bump
+        self._name = name
+
+    def __enter__(self):
+        self._bump(self._name)
+        return self
+
+    def __exit__(self, *exc):
+        self._bump(self._name)
+        return False
 
 
 class _MappedFuture:
@@ -288,6 +312,36 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
         self.obs = Observability()
         self.executor.obs = self.obs
+        # Near cache (ISSUE 4): the epoch-guarded host read tier — hot
+        # single-key reads answer from host memory regardless of link
+        # phase.  Built even when disabled so the epoch bookkeeping is
+        # already coherent when a live `CONFIG SET nearcache yes` lands.
+        # Multi-controller lockstep gate (same rule as mailbox_collect):
+        # a cache hit SKIPS a device dispatch, and eviction order depends
+        # on per-process-randomized hash() sharding — controllers would
+        # diverge in which reads dispatch, breaking SPMD program order.
+        from redisson_tpu.cache import ShardedLRUStore, SketchNearCache
+
+        import jax
+
+        ncc = config.tpu_sketch
+        self.nearcache = SketchNearCache(
+            ShardedLRUStore(
+                max_bytes=ncc.nearcache_max_bytes,
+                nshards=ncc.nearcache_shards,
+                tenant_quota_bytes=ncc.nearcache_tenant_quota_bytes,
+                on_evict=lambda tenant, nbytes: (
+                    self.obs.nearcache_evictions.inc()
+                ),
+            ),
+            obs=self.obs,
+            enabled=ncc.nearcache and jax.process_count() == 1,
+            max_batch=ncc.nearcache_max_batch,
+        )
+        if jax.process_count() > 1:
+            # Refuse live re-enables too (CONFIG SET nearcache yes):
+            # one controller turning it on alone would desync the fleet.
+            self.nearcache.locked_off = True
         # Self-healing dispatch (ISSUE 3): per-(shard, opcode) circuit
         # breakers + per-executor health machine.  When a breaker opens,
         # affected sketches fail over to host golden mirrors
@@ -451,6 +505,18 @@ class TpuSketchEngine(SketchDurabilityMixin):
             "sketches currently serving from the host golden mirror",
             lambda: len(self._mirrors),
         )
+        # Near cache (ISSUE 4): live occupancy (hits/misses/evictions
+        # are counters registered by the obs bundle itself).
+        reg.gauge_callback(
+            "rtpu_nearcache_bytes",
+            "host bytes resident in the sketch near cache",
+            self.nearcache.store.bytes,
+        )
+        reg.gauge_callback(
+            "rtpu_nearcache_entries",
+            "entries resident in the sketch near cache",
+            self.nearcache.store.entries,
+        )
 
         # One registry.stats() snapshot serves BOTH gauges per scrape:
         # stats() holds the tenancy lock (contended by the serving
@@ -533,6 +599,19 @@ class TpuSketchEngine(SketchDurabilityMixin):
         """Direct state reads must observe all queued coalesced ops."""
         if self.coalescer is not None:
             self.coalescer.drain()
+
+    def _nc_mutate(self, name: str, structural: bool = False):
+        """Near-cache write discipline for a mutating op on ``name``:
+        bump the write epoch at entry AND exit (structural ops bump the
+        structural epoch too — they retire monotone positives).  Every
+        path that can change the object's readable state must cross this
+        (or drop_object/invalidate_all) — mirror-degraded, replicated,
+        and sharded writes included, which it gets for free by wrapping
+        the ENGINE entry points those paths all flow through."""
+        nc = self.nearcache
+        return _EpochGuard(
+            nc.note_structural if structural else nc.note_write, name
+        )
 
     def prewarm_wait(self, timeout=None) -> bool:
         """Block until the AOT bucket pre-warmer has compiled every
@@ -751,6 +830,10 @@ class TpuSketchEngine(SketchDurabilityMixin):
         self._drain()
         self._reap_rows(entry.pool, self._entry_rows(entry), epoch)
         self.topk.drop(name)
+        # Structural epoch advance + entry drop: a successor object under
+        # this name continues the epoch sequence, so an in-flight read of
+        # the OLD object can never install as fresh.
+        self.nearcache.drop_object(name)
         if self._mirrors:
             with self._mirror_lock:
                 self._mirrors.pop(name, None)
@@ -772,6 +855,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 dest.pool, self._entry_rows(dest), dest.pool.topology_epoch
             )
         self.topk.rename(old, new)
+        # Both names change identity: drop entries + structural bumps.
+        self.nearcache.drop_object(old)
+        self.nearcache.drop_object(new)
         if self._mirrors:
             with self._mirror_lock:
                 self._mirrors.pop(new, None)
@@ -854,6 +940,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         entry = self._lookup_kind(name, PoolKind.BLOOM)
         if entry is None:
             raise RuntimeError(f"bloom filter {name!r} is not initialized")
+        # Topology change for this object's reads: defensively retire
+        # every cached entry (structural bump) while replicas publish.
+        self.nearcache.note_structural(name)
         with self.registry._lock:
             if entry.replica_rows:
                 return True
@@ -1000,39 +1089,60 @@ class TpuSketchEngine(SketchDurabilityMixin):
         return fut if gather is None else _MappedFuture(fut, gather)
 
     def bloom_add(self, name, H1, H2) -> LazyResult:
-        entry = self._require(name, PoolKind.BLOOM)
-        h1m, h2m = self._bloom_reduce(entry, H1, H2)
-        m, k = entry.params["size"], entry.params["hash_iterations"]
-        if (
-            not self.config.tpu_sketch.exact_add_semantics
-            and not entry.replica_rows
-            # Degraded: route through the hashed path's mirror failover
-            # instead of hitting the dead device via the fast-add st
-            # dispatch.
-            and not self._degraded(entry)
-        ):
-            # Fast single-tenant bulk path dispatches immediately — but only
-            # after queued coalesced ops flush, so a contains submitted
-            # *before* this add can never observe its writes (arrival-order
-            # contract of the coalescer docstring).
-            self._drain()
-            res = self.executor.bloom_add_fast_st(
-                entry.pool, entry.row, m, k, h1m, h2m
+        with self._nc_mutate(name):
+            entry = self._require(name, PoolKind.BLOOM)
+            h1m, h2m = self._bloom_reduce(entry, H1, H2)
+            m, k = entry.params["size"], entry.params["hash_iterations"]
+            if (
+                not self.config.tpu_sketch.exact_add_semantics
+                and not entry.replica_rows
+                # Degraded: route through the hashed path's mirror
+                # failover instead of hitting the dead device via the
+                # fast-add st dispatch.
+                and not self._degraded(entry)
+            ):
+                # Fast single-tenant bulk path dispatches immediately —
+                # but only after queued coalesced ops flush, so a
+                # contains submitted *before* this add can never observe
+                # its writes (arrival-order contract of the coalescer
+                # docstring).
+                self._drain()
+                res = self.executor.bloom_add_fast_st(
+                    entry.pool, entry.row, m, k, h1m, h2m
+                )
+                self._replication_fence(
+                    entry,
+                    False,
+                    lambda: self._bloom_dispatch_hashed(
+                        entry, h1m, h2m, np.ones(len(H1), bool)
+                    ),
+                )
+                return res
+            return self._bloom_dispatch_hashed(
+                entry, h1m, h2m, np.ones(len(H1), bool)
             )
-            self._replication_fence(
-                entry,
-                False,
-                lambda: self._bloom_dispatch_hashed(
-                    entry, h1m, h2m, np.ones(len(H1), bool)
-                ),
-            )
-            return res
-        return self._bloom_dispatch_hashed(
-            entry, h1m, h2m, np.ones(len(H1), bool)
-        )
 
     def bloom_contains(self, name, H1, H2) -> LazyResult:
+        # Epoch capture BEFORE entry resolution: a delete racing the
+        # lookup bumps epochs in between, and a late capture would tag
+        # the old object's results as fresh for its successor.
+        nc = self.nearcache
+        captured = nc.epochs(name)
         entry = self._require(name, PoolKind.BLOOM)
+        if nc.active(len(H1)):
+            H1a, H2a = np.asarray(H1), np.asarray(H2)
+            return nc.lookup_batch(
+                "bloom", name, nc.hashed_keys(H1a, H2a), np.bool_,
+                lambda idx: self._bloom_contains_dispatch(
+                    entry,
+                    H1a if idx is None else H1a[idx],
+                    H2a if idx is None else H2a[idx],
+                ),
+                monotone=True, captured=captured,
+            )
+        return self._bloom_contains_dispatch(entry, H1, H2)
+
+    def _bloom_contains_dispatch(self, entry, H1, H2) -> LazyResult:
         h1m, h2m = self._bloom_reduce(entry, H1, H2)
         m, k = entry.params["size"], entry.params["hash_iterations"]
         if (
@@ -1048,7 +1158,18 @@ class TpuSketchEngine(SketchDurabilityMixin):
         )
 
     def bloom_count(self, name) -> LazyResult:
+        nc = self.nearcache
+        captured = nc.epochs(name)  # before entry resolution, see contains
         entry = self._require(name, PoolKind.BLOOM)
+        if nc.active(1):
+            return nc.lookup_scalar(
+                "bloom", name, ("count",),
+                lambda: self._bloom_count_dispatch(entry),
+                captured=captured,
+            )
+        return self._bloom_count_dispatch(entry)
+
+    def _bloom_count_dispatch(self, entry) -> LazyResult:
         res = self._serve_degraded(entry, 1, lambda mir: mir.count())
         if res is not None:
             return res
@@ -1243,27 +1364,30 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def bloom_add_encoded(self, name, blocks, lengths) -> LazyResult:
         if self.executor.supports_device_hash:
-            entry = self._require(name, PoolKind.BLOOM)
-            if (
-                self.coalescer is not None
-                and self.config.tpu_sketch.exact_add_semantics
-            ) or entry.replica_rows or self._degraded(entry):
-                # The mixed-keys path owns the degraded-mirror failover.
-                return self._bloom_submit_mixed_keys(entry, blocks, lengths, True)
-            if not self.config.tpu_sketch.exact_add_semantics:
-                m, k = entry.params["size"], entry.params["hash_iterations"]
-                self._drain()
-                res = self.executor.bloom_add_keys_st(
-                    entry.pool, entry.row, m, k, blocks, lengths
-                )
-                self._replication_fence(
-                    entry,
-                    False,
-                    lambda: self._bloom_submit_mixed_keys(
+            with self._nc_mutate(name):
+                entry = self._require(name, PoolKind.BLOOM)
+                if (
+                    self.coalescer is not None
+                    and self.config.tpu_sketch.exact_add_semantics
+                ) or entry.replica_rows or self._degraded(entry):
+                    # The mixed-keys path owns the degraded-mirror failover.
+                    return self._bloom_submit_mixed_keys(
                         entry, blocks, lengths, True
-                    ),
-                )
-                return res
+                    )
+                if not self.config.tpu_sketch.exact_add_semantics:
+                    m, k = entry.params["size"], entry.params["hash_iterations"]
+                    self._drain()
+                    res = self.executor.bloom_add_keys_st(
+                        entry.pool, entry.row, m, k, blocks, lengths
+                    )
+                    self._replication_fence(
+                        entry,
+                        False,
+                        lambda: self._bloom_submit_mixed_keys(
+                            entry, blocks, lengths, True
+                        ),
+                    )
+                    return res
         return self.bloom_add(name, *hashing.hash128_np(blocks, lengths))
 
     def collect_results(self, lazies) -> None:
@@ -1279,19 +1403,44 @@ class TpuSketchEngine(SketchDurabilityMixin):
             pass
 
     def bloom_contains_encoded(self, name, blocks, lengths) -> LazyResult:
-        if self.executor.supports_device_hash:
-            entry = self._require(name, PoolKind.BLOOM)
-            if (
-                self.coalescer is not None
-                or entry.replica_rows
-                or self._degraded(entry)  # mixed-keys path serves mirror
-            ):
-                return self._bloom_submit_mixed_keys(entry, blocks, lengths, False)
-            m, k = entry.params["size"], entry.params["hash_iterations"]
-            return self.executor.bloom_contains_keys_st(
-                entry.pool, entry.row, m, k, blocks, lengths
+        if not self.executor.supports_device_hash:
+            return self.bloom_contains(name, *hashing.hash128_np(blocks, lengths))
+        nc = self.nearcache
+        captured = nc.epochs(name)  # before entry resolution, see contains
+        entry = self._require(name, PoolKind.BLOOM)
+        B = blocks.shape[0]
+        if nc.active(B):
+            lengths_arr = np.asarray(lengths)
+
+            def fetch(idx):
+                if idx is None:
+                    return self._bloom_contains_encoded_dispatch(
+                        entry, blocks, lengths
+                    )
+                sub_l = (
+                    lengths if lengths_arr.ndim == 0 else lengths_arr[idx]
+                )
+                return self._bloom_contains_encoded_dispatch(
+                    entry, blocks[idx], sub_l
+                )
+
+            return nc.lookup_batch(
+                "bloom", name, nc.encoded_keys(blocks, lengths), np.bool_,
+                fetch, monotone=True, captured=captured,
             )
-        return self.bloom_contains(name, *hashing.hash128_np(blocks, lengths))
+        return self._bloom_contains_encoded_dispatch(entry, blocks, lengths)
+
+    def _bloom_contains_encoded_dispatch(self, entry, blocks, lengths):
+        if (
+            self.coalescer is not None
+            or entry.replica_rows
+            or self._degraded(entry)  # mixed-keys path serves mirror
+        ):
+            return self._bloom_submit_mixed_keys(entry, blocks, lengths, False)
+        m, k = entry.params["size"], entry.params["hash_iterations"]
+        return self.executor.bloom_contains_keys_st(
+            entry.pool, entry.row, m, k, blocks, lengths
+        )
 
     # -- hll ---------------------------------------------------------------
 
@@ -1314,6 +1463,10 @@ class TpuSketchEngine(SketchDurabilityMixin):
         return entry
 
     def hll_add(self, name, c0, c1, c2) -> LazyResult:
+        with self._nc_mutate(name):
+            return self._hll_add_impl(name, c0, c1, c2)
+
+    def _hll_add_impl(self, name, c0, c1, c2) -> LazyResult:
         entry = self.hll_ensure(name)
         res = self._serve_degraded(
             entry, len(c0),
@@ -1340,18 +1493,30 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def hll_add_encoded(self, name, blocks, lengths) -> LazyResult:
         if self.coalescer is None and self.executor.supports_device_hash:
-            entry = self.hll_ensure(name)
-            if not self._degraded(entry):
-                return self.executor.hll_add_keys_single(
-                    entry.pool, entry.row, blocks, lengths
-                )
+            with self._nc_mutate(name):
+                entry = self.hll_ensure(name)
+                if not self._degraded(entry):
+                    return self.executor.hll_add_keys_single(
+                        entry.pool, entry.row, blocks, lengths
+                    )
         c0, c1, c2, _ = hashing.murmur3_x86_128(blocks, lengths)
         return self.hll_add(name, c0, c1, c2)
 
     def hll_count(self, name) -> LazyResult:
+        nc = self.nearcache
+        captured = nc.epochs(name)  # before entry resolution
         entry = self._lookup_kind(name, PoolKind.HLL)
         if entry is None:
             return ImmediateResult(0)
+        if nc.active(1):
+            return nc.lookup_scalar(
+                "hll", name, ("count",),
+                lambda: self._hll_count_dispatch(entry),
+                captured=captured,
+            )
+        return self._hll_count_dispatch(entry)
+
+    def _hll_count_dispatch(self, entry) -> LazyResult:
         res = self._serve_degraded(entry, 1, lambda mir: mir.count())
         if res is not None:
             return res
@@ -1388,6 +1553,10 @@ class TpuSketchEngine(SketchDurabilityMixin):
         return int(round(golden.ertl_estimate(hist)))
 
     def hll_merge_with(self, name, other_names) -> None:
+        with self._nc_mutate(name):
+            return self._hll_merge_with_impl(name, other_names)
+
+    def _hll_merge_with_impl(self, name, other_names) -> None:
         entry = self.hll_ensure(name)
         src_entries = [
             e
@@ -1463,6 +1632,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
         need_words = class_words_for_bits(min_bits)
         if need_words <= cur_words:
             return
+        # Size-class migration is STRUCTURAL for the near cache (ISSUE
+        # 4: clear/resize/migration bump unconditionally) — entry+exit
+        # bumps bracket the whole commit so no read captured mid-
+        # migration can install.
+        with self._nc_mutate(entry.name, structural=True):
+            self._bitset_migrate(entry, need_words)
+
+    def _bitset_migrate(self, entry, need_words: int) -> None:
         # Shrink the queue first (optional — flush-time row resolution in
         # _bitset_submit_mixed makes queued ops follow the repoint, so
         # correctness doesn't depend on this drain).
@@ -1616,31 +1793,54 @@ class TpuSketchEngine(SketchDurabilityMixin):
         from redisson_tpu.ops import bitset as bitset_ops
 
         idx = np.asarray(idx, np.uint32)
-        entry = self.bitset_ensure(name, int(idx.max()) + 1 if idx.size else 1)
-        if value:
-            return self._bitset_rw(
-                bitset_ops.OP_SET, self.executor.bitset_set, entry, idx
+        # Clearing bits retires monotone positives → structural bump;
+        # setting bits is an ordinary (monotone-safe) write.
+        with self._nc_mutate(name, structural=not value):
+            entry = self.bitset_ensure(
+                name, int(idx.max()) + 1 if idx.size else 1
             )
-        return self._bitset_rw(
-            bitset_ops.OP_CLEAR, self.executor.bitset_clear_bits, entry, idx
-        )
+            if value:
+                return self._bitset_rw(
+                    bitset_ops.OP_SET, self.executor.bitset_set, entry, idx
+                )
+            return self._bitset_rw(
+                bitset_ops.OP_CLEAR, self.executor.bitset_clear_bits, entry,
+                idx,
+            )
 
     def bitset_flip(self, name, idx) -> LazyResult:
         from redisson_tpu.ops import bitset as bitset_ops
 
         idx = np.asarray(idx, np.uint32)
-        entry = self.bitset_ensure(name, int(idx.max()) + 1 if idx.size else 1)
-        return self._bitset_rw(
-            bitset_ops.OP_FLIP, self.executor.bitset_flip, entry, idx
-        )
+        with self._nc_mutate(name, structural=True):  # flips clear bits
+            entry = self.bitset_ensure(
+                name, int(idx.max()) + 1 if idx.size else 1
+            )
+            return self._bitset_rw(
+                bitset_ops.OP_FLIP, self.executor.bitset_flip, entry, idx
+            )
 
     def bitset_get(self, name, idx) -> LazyResult:
-        from redisson_tpu.ops import bitset as bitset_ops
-
         idx = np.asarray(idx, np.uint32)
+        nc = self.nearcache
+        captured = nc.epochs(name)  # before entry resolution
         entry = self._lookup_kind(name, PoolKind.BITSET)
         if entry is None:
             return ImmediateResult(np.zeros(len(idx), bool))
+        if nc.active(len(idx)):
+            return nc.lookup_batch(
+                "bitset", name, [int(i) for i in idx], np.bool_,
+                lambda midx: self._bitset_get_dispatch(
+                    entry, idx if midx is None else idx[midx]
+                ),
+                monotone=True,  # OP_CLEAR/OP_FLIP/replace are structural
+                captured=captured,
+            )
+        return self._bitset_get_dispatch(entry, idx)
+
+    def _bitset_get_dispatch(self, entry, idx) -> LazyResult:
+        from redisson_tpu.ops import bitset as bitset_ops
+
         cap = entry.pool.row_units * 32
         in_range = idx < cap
         safe_idx = np.where(in_range, idx, 0).astype(np.uint32)
@@ -1659,49 +1859,83 @@ class TpuSketchEngine(SketchDurabilityMixin):
         return _MappedFuture(res, lambda v: v & in_range)
 
     def bitset_set_range(self, name, from_bit, to_bit, value: bool) -> LazyResult:
-        entry = self.bitset_ensure(name, int(to_bit))
-        res = self._serve_degraded(
-            entry, 1,
-            lambda mir: mir.set_range(int(from_bit), int(to_bit), bool(value)),
-        )
-        if res is not None:
-            return res
-        self._drain()
-        return self.executor.bitset_set_range(
-            entry.pool, entry.row, int(from_bit), int(to_bit), value
-        )
+        with self._nc_mutate(name, structural=not value):
+            entry = self.bitset_ensure(name, int(to_bit))
+            res = self._serve_degraded(
+                entry, 1,
+                lambda mir: mir.set_range(int(from_bit), int(to_bit), bool(value)),
+            )
+            if res is not None:
+                return res
+            self._drain()
+            return self.executor.bitset_set_range(
+                entry.pool, entry.row, int(from_bit), int(to_bit), value
+            )
+
+    def _nc_scalar(self, kind, name, key, dispatch, captured):
+        """Near-cache plumbing shared by every scalar read-through
+        (bitset cardinality/length/bitpos, CMS total): epoch-tagged,
+        single host int.  ``captured``: epoch pair sampled before entry
+        resolution."""
+        nc = self.nearcache
+        if nc.active(1):
+            return int(
+                nc.lookup_scalar(
+                    kind, name, key, dispatch, captured=captured
+                ).result()
+            )
+        return int(dispatch().result())
 
     def bitset_cardinality(self, name) -> int:
+        captured = self.nearcache.epochs(name)
         entry = self._lookup_kind(name, PoolKind.BITSET)
         if entry is None:
             return 0
-        res = self._serve_degraded(entry, 1, lambda mir: mir.cardinality())
-        if res is not None:
-            return res.result()
-        self._drain()
-        return self.executor.bitset_cardinality(entry.pool, entry.row).result()
+
+        def dispatch():
+            res = self._serve_degraded(entry, 1, lambda mir: mir.cardinality())
+            if res is not None:
+                return res
+            self._drain()
+            return self.executor.bitset_cardinality(entry.pool, entry.row)
+
+        return self._nc_scalar("bitset", name, ("card",), dispatch, captured)
 
     def bitset_length(self, name) -> int:
+        captured = self.nearcache.epochs(name)
         entry = self._lookup_kind(name, PoolKind.BITSET)
         if entry is None:
             return 0
-        res = self._serve_degraded(entry, 1, lambda mir: mir.length())
-        if res is not None:
-            return res.result()
-        self._drain()
-        return self.executor.bitset_length(entry.pool, entry.row).result()
+
+        def dispatch():
+            res = self._serve_degraded(entry, 1, lambda mir: mir.length())
+            if res is not None:
+                return res
+            self._drain()
+            return self.executor.bitset_length(entry.pool, entry.row)
+
+        return self._nc_scalar("bitset", name, ("len",), dispatch, captured)
 
     def bitset_bitpos(self, name, target_bit: int) -> int:
+        captured = self.nearcache.epochs(name)
         entry = self._lookup_kind(name, PoolKind.BITSET)
         if entry is None:
             return -1 if target_bit else 0
-        res = self._serve_degraded(
-            entry, 1, lambda mir: mir.bitpos(int(target_bit))
+
+        def dispatch():
+            res = self._serve_degraded(
+                entry, 1, lambda mir: mir.bitpos(int(target_bit))
+            )
+            if res is not None:
+                return res
+            self._drain()
+            return self.executor.bitset_bitpos(
+                entry.pool, entry.row, target_bit
+            )
+
+        return self._nc_scalar(
+            "bitset", name, ("bitpos", int(target_bit)), dispatch, captured
         )
-        if res is not None:
-            return res.result()
-        self._drain()
-        return self.executor.bitset_bitpos(entry.pool, entry.row, target_bit).result()
 
     def bitset_bitop(self, dest: str, src_names, op: str) -> None:
         """BITOP dest = op(srcs).  All operands (dest included) are grown
@@ -1716,6 +1950,10 @@ class TpuSketchEngine(SketchDurabilityMixin):
         to the byte boundary too) and is masked there so tail bits of the
         size-class row stay 0.
         """
+        with self._nc_mutate(dest, structural=True):  # dest is REPLACED
+            return self._bitset_bitop_impl(dest, src_names, op)
+
+    def _bitset_bitop_impl(self, dest: str, src_names, op: str) -> None:
         max_bits = max(
             (self.bitset_capacity_bits(n) for n in (dest, *src_names)),
             default=0,
@@ -1808,26 +2046,36 @@ class TpuSketchEngine(SketchDurabilityMixin):
         """Total inserted weight (CMS.INFO 'count'): every increment adds
         its weight to exactly one cell per depth row, so row 0's sum is
         the total."""
+        captured = self.nearcache.epochs(name)  # before entry resolution
         entry = self._require(name, PoolKind.CMS)
         w = entry.params["width"]
-        res = self._serve_degraded(entry, 1, lambda mir: mir.total())
-        if res is not None:
-            return res.result()
-        self._drain()
-        row = self.executor.read_row(entry.pool, entry.row)
-        return int(np.asarray(row[:w], np.uint64).sum())
+
+        def dispatch():
+            res = self._serve_degraded(entry, 1, lambda mir: mir.total())
+            if res is not None:
+                return res
+            self._drain()
+            row = self.executor.read_row(entry.pool, entry.row)
+            return ImmediateResult(int(np.asarray(row[:w], np.uint64).sum()))
+
+        return self._nc_scalar("cms", name, ("total",), dispatch, captured)
 
     def cms_reset(self, name) -> None:
         """Zero a CMS's counters in place (CMS.MERGE overwrite semantics)
         — the registry entry and any top-K configuration survive."""
-        entry = self._require(name, PoolKind.CMS)
-        res = self._serve_degraded(entry, 1, lambda mir: mir.reset())
-        if res is not None:
-            return
-        self._drain()
-        self.executor.zero_row(entry.pool, entry.row)
+        with self._nc_mutate(name, structural=True):  # counters REPLACED
+            entry = self._require(name, PoolKind.CMS)
+            res = self._serve_degraded(entry, 1, lambda mir: mir.reset())
+            if res is not None:
+                return
+            self._drain()
+            self.executor.zero_row(entry.pool, entry.row)
 
     def cms_add(self, name, H1, H2, weights) -> LazyResult:
+        with self._nc_mutate(name):
+            return self._cms_add_impl(name, H1, H2, weights)
+
+    def _cms_add_impl(self, name, H1, H2, weights) -> LazyResult:
         entry = self._require(name, PoolKind.CMS)
         d, w = entry.params["depth"], entry.params["width"]
         h1w, h2w = hashing.km_reduce_mod(H1, H2, w)
@@ -1860,7 +2108,24 @@ class TpuSketchEngine(SketchDurabilityMixin):
         )
 
     def cms_estimate(self, name, H1, H2) -> LazyResult:
+        nc = self.nearcache
+        captured = nc.epochs(name)  # before entry resolution
         entry = self._require(name, PoolKind.CMS)
+        if nc.active(len(H1)):
+            H1a, H2a = np.asarray(H1), np.asarray(H2)
+            return nc.lookup_batch(
+                "cms", name, nc.hashed_keys(H1a, H2a), np.uint32,
+                lambda idx: self._cms_estimate_dispatch(
+                    entry,
+                    H1a if idx is None else H1a[idx],
+                    H2a if idx is None else H2a[idx],
+                ),
+                monotone=False,  # any add can raise an estimate
+                captured=captured,
+            )
+        return self._cms_estimate_dispatch(entry, H1, H2)
+
+    def _cms_estimate_dispatch(self, entry, H1, H2) -> LazyResult:
         d, w = entry.params["depth"], entry.params["width"]
         h1w, h2w = hashing.km_reduce_mod(H1, H2, w)
         rows = np.full(len(H1), entry.row, np.int32)
@@ -1899,6 +2164,10 @@ class TpuSketchEngine(SketchDurabilityMixin):
         excluded.  Falls back to the vectorized XLA path where the kernel
         isn't available (sharded mode) or the geometry doesn't fit VMEM
         lane blocks; the fallback's estimates include the whole batch."""
+        with self._nc_mutate(name):
+            return self._cms_add_seq_impl(name, H1, H2, weights)
+
+    def _cms_add_seq_impl(self, name, H1, H2, weights) -> LazyResult:
         entry = self._require(name, PoolKind.CMS)
         d, w = entry.params["depth"], entry.params["width"]
         if self._degraded(entry):
@@ -1935,6 +2204,10 @@ class TpuSketchEngine(SketchDurabilityMixin):
         )
 
     def cms_merge(self, name, other_names) -> None:
+        with self._nc_mutate(name):
+            return self._cms_merge_impl(name, other_names)
+
+    def _cms_merge_impl(self, name, other_names) -> None:
         entry = self._require(name, PoolKind.CMS)
         src_entries = []
         for n in other_names:

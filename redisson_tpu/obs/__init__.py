@@ -65,6 +65,21 @@ class Observability:
             "rtpu_faults_injected",
             "chaos faults injected, by fault point and kind",
             ("point", "kind"))
+        # Near cache (ISSUE 4): hit/miss by result kind; evictions and
+        # live byte occupancy are store-side (evictions inc'd via the
+        # store's on_evict hook, bytes a render-time gauge registered by
+        # the engine).
+        self.nearcache_hits = r.counter(
+            "rtpu_nearcache_hits",
+            "reads answered from the host near cache, by object kind",
+            ("kind",))
+        self.nearcache_misses = r.counter(
+            "rtpu_nearcache_misses",
+            "near-cache probes that went to the device, by object kind",
+            ("kind",))
+        self.nearcache_evictions = r.counter(
+            "rtpu_nearcache_evictions",
+            "near-cache entries evicted (quota or budget pressure)")
 
     # -- instrumentation helpers (one call per batch, never per op) --------
 

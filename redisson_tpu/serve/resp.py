@@ -823,12 +823,50 @@ class RespServer:
         "slowlog-max-len": "128",
     }
 
+    # Near-cache tunables (ISSUE 4) live-apply to the engine's
+    # SketchNearCache on CONFIG SET.  Registered only when the fronted
+    # engine HAS a near cache (host engine: unknown option — silently
+    # acking would fake a capability).
+    def _nearcache(self):
+        return getattr(getattr(self._client, "_engine", None),
+                       "nearcache", None)
+
+    def _config_table_init(self) -> dict:
+        table = dict(self._CONFIG_KEYS)
+        nc = self._nearcache()
+        if nc is not None:
+            table.update({
+                "nearcache": "yes" if nc.enabled else "no",
+                "nearcache-max-bytes": str(nc.store.max_bytes),
+                "nearcache-tenant-quota-bytes": str(
+                    nc.store.tenant_quota_bytes
+                ),
+                "nearcache-max-batch": str(nc.max_batch),
+            })
+        return table
+
+    def _apply_nearcache_config(self, key: str, val: str) -> None:
+        nc = self._nearcache()
+        if nc is None:  # validated against the table: can't happen
+            return
+        if key == "nearcache":
+            try:
+                nc.set_enabled(val.lower() in ("yes", "1", "true", "on"))
+            except ValueError as e:  # forced off under multi-host
+                raise RespError(str(e)) from e
+        elif key == "nearcache-max-bytes":
+            nc.store.resize(max_bytes=int(val))
+        elif key == "nearcache-tenant-quota-bytes":
+            nc.store.resize(tenant_quota_bytes=int(val))
+        elif key == "nearcache-max-batch":
+            nc.max_batch = int(val)
+
     def _cmd_CONFIG(self, args):
         import fnmatch
 
         sub = args[0].decode().upper()
         if not hasattr(self, "_config_table"):
-            self._config_table = dict(self._CONFIG_KEYS)
+            self._config_table = self._config_table_init()
         if sub == "GET":
             pat = args[1].decode().lower()
             flat = []
@@ -852,23 +890,66 @@ class RespServer:
                         f"Unknown option or number of arguments for "
                         f"CONFIG SET - '{key}'"
                     )
-                if key.startswith("slowlog-"):
+                if key.startswith("slowlog-") or (
+                    key.startswith("nearcache-")
+                ):
                     try:
-                        int(pairs[i + 1])
+                        iv = int(pairs[i + 1])
                     except ValueError:
                         raise RespError(
                             f"Invalid argument '{pairs[i + 1].decode()}' "
                             f"for CONFIG SET '{key}'"
                         )
+                    # Bounds, like redis-server's out-of-range rejection:
+                    # a negative/zero budget or batch cap would silently
+                    # kill the cache while acking OK.  Quota 0 is legal
+                    # (0 → re-derive the max_bytes/8 default).
+                    if key in (
+                        "nearcache-max-bytes", "nearcache-max-batch"
+                    ) and iv <= 0:
+                        raise RespError(
+                            f"argument must be positive for CONFIG SET "
+                            f"'{key}'"
+                        )
+                    if key == "nearcache-tenant-quota-bytes" and iv < 0:
+                        raise RespError(
+                            f"argument must be >= 0 for CONFIG SET "
+                            f"'{key}'"
+                        )
+                elif key == "nearcache":
+                    v = pairs[i + 1].decode().lower()
+                    if v not in (
+                        "yes", "no", "1", "0", "true", "false", "on", "off"
+                    ):
+                        raise RespError(
+                            f"Invalid argument '{pairs[i + 1].decode()}' "
+                            f"for CONFIG SET '{key}'"
+                        )
+                    nc = self._nearcache()
+                    if (
+                        nc is not None and nc.locked_off
+                        and v in ("yes", "1", "true", "on")
+                    ):
+                        # Refused HERE, before any table write: CONFIG GET
+                        # must never report yes while the cache is forced
+                        # off (multi-host lockstep).
+                        raise RespError(
+                            "nearcache is forced off under multi-host "
+                            "(a cache hit skips a device dispatch — "
+                            "multi-controller lockstep)"
+                        )
             for i in range(0, len(pairs), 2):
                 key = pairs[i].decode().lower()
                 val = pairs[i + 1].decode()
                 self._config_table[key] = val
-                # Live-apply the slowlog tunables (validated above).
+                # Live-apply the slowlog/nearcache tunables (validated
+                # above).
                 if key == "slowlog-log-slower-than":
                     self.obs.slowlog.set_threshold_us(int(val))
                 elif key == "slowlog-max-len":
                     self.obs.slowlog.set_max_len(int(val))
+                elif key.startswith("nearcache"):
+                    self._apply_nearcache_config(key, val)
             return _encode_simple("OK")
         if sub == "RESETSTAT":
             # Zero the commandstats/latencystats families, like Redis.
@@ -1694,7 +1775,9 @@ class RespServer:
     # Default INFO excludes commandstats/latencystats, like redis-server
     # (they can be wide); 'INFO all'/'everything' or the explicit section
     # name includes them.
-    _INFO_DEFAULT = ("server", "clients", "memory", "stats", "keyspace")
+    _INFO_DEFAULT = (
+        "server", "clients", "memory", "stats", "nearcache", "keyspace",
+    )
 
     def _cmd_INFO(self, args):
         section = args[0].decode().lower() if args else "default"
@@ -1774,6 +1857,27 @@ class RespServer:
                         f"p50={st['p50_us']:g},p99={st['p99_us']:g},"
                         f"p99.9={st['p999_us']:g}"
                     )
+            elif s == "nearcache":
+                # Sketch near cache (ISSUE 4): the epoch-guarded host
+                # read tier.  Section absent on the host engine (no tier
+                # to report — honesty over empty zeros).
+                nc = self._nearcache()
+                if nc is not None:
+                    st = nc.stats()
+                    lines += [
+                        "# Nearcache",
+                        f"nearcache_enabled:{1 if st['enabled'] else 0}",
+                        f"nearcache_hits:{st['hits']}",
+                        f"nearcache_misses:{st['misses']}",
+                        f"nearcache_hit_rate:{st['hit_rate']}",
+                        f"nearcache_bytes:{st['bytes']}",
+                        f"nearcache_max_bytes:{st['max_bytes']}",
+                        f"nearcache_entries:{st['entries']}",
+                        f"nearcache_evictions:{st['evictions']}",
+                        f"nearcache_tenants:{st['tenants']}",
+                        f"nearcache_tenant_quota_bytes:"
+                        f"{st['tenant_quota_bytes']}",
+                    ]
             elif s == "keyspace":
                 n = self._client.get_keys().count()
                 lines += ["# Keyspace", f"db0:keys={n},expires=0,avg_ttl=0"]

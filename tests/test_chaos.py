@@ -486,6 +486,79 @@ class TestDegradedServe:
             c._engine.shutdown()
 
 
+class TestNearCacheChaos:
+    """Near cache × chaos (ISSUE 4 satellite): under breaker-open
+    degradation every MIRROR write must bump the write epoch — a cached
+    pre-degradation read can never serve stale — and reconcile-on-close
+    must leave cache and device bit-identical."""
+
+    def test_mirror_writes_bump_epochs_no_stale_negative(self):
+        c = make_client(breaker_failure_threshold=2, breaker_open_ms=60_000)
+        eng = c._engine
+        try:
+            bf = c.get_bloom_filter("ncc-bf")
+            bf.try_init(20_000, 0.01)
+            bf.add("pre")
+            # Cache a negative AND a positive before the fault lands.
+            assert bf.contains("late-add") is False
+            assert bf.contains("pre") is True
+            assert eng.nearcache.store.entries() >= 2
+            chaos.install(ChaosSchedule(seed=4, rate=1.0, points=BLOOM_POINTS))
+            for i in range(8):
+                try:
+                    bf.add(f"open{i}")
+                except Exception:
+                    pass
+                if eng.health.any_degraded:
+                    break
+            assert _await(lambda: eng.health.any_degraded)
+            # The mirror write bumps the epoch at submit: the cached
+            # negative must NOT answer this read.
+            assert _flap(lambda: bf.add("late-add")) is True
+            assert _flap(lambda: bf.contains("late-add")) is True
+            # The monotone positive is still warm and still true.
+            assert _flap(lambda: bf.contains("pre")) is True
+        finally:
+            chaos.clear()
+            eng.shutdown()
+
+    def test_reconcile_leaves_cache_and_device_bit_identical(self):
+        c = make_client(breaker_failure_threshold=2, breaker_open_ms=600)
+        eng = c._engine
+        nc = eng.nearcache
+        try:
+            bf = c.get_bloom_filter("ncc-rec")
+            bf.try_init(20_000, 0.01)
+            pre = [f"pre{i}" for i in range(20)]
+            bf.add_all(pre)
+            chaos.install(ChaosSchedule(seed=6, rate=1.0, points=BLOOM_POINTS))
+            for i in range(8):
+                try:
+                    bf.add(f"open{i}")
+                except Exception:
+                    pass
+                if eng.health.any_degraded:
+                    break
+            assert _await(lambda: eng.health.any_degraded)
+            during = [f"during{i}" for i in range(20)]
+            for k in during:
+                assert _flap(lambda k=k: bf.add(k)) is True
+            # Cache some degraded-window reads (mirror-served).
+            assert all(_flap(lambda k=k: bf.contains(k)) for k in during)
+            # Heal: breaker closes, mirror reconciles to the device row.
+            chaos.clear()
+            assert _await(lambda: not eng.health.any_degraded)
+            assert not eng._mirrors
+            probe = pre + during + [f"ghost{i}" for i in range(20)]
+            cached = [bf.contains(k) for k in probe]  # may serve from cache
+            nc.store.clear()  # force the next pass to the device
+            device = [bf.contains(k) for k in probe]
+            assert cached == device  # bit-identical, entry for entry
+        finally:
+            chaos.clear()
+            eng.shutdown()
+
+
 class TestDegradedKinds:
     """Mirror parity for the other sketch kinds (hll/bitset/cms)."""
 
