@@ -21,6 +21,7 @@ engine (the Redis-server stand-in) through the identical client path.
 """
 
 import json
+import threading
 import time
 
 import numpy as np
@@ -387,6 +388,234 @@ def bench_nearcache_hotkeys(make_client):
     return out
 
 
+def _resp_skip_frame(buf: bytes, i: int) -> int:
+    from redisson_tpu.serve.wireutil import skip_reply_frame
+
+    return skip_reply_frame(buf, i)
+
+
+def _resp_wire(args) -> bytes:
+    from redisson_tpu.serve.wireutil import wire_command
+
+    return wire_command(args)
+
+
+def bench_config6_frontdoor(make_client):
+    """Config 6 — front-door command-stream vectorization (ISSUE 6).
+
+    Loopback RESP server, P pipelined connections, each streaming batches
+    of mixed hot-read/write commands (zipf BF.EXISTS + BF.ADD on one
+    filter, repeated GETs on a hot string set, SETBIT/GETBIT on one
+    bitmap).  Interleaved A/B: alternating passes with the vectorizer ON
+    and OFF on the SAME server/connections, so link phase and cache state
+    can't favor one arm.  Publishes fused-vs-unfused pipelined cmds/s,
+    the fusion ratio, and the response-cache hit rate — the tentpole's
+    headline, captured in BENCH_rN.json rather than prose.  A second
+    mini-A/B toggles the coalescer's phase-aware merge cap
+    (max_batch_slow_phase) and reports the observed link phase with both
+    numbers: the cap must pay ONLY in the slow phase, so in a fast-phase
+    window the two arms read ~equal."""
+    import socket as _socket
+
+    from redisson_tpu.serve.resp import RespServer
+
+    P = 4            # pipelined connections
+    DEPTH = 256      # commands per pipelined batch
+    PASS_S = 1.5     # seconds per measured pass
+    N_ITEMS = 512    # hot bloom keyspace
+    client = make_client(batch_window_us=200)
+    server = RespServer(client)
+    try:
+        bf = client.get_bloom_filter("fd-bf")
+        bf.try_init(100_000, 0.01)
+        bf.add_all_async(
+            np.arange(0, N_ITEMS, 2, dtype=np.uint64)
+        ).result(timeout=600.0)
+        seed_sock = _socket.create_connection((server.host, server.port))
+        seed = [
+            [b"SET", b"fd-s%d" % i, b"value-%d" % i] for i in range(4)
+        ] + [[b"SETBIT", b"fd-bs", b"%d" % i, b"1"] for i in range(0, 64, 2)]
+        seed_sock.sendall(b"".join(_resp_wire(c) for c in seed))
+        buf = b""
+        got = 0
+        while got < len(seed):
+            buf += seed_sock.recv(1 << 16)
+            pos = 0
+            got = 0
+            while True:
+                try:
+                    pos = _resp_skip_frame(buf, pos)
+                    got += 1
+                except (IndexError, ValueError):
+                    break
+        seed_sock.close()
+
+        rng = np.random.default_rng(17)
+
+        def make_batch():
+            # Burst-shaped pipeline (the redis-benchmark / bulk-client
+            # pattern the tentpole targets): a client streams a SPAN of
+            # same-family commands before switching — mixed hot
+            # reads/writes INSIDE each span (BF.ADD among BF.EXISTS,
+            # SETBIT among GETBIT, SET among GET), so every span
+            # exercises the mixed fused path, not a read-only fast case.
+            cmds = []
+            while len(cmds) < DEPTH:
+                burst = min(int(rng.integers(16, 49)), DEPTH - len(cmds))
+                hot = (rng.zipf(1.3, burst) - 1) % N_ITEMS
+                fam = rng.random()
+                if fam < 0.5:  # bloom span, ~15% writes
+                    for i in range(burst):
+                        if rng.random() < 0.15:
+                            cmds.append(
+                                [b"BF.ADD", b"fd-bf", b"%d" % hot[i]]
+                            )
+                        else:
+                            cmds.append(
+                                [b"BF.EXISTS", b"fd-bf", b"%d" % hot[i]]
+                            )
+                elif fam < 0.8:  # hot string span, ~4% writes
+                    for i in range(burst):
+                        k = b"fd-s%d" % (int(hot[i]) % 4)
+                        if rng.random() < 0.04:
+                            cmds.append(
+                                [b"SET", k, b"value-%d" % int(hot[i])]
+                            )
+                        else:
+                            cmds.append([b"GET", k])
+                else:  # bitmap span, ~20% writes
+                    for i in range(burst):
+                        off = b"%d" % (hot[i] % 64)
+                        if rng.random() < 0.2:
+                            cmds.append([b"SETBIT", b"fd-bs", off, b"1"])
+                        else:
+                            cmds.append([b"GETBIT", b"fd-bs", off])
+            return b"".join(_resp_wire(c) for c in cmds)
+
+        batches = [make_batch() for _ in range(8)]
+
+        def pass_cmds_per_sec(duration_s):
+            stop = time.perf_counter() + duration_s
+            counts = [0] * P
+            errors = []
+
+            def worker(t, sock):
+                try:
+                    k = t
+                    while time.perf_counter() < stop:
+                        payload = batches[k % len(batches)]
+                        k += 1
+                        sock.sendall(payload)
+                        buf = b""
+                        got = 0
+                        pos = 0
+                        while got < DEPTH:
+                            buf += sock.recv(1 << 16)
+                            while True:
+                                try:
+                                    pos = _resp_skip_frame(buf, pos)
+                                    got += 1
+                                except (IndexError, ValueError):
+                                    break
+                        counts[t] += got
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+            socks = [
+                _socket.create_connection((server.host, server.port))
+                for _ in range(P)
+            ]
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=worker, args=(t, socks[t]))
+                for t in range(P)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            dt = time.perf_counter() - t0
+            for s in socks:
+                s.close()
+            if errors:
+                raise errors[0]
+            return sum(counts) / dt
+
+        obs = server.obs
+
+        def counter_total(fam):
+            return sum(int(c.value) for _, c in fam.items())
+
+        # Warm both arms (compile buckets, seed caches) before timing.
+        for vec in (True, False):
+            server.vectorize = vec
+            pass_cmds_per_sec(0.4)
+        # Interleaved A/B: on/off alternating, 3 passes each.  Counter
+        # deltas accumulate around the ON passes ONLY — the OFF arm
+        # dispatches every command sequentially on purpose, and folding
+        # its unfused commands into the denominator would dilute the
+        # published fusion ratio by however slow that arm happens to be.
+        on_passes, off_passes = [], []
+        fused = total = rch = rcm = 0
+        for _ in range(3):
+            server.vectorize = True
+            f0, t0 = (
+                counter_total(obs.resp_fused_cmds),
+                counter_total(obs.resp_commands),
+            )
+            h0, m0 = (
+                counter_total(obs.resp_cache_hits),
+                counter_total(obs.resp_cache_misses),
+            )
+            on_passes.append(pass_cmds_per_sec(PASS_S))
+            fused += counter_total(obs.resp_fused_cmds) - f0
+            total += counter_total(obs.resp_commands) - t0
+            rch += counter_total(obs.resp_cache_hits) - h0
+            rcm += counter_total(obs.resp_cache_misses) - m0
+            server.vectorize = False
+            off_passes.append(pass_cmds_per_sec(PASS_S))
+        server.vectorize = True
+        out = {
+            "frontdoor_cmds_per_sec": round(float(np.median(on_passes))),
+            "frontdoor_unfused_cmds_per_sec": round(
+                float(np.median(off_passes))
+            ),
+            "frontdoor_passes": [round(p) for p in on_passes],
+            "frontdoor_unfused_passes": [round(p) for p in off_passes],
+            "frontdoor_speedup": round(
+                float(np.median(on_passes))
+                / max(1.0, float(np.median(off_passes))), 2
+            ),
+            "frontdoor_fusion_ratio": (
+                round(fused / total, 4) if total else 0.0
+            ),
+            "frontdoor_response_cache_hit_rate": (
+                round(rch / (rch + rcm), 4) if rch + rcm else 0.0
+            ),
+            "frontdoor_connections": P,
+            "frontdoor_pipeline_depth": DEPTH,
+        }
+        # Merge-cap mini A/B (satellite): same fused traffic with the
+        # phase-aware cap armed vs disabled, plus the phase the link was
+        # actually in (the cap only ENGAGES when the put-RT EWMA says
+        # slow) — fast-phase windows should read ~equal, which is the
+        # "pays only where intended" evidence on a fast link.
+        co = getattr(client._engine, "coalescer", None)
+        if co is not None:
+            ab = {}
+            for label, cap in (("on", co.max_batch * 4), ("off", 0)):
+                co.max_batch_slow_phase = cap
+                ab[label] = round(pass_cmds_per_sec(0.8))
+            co.max_batch_slow_phase = 0
+            ab["phase_slow"] = bool(co._put_rt_ewma > co.slow_launch_s)
+            ab["put_rt_ewma_ms"] = round(co._put_rt_ewma * 1000, 2)
+            out["frontdoor_merge_cap_ab"] = ab
+        return out
+    finally:
+        server.close()
+        client.shutdown()
+
+
 def bench_config3_bitset(client):
     """Config 3: 2^30-bit RBitSet, batched get/set (raw bitmap path).
 
@@ -744,6 +973,9 @@ def main():
     # Near-cache hot-key pass (ISSUE 4 tentpole evidence): same traffic
     # with the tier on vs off + measured hit rate.
     nearcache_stats = bench_nearcache_hotkeys(make_client)
+    # Front-door vectorization pass (ISSUE 6 tentpole evidence):
+    # pipelined RESP cmds/s with fused runs on vs off, interleaved A/B.
+    frontdoor_stats = bench_config6_frontdoor(make_client)
     host_ops = measure_host_baseline()
 
     # vs_baseline: the bench env ships no redis-server, so the Redis-backed
@@ -787,6 +1019,11 @@ def main():
                     # + epoch-aware hit rate — the host-tier win measured
                     # independently of tunnel phase.
                     **nearcache_stats,
+                    # Front door (ISSUE 6): config6_frontdoor — pipelined
+                    # RESP throughput, fusion on vs off (interleaved),
+                    # fusion ratio + response-cache hit rate + the
+                    # phase-aware merge-cap mini A/B.
+                    **frontdoor_stats,
                     "hll_pfadd_ops_per_sec": round(hll_ops),
                     "config3_bitset_ops_per_sec": round(bitset_ops),
                     "config4_mixed_ops_per_sec": round(mixed_ops),

@@ -61,6 +61,12 @@ def main(argv=None) -> int:
         "bodies are Python — RCE for anyone who can reach the socket; "
         "refused unless --requirepass is set or the bind is loopback)",
     )
+    p.add_argument(
+        "--no-resp-vectorize", action="store_true",
+        help="disable front-door pipeline vectorization (fused runs + "
+        "per-connection response cache; docs/performance.md) — "
+        "debugging escape hatch, semantics are identical either way",
+    )
     args = p.parse_args(argv)
 
     import redisson_tpu
@@ -91,6 +97,8 @@ def main(argv=None) -> int:
         cfg.requirepass = args.requirepass
     if args.enable_python_scripts:
         cfg.enable_python_scripts = True
+    if args.no_resp_vectorize:
+        cfg.resp_vectorize = False
 
     client = redisson_tpu.create(cfg)
     server = RespServer(

@@ -90,11 +90,14 @@ class _AssembledResult:
             for i, v in self._hit_vals:
                 out[i] = v
             out[self._miss_idx] = sub
-            for j, i in enumerate(self._miss_idx):
-                self._cache.install(
-                    self._name, self._keys[i], sub[j].item(),
-                    captured=self._captured, monotone=self._monotone,
-                )
+            self._cache.install_batch(
+                self._name,
+                [
+                    (self._keys[i], sub[j].item())
+                    for j, i in enumerate(self._miss_idx)
+                ],
+                captured=self._captured, monotone=self._monotone,
+            )
             self._done = out
             self._fut = None
         return self._done
@@ -300,6 +303,31 @@ class SketchNearCache:
             ent = (value, w, None)
         nbytes = _ENTRY_OVERHEAD + _key_nbytes(key)
         self.store.put(name, key, ent, nbytes)
+
+    def install_batch(self, name: str, items, *, captured,
+                      monotone) -> None:
+        """Batch install for assembled partial-hit results (the fused
+        front-door runs make these hundreds of ops long): ONE epoch
+        sample covers every miss of the object — per-key re-sampling in
+        install() is redundant inside a single resolve, and the epoch
+        rules applied here are install()'s exactly."""
+        if not self.enabled:
+            return
+        w, s = self.epochs(name)
+        tagged_ok = (w, s) == captured
+        monotone_ok = monotone and captured[1] == s
+        for key, value in items:
+            if monotone and bool(value):
+                if not monotone_ok:
+                    continue
+                ent = (value, None, s)  # positive: survives plain writes
+            else:
+                if not tagged_ok:
+                    continue
+                ent = (value, w, None)
+            self.store.put(
+                name, key, ent, _ENTRY_OVERHEAD + _key_nbytes(key)
+            )
 
     def _count(self, kind: str, hits: int, misses: int) -> None:
         self.hits += hits

@@ -39,6 +39,14 @@ class TpuSketchConfig:
         # unbounded-queue p99 catastrophe (round-2 postmortem).  0 → auto
         # (8 × max_batch).
         self.max_queued_ops = 0
+        # Phase-aware merge cap (ISSUE 6 satellite, ROADMAP per-transfer-RT
+        # lever): while the link's observed launch-retirement EWMA says
+        # every transfer costs ~a round trip, merge-at-pop may combine
+        # parked/queued segments PAST the static max_batch up to this
+        # bound — fewer, larger launches exactly when each launch eats an
+        # RT.  0 disables (cap stays max_batch); in the fast phase the
+        # static cap always applies.
+        self.max_batch_slow_phase = 0
         # Adaptive in-flight: shrink the dispatch window toward
         # min_inflight while observed launch retirement is slow (the
         # transport's >~12-launch cliff degrades EVERY op when the link
@@ -181,6 +189,18 @@ class Config:
         # the bind is loopback.  (The in-process Python ScriptService is
         # unaffected: in-process callers can run code anyway.)
         self.enable_python_scripts = False
+        # Front-door command-stream vectorization (ISSUE 6 tentpole):
+        # fuse runs of adjacent pipelined commands that target the same
+        # (object, opcode) family into single engine launches, demuxing
+        # the packed result back into per-command replies in order.
+        # Per-connection sequential semantics are preserved bit-for-bit
+        # (non-fusable commands act as run barriers).
+        self.resp_vectorize = True
+        # Per-connection response cache for REPEATED IDENTICAL read
+        # commands inside one pipeline window (one parsed-ahead batch):
+        # entry count bound; 0 disables.  Entries are invalidated by any
+        # write epoch bump (any non-read RESP command on any connection).
+        self.resp_response_cache_size = 64
 
     # -- fluent setters, mirroring the Java builder idiom ------------------
 
@@ -225,6 +245,8 @@ class Config:
         "requirepass",
         "enable_python_scripts",
         "script_timeout_ms",
+        "resp_vectorize",
+        "resp_response_cache_size",
     )
 
     def to_dict(self) -> dict:

@@ -139,9 +139,25 @@ class BatchCoalescer:
                  max_window_us: int = 0,
                  group_collect: Optional[Callable] = None, obs=None,
                  retry_max_backoff_s: float = 2.0,
-                 retry_jitter: float = 0.2, health=None):
+                 retry_jitter: float = 0.2, health=None,
+                 max_batch_slow_phase: int = 0):
         self.window_s = batch_window_us / 1e6
         self.max_batch = max_batch
+        # Phase-aware merge cap (ISSUE 6 satellite, the ROADMAP
+        # per-transfer-RT lever): in the link regime where EVERY launch
+        # eats ~a round trip, a backlog of queued/parked segments should
+        # collapse into FEWER, LARGER launches than the static max_batch
+        # allows — merge-at-pop may combine segments up to this bound
+        # while the put-RT EWMA says the slow phase holds.  0 disables;
+        # values <= max_batch are inert.  Only the POP-TIME merge is
+        # affected: submit-side segment fill keeps the static cap, so
+        # producer latency is untouched in either phase.
+        self.max_batch_slow_phase = max(0, int(max_batch_slow_phase))
+        # EWMA of observed launch retirement latency — the link model's
+        # put-RT signal.  Genuine samples only for FAST readings (a
+        # backlogged completer's near-zero collect time proves nothing);
+        # slow readings always count (the result really took that long).
+        self._put_rt_ewma = 0.0
         # Adaptive flush window: ``batch_window_us`` is the BASE; an
         # EWMA-of-arrival-rate + queue-pressure controller moves the live
         # window inside [min_window, max_window] — shrinking it under
@@ -363,18 +379,30 @@ class BatchCoalescer:
         self._order.appendleft(seg)
         self._wake.notify()
 
+    def merge_cap(self) -> int:
+        """Live pop-time merge bound: the static ``max_batch`` in the
+        fast phase, ``max_batch_slow_phase`` while the put-RT EWMA says
+        each launch costs ~a round trip (fewer, larger launches are the
+        only lever left there — the per-op near cache already dodges the
+        link, and transfer count per launch is fixed)."""
+        cap = self.max_batch_slow_phase
+        if cap > self.max_batch and self._put_rt_ewma > self.slow_launch_s:
+            return cap
+        return self.max_batch
+
     def _merge_consecutive_locked(self, head: _Segment, i: int) -> _Segment:
         """Fold queued segments with the same key immediately FOLLOWING
-        ``head``'s old position into it (up to max_batch): a backlog
-        becomes one larger launch instead of a deep dispatch queue.  Only
-        the consecutive run is merged — a different-key segment (possibly
-        the same pool on another op path) acts as an order fence, so
-        per-pool arrival order is preserved."""
+        ``head``'s old position into it (up to the live merge cap — see
+        merge_cap): a backlog becomes one larger launch instead of a deep
+        dispatch queue.  Only the consecutive run is merged — a
+        different-key segment (possibly the same pool on another op path)
+        acts as an order fence, so per-pool arrival order is preserved."""
+        cap = self.merge_cap()
         while i < len(self._order):
             nxt = self._order[i]
             if (
                 nxt.key != head.key
-                or head.nops + nxt.nops > self.max_batch
+                or head.nops + nxt.nops > cap
                 or nxt.not_before is not None
             ):
                 break
@@ -534,6 +562,13 @@ class BatchCoalescer:
         take that long to arrive)."""
         with self._inflight_cv:
             self._uncollected = max(0, self._uncollected - 1)
+            if collect_s is not None and (
+                genuine or collect_s > self.slow_launch_s
+            ):
+                # Link-phase EWMA (feeds merge_cap): ~4-sample constant —
+                # fast enough to catch a phase flip, slow enough that one
+                # stall doesn't flap the cap.
+                self._put_rt_ewma += 0.25 * (collect_s - self._put_rt_ewma)
             if self._adaptive and collect_s is not None:
                 if collect_s > self.slow_launch_s:
                     self._inflight_limit = max(

@@ -25,6 +25,7 @@
  */
 
 #include <stdint.h>
+#include <string.h>
 
 /* The build probes cc/gcc/g++/clang in order; under a C++ compiler the
  * symbols must not mangle (ctypes looks them up by C name). */
@@ -154,6 +155,48 @@ long rtpu_resp_encode_ints(const long *vals, long n, unsigned char *out,
             out[w++] = '-';
         while (t)
             out[w++] = tmp[--t];
+        out[w++] = '\r';
+        out[w++] = '\n';
+    }
+    return w;
+}
+
+/* Serialize a batch of bulk-string replies (`$len\r\n<bytes>\r\n`, or
+ * `$-1\r\n` for nil when lens[i] < 0) — the common reply shape of fused
+ * GET/MGET runs and container reads (HGETALL/LRANGE/SMEMBERS pipelines).
+ * Values arrive concatenated in `payload` at (offs[i], lens[i]); one call
+ * per reply batch instead of one Python string-build per value.  Returns
+ * bytes written, or -1 if the output buffer is too small. */
+long rtpu_resp_encode_bulks(const unsigned char *payload, const long *offs,
+                            const long *lens, long n, unsigned char *out,
+                            long cap)
+{
+    long w = 0;
+    for (long i = 0; i < n; i++) {
+        long L = lens[i];
+        if (L < 0) {
+            if (w + 5 > cap)
+                return -1;
+            memcpy(out + w, "$-1\r\n", 5);
+            w += 5;
+            continue;
+        }
+        /* "$" + <=20 digits + CRLF + payload + CRLF */
+        if (w + L + 26 > cap)
+            return -1;
+        out[w++] = '$';
+        unsigned char tmp[24];
+        long t = 0, v = L;
+        do {
+            tmp[t++] = '0' + (unsigned char)(v % 10);
+            v /= 10;
+        } while (v);
+        while (t)
+            out[w++] = tmp[--t];
+        out[w++] = '\r';
+        out[w++] = '\n';
+        memcpy(out + w, payload + offs[i], (size_t)L);
+        w += L;
         out[w++] = '\r';
         out[w++] = '\n';
     }

@@ -106,6 +106,15 @@ class BloomFilter(RObject):
 
     contains_async = contains_all_async
 
+    def mixed_async(self, objs, flags):
+        """Ordered add/contains mix in ONE engine call (the front-door
+        fused-run entry, ISSUE 6): ``flags[i]`` True adds ``objs[i]``
+        (result: newly added), False tests membership.  Intra-batch
+        sequencing matches issuing the ops one at a time."""
+        return self._engine.bloom_mixed_encoded(
+            self._name, *self._encode(objs), flags
+        )
+
     def contains_many(self, batches) -> list:
         """Pipelined bulk membership: dispatch EVERY batch, then collect
         all results in one reply flush — the RBatch idiom (a Redisson

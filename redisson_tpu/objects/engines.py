@@ -407,6 +407,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 ),
                 retry_jitter=config.tpu_sketch.retry_jitter,
                 health=self.health,
+                max_batch_slow_phase=(
+                    config.tpu_sketch.max_batch_slow_phase
+                ),
             )
         else:
             # Direct-dispatch mode: the executor is the only recorder of
@@ -486,6 +489,12 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 "rtpu_flush_window_us",
                 "live adaptive flush window",
                 lambda: c.window_s * 1e6,
+            )
+            reg.gauge_callback(
+                "rtpu_flush_merge_cap",
+                "live pop-time merge cap (max_batch, or "
+                "max_batch_slow_phase while the link phase is slow)",
+                c.merge_cap,
             )
         if self.prewarmer is not None:
             reg.gauge_callback(
@@ -1252,17 +1261,28 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
         return dispatch
 
-    def _bloom_submit_mixed_keys(self, entry, blocks, lengths, is_add: bool):
+    def _bloom_submit_mixed_keys(self, entry, blocks, lengths, is_add):
         """Device-hash path: raw codec lanes ride the mixed kernel;
         producer threads never hash (GIL relief under offered load).
         Replicated entries expand writes to every copy and rotate reads.
         Lane count is part of the segment key so concatenated chunks
-        always agree on shape."""
+        always agree on shape.
+
+        ``is_add`` is a scalar for uniform batches or a per-op bool array
+        for an ordered add/contains mix (the front-door fused runs of
+        ISSUE 6) — the mixed kernel honors intra-batch order either way;
+        only the runs-metadata compression requires a uniform flag."""
         m, k = entry.params["size"], entry.params["hash_iterations"]
         pool = entry.pool
         B = blocks.shape[0]
         L = blocks.shape[1]
         lengths = np.asarray(lengths, np.uint32)
+        uniform = np.ndim(is_add) == 0
+        orig_flags = (
+            np.full(B, bool(is_add), bool)
+            if uniform else np.asarray(is_add, bool)
+        )
+        any_add = bool(orig_flags.any())
         if self._degraded(entry):
             # Degraded: hash host-side (the mirror consumes reduced
             # hashes) and serve from the golden mirror.
@@ -1273,9 +1293,8 @@ class TpuSketchEngine(SketchDurabilityMixin):
             h1m, h2m = self._bloom_reduce(
                 entry, *hashing.hash128_np(blocks, lens)
             )
-            flags = np.full(B, bool(is_add), bool)
             res = self._mirror_call(
-                entry, B, lambda mir: mir.mixed(h1m, h2m, flags)
+                entry, B, lambda mir: mir.mixed(h1m, h2m, orig_flags)
             )
             if res is not None:
                 return res
@@ -1295,6 +1314,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if (
             self.coalescer is not None
             and not saw_replicas
+            and uniform
             and getattr(self.executor, "supports_runs_metadata", False)
         ):
             # Run-length path: row/m/is_add are constant across this call,
@@ -1315,7 +1335,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 meta=(entry.row, m, is_add, len_meta),
                 tenant=entry.name,
             )
-            if is_add:
+            if any_add:
                 self._replication_fence(
                     entry,
                     saw_replicas,
@@ -1328,7 +1348,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
             return fut
         if lengths.ndim == 0:
             lengths = np.full(B, lengths, np.uint32)
-        flags = np.full(B, is_add, bool)
+        flags = orig_flags
         orig = (blocks, lengths)
         if saw_replicas:
             rows, eidx, ppos = self._bloom_expand_ops(entry, B, flags)
@@ -1354,12 +1374,20 @@ class TpuSketchEngine(SketchDurabilityMixin):
             fut = self.executor.bloom_mixed_keys(
                 pool, rows, m_arr, k, blocks, lengths, flags
             )
-        if is_add:
-            self._replication_fence(
-                entry,
-                saw_replicas,
-                lambda: self._bloom_submit_mixed_keys(entry, *orig, True),
-            )
+        if any_add:
+            # Fence re-applies WRITES only: for a mixed batch the add
+            # subset re-broadcasts (contains ops have nothing to re-apply
+            # and re-running them would waste a launch).
+            if uniform:
+                redo = lambda: self._bloom_submit_mixed_keys(  # noqa: E731
+                    entry, *orig, True
+                )
+            else:
+                sel = orig_flags
+                redo = lambda: self._bloom_submit_mixed_keys(  # noqa: E731
+                    entry, orig[0][sel], orig[1][sel], True
+                )
+            self._replication_fence(entry, saw_replicas, redo)
         return fut if gather is None else _MappedFuture(fut, gather)
 
     def bloom_add_encoded(self, name, blocks, lengths) -> LazyResult:
@@ -1441,6 +1469,30 @@ class TpuSketchEngine(SketchDurabilityMixin):
         return self.executor.bloom_contains_keys_st(
             entry.pool, entry.row, m, k, blocks, lengths
         )
+
+    def bloom_mixed_encoded(self, name, blocks, lengths, flags) -> LazyResult:
+        """Front-door fused run (ISSUE 6): one ordered add/contains mix on
+        one filter as ONE engine call — per-op results (newly-added for
+        add ops, membership for contains ops) come back in command order.
+        The mixed kernel already honors intra-batch sequencing (adds and
+        contains of one pool share a coalescer segment today), so a run
+        of 500 pipelined BF.ADD/BF.EXISTS costs one launch, not 500."""
+        flags = np.asarray(flags, bool)
+        if not flags.any():
+            return self.bloom_contains_encoded(name, blocks, lengths)
+        if flags.all():
+            return self.bloom_add_encoded(name, blocks, lengths)
+        with self._nc_mutate(name):
+            entry = self._require(name, PoolKind.BLOOM)
+            if not self.executor.supports_device_hash:
+                lens = np.asarray(lengths, np.uint32)
+                if lens.ndim == 0:
+                    lens = np.full(blocks.shape[0], lens, np.uint32)
+                h1m, h2m = self._bloom_reduce(
+                    entry, *hashing.hash128_np(blocks, lens)
+                )
+                return self._bloom_dispatch_hashed(entry, h1m, h2m, flags)
+            return self._bloom_submit_mixed_keys(entry, blocks, lengths, flags)
 
     # -- hll ---------------------------------------------------------------
 
@@ -2572,6 +2624,30 @@ class HostSketchEngine:
 
     def bloom_contains_encoded(self, name, blocks, lengths):
         return self.bloom_contains(name, *hashing.hash128_np(blocks, lengths))
+
+    def bloom_mixed_encoded(self, name, blocks, lengths, flags):
+        """Ordered add/contains mix on one filter (front-door fused runs):
+        consecutive same-flag spans apply in order under one lock hold,
+        so results are bit-identical to the sequential command stream."""
+        o = self._require(name, PoolKind.BLOOM)
+        model = o["model"]
+        H1, H2 = hashing.hash128_np(blocks, lengths)
+        h1m, h2m = hashing.km_reduce_mod(H1, H2, model.size)
+        flags = np.asarray(flags, bool)
+        n = len(flags)
+        out = np.empty(n, bool)
+        with self._lock:
+            i = 0
+            while i < n:
+                j = i + 1
+                while j < n and flags[j] == flags[i]:
+                    j += 1
+                if flags[i]:
+                    out[i:j] = model.add_hashed(h1m[i:j], h2m[i:j])
+                else:
+                    out[i:j] = model.contains_hashed(h1m[i:j], h2m[i:j])
+                i = j
+        return ImmediateResult(out)
 
     def bloom_replicate(self, name) -> bool:
         return False  # one host copy; nothing to spread reads across

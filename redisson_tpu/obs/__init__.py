@@ -80,6 +80,27 @@ class Observability:
         self.nearcache_evictions = r.counter(
             "rtpu_nearcache_evictions",
             "near-cache entries evicted (quota or budget pressure)")
+        # Front door vectorization (ISSUE 6): pipelined command runs fused
+        # into single engine launches, plus the per-connection response
+        # cache for repeated identical reads inside one pipeline window.
+        self.resp_fused_cmds = r.counter(
+            "rtpu_resp_fused_cmds",
+            "RESP commands absorbed into fused front-door runs, by family",
+            ("family",))
+        self.resp_fused_ops = r.counter(
+            "rtpu_resp_fused_ops",
+            "engine ops carried by fused front-door runs, by family",
+            ("family",))
+        self.resp_fused_runs = r.counter(
+            "rtpu_resp_fused_runs",
+            "fused front-door runs dispatched, by family", ("family",))
+        self.resp_cache_hits = r.counter(
+            "rtpu_resp_response_cache_hits",
+            "pipelined replies served from the per-connection response "
+            "cache")
+        self.resp_cache_misses = r.counter(
+            "rtpu_resp_response_cache_misses",
+            "response-cache probes that executed the command")
 
     # -- instrumentation helpers (one call per batch, never per op) --------
 

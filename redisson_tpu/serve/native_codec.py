@@ -78,6 +78,17 @@ class NativeRespParser:
         self._enc = lib.rtpu_resp_encode_ints
         self._enc.restype = ctypes.c_long
         self._enc.argtypes = [ctypes.POINTER(L), L, ctypes.c_char_p, L]
+        # Batch bulk-reply encoder (fused GET/MGET runs, container
+        # reads).  getattr-guarded: a stale .so without the symbol (no
+        # compiler to rebuild) must degrade this one call, not unload
+        # the whole parser.
+        self._enc_bulks = getattr(lib, "rtpu_resp_encode_bulks", None)
+        if self._enc_bulks is not None:
+            self._enc_bulks.restype = ctypes.c_long
+            self._enc_bulks.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(L), ctypes.POINTER(L), L,
+                ctypes.c_char_p, L,
+            ]
         self._counts = (L * self.MAX_FRAMES)()
         self._offs = (L * self.MAX_ARGS)()
         self._lens = (L * self.MAX_ARGS)()
@@ -108,6 +119,34 @@ class NativeRespParser:
         cap = 26 * n
         out = ctypes.create_string_buffer(cap)
         w = self._enc(arr, n, out, cap)
+        if w < 0:  # pragma: no cover — cap is sized to the worst case
+            raise ValueError("encode buffer overflow")
+        return out.raw[:w]
+
+    def encode_bulks(self, vals) -> Optional[bytes]:
+        """Serialize ``vals`` (bytes or None per item) as concatenated
+        RESP bulk-string replies in ONE native call; None when the loaded
+        .so predates the symbol (caller keeps its Python path)."""
+        if self._enc_bulks is None:
+            return None
+        L = ctypes.c_long
+        n = len(vals)
+        offs = (L * n)()
+        lens = (L * n)()
+        parts = []
+        off = 0
+        for i, v in enumerate(vals):
+            if v is None:
+                lens[i] = -1
+            else:
+                parts.append(v)
+                offs[i] = off
+                lens[i] = len(v)
+                off += len(v)
+        payload = b"".join(parts)
+        cap = off + 26 * n
+        out = ctypes.create_string_buffer(cap)
+        w = self._enc_bulks(payload, offs, lens, n, out, cap)
         if w < 0:  # pragma: no cover — cap is sized to the worst case
             raise ValueError("encode buffer overflow")
         return out.raw[:w]

@@ -51,6 +51,8 @@ import threading
 import time
 from typing import Optional
 
+import numpy as np
+
 
 class RespError(Exception):
     pass
@@ -85,6 +87,66 @@ _ERROR_CODES = (
 _SCRIPT_CMDS = frozenset(
     ("EVAL", "EVALSHA", "SCRIPT", "FCALL", "FCALL_RO", "FUNCTION")
 )
+
+# -- front-door vectorization tables (ISSUE 6 tentpole) ----------------------
+
+# Commands that may not be dispatched from inside a buffered pipelined
+# batch: blocking commands would hold earlier replies hostage; pub/sub
+# handlers write to the socket themselves (their pushes must not overtake
+# buffered replies).
+_PIPELINE_STOP = frozenset((
+    b"BLPOP", b"BRPOP", b"XREAD", b"XREADGROUP",
+    b"SUBSCRIBE", b"UNSUBSCRIBE",
+))
+
+# NON-MUTATING commands: dispatching one cannot change any keyspace-read
+# result, so it does not bump the server's write epoch (the response
+# cache's invalidation clock).  Conservative ALLOWLIST — anything absent
+# counts as a write.
+_NONMUTATING = frozenset((
+    "GET", "MGET", "STRLEN", "GETRANGE", "EXISTS", "TTL", "PTTL", "TYPE",
+    "KEYS", "DBSIZE", "RANDOMKEY", "GETBIT", "BITCOUNT", "BITPOS",
+    "PFCOUNT", "BF.EXISTS", "BF.MEXISTS", "BF.INFO", "CMS.QUERY",
+    "CMS.INFO", "TOPK.QUERY", "TOPK.COUNT", "TOPK.LIST", "TOPK.INFO",
+    "LLEN", "LRANGE", "LINDEX", "LPOS", "HGET", "HMGET", "HGETALL",
+    "HKEYS", "HVALS", "HLEN", "HEXISTS", "HRANDFIELD", "SCARD",
+    "SISMEMBER", "SMISMEMBER", "SMEMBERS", "SRANDMEMBER", "SINTER",
+    "SUNION", "SDIFF", "SINTERCARD", "ZSCORE", "ZRANGE", "ZCARD",
+    "ZRANK", "ZCOUNT", "ZRANGEBYSCORE", "ZREVRANGE", "ZREVRANK",
+    "ZRANGEBYLEX", "ZRANDMEMBER", "XLEN", "XRANGE", "XREVRANGE", "XINFO",
+    "XPENDING", "GEOPOS", "GEODIST", "GEOHASH", "GEOSEARCH", "HSCAN",
+    "SSCAN", "ZSCAN", "SCAN", "OBJECT", "DUMP", "PING", "ECHO", "SELECT",
+    "TIME", "COMMAND", "CLIENT", "INFO", "SLOWLOG", "WAIT", "AUTH",
+    "HELLO", "QUIT",
+))
+
+# Response-CACHEABLE subset: deterministic pure keyspace reads whose
+# reply depends only on (argv, keyspace state) — no cursors, no
+# randomness, no wall-clock.  Served from the per-connection response
+# cache while the write epoch is unmoved.
+_CACHEABLE = frozenset((
+    "GET", "MGET", "STRLEN", "GETRANGE", "EXISTS", "TYPE", "GETBIT",
+    "BITCOUNT", "BITPOS", "PFCOUNT", "BF.EXISTS", "BF.MEXISTS",
+    "CMS.QUERY", "LLEN", "LRANGE", "LINDEX", "HGET", "HMGET", "HGETALL",
+    "HLEN", "HEXISTS", "SCARD", "SISMEMBER", "SMISMEMBER", "SMEMBERS",
+    "ZSCORE", "ZCARD", "ZRANK",
+))
+
+# Fusable families: runs of ADJACENT commands in one parsed-ahead batch
+# that target the same (object, opcode family) fuse into one engine call.
+# name -> (is_add, takes_many_items)
+_BF_RUN = {
+    b"BF.ADD": (True, False),
+    b"BF.MADD": (True, True),
+    b"BF.EXISTS": (False, False),
+    b"BF.MEXISTS": (False, True),
+}
+_BIT_RUN = frozenset((b"SETBIT", b"GETBIT"))
+_GET_RUN = frozenset((b"GET", b"MGET"))
+
+# Bound on ops one fused run may carry (memory + keeps fused launches in
+# the pre-warmed bucket ladder; a longer run simply splits).
+_RUN_MAX_OPS = 1 << 14
 
 
 def _encode_error(s: str) -> bytes:
@@ -125,16 +187,25 @@ _int_encoder_loaded = False
 def _encode_array(items) -> bytes:
     global _int_encoder, _int_encoder_loaded
     out = b"*" + str(len(items)).encode() + b"\r\n"
-    if len(items) >= 8 and all(type(it) is int for it in items):
-        # Batch integer replies (BF.MADD / BF.MEXISTS / CMS.QUERY
-        # pipelines) serialize in one native call (rtpu_resp_encode_ints).
+    if len(items) >= 8:
         if not _int_encoder_loaded:
             from redisson_tpu.serve import native_codec
 
             _int_encoder = native_codec.get_parser()
             _int_encoder_loaded = True
         if _int_encoder is not None:
-            return out + _int_encoder.encode_ints(items)
+            if all(type(it) is int for it in items):
+                # Batch integer replies (BF.MADD / BF.MEXISTS / CMS.QUERY
+                # pipelines) serialize in one native call
+                # (rtpu_resp_encode_ints).
+                return out + _int_encoder.encode_ints(items)
+            if all(it is None or type(it) is bytes for it in items):
+                # Batch bulk replies (MGET / HGETALL / LRANGE pipelines):
+                # one native call builds every `$len\r\n...\r\n` frame
+                # (rtpu_resp_encode_bulks; None on a stale .so).
+                enc = _int_encoder.encode_bulks(items)
+                if enc is not None:
+                    return out + enc
     for it in items:
         if isinstance(it, int):
             out += _encode_int(it)
@@ -388,6 +459,22 @@ class RespServer:
         self._script_kill = None  # run record a SCRIPT KILL is targeting
         self.max_connections = max_connections
         self.idle_timeout_s = idle_timeout_s
+        # Front-door vectorization (ISSUE 6): fuse runs of adjacent
+        # pipelined commands into single engine launches; the response
+        # cache serves repeated identical reads inside one pipeline
+        # window.  Both live-togglable via attributes (bench A/B).
+        self.vectorize = bool(
+            getattr(client.config, "resp_vectorize", True)
+        )
+        self.response_cache_size = int(
+            getattr(client.config, "resp_response_cache_size", 64)
+        )
+        # Write epoch: bumped by every mutating RESP command on ANY
+        # connection; response-cache entries serve only while it is
+        # unmoved since install.  Guarded — a lost increment would let a
+        # stale cached reply outlive the write that obsoleted it.
+        self._write_epoch = 0
+        self._epoch_lock = threading.Lock()
         # Observability (ISSUE 1): per-command stats + SLOWLOG record
         # into the CLIENT's bundle (shared with the engine's registry,
         # so one Prometheus endpoint exposes both); a bare client
@@ -487,41 +574,38 @@ class RespServer:
                     # Redis silently skips these with NO reply — emitting
                     # one would desync a pipelining client's reply count.
                     continue
-                reply = self._safe_dispatch(cmd, ctx)
                 # Pipelined batch: commands the reader already parsed
                 # ahead reply in ONE sendall (the CommandBatchEncoder
                 # role) — syscall count stops scaling with pipeline
-                # depth.  Bounded so a huge pipeline cannot buffer
-                # unbounded reply bytes.
+                # depth; the vectorizer additionally fuses runs of
+                # adjacent same-family commands into single engine
+                # launches (ISSUE 6).  Bounded so a huge pipeline cannot
+                # buffer unbounded reply bytes.
                 pending = reader._pending
                 if pending:
-                    out = [reply]
-                    size = len(reply)
-                    while pending and len(out) < 1024 and size < (1 << 20):
-                        # Flush buffered replies BEFORE any command that
-                        # blocks (BLPOP would hold earlier replies
-                        # hostage) or whose handler writes to the socket
-                        # ITSELF (SUBSCRIBE's ack would overtake them —
-                        # reply order must be command order).
+                    batch = [cmd]
+                    while pending and len(batch) < 1024:
+                        # Collect up to the first command that blocks
+                        # (BLPOP would hold earlier replies hostage) or
+                        # whose handler writes to the socket ITSELF
+                        # (SUBSCRIBE's ack would overtake buffered
+                        # replies — reply order must be command order).
                         if not pending[0]:
                             # Empty frame in a pipeline: skip, no reply.
                             pending.popleft()
                             continue
-                        if pending[0][0].upper() in (
-                            b"BLPOP",
-                            b"BRPOP",
-                            b"XREAD",       # BLOCK would hold earlier
-                            b"XREADGROUP",  # replies hostage
-                            b"SUBSCRIBE",
-                            b"UNSUBSCRIBE",
-                        ):
+                        if pending[0][0].upper() in _PIPELINE_STOP:
                             break
-                        r = self._safe_dispatch(pending.popleft(), ctx)
-                        out.append(r)
-                        size += len(r)
-                    ctx.send(b"".join(out))
+                        batch.append(pending.popleft())
+                    frames, consumed = self._dispatch_pipeline(batch, ctx)
+                    if consumed < len(batch):
+                        # Reply-buffer cap hit: the unprocessed tail goes
+                        # back to the FRONT of the parse-ahead queue, in
+                        # order, for the next loop pass.
+                        pending.extendleft(reversed(batch[consumed:]))
+                    ctx.send(b"".join(frames))
                 else:
-                    ctx.send(reply)
+                    ctx.send(self._safe_dispatch(cmd, ctx))
         finally:
             # Drop this connection's subscriptions with it.
             for channel, lid in list(ctx.subs.items()):
@@ -581,9 +665,6 @@ class RespServer:
         )
         try:
             reply = self._dispatch(cmd, ctx, name)
-        except RespError as e:
-            err = True
-            reply = _encode_error(str(e))
         except ScriptKilledError:
             # SCRIPT KILL's async exception can land AFTER the script
             # body left its guarded block (next bytecode boundary):
@@ -594,17 +675,16 @@ class RespServer:
             self._script_unregister()  # the clear itself may have died
             err = True
             reply = _encode_error("Script killed by user with SCRIPT KILL...")
-        except TypeError as e:
-            # Kind guards raise TypeError — clients key on the WRONGTYPE
-            # code (redis-py maps it to a dedicated exception class).
-            err = True
-            reply = _encode_error(
-                "WRONGTYPE Operation against a key holding the wrong kind "
-                f"of value ({e})"
-            )
         except Exception as e:
+            # RespError / TypeError-WRONGTYPE / generic all map through
+            # the ONE shared helper the fused-run demux also uses.
             err = True
-            reply = _encode_error(f"{type(e).__name__}: {e}")
+            reply = self._fused_error_frame(e)
+        if not queueing and name not in _NONMUTATING:
+            # Any executed command that may have changed keyspace state
+            # retires every response-cache entry (coarse, cheap, safe —
+            # the cache's whole window is one parsed-ahead batch).
+            self._bump_write_epoch()
         dt = time.perf_counter() - t0
         obs = self.obs
         if obs is not None and not queueing:
@@ -641,6 +721,403 @@ class RespServer:
         if name in ("XREAD", "XREADGROUP"):
             return any(a.upper() == b"BLOCK" for a in cmd[1:])
         return False
+
+    # -- front-door vectorization (ISSUE 6 tentpole) -----------------------
+
+    def _bump_write_epoch(self) -> None:
+        with self._epoch_lock:
+            self._write_epoch += 1
+
+    @staticmethod
+    def _fused_error_frame(e: BaseException) -> bytes:
+        """THE exception → reply-frame mapping, shared by
+        _safe_dispatch's except arms and the fused-run demux — one
+        implementation, so a fused run's per-command error bytes can
+        never drift from what sequential dispatch would have replied
+        (the byte-identical contract).  Kind guards raise TypeError —
+        clients key on the WRONGTYPE code (redis-py maps it to a
+        dedicated exception class)."""
+        if isinstance(e, RespError):
+            return _encode_error(str(e))
+        if isinstance(e, TypeError):
+            return _encode_error(
+                "WRONGTYPE Operation against a key holding the wrong kind "
+                f"of value ({e})"
+            )
+        return _encode_error(f"{type(e).__name__}: {e}")
+
+    def _dispatch_pipeline(self, batch, ctx: "_ConnCtx"):
+        """Vectorized dispatch of one parsed-ahead batch.  Scans for runs
+        of adjacent same-family commands and fuses each run into one
+        engine call, demuxing the packed result into per-command replies
+        in command order; everything else (and every command while the
+        connection is in MULTI / unauthenticated / script-BUSY state)
+        dispatches sequentially, so per-connection semantics are
+        bit-identical to the unfused path.  Returns (frames, consumed):
+        ``consumed`` < len(batch) when the bounded reply buffer filled —
+        the caller re-queues the tail."""
+        out: list = []
+        size = 0
+        i = 0
+        n = len(batch)
+        # Per-window response cache: (name, *argv) -> reply frame, valid
+        # while the write epoch is unmoved.
+        rc: dict = {}
+        rc_cap = self.response_cache_size
+        rc_state = [self._write_epoch]
+        while i < n:
+            if size >= (1 << 20):
+                break
+            cmd = batch[i]
+            name = cmd[0].decode("latin-1", "replace").upper()
+            plain = (
+                self.vectorize
+                and ctx.authed
+                and not ctx.in_multi
+                and not self._script_busy()
+            )
+            if plain and rc_cap > 0 and name in _CACHEABLE:
+                hit = self._rc_probe(rc, rc_state, name, cmd)
+                if hit is not None:
+                    out.append(hit)
+                    size += len(hit)
+                    i += 1
+                    continue
+            run = self._scan_run(batch, i) if plain else None
+            if run is not None:
+                frames, j = self._exec_run(run, batch, i, ctx, rc, rc_state)
+                out.extend(frames)
+                size += sum(len(f) for f in frames)
+                i = j
+                continue
+            frame = self._safe_dispatch(cmd, ctx)
+            if (
+                plain and rc_cap > 0 and name in _CACHEABLE
+                and not frame.startswith(b"-")
+            ):
+                self._rc_install(rc, rc_state, name, cmd, frame)
+            out.append(frame)
+            size += len(frame)
+            i += 1
+        return out, i
+
+    # response-cache plumbing: rc_state[0] holds the epoch the window's
+    # entries were installed under; any bump wipes the window.
+
+    def _rc_probe(self, rc, rc_state, name, cmd):
+        cur = self._write_epoch
+        if cur != rc_state[0]:
+            rc.clear()
+            rc_state[0] = cur
+            if self.obs is not None:
+                self.obs.resp_cache_misses.inc()
+            return None
+        hit = rc.get((name, *cmd[1:]))
+        obs = self.obs
+        if obs is not None:
+            if hit is not None:
+                obs.resp_cache_hits.inc()
+                # The command "executed" from the cache: calls still
+                # count (INFO commandstats parity).
+                obs.resp_commands.inc((name,))
+            else:
+                obs.resp_cache_misses.inc()
+        return hit
+
+    def _rc_install(self, rc, rc_state, name, cmd, frame) -> None:
+        if len(frame) > (8 << 10):  # bound per-entry bytes
+            return
+        cur = self._write_epoch
+        if cur != rc_state[0]:
+            # A write landed between this command's probe and now: the
+            # window dies — and THIS frame may predate that write, so it
+            # must be dropped, never re-homed under the new epoch (a
+            # pre-write reply cached under the post-write epoch would
+            # outlive the write that obsoleted it).
+            rc.clear()
+            rc_state[0] = cur
+            return
+        if len(rc) < self.response_cache_size:
+            rc[(name, *cmd[1:])] = frame
+
+    # -- run scanning ------------------------------------------------------
+
+    def _scan_run(self, batch, i):
+        """A fused-run descriptor starting at ``batch[i]``, or None.
+        Runs are maximal spans of adjacent commands of one family (same
+        target object for bf/bitset); any non-member — including a
+        malformed member whose sequential dispatch would error — ends
+        the run and dispatches sequentially (a run barrier)."""
+        first = batch[i][0].upper()
+        if first in _BF_RUN:
+            return self._collect_bf_run(batch, i)
+        if first in _BIT_RUN:
+            return self._collect_bit_run(batch, i)
+        if first in _GET_RUN:
+            return self._collect_get_run(batch, i)
+        return None
+
+    @staticmethod
+    def _collect_bf_run(batch, i):
+        cmd = batch[i]
+        if len(cmd) < 3:
+            return None
+        key = cmd[1]
+        items: list = []
+        flags: list = []
+        shape: list = []  # (upper name str, nops, many) per command
+        j = i
+        while j < len(batch) and len(items) < _RUN_MAX_OPS:
+            c = batch[j]
+            spec = _BF_RUN.get(c[0].upper())
+            if spec is None or len(c) < 3 or c[1] != key:
+                break
+            is_add, many = spec
+            ops = c[2:] if many else c[2:3]
+            items.extend(ops)
+            flags.extend([is_add] * len(ops))
+            shape.append(
+                (c[0].decode("latin-1", "replace").upper(), len(ops), many)
+            )
+            j += 1
+        if j - i < 2:
+            return None
+        return ("bloom", j, key, items, flags, shape)
+
+    @staticmethod
+    def _collect_bit_run(batch, i):
+        key = batch[i][1] if len(batch[i]) >= 2 else None
+        idx: list = []
+        kinds: list = []  # 0 = get, 1 = clear, 2 = set
+        names: list = []
+        j = i
+        while j < len(batch) and len(idx) < _RUN_MAX_OPS:
+            c = batch[j]
+            nm = c[0].upper()
+            if nm == b"GETBIT":
+                if len(c) < 3 or c[1] != key:
+                    break
+                try:
+                    off = int(c[2])
+                except ValueError:
+                    break
+                if off < 0:
+                    break
+                idx.append(off)
+                kinds.append(0)
+            elif nm == b"SETBIT":
+                if len(c) < 4 or c[1] != key:
+                    break
+                try:
+                    off, val = int(c[2]), int(c[3])
+                except ValueError:
+                    break
+                if off < 0:
+                    break
+                idx.append(off)
+                kinds.append(2 if val else 1)
+            else:
+                break
+            names.append(c[0].decode("latin-1", "replace").upper())
+            j += 1
+        if j - i < 2:
+            return None
+        return ("bitset", j, key, idx, kinds, names)
+
+    @staticmethod
+    def _collect_get_run(batch, i):
+        j = i
+        while j < len(batch):
+            c = batch[j]
+            if c[0].upper() not in _GET_RUN or len(c) < 2:
+                break
+            j += 1
+        if j - i < 2:
+            return None
+        return ("mget", j, None, None, None, None)
+
+    # -- run execution -----------------------------------------------------
+
+    def _exec_run(self, run, batch, i, ctx: "_ConnCtx", rc, rc_state):
+        fam, j = run[0], run[1]
+        t0 = time.perf_counter()
+        if fam == "mget":
+            # One grid pass: the whole read run executes under a single
+            # grid-lock hold (handlers re-enter the RLock for free), and
+            # repeated identical reads inside the run serve from the
+            # response cache.  The run stops early once it has buffered
+            # the reply-byte bound — the caller re-queues the tail (same
+            # 1 MB discipline the per-command loop enforces).
+            frames = []
+            size = 0
+            grid = self._client._grid
+            with grid.lock:
+                for k in range(i, j):
+                    if size >= (1 << 20):
+                        j = k
+                        break
+                    cmd = batch[k]
+                    name = cmd[0].decode("latin-1", "replace").upper()
+                    # The run's FIRST command was already probed (and
+                    # missed) by the caller — re-probing would double-
+                    # count resp_cache_misses.
+                    hit = (
+                        self._rc_probe(rc, rc_state, name, cmd)
+                        if k > i and self.response_cache_size > 0
+                        else None
+                    )
+                    if hit is not None:
+                        frames.append(hit)
+                        size += len(hit)
+                        continue
+                    frame = self._safe_dispatch(cmd, ctx)
+                    if (
+                        self.response_cache_size > 0
+                        and not frame.startswith(b"-")
+                    ):
+                        self._rc_install(rc, rc_state, name, cmd, frame)
+                    frames.append(frame)
+                    size += len(frame)
+            # names=None: each command's stats were recorded by its own
+            # _safe_dispatch above (the run is lock-amortization + the
+            # response cache, not an engine-call fusion — it still counts
+            # toward the "mget" family per the ISSUE's GET/MGET-run
+            # definition, so the fusion ratio is interpretable against
+            # the per-family breakdown in rtpu_resp_fused_cmds).
+            self._count_fused(fam, j - i, j - i, None, 0.0)
+            return frames, j
+        if fam == "bloom":
+            _, _, key, items, flags, shape = run
+            err = None
+            vals = None
+            any_add = any(flags)
+            try:
+                bf = self._client.get_bloom_filter(self._s(key))
+                if not any_add:
+                    fut = bf.contains_all_async(items)
+                elif all(flags):
+                    fut = bf.add_all_async(items)
+                else:
+                    fut = bf.mixed_async(items, np.asarray(flags, bool))
+                vals = fut.result()
+            except Exception as e:
+                err = e
+            if any_add:
+                self._bump_write_epoch()
+            frames = []
+            pos = 0
+            names = []
+            for nm, nops, many in shape:
+                names.append(nm)
+                if err is not None:
+                    frames.append(self._fused_error_frame(err))
+                elif many:
+                    frames.append(
+                        _encode_array(
+                            [int(v) for v in vals[pos : pos + nops]]
+                        )
+                    )
+                else:
+                    frames.append(_encode_int(int(vals[pos])))
+                pos += nops
+            self._install_read_frames(
+                rc, rc_state, batch, i, [s[0] for s in shape], frames,
+                readable=("BF.EXISTS", "BF.MEXISTS"), err=err,
+                wrote=any_add,
+            )
+            self._count_fused(
+                fam, j - i, len(items), names,
+                time.perf_counter() - t0, err=err,
+            )
+            return frames, j
+        # fam == "bitset"
+        _, _, key, idx, kinds, names = run
+        err = None
+        any_write = any(k != 0 for k in kinds)
+        groups: list = []  # (start, end, future-or-exception)
+        try:
+            bs = self._client.get_bit_set(self._s(key))
+            p = 0
+            while p < len(kinds):
+                q = p + 1
+                while q < len(kinds) and kinds[q] == kinds[p]:
+                    q += 1
+                sel = idx[p:q]
+                if kinds[p] == 0:
+                    groups.append((p, q, bs.get_many_async(sel)))
+                else:
+                    groups.append(
+                        (p, q, bs.set_many_async(sel, kinds[p] == 2))
+                    )
+                p = q
+        except Exception as e:
+            # Submit-time failure: nothing later can have applied —
+            # every not-yet-grouped op fails with the same error.
+            err = e
+            done = groups[-1][1] if groups else 0
+            groups.append((done, len(kinds), e))
+        if any_write:
+            self._bump_write_epoch()
+        frames: list = [None] * len(kinds)
+        # Resolve PER GROUP: consecutive groups joined one coalescer
+        # segment (one launch), but a terminal failure can still be
+        # group-scoped (a migration-split launch, a breaker opening
+        # mid-run) — an earlier group's applied writes must answer their
+        # real results, only the failed group's commands get the error
+        # (the sequential path's granularity).
+        for p, q, fut in groups:
+            if isinstance(fut, BaseException):
+                e = fut
+            else:
+                try:
+                    vals = np.asarray(fut.result()).reshape(-1)
+                    for o in range(p, q):
+                        frames[o] = _encode_int(int(bool(vals[o - p])))
+                    continue
+                except Exception as ex:
+                    e = ex
+            err = err or e
+            ef = self._fused_error_frame(e)
+            for o in range(p, q):
+                frames[o] = ef
+        self._install_read_frames(
+            rc, rc_state, batch, i, names, frames,
+            readable=("GETBIT",), err=err, wrote=any_write,
+        )
+        self._count_fused(
+            fam, j - i, len(idx), names, time.perf_counter() - t0, err=err,
+        )
+        return frames, j
+
+    def _install_read_frames(self, rc, rc_state, batch, i, names, frames,
+                             readable, err, wrote) -> None:
+        """Feed a fused run's READ replies into the response-cache window
+        (a later identical read in this pipeline serves for free).
+        ``wrote``: the run contained writes — its read frames may have
+        been computed BEFORE a same-key write later in the run, so none
+        may be cached (the run's own epoch bump also refuses them in
+        _rc_install; this skip is the cheap explicit form)."""
+        if err is not None or wrote or self.response_cache_size <= 0:
+            return
+        for off, nm in enumerate(names):
+            if nm in readable:
+                self._rc_install(
+                    rc, rc_state, nm, batch[i + off], frames[off]
+                )
+
+    def _count_fused(self, fam, ncmds, nops, names, dt, err=None) -> None:
+        obs = self.obs
+        if obs is None:
+            return
+        obs.resp_fused_runs.inc((fam,))
+        obs.resp_fused_cmds.inc((fam,), ncmds)
+        obs.resp_fused_ops.inc((fam,), nops)
+        if names:
+            # Per-command stats parity (INFO commandstats): each fused
+            # command counts a call, with the run's wall time amortized.
+            per = dt / max(1, ncmds)
+            for nm in names:
+                obs.record_resp_command(nm, per, err is not None)
 
     @staticmethod
     def _slowlog_sanitize(name: str, cmd: list) -> list:
@@ -1776,7 +2253,8 @@ class RespServer:
     # (they can be wide); 'INFO all'/'everything' or the explicit section
     # name includes them.
     _INFO_DEFAULT = (
-        "server", "clients", "memory", "stats", "nearcache", "keyspace",
+        "server", "clients", "memory", "stats", "nearcache", "frontdoor",
+        "keyspace",
     )
 
     def _cmd_INFO(self, args):
@@ -1878,6 +2356,31 @@ class RespServer:
                         f"nearcache_tenant_quota_bytes:"
                         f"{st['tenant_quota_bytes']}",
                     ]
+            elif s == "frontdoor" and obs is not None:
+                # Front-door vectorization (ISSUE 6): fusion + response-
+                # cache effectiveness of the pipelined command stream.
+                def _tot(fam):
+                    return sum(int(c.value) for _, c in fam.items())
+
+                fused = _tot(obs.resp_fused_cmds)
+                total = sum(
+                    int(c.value) for _, c in obs.resp_commands.items()
+                )
+                rch = _tot(obs.resp_cache_hits)
+                rcm = _tot(obs.resp_cache_misses)
+                lines += [
+                    "# Frontdoor",
+                    f"frontdoor_vectorize:{1 if self.vectorize else 0}",
+                    f"frontdoor_fused_cmds:{fused}",
+                    f"frontdoor_fused_ops:{_tot(obs.resp_fused_ops)}",
+                    f"frontdoor_fused_runs:{_tot(obs.resp_fused_runs)}",
+                    f"frontdoor_fusion_ratio:"
+                    f"{round(fused / total, 4) if total else 0.0}",
+                    f"frontdoor_response_cache_hits:{rch}",
+                    f"frontdoor_response_cache_misses:{rcm}",
+                    f"frontdoor_response_cache_hit_rate:"
+                    f"{round(rch / (rch + rcm), 4) if rch + rcm else 0.0}",
+                ]
             elif s == "keyspace":
                 n = self._client.get_keys().count()
                 lines += ["# Keyspace", f"db0:keys={n},expires=0,avg_ttl=0"]

@@ -1,0 +1,44 @@
+"""Client-side RESP wire helpers shared by the bench and the test
+harnesses (one copy of the reply-frame walker — a framing fix applied to
+a private duplicate would leave the other silently wrong).
+
+These are deliberately simple and allocation-light: the bench's reply
+counter calls ``skip_reply_frame`` per frame on the hot loop.
+"""
+
+from __future__ import annotations
+
+
+def wire_command(args) -> bytes:
+    """Encode one command as a RESP multibulk request frame."""
+    out = b"*%d\r\n" % len(args)
+    for a in args:
+        if isinstance(a, str):
+            a = a.encode()
+        out += b"$%d\r\n%s\r\n" % (len(a), a)
+    return out
+
+
+def skip_reply_frame(buf: bytes, i: int) -> int:
+    """End offset of the RESP reply frame starting at ``i``.
+
+    Raises IndexError when the frame is incomplete (read more bytes and
+    retry) and ValueError on an unparseable frame type — callers must
+    treat the latter as a corrupt stream, never silently resync."""
+    j = buf.index(b"\r\n", i)
+    t, body = buf[i : i + 1], buf[i + 1 : j]
+    i = j + 2
+    if t in (b"+", b"-", b":"):
+        return i
+    if t == b"$":
+        n = int(body)
+        if n < 0:
+            return i
+        if len(buf) < i + n + 2:
+            raise IndexError("incomplete bulk")
+        return i + n + 2
+    if t in (b"*", b">"):
+        for _ in range(max(0, int(body))):
+            i = skip_reply_frame(buf, i)
+        return i
+    raise ValueError(f"bad reply frame type {t!r}")

@@ -143,3 +143,67 @@ def test_shutdown_rejects_new_ops():
     cl.shutdown()
     with pytest.raises(RuntimeError):
         bf.add_async("y")
+
+
+def test_phase_aware_merge_cap_unit():
+    """ISSUE 6 satellite: merge-at-pop may exceed the static max_batch up
+    to max_batch_slow_phase ONLY while the put-RT EWMA says the link is
+    in its per-transfer-RT phase; the fast phase keeps the static cap."""
+    import time
+
+    from redisson_tpu.executor.coalescer import BatchCoalescer
+
+    class _Lazy:
+        def __init__(self, n):
+            self._n = n
+
+        def result(self, timeout=None):
+            return np.zeros(self._n)
+
+    for slow, want_max in ((True, 32), (False, 8)):
+        gate = threading.Event()
+        launches = []
+
+        def block_dispatch(cols):
+            gate.wait(timeout=10)
+            return _Lazy(len(cols[0]))
+
+        def rec_dispatch(cols):
+            launches.append(len(cols[0]))
+            return _Lazy(len(cols[0]))
+
+        c = BatchCoalescer(
+            batch_window_us=100, max_batch=8, max_inflight=4,
+            adaptive_window=False, adaptive_inflight=False,
+            max_batch_slow_phase=32,
+        )
+        assert c.merge_cap() == 8
+        # Stall the flush thread inside a first launch so a backlog of
+        # same-key segments builds behind it deterministically.
+        stall = c.submit("a", block_dispatch, (np.zeros(1),), 1)
+        for _ in range(200):
+            with c._lock:
+                if c._inflight or not c._order:
+                    break
+            time.sleep(0.005)
+        futs = [
+            c.submit("b", rec_dispatch, (np.zeros(1),), 1)
+            for _ in range(32)
+        ]
+        if slow:
+            c._put_rt_ewma = 1.0  # simulated per-transfer-RT phase
+            assert c.merge_cap() == 32
+        gate.set()
+        stall.result(10)
+        for f in futs:
+            np.asarray(
+                f.result(10) if hasattr(f, "result") else f
+            )
+        assert max(launches) <= want_max
+        if slow:
+            # The whole backlog collapsed into one over-max_batch launch.
+            assert max(launches) > 8
+            assert len(launches) < 4
+        else:
+            assert len(launches) == 4
+        c.shutdown()

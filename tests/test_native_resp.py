@@ -202,3 +202,28 @@ def test_reader_fallback_on_malformed(parser):
             reader.read_command()
         a.close()
         b.close()
+
+
+def test_encode_bulks_native(parser):
+    from redisson_tpu.serve.resp import _encode_array, _encode_bulk
+
+    vals = [b"abc", None, b"", b"x" * 4096, None, b"\r\n$5\r\n", b"1",
+            b"tail"]
+    want = b"".join(
+        b"$-1\r\n" if v is None else b"$%d\r\n%s\r\n" % (len(v), v)
+        for v in vals
+    )
+    assert parser.encode_bulks(vals) == want
+    # The array encoder rides it for >=8 all-bytes/None items...
+    assert _encode_array(vals) == b"*8\r\n" + want
+    # ...and still matches the per-item Python path exactly.
+    py = b"*8\r\n" + b"".join(_encode_bulk(v) for v in vals)
+    assert _encode_array(vals) == py
+
+
+def test_require_native_guard():
+    # The CI job that exercises the native parser sets
+    # RTPU_REQUIRE_NATIVE_RESP=1: the suite must FAIL (not silently
+    # fall back to the Python parser) when the codec did not build.
+    if os.environ.get("RTPU_REQUIRE_NATIVE_RESP"):
+        assert get_parser() is not None

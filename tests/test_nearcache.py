@@ -757,3 +757,104 @@ class TestDifferentialSoak:
             chaos.clear()
             eng.shutdown()
             gold.shutdown()
+
+
+class TestLocalCachedMapCrossHandleSharing:
+    """ISSUE 6 satellite (ROADMAP near-cache-reach): map gets route
+    through ONE per-client store, so two handles to one map share hits."""
+
+    def test_two_handles_share_hits(self):
+        import redisson_tpu
+
+        c = redisson_tpu.create(Config())
+        try:
+            a = c.get_local_cached_map("lcm-share")
+            b = c.get_local_cached_map("lcm-share")
+            assert a._cache is b._cache  # one store per client
+            a.put("k", "v")
+            # A's invalidation message asynchronously discards through
+            # B's listener (the converging-writes rule): drain the bus,
+            # then settle one read-through install via A.
+            c._topic_bus.drain(timeout=10)
+            assert a.get("k") == "v"
+            h0 = b.cache_stats()["hits"]
+            assert b.get("k") == "v"
+            assert b.cache_stats()["hits"] >= h0 + 1
+        finally:
+            c.shutdown()
+
+    def test_write_through_one_handle_invalidates_shared_entry(self):
+        import time
+
+        import redisson_tpu
+
+        c = redisson_tpu.create(Config())
+        try:
+            a = c.get_local_cached_map("lcm-coh")
+            b = c.get_local_cached_map("lcm-coh")
+            a.put("k", "v1")
+            assert b.get("k") == "v1"
+            b.put("k", "v2")  # writer maintains the shared store itself
+            assert a.get("k") == "v2"
+            a.remove("k")
+            assert b.get("k") is None
+        finally:
+            c.shutdown()
+
+    def test_generation_guard_blocks_stale_install(self):
+        import redisson_tpu
+
+        c = redisson_tpu.create(Config())
+        try:
+            m = c.get_local_cached_map("lcm-gen")
+            m.put("k", "v1")
+            m.clear_local_cache()
+            gen = m._hub.gen("lcm-gen")  # reader samples here...
+            m.put("k", "v2")             # ...a write lands in between
+            ok = m._hub.install_if(
+                "lcm-gen", m._enc_key("k"), "v1", 64, gen
+            )
+            assert not ok               # the stale install is refused
+            assert m.get("k") == "v2"
+        finally:
+            c.shutdown()
+
+    def test_disabled_handle_neither_serves_nor_erases_peer_bound(self):
+        # Review regression: a cache_size=0 handle must stay fully
+        # opted out (read-through, no shared-store hits) and must NOT
+        # pass its 0 into the shared tenant limits — the store reads
+        # max_entries=0 as UNBOUNDED, erasing the enabled peer's bound.
+        import redisson_tpu
+
+        c = redisson_tpu.create(Config())
+        try:
+            a = c.get_local_cached_map("lcm-dis", cache_size=4)
+            b = c.get_local_cached_map("lcm-dis", cache_size=0)
+            for i in range(12):
+                a.put(f"k{i}", i)
+            c._topic_bus.drain(timeout=10)
+            for i in range(12):
+                a.get(f"k{i}")
+            assert a.cached_size() <= 4  # peer bound survives b
+            h0 = b.cache_stats()["hits"]
+            assert b.get("k11") == 11    # reads through...
+            assert b.cache_stats()["hits"] == h0  # ...never a shared hit
+        finally:
+            c.shutdown()
+
+    def test_distinct_maps_keep_distinct_quotas(self):
+        import redisson_tpu
+
+        c = redisson_tpu.create(Config())
+        try:
+            small = c.get_local_cached_map("lcm-q-small",
+                                           cache_max_bytes=2048)
+            big = c.get_local_cached_map("lcm-q-big")
+            for i in range(64):
+                small.put(f"k{i}", "v" * 100)
+                big.put(f"k{i}", "v" * 100)
+            st = small.cache_stats()
+            assert st["tenant_bytes"] <= 2048
+            assert big.cache_stats()["tenant_bytes"] > 2048
+        finally:
+            c.shutdown()
