@@ -24,13 +24,16 @@ mid-flight and resolve when the matching delta lands; the key is pruned
 only at an exact zero balance, which later deltas recreate correctly via
 ``.get(tenant, 0)``).
 
-Eviction order within a tenant is per-shard LRU walked round-robin across
-shards (approximate global LRU — exact cross-shard ordering would need a
-shared clock and a shared lock, the two things sharding exists to avoid).
+Eviction order within a tenant is LRU-of-LRUs: every entry carries a
+store-wide recency stamp, and each eviction takes the globally
+least-recent among the shards' per-shard LRU heads (an O(nshards) peek
+per eviction, no shared lock on the hit path).  The entry a put() just
+installed is never evicted to make room for itself.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 from collections import OrderedDict
 
@@ -43,8 +46,10 @@ class _Shard:
 
     def __init__(self):
         self.lock = threading.Lock()
-        # (tenant, key) -> (value, nbytes); OrderedDict insertion order IS
-        # the recency order (move_to_end on hit).
+        # (tenant, key) -> (value, nbytes, stamp); OrderedDict insertion
+        # order IS the recency order (move_to_end on hit); ``stamp`` is
+        # the store-wide recency clock value of the entry's last touch —
+        # the cross-shard comparison key for LRU-of-LRUs eviction.
         self.entries: OrderedDict = OrderedDict()
         # tenant -> OrderedDict(key -> nbytes), same recency order — the
         # O(1) source of "this tenant's LRU entry in this shard".
@@ -76,15 +81,18 @@ class ShardedLRUStore:
         self._tenant_entries: dict = {}
         self._tenant_limits: dict = {}  # tenant -> (max_bytes, max_entries)
         self._on_evict = on_evict
-        # Eviction rotation cursor: successive evictions start at
-        # successive shards, so pressure spreads and per-shard LRU order
-        # approximates global LRU.  A FIXED start point (the old
-        # hash(tenant) anchor) drained one shard to empty before touching
-        # the next — surviving entries piled into a single shard and
-        # just-installed keys in the drained shards died regardless of
-        # recency.  Unlocked increment: a lost update only repeats a
-        # start shard once, which rotation tolerates.
-        self._cursor = 0
+        # Store-wide recency clock for cross-shard victim selection
+        # (LRU-of-LRUs, see _victim_shard): every insert and every hit
+        # promotion stamps the entry from this counter, so "least recent
+        # among the shards' LRU heads" is a global-LRU approximation
+        # instead of a per-shard guess.  The earlier rotation cursor
+        # spread pressure but was recency-BLIND across shards: a
+        # globally-recent key sitting alone in its shard was that
+        # shard's LRU and died whenever the cursor landed there
+        # (hash-seed-dependent eviction of hot keys).  itertools.count
+        # is effectively atomic under the GIL; a torn interleaving only
+        # perturbs tie-breaks.
+        self._stamp = itertools.count()
         # Monotonic stats (read without locks: torn reads of ints are
         # fine for monitoring).
         self.hits = 0
@@ -155,6 +163,7 @@ class ShardedLRUStore:
                 return MISS
             s.entries.move_to_end(k)
             s.tenants[tenant].move_to_end(key)
+            s.entries[k] = (ent[0], ent[1], next(self._stamp))
             self.hits += 1
             return ent[0]
 
@@ -172,7 +181,7 @@ class ShardedLRUStore:
         k = (tenant, key)
         with s.lock:
             old = s.entries.pop(k, None)
-            s.entries[k] = (value, nbytes)
+            s.entries[k] = (value, nbytes, next(self._stamp))
             t = s.tenants.get(tenant)
             if t is None:
                 t = s.tenants[tenant] = OrderedDict()
@@ -182,18 +191,31 @@ class ShardedLRUStore:
         self._acct(
             tenant, nbytes - (old[1] if old else 0), 0 if old else 1
         )
-        self._enforce(tenant, max_b, max_e)
+        self._enforce(tenant, max_b, max_e, protect=k)
         return True
 
-    def _evict_one(self, shard: _Shard, tenant=None) -> bool:
+    def _evict_one(self, shard: _Shard, tenant=None, protect=None) -> bool:
         """Drop the LRU entry of ``shard`` (of ``tenant`` only, when
         given — O(1) via the per-tenant recency index).  Returns True if
-        something was evicted."""
+        something was evicted.
+
+        ``protect``: the (tenant, key) a put() just installed — never
+        evict it to make room for itself.  The protected entry sits at
+        the MRU end, so it can be the LRU head only as the shard's sole
+        eligible entry; the next-LRU (if any) is taken instead, still
+        O(1).  Without this, an eviction landing on the new entry's
+        shard could evict it on the spot: put() returned True, the
+        entry was gone, and a just-written key missed its first read
+        (surfaced as hash-seed-dependent flakes in the quota tests)."""
         with shard.lock:
             if tenant is None:
-                if not shard.entries:
+                it = iter(shard.entries)
+                victim = next(it, None)
+                if victim == protect:
+                    victim = next(it, None)  # head is the new entry
+                if victim is None:
                     return False
-                victim, ent = shard.entries.popitem(last=False)
+                ent = shard.entries.pop(victim)
                 t = shard.tenants.get(victim[0])
                 if t is not None:
                     t.pop(victim[1], None)
@@ -203,7 +225,13 @@ class ShardedLRUStore:
                 t = shard.tenants.get(tenant)
                 if not t:
                     return False
-                key, _nb = t.popitem(last=False)
+                it = iter(t)
+                key = next(it, None)
+                if protect is not None and protect == (tenant, key):
+                    key = next(it, None)
+                if key is None:
+                    return False
+                t.pop(key)
                 if not t:
                     del shard.tenants[tenant]
                 victim = (tenant, key)
@@ -215,35 +243,64 @@ class ShardedLRUStore:
             self._on_evict(victim[0], ent[1])
         return True
 
-    def _enforce(self, tenant, max_b: int, max_e: int) -> None:
+    def _victim_shard(self, tenant=None, protect=None) -> int:
+        """LRU-of-LRUs victim selection: the shard whose eligible LRU
+        head (of ``tenant`` when given, skipping ``protect``) carries
+        the globally smallest recency stamp — so cross-shard eviction
+        order tracks GLOBAL recency, not the accident of which shard a
+        key hashed to.  Racy by design (stamps are re-read unlocked by
+        _evict_one's pop): a concurrent touch only upgrades a victim to
+        survivor, never the reverse.  Returns -1 when nothing is
+        evictable."""
+        best, best_stamp = -1, None
+        for idx, shard in enumerate(self._shards):
+            with shard.lock:
+                if tenant is None:
+                    it = iter(shard.entries)
+                    k = next(it, None)
+                    if k == protect:
+                        k = next(it, None)
+                    if k is None:
+                        continue
+                    st = shard.entries[k][2]
+                else:
+                    t = shard.tenants.get(tenant)
+                    if not t:
+                        continue
+                    it = iter(t)
+                    key = next(it, None)
+                    if protect is not None and protect == (tenant, key):
+                        key = next(it, None)
+                    if key is None:
+                        continue
+                    st = shard.entries[(tenant, key)][2]
+            if best_stamp is None or st < best_stamp:
+                best, best_stamp = idx, st
+        return best
+
+    def _enforce(self, tenant, max_b: int, max_e: int,
+                 protect=None) -> None:
         # Tenant quota first (fairness: the hot tenant recycles itself),
-        # then the global budget.  Each eviction starts at the NEXT shard
-        # in rotation (see _cursor) so pressure spreads instead of
-        # draining one shard to empty; each pass bounded to stay
-        # O(evictions).
+        # then the global budget.  Victims come from _victim_shard
+        # (LRU-of-LRUs), so pressure lands on the least-recent entry
+        # store-wide; each pass bounded to stay O(evictions).
         for _ in range(1 << 16):  # backstop, never hit in practice
             over_b = self._tenant_bytes.get(tenant, 0) > max_b
             over_e = max_e and self._tenant_entries.get(tenant, 0) > max_e
             if not (over_b or over_e):
                 break
-            start = self._cursor
-            self._cursor = (start + 1) % self._nshards
-            for i in range(self._nshards):
-                if self._evict_one(
-                    self._shards[(start + i) % self._nshards], tenant
-                ):
-                    break
-            else:
+            idx = self._victim_shard(tenant, protect)
+            if idx < 0 or not self._evict_one(
+                self._shards[idx], tenant, protect=protect
+            ):
                 break  # accounting drift guard: nothing left to evict
         for _ in range(1 << 16):
             if self.bytes() <= self.max_bytes:
                 break
-            start = self._cursor
-            self._cursor = (start + 1) % self._nshards
-            for i in range(self._nshards):
-                if self._evict_one(self._shards[(start + i) % self._nshards]):
-                    break
-            else:
+            idx = self._victim_shard(None, protect)
+            if idx < 0 or not self._evict_one(
+                self._shards[idx], protect=protect
+            ):
                 break
 
     def discard(self, tenant, key) -> None:
@@ -290,7 +347,7 @@ class ShardedLRUStore:
             freed: dict = {}
             counts: dict = {}
             with s.lock:
-                for (t, _k), (_v, nb) in s.entries.items():
+                for (t, _k), (_v, nb, _st) in s.entries.items():
                     freed[t] = freed.get(t, 0) + nb
                     counts[t] = counts.get(t, 0) + 1
                 s.entries.clear()

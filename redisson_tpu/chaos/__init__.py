@@ -52,7 +52,7 @@ _rules: dict[str, FaultRule] = {}
 _counts: dict[tuple, int] = {}  # (point, kind) -> faults actually injected
 _observer = None  # callable(point, kind) — obs counter wiring (engine sets)
 
-KINDS = ("error", "latency", "corrupt")
+KINDS = ("error", "latency", "corrupt", "pressure")
 
 
 class FaultInjected(RuntimeError):
@@ -162,13 +162,13 @@ def _match(point: str) -> Optional[FaultRule]:
     return rule
 
 
-def fire(point: str, data=None) -> None:
-    """Evaluate the schedule at a named fault point.  Only reachable when
-    ``ENABLED`` is True (call sites guard); no-op when no rule matches or
-    the rule's deterministic roll says pass."""
+def _roll_and_record(point: str) -> Optional[FaultRule]:
+    """Shared match/roll/count/observer bookkeeping for fire() and
+    bias(): the matched rule when its deterministic roll says inject
+    (already counted and reported to the observer), else None."""
     rule = _match(point)
     if rule is None or not rule.roll():
-        return
+        return None
     key = (point, rule.kind)
     with _lock:
         _counts[key] = _counts.get(key, 0) + 1
@@ -178,6 +178,21 @@ def fire(point: str, data=None) -> None:
             obs(point, rule.kind)
         except Exception:
             pass
+    return rule
+
+
+def fire(point: str, data=None) -> None:
+    """Evaluate the schedule at a named fault point.  Only reachable when
+    ``ENABLED`` is True (call sites guard); no-op when no rule matches or
+    the rule's deterministic roll says pass."""
+    rule = _roll_and_record(point)
+    if rule is None:
+        return
+    if rule.kind == "pressure":
+        # Pressure rules only act through bias() (wait-estimate
+        # inflation); at an ordinary fault point they are inert — the
+        # roll above still advanced, keeping the sequence deterministic.
+        return
     if rule.kind == "latency":
         import time
 
@@ -205,6 +220,20 @@ def fire(point: str, data=None) -> None:
     raise FaultInjected(point)
 
 
+def bias(point: str) -> float:
+    """Deterministic estimate inflation for the overload control plane
+    (ISSUE 7): evaluate the schedule at ``point`` and return the rule's
+    ``latency_s`` as extra SECONDS to add to a wait estimate — no sleep,
+    no exception, so the injection perturbs only the admission decision,
+    never the op itself.  0.0 when disabled, unmatched, or the roll says
+    pass.  Conventionally installed at ``overload.pressure`` with
+    kind='pressure' (any kind works: only the magnitude is read)."""
+    if not ENABLED:
+        return 0.0
+    rule = _roll_and_record(point)
+    return rule.latency_s if rule is not None else 0.0
+
+
 __all__ = [
     "ChaosSchedule",
     "CorruptionDetected",
@@ -213,6 +242,7 @@ __all__ = [
     "FaultRule",
     "KINDS",
     "active",
+    "bias",
     "clear",
     "counts",
     "fire",

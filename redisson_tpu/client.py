@@ -341,6 +341,23 @@ class RedissonTpuClient(CamelCompatMixin):
                 )
             return self._failure_monitor
 
+    def op_deadline(self, ms):
+        """Overload control plane (ISSUE 7): attach an end-to-end
+        deadline of ``ms`` milliseconds to every sketch op submitted
+        inside the returned context on this thread.  Past the deadline
+        ops are shed pre-dispatch (DeadlineExceededError) instead of
+        queueing; ``None``/0 pushes an explicit no-deadline frame
+        (shadows any outer scope).
+
+            with client.op_deadline(50):
+                bf.add_all_async(keys).result()
+        """
+        from redisson_tpu import overload
+
+        return overload.deadline_scope(
+            ms / 1000.0 if ms else None
+        )
+
     def change_topology(self, num_shards: int) -> bool:
         """Online reshard of the sketch engine (SURVEY §2.4 cluster row):
         remap every device row onto a new shard count on the LIVE engine —

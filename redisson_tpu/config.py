@@ -107,6 +107,39 @@ class TpuSketchConfig:
         # passes belong to the three-transfer link path, and per-op key
         # materialization would tax them for nothing.
         self.nearcache_max_batch = 1024
+        # Overload control plane (ISSUE 7) — the maxmemory/timeout/
+        # client-output-buffer-limit analog for the batched dispatch
+        # path.  ``op_deadline_ms``: default end-to-end deadline stamped
+        # on every RESP command (0 = none; per-connection override via
+        # CLIENT DEADLINE, direct-API via client.op_deadline(ms)).  Ops
+        # whose deadline expires are shed strictly PRE-dispatch (fast
+        # DeadlineExceededError / -BUSY reply) — acked writes are never
+        # shed.
+        self.op_deadline_ms = 0
+        # Bound on a no-deadline blocking .result() wait (replaces the
+        # old hardcoded 120 s in HintedFuture).  A fetch timeout records
+        # a breaker failure like any other completion failure.
+        self.fetch_timeout_ms = 120_000
+        # RESP ingress shedding: once coalescer queue pressure
+        # (queued_ops / max_queued_ops) crosses this watermark, every
+        # non-exempt command is refused with a -BUSY error instead of
+        # queueing.  The door is deliberately command-family-blind
+        # (host-side ops are shed too — they share the process's grid
+        # lock and threads, and classifying the backend of every
+        # command is a maintenance trap); the exempt list covers the
+        # handshake/admin/introspection surface an operator needs
+        # during the incident.  1.0 effectively disables ingress
+        # shedding (pressure rarely exceeds the bound); must be > 0.
+        self.admission_watermark = 0.9
+        # Per-tenant fairness: token-bucket rate limit (ops/sec, 0 =
+        # unlimited), bucket burst size (0 → 2x the rate), and a
+        # queued+in-flight op quota (0 = unlimited).  Over-quota tenants
+        # are shed FIRST (TenantThrottledError / -BUSY), so a
+        # well-behaved tenant keeps its throughput during another
+        # tenant's burst.
+        self.tenant_rate_limit = 0
+        self.tenant_burst_ops = 0
+        self.tenant_max_inflight = 0
         # Device-side result mailbox: the completer concatenates pending
         # launches' packed results on device and fetches them in ONE D2H
         # (PROFILE.md remaining-lever 2) — each host fetch costs a full
@@ -201,6 +234,19 @@ class Config:
         # entry count bound; 0 disables.  Entries are invalidated by any
         # write epoch bump (any non-read RESP command on any connection).
         self.resp_response_cache_size = 64
+        # Slow-client protection (ISSUE 7): the client-output-buffer-
+        # limit analog.  ``client_output_buffer_limit``: a reply frame
+        # still holding more than this many unsent bytes after its
+        # grace window (soft_seconds when set, else ~1 s) drops the
+        # connection (0 = unlimited, the redis-server default for
+        # normal clients) — time-gated so a fast reader of a large
+        # reply is untouched while a trickler cannot ride byte-at-a-
+        # time progress forever.  ``client_output_buffer_soft_seconds``:
+        # a send making NO progress for this long is dropped regardless
+        # of the byte bound (0 = fall back to the connection's idle
+        # timeout).  Both live-settable via CONFIG SET.
+        self.client_output_buffer_limit = 0
+        self.client_output_buffer_soft_seconds = 0.0
 
     # -- fluent setters, mirroring the Java builder idiom ------------------
 
@@ -247,6 +293,8 @@ class Config:
         "script_timeout_ms",
         "resp_vectorize",
         "resp_response_cache_size",
+        "client_output_buffer_limit",
+        "client_output_buffer_soft_seconds",
     )
 
     def to_dict(self) -> dict:

@@ -48,7 +48,9 @@ from typing import Callable, Optional
 import jax  # already a transitive import (tpu_executor): free here
 import numpy as np
 
+from redisson_tpu import chaos as _chaos
 from redisson_tpu.executor.failures import (
+    DeadlineExceededError,
     DispatchTimeoutError,
     KernelExecutionError,
     NonRetryableDispatchError,
@@ -102,25 +104,60 @@ class HintedFuture:
     """Future adapter: a blocking .result() nudges the coalescer to flush
     immediately instead of waiting out the batch window (the sync-bridge
     behavior of CommandAsyncService#get).  Optional ``transform`` maps the
-    raw result slice (mirrors LazyResult's transform kwarg)."""
+    raw result slice (mirrors LazyResult's transform kwarg).
 
-    def __init__(self, fut: Future, coalescer: "BatchCoalescer", transform=None):
+    Timeout resolution (ISSUE 7): an explicit ``timeout`` argument wins;
+    otherwise the wait is bounded by the op's residual DEADLINE (when one
+    rode the submit) capped at the coalescer's config-derived
+    ``fetch_timeout_s`` (the old hardcoded 120 s, now ``fetch_timeout_ms``).
+    A deadline-bounded miss raises :class:`DeadlineExceededError`
+    (overload — the device is not implicated); a fetch-timeout miss
+    raises :class:`DispatchTimeoutError` AND records a breaker failure +
+    ``rtpu_fetch_timeouts``, like any other completion failure."""
+
+    def __init__(self, fut: Future, coalescer: "BatchCoalescer",
+                 transform=None, deadline: Optional[float] = None,
+                 op: Optional[str] = None, nops: int = 1):
         self._fut = fut
         self._c = coalescer
         self._transform = transform
+        self._deadline = deadline
+        self._op = op
+        self._nops = nops
 
-    def result(self, timeout: Optional[float] = 120.0):
-        # Default generous enough to absorb a first-compile of a large
-        # bucket on a tunneled device (~30-60s); steady state resolves in
-        # milliseconds.  Callers wanting a strict deadline pass their own.
+    @property
+    def deadline(self) -> Optional[float]:
+        return self._deadline
+
+    def result(self, timeout: Optional[float] = None):
+        deadline_bound = False
+        if timeout is None:
+            # Default generous enough to absorb a first-compile of a
+            # large bucket on a tunneled device; steady state resolves
+            # in milliseconds.
+            timeout = getattr(self._c, "fetch_timeout_s", 120.0)
+            if self._deadline is not None:
+                rem = self._deadline - time.monotonic()
+                if rem < timeout:
+                    timeout = max(0.0, rem)
+                    deadline_bound = True
         if not self._fut.done():
             self._c.flush_hint()
         try:
             v = self._fut.result(timeout)
         except concurrent.futures.TimeoutError as e:
-            raise DispatchTimeoutError(
+            if deadline_bound:
+                self._c.note_deadline_wait(self._op, self._nops)
+                raise DeadlineExceededError(
+                    f"op deadline expired waiting for "
+                    f"{self._op or 'result'} (residual budget "
+                    f"{timeout * 1e3:.1f} ms)", stage="fetch_wait",
+                ) from e
+            err = DispatchTimeoutError(
                 f"result not ready within {timeout}s"
-            ) from e
+            )
+            self._c.note_fetch_timeout(self._op, err)
+            raise err from e
         return v if self._transform is None else self._transform(v)
 
     def get(self):
@@ -128,6 +165,9 @@ class HintedFuture:
 
     def done(self) -> bool:
         return self._fut.done()
+
+    def add_done_callback(self, fn) -> None:
+        self._fut.add_done_callback(fn)
 
 
 class BatchCoalescer:
@@ -140,7 +180,8 @@ class BatchCoalescer:
                  group_collect: Optional[Callable] = None, obs=None,
                  retry_max_backoff_s: float = 2.0,
                  retry_jitter: float = 0.2, health=None,
-                 max_batch_slow_phase: int = 0):
+                 max_batch_slow_phase: int = 0,
+                 fetch_timeout_s: float = 120.0):
         self.window_s = batch_window_us / 1e6
         self.max_batch = max_batch
         # Phase-aware merge cap (ISSUE 6 satellite, the ROADMAP
@@ -194,6 +235,17 @@ class BatchCoalescer:
         # Optional DispatchHealth (executor/health.py): per-(shard, op)
         # circuit breakers.  None → standalone coalescer, retry-only.
         self._health = health
+        # Overload control plane (ISSUE 7).  ``fetch_timeout_s`` bounds a
+        # no-deadline blocking .result() (the old hardcoded 120 s, now
+        # config fetch_timeout_ms).  The admission estimator keeps an
+        # EWMA of flush-to-retire latency and ops-per-launch; a submit
+        # carrying a deadline is shed FAST when the estimated queue wait
+        # exceeds its residual budget (blocking at the queue bound stays
+        # the no-deadline default).
+        self.fetch_timeout_s = max(0.001, float(fetch_timeout_s))
+        self._service_ewma_s = 0.0
+        self._ops_per_launch_ewma = 0.0
+        self.last_est_wait_s = 0.0  # rtpu_admission_est_wait_us gauge
         # Engine-side backpressure (the pooled-acquire role): submit()
         # blocks while this many ops sit queued ahead of the flush thread.
         self.max_queued_ops = max_queued_ops if max_queued_ops > 0 else 8 * max_batch
@@ -261,7 +313,8 @@ class BatchCoalescer:
     # -- producer side -----------------------------------------------------
 
     def submit(self, key, dispatch: Callable, arrays: tuple, nops: int,
-               pool_key=None, meta=None, tenant=None) -> Future:
+               pool_key=None, meta=None, tenant=None,
+               deadline: Optional[float] = None) -> Future:
         """Queue ``nops`` ops (column arrays in ``arrays``) for the segment
         identified by ``key``; returns a Future of the per-op result slice.
 
@@ -273,19 +326,46 @@ class BatchCoalescer:
         ``meta``: per-chunk run-length metadata; when present the segment's
         dispatch is called as ``dispatch(cols, metas)`` where ``metas`` is
         the list of (nops, meta) per chunk in order.  All submits of one
-        key must agree on using meta or not (keys embed the path)."""
+        key must agree on using meta or not (keys embed the path).
+
+        ``deadline``: absolute monotonic instant after which the ops are
+        worthless (ISSUE 7).  With one set, submit FAILS FAST with
+        DeadlineExceededError instead of blocking: already expired, the
+        admission estimate says the queue wait alone exceeds the residual
+        budget, or the backpressure wait outlives it.  Ops shed here (and
+        by the expired-segment sweep at flush) were never dispatched —
+        no acked write is ever shed."""
         if pool_key is None:
             pool_key = key
         fut: Future = Future()
         with self._lock:
             if self._closed:
                 raise RuntimeError("coalescer is shut down")
+            if deadline is not None:
+                now = time.monotonic()
+                if now >= deadline:
+                    self._count_shed("deadline", "submit", nops)
+                    raise DeadlineExceededError(
+                        f"op deadline already expired at submit "
+                        f"({_op_label(key)}, {nops} ops)", stage="submit",
+                    )
+                est = self.estimate_wait_s()
+                if est > deadline - now:
+                    self._count_shed("admission", "admission", nops)
+                    raise DeadlineExceededError(
+                        f"admission control: estimated queue wait "
+                        f"{est * 1e3:.1f} ms exceeds residual deadline "
+                        f"{(deadline - now) * 1e3:.1f} ms "
+                        f"({_op_label(key)}, {nops} ops)",
+                        stage="admission",
+                    )
             # Backpressure: block while the queue is at capacity (an
             # oversize single submit is admitted when the queue is empty,
             # so it can never deadlock).  FIFO: later submits wait behind
             # an already-blocked one, so sustained small traffic cannot
             # starve a bulk submit.  The flush thread only ever REMOVES
-            # queued ops, so this wait cannot starve globally.
+            # queued ops, so this wait cannot starve globally.  An op
+            # carrying a deadline waits only out its residual budget.
             def _full() -> bool:
                 return (
                     self._queued_ops > 0
@@ -299,8 +379,19 @@ class BatchCoalescer:
                     while not self._closed and (
                         self._admit_q[0] is not ticket or _full()
                     ):
+                        wait_s = 1.0
+                        if deadline is not None:
+                            wait_s = deadline - time.monotonic()
+                            if wait_s <= 0:
+                                self._count_shed("deadline", "queue", nops)
+                                raise DeadlineExceededError(
+                                    f"queue full past op deadline "
+                                    f"({_op_label(key)}, {nops} ops)",
+                                    stage="queue",
+                                )
+                            wait_s = min(wait_s, 1.0)
                         self._wake.notify()
-                        self._admit.wait(timeout=1.0)
+                        self._admit.wait(timeout=wait_s)
                 finally:
                     try:
                         self._admit_q.remove(ticket)
@@ -329,7 +420,7 @@ class BatchCoalescer:
             seg.chunks.append(arrays)
             if meta is not None:
                 seg.metas.append((nops, meta))
-            seg.futures.append((fut, seg.nops, nops, tenant))
+            seg.futures.append((fut, seg.nops, nops, tenant, deadline))
             seg.nops += nops
             self._queued_ops += nops
             self._ops_seen += nops  # feeds the adaptive-window EWMA
@@ -342,6 +433,87 @@ class BatchCoalescer:
         with self._lock:
             self._hurry = True
             self._wake.notify()
+
+    # -- overload control plane (ISSUE 7) ----------------------------------
+
+    def pressure(self) -> float:
+        """Queue pressure in ~[0, 1]: queued ops over the admission
+        bound (can exceed 1.0 transiently — an oversize single submit is
+        admitted at an empty queue).  The RESP front door sheds at
+        ingress once this crosses its watermark."""
+        return self._queued_ops / max(1, self.max_queued_ops)
+
+    def estimate_wait_s(self) -> float:
+        """Admission-control estimate of the queue wait a NEW op faces:
+        launches ahead of it (queued ops at the observed ops-per-launch,
+        plus dispatched-but-uncollected) times the flush-to-retire EWMA,
+        divided by the live pipelining window.  Zero until the first
+        launch retires (an idle engine admits everything).  The
+        ``overload.pressure`` chaos point inflates the estimate
+        deterministically (chaos.bias) so shedding is drivable in
+        tests without real load."""
+        svc = self._service_ewma_s
+        if svc <= 0.0:
+            est = 0.0
+        else:
+            opl = max(1.0, self._ops_per_launch_ewma)
+            launches_ahead = self._queued_ops / opl + self._uncollected
+            est = svc * launches_ahead / max(1, self._inflight_limit)
+        if _chaos.ENABLED:
+            est += _chaos.bias("overload.pressure")
+        self.last_est_wait_s = est
+        return est
+
+    def _count_shed(self, reason: str, stage: str, nops: int) -> None:
+        if self.obs is not None:
+            self.obs.shed_ops.inc((reason,), nops)
+            self.obs.deadline_exceeded.inc((stage,), nops)
+
+    def note_fetch_timeout(self, op: Optional[str], exc) -> None:
+        """A blocking result wait hit the config fetch timeout: treat it
+        like any other completion failure — it feeds the breaker (a
+        device whose results never arrive must eventually open the
+        circuit) and the rtpu_fetch_timeouts counter."""
+        if self._health is not None:
+            self._health.record_failure(op or "fetch", exc)
+        if self.obs is not None:
+            self.obs.fetch_timeouts.inc((op or "fetch",))
+
+    def note_deadline_wait(self, op: Optional[str], nops: int = 1) -> None:
+        """A result wait was cut short by the op's own deadline: overload
+        accounting only (ops-denominated, like every other stage) — the
+        device is not implicated, so no breaker failure is recorded."""
+        if self.obs is not None:
+            self.obs.deadline_exceeded.inc(("fetch_wait",), nops)
+
+    @staticmethod
+    def _all_expired(seg: _Segment, now: float) -> bool:
+        """True when EVERY op in the segment carries a deadline and all
+        of them have passed — the segment is pure waste: shed it before
+        it costs a device launch (or before its parked backoff matures)."""
+        return bool(seg.futures) and all(
+            dl is not None and dl <= now
+            for _f, _s, _n, _t, dl in seg.futures
+        )
+
+    def _shed_segment(self, seg: _Segment) -> None:
+        """Resolve every future of a fully-expired segment with
+        DeadlineExceededError — strictly pre-dispatch, so nothing in it
+        was ever applied (retry segments were dispatched but FAILED:
+        equally unapplied)."""
+        if seg.span is not None:
+            seg.span.nops = seg.nops
+            seg.span.stamp("device_dispatch")
+            seg.span.finish(error=True)
+        self._count_shed("deadline", "queue", seg.nops)
+        e = DeadlineExceededError(
+            f"op deadline expired while queued "
+            f"({_op_label(seg.key)}, {seg.nops} ops, "
+            f"attempts={seg.attempts})", stage="queue",
+        )
+        for fut, _start, _n, _tenant, _dl in seg.futures:
+            if fut.set_running_or_notify_cancel():
+                fut.set_exception(e)
 
     # -- flush thread ------------------------------------------------------
 
@@ -413,8 +585,8 @@ class BatchCoalescer:
             head.chunks.extend(nxt.chunks)
             if head.metas is not None:
                 head.metas.extend(nxt.metas)
-            for fut, start, n, tenant in nxt.futures:
-                head.futures.append((fut, head.nops + start, n, tenant))
+            for fut, start, n, tenant, dl in nxt.futures:
+                head.futures.append((fut, head.nops + start, n, tenant, dl))
             head.nops += nxt.nops
         if not self._order:
             self._hurry = False
@@ -439,6 +611,12 @@ class BatchCoalescer:
                 continue
             nb = seg.not_before
             if nb is not None and nb > now and not self._closed:
+                if self._all_expired(seg, now):
+                    # Every op in the parked segment is past its
+                    # deadline: don't wait out the backoff — pop it now
+                    # so the flush loop sheds it (futures resolve fast,
+                    # its pool's later segments unblock).
+                    return seg, i, None
                 parked.add(seg.pool_key)
                 deadline = nb if deadline is None else min(deadline, nb)
                 continue
@@ -509,8 +687,22 @@ class BatchCoalescer:
                     self._wake.wait(timeout=timeout)
                     continue
                 self._pop_seg_locked(seg)
-                if seg.dispatch is not None:
+                if seg.dispatch is not None and not self._all_expired(
+                    seg, now
+                ):
                     seg = self._merge_consecutive_locked(seg, idx)
+            # Expired-segment sweep (ISSUE 7): a segment whose EVERY op
+            # is past its deadline is shed here — before staging, before
+            # a launch slot, before the device sees it.  (Merging is
+            # skipped for an expired head so a fresh same-key segment
+            # behind it is not dragged into the shed.)
+            if seg.dispatch is not None and self._all_expired(
+                seg, time.monotonic()
+            ):
+                with self._lock:
+                    self._inflight -= 1
+                self._shed_segment(seg)
+                continue
             cols = stage_exc = None
             if seg.dispatch is not None:
                 # Stage FIRST (host-side pad/concat of the segment's
@@ -603,7 +795,7 @@ class BatchCoalescer:
             seg.span.nops = seg.nops
             seg.span.stamp("device_dispatch")
             seg.span.finish(error=True)
-        for fut, start, n, _ in seg.futures:
+        for fut, start, n, _, _dl in seg.futures:
             if fut.set_running_or_notify_cancel():
                 fut.set_exception(
                     e
@@ -617,7 +809,7 @@ class BatchCoalescer:
             if seg.dispatch is None:  # barrier segment (drain)
                 with self._lock:
                     self._inflight -= 1
-                for fut, _, _, _ in seg.futures:
+                for fut, _, _, _, _ in seg.futures:
                     if fut.set_running_or_notify_cancel():
                         fut.set_result(None)
                 return
@@ -765,6 +957,18 @@ class BatchCoalescer:
                     first = False
                     if self._health is not None:
                         self._health.record_success(_op_label(seg.key))
+                    # Admission estimator (ISSUE 7): flush-to-retire
+                    # latency + ops-per-launch EWMAs (~5-sample time
+                    # constant) — the service model behind
+                    # estimate_wait_s.  GIL-atomic float stores; exact
+                    # interleaving doesn't matter for an estimator.
+                    retire_s = time.monotonic() - t0
+                    self._service_ewma_s += 0.2 * (
+                        retire_s - self._service_ewma_s
+                    )
+                    self._ops_per_launch_ewma += 0.2 * (
+                        seg.nops - self._ops_per_launch_ewma
+                    )
                     if seg.span is not None:
                         seg.span.nops = seg.nops
                         seg.span.stamp("d2h_fetch")
@@ -773,10 +977,10 @@ class BatchCoalescer:
                         # Per-tenant accounting, deferred from submit to
                         # HERE so producers never pay the counter lock.
                         op = _op_label(seg.key)
-                        for _, _, n, tenant in seg.futures:
+                        for _, _, n, tenant, _dl in seg.futures:
                             if tenant is not None:
                                 self.obs.tenant_ops.inc((tenant, op), n)
-                    for fut, start, n, _ in seg.futures:
+                    for fut, start, n, _, _dl in seg.futures:
                         if fut.set_running_or_notify_cancel():
                             fut.set_result(
                                 None if res is None else res[start : start + n]
@@ -793,7 +997,7 @@ class BatchCoalescer:
                         seg.span.nops = seg.nops
                         seg.span.stamp("d2h_fetch")
                         seg.span.finish(error=True)
-                    for fut, start, n, _ in seg.futures:
+                    for fut, start, n, _, _dl in seg.futures:
                         if fut.set_running_or_notify_cancel():
                             fut.set_exception(
                                 KernelExecutionError(
@@ -821,7 +1025,7 @@ class BatchCoalescer:
                 return
             barrier = object()  # unique key: never merged into
             seg = _Segment(barrier, barrier, None)
-            seg.futures.append((fut, 0, 0, None))
+            seg.futures.append((fut, 0, 0, None, None))
             self._order.append(seg)
             self._hurry = True  # the caller is about to block on it
             self._wake.notify()

@@ -29,6 +29,36 @@ class DispatchTimeoutError(RedissonTpuError, TimeoutError):
     """A blocking result wait exceeded its deadline."""
 
 
+class DeadlineExceededError(RedissonTpuError, TimeoutError):
+    """An op's end-to-end deadline expired (overload control plane,
+    ISSUE 7).  Raised at every stage strictly BEFORE the device launch —
+    admission control at submit, the expired-segment sweep at flush, the
+    residual-budget wait at fetch — so a deadline failure never means a
+    half-applied op: either the op was shed pre-dispatch (``stage`` one
+    of ``submit``/``admission``/``queue``) or its result simply wasn't
+    awaited in time (``fetch_wait``: the op may still complete on
+    device, but it was never acked)."""
+
+    def __init__(self, msg: str, stage: str = "submit"):
+        super().__init__(msg)
+        self.stage = stage
+
+
+class TenantThrottledError(RedissonTpuError):
+    """The op was shed by a per-tenant quota (token-bucket rate limit or
+    in-flight bound) before touching the queue — the fairness arm of the
+    overload control plane: one bursting tenant is shed here so the
+    well-behaved rest never see its queue wait."""
+
+    def __init__(self, tenant: str, reason: str, detail: str = ""):
+        super().__init__(
+            f"tenant {tenant!r} throttled ({reason})"
+            + (f": {detail}" if detail else "")
+        )
+        self.tenant = tenant
+        self.reason = reason
+
+
 class NonRetryableDispatchError(RedissonTpuError):
     """Dispatch failed AFTER part of its device state was already applied
     (e.g. the first group of a migration-split compound launch succeeded,

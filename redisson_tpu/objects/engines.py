@@ -352,6 +352,18 @@ class TpuSketchEngine(SketchDurabilityMixin):
             failure_threshold=config.tpu_sketch.breaker_failure_threshold,
             open_s=config.tpu_sketch.breaker_open_ms / 1000.0,
         )
+        # Per-tenant fair load shedding (ISSUE 7): token-bucket rate
+        # limits + in-flight quotas enforced at the submit boundary.
+        # Built even when both limits are 0 (inactive) so a live
+        # CONFIG SET tenant-rate-limit lands on a running engine.
+        from redisson_tpu.tenancy.registry import TenantGovernor
+
+        self.governor = TenantGovernor(
+            rate_limit=config.tpu_sketch.tenant_rate_limit,
+            burst=config.tpu_sketch.tenant_burst_ops,
+            max_inflight=config.tpu_sketch.tenant_max_inflight,
+            obs=self.obs,
+        )
         self.health.reconcile_cb = self._reconcile_kind
         self._mirrors: dict = {}  # name -> degraded-mode mirror
         self._mirror_lock = threading.RLock()
@@ -409,6 +421,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 health=self.health,
                 max_batch_slow_phase=(
                     config.tpu_sketch.max_batch_slow_phase
+                ),
+                fetch_timeout_s=(
+                    config.tpu_sketch.fetch_timeout_ms / 1000.0
                 ),
             )
         else:
@@ -495,6 +510,11 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 "live pop-time merge cap (max_batch, or "
                 "max_batch_slow_phase while the link phase is slow)",
                 c.merge_cap,
+            )
+            reg.gauge_callback(
+                "rtpu_admission_est_wait_us",
+                "last admission-control queue-wait estimate",
+                lambda: c.last_est_wait_s * 1e6,
             )
         if self.prewarmer is not None:
             reg.gauge_callback(
@@ -775,17 +795,42 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def _submit(self, key, dispatch, arrays, nops, pool_key=None, meta=None,
                 tenant=None):
-        from redisson_tpu.executor.coalescer import HintedFuture
+        from redisson_tpu import overload as _ovl
+        from redisson_tpu.executor.coalescer import HintedFuture, _op_label
 
         # ``tenant`` rides the segment as an appended (tenant, nops)
         # tuple; the coalescer's COMPLETER thread turns it into the
         # per-tenant counters, so this producer path pays no counter
         # lock (the ≤10% submit-overhead guard in test_observability.py).
-        fut = self.coalescer.submit(
-            key, dispatch, arrays, nops, pool_key=pool_key, meta=meta,
-            tenant=tenant,
+        #
+        # Overload control plane (ISSUE 7): the ambient deadline (RESP
+        # ingress stamp or client.op_deadline scope) rides the op into
+        # the coalescer — admission control + queue shedding there, the
+        # residual budget on the returned future's .result().  The
+        # tenant governor sheds over-quota tenants HERE, before the op
+        # can cost anyone else queue wait.
+        deadline = _ovl.current_deadline()
+        gov = self.governor
+        governed = (
+            gov is not None and tenant is not None and gov.active
         )
-        return HintedFuture(fut, self.coalescer)
+        if governed:
+            gov.admit(tenant, nops)  # raises TenantThrottledError
+        try:
+            fut = self.coalescer.submit(
+                key, dispatch, arrays, nops, pool_key=pool_key, meta=meta,
+                tenant=tenant, deadline=deadline,
+            )
+        except BaseException:
+            if governed:
+                gov.release(tenant, nops)
+            raise
+        if governed and gov.max_inflight > 0:
+            fut.add_done_callback(lambda _f: gov.release(tenant, nops))
+        return HintedFuture(
+            fut, self.coalescer, deadline=deadline, op=_op_label(key),
+            nops=nops,
+        )
 
     def _prewarm_keyed(self, pool, k: int, L: int, blocks, lengths) -> None:
         """Register device-hash warm ladders for an observed codec

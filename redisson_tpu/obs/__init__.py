@@ -65,6 +65,38 @@ class Observability:
             "rtpu_faults_injected",
             "chaos faults injected, by fault point and kind",
             ("point", "kind"))
+        # Overload control plane (ISSUE 7): pre-dispatch shedding by
+        # reason (deadline | admission | tenant | ingress), deadline
+        # failures by stage (submit | admission | queue | fetch_wait),
+        # per-tenant throttles, fetch timeouts (the breaker-feeding
+        # kind), and slow-client disconnects.  The admission wait
+        # estimate itself is a render-time gauge the engine registers
+        # (rtpu_admission_est_wait_us).
+        self.shed_ops = r.counter(
+            "rtpu_shed_ops",
+            "ops shed pre-dispatch by the overload control plane, "
+            "by reason", ("reason",))
+        self.deadline_exceeded = r.counter(
+            "rtpu_deadline_exceeded",
+            "ops that failed with DeadlineExceededError, by stage",
+            ("stage",))
+        self.tenant_throttled = r.counter(
+            "rtpu_tenant_throttled",
+            "ops shed by per-tenant quotas, by tenant",
+            ("tenant",), max_children=2048)
+        self.fetch_timeouts = r.counter(
+            "rtpu_fetch_timeouts",
+            "blocking result waits that hit the fetch timeout, by op",
+            ("op",))
+        self.slow_client_disconnects = r.counter(
+            "rtpu_slow_client_disconnects",
+            "connections dropped by the output-buffer limits, by cause",
+            ("cause",))
+        self.resp_ingress_shed = r.counter(
+            "rtpu_resp_ingress_shed",
+            "RESP commands (or transactions) refused at ingress by the "
+            "admission watermark — COMMAND-denominated, unlike the "
+            "ops-denominated rtpu_shed_ops")
         # Near cache (ISSUE 4): hit/miss by result kind; evictions and
         # live byte occupancy are store-side (evictions inc'd via the
         # store's on_evict hook, bytes a render-time gauge registered by

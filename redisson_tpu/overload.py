@@ -1,0 +1,94 @@
+"""Ambient op-deadline propagation (overload control plane, ISSUE 7).
+
+Redis bounds a command's life with ``timeout``/``busy-reply-threshold``;
+the TPU dispatch path is deeper — RESP ingress → engine submit →
+coalescer segment → device dispatch → D2H fetch — and an op can rot at
+any of those stages.  One absolute deadline, attached where the op
+enters the system, rides the whole path:
+
+- **RESP ingress** stamps every command with the config default
+  (``op_deadline_ms``) or the connection's ``CLIENT DEADLINE`` override.
+- **Direct API** callers use :func:`deadline_scope` (surfaced as
+  ``client.op_deadline(ms)``).
+- The **coalescer** reads the ambient deadline at submit (admission
+  control + queue shedding) and the returned future honors the residual
+  budget at ``.result()``.
+
+The deadline is carried in a thread-local STACK of absolute
+``time.monotonic()`` instants: nesting works (the innermost scope wins),
+and pushing ``None`` explicitly disables any outer deadline (the
+``CLIENT DEADLINE 0`` semantics).  No scope installed means no deadline
+— the blocking, wait-forever behavior stays the default.
+
+Deadlines here are best-effort shedding hints, not transactions: an op
+shed by any stage was NEVER dispatched (no acked-write hazard), while an
+op that merely missed its fetch wait may still complete on device — it
+just was not acked (see failures.DeadlineExceededError.stage).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from redisson_tpu.executor.failures import DeadlineExceededError  # noqa: F401
+# (re-exported: deadline consumers want the scope and the error together)
+
+_ctl = threading.local()
+
+
+def current_deadline() -> Optional[float]:
+    """The innermost ambient deadline (absolute ``time.monotonic()``
+    seconds) or None when the current thread has none in scope."""
+    stack = getattr(_ctl, "stack", None)
+    return stack[-1] if stack else None
+
+
+def remaining(deadline: Optional[float],
+              now: Optional[float] = None) -> Optional[float]:
+    """Residual budget in seconds (may be negative); None for no
+    deadline."""
+    if deadline is None:
+        return None
+    return deadline - (time.monotonic() if now is None else now)
+
+
+class deadline_scope:
+    """Context manager attaching a deadline ``seconds`` from entry to
+    every engine op submitted inside the block on this thread.
+    ``seconds=None`` pushes an explicit no-deadline frame (shadows any
+    outer scope)."""
+
+    __slots__ = ("_seconds", "_abs")
+
+    def __init__(self, seconds: Optional[float] = None, *,
+                 at: Optional[float] = None):
+        if seconds is not None and at is not None:
+            raise ValueError("pass seconds or at=, not both")
+        self._seconds = seconds
+        self._abs = at
+
+    def __enter__(self) -> "deadline_scope":
+        stack = getattr(_ctl, "stack", None)
+        if stack is None:
+            stack = _ctl.stack = []
+        if self._abs is not None:
+            stack.append(self._abs)
+        elif self._seconds is not None:
+            stack.append(time.monotonic() + self._seconds)
+        else:
+            stack.append(None)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _ctl.stack.pop()
+        return False
+
+
+__all__ = [
+    "DeadlineExceededError",
+    "current_deadline",
+    "deadline_scope",
+    "remaining",
+]

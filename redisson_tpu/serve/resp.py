@@ -53,6 +53,12 @@ from typing import Optional
 
 import numpy as np
 
+from redisson_tpu import overload as _overload
+from redisson_tpu.executor.failures import (
+    DeadlineExceededError,
+    TenantThrottledError,
+)
+
 
 class RespError(Exception):
     pass
@@ -87,6 +93,19 @@ _ERROR_CODES = (
 _SCRIPT_CMDS = frozenset(
     ("EVAL", "EVALSHA", "SCRIPT", "FCALL", "FCALL_RO", "FUNCTION")
 )
+
+# Commands EXEMPT from ingress shedding (overload control plane, ISSUE
+# 7): connection handshake, admin, and introspection — exactly the
+# surface an operator needs to SEE and FIX an overload (shedding INFO /
+# CONFIG during the incident they diagnose would be self-defeating).
+# Everything else is refused with -BUSY once queue pressure crosses the
+# admission watermark.
+_SHED_EXEMPT = frozenset((
+    "PING", "ECHO", "AUTH", "HELLO", "QUIT", "RESET", "SELECT",
+    "INFO", "CONFIG", "CLIENT", "COMMAND", "SLOWLOG", "DEBUG",
+    "SHUTDOWN", "SCRIPT", "WAIT", "MULTI", "EXEC", "DISCARD",
+    "SUBSCRIBE", "UNSUBSCRIBE",
+))
 
 # -- front-door vectorization tables (ISSUE 6 tentpole) ----------------------
 
@@ -369,8 +388,9 @@ class _ConnCtx:
     with replies), this connection's channel subscriptions, and the
     MULTI/EXEC transaction queue."""
 
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket, server: "RespServer" = None):
         self.sock = sock
+        self.server = server  # live output-buffer limits (CONFIG SET)
         self.lock = threading.Lock()
         try:  # for SLOWLOG entries; the peer may already be gone
             self.addr = "%s:%d" % sock.getpeername()[:2]
@@ -383,20 +403,102 @@ class _ConnCtx:
         self.in_exec = False  # replaying an EXEC (blocking cmds don't block)
         self.proto = 2  # RESP protocol version; HELLO 3 upgrades
         self.client_name: Optional[str] = None
+        # Per-connection op-deadline override (CLIENT DEADLINE, ISSUE 7):
+        # None = server default (op_deadline_ms), 0 = no deadline.
+        self.op_deadline_ms: Optional[int] = None
+
+    def _kill(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
 
     def send(self, frame: bytes) -> None:
         with self.lock:
-            try:
-                self.sock.sendall(frame)
-            except OSError:
-                # Includes socket.timeout: the connection's timeout covers
-                # sendall too, and a timed-out/failed send may have written
-                # a PARTIAL frame — continuing would desync the reply
-                # stream.  Kill the socket; the read loop reclaims the slot.
+            srv = self.server
+            hard = getattr(srv, "output_buffer_limit", 0) if srv else 0
+            soft_s = (
+                getattr(srv, "output_buffer_soft_seconds", 0.0)
+                if srv else 0.0
+            )
+            if not hard and not soft_s:
                 try:
-                    self.sock.shutdown(socket.SHUT_RDWR)
+                    self.sock.sendall(frame)
                 except OSError:
-                    pass
+                    # Includes socket.timeout: the connection's timeout
+                    # covers sendall too, and a timed-out/failed send may
+                    # have written a PARTIAL frame — continuing would
+                    # desync the reply stream.  Kill the socket; the read
+                    # loop reclaims the slot.
+                    self._kill()
+                return
+            self._send_bounded(frame, srv, hard, soft_s)
+
+    def _send_bounded(self, frame: bytes, srv, hard: int,
+                      soft_s: float) -> None:
+        """Slow-client protection (the client-output-buffer-limit analog,
+        ISSUE 7): replies are written through, so the server-side
+        'buffer' is the unsent remainder of the current frame.  A frame
+        still holding more than ``hard`` bytes unsent after its grace
+        (soft-seconds when set, else ~1 s) — or one making NO progress
+        for ``soft_s`` seconds — disconnects the client instead of
+        parking a connection thread (and the engine results it holds)
+        behind a receiver that never (or barely) reads.
+
+        Waits use select(), NOT settimeout(): the socket's timeout is
+        shared state the connection's reader thread relies on
+        (idle_timeout_s semantics), and this method runs cross-thread
+        for pub/sub pushes."""
+        import select
+
+        view = memoryview(frame)
+        frame_t0 = last_progress = time.monotonic()
+        # No-progress stall bound: soft-seconds when configured, else
+        # the socket's own timeout (the idle_timeout_s the legacy
+        # sendall path died under) — with only the hard byte limit set,
+        # an under-limit stall must NOT loop forever where the old path
+        # disconnected.
+        stall_s = soft_s or self.sock.gettimeout() or 0.0
+        # The hard byte limit gets its OWN time gate (soft-seconds when
+        # set, else ~1 s): gating it on continuous stall alone lets a
+        # one-byte-per-tick trickler reset the clock forever, and tying
+        # it to idle_timeout made it a 300 s (or never, at idle 0) wait.
+        hard_grace_s = soft_s or 1.0
+        while view:
+            now = time.monotonic()
+            if (
+                hard and len(view) > hard
+                and now - frame_t0 > hard_grace_s
+            ):
+                srv._note_slow_client("hard-bytes", len(view))
+                self._kill()
+                return
+            tick = 1.0
+            if stall_s:
+                rem = stall_s - (now - last_progress)
+                if rem <= 0:
+                    srv._note_slow_client(
+                        "soft-seconds" if soft_s else "idle-timeout",
+                        len(view),
+                    )
+                    self._kill()
+                    return
+                tick = min(tick, rem)
+            if hard and len(view) > hard:
+                tick = min(tick, max(0.01, hard_grace_s - (now - frame_t0)))
+            try:
+                _r, writable, _x = select.select((), (self.sock,), (), tick)
+                if not writable:
+                    continue  # loop re-checks the stall / hard gates
+                # Blocking socket + select-says-writable: send() takes
+                # whatever buffer space exists and returns promptly.
+                n = self.sock.send(view)
+            except (OSError, ValueError):
+                self._kill()
+                return
+            if n > 0:
+                last_progress = time.monotonic()
+                view = view[n:]
 
 
 class RespServer:
@@ -459,6 +561,25 @@ class RespServer:
         self._script_kill = None  # run record a SCRIPT KILL is targeting
         self.max_connections = max_connections
         self.idle_timeout_s = idle_timeout_s
+        # Overload control plane (ISSUE 7).  Deadline default stamped on
+        # every command at ingress (CLIENT DEADLINE overrides per
+        # connection); ingress shedding once coalescer queue pressure
+        # crosses the watermark; slow-client output-buffer limits.  All
+        # live-settable via CONFIG SET.
+        tsk = getattr(client.config, "tpu_sketch", None)
+        self.op_deadline_ms = int(getattr(tsk, "op_deadline_ms", 0) or 0)
+        self.admission_watermark = float(
+            getattr(tsk, "admission_watermark", 0.9) or 0.9
+        )
+        self.output_buffer_limit = int(
+            getattr(client.config, "client_output_buffer_limit", 0) or 0
+        )
+        self.output_buffer_soft_seconds = float(
+            getattr(client.config, "client_output_buffer_soft_seconds", 0.0)
+            or 0.0
+        )
+        self._ingress_shed = 0  # lifetime commands shed at ingress
+        self._slow_client_kills = 0
         # Front-door vectorization (ISSUE 6): fuse runs of adjacent
         # pipelined commands into single engine launches; the response
         # cache serves repeated identical reads inside one pipeline
@@ -538,7 +659,7 @@ class RespServer:
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             reader = _Reader(conn)
-            ctx = _ConnCtx(conn)
+            ctx = _ConnCtx(conn, server=self)
             if self._requirepass:
                 ctx.authed = False
         except Exception:
@@ -664,7 +785,16 @@ class RespServer:
             "EXEC", "DISCARD", "MULTI", "RESET",
         )
         try:
-            reply = self._dispatch(cmd, ctx, name)
+            # Deadline attach (ISSUE 7): every command gets its own
+            # fresh end-to-end deadline — connection override first,
+            # else the server default; 0/None → no deadline (ops block,
+            # the pre-overload behavior).
+            dl_s = self._op_deadline_s(ctx)
+            if dl_s is not None:
+                with _overload.deadline_scope(dl_s):
+                    reply = self._dispatch(cmd, ctx, name)
+            else:
+                reply = self._dispatch(cmd, ctx, name)
         except ScriptKilledError:
             # SCRIPT KILL's async exception can land AFTER the script
             # body left its guarded block (next bytecode boundary):
@@ -722,6 +852,54 @@ class RespServer:
             return any(a.upper() == b"BLOCK" for a in cmd[1:])
         return False
 
+    # -- overload control plane (ISSUE 7) ----------------------------------
+
+    def _op_deadline_s(self, ctx: "_ConnCtx") -> Optional[float]:
+        """Effective op deadline for this connection, in relative
+        seconds, or None for no deadline."""
+        ms = ctx.op_deadline_ms
+        if ms is None:
+            ms = self.op_deadline_ms
+        return ms / 1000.0 if ms and ms > 0 else None
+
+    def _pressure(self) -> float:
+        """Coalescer queue pressure (0 when the fronted engine has no
+        coalescer — host engine / direct-dispatch mode)."""
+        c = getattr(getattr(self._client, "_engine", None),
+                    "coalescer", None)
+        return c.pressure() if c is not None else 0.0
+
+    def _pressure_over(self) -> bool:
+        w = self.admission_watermark
+        return w > 0 and self._pressure() > w
+
+    def _count_ingress_shed(self) -> None:
+        # Commands, not ops: a shed command's engine op count is
+        # unknowable pre-parse, and mixing units into the ops-
+        # denominated rtpu_shed_ops family would make its total
+        # meaningless — ingress has its own command-denominated counter.
+        self._ingress_shed += 1
+        if self.obs is not None:
+            self.obs.resp_ingress_shed.inc()
+
+    def _shed_at_ingress(self, name: str, ctx: "_ConnCtx") -> bool:
+        """True when this command must be refused with -BUSY: pressure
+        over the watermark, command not exempt, and not inside an
+        already-running transaction (EXEC completes atomically once
+        started; MULTI queueing is free — the whole transaction is
+        judged once, at EXEC, in _cmdctx_EXEC)."""
+        if name in _SHED_EXEMPT or ctx.in_exec or ctx.in_multi:
+            return False
+        if not self._pressure_over():
+            return False
+        self._count_ingress_shed()
+        return True
+
+    def _note_slow_client(self, cause: str, pending: int) -> None:
+        self._slow_client_kills += 1
+        if self.obs is not None:
+            self.obs.slow_client_disconnects.inc((cause,))
+
     # -- front-door vectorization (ISSUE 6 tentpole) -----------------------
 
     def _bump_write_epoch(self) -> None:
@@ -739,6 +917,13 @@ class RespServer:
         dedicated exception class)."""
         if isinstance(e, RespError):
             return _encode_error(str(e))
+        if isinstance(e, DeadlineExceededError):
+            # Overload control plane (ISSUE 7): deadline sheds surface
+            # as the retryable -BUSY family, like redis-server's
+            # busy-state refusals.
+            return _encode_error(f"BUSY RTPU op deadline exceeded: {e}")
+        if isinstance(e, TenantThrottledError):
+            return _encode_error(f"BUSY RTPU tenant throttled: {e}")
         if isinstance(e, TypeError):
             return _encode_error(
                 "WRONGTYPE Operation against a key holding the wrong kind "
@@ -760,6 +945,12 @@ class RespServer:
         size = 0
         i = 0
         n = len(batch)
+        # Overload (ISSUE 7): while pressure is over the watermark,
+        # skip run fusion so every command flows through _safe_dispatch
+        # and the ingress shed check there — a fused run would bypass
+        # it.  (Checked once per parsed-ahead batch; the per-command
+        # check re-reads live pressure.)
+        overloaded = self._pressure_over()
         # Per-window response cache: (name, *argv) -> reply frame, valid
         # while the write epoch is unmoved.
         rc: dict = {}
@@ -783,7 +974,10 @@ class RespServer:
                     size += len(hit)
                     i += 1
                     continue
-            run = self._scan_run(batch, i) if plain else None
+            run = (
+                self._scan_run(batch, i)
+                if plain and not overloaded else None
+            )
             if run is not None:
                 frames, j = self._exec_run(run, batch, i, ctx, rc, rc_state)
                 out.extend(frames)
@@ -939,6 +1133,16 @@ class RespServer:
     # -- run execution -----------------------------------------------------
 
     def _exec_run(self, run, batch, i, ctx: "_ConnCtx", rc, rc_state):
+        # The fused run is ONE engine call serving many commands: one
+        # shared deadline covers it (per-command scopes re-stamp inside
+        # the mget fam's _safe_dispatch calls).
+        dl_s = self._op_deadline_s(ctx)
+        if dl_s is None:
+            return self._exec_run_inner(run, batch, i, ctx, rc, rc_state)
+        with _overload.deadline_scope(dl_s):
+            return self._exec_run_inner(run, batch, i, ctx, rc, rc_state)
+
+    def _exec_run_inner(self, run, batch, i, ctx: "_ConnCtx", rc, rc_state):
         fam, j = run[0], run[1]
         t0 = time.perf_counter()
         if fam == "mget":
@@ -1176,6 +1380,18 @@ class RespServer:
                 "BUSY Redis is busy running a script. You can only call "
                 "SCRIPT KILL or SHUTDOWN NOSAVE."
             )
+        if self._shed_at_ingress(name, ctx):
+            # Overload control plane (ISSUE 7): the coalescer queue is
+            # past the admission watermark — refuse engine-bound work at
+            # the door (the -BUSY retryable surface) instead of letting
+            # it buy unbounded queue wait.  Strictly pre-dispatch: a
+            # shed command was never executed, so no acked state is
+            # involved.
+            raise RespError(
+                "BUSY RTPU overloaded: command shed at ingress (queue "
+                f"pressure {self._pressure():.2f} over watermark "
+                f"{self.admission_watermark:g}); retry later"
+            )
         if ctx.in_multi and name not in ("EXEC", "DISCARD", "MULTI", "RESET"):
             # Redis MULTI semantics: commands queue (validated for
             # existence only) and run contiguously at EXEC.  Pub/sub
@@ -1233,6 +1449,21 @@ class RespServer:
         if queued is None:  # a queue-time error poisons the transaction
             raise RespError(
                 "EXECABORT Transaction discarded because of previous errors"
+            )
+        if queued and self._pressure_over() and any(
+            c[0].decode("latin-1", "replace").upper() not in _SHED_EXEMPT
+            for c in queued
+        ):
+            # Overload door for transactions (ISSUE 7): MULTI queueing
+            # is free, so the judgment lands HERE, before any queued
+            # command executes — otherwise wrapping work in MULTI/EXEC
+            # would bypass ingress shedding entirely.  The transaction
+            # is consumed (EXECABORT semantics), nothing partial ran.
+            self._count_ingress_shed()
+            raise RespError(
+                "BUSY RTPU overloaded: transaction shed at EXEC (queue "
+                f"pressure {self._pressure():.2f} over watermark "
+                f"{self.admission_watermark:g}); retry later"
             )
         frames = []
         ctx.in_exec = True  # blocking commands act non-blocking (Redis)
@@ -1320,6 +1551,32 @@ class RespServer:
                 ),
                 "nearcache-max-batch": str(nc.max_batch),
             })
+        # Overload control plane (ISSUE 7): live-settable everywhere the
+        # serve layer applies (output-buffer limits, deadline default,
+        # watermark); the engine-side knobs (fetch timeout, tenant
+        # quotas) register only when the fronted engine HAS a coalescer/
+        # governor — acking them on the host engine would fake the
+        # capability.
+        table.update({
+            "op-deadline-ms": str(self.op_deadline_ms),
+            "admission-watermark": f"{self.admission_watermark:g}",
+            "client-output-buffer-limit": str(self.output_buffer_limit),
+            "client-output-buffer-soft-seconds":
+                f"{self.output_buffer_soft_seconds:g}",
+        })
+        eng = getattr(self._client, "_engine", None)
+        c = getattr(eng, "coalescer", None)
+        if c is not None:
+            table["fetch-timeout-ms"] = str(
+                int(c.fetch_timeout_s * 1000)
+            )
+        gov = getattr(eng, "governor", None)
+        if gov is not None:
+            table.update({
+                "tenant-rate-limit": str(int(gov.rate_limit)),
+                "tenant-burst-ops": str(int(gov._burst_cfg)),
+                "tenant-max-inflight": str(int(gov.max_inflight)),
+            })
         return table
 
     def _apply_nearcache_config(self, key: str, val: str) -> None:
@@ -1337,6 +1594,76 @@ class RespServer:
             nc.store.resize(tenant_quota_bytes=int(val))
         elif key == "nearcache-max-batch":
             nc.max_batch = int(val)
+
+    # Overload knobs (ISSUE 7) with bounds validation: CONFIG SET
+    # rejects nonsense (negative deadline, zero watermark) instead of
+    # applying it — the nearcache-knob pattern.
+    _OVERLOAD_KEYS = frozenset((
+        "op-deadline-ms", "admission-watermark", "fetch-timeout-ms",
+        "tenant-rate-limit", "tenant-burst-ops", "tenant-max-inflight",
+        "client-output-buffer-limit", "client-output-buffer-soft-seconds",
+    ))
+
+    def _validate_overload_config(self, key: str, raw: bytes) -> None:
+        def bad(msg: str):
+            raise RespError(
+                f"argument must be {msg} for CONFIG SET '{key}'"
+            )
+
+        if key in ("admission-watermark",
+                   "client-output-buffer-soft-seconds",
+                   "tenant-rate-limit", "tenant-burst-ops"):
+            # Float-valued knobs — validated exactly as wide as the
+            # setter applies them (the governor takes fractional
+            # rates).
+            try:
+                fv = float(raw)
+            except ValueError:
+                raise RespError(
+                    f"Invalid argument '{raw.decode()}' for CONFIG SET "
+                    f"'{key}'"
+                )
+            if key == "admission-watermark" and not 0.0 < fv <= 1.0:
+                bad("in (0, 1] (use 1 to effectively disable shedding)")
+            elif key != "admission-watermark" and fv < 0:
+                bad(">= 0")
+            return
+        try:
+            iv = int(raw)
+        except ValueError:
+            raise RespError(
+                f"Invalid argument '{raw.decode()}' for CONFIG SET "
+                f"'{key}'"
+            )
+        if key == "fetch-timeout-ms" and iv <= 0:
+            bad("positive")
+        if iv < 0:
+            bad(">= 0")
+
+    def _apply_overload_config(self, key: str, val: str) -> None:
+        eng = getattr(self._client, "_engine", None)
+        if key == "op-deadline-ms":
+            self.op_deadline_ms = int(val)
+        elif key == "admission-watermark":
+            self.admission_watermark = float(val)
+        elif key == "client-output-buffer-limit":
+            self.output_buffer_limit = int(val)
+        elif key == "client-output-buffer-soft-seconds":
+            self.output_buffer_soft_seconds = float(val)
+        elif key == "fetch-timeout-ms":
+            c = getattr(eng, "coalescer", None)
+            if c is not None:
+                c.fetch_timeout_s = int(val) / 1000.0
+        elif key in ("tenant-rate-limit", "tenant-burst-ops",
+                     "tenant-max-inflight"):
+            gov = getattr(eng, "governor", None)
+            if gov is not None:
+                if key == "tenant-rate-limit":
+                    gov.set_limits(rate_limit=float(val))
+                elif key == "tenant-burst-ops":
+                    gov.set_limits(burst=float(val))
+                else:
+                    gov.set_limits(max_inflight=int(val))
 
     def _cmd_CONFIG(self, args):
         import fnmatch
@@ -1367,7 +1694,9 @@ class RespServer:
                         f"Unknown option or number of arguments for "
                         f"CONFIG SET - '{key}'"
                     )
-                if key.startswith("slowlog-") or (
+                if key in self._OVERLOAD_KEYS:
+                    self._validate_overload_config(key, pairs[i + 1])
+                elif key.startswith("slowlog-") or (
                     key.startswith("nearcache-")
                 ):
                     try:
@@ -1425,6 +1754,8 @@ class RespServer:
                     self.obs.slowlog.set_threshold_us(int(val))
                 elif key == "slowlog-max-len":
                     self.obs.slowlog.set_max_len(int(val))
+                elif key in self._OVERLOAD_KEYS:
+                    self._apply_overload_config(key, val)
                 elif key.startswith("nearcache"):
                     self._apply_nearcache_config(key, val)
             return _encode_simple("OK")
@@ -1571,14 +1902,20 @@ class RespServer:
                 return _encode_array(flat)
             if len(args) < 4:
                 raise RespError(
-                    "DEBUG INJECT <point> <kind> <rate> [seed] | OFF | LIST"
+                    "DEBUG INJECT <point> <kind> <rate> [seed] [seconds] "
+                    "| OFF | LIST"
                 )
             point = args[1].decode()
             kind = args[2].decode().lower()
             try:
                 rate = float(args[3])
                 seed = int(args[4]) if len(args) > 4 else 0
-                chaos.inject(point, kind=kind, rate=rate, seed=seed)
+                # Optional magnitude: latency rules sleep this long,
+                # pressure rules (overload.pressure, ISSUE 7) inflate
+                # the admission wait estimate by it.
+                latency_s = float(args[5]) if len(args) > 5 else 0.001
+                chaos.inject(point, kind=kind, rate=rate, seed=seed,
+                             latency_s=latency_s)
             except ValueError as e:
                 raise RespError(str(e)) from e
             return _encode_simple("OK")
@@ -2254,7 +2591,7 @@ class RespServer:
     # name includes them.
     _INFO_DEFAULT = (
         "server", "clients", "memory", "stats", "nearcache", "frontdoor",
-        "keyspace",
+        "overload", "keyspace",
     )
 
     def _cmd_INFO(self, args):
@@ -2381,6 +2718,54 @@ class RespServer:
                     f"frontdoor_response_cache_hit_rate:"
                     f"{round(rch / (rch + rcm), 4) if rch + rcm else 0.0}",
                 ]
+            elif s == "overload" and obs is not None:
+                # Overload control plane (ISSUE 7): deadlines, admission
+                # control, tenant quotas, slow-client limits — the
+                # operator's one-stop view of what is being shed and why
+                # (docs/robustness.md explains each line).
+                def _fam_tot(fam):
+                    return sum(int(c.value) for _, c in fam.items())
+
+                eng = getattr(self._client, "_engine", None)
+                c = getattr(eng, "coalescer", None)
+                gov = getattr(eng, "governor", None)
+                shed_by = {
+                    lv[0]: int(cv.value)
+                    for lv, cv in obs.shed_ops.items()
+                }
+                shed_detail = ",".join(
+                    f"{k}={v}" for k, v in sorted(shed_by.items())
+                )
+                lines += [
+                    "# Overload",
+                    f"overload_op_deadline_ms:{self.op_deadline_ms}",
+                    f"overload_admission_watermark:"
+                    f"{self.admission_watermark:g}",
+                    f"overload_pressure:{round(self._pressure(), 4)}",
+                    f"overload_est_wait_us:"
+                    f"{0 if c is None else round(c.last_est_wait_s * 1e6)}",
+                    f"overload_fetch_timeout_ms:"
+                    f"{0 if c is None else int(c.fetch_timeout_s * 1000)}",
+                    f"overload_shed_ops:{sum(shed_by.values())}",
+                    f"overload_shed_by_reason:{shed_detail}",
+                    f"overload_deadline_exceeded:"
+                    f"{_fam_tot(obs.deadline_exceeded)}",
+                    f"overload_ingress_shed_commands:{self._ingress_shed}",
+                    f"overload_tenant_throttled:"
+                    f"{_fam_tot(obs.tenant_throttled)}",
+                    f"overload_tenant_rate_limit:"
+                    f"{0 if gov is None else gov.rate_limit:g}",
+                    f"overload_tenant_max_inflight:"
+                    f"{0 if gov is None else gov.max_inflight}",
+                    f"overload_fetch_timeouts:"
+                    f"{_fam_tot(obs.fetch_timeouts)}",
+                    f"overload_slow_client_disconnects:"
+                    f"{self._slow_client_kills}",
+                    f"overload_output_buffer_limit:"
+                    f"{self.output_buffer_limit}",
+                    f"overload_output_buffer_soft_seconds:"
+                    f"{self.output_buffer_soft_seconds:g}",
+                ]
             elif s == "keyspace":
                 n = self._client.get_keys().count()
                 lines += ["# Keyspace", f"db0:keys={n},expires=0,avg_ttl=0"]
@@ -2448,6 +2833,23 @@ class RespServer:
                 f"resp={ctx.proto}".encode()
             )
         if sub == "NO-EVICT" or sub == "NO-TOUCH":
+            return _encode_simple("OK")
+        if sub == "DEADLINE":
+            # Overload control plane (ISSUE 7): per-connection override
+            # of the server's op_deadline_ms.  CLIENT DEADLINE <ms> sets
+            # it, 0 disables deadlines for this connection, a negative
+            # value reverts to the server default; with no argument the
+            # current setting is returned.
+            if len(args) == 1:
+                cur = ctx.op_deadline_ms
+                return _encode_bulk(
+                    b"default" if cur is None else str(cur).encode()
+                )
+            try:
+                v = int(args[1])
+            except ValueError:
+                raise RespError("value is not an integer or out of range")
+            ctx.op_deadline_ms = None if v < 0 else v
             return _encode_simple("OK")
         raise RespError(f"unsupported CLIENT subcommand {sub}")
 

@@ -1,0 +1,822 @@
+"""Overload control plane (ISSUE 7): end-to-end deadlines, admission
+control, per-tenant fair load shedding, and slow-client protection.
+
+Covers the tentpole invariants —
+
+- deadlines ride RESP ingress / the direct-API scope into the coalescer
+  and shed expired work strictly PRE-dispatch (fast DeadlineExceededError
+  instead of the old 120 s hang);
+- parked-backoff segments whose every op expired are dropped with their
+  futures resolved;
+- admission control fails a deadline-carrying submit fast when the
+  estimated queue wait exceeds the residual budget (blocking stays the
+  no-deadline default), drivable deterministically via the
+  ``overload.pressure`` chaos point;
+- the tenant governor sheds over-quota tenants first (token bucket +
+  in-flight quota) and never touches within-quota tenants;
+- acked writes are never shed (differential soak under fault injection);
+- the RESP server sheds at ingress past the watermark, disconnects slow
+  clients at the output-buffer limits, live-applies every overload knob
+  via CONFIG SET with bounds validation, and reports INFO overload.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config, chaos
+from redisson_tpu.executor.coalescer import BatchCoalescer, HintedFuture
+from redisson_tpu.executor.failures import (
+    DeadlineExceededError,
+    DispatchTimeoutError,
+    TenantThrottledError,
+)
+from redisson_tpu.obs import Observability
+from redisson_tpu.serve.resp import RespServer
+from redisson_tpu.tenancy.registry import TenantGovernor
+from redisson_tpu import overload
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off():
+    chaos.clear()
+    chaos.reset_counts()
+    yield
+    chaos.clear()
+    chaos.reset_counts()
+
+
+def make_client(**tpu_kw):
+    from redisson_tpu.client import RedissonTpuClient
+
+    tpu_kw.setdefault("batch_window_us", 100)
+    tpu_kw.setdefault("min_bucket", 64)
+    # Keep breakers out of the way unless a test wants them: these
+    # tests drive sustained fault injection and a surprise degradation
+    # would change which layer answers.
+    tpu_kw.setdefault("breaker_failure_threshold", 10_000)
+    cfg = Config().use_tpu_sketch(**tpu_kw)
+    cfg.retry_attempts = 2
+    cfg.retry_interval_ms = 5
+    return RedissonTpuClient(cfg)
+
+
+class _FakeLazy:
+    def __init__(self, value):
+        self._v = value
+
+    def result(self):
+        return self._v
+
+
+class _BlockingLazy:
+    def __init__(self, gate, value):
+        self._gate = gate
+        self._v = value
+
+    def result(self):
+        self._gate.wait(10.0)
+        return self._v
+
+
+class _FakeHealth:
+    def __init__(self):
+        self.failures = []
+
+    def allow_dispatch(self, op):
+        return True
+
+    def record_failure(self, op, exc=None):
+        self.failures.append((op, exc))
+
+    def record_success(self, op):
+        pass
+
+
+# -- deadline scope ----------------------------------------------------------
+
+
+class TestDeadlineScope:
+    def test_nesting_inner_wins_and_restores(self):
+        assert overload.current_deadline() is None
+        with overload.deadline_scope(10.0):
+            outer = overload.current_deadline()
+            assert outer is not None
+            with overload.deadline_scope(0.5):
+                assert overload.current_deadline() < outer
+            assert overload.current_deadline() == outer
+        assert overload.current_deadline() is None
+
+    def test_none_frame_shadows_outer(self):
+        with overload.deadline_scope(1.0):
+            with overload.deadline_scope(None):
+                assert overload.current_deadline() is None
+            assert overload.current_deadline() is not None
+
+    def test_thread_isolation(self):
+        seen = []
+        with overload.deadline_scope(5.0):
+            t = threading.Thread(
+                target=lambda: seen.append(overload.current_deadline())
+            )
+            t.start()
+            t.join()
+        assert seen == [None]
+
+
+# -- tenant governor ---------------------------------------------------------
+
+
+class TestTenantGovernor:
+    def test_rate_limit_sheds_over_quota_only(self):
+        clock = [0.0]
+        g = TenantGovernor(rate_limit=100.0, burst=100.0,
+                           clock=lambda: clock[0])
+        g.admit("a", 100)  # burst drained
+        with pytest.raises(TenantThrottledError) as ei:
+            g.admit("a", 1)
+        assert ei.value.reason == "rate"
+        # Another tenant is untouched by a's exhaustion.
+        g.admit("b", 100)
+        # Refill: 0.5 s at 100 ops/s -> 50 tokens.
+        clock[0] = 0.5
+        g.admit("a", 50)
+        with pytest.raises(TenantThrottledError):
+            g.admit("a", 1)
+
+    def test_full_bucket_admits_oversize_with_debt(self):
+        clock = [0.0]
+        g = TenantGovernor(rate_limit=10.0, burst=20.0,
+                           clock=lambda: clock[0])
+        g.admit("a", 500)  # full bucket: admitted, tokens go negative
+        with pytest.raises(TenantThrottledError):
+            g.admit("a", 1)  # deep in debt
+        clock[0] = 60.0  # debt (-480) repaid at 10/s, then some
+        g.admit("a", 1)
+
+    def test_inflight_quota_and_release(self):
+        g = TenantGovernor(max_inflight=10)
+        g.admit("a", 8)
+        with pytest.raises(TenantThrottledError) as ei:
+            g.admit("a", 4)
+        assert ei.value.reason == "inflight"
+        g.release("a", 8)
+        g.admit("a", 10)
+
+    def test_inflight_oversize_single_submit_admitted_when_idle(self):
+        """A bulk op larger than the quota is admitted when the tenant
+        has nothing in flight (the token-bucket / coalescer-queue
+        carve-out) — it must not be unserviceable at any retry rate."""
+        g = TenantGovernor(max_inflight=100)
+        g.admit("a", 512)  # oversize, idle tenant: admitted
+        with pytest.raises(TenantThrottledError):
+            g.admit("a", 1)  # now over quota: throttled
+        g.release("a", 512)
+        g.admit("a", 512)
+
+    def test_set_limits_live(self):
+        g = TenantGovernor()
+        assert not g.active
+        g.admit("a", 10_000)  # inactive: everything passes
+        g.set_limits(rate_limit=1.0, burst=1.0)
+        assert g.active
+        g.admit("a", 1)
+        with pytest.raises(TenantThrottledError):
+            g.admit("a", 1)
+
+    def test_disable_reenable_inflight_does_not_leak(self):
+        """A disable/re-enable cycle must not strand in-flight charges:
+        release() is skipped while the quota is off, so set_limits
+        resets the charge table — otherwise the tenant is throttled
+        forever once re-enabled."""
+        g = TenantGovernor(max_inflight=1000)
+        g.admit("a", 500)
+        g.set_limits(max_inflight=0)  # live-disable; the 500 never release
+        g.admit("a", 10_000)  # off: passes
+        g.set_limits(max_inflight=400)  # re-enable, clean slate
+        g.admit("a", 400)
+        # A stale release from the pre-disable ops clamps at zero.
+        g.release("a", 500)
+        g.release("a", 500)
+        g.admit("a", 400)
+
+
+# -- coalescer: deadlines + admission ---------------------------------------
+
+
+def _mk(**kw):
+    kw.setdefault("batch_window_us", 200)
+    kw.setdefault("max_batch", 1024)
+    return BatchCoalescer(**kw)
+
+
+def _cols(n=8):
+    return (np.arange(n, dtype=np.int64),)
+
+
+def test_expired_deadline_sheds_at_submit():
+    c = _mk()
+    try:
+        with pytest.raises(DeadlineExceededError) as ei:
+            c.submit(("k",), lambda cols: _FakeLazy(cols[0]), _cols(), 8,
+                     deadline=time.monotonic() - 0.01)
+        assert ei.value.stage == "submit"
+    finally:
+        c.shutdown()
+
+
+def test_admission_sheds_on_pressure_bias():
+    """The overload.pressure chaos point inflates the wait estimate
+    deterministically: a deadline-carrying submit sheds fast, a
+    no-deadline submit still queues and completes (blocking stays the
+    default)."""
+    chaos.inject("overload.pressure", kind="pressure", rate=1.0,
+                 latency_s=30.0)
+    c = _mk()
+    try:
+        with pytest.raises(DeadlineExceededError) as ei:
+            c.submit(("k",), lambda cols: _FakeLazy(cols[0]), _cols(), 8,
+                     deadline=time.monotonic() + 1.0)
+        assert ei.value.stage == "admission"
+        fut = c.submit(("k",), lambda cols: _FakeLazy(cols[0]), _cols(), 8)
+        assert HintedFuture(fut, c).result(timeout=10.0) is not None
+    finally:
+        chaos.clear()
+        c.shutdown()
+
+
+def test_queued_segment_expired_is_shed_pre_dispatch():
+    """A segment stuck behind a slow launch whose deadline lapses is
+    shed without ever dispatching; the op ahead is untouched."""
+    gate = threading.Event()
+    b_dispatched = []
+
+    def slow(cols):
+        gate.wait(10.0)
+        return _FakeLazy(np.concatenate(cols) if len(cols) > 1 else cols[0])
+
+    def fast(cols):
+        b_dispatched.append(1)
+        return _FakeLazy(cols[0])
+
+    c = _mk(batch_window_us=100)
+    try:
+        fa = c.submit(("a",), slow, _cols(), 8)
+        time.sleep(0.05)  # let the flush thread enter slow()
+        fb = c.submit(("b",), fast, _cols(), 8,
+                      deadline=time.monotonic() + 0.15)
+        time.sleep(0.4)  # deadline lapses while 'a' blocks the loop
+        gate.set()
+        with pytest.raises(DeadlineExceededError) as ei:
+            HintedFuture(fb, c).result(timeout=5.0)
+        assert ei.value.stage == "queue"
+        assert not b_dispatched  # shed strictly pre-dispatch
+        assert HintedFuture(fa, c).result(timeout=5.0) is not None
+    finally:
+        gate.set()
+        c.shutdown()
+
+
+def test_parked_backoff_all_expired_dropped_fast():
+    """Satellite: a parked (retry-backoff) segment whose every op
+    expired must be dropped with futures resolved — not wait out the
+    backoff, not burn the remaining retry budget."""
+    def dispatch(cols):
+        raise RuntimeError("transient")
+
+    c = _mk(retry_attempts=10, retry_interval_s=5.0,
+            retry_max_backoff_s=5.0)
+    try:
+        fut = c.submit(("k",), dispatch, _cols(), 8,
+                       deadline=time.monotonic() + 0.25)
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=10.0)
+        # Without the parked-expired drop this resolves only after the
+        # ~5 s backoff (x10 attempts); with it, right at the deadline.
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        c.shutdown()
+
+
+def test_fetch_timeout_from_config_records_breaker_failure():
+    """Satellite: the hardcoded 120 s default is gone — a no-deadline
+    .result() is bounded by fetch_timeout_s, and tripping it records a
+    breaker failure + rtpu_fetch_timeouts like other completion
+    failures."""
+    gate = threading.Event()
+    health = _FakeHealth()
+    obs = Observability()
+    c = _mk(fetch_timeout_s=0.2, health=health, obs=obs)
+    try:
+        fut = c.submit(
+            ("bloom_mix",), lambda cols: _BlockingLazy(gate, cols[0]),
+            _cols(), 8,
+        )
+        hf = HintedFuture(fut, c, op="bloom_mix")
+        t0 = time.monotonic()
+        with pytest.raises(DispatchTimeoutError):
+            hf.result()
+        assert time.monotonic() - t0 < 2.0
+        assert health.failures and health.failures[0][0] == "bloom_mix"
+        assert sum(
+            int(cv.value) for _, cv in obs.fetch_timeouts.items()
+        ) == 1
+    finally:
+        gate.set()
+        c.shutdown()
+
+
+def test_deadline_bounded_wait_is_not_a_device_failure():
+    """A result wait cut short by the op's own deadline raises
+    DeadlineExceededError and does NOT feed the breaker — overload is
+    not device failure."""
+    gate = threading.Event()
+    health = _FakeHealth()
+    c = _mk(fetch_timeout_s=30.0, health=health)
+    try:
+        dl = time.monotonic() + 0.15
+        fut = c.submit(
+            ("k",), lambda cols: _BlockingLazy(gate, cols[0]), _cols(), 8,
+            deadline=dl,
+        )
+        hf = HintedFuture(fut, c, deadline=dl, op="k")
+        with pytest.raises(DeadlineExceededError) as ei:
+            hf.result()
+        assert ei.value.stage == "fetch_wait"
+        assert not health.failures
+    finally:
+        gate.set()
+        c.shutdown()
+
+
+def test_no_deadline_submit_still_blocks_at_queue_bound():
+    """Blocking backpressure remains the no-deadline default (the
+    pre-overload contract: test_backpressure.py's invariant)."""
+    gate = threading.Event()
+
+    def dispatch(cols):
+        gate.wait(5.0)
+        return _FakeLazy(np.concatenate(cols) if len(cols) > 1 else cols[0])
+
+    c = _mk(max_queued_ops=64, max_inflight=1)
+    try:
+        # Key "a" pops into the gated dispatch (flush thread blocked);
+        # key "b" stays QUEUED, holding the bound (same-key submits
+        # would join one segment and pop together, emptying the queue).
+        futs = [c.submit(("a",), dispatch, _cols(32), 32)]
+        time.sleep(0.1)  # let the flush thread enter dispatch
+        futs.append(c.submit(("b",), dispatch, _cols(40), 40))
+        done = threading.Event()
+
+        def producer():
+            futs.append(c.submit(("c",), dispatch, _cols(64), 64))
+            done.set()
+
+        threading.Thread(target=producer, daemon=True).start()
+        assert not done.wait(0.3)  # blocked, not shed
+        gate.set()
+        assert done.wait(5.0)
+        for f in futs:
+            HintedFuture(f, c).result(timeout=5.0)
+    finally:
+        gate.set()
+        c.shutdown()
+
+
+def test_deadline_bounded_queue_wait_sheds_instead_of_blocking():
+    gate = threading.Event()
+
+    def dispatch(cols):
+        gate.wait(5.0)
+        return _FakeLazy(np.concatenate(cols) if len(cols) > 1 else cols[0])
+
+    c = _mk(max_queued_ops=64, max_inflight=1)
+    try:
+        c.submit(("a",), dispatch, _cols(32), 32)
+        time.sleep(0.1)  # flush thread now parked inside dispatch
+        c.submit(("b",), dispatch, _cols(40), 40)  # queued: bound held
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceededError) as ei:
+            c.submit(("c",), dispatch, _cols(64), 64,
+                     deadline=time.monotonic() + 0.2)
+        assert ei.value.stage == "queue"
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        gate.set()
+        c.shutdown()
+
+
+# -- engine level: deadline x chaos ------------------------------------------
+
+
+class TestEngineDeadlines:
+    def test_injected_latency_converts_to_fast_deadline_error(self):
+        """Satellite: injected latency at dispatch.* + an op deadline
+        must surface as a FAST DeadlineExceededError, not a 120 s
+        hang."""
+        client = make_client()
+        try:
+            bf = client.get_bloom_filter("dl")
+            bf.try_init(10_000, 0.01)
+            keys = np.arange(32, dtype=np.uint64)
+            bf.add_all_async(keys).result(timeout=60.0)  # warm/compile
+            chaos.inject("dispatch", kind="latency", rate=1.0, seed=1,
+                         latency_s=0.5)
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceededError):
+                with client.op_deadline(100):
+                    bf.contains_all_async(keys).result()
+            assert time.monotonic() - t0 < 5.0
+            chaos.clear()
+            # The engine recovers: same op, no deadline, succeeds.
+            assert bf.contains_all(keys) == len(keys)
+        finally:
+            chaos.clear()
+            client.shutdown()
+
+    def test_acked_writes_never_shed_differential(self):
+        """Satellite soak: under fault injection + deadlines, every
+        write the caller saw acked is present afterwards (shedding is
+        strictly pre-dispatch)."""
+        client = make_client()
+        try:
+            bf = client.get_bloom_filter("acked")
+            bf.try_init(50_000, 0.01)
+            bf.add_all_async(
+                np.array([10**9], dtype=np.uint64)
+            ).result(timeout=60.0)  # warm/compile
+            chaos.inject("dispatch", kind="error", rate=0.4, seed=7)
+            acked, shed = [], 0
+            for i in range(60):
+                keys = np.arange(i * 8, i * 8 + 8, dtype=np.uint64)
+                try:
+                    with client.op_deadline(500):
+                        fut = bf.add_all_async(keys)
+                    fut.result()
+                    acked.append(keys)
+                except Exception:
+                    shed += 1
+            chaos.clear()
+            assert acked, "soak produced no acked writes"
+            for keys in acked:
+                assert bf.contains_all(keys) == len(keys), (
+                    "acked write lost under shedding"
+                )
+        finally:
+            chaos.clear()
+            client.shutdown()
+
+    def test_tenant_governor_sheds_burster_not_victim(self):
+        """Over-quota tenants shed first: the bursting tenant trips
+        TenantThrottledError while the within-quota tenant never
+        does."""
+        client = make_client(tenant_rate_limit=1_000,
+                             tenant_burst_ops=500)
+        try:
+            victim = client.get_bloom_filter("victim")
+            victim.try_init(10_000, 0.01)
+            burster = client.get_bloom_filter("burster")
+            burster.try_init(10_000, 0.01)
+            keys = np.arange(32, dtype=np.uint64)
+            victim.add_all_async(keys).result(timeout=60.0)  # warm
+            burst_shed = 0
+            for _ in range(8):  # 8 x 1024 ops back-to-back >> the quota
+                try:
+                    burster.add_all_async(
+                        np.arange(1024, dtype=np.uint64)
+                    ).result()
+                except TenantThrottledError:
+                    burst_shed += 1
+                # Victim trickles well under its own rate, mid-burst.
+                victim.contains_all_async(keys).result()
+            assert burst_shed > 0
+            snap = client._engine.governor.stats()
+            assert snap["throttled_ops"] > 0
+        finally:
+            client.shutdown()
+
+
+@pytest.mark.slow
+def test_fairness_soak_victim_keeps_throughput():
+    """Fairness soak: a within-quota tenant retains most of its solo
+    throughput while a co-tenant bursts far over the rate limit (the
+    bench's config7 fairness claim, in miniature)."""
+    client = make_client(tenant_rate_limit=4_000, tenant_burst_ops=2_000,
+                         max_queued_ops=1 << 14)
+    try:
+        victim = client.get_bloom_filter("victim")
+        victim.try_init(50_000, 0.01)
+        burster = client.get_bloom_filter("burster")
+        burster.try_init(50_000, 0.01)
+        keys = np.arange(50, dtype=np.uint64)
+        victim.add_all_async(keys).result(timeout=60.0)
+        burster.add_all_async(keys).result(timeout=60.0)
+
+        def victim_rate(duration_s):
+            # Paced at ~1000 ops/s: a quarter of the tenant quota.
+            chunks = 0
+            t_end = time.perf_counter() + duration_s
+            while time.perf_counter() < t_end:
+                victim.contains_all_async(keys).result()
+                chunks += 1
+                time.sleep(0.05)
+            return chunks / duration_s
+
+        solo = victim_rate(1.5)
+
+        stop = threading.Event()
+
+        def burst():
+            while not stop.is_set():
+                try:
+                    burster.add_all_async(
+                        np.arange(512, dtype=np.uint64)
+                    ).result()
+                except Exception:
+                    time.sleep(0.001)  # shed fast-path: don't spin hot
+
+        t = threading.Thread(target=burst, daemon=True)
+        t.start()
+        try:
+            contested = victim_rate(1.5)
+        finally:
+            stop.set()
+            t.join(timeout=10.0)
+        # The bench asserts >= 0.8 on quiet hardware; the test keeps a
+        # generous margin for CI noise while still catching a collapse.
+        assert contested >= 0.5 * solo, (solo, contested)
+    finally:
+        client.shutdown()
+
+
+# -- RESP server --------------------------------------------------------------
+
+
+class _Resp:
+    """Minimal RESP2 wire client (the test_resp_server idiom)."""
+
+    def __init__(self, host, port, timeout=10):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self._buf = b""
+
+    def cmd(self, *args):
+        out = b"*" + str(len(args)).encode() + b"\r\n"
+        for a in args:
+            if not isinstance(a, bytes):
+                a = str(a).encode()
+            out += b"$" + str(len(a)).encode() + b"\r\n" + a + b"\r\n"
+        self.sock.sendall(out)
+        return self._read()
+
+    def _recv(self):
+        data = self.sock.recv(65536)
+        if not data:
+            raise ConnectionError("closed")
+        self._buf += data
+
+    def _line(self):
+        while b"\r\n" not in self._buf:
+            self._recv()
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _read(self):
+        line = self._line()
+        t, body = line[:1], line[1:]
+        if t == b"+":
+            return body.decode()
+        if t == b"-":
+            raise RuntimeError(body.decode())
+        if t == b":":
+            return int(body)
+        if t == b"$":
+            n = int(body)
+            if n < 0:
+                return None
+            while len(self._buf) < n + 2:
+                self._recv()
+            out, self._buf = self._buf[:n], self._buf[n + 2:]
+            return out
+        if t == b"*":
+            n = int(body)
+            return None if n < 0 else [self._read() for _ in range(n)]
+        raise RuntimeError(f"bad reply {t!r}")
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture
+def served():
+    client = make_client()
+    server = RespServer(client)
+    conn = _Resp(server.host, server.port)
+    yield client, server, conn
+    conn.close()
+    server.close()
+    client.shutdown()
+
+
+class TestRespOverload:
+    def test_client_deadline_admission_shed_and_clear(self, served):
+        client, server, conn = served
+        conn.cmd("BF.RESERVE", "f", "0.01", "1000")
+        conn.cmd("BF.ADD", "f", "warm")  # compile outside the window
+        chaos.inject("overload.pressure", kind="pressure", rate=1.0,
+                     latency_s=30.0)
+        assert conn.cmd("CLIENT", "DEADLINE") == b"default"
+        assert conn.cmd("CLIENT", "DEADLINE", "50") == "OK"
+        assert conn.cmd("CLIENT", "DEADLINE") == b"50"
+        with pytest.raises(RuntimeError, match="BUSY.*deadline"):
+            conn.cmd("BF.ADD", "f", "x")
+        # CLIENT DEADLINE 0: no deadline -> no admission check -> flows.
+        assert conn.cmd("CLIENT", "DEADLINE", "0") == "OK"
+        assert conn.cmd("BF.ADD", "f", "x") in (0, 1)
+        chaos.clear()
+
+    def test_default_op_deadline_from_config(self):
+        client = make_client(op_deadline_ms=50)
+        server = RespServer(client)
+        conn = _Resp(server.host, server.port)
+        try:
+            # Warm (first-touch compile) outlives a 50 ms deadline by
+            # design — run it with the per-connection override off,
+            # then revert to the server default.
+            conn.cmd("CLIENT", "DEADLINE", "0")
+            conn.cmd("BF.RESERVE", "f", "0.01", "1000")
+            conn.cmd("BF.ADD", "f", "warm")
+            conn.cmd("CLIENT", "DEADLINE", "-1")
+            chaos.inject("overload.pressure", kind="pressure", rate=1.0,
+                         latency_s=30.0)
+            with pytest.raises(RuntimeError, match="BUSY.*deadline"):
+                conn.cmd("BF.ADD", "f", "x")
+        finally:
+            chaos.clear()
+            conn.close()
+            server.close()
+            client.shutdown()
+
+    def test_ingress_watermark_sheds_nonexempt_only(self, served):
+        client, server, conn = served
+        conn.cmd("SET", "k", "v")
+        c = client._engine.coalescer
+        server.admission_watermark = 0.5
+        # Simulate a deep queue (white-box: pressure reads _queued_ops;
+        # the idle flush thread won't touch a fabricated count with no
+        # segments queued).  Must dwarf the default max_queued_ops
+        # (8 x max_batch = 512k) to cross the watermark.
+        c._queued_ops += 1_000_000
+        try:
+            with pytest.raises(RuntimeError, match="BUSY.*overloaded"):
+                conn.cmd("GET", "k")
+            with pytest.raises(RuntimeError, match="BUSY.*overloaded"):
+                conn.cmd("BF.ADD", "f", "x")
+            # Exempt: the operator can still see and fix the overload.
+            assert conn.cmd("PING") == "PONG"
+            assert b"overload_pressure" in conn.cmd("INFO", "overload")
+            assert conn.cmd("CONFIG", "GET", "admission-watermark")
+            # MULTI/EXEC cannot bypass the door: queueing is free, the
+            # transaction is judged (and consumed) at EXEC.
+            assert conn.cmd("MULTI") == "OK"
+            assert conn.cmd("SET", "k", "w") == "QUEUED"
+            with pytest.raises(RuntimeError, match="BUSY.*transaction"):
+                conn.cmd("EXEC")
+            with pytest.raises(RuntimeError, match="without MULTI"):
+                conn.cmd("EXEC")  # consumed: EXECABORT-style, not queued
+        finally:
+            c._queued_ops -= 1_000_000
+        assert conn.cmd("GET", "k") == b"v"  # the shed SET never ran
+
+    def test_config_set_validation_and_live_apply(self, served):
+        client, server, conn = served
+        # Nonsense is rejected before anything applies.
+        for key, bad in (
+            ("op-deadline-ms", "-5"),
+            ("admission-watermark", "0"),
+            ("admission-watermark", "-0.5"),
+            ("admission-watermark", "1.5"),
+            ("fetch-timeout-ms", "0"),
+            ("tenant-rate-limit", "-1"),
+            ("client-output-buffer-limit", "-1"),
+            ("client-output-buffer-soft-seconds", "-2"),
+            ("op-deadline-ms", "abc"),
+        ):
+            with pytest.raises(RuntimeError):
+                conn.cmd("CONFIG", "SET", key, bad)
+        # Valid values apply live, to the right layer.
+        # Fractional rates are legal (the governor takes floats): the
+        # validator must be exactly as wide as the setter.
+        assert conn.cmd(
+            "CONFIG", "SET", "tenant-rate-limit", "0.5"
+        ) == "OK"
+        assert client._engine.governor.rate_limit == 0.5
+        assert conn.cmd(
+            "CONFIG", "SET", "op-deadline-ms", "250",
+            "admission-watermark", "0.75",
+            "fetch-timeout-ms", "30000",
+            "tenant-rate-limit", "5000",
+            "tenant-max-inflight", "4096",
+            "client-output-buffer-limit", "65536",
+            "client-output-buffer-soft-seconds", "2.5",
+        ) == "OK"
+        assert server.op_deadline_ms == 250
+        assert server.admission_watermark == 0.75
+        assert client._engine.coalescer.fetch_timeout_s == 30.0
+        gov = client._engine.governor
+        assert gov.rate_limit == 5000 and gov.max_inflight == 4096
+        assert server.output_buffer_limit == 65536
+        assert server.output_buffer_soft_seconds == 2.5
+        got = conn.cmd("CONFIG", "GET", "op-deadline-ms")
+        assert got == [b"op-deadline-ms", b"250"]
+
+    def test_info_overload_section(self, served):
+        _client, _server, conn = served
+        info = conn.cmd("INFO", "overload").decode()
+        for key in (
+            "overload_op_deadline_ms", "overload_admission_watermark",
+            "overload_pressure", "overload_est_wait_us",
+            "overload_shed_ops", "overload_deadline_exceeded",
+            "overload_tenant_throttled", "overload_fetch_timeouts",
+            "overload_slow_client_disconnects",
+            "overload_output_buffer_limit",
+        ):
+            assert key in info, key
+        # Default INFO includes the section too.
+        assert "# Overload" in conn.cmd("INFO").decode()
+
+    def test_slow_client_disconnected_at_output_buffer_limit(self, served):
+        client, server, conn = served
+        big = b"x" * (4 << 20)
+        conn.cmd("SET", "big", big)
+        assert conn.cmd(
+            "CONFIG", "SET", "client-output-buffer-limit", "8192",
+            "client-output-buffer-soft-seconds", "1",
+        ) == "OK"
+        # A client that requests a huge reply and never reads: the
+        # server's bounded send must disconnect it instead of parking
+        # the connection thread forever.
+        lazy = socket.create_connection(
+            (server.host, server.port), timeout=10
+        )
+        lazy.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 16384)
+        lazy.sendall(b"*2\r\n$3\r\nGET\r\n$3\r\nbig\r\n")
+        deadline = time.monotonic() + 10.0
+        killed = False
+        while time.monotonic() < deadline:
+            if server._slow_client_kills > 0:
+                killed = True
+                break
+            time.sleep(0.05)
+        assert killed, "slow client was not disconnected"
+        lazy.close()
+        info = conn.cmd("INFO", "overload").decode()
+        assert "overload_slow_client_disconnects:0" not in info
+        # A well-behaved client still gets the big value under the same
+        # limits (progress resets the stall clock).
+        assert conn.cmd("GET", "big") == big
+
+    def test_hard_only_limit_still_disconnects_underlimit_stall(self):
+        """With ONLY the hard byte limit set (soft-seconds 0), a stall
+        whose pending remainder is UNDER the limit must still fall back
+        to the socket's own timeout — not loop forever holding the
+        connection thread (the legacy sendall died under idle_timeout)."""
+        client = make_client()
+        server = RespServer(client, idle_timeout_s=1.0)
+        conn = _Resp(server.host, server.port)
+        try:
+            conn.cmd("SET", "big", b"x" * (4 << 20))
+            assert conn.cmd(
+                "CONFIG", "SET",
+                "client-output-buffer-limit", str(64 << 20),
+            ) == "OK"
+            lazy = socket.create_connection(
+                (server.host, server.port), timeout=10
+            )
+            lazy.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 16384)
+            lazy.sendall(b"*2\r\n$3\r\nGET\r\n$3\r\nbig\r\n")
+            deadline = time.monotonic() + 10.0
+            while (
+                server._slow_client_kills == 0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            assert server._slow_client_kills > 0
+            lazy.close()
+        finally:
+            conn.close()
+            server.close()
+            client.shutdown()
+
+    def test_fast_clients_unaffected_by_buffer_limits(self, served):
+        _client, server, conn = served
+        conn.cmd("CONFIG", "SET", "client-output-buffer-limit", "4096",
+                 "client-output-buffer-soft-seconds", "1")
+        conn.cmd("SET", "k", "v" * 100_000)
+        for _ in range(5):
+            assert len(conn.cmd("GET", "k")) == 100_000
+        assert server._slow_client_kills == 0
