@@ -1,0 +1,48 @@
+"""Project-invariant correctness tooling (ISSUE 8 tentpole).
+
+Review alone does not scale: PRs 5-7 each burned multiple hardening
+rounds on the SAME concurrency defect classes (blocking work under a
+dispatch/engine lock, cross-thread socket-timeout mutation, name-keyed
+dicts that leak until a rising-floor prune is retrofitted, config knobs
+whose CONFIG SET arm or INFO mention is missing, unbounded metric
+labels).  Redisson-class systems ship machine-checked invariants for
+exactly this reason — FreeBSD's witness(4) for lock order, TSan-style
+happens-before checks — so this package encodes the review findings as
+checks that can never regress:
+
+- :mod:`redisson_tpu.analysis.rtpulint` — an AST-based static analyzer
+  (stdlib ``ast`` only) with project-specific rules RT001-RT006, each
+  distilled from a named review finding (docs/static_analysis.md maps
+  rule -> originating bug).  Run it with
+  ``python -m redisson_tpu.analysis redisson_tpu/``; suppress a
+  deliberate violation inline with
+  ``# rtpulint: disable=RTnnn <reason>`` (the reason is mandatory).
+- :mod:`redisson_tpu.analysis.witness` — an opt-in
+  (``RTPU_LOCK_WITNESS=1``) runtime lock-order witness: the named locks
+  in coalescer/engines/resp/tenancy/nearcache are wrapped at creation,
+  the per-thread acquisition graph is recorded, and cycles (potential
+  deadlock) or blocking calls made while a named lock is held fail the
+  test suite with the offending stack pairs.
+"""
+
+# Lazy re-exports (PEP 562): every production module imports
+# `analysis.witness` at module load to name its locks, and the
+# witness's zero-overhead-when-disabled contract would ring hollow if
+# that import dragged the whole AST analyzer (ast/tokenize/io) into
+# every serving process.  The analyzer loads only when something
+# actually lints (the CLI, tests).
+_ANALYZER_EXPORTS = frozenset((
+    "RULES", "Violation", "lint_file", "lint_paths", "lint_source",
+))
+
+__all__ = sorted(_ANALYZER_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name in _ANALYZER_EXPORTS:
+        from redisson_tpu.analysis import rtpulint
+
+        return getattr(rtpulint, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

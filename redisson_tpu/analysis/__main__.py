@@ -1,0 +1,59 @@
+"""CLI: ``python -m redisson_tpu.analysis [paths...]``.
+
+Exit status: 0 when every finding is suppressed (or none), 1 when any
+unsuppressed violation remains, 2 on usage errors.  CI runs this over
+``redisson_tpu/`` in the tier-1 workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from redisson_tpu.analysis.rtpulint import RULES, lint_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m redisson_tpu.analysis",
+        description="rtpulint: project-invariant static analyzer "
+                    "(rules RT001-RT006; see docs/static_analysis.md)",
+    )
+    ap.add_argument("paths", nargs="*", default=["redisson_tpu"],
+                    help="files/directories to lint (default: redisson_tpu)")
+    ap.add_argument("--rule", action="append", dest="rules", metavar="RTnnn",
+                    help="run only these rules (repeatable)")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+    if args.rules:
+        bad = [r for r in args.rules if r not in RULES]
+        if bad:
+            print(f"unknown rule(s): {', '.join(bad)}", file=sys.stderr)
+            return 2
+
+    violations = lint_paths(args.paths or ["redisson_tpu"],
+                            rules=args.rules)
+    live = [v for v in violations if not v.suppressed]
+    suppressed = [v for v in violations if v.suppressed]
+    for v in live:
+        print(v.format())
+    if args.show_suppressed:
+        for v in suppressed:
+            print(v.format())
+    print(
+        f"rtpulint: {len(live)} violation(s), "
+        f"{len(suppressed)} suppressed",
+        file=sys.stderr,
+    )
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

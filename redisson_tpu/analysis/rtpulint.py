@@ -1,0 +1,782 @@
+"""rtpulint — AST-based static analyzer for redisson_tpu's own invariants.
+
+Every rule below is a review finding from PRs 3-7 turned into a check
+(docs/static_analysis.md links each rule to the CHANGES.md entry it was
+distilled from):
+
+RT001  No blocking call (``time.sleep``, socket ``sendall``/``recv``,
+       ``select.select``, ``.result()``, ``.block_until_ready()``,
+       ``device_put``, device row I/O, ``drain()``, jit compilation)
+       inside a ``with <lock>:`` body — or between ``<lock>.acquire()``
+       and ``<lock>.release()`` — in the dispatch/engine/cache/serve/
+       tenancy modules.  Condition ``.wait()``/``.wait_for()`` are
+       exempt (they RELEASE the lock while blocked).
+RT002  No ``settimeout()`` on a socket reachable through shared state
+       (an attribute): the socket's timeout is owned by its reader
+       thread; a cross-thread mutation shrinks an unrelated wait.
+       Sockets held in locals (created and owned by this function) are
+       fine.
+RT003  Chaos imports must be module-top (a per-call ``sys.modules``
+       lookup on the DISABLED path taxes every dispatch), and
+       ``chaos.fire(...)`` call sites must be guarded by
+       ``if chaos.ENABLED:`` (the zero-overhead-when-disabled
+       contract).
+RT004  Every config key the RESP layer serves live (the CONFIG GET/SET
+       table) must have a bounds-validation arm and an INFO section
+       mention.  Boot-only ``Config`` fields are out of scope — they
+       never enter the served table.
+RT005  Metric label values must be plain values routed through the
+       bounded-cardinality registry helpers: no f-string/concat/
+       ``.format`` label elements (composite labels defeat the
+       per-family cardinality cap), and no ``Family(...)`` construction
+       outside the registry itself.
+RT006  A module-level dict that grows under non-constant (object/tenant
+       name) keys must have a prune path in the same module
+       (``pop``/``del``/``clear`` or a ``*prune*`` function touching
+       it) — the rising-floor idiom.  Name-churn workloads otherwise
+       leak one entry per name ever seen.
+
+Suppression: ``# rtpulint: disable=RT001 <reason>`` on the offending
+line, or alone on the line directly above it.  The reason is mandatory
+— a bare disable is itself reported (RT000).  Multiple rules:
+``disable=RT001,RT005 <reason>``.
+
+Fixtures can force a module role with a ``# rtpulint: role=<role>``
+comment in the first ten lines (roles: dispatch, engine, cache, serve,
+tenancy, chaos, host).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+RULES = {
+    "RT000": "malformed rtpulint suppression (missing reason / unknown rule)",
+    "RT001": "blocking call while holding a lock",
+    "RT002": "settimeout() on a shared-state socket",
+    "RT003": "chaos import not module-top / unguarded chaos.fire()",
+    "RT004": "served config key without validation arm or INFO mention",
+    "RT005": "metric label outside the bounded-cardinality helpers",
+    "RT006": "module-level name-keyed dict without a prune path",
+}
+
+# Roles a rule applies to.  "*" = every non-test module.
+_RULE_ROLES = {
+    "RT001": {"dispatch", "engine", "cache", "serve", "tenancy"},
+    "RT002": {"serve"},
+    "RT003": {"*"},
+    "RT004": {"*"},  # self-scoping: only fires where a config table lives
+    "RT005": {"*"},
+    "RT006": {"*"},
+}
+
+_ROLE_BY_PATH = (
+    ("executor", "dispatch"),
+    ("objects", "engine"),
+    ("cache", "cache"),
+    ("serve", "serve"),
+    ("tenancy", "tenancy"),
+    ("chaos", "chaos"),
+    ("analysis", "analysis"),
+)
+
+
+@dataclass
+class Violation:
+    path: str
+    line: int
+    rule: str
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def format(self) -> str:
+        tag = " [suppressed: %s]" % self.reason if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{tag}"
+
+
+# -- suppression / directive parsing ------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*rtpulint:\s*disable=([A-Z0-9,]+)\s*(.*)$"
+)
+_ROLE_RE = re.compile(r"#\s*rtpulint:\s*role=([a-z]+)")
+
+
+def _scan_comments(source: str):
+    """(suppressions, role, bad_suppressions).
+
+    ``suppressions``: line -> list[(frozenset_of_rules, reason)].  A
+    comment sharing a line with code applies to that line; a
+    comment-only line applies to the next line (so a long offending
+    line can carry its reason above itself)."""
+    suppressions: dict[int, list] = {}
+    bad: list[tuple[int, str]] = []
+    role: Optional[str] = None
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return suppressions, role, bad
+    code_lines = set()
+    for tok in tokens:
+        if tok.type in (
+            tokenize.COMMENT, tokenize.NL, tokenize.NEWLINE,
+            tokenize.INDENT, tokenize.DEDENT, tokenize.ENDMARKER,
+        ):
+            continue
+        for ln in range(tok.start[0], tok.end[0] + 1):
+            code_lines.add(ln)
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        line = tok.start[0]
+        m = _ROLE_RE.search(tok.string)
+        if m and line <= 10:
+            role = m.group(1)
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = frozenset(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = m.group(2).strip()
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            bad.append((line, f"unknown rule(s) {', '.join(sorted(unknown))}"))
+            continue
+        if not reason:
+            bad.append((line, "suppression has no reason"))
+            continue
+        target = line if line in code_lines else line + 1
+        suppressions.setdefault(target, []).append((rules, reason))
+    return suppressions, role, bad
+
+
+# -- shared AST helpers -------------------------------------------------------
+
+
+def _terminal_name(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _base_name(node) -> Optional[str]:
+    """Leftmost identifier of an attribute chain (``self._c.fire`` -> 'self')."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+_LOCKISH_RE = re.compile(r"lock|mutex|(^|_)(cv|cond)$|condition")
+
+
+def _lockish(node) -> Optional[str]:
+    """Dotted-ish display name when ``node`` looks like a lock object."""
+    ident = _terminal_name(node)
+    if ident is None:
+        return None
+    if _LOCKISH_RE.search(ident.lower().strip("_")):
+        return ident
+    return None
+
+
+def _add_parents(tree) -> None:
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            child._rtpu_parent = parent  # type: ignore[attr-defined]
+
+
+def _ancestors(node):
+    n = getattr(node, "_rtpu_parent", None)
+    while n is not None:
+        yield n
+        n = getattr(n, "_rtpu_parent", None)
+
+
+def _walk_no_defs(node):
+    """ast.walk that does not descend into nested function/class/lambda
+    bodies (code that merely DEFINES deferred work under a lock is not
+    executing it there)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# -- RT001: blocking call while holding a lock --------------------------------
+
+# Attribute names whose CALL blocks the thread (or compiles).  ``wait``
+# and ``wait_for`` are deliberately absent: a Condition wait RELEASES
+# the lock while blocked, which is the correct idiom under a lock.
+_BLOCKING_ATTRS = {
+    "sendall": "socket send",
+    "recv": "socket recv",
+    "recv_into": "socket recv",
+    "accept": "socket accept",
+    "connect": "socket connect",
+    "result": "future/result wait",
+    "block_until_ready": "device sync",
+    "device_put": "H2D transfer",
+    "read_row": "device row read",
+    "write_row": "device row write",
+    "drain": "coalescer drain barrier",
+    "_drain": "coalescer drain barrier",
+    "_jit": "jit compilation",
+}
+
+
+def _blocking_call(call: ast.Call) -> Optional[str]:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        attr = func.attr
+        if attr == "sleep" and _base_name(func.value) in (
+            "time", "_time",
+        ):
+            return "time.sleep"
+        if attr == "select" and _base_name(func.value) in (
+            "select", "selectors",
+        ):
+            return "select.select"
+        if attr in _BLOCKING_ATTRS:
+            # ``str.join``-style false positives: constant receivers
+            # never block.
+            if isinstance(func.value, ast.Constant):
+                return None
+            return _BLOCKING_ATTRS[attr]
+        return None
+    if isinstance(func, ast.Name):
+        if func.id in ("sleep",):
+            return "sleep"
+        if func.id in ("device_put",):
+            return "H2D transfer"
+        if func.id == "_jit":
+            return "jit compilation"
+    return None
+
+
+def _check_rt001(ctx) -> None:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _rt001_block(ctx, node.body, {})
+
+
+def _rt001_block(ctx, stmts, held: dict) -> None:
+    """Scan a statement list with ``held`` = {lock name: line}."""
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # nested definitions start with nothing held
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            added = []
+            for item in stmt.items:
+                name = _lockish(item.context_expr)
+                if name is not None and name not in held:
+                    held[name] = stmt.lineno
+                    added.append(name)
+            _rt001_block(ctx, stmt.body, held)
+            for name in added:
+                held.pop(name, None)
+            continue
+        # acquire()/release() pairs at statement level.
+        call = None
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+        if call is not None and isinstance(call.func, ast.Attribute):
+            recv = _lockish(call.func.value)
+            if recv is not None:
+                if call.func.attr == "acquire":
+                    held.setdefault(recv, stmt.lineno)
+                    continue
+                if call.func.attr == "release":
+                    held.pop(recv, None)
+                    continue
+        if held:
+            _rt001_scan_expr(ctx, stmt, held)
+        # Recurse into compound statements (their bodies inherit held).
+        for block in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, block, None)
+            if sub:
+                _rt001_block(ctx, sub, held)
+        for handler in getattr(stmt, "handlers", ()) or ():
+            _rt001_block(ctx, handler.body, held)
+
+
+def _rt001_scan_expr(ctx, stmt, held: dict) -> None:
+    """Flag blocking calls in the EXPRESSIONS of one statement (its
+    nested blocks are scanned by _rt001_block's recursion)."""
+    exprs = []
+    for f in ast.iter_fields(stmt):
+        name, value = f
+        if name in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.AST):
+            exprs.append(value)
+        elif isinstance(value, list):
+            exprs.extend(v for v in value if isinstance(v, ast.AST))
+    for root in exprs:
+        nodes = [root] if isinstance(root, ast.Call) else []
+        nodes += list(_walk_no_defs(root))
+        for n in nodes:
+            if not isinstance(n, ast.Call):
+                continue
+            func = n.func
+            if isinstance(func, ast.Attribute) and func.attr in (
+                "wait", "wait_for", "notify", "notify_all",
+            ):
+                continue
+            what = _blocking_call(n)
+            if what is not None:
+                lock, since = next(iter(held.items()))
+                ctx.report(
+                    "RT001", n.lineno,
+                    f"blocking call ({what}) while holding lock "
+                    f"{lock!r} (held since line {since}); move the "
+                    f"blocking work outside the critical section",
+                )
+
+
+# -- RT002: settimeout on a shared-state socket -------------------------------
+
+
+def _check_rt002(ctx) -> None:
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "settimeout"):
+            continue
+        recv = node.func.value
+        # A local variable (a socket this function created/owns) may
+        # set its own timeout; anything reached through an attribute
+        # (self.sock, ctx.sock) is shared state another thread's
+        # reader loop relies on.
+        if isinstance(recv, ast.Attribute):
+            ctx.report(
+                "RT002", node.lineno,
+                "settimeout() on a socket reachable through shared "
+                "state: the timeout belongs to the socket's reader "
+                "thread — wait with select() instead (see "
+                "_ConnCtx._send_bounded)",
+            )
+
+
+# -- RT003: chaos import/guard discipline -------------------------------------
+
+
+def _chaos_aliases(tree) -> set:
+    aliases = {"chaos", "_chaos"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                node.module.startswith("redisson_tpu"):
+            for a in node.names:
+                if a.name == "chaos":
+                    aliases.add(a.asname or a.name)
+    return aliases
+
+
+def _is_chaos_import(node) -> bool:
+    if isinstance(node, ast.Import):
+        return any(
+            a.name == "redisson_tpu.chaos"
+            or a.name.startswith("redisson_tpu.chaos.")
+            for a in node.names
+        )
+    if isinstance(node, ast.ImportFrom):
+        mod = node.module or ""
+        if mod == "redisson_tpu":
+            return any(a.name == "chaos" for a in node.names)
+        return mod == "redisson_tpu.chaos" or \
+            mod.startswith("redisson_tpu.chaos.")
+    return False
+
+
+def _guarded_by_enabled(node, aliases: set) -> bool:
+    """True when an ancestor ``if`` tests ``<alias>.ENABLED``, or the
+    enclosing function opens with ``if not <alias>.ENABLED: return``."""
+    func = None
+    for anc in _ancestors(node):
+        if isinstance(anc, ast.If) and _mentions_enabled(anc.test, aliases):
+            return True
+        if func is None and isinstance(
+            anc, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            func = anc
+    if func is not None:
+        for stmt in func.body:
+            if getattr(stmt, "lineno", 10**9) >= node.lineno:
+                break
+            if (
+                isinstance(stmt, ast.If)
+                and isinstance(stmt.test, ast.UnaryOp)
+                and isinstance(stmt.test.op, ast.Not)
+                and _mentions_enabled(stmt.test.operand, aliases)
+                and any(isinstance(s, (ast.Return, ast.Raise))
+                        for s in stmt.body)
+            ):
+                return True
+    return False
+
+
+def _mentions_enabled(test, aliases: set) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Attribute) and n.attr == "ENABLED" and \
+                isinstance(n.value, ast.Name) and n.value.id in aliases:
+            return True
+    return False
+
+
+def _check_rt003(ctx) -> None:
+    if ctx.role == "chaos":
+        return  # the engine itself is exempt
+    aliases = _chaos_aliases(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if _is_chaos_import(node) and any(
+            isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+            for a in _ancestors(node)
+        ):
+            ctx.report(
+                "RT003", node.lineno,
+                "chaos imported inside a function: hoist to module top "
+                "(per-call sys.modules lookups tax the DISABLED path)",
+            )
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "fire"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in aliases
+        ):
+            if not _guarded_by_enabled(node, aliases):
+                ctx.report(
+                    "RT003", node.lineno,
+                    f"{node.func.value.id}.fire() without an "
+                    f"'if {node.func.value.id}.ENABLED:' guard "
+                    "(zero-overhead-when-disabled contract)",
+                )
+
+
+# -- RT004: served config surface coherence -----------------------------------
+
+
+def _dict_literal_keys(d: ast.Dict):
+    for k in d.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            yield k.value, k.lineno
+
+
+def _str_constants(node) -> set:
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+    return out
+
+
+def _check_rt004(ctx) -> None:
+    keys: list[tuple[str, int]] = []
+    validated: set = set()
+    info_strs: set = set()
+    classes = [n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)]
+    funcs = [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for cls in classes:
+        for stmt in cls.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            targets = [t.id for t in stmt.targets if isinstance(t, ast.Name)]
+            if not targets:
+                continue
+            name = targets[0]
+            if name.endswith("_CONFIG_KEYS") or name == "_CONFIG_KEYS":
+                if isinstance(stmt.value, ast.Dict):
+                    keys.extend(_dict_literal_keys(stmt.value))
+            elif "KEYS" in name:
+                # Membership sets routed through a validator
+                # (_OVERLOAD_KEYS -> _validate_overload_config).
+                validated |= _str_constants(stmt.value)
+    for fn in funcs:
+        if fn.name.endswith("_config_table_init"):
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Dict):
+                    keys.extend(_dict_literal_keys(n))
+                elif (
+                    isinstance(n, ast.Assign)
+                    and isinstance(n.targets[0], ast.Subscript)
+                    and isinstance(n.targets[0].slice, ast.Constant)
+                    and isinstance(n.targets[0].slice.value, str)
+                ):
+                    keys.append((n.targets[0].slice.value, n.lineno))
+        if "validate" in fn.name or fn.name == "_cmd_CONFIG":
+            validated |= _str_constants(fn)
+        if fn.name == "_cmd_INFO" or "_info" in fn.name:
+            info_strs |= _str_constants(fn)
+    if not keys:
+        return
+    seen = set()
+    for key, line in keys:
+        if key in seen:
+            continue
+        seen.add(key)
+        missing = []
+        if not _rt004_validated(key, validated):
+            missing.append("no CONFIG SET bounds-validation arm")
+        if not _rt004_in_info(key, info_strs):
+            missing.append("no INFO section mention")
+        if missing:
+            ctx.report(
+                "RT004", line,
+                f"served config key '{key}': " + " and ".join(missing),
+            )
+
+
+def _rt004_validated(key: str, validated: set) -> bool:
+    if key in validated:
+        return True
+    # Prefix arms ("slowlog-", "nearcache-" families).
+    return any(
+        v.endswith("-") and key.startswith(v) for v in validated
+    )
+
+
+def _rt004_in_info(key: str, info_strs: set) -> bool:
+    norm = key.replace("-", "_")
+    toks = norm.split("_")
+    needles = ["_".join(toks[i:]) for i in range(len(toks))
+               if len(toks) - i >= 2]
+    if not needles:
+        needles = [norm]
+    return any(
+        any(needle in s for s in info_strs) for needle in needles
+    )
+
+
+# -- RT005: bounded-cardinality metric labels ---------------------------------
+
+
+def _dynamic_string(node) -> bool:
+    if isinstance(node, ast.JoinedStr):
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.Add, ast.Mod)
+    ):
+        # "a" + x / "%s" % x label building.
+        return any(
+            isinstance(n, ast.Constant) and isinstance(n.value, str)
+            for n in ast.walk(node)
+        )
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "format":
+        return True
+    return False
+
+
+def _check_rt005(ctx) -> None:
+    in_registry = ctx.rel.replace(os.sep, "/").endswith("obs/registry.py")
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if (
+            not in_registry
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "Family"
+        ):
+            ctx.report(
+                "RT005", node.lineno,
+                "Family(...) constructed outside obs/registry.py: use "
+                "registry.counter/gauge/histogram (they enforce the "
+                "cardinality cap and Prometheus typing)",
+            )
+            continue
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("inc", "observe", "set")
+            and node.args
+            and isinstance(node.args[0], ast.Tuple)
+        ):
+            for el in node.args[0].elts:
+                if _dynamic_string(el):
+                    ctx.report(
+                        "RT005", el.lineno,
+                        "dynamically-built metric label value "
+                        "(f-string/concat/format): composite labels "
+                        "defeat the per-family cardinality cap — pass "
+                        "the raw value as its own label dimension",
+                    )
+
+
+# -- RT006: module-level name-keyed dicts need a prune path -------------------
+
+
+def _check_rt006(ctx) -> None:
+    module_dicts: dict[str, int] = {}
+    for stmt in ctx.tree.body:
+        target = None
+        value = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            target, value = stmt.targets[0].id, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name):
+            target, value = stmt.target.id, stmt.value
+        if target is None or value is None:
+            continue
+        is_dict = isinstance(value, ast.Dict) or (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id in ("dict", "defaultdict", "OrderedDict")
+        )
+        if is_dict:
+            module_dicts[target] = stmt.lineno
+    if not module_dicts:
+        return
+    grows: set = set()
+    pruned: set = set()
+    for node in ast.walk(ctx.tree):
+        # X[expr] = ... with a non-constant key.
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in module_dicts and \
+                        not isinstance(t.slice, ast.Constant):
+                    grows.add(t.value.id)
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in module_dicts:
+            if node.func.attr == "setdefault" and node.args and \
+                    not isinstance(node.args[0], ast.Constant):
+                grows.add(node.func.value.id)
+            if node.func.attr in ("pop", "popitem", "clear"):
+                pruned.add(node.func.value.id)
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in module_dicts:
+                    pruned.add(t.value.id)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                "prune" in node.name:
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name) and n.id in module_dicts:
+                    pruned.add(n.id)
+    for name in sorted(grows - pruned):
+        ctx.report(
+            "RT006", module_dicts[name],
+            f"module-level dict {name!r} grows under non-constant keys "
+            "but has no prune path (pop/del/clear or a *prune* "
+            "function): name-churn leaks one entry per name forever — "
+            "use the rising-floor idiom (see SketchNearCache._epochs)",
+        )
+
+
+_CHECKS = {
+    "RT001": _check_rt001,
+    "RT002": _check_rt002,
+    "RT003": _check_rt003,
+    "RT004": _check_rt004,
+    "RT005": _check_rt005,
+    "RT006": _check_rt006,
+}
+
+
+# -- driver -------------------------------------------------------------------
+
+
+@dataclass
+class _FileCtx:
+    path: str
+    rel: str
+    role: str
+    tree: ast.AST
+    suppressions: dict
+    violations: list = field(default_factory=list)
+
+    def report(self, rule: str, line: int, message: str) -> None:
+        for rules, reason in self.suppressions.get(line, ()):
+            if rule in rules:
+                self.violations.append(Violation(
+                    self.rel, line, rule, message,
+                    suppressed=True, reason=reason,
+                ))
+                return
+        self.violations.append(Violation(self.rel, line, rule, message))
+
+
+def _role_of(rel: str) -> str:
+    parts = rel.replace(os.sep, "/").split("/")
+    for marker, role in _ROLE_BY_PATH:
+        if marker in parts[:-1]:
+            return role
+    return "host"
+
+
+def lint_source(source: str, rel: str = "<string>",
+                role: Optional[str] = None,
+                rules: Optional[Iterable[str]] = None) -> list:
+    """Lint one source string; returns [Violation] (suppressed
+    included, flagged)."""
+    suppressions, directive_role, bad = _scan_comments(source)
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as e:
+        return [Violation(rel, e.lineno or 1, "RT000",
+                          f"syntax error: {e.msg}")]
+    _add_parents(tree)
+    eff_role = role or directive_role or _role_of(rel)
+    ctx = _FileCtx(rel, rel, eff_role, tree, suppressions)
+    for line, why in bad:
+        ctx.violations.append(Violation(rel, line, "RT000", why))
+    wanted = set(rules) if rules else set(_CHECKS)
+    for rule, check in _CHECKS.items():
+        if rule not in wanted:
+            continue
+        applies = _RULE_ROLES[rule]
+        if "*" in applies or eff_role in applies:
+            check(ctx)
+    ctx.violations.sort(key=lambda v: (v.line, v.rule))
+    return ctx.violations
+
+
+def lint_file(path: str, root: Optional[str] = None,
+              rules: Optional[Iterable[str]] = None) -> list:
+    rel = os.path.relpath(path, root) if root else path
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, rel=rel, rules=rules)
+
+
+def _iter_py(path: str):
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = [
+            d for d in dirnames
+            if d not in ("__pycache__", ".git", "fixtures")
+        ]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[Iterable[str]] = None) -> list:
+    out = []
+    for path in paths:
+        for fp in _iter_py(path):
+            out.append((fp, lint_file(fp, rules=rules)))
+    violations = [v for _, vs in out for v in vs]
+    return violations

@@ -49,6 +49,7 @@ import jax  # already a transitive import (tpu_executor): free here
 import numpy as np
 
 from redisson_tpu import chaos as _chaos
+from redisson_tpu.analysis import witness as _witness
 from redisson_tpu.executor.failures import (
     DeadlineExceededError,
     DispatchTimeoutError,
@@ -260,7 +261,9 @@ class BatchCoalescer:
         self._adaptive = adaptive_inflight
         self._inflight_limit = self._max_inflight_cfg
         self._uncollected = 0
-        self._inflight_cv = threading.Condition(threading.Lock())
+        self._inflight_cv = threading.Condition(
+            _witness.named(threading.Lock(), "coalescer.inflight")
+        )
         self._good_streak = 0
         # Retirement thresholds (s): measured on the tunneled v5e —
         # pipelined launches retire in 10-50 ms in the fast regime;
@@ -278,7 +281,10 @@ class BatchCoalescer:
         self._open: dict = {}
         self._pool_tail: dict = {}
         self._hurry = False  # a caller is blocking: drain the queue now
-        self._lock = threading.Lock()
+        # Witness-named (analysis/witness.py): lock-order + blocking
+        # discipline on the queue lock is checked at test time under
+        # RTPU_LOCK_WITNESS=1; named() is identity when it is off.
+        self._lock = _witness.named(threading.Lock(), "coalescer.queue")
         self._wake = threading.Condition(self._lock)
         # Producers blocked on the queue bound wait here; notified as
         # segments pop for dispatch.  FIFO tickets: without ordering, a
@@ -443,16 +449,41 @@ class BatchCoalescer:
         ingress once this crosses its watermark."""
         return self._queued_ops / max(1, self.max_queued_ops)
 
+    def _phase_service_s(self) -> float:
+        """Per-launch service estimate with the link-phase correction
+        (ROADMAP overload item (a)): the flush-to-retire EWMA is the
+        admission base, but its ~5-sample constant trails a link-phase
+        flip, so for the first seconds after one the estimator
+        under-admitted (stale-fast base in the new slow phase) or
+        over-admitted nothing and SHED healthy traffic (stale-slow base
+        in the new fast phase).  ``merge_cap()``'s put-RT EWMA is the
+        faster phase signal — slow samples always count and its ~4-
+        sample constant flips within a couple of launches — so it
+        corrects the base in BOTH directions: a slow put-RT FLOORS the
+        service estimate (a launch cannot retire faster than the link
+        round trip it now costs), a fast put-RT under a stale-slow base
+        CAPS it near the fast-phase bound."""
+        svc = self._service_ewma_s
+        rt = self._put_rt_ewma
+        if svc <= 0.0 or rt <= 0.0:
+            return svc
+        if rt > self.slow_launch_s:
+            return max(svc, rt)
+        if rt < self.fast_launch_s and svc > self.slow_launch_s:
+            return max(rt, self.fast_launch_s)
+        return svc
+
     def estimate_wait_s(self) -> float:
         """Admission-control estimate of the queue wait a NEW op faces:
         launches ahead of it (queued ops at the observed ops-per-launch,
-        plus dispatched-but-uncollected) times the flush-to-retire EWMA,
-        divided by the live pipelining window.  Zero until the first
-        launch retires (an idle engine admits everything).  The
-        ``overload.pressure`` chaos point inflates the estimate
-        deterministically (chaos.bias) so shedding is drivable in
-        tests without real load."""
-        svc = self._service_ewma_s
+        plus dispatched-but-uncollected) times the phase-corrected
+        flush-to-retire EWMA (see _phase_service_s), divided by the
+        live pipelining window.  Zero until the first launch retires
+        (an idle engine admits everything).  The ``overload.pressure``
+        chaos point inflates the estimate deterministically
+        (chaos.bias) so shedding is drivable in tests without real
+        load."""
+        svc = self._phase_service_s()
         if svc <= 0.0:
             est = 0.0
         else:

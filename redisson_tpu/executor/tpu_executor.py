@@ -39,6 +39,7 @@ from redisson_tpu.ops import hll as hll_ops
 from redisson_tpu.tenancy import SizeClassPool
 
 
+# rtpulint: disable=RT006 keyed by Mesh topology (a handful per process, meshes hash by content), not by object/tenant name — bounded by construction
 _REPLICATORS: dict = {}
 
 # Device-side scan chunking: ONE launch for arbitrarily large batches
@@ -1555,10 +1556,23 @@ def _nops_of(name: str, args) -> int:
     return best
 
 
+# Row-maintenance methods EXEMPT from the direct-dispatch deadline shed:
+# they run inside compound engine operations (delete's detach→zero,
+# migration's read→write→zero, reconcile's write-back, snapshots) where
+# an abort between steps would tear state — a detached-but-unzeroed row
+# could be reallocated carrying stale bits.  Serving-path ops (the
+# bloom/hll/bitset/cms dispatch families) all shed.
+_DEADLINE_EXEMPT = frozenset(("read_row", "write_row", "zero_row"))
+
+
 def _locked(fn):
     import functools
 
-    from redisson_tpu.executor.failures import ExecutorRetiredError
+    from redisson_tpu import overload as _ovl
+    from redisson_tpu.executor.failures import (
+        DeadlineExceededError,
+        ExecutorRetiredError,
+    )
 
     name = fn.__name__
     annotation = "rtpu:" + name  # device-trace label (one str, not per call)
@@ -1566,12 +1580,42 @@ def _locked(fn):
     # allocation): rules can target one method ("dispatch.bloom_mixed")
     # or the whole boundary ("dispatch").
     fault_point = "dispatch." + name
+    sheddable = name not in _DEADLINE_EXEMPT
+
+    def _shed_expired(self, args, stage: str) -> None:
+        """Direct-dispatch deadline shed (ROADMAP overload item (c)):
+        with no coalescer in front, the dispatch lock IS the queue — an
+        op whose deadline lapsed must shed before the device sees it,
+        exactly like the coalescer's pre-dispatch sweep.  Strictly
+        pre-dispatch, so no acked write is ever shed."""
+        nops = _nops_of(name, args)
+        obs = self.obs
+        if obs is not None:
+            obs.shed_ops.inc(("deadline",), nops)
+            obs.deadline_exceeded.inc(("direct",), nops)
+        raise DeadlineExceededError(
+            f"op deadline expired {stage} direct dispatch "
+            f"({name}, {nops} ops)", stage="direct",
+        )
 
     @functools.wraps(fn)
     def wrapper(self, *args, **kwargs):
+        deadline = _ovl.current_deadline() if sheddable else None
+        if deadline is not None and time.monotonic() >= deadline:
+            _shed_expired(self, args, "before")
         with self._dispatch_lock:
             if _chaos.ENABLED:
                 _chaos.fire(fault_point)
+            # Re-check after the lock wait: a long queue behind another
+            # thread's dispatches may have outlived the budget.  Nested
+            # wrapped calls (_dispatch_recording) are mid-compound-op
+            # and never shed — the outermost check governed admission.
+            if (
+                deadline is not None
+                and not getattr(self, "_dispatch_recording", False)
+                and time.monotonic() >= deadline
+            ):
+                _shed_expired(self, args, "waiting for the lock of")
             # A live change_topology may have swapped this executor out
             # while the caller was blocked on the lock (callers read
             # ``engine.executor`` BEFORE acquiring it).  Running the old

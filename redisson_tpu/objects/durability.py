@@ -29,6 +29,7 @@ from typing import Optional
 import numpy as np
 
 from redisson_tpu import chaos as _chaos
+from redisson_tpu.analysis import witness as _witness
 
 _DUMP_VERSION = 2
 _DUMP_MAGIC = b"RTPU"
@@ -481,6 +482,7 @@ class SketchDurabilityMixin:
                             f"reshard-restore needs an empty keyspace"
                         )
                     entry.expire_at = t.get("expire_at")
+                    # rtpulint: disable=RT001 reshard-restore must be atomic vs concurrent lookups/creates: both locks stay held for the whole install or a half-restored keyspace becomes visible (BUSYKEY refusal above is the fast path out)
                     self.executor.write_row(
                         entry.pool, entry.row, getter(int(t["row"]))
                     )
@@ -542,7 +544,12 @@ class SketchDurabilityMixin:
         from redisson_tpu.executor.tpu_executor import TpuCommandExecutor
 
         with self.registry._lock:
-            self._drain()
+            with _witness.allow_blocking(
+                "swap protocol: drain blocks under the registry lock "
+                "by design (see change_topology docstring step 1-2)"
+            ):
+                # rtpulint: disable=RT001 the documented swap protocol: registry lock blocks NEW lookups while queued ops drain on the old layout — draining outside the lock would let a post-drain op capture the old executor mid-swap
+                self._drain()
             old_exec = self.executor
             old_thresh = getattr(
                 self.config.tpu_sketch, "mbit_threshold_words", 0

@@ -23,6 +23,9 @@ from typing import Optional
 
 import numpy as np
 
+from redisson_tpu import chaos as _chaos
+from redisson_tpu import overload as _ovl
+from redisson_tpu.analysis import witness as _witness
 from redisson_tpu.executor import LazyResult, TpuCommandExecutor
 from redisson_tpu.objects.durability import SketchDurabilityMixin
 from redisson_tpu.ops import golden
@@ -49,7 +52,7 @@ class TopKStore:
     exactness, so the table only needs to not LOSE heavy keys."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _witness.named(threading.Lock(), "engine.topk")
         self._tables: dict[str, dict] = {}
 
     def configure(self, name: str, k: int) -> None:
@@ -366,7 +369,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         )
         self.health.reconcile_cb = self._reconcile_kind
         self._mirrors: dict = {}  # name -> degraded-mode mirror
-        self._mirror_lock = threading.RLock()
+        self._mirror_lock = _witness.named(
+            threading.RLock(), "engine.mirror"
+        )
         # Bumped (under the lock) whenever reconcile writes mirrors back
         # to the device: a seed row read before the bump may predate the
         # write-back and must be discarded (see _degraded).
@@ -376,8 +381,6 @@ class TpuSketchEngine(SketchDurabilityMixin):
         # The closure is remembered so shutdown() can unhook it — a
         # module-global observer would otherwise pin this engine (and
         # its device pools) past shutdown.
-        from redisson_tpu import chaos as _chaos
-
         self._chaos_observer = (
             lambda point, kind: self.obs.faults_injected.inc((point, kind))
         )
@@ -600,8 +603,6 @@ class TpuSketchEngine(SketchDurabilityMixin):
         )
 
     def shutdown(self) -> None:
-        from redisson_tpu import chaos as _chaos
-
         _chaos.unset_observer(self._chaos_observer)
         self.health.shutdown()
         self._stop_snapshotter()
@@ -779,6 +780,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 row = mirror.encode(entry.pool.row_units)
                 try:
                     for r in self._entry_rows(entry):
+                        # rtpulint: disable=RT001 write-back MUST hold the mirror lock: a degraded op interleaving between write-back and mirror drop would apply to a mirror about to be discarded (lost acked write); the degraded flag clears atomically with the mirrors below
                         self.executor.write_row(entry.pool, r, row)
                 except Exception:
                     return False
@@ -795,7 +797,6 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def _submit(self, key, dispatch, arrays, nops, pool_key=None, meta=None,
                 tenant=None):
-        from redisson_tpu import overload as _ovl
         from redisson_tpu.executor.coalescer import HintedFuture, _op_label
 
         # ``tenant`` rides the segment as an appended (tenant, nops)
@@ -1091,7 +1092,15 @@ class TpuSketchEngine(SketchDurabilityMixin):
         bits only turn ON, so the redundant re-write is idempotent and
         the original future's results stay valid."""
         if not saw_replicas and entry.replica_rows:
-            redispatch()
+            # The primary write already applied: this broadcast
+            # COMPLETES an acked write, so it must never shed on the
+            # caller's deadline (neither the direct _locked shed nor
+            # the coalescer's submit/queue shed) — a shed here leaves
+            # replicas diverged from the primary and rotating reads
+            # flapping.  The explicit None frame shadows any ambient
+            # deadline for exactly this redispatch.
+            with _ovl.deadline_scope(None):
+                redispatch()
 
     def _bloom_dispatch_hashed(self, entry, h1m, h2m, is_add) -> LazyResult:
         """One mixed-kernel dispatch for hashed ops, honoring replication:
@@ -1768,9 +1777,11 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 new_row = new_pool.alloc_row()
                 # Read INSIDE the lock: the copy and the commit are atomic
                 # vs concurrent flushes applying ops to the old row.
+                # rtpulint: disable=RT001 migration copy-and-commit must be atomic vs concurrent flushes on the old row — releasing the dispatch lock between read and write would lose ops applied in the gap
                 data = self.executor.read_row(old_pool, old_row)
                 padded = np.zeros(need_words, dtype=np.uint32)
                 padded[: len(data)] = data
+                # rtpulint: disable=RT001 same atomic migration window as the read above
                 self.executor.write_row(new_pool, new_row, padded)
                 self.executor.zero_row(old_pool, old_row)
                 old_pool.free_row(old_row)
@@ -2342,7 +2353,7 @@ class HostSketchEngine:
         from redisson_tpu.obs import Observability
 
         self.config = config
-        self._lock = threading.RLock()
+        self._lock = _witness.named(threading.RLock(), "engine.host")
         self._objects: dict[str, dict] = {}
         # Same observability surface as the TPU engine (so a RESP server
         # or client fronting either backend finds one bundle to record

@@ -53,7 +53,9 @@ from typing import Optional
 
 import numpy as np
 
+from redisson_tpu import chaos
 from redisson_tpu import overload as _overload
+from redisson_tpu.analysis import witness as _witness
 from redisson_tpu.executor.failures import (
     DeadlineExceededError,
     TenantThrottledError,
@@ -391,7 +393,7 @@ class _ConnCtx:
     def __init__(self, sock: socket.socket, server: "RespServer" = None):
         self.sock = sock
         self.server = server  # live output-buffer limits (CONFIG SET)
-        self.lock = threading.Lock()
+        self.lock = _witness.named(threading.Lock(), "resp.conn.send")
         try:  # for SLOWLOG entries; the peer may already be gone
             self.addr = "%s:%d" % sock.getpeername()[:2]
         except OSError:
@@ -423,6 +425,7 @@ class _ConnCtx:
             )
             if not hard and not soft_s:
                 try:
+                    # rtpulint: disable=RT001 the conn write lock EXISTS to serialize whole-frame socket writes (pub/sub pushes interleave with replies); blocking here is its purpose, and the socket timeout / output-buffer limits bound the stall
                     self.sock.sendall(frame)
                 except OSError:
                     # Includes socket.timeout: the connection's timeout
@@ -556,7 +559,9 @@ class RespServer:
         self._script_timeout_ms = getattr(
             client.config, "script_timeout_ms", 5000
         )
-        self._script_lock = threading.Lock()
+        self._script_lock = _witness.named(
+            threading.Lock(), "resp.script"
+        )
         self._script_run = None  # (thread, started_monotonic) while running
         self._script_kill = None  # run record a SCRIPT KILL is targeting
         self.max_connections = max_connections
@@ -595,7 +600,9 @@ class RespServer:
         # unmoved since install.  Guarded — a lost increment would let a
         # stale cached reply outlive the write that obsoleted it.
         self._write_epoch = 0
-        self._epoch_lock = threading.Lock()
+        self._epoch_lock = _witness.named(
+            threading.Lock(), "resp.write_epoch"
+        )
         # Observability (ISSUE 1): per-command stats + SLOWLOG record
         # into the CLIENT's bundle (shared with the engine's registry,
         # so one Prometheus endpoint exposes both); a bare client
@@ -608,13 +615,13 @@ class RespServer:
         self._started = time.monotonic()
         self._conns_accepted = 0
         self._nconn = 0
-        self._conn_lock = threading.Lock()
+        self._conn_lock = _witness.named(threading.Lock(), "resp.conns")
         self._conn_idle = threading.Condition(self._conn_lock)
         self._conns: set = set()  # live sockets, for shutdown drain
         # SCAN resume state: cursor id -> last key returned (see _cmd_SCAN).
         self._scan_states: dict[int, str] = {}
         self._scan_next = 0
-        self._scan_lock = threading.Lock()
+        self._scan_lock = _witness.named(threading.Lock(), "resp.scan")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -639,18 +646,24 @@ class RespServer:
             except OSError:
                 return
             with self._conn_lock:
-                if self._nconn >= self.max_connections:
-                    try:
-                        conn.sendall(
-                            b"-ERR max number of clients reached\r\n"
-                        )
-                        conn.close()
-                    except OSError:
-                        pass
-                    continue
-                self._nconn += 1
-                self._conns_accepted += 1
-                self._conns.add(conn)
+                refused = self._nconn >= self.max_connections
+                if not refused:
+                    self._nconn += 1
+                    self._conns_accepted += 1
+                    self._conns.add(conn)
+            if refused:
+                # Refusal send OUTSIDE _conn_lock (rtpulint RT001): a
+                # stalled rejected peer must not park the accept thread
+                # while it holds the lock every disconnecting
+                # connection needs for slot teardown.
+                try:
+                    conn.sendall(
+                        b"-ERR max number of clients reached\r\n"
+                    )
+                    conn.close()
+                except OSError:
+                    pass
+                continue
             threading.Thread(
                 target=self._serve_conn, args=(conn,),
                 name="rtpu-resp-conn", daemon=True,
@@ -1518,17 +1531,22 @@ class RespServer:
     # keys only (anything else errors — silently acking unknown tunables
     # would fake capabilities the engine does not have).
     _CONFIG_KEYS = {
-        "maxmemory": "0",
-        "maxmemory-policy": "noeviction",
-        "save": "",
-        "appendonly": "no",
-        "databases": "1",
-        "timeout": "0",
-        "proto-max-bulk-len": "536870912",
+        # Client-compat stubs: stock clients interrogate these on
+        # connect; they have no live semantics here (writes round-trip
+        # through the table, nothing applies), so there is nothing to
+        # bounds-validate and no honest INFO line to emit.
+        "maxmemory": "0",  # rtpulint: disable=RT004 client-compat stub, no live semantics
+        "maxmemory-policy": "noeviction",  # rtpulint: disable=RT004 client-compat stub, no live semantics
+        "save": "",  # rtpulint: disable=RT004 client-compat stub, no live semantics
+        "appendonly": "no",  # rtpulint: disable=RT004 client-compat stub, no live semantics
+        "databases": "1",  # rtpulint: disable=RT004 client-compat stub, no live semantics
+        "timeout": "0",  # rtpulint: disable=RT004 client-compat stub, no live semantics
+        "proto-max-bulk-len": "536870912",  # rtpulint: disable=RT004 client-compat stub, no live semantics
         # Applied to the live slowlog ring on CONFIG SET (obs/slowlog.py;
-        # same defaults as redis-server).
-        "slowlog-log-slower-than": "10000",
-        "slowlog-max-len": "128",
+        # same defaults as redis-server).  Surfaced via SLOWLOG GET/LEN
+        # and CONFIG GET, not INFO — redis-server parity.
+        "slowlog-log-slower-than": "10000",  # rtpulint: disable=RT004 surfaced via SLOWLOG/CONFIG GET, not INFO (redis parity)
+        "slowlog-max-len": "128",  # rtpulint: disable=RT004 surfaced via SLOWLOG/CONFIG GET, not INFO (redis parity)
     }
 
     # Near-cache tunables (ISSUE 4) live-apply to the engine's
@@ -1886,8 +1904,6 @@ class RespServer:
                     "DEBUG INJECT on a non-loopback bind requires "
                     "requirepass (fault injection is an admin surface)"
                 )
-            from redisson_tpu import chaos
-
             if len(args) >= 2 and args[1].decode().upper() == "OFF":
                 chaos.clear()
                 return _encode_simple("OK")
@@ -2692,6 +2708,7 @@ class RespServer:
                         f"nearcache_tenants:{st['tenants']}",
                         f"nearcache_tenant_quota_bytes:"
                         f"{st['tenant_quota_bytes']}",
+                        f"nearcache_max_batch:{st['max_batch']}",
                     ]
             elif s == "frontdoor" and obs is not None:
                 # Front-door vectorization (ISSUE 6): fusion + response-
@@ -2755,6 +2772,8 @@ class RespServer:
                     f"{_fam_tot(obs.tenant_throttled)}",
                     f"overload_tenant_rate_limit:"
                     f"{0 if gov is None else gov.rate_limit:g}",
+                    f"overload_tenant_burst_ops:"
+                    f"{0 if gov is None else gov._burst_cfg:g}",
                     f"overload_tenant_max_inflight:"
                     f"{0 if gov is None else gov.max_inflight}",
                     f"overload_fetch_timeouts:"

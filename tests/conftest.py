@@ -31,3 +31,25 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _lock_witness_guard():
+    """Lock-order witness (ISSUE 8): under RTPU_LOCK_WITNESS=1 every
+    test fails if it produced a lock-order cycle or a blocking call
+    under a witness-named lock — the report carries the offending
+    stack pairs.  Free when the witness is off (active() is False
+    until the first lock is wrapped)."""
+    yield
+    from redisson_tpu.analysis import witness
+
+    if witness.active():
+        vs = witness.take_violations()
+        if vs:
+            pytest.fail(
+                "lock-order witness found %d violation(s):\n\n%s"
+                % (len(vs), "\n\n".join(v.format() for v in vs)),
+                pytrace=False,
+            )

@@ -1,0 +1,44 @@
+# rtpulint: role=dispatch
+"""RT001 known-bad corpus: blocking work while holding a lock.
+
+Each marked line reproduces a defect class a review round actually
+caught (the in-place retry sleep that stalled every queue, PR 3; the
+mirror-seed drain under the mirror lock, PR 3 round 2)."""
+
+import select
+import threading
+import time
+
+_MODULE_LOCK = threading.Lock()
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def retry_sleeps_in_place(self):
+        with self._lock:
+            time.sleep(0.05)  # rtpulint-expect: RT001
+
+    def fetch_result_under_lock(self, fut):
+        with self._lock:
+            return fut.result()  # rtpulint-expect: RT001
+
+    def send_between_acquire_release(self, sock, data):
+        self._lock.acquire()
+        sock.sendall(data)  # rtpulint-expect: RT001
+        self._lock.release()
+
+    def select_under_module_lock(self, socks):
+        with _MODULE_LOCK:
+            return select.select(socks, (), (), 0.1)  # rtpulint-expect: RT001
+
+    def ship_under_lock(self, jax, arr):
+        with self._lock:
+            return jax.device_put(arr)  # rtpulint-expect: RT001
+
+    def seed_mirror_under_lock(self, coalescer, executor, pool, row):
+        with self._lock:
+            coalescer.drain()  # rtpulint-expect: RT001
+            return executor.read_row(pool, row)  # rtpulint-expect: RT001

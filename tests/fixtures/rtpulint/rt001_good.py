@@ -1,0 +1,39 @@
+# rtpulint: role=dispatch
+"""RT001 known-good corpus: the idioms the codebase actually uses."""
+
+import threading
+import time
+
+
+class Dispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def condition_wait_is_fine(self):
+        # wait() RELEASES the lock while blocked: the correct idiom.
+        with self._cv:
+            self._cv.wait(timeout=0.1)
+
+    def stage_under_lock_block_outside(self, fut):
+        with self._lock:
+            staged = 1
+        fut.result()
+        return staged
+
+    def closure_defined_under_lock(self, fut):
+        # DEFINING deferred work under a lock is not executing it there.
+        with self._lock:
+            def later():
+                return fut.result()
+        return later
+
+    def release_before_blocking(self, sock, data):
+        self._lock.acquire()
+        self._lock.release()
+        sock.sendall(data)
+
+    def suppressed_with_reason(self):
+        with self._lock:
+            # rtpulint: disable=RT001 fixture: a documented by-design critical-section block
+            time.sleep(0.0)

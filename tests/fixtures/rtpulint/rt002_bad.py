@@ -1,0 +1,16 @@
+# rtpulint: role=serve
+"""RT002 known-bad corpus: settimeout() on a shared-state socket (the
+PR 7 third-round finding: a cross-thread pub/sub push shrank the
+subscriber reader's idle timeout and killed a healthy connection)."""
+
+
+class ConnCtx:
+    def __init__(self, sock):
+        self.sock = sock
+
+    def tighten_for_send(self, tick):
+        self.sock.settimeout(tick)  # rtpulint-expect: RT002
+
+
+def push_cross_thread(ctx, tick):
+    ctx.sock.settimeout(tick)  # rtpulint-expect: RT002

@@ -1,0 +1,22 @@
+# rtpulint: role=serve
+"""RT002 known-good corpus: a socket sets its OWN timeout where it is
+still a local (single owner), and cross-thread waits use select()."""
+
+import select
+import socket
+
+
+def serve_conn(conn, idle_s):
+    # The reader thread configuring the connection it owns: fine.
+    conn.settimeout(idle_s)
+
+
+def dial(host, port):
+    sock = socket.create_connection((host, port))
+    sock.settimeout(1.0)
+    return sock
+
+
+def bounded_send_wait(ctx, tick):
+    # Cross-thread wait WITHOUT touching the shared timeout.
+    return select.select((), (ctx.sock,), (), tick)

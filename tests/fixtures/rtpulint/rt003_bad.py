@@ -1,0 +1,22 @@
+"""RT003 known-bad corpus: function-level chaos imports (per-call
+sys.modules lookups on the DISABLED path — the PR 3 round-2 finding in
+prewarm/durability) and unguarded fire() (breaks the zero-overhead-
+when-disabled contract)."""
+
+from redisson_tpu import chaos as _chaos
+
+
+def dispatch(point):
+    _chaos.fire(point)  # rtpulint-expect: RT003
+
+
+def lazy_import():
+    from redisson_tpu import chaos  # rtpulint-expect: RT003
+
+    return chaos.active()
+
+
+def lazy_import_module():
+    import redisson_tpu.chaos  # rtpulint-expect: RT003
+
+    return redisson_tpu.chaos.ENABLED

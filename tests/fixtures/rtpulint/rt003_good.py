@@ -1,0 +1,25 @@
+"""RT003 known-good corpus: module-top import, both guard shapes, and
+unguarded control-plane calls (management needs no guard)."""
+
+from redisson_tpu import chaos as _chaos
+
+
+def dispatch(point):
+    if _chaos.ENABLED:
+        _chaos.fire(point)
+
+
+def dispatch_early_return(point):
+    if not _chaos.ENABLED:
+        return
+    _chaos.fire(point)
+
+
+def dispatch_compound_guard(point, extra):
+    if _chaos.ENABLED and extra:
+        _chaos.fire(point)
+
+
+def control_plane():
+    _chaos.clear()
+    return _chaos.active()

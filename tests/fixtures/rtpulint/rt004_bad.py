@@ -1,0 +1,19 @@
+# rtpulint: role=serve
+"""RT004 known-bad corpus: served config keys missing their validation
+arm and/or INFO mention (the PR 7 class: tenant-burst-ops was settable
+and applied but invisible in INFO overload)."""
+
+
+class MiniServer:
+    _CONFIG_KEYS = {
+        "shiny-knob": "0",  # rtpulint-expect: RT004
+        "half-knob": "1",  # rtpulint-expect: RT004
+        "good-knob": "2",
+    }
+
+    def _validate_mini_config(self, key, raw):
+        if key in ("good-knob", "half-knob") and int(raw) < 0:
+            raise ValueError(">= 0 required")
+
+    def _cmd_INFO(self, args):
+        return "good_knob:2"

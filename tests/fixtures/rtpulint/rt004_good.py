@@ -1,0 +1,34 @@
+# rtpulint: role=serve
+"""RT004 known-good corpus: every served key has a validation arm and
+an INFO line; a deliberate compat stub rides a reasoned suppression;
+a prefix family ("window-") validates via startswith."""
+
+
+class MiniServer:
+    _CONFIG_KEYS = {
+        "flush-window-us": "200",
+        "window-min-us": "100",
+        "compat-stub": "0",  # rtpulint: disable=RT004 fixture compat stub, no live semantics
+    }
+
+    _TUNABLE_KEYS = frozenset(("merge-cap",))
+
+    def _config_table_init(self):
+        table = dict(self._CONFIG_KEYS)
+        table["merge-cap"] = "0"
+        return table
+
+    def _validate_mini_config(self, key, raw):
+        if key == "flush-window-us" and int(raw) <= 0:
+            raise ValueError("positive required")
+        if key.startswith("window-") and int(raw) < 0:
+            raise ValueError(">= 0 required")
+
+    def _cmd_INFO(self, args):
+        window = 200
+        cap = 0
+        return (
+            f"flush_window_us:{window}\r\n"
+            f"window_min_us:{100}\r\n"
+            f"merge_cap:{cap}"
+        )

@@ -1,0 +1,19 @@
+"""RT005 known-bad corpus: dynamically-composed metric label values
+and a Family built outside the registry helpers (both defeat the
+per-family bounded-cardinality cap)."""
+
+
+class Recorder:
+    def __init__(self, fam):
+        self.fam = fam
+
+    def record(self, tenant, op):
+        self.fam.inc((f"tenant:{tenant}", op))  # rtpulint-expect: RT005
+        self.fam.inc(("op-" + op,))  # rtpulint-expect: RT005
+        self.fam.observe(("{}:{}".format(tenant, op),), 0.01)  # rtpulint-expect: RT005
+
+
+def rogue_family():
+    from redisson_tpu.obs.registry import Family
+
+    return Family("rogue_total", "", "counter")  # rtpulint-expect: RT005

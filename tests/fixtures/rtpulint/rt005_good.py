@@ -1,0 +1,17 @@
+"""RT005 known-good corpus: raw values ride their own label
+dimensions through the registry helpers (the cap collapses overflow
+into one sentinel child)."""
+
+
+class Recorder:
+    def __init__(self, registry):
+        self.ops = registry.counter(
+            "rtpu_fixture_ops", "per-tenant ops", labelnames=("tenant", "op")
+        )
+        self.lat = registry.histogram(
+            "rtpu_fixture_latency", "dispatch latency", labelnames=("op",)
+        )
+
+    def record(self, tenant, op, seconds):
+        self.ops.inc((tenant, op))
+        self.lat.observe((op,), seconds)

@@ -1,0 +1,16 @@
+"""RT006 known-bad corpus: a module-level dict growing under
+name keys with no prune path (the PR 4/5 class: _epochs and the
+_MapCacheHub gens both leaked one entry per name ever seen until the
+rising-floor prune was retrofitted)."""
+
+_EPOCHS: dict = {}  # rtpulint-expect: RT006
+
+_WATCHERS = {}  # rtpulint-expect: RT006
+
+
+def note_write(name):
+    _EPOCHS[name] = _EPOCHS.get(name, 0) + 1
+
+
+def watch(name, fn):
+    _WATCHERS.setdefault(name, []).append(fn)

@@ -1,0 +1,31 @@
+"""RT006 known-good corpus: the rising-floor idiom, an explicit
+delete path, and constant-keyed tables (which cannot leak)."""
+
+_EPOCHS: dict = {}
+_FLOOR = 0
+
+_SESSIONS = {}
+
+_BY_CODE = {0: "zero", 1: "one"}  # constant keys: bounded by source
+
+
+def note_write(name):
+    _EPOCHS[name] = _EPOCHS.get(name, _FLOOR) + 1
+    if len(_EPOCHS) > 1024:
+        _prune_epochs()
+
+
+def _prune_epochs():
+    # Rising floor: fold dead names into the floor; pruned names can
+    # neither serve nor install stale state.
+    global _FLOOR
+    _FLOOR = max(_EPOCHS.values(), default=_FLOOR)
+    _EPOCHS.clear()
+
+
+def open_session(sid, conn):
+    _SESSIONS[sid] = conn
+
+
+def close_session(sid):
+    _SESSIONS.pop(sid, None)

@@ -820,3 +820,163 @@ class TestRespOverload:
         for _ in range(5):
             assert len(conn.cmd("GET", "k")) == 100_000
         assert server._slow_client_kills == 0
+
+
+# -- direct-dispatch deadlines (ROADMAP overload item (c), ISSUE 8) ----------
+
+
+class TestDirectDispatchDeadlines:
+    def test_expired_deadline_sheds_before_direct_dispatch(self):
+        """With no coalescer in front (coalesce=False) the dispatch
+        lock IS the queue: an expired op must shed strictly
+        PRE-dispatch in the _locked wrapper, exactly like the
+        coalescer's sweep — previously it dispatched regardless."""
+        client = make_client(coalesce=False)
+        try:
+            bf = client.get_bloom_filter("direct-dl")
+            bf.try_init(10_000, 0.01)
+            warm = np.arange(16, dtype=np.uint64)
+            late = np.arange(100, 116, dtype=np.uint64)
+            bf.add_all(warm)
+            with overload.deadline_scope(at=time.monotonic() - 0.01):
+                with pytest.raises(DeadlineExceededError) as ei:
+                    bf.add_all(late)
+            assert ei.value.stage == "direct"
+            # Strictly pre-dispatch: the shed write never reached the
+            # device, earlier acked writes are untouched.
+            assert bf.contains_all(late) == 0
+            assert bf.contains_all(warm) == len(warm)
+            obs = client._engine.obs
+            assert obs.deadline_exceeded.get(("direct",)) >= len(late)
+            assert obs.shed_ops.get(("deadline",)) >= len(late)
+            # Without a deadline the same op proceeds (recovery).
+            bf.add_all(late)
+            assert bf.contains_all(late) == len(late)
+        finally:
+            client.shutdown()
+
+    def test_direct_reads_shed_too(self):
+        client = make_client(coalesce=False)
+        try:
+            bf = client.get_bloom_filter("direct-dl-read")
+            bf.try_init(10_000, 0.01)
+            keys = np.arange(16, dtype=np.uint64)
+            bf.add_all(keys)
+            with overload.deadline_scope(at=time.monotonic() - 0.01):
+                with pytest.raises(DeadlineExceededError):
+                    bf.contains_all(keys)
+        finally:
+            client.shutdown()
+
+    def test_row_maintenance_exempt_mid_compound_op(self):
+        """delete()'s detach->zero->free must not tear apart when a
+        deadline lapses mid-compound: read/write/zero_row are exempt
+        from the direct shed (a detached-but-unzeroed row could be
+        reallocated carrying stale bits)."""
+        client = make_client(coalesce=False)
+        try:
+            bf = client.get_bloom_filter("direct-maint")
+            bf.try_init(10_000, 0.01)
+            bf.add_all(np.arange(8, dtype=np.uint64))
+            with overload.deadline_scope(at=time.monotonic() - 0.01):
+                assert client._engine.delete("direct-maint") is True
+            # The row was actually zeroed: a successor under the name
+            # starts empty.
+            bf2 = client.get_bloom_filter("direct-maint")
+            bf2.try_init(10_000, 0.01)
+            assert bf2.contains_all(np.arange(8, dtype=np.uint64)) == 0
+        finally:
+            client.shutdown()
+
+
+# -- admission estimator x link phase (ROADMAP overload item (a), ISSUE 8) ---
+
+
+def test_admission_estimator_tracks_link_phase_flip():
+    """merge_cap()'s put-RT EWMA corrects the admission estimate in
+    BOTH directions around a link-phase flip, against synthetic
+    retirement samples (no wall-clock dependence):
+
+    - fast->slow: the retire EWMA still says 5 ms/launch while the
+      put-RT signal already says ~0.5 s — the estimate must be floored
+      by the put RT instead of over-admitting into a half-second queue;
+    - slow->fast: the retire EWMA is still slow-poisoned while genuine
+      fast retirements pulled the put RT under fast_launch_s — the
+      estimate must be capped so healthy traffic stops being shed."""
+    c = _mk(max_batch=64, max_inflight=8)
+    try:
+        c._service_ewma_s = 0.005  # fast-phase retire history
+        c._ops_per_launch_ewma = 8.0
+        with c._lock:
+            c._queued_ops = 64  # 8 launches queued ahead
+        assert c.estimate_wait_s() < 0.1
+        # Link flips slow: three ~0.5 s retirements flip the put-RT
+        # EWMA past slow_launch_s (slow samples always count, even
+        # non-genuine ones) while the retire EWMA is untouched.
+        for _ in range(3):
+            c._release_launch_slot(0.5, genuine=False)
+        assert c._put_rt_ewma > c.slow_launch_s
+        est_slow = c.estimate_wait_s()
+        assert est_slow > 0.5, est_slow  # floored by the phase signal
+        with pytest.raises(DeadlineExceededError) as ei:
+            c.submit(("k",), lambda cols: _FakeLazy(cols[0]), _cols(), 8,
+                     deadline=time.monotonic() + 0.2)
+        assert ei.value.stage == "admission"
+        # Flip back fast: genuine fast retirements pull the put RT
+        # under fast_launch_s within a few launches; the retire EWMA
+        # stays slow-poisoned (forced), but the cap stops the shed.
+        c._service_ewma_s = 1.0
+        for _ in range(8):
+            c._release_launch_slot(0.01, genuine=True)
+        assert c._put_rt_ewma < c.fast_launch_s
+        est_fast = c.estimate_wait_s()
+        assert est_fast <= c.slow_launch_s, est_fast
+        fut = c.submit(("k",), lambda cols: _FakeLazy(cols[0]), _cols(), 8,
+                       deadline=time.monotonic() + 5.0)
+        assert HintedFuture(fut, c).result(timeout=10.0) is not None
+    finally:
+        c.shutdown()
+
+
+def test_phase_service_neutral_between_thresholds():
+    """Between the fast/slow thresholds the put-RT signal is
+    ambiguous: the retire EWMA stands unmodified (no correction
+    flapping in the gray zone)."""
+    c = _mk()
+    try:
+        c._service_ewma_s = 0.02
+        c._put_rt_ewma = 0.1  # between fast (0.08) and slow (0.25)
+        assert c._phase_service_s() == 0.02
+        # And a zeroed signal (no launches yet) leaves the base alone.
+        c._put_rt_ewma = 0.0
+        assert c._phase_service_s() == 0.02
+    finally:
+        c.shutdown()
+
+
+def test_replication_fence_shadows_ambient_deadline():
+    """Review finding (PR 8): the replication fence's redispatch
+    COMPLETES a write already applied to the primary row, so it must
+    run under an explicit no-deadline frame — a caller deadline that
+    lapsed during the first dispatch must not shed the broadcast
+    (diverged replicas, reads rotating across copies would flap)."""
+    client = make_client(coalesce=False)
+    try:
+        bf = client.get_bloom_filter("fence")
+        bf.try_init(10_000, 0.01)
+        keys = np.arange(32, dtype=np.uint64)
+        eng = client._engine
+        entry = eng.registry.lookup("fence")
+        entry.replica_rows = [entry.row]  # publish: the fence must fire
+        seen = []
+
+        def redispatch():
+            seen.append(overload.current_deadline())
+            bf.add_all(keys)  # real non-exempt direct dispatch
+
+        with overload.deadline_scope(at=time.monotonic() - 0.01):
+            eng._replication_fence(entry, False, redispatch)
+        assert seen == [None]  # ambient expired deadline was shadowed
+        assert bf.contains_all(keys) == len(keys)  # broadcast applied
+    finally:
+        client.shutdown()
