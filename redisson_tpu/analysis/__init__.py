@@ -28,14 +28,24 @@ checks that can never regress:
 # Lazy re-exports (PEP 562): every production module imports
 # `analysis.witness` at module load to name its locks, and the
 # witness's zero-overhead-when-disabled contract would ring hollow if
-# that import dragged the whole AST analyzer (ast/tokenize/io) into
-# every serving process.  The analyzer loads only when something
-# actually lints (the CLI, tests).
+# that import dragged the whole AST analyzer (ast/tokenize/io) — or
+# the schedule explorer — into every serving process.  The tooling
+# loads only when something actually lints or explores (the CLI,
+# tests).
 _ANALYZER_EXPORTS = frozenset((
     "RULES", "Violation", "lint_file", "lint_paths", "lint_source",
 ))
+_LOCKGRAPH_EXPORTS = frozenset((
+    "build_graph", "find_cycles", "lint_tree", "load_runtime_edges",
+    "merge_runtime_edges",
+))
+_EXPLORER_EXPORTS = frozenset((
+    "checkpoint", "explore", "schedule_test",
+))
 
-__all__ = sorted(_ANALYZER_EXPORTS)
+__all__ = sorted(
+    _ANALYZER_EXPORTS | _LOCKGRAPH_EXPORTS | _EXPLORER_EXPORTS
+)
 
 
 def __getattr__(name: str):
@@ -43,6 +53,14 @@ def __getattr__(name: str):
         from redisson_tpu.analysis import rtpulint
 
         return getattr(rtpulint, name)
+    if name in _LOCKGRAPH_EXPORTS:
+        from redisson_tpu.analysis import lockgraph
+
+        return getattr(lockgraph, name)
+    if name in _EXPLORER_EXPORTS:
+        from redisson_tpu.analysis import explorer
+
+        return getattr(explorer, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}"
     )

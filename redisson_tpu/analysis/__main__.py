@@ -1,13 +1,22 @@
 """CLI: ``python -m redisson_tpu.analysis [paths...]``.
 
+Runs the per-file rules (RT001-RT009) AND — when any path is a
+directory — the whole-tree static lock-order pass (RT010,
+analysis/lockgraph.py): the witness-named lock graph extracted across
+every call path must be acyclic, optionally merged with a runtime
+witness export (``--runtime-edges``).
+
 Exit status: 0 when every finding is suppressed (or none), 1 when any
 unsuppressed violation remains, 2 on usage errors.  CI runs this over
-``redisson_tpu/`` in the tier-1 workflow.
+``redisson_tpu/`` in the tier-1 workflow (the ``model-check`` job adds
+the explorer suites on top).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
 
 from redisson_tpu.analysis.rtpulint import RULES, lint_paths
@@ -17,7 +26,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m redisson_tpu.analysis",
         description="rtpulint: project-invariant static analyzer "
-                    "(rules RT001-RT006; see docs/static_analysis.md)",
+                    "(rules RT001-RT010; see docs/static_analysis.md)",
     )
     ap.add_argument("paths", nargs="*", default=["redisson_tpu"],
                     help="files/directories to lint (default: redisson_tpu)")
@@ -26,6 +35,14 @@ def main(argv=None) -> int:
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print suppressed findings")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--no-lock-graph", action="store_true",
+                    help="skip the whole-tree RT010 lock-order pass")
+    ap.add_argument("--runtime-edges", metavar="FILE",
+                    help="witness export (RTPU_LOCK_WITNESS_EXPORT JSON) "
+                         "to merge into the static lock graph")
+    ap.add_argument("--dump-lock-graph", action="store_true",
+                    help="print the extracted catalog + edges as JSON and "
+                         "exit (no linting)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -38,8 +55,86 @@ def main(argv=None) -> int:
             print(f"unknown rule(s): {', '.join(bad)}", file=sys.stderr)
             return 2
 
-    violations = lint_paths(args.paths or ["redisson_tpu"],
-                            rules=args.rules)
+    paths = args.paths or ["redisson_tpu"]
+
+    have_dir = any(os.path.isdir(p) for p in paths)
+    wanted_rt010 = args.rules is not None and "RT010" in args.rules
+    run_graph = (
+        not args.no_lock_graph
+        and (args.rules is None or wanted_rt010
+             # An explicit --runtime-edges IS a request for the merge:
+             # honor it even when --rule narrowed to other rules.
+             or bool(args.runtime_edges))
+        and have_dir
+    )
+    # The lock graph is a WHOLE-TREE pass: on file-only paths it cannot
+    # run.  Skipping it silently when the caller asked for it by name
+    # would report "gate passed" for a gate that never ran.
+    if not run_graph and not args.dump_lock_graph:
+        if args.runtime_edges and args.no_lock_graph:
+            print(
+                "warning: --runtime-edges ignored (--no-lock-graph)",
+                file=sys.stderr,
+            )
+        elif (wanted_rt010 or args.runtime_edges) and not have_dir:
+            print(
+                "error: RT010/--runtime-edges need a directory path "
+                "(the lock graph is a whole-tree pass; file-only paths "
+                "cannot run it)",
+                file=sys.stderr,
+            )
+            return 2
+
+    def _load_runtime(lockgraph):
+        """Usage-error (exit 2) on a missing/malformed witness export —
+        realistic in CI: export_to is best-effort and only registers
+        when the witness actually armed, so the file may not exist."""
+        try:
+            return lockgraph.load_runtime_edges(args.runtime_edges)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(
+                f"error: cannot read --runtime-edges "
+                f"{args.runtime_edges!r}: {e} (was the witness armed? "
+                f"RTPU_LOCK_WITNESS=1 + RTPU_LOCK_WITNESS_EXPORT "
+                f"produce it)",
+                file=sys.stderr,
+            )
+            return None
+
+    if args.dump_lock_graph:
+        from redisson_tpu.analysis import lockgraph
+
+        graph = lockgraph.build_graph(paths)
+        if args.runtime_edges:
+            runtime = _load_runtime(lockgraph)
+            if runtime is None:
+                return 2
+            lockgraph.merge_runtime_edges(graph, runtime)
+        json.dump(graph.to_dict(), sys.stdout, indent=2)
+        print()
+        return 0
+
+    file_rules = (
+        [r for r in args.rules if r != "RT010"] if args.rules else None
+    )
+    violations = []
+    if file_rules is None or file_rules:
+        violations = lint_paths(paths, rules=file_rules)
+
+    graph = None
+    if run_graph:
+        from redisson_tpu.analysis import lockgraph
+
+        runtime = None
+        if args.runtime_edges:
+            runtime = _load_runtime(lockgraph)
+            if runtime is None:
+                return 2
+        graph, cycle_violations = lockgraph.lint_tree(
+            paths, runtime_edges=runtime
+        )
+        violations.extend(cycle_violations)
+
     live = [v for v in violations if not v.suppressed]
     suppressed = [v for v in violations if v.suppressed]
     for v in live:
@@ -47,9 +142,16 @@ def main(argv=None) -> int:
     if args.show_suppressed:
         for v in suppressed:
             print(v.format())
+    tail = ""
+    if graph is not None:
+        tail = (
+            f"; lock graph: {len(graph.catalog)} locks, "
+            f"{len(graph.edges)} edges, "
+            f"{len(graph.suppressed)} suppressed edges"
+        )
     print(
         f"rtpulint: {len(live)} violation(s), "
-        f"{len(suppressed)} suppressed",
+        f"{len(suppressed)} suppressed{tail}",
         file=sys.stderr,
     )
     return 1 if live else 0

@@ -35,6 +35,32 @@ RT006  A module-level dict that grows under non-constant (object/tenant
        (``pop``/``del``/``clear`` or a ``*prune*`` function touching
        it) — the rising-floor idiom.  Name-churn workloads otherwise
        leak one entry per name ever seen.
+RT007  Deadline propagation: a function that accepts a ``deadline``
+       parameter must thread it into every coalescer ``submit`` /
+       ``HintedFuture`` it makes, and must not issue an unbounded
+       ``.result()``/``.wait()`` (no arguments at all) — dropping the
+       budget mid-path recreates the PR 7 class of 120 s hangs behind
+       a deadline the caller thought was live.
+RT008  Near-cache epoch-bump pairing: a mutating engine path (one that
+       submits device work) must bump the write epoch at entry AND
+       exit — a single bare ``note_write``/``note_structural`` call
+       next to a submit re-opens the capture-window race the
+       ``_EpochGuard`` entry+exit discipline closed; and a
+       ``_nc_mutate(...)`` guard that is not used as a context manager
+       never runs at all.
+RT009  Future-resolution completeness: a locally created
+       ``Future``/``HintedFuture`` must be resolved
+       (``set_result``/``set_exception``/``cancel``), returned, or
+       handed off on every path — including exception arms: resolving
+       futures inside a ``try`` whose ``except`` swallows (neither
+       raises, returns, nor resolves) strands the waiter (the PR 7
+       stranded-in-flight-charge class).
+RT010  Static lock-order cycle (whole-tree pass, analysis/lockgraph.py):
+       the witness-named lock graph extracted across every call path
+       must stay acyclic, merged with any runtime witness edges — a
+       cycle is a potential deadlock even if no test ever ran the
+       schedule.  Suppress a by-design edge at its inner-acquisition
+       line.
 
 Suppression: ``# rtpulint: disable=RT001 <reason>`` on the offending
 line, or alone on the line directly above it.  The reason is mandatory
@@ -64,6 +90,10 @@ RULES = {
     "RT004": "served config key without validation arm or INFO mention",
     "RT005": "metric label outside the bounded-cardinality helpers",
     "RT006": "module-level name-keyed dict without a prune path",
+    "RT007": "deadline accepted but not threaded into a submit/wait",
+    "RT008": "near-cache epoch bump not paired entry+exit",
+    "RT009": "created future not resolved/handed off on all paths",
+    "RT010": "static lock-order cycle (whole-tree pass)",
 }
 
 # Roles a rule applies to.  "*" = every non-test module.
@@ -74,6 +104,12 @@ _RULE_ROLES = {
     "RT004": {"*"},  # self-scoping: only fires where a config table lives
     "RT005": {"*"},
     "RT006": {"*"},
+    "RT007": {"*"},  # self-scoping: only fires in deadline-accepting funcs
+    "RT008": {"*"},  # self-scoping: only fires next to epoch-bump calls
+    "RT009": {"*"},  # self-scoping: only fires where a future is created
+    # RT010 is a WHOLE-TREE rule (analysis/lockgraph.py): it has no
+    # per-file check here, but lives in RULES so disable=RT010
+    # suppressions parse and the CLI can name it.
 }
 
 _ROLE_BY_PATH = (
@@ -682,6 +718,231 @@ def _check_rt006(ctx) -> None:
         )
 
 
+# -- RT007: deadline propagation ----------------------------------------------
+
+
+def _mentions_name(node, name: str) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id == name:
+            return True
+    return False
+
+
+def _call_threads_deadline(call: ast.Call) -> bool:
+    if any(kw.arg == "deadline" for kw in call.keywords if kw.arg):
+        return True
+    return any(_mentions_name(a, "deadline") for a in call.args) or any(
+        _mentions_name(kw.value, "deadline") for kw in call.keywords
+    )
+
+
+def _check_rt007(ctx) -> None:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        args = fn.args
+        params = {
+            a.arg for a in (
+                args.args + args.posonlyargs + args.kwonlyargs
+            )
+        }
+        if "deadline" not in params:
+            continue
+        for node in _walk_no_defs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            callee = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None
+            )
+            if callee is None:
+                continue
+            if callee in ("submit", "HintedFuture"):
+                if not _call_threads_deadline(node):
+                    ctx.report(
+                        "RT007", node.lineno,
+                        f"{callee}(...) inside a deadline-accepting "
+                        f"function does not thread the deadline through "
+                        f"— the budget dies here and the op can outlive "
+                        f"it (pass deadline=...)",
+                    )
+            elif callee in ("result", "wait") and not node.args \
+                    and not node.keywords:
+                ctx.report(
+                    "RT007", node.lineno,
+                    f".{callee}() with no bound inside a deadline-"
+                    f"accepting function waits forever past the "
+                    f"caller's budget — bound it by the residual "
+                    f"deadline",
+                )
+
+
+# -- RT008: near-cache epoch-bump pairing -------------------------------------
+
+
+_BUMP_ATTRS = ("note_write", "note_structural")
+
+
+def _check_rt008(ctx) -> None:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        bumps: list = []
+        submits = 0
+        for node in _walk_no_defs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in _BUMP_ATTRS:
+                    bumps.append(node.lineno)
+                elif f.attr in ("submit", "_submit"):
+                    submits += 1
+            # A guard constructed but thrown away never bumps at all.
+            if (
+                isinstance(f, ast.Attribute) and f.attr == "_nc_mutate"
+                or isinstance(f, ast.Name) and f.id == "_nc_mutate"
+            ):
+                parent = getattr(node, "_rtpu_parent", None)
+                if isinstance(parent, ast.Expr):
+                    ctx.report(
+                        "RT008", node.lineno,
+                        "_nc_mutate(...) discarded — the epoch guard "
+                        "only bumps as a context manager: write "
+                        "`with self._nc_mutate(name):` around the "
+                        "mutation",
+                    )
+        # A properly guarded path contributes NO bare bump calls (the
+        # guard holds the bound methods as values), so one bare bump
+        # next to a submit is suspicious even when a sibling path in
+        # the same function uses the `with _nc_mutate` form.
+        if submits and len(bumps) == 1:
+            ctx.report(
+                "RT008", bumps[0],
+                "mutating path bumps the near-cache epoch exactly once "
+                "— the discipline is entry AND exit (a read captured in "
+                "the entry→submit window must not install): wrap the "
+                "mutation in `with self._nc_mutate(name):`",
+            )
+
+
+# -- RT009: future-resolution completeness ------------------------------------
+
+
+_FUTURE_CTORS = ("Future", "HintedFuture")
+
+
+def _is_future_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in _FUTURE_CTORS
+    if isinstance(f, ast.Attribute):
+        return f.attr in _FUTURE_CTORS
+    return False
+
+
+def _check_rt009(ctx) -> None:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # var -> creation line
+        created: dict = {}
+        for node in _walk_no_defs(fn):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                target, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name) and \
+                    node.value is not None:
+                target, value = node.target.id, node.value
+            else:
+                continue
+            if isinstance(value, ast.Call) and _is_future_ctor(value):
+                created[target] = node.lineno
+        if not created:
+            continue
+        resolved: set = set()
+        escaped: set = set()
+        for node in _walk_no_defs(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in created
+                    and f.attr in ("set_result", "set_exception", "cancel",
+                                   "set_running_or_notify_cancel")
+                ):
+                    resolved.add(f.value.id)
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    for v in created:
+                        if _mentions_name(arg, v):
+                            escaped.add(v)
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                val = node.value
+                if val is not None:
+                    for v in created:
+                        if _mentions_name(val, v):
+                            escaped.add(v)
+            elif isinstance(node, ast.Assign):
+                # aliasing / storing: fut2 = fut, self.x = fut, d[k] = fut
+                for v in created:
+                    if _mentions_name(node.value, v):
+                        escaped.add(v)
+        for v, line in created.items():
+            if v not in resolved and v not in escaped:
+                ctx.report(
+                    "RT009", line,
+                    f"future {v!r} is created but never resolved, "
+                    f"returned, or handed off — every waiter on it "
+                    f"blocks until the fetch timeout",
+                )
+        # Exception arms: resolving inside a try whose handler swallows.
+        for node in _walk_no_defs(fn):
+            if not isinstance(node, ast.Try):
+                continue
+            resolves_inside = set()
+            for sub in node.body:
+                for n in ast.walk(sub):
+                    if (
+                        isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id in created
+                        and n.func.attr in ("set_result", "set_exception")
+                    ):
+                        resolves_inside.add(n.func.value.id)
+            if not resolves_inside:
+                continue
+            for handler in node.handlers:
+                ok = False
+                for n in ast.walk(handler):
+                    if isinstance(n, (ast.Raise, ast.Return)):
+                        ok = True
+                        break
+                    if (
+                        isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id in resolves_inside
+                        and n.func.attr in ("set_result", "set_exception",
+                                            "cancel")
+                    ):
+                        ok = True
+                        break
+                if not ok:
+                    ctx.report(
+                        "RT009", handler.lineno,
+                        f"except arm swallows while the try body "
+                        f"resolves future(s) {sorted(resolves_inside)} — "
+                        f"a failure here strands the waiter: re-raise, "
+                        f"return, or set_exception",
+                    )
+
+
 _CHECKS = {
     "RT001": _check_rt001,
     "RT002": _check_rt002,
@@ -689,6 +950,9 @@ _CHECKS = {
     "RT004": _check_rt004,
     "RT005": _check_rt005,
     "RT006": _check_rt006,
+    "RT007": _check_rt007,
+    "RT008": _check_rt008,
+    "RT009": _check_rt009,
 }
 
 

@@ -103,12 +103,24 @@ def force(on: bool) -> None:
     _forced = on
 
 
+_EXPORT_ENV = "RTPU_LOCK_WITNESS_EXPORT"
+
+
 def _ensure_state() -> "_State":
     global _state
     with _state_guard:
         if _state is None:
             _state = _State()
             _install_probes()
+            # Static/dynamic merge (ISSUE 9): with the env var set, the
+            # observed acquisition graph is dumped at process exit so
+            # the static lock-graph gate (analysis/lockgraph.py) can
+            # fold runtime-only edges into its cycle check.
+            path = os.environ.get(_EXPORT_ENV)
+            if path:
+                import atexit
+
+                atexit.register(export_to, path)
     return _state
 
 
@@ -337,6 +349,32 @@ def _note_blocking(what: str) -> None:
 # -- reporting ----------------------------------------------------------------
 
 
+def export_edges() -> list:
+    """The observed name-level acquisition graph as sorted (a, b)
+    pairs — the runtime half of the static/dynamic lock-graph merge
+    (analysis/lockgraph.py merge_runtime_edges)."""
+    st = _state
+    if st is None:
+        return []
+    with st.guard:
+        return sorted(
+            (a, b) for a, succ in st.graph.items() for b in succ
+        )
+
+
+def export_to(path: str) -> None:
+    """Dump the acquisition graph as the JSON shape
+    ``lockgraph.load_runtime_edges`` reads."""
+    import json
+
+    edges = export_edges()
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"edges": [list(e) for e in edges]}, f, indent=0)
+    except OSError:  # pragma: no cover — export is best-effort
+        pass
+
+
 def violations() -> list:
     st = _state
     if st is None:
@@ -386,6 +424,8 @@ __all__ = [
     "allow_blocking",
     "assert_clean",
     "enabled",
+    "export_edges",
+    "export_to",
     "force",
     "named",
     "reset",

@@ -153,10 +153,14 @@ def _may_block(name: str) -> bool:
 
 import threading as _threading
 
+from redisson_tpu.analysis import witness as _witness
+
 _shared_pool = None
 # Module-scope lock: creating it lazily raced — two first callers could
 # each install a different lock and build two executors.
-_shared_pool_lock = _threading.Lock()
+# Witness-named (ISSUE 9 satellite: grid-tier lock coverage); identity
+# when the witness is off.
+_shared_pool_lock = _witness.named(_threading.Lock(), "grid.shared_pool")
 
 
 def _get_shared_pool():

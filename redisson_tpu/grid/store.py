@@ -18,6 +18,8 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from redisson_tpu.analysis import witness as _witness
+
 
 class GridEntry:
     __slots__ = ("kind", "value", "expire_at")
@@ -35,7 +37,7 @@ class GridStore:
     SWEEP_INTERVAL_S = 0.25
 
     def __init__(self):
-        self.lock = threading.RLock()
+        self.lock = _witness.named(threading.RLock(), "grid.store")
         self.cond = threading.Condition(self.lock)
         self._data: dict[str, GridEntry] = {}
         self._sweeper: Optional[threading.Thread] = None

@@ -13,6 +13,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
 
+from redisson_tpu.analysis import witness as _witness
 from redisson_tpu.objects.base import CamelCompatMixin
 
 
@@ -20,7 +21,7 @@ class TopicBus:
     """Per-client pub/sub hub (the PublishSubscribeService analog)."""
 
     def __init__(self, n_threads: int = 2):
-        self._lock = threading.Lock()
+        self._lock = _witness.named(threading.Lock(), "grid.topics.bus")
         self._listeners: dict[str, dict[int, Callable]] = {}
         self._pattern_listeners: dict[str, dict[int, Callable]] = {}
         self._next_id = 1

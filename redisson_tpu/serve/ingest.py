@@ -16,6 +16,8 @@ from typing import Optional
 
 import numpy as np
 
+from redisson_tpu.analysis import witness as _witness
+
 
 class TopicCmsBridge:
     """Subscribes to a topic and streams every message into a
@@ -45,7 +47,7 @@ class TopicCmsBridge:
         self._batch_size = batch_size
         self._interval = flush_interval_s
         self._weight_fn = weight_fn
-        self._lock = threading.Lock()
+        self._lock = _witness.named(threading.Lock(), "serve.ingest")
         self._idle = threading.Condition(self._lock)
         self._active = 0  # _on_message calls currently executing
         self._buf: list = []

@@ -9,6 +9,8 @@ from __future__ import annotations
 import threading
 import time
 
+from redisson_tpu.analysis import witness as _witness
+
 
 class _Reservoir:
     """Bounded latency reservoir for percentile estimates."""
@@ -48,7 +50,7 @@ class _Reservoir:
 
 class Metrics:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _witness.named(threading.Lock(), "serve.metrics")
         self.started = time.monotonic()
         self.ops_total = 0
         self.batches_total = 0
@@ -144,7 +146,7 @@ class Profiler:
         import threading
 
         self._active = False
-        self._plock = threading.Lock()
+        self._plock = _witness.named(threading.Lock(), "serve.profiler")
 
     def start(self, log_dir: str) -> None:
         import jax

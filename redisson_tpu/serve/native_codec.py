@@ -32,7 +32,9 @@ PARSE_OK = 0
 PARSE_PROTO_ERROR = 1
 PARSE_FALLBACK = 2
 
-_lock = threading.Lock()
+from redisson_tpu.analysis import witness as _witness
+
+_lock = _witness.named(threading.Lock(), "serve.native_codec")
 _parser: Optional["NativeRespParser"] = None
 _load_failed = False
 

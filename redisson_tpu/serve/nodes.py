@@ -124,7 +124,11 @@ class FailureMonitor:
         # (wedged ping) may overlap the next start()'s thread briefly —
         # the lock keeps _down/listener emission race-free until the old
         # thread sees its own stop event and exits.
-        self._sweep_lock = threading.Lock()
+        from redisson_tpu.analysis import witness as _witness
+
+        self._sweep_lock = _witness.named(
+            threading.Lock(), "serve.nodes.sweep"
+        )
 
     def add_listener(self, cb) -> None:
         """``cb(event)`` is invoked from the monitor thread."""

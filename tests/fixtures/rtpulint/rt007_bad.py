@@ -1,0 +1,29 @@
+# rtpulint: role=engine
+"""RT007 known-bad corpus: a deadline accepted but dropped mid-path.
+
+The PR 7 bug class: the caller attached a budget, some layer between
+it and the device forgot to thread it through, and the op waited out
+the 120 s fetch timeout behind a deadline everyone thought was live."""
+
+
+class Engine:
+    def __init__(self, coalescer):
+        self.coalescer = coalescer
+
+    def submit_drops_budget(self, key, arrays, nops, deadline):
+        return self.coalescer.submit(key, None, arrays, nops)  # rtpulint-expect: RT007
+
+    def wrapper_drops_budget(self, fut, deadline):
+        return HintedFuture(fut, self.coalescer)  # rtpulint-expect: RT007
+
+    def unbounded_wait(self, fut, deadline):
+        return fut.result()  # rtpulint-expect: RT007
+
+    def unbounded_cond_wait(self, cv, deadline):
+        with cv:
+            cv.wait()  # rtpulint-expect: RT007
+
+
+class HintedFuture:
+    def __init__(self, fut, coalescer, deadline=None):
+        self._fut = fut

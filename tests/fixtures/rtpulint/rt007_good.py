@@ -1,0 +1,30 @@
+# rtpulint: role=engine
+"""RT007 known-good corpus: the budget rides every hop."""
+
+
+class HintedFuture:
+    def __init__(self, fut, coalescer, deadline=None):
+        self._fut = fut
+        self._deadline = deadline
+
+
+class Engine:
+    def __init__(self, coalescer):
+        self.coalescer = coalescer
+
+    def submit_threads_deadline(self, key, arrays, nops, deadline):
+        fut = self.coalescer.submit(
+            key, None, arrays, nops, deadline=deadline
+        )
+        return HintedFuture(fut, self.coalescer, deadline=deadline)
+
+    def positional_reference_counts(self, key, arrays, nops, deadline):
+        # The budget is visibly threaded even without the kwarg form.
+        return self.coalescer.submit(key, arrays, nops, deadline)
+
+    def bounded_wait(self, fut, deadline, now):
+        return fut.result(timeout=deadline - now)
+
+    def no_deadline_param_is_out_of_scope(self, fut):
+        # Without a deadline parameter there is no budget to drop.
+        return fut.result()

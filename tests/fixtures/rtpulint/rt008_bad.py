@@ -1,0 +1,25 @@
+# rtpulint: role=engine
+"""RT008 known-bad corpus: epoch bumps not paired entry+exit.
+
+The near-cache correctness argument (cache/nearcache.py module doc)
+needs BOTH bumps: entry retires stale serving the moment the write is
+in flight, exit retires installs whose reads were captured inside the
+entry->submit window.  One bare bump next to a submit re-opens the
+window; a discarded guard never bumps at all."""
+
+
+class Engine:
+    def __init__(self, nearcache, coalescer):
+        self.nearcache = nearcache
+        self.coalescer = coalescer
+
+    def _nc_mutate(self, name):
+        return object()
+
+    def add_bumps_once(self, name, arrays):
+        self.nearcache.note_write(name)  # rtpulint-expect: RT008
+        return self.coalescer.submit(("add", name), None, arrays, 1)
+
+    def clear_discards_guard(self, name, arrays):
+        self._nc_mutate(name)  # rtpulint-expect: RT008
+        return self.coalescer.submit(("clear", name), None, arrays, 1)

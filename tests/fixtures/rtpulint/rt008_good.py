@@ -1,0 +1,32 @@
+# rtpulint: role=engine
+"""RT008 known-good corpus: the entry+exit discipline, in its three
+legitimate shapes."""
+
+
+class Engine:
+    def __init__(self, nearcache, coalescer):
+        self.nearcache = nearcache
+        self.coalescer = coalescer
+
+    def _nc_mutate(self, name):
+        return object()
+
+    def add_under_guard(self, name, arrays):
+        # The canonical form: the guard bumps on __enter__ AND __exit__.
+        with self._nc_mutate(name):
+            return self.coalescer.submit(("add", name), None, arrays, 1)
+
+    def manual_pairing(self, name, arrays):
+        self.nearcache.note_write(name)
+        fut = self.coalescer.submit(("add", name), None, arrays, 1)
+        self.nearcache.note_write(name)
+        return fut
+
+    def host_only_drop(self, name):
+        # No device submit: a single structural bump is the whole story
+        # (drop_object's shape — nothing rides the coalescer).
+        self.nearcache.note_structural(name)
+
+    def read_path_no_bump(self, name, arrays):
+        # Reads never bump; nothing to pair.
+        return self.coalescer.submit(("read", name), None, arrays, 1)

@@ -1,0 +1,28 @@
+# rtpulint: role=dispatch
+"""RT009 known-bad corpus: futures stranded on some path.
+
+The PR 7 class: a future someone is (or will be) waiting on is created
+but an exit path — including an except arm — forgets it, and the
+waiter blocks until the fetch timeout."""
+
+from concurrent.futures import Future
+
+
+class Dispatcher:
+    def __init__(self):
+        self.queue = []
+
+    def created_and_dropped(self, op):
+        fut = Future()  # rtpulint-expect: RT009
+        if op is None:
+            return None
+        return None
+
+    def swallowing_except_arm(self, results):
+        fut = Future()
+        self.queue.append(fut)
+        try:
+            fut.set_result(results.pop())
+        except Exception:  # rtpulint-expect: RT009
+            pass
+        return fut

@@ -1,0 +1,43 @@
+# rtpulint: role=dispatch
+"""RT009 known-good corpus: every created future is resolved,
+returned, or handed off — exception arms included."""
+
+from concurrent.futures import Future
+
+
+class Dispatcher:
+    def __init__(self):
+        self.queue = []
+
+    def returned_to_caller(self, op):
+        fut = Future()
+        self.queue.append((op, fut))
+        return fut
+
+    def resolved_locally(self, value):
+        fut = Future()
+        fut.set_result(value)
+        return fut
+
+    def except_arm_resolves(self, results):
+        fut = Future()
+        try:
+            fut.set_result(results.pop())
+        except Exception as e:
+            fut.set_exception(e)
+        return fut
+
+    def except_arm_reraises(self, results):
+        fut = Future()
+        self.queue.append(fut)
+        try:
+            fut.set_result(results.pop())
+        except Exception:
+            raise
+        return fut
+
+    def handed_off_in_tuple(self, op):
+        # Escape through a container argument (the coalescer's
+        # seg.futures.append((fut, start, n, ...)) shape).
+        fut = Future()
+        self.queue.append((fut, 0, 1, None))

@@ -1,0 +1,611 @@
+"""Deterministic schedule explorer (ISSUE 9): harness units, model
+checks of the four core state machines, and the historical race bugs
+re-encoded as schedule tests.
+
+Every ``@schedule_test`` body runs under the cooperative scheduler in
+``redisson_tpu/analysis/explorer.py``: interleavings are explored
+bounded-exhaustively, any failing schedule prints a replay token, and
+``RTPU_SCHEDULE_REPLAY=<token>`` re-runs exactly that schedule.
+
+The historical tests are MUTATION-STYLE guards: each drives the REAL
+shipped code (``RespServer._rc_install``, ``TpuSketchEngine._degraded``,
+``TenantGovernor.set_limits``) through the interleaving that broke the
+pre-fix version — reverting the fix makes a schedule fail
+deterministically.
+"""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from redisson_tpu.analysis.explorer import (
+    DeadlockError,
+    ScheduleFailure,
+    checkpoint,
+    explore,
+    schedule_test,
+)
+
+pytestmark = pytest.mark.explorer
+
+
+# -- harness units ------------------------------------------------------------
+
+
+def _lost_update_body():
+    state = {"x": 0}
+
+    def worker():
+        v = state["x"]
+        checkpoint("between read and write")
+        state["x"] = v + 1
+
+    t1 = threading.Thread(target=worker)
+    t2 = threading.Thread(target=worker)
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    assert state["x"] == 2, f"lost update: x={state['x']}"
+
+
+def test_explorer_finds_lost_update_and_replays_it():
+    with pytest.raises(ScheduleFailure) as ei:
+        explore(_lost_update_body, max_schedules=500, preemption_bound=2)
+    token = ei.value.token
+    assert token.startswith("x:")
+    # The printed token replays EXACTLY the failing schedule.
+    with pytest.raises(ScheduleFailure) as ei2:
+        explore(_lost_update_body, replay=token)
+    assert ei2.value.token == token
+
+
+def test_preemption_bound_zero_hides_the_race():
+    # With no preemptions allowed, each worker runs its read->write
+    # atomically — the schedule space collapses and the race is
+    # unreachable (the knob trades coverage for tractability).
+    r = explore(_lost_update_body, max_schedules=2000, preemption_bound=0)
+    assert r.complete
+
+
+def test_lock_closes_the_race_exhaustively():
+    def body():
+        state = {"x": 0}
+        lock = threading.Lock()
+
+        def worker():
+            with lock:
+                v = state["x"]
+                checkpoint()
+                state["x"] = v + 1
+
+        ts = [threading.Thread(target=worker) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert state["x"] == 2
+
+    r = explore(body, max_schedules=5000, preemption_bound=2)
+    assert r.complete  # the whole interleaving tree was proven
+
+
+def test_exploration_is_deterministic():
+    counts = []
+    for _ in range(2):
+        r = explore(_lost_update_body, max_schedules=2000,
+                    preemption_bound=0)
+        counts.append(r.schedules)
+    assert counts[0] == counts[1]
+
+
+def test_deadlock_detection_reports_ab_ba():
+    def body():
+        a, b = threading.Lock(), threading.Lock()
+
+        def t1():
+            with a:
+                checkpoint()
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                checkpoint()
+                with a:
+                    pass
+
+        x1 = threading.Thread(target=t1)
+        x2 = threading.Thread(target=t2)
+        x1.start()
+        x2.start()
+        x1.join()
+        x2.join()
+
+    with pytest.raises(ScheduleFailure) as ei:
+        explore(body, max_schedules=2000, preemption_bound=2)
+    assert isinstance(ei.value.__cause__, DeadlockError)
+    assert "lock" in str(ei.value.__cause__)
+
+
+def test_virtual_clock_orders_sleeps_instantly():
+    def body():
+        order = []
+
+        def s(tag, secs):
+            time.sleep(secs)
+            order.append(tag)
+
+        t1 = threading.Thread(target=s, args=("slow", 100.0))
+        t2 = threading.Thread(target=s, args=("fast", 5.0))
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        assert order == ["fast", "slow"], order
+
+    t0 = time.monotonic()
+    r = explore(body, max_schedules=200)
+    assert r.complete
+    assert time.monotonic() - t0 < 5.0  # 100 virtual seconds cost ~nothing
+
+
+def test_queue_and_future_primitives_are_cooperative():
+    import queue
+    from concurrent.futures import Future
+
+    def body():
+        q = queue.Queue(maxsize=1)
+        f = Future()
+        got = []
+
+        def consumer():
+            for _ in range(3):
+                got.append(q.get())
+            f.set_result(sum(got))
+
+        def producer():
+            for i in range(3):
+                q.put(i)
+
+        c = threading.Thread(target=consumer)
+        p = threading.Thread(target=producer)
+        c.start()
+        p.start()
+        assert f.result(timeout=30) == 3
+        c.join()
+        p.join()
+        assert got == [0, 1, 2], got
+
+    r = explore(body, max_schedules=400, preemption_bound=1)
+    assert r.schedules >= 1
+
+
+# -- model check 1: coalescer flush / park / merge ----------------------------
+
+
+class _FakeLazy:
+    def __init__(self, v):
+        self._v = v
+
+    def result(self):
+        return self._v
+
+    def get(self):
+        return self._v
+
+
+@schedule_test(max_schedules=60, random_schedules=24, preemption_bound=1,
+               max_steps=200000)
+def test_model_coalescer_flush_park_merge():
+    """Two producer threads × flaky-once dispatch: across every explored
+    schedule, (a) every future resolves with its own op's value, (b) no
+    op is lost or double-dispatched through the park/backoff/merge
+    machinery, (c) shutdown drains cleanly."""
+    from redisson_tpu.executor.coalescer import BatchCoalescer
+
+    calls = []
+    flaky = {"armed": True}
+
+    def dispatch(cols):
+        if flaky["armed"]:
+            flaky["armed"] = False
+            raise RuntimeError("transient dispatch failure")
+        arr = np.asarray(cols[0])
+        calls.append(arr.copy())
+        return _FakeLazy(arr * 2)
+
+    c = BatchCoalescer(batch_window_us=200, max_batch=4, max_inflight=2,
+                       retry_attempts=3, retry_interval_s=0.01,
+                       adaptive_window=False, adaptive_inflight=False)
+    futs = []
+
+    def producer(base):
+        for i in range(2):
+            futs.append((base + i,
+                         c.submit(("op", 1), dispatch,
+                                  (np.asarray([base + i]),), 1,
+                                  pool_key="p")))
+
+    t = threading.Thread(target=producer, args=(100,))
+    t.start()
+    producer(200)
+    t.join()
+    for val, f in futs:
+        got = f.result(timeout=60)
+        assert list(got) == [val * 2], (val, got)
+    c.drain(timeout=60)
+    total = sum(len(a) for a in calls)
+    assert total == 4, f"ops dispatched {total} != 4 submitted"
+    c.shutdown()
+
+
+@schedule_test(max_schedules=40, random_schedules=16, preemption_bound=1,
+               max_steps=200000)
+def test_model_coalescer_deadline_shed_vs_healthy_traffic():
+    """An expired-at-flush segment is shed without dispatch while a
+    healthy segment behind it still completes — in every schedule."""
+    from redisson_tpu.executor.coalescer import BatchCoalescer
+    from redisson_tpu.executor.failures import DeadlineExceededError
+
+    dispatched = []
+
+    def dispatch(cols):
+        arr = np.asarray(cols[0])
+        dispatched.append(arr.copy())
+        return _FakeLazy(arr)
+
+    # A huge flush window parks young segments in the queue, so the
+    # doomed op is still QUEUED when its deadline lapses (virtually).
+    c = BatchCoalescer(batch_window_us=10_000_000, max_batch=4,
+                       adaptive_window=False, adaptive_inflight=False)
+    # Deadline generous enough to pass the submit-time check, expired
+    # by the time the flush loop sweeps (virtual sleep below).
+    dead = c.submit(("doomed", 1), dispatch, (np.asarray([1]),), 1,
+                    pool_key="d", deadline=time.monotonic() + 0.001)
+    time.sleep(0.05)  # virtual: expires the deadline while queued
+    live = c.submit(("live", 1), dispatch, (np.asarray([2]),), 1,
+                    pool_key="l")
+    assert list(live.result(timeout=60)) == [2]
+    with pytest.raises(DeadlineExceededError):
+        dead.result(timeout=60)
+    assert all(1 not in a for a in dispatched), \
+        "expired op reached the device"
+    c.shutdown()
+
+
+# -- model check 2: breaker CLOSED -> OPEN -> HALF_OPEN -----------------------
+
+
+@schedule_test(max_schedules=400, random_schedules=64, preemption_bound=2)
+def test_model_breaker_single_probe_half_open():
+    """Across every schedule: the open window refuses dispatch, exactly
+    ONE of two racing callers is admitted as the half-open probe, and
+    the probe's success closes the circuit."""
+    from redisson_tpu.executor.health import (
+        BreakerBoard, CLOSED, OPEN,
+    )
+
+    board = BreakerBoard(failure_threshold=2, open_s=1.0,
+                         clock=time.monotonic)
+    board.record_failure(0, "op")
+    board.record_failure(0, "op")
+    assert board.states()[(0, "op")] == OPEN
+    assert not board.allow(0, "op"), "open circuit admitted a dispatch"
+
+    time.sleep(1.5)  # virtual: the open window elapses
+    admitted = []
+
+    def prober(tag):
+        checkpoint(f"probe {tag}")
+        if board.allow(0, "op"):
+            admitted.append(tag)
+
+    t1 = threading.Thread(target=prober, args=("a",))
+    t2 = threading.Thread(target=prober, args=("b",))
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    assert len(admitted) == 1, \
+        f"half-open admitted {admitted} — one probe at a time"
+    board.record_success(0, "op")
+    assert board.states()[(0, "op")] == CLOSED
+    assert board.allow(0, "op")
+
+
+@schedule_test(max_schedules=300, random_schedules=64, preemption_bound=2)
+def test_model_breaker_failure_success_race_never_wedges():
+    """record_failure / record_success racing from two threads: the
+    breaker always lands in a legal state and a later success from
+    half-open always closes (no schedule wedges it open forever)."""
+    from redisson_tpu.executor.health import BreakerBoard, CLOSED
+
+    board = BreakerBoard(failure_threshold=1, open_s=0.5,
+                         clock=time.monotonic)
+
+    def failer():
+        board.record_failure(0, "op")
+
+    def succeeder():
+        board.record_success(0, "op")
+
+    t1 = threading.Thread(target=failer)
+    t2 = threading.Thread(target=succeeder)
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    assert board.states()[(0, "op")] in ("closed", "open")
+    # Recovery is always reachable: wait out the window, probe, succeed.
+    time.sleep(1.0)
+    assert board.allow(0, "op")
+    board.record_success(0, "op")
+    assert board.states()[(0, "op")] == CLOSED
+
+
+# -- model check 3: near-cache epoch protocol ---------------------------------
+
+
+@schedule_test(max_schedules=600, random_schedules=64, preemption_bound=2)
+def test_model_nearcache_never_serves_stale_after_write():
+    """The whole epoch correctness argument, model-checked: a reader
+    that captured its epoch pair before submitting can NEVER install a
+    pre-write value that a post-write probe then serves.  Removing the
+    exit bump (or the capture-before-submit guard) makes a schedule
+    fail."""
+    from redisson_tpu.cache.lru import MISS, ShardedLRUStore
+    from redisson_tpu.cache.nearcache import SketchNearCache
+
+    store = ShardedLRUStore(max_bytes=1 << 20, nshards=2)
+    nc = SketchNearCache(store, max_batch=16)
+    name, key = "obj", (1, 2)
+    truth = {"v": 0}
+
+    def writer():
+        nc.note_write(name)       # entry bump: write is in flight
+        checkpoint("device applies the write")
+        truth["v"] = 1
+        checkpoint("between apply and exit bump")
+        nc.note_write(name)       # exit bump: retires in-window installs
+
+    def reader():
+        captured = nc.epochs(name)  # capture BEFORE submitting the miss
+        checkpoint("miss dispatched")
+        seen = truth["v"]           # the device-side read, ordered freely
+        checkpoint("result resolves")
+        nc.install(name, key, seen, captured=captured, monotone=False)
+
+    w = threading.Thread(target=writer)
+    r = threading.Thread(target=reader)
+    w.start()
+    r.start()
+    w.join()
+    r.join()
+    v = nc.probe(name, key)
+    assert v is MISS or v == truth["v"], \
+        f"stale value {v!r} served after the write (truth={truth['v']})"
+
+
+# -- model check 4 + historical race 3: tenant governor -----------------------
+
+
+@schedule_test(max_schedules=500, random_schedules=64, preemption_bound=2)
+def test_model_governor_charge_release_balance():
+    """Concurrent admit/release across two tenants: in-flight charges
+    never go negative, never leak, and capacity freed by release is
+    admittable again in every schedule."""
+    from redisson_tpu.executor.failures import TenantThrottledError
+    from redisson_tpu.tenancy.registry import TenantGovernor
+
+    gov = TenantGovernor(max_inflight=4, clock=time.monotonic)
+
+    def tenant_load(tenant):
+        gov.admit(tenant, 3)
+        checkpoint(f"{tenant} ops in flight")
+        gov.release(tenant, 3)
+        gov.admit(tenant, 2)
+        gov.release(tenant, 2)
+
+    t1 = threading.Thread(target=tenant_load, args=("a",))
+    t2 = threading.Thread(target=tenant_load, args=("b",))
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    assert gov._inflight == {}, f"leaked charges: {gov._inflight}"
+    gov.admit("a", 4)  # full quota must be free again
+    with pytest.raises(TenantThrottledError):
+        gov.admit("a", 1)
+    gov.release("a", 4)
+
+
+@schedule_test(max_schedules=400, random_schedules=64, preemption_bound=2)
+def test_history_governor_stranded_inflight_charges():
+    """PR 7 review bug, re-encoded (CHANGES.md PR 7 'Review hardening'):
+    release() is skipped while max_inflight is 0, so charges taken
+    before a disable were stranded forever once re-enabled — the fix
+    makes set_limits clear in-flight charges too.  Reverting that
+    clear makes every schedule here fail."""
+    from redisson_tpu.tenancy.registry import TenantGovernor
+
+    gov = TenantGovernor(max_inflight=4, clock=time.monotonic)
+
+    def tenant():
+        gov.admit("t", 3)
+        checkpoint("ops in flight across the disable")
+        gov.release("t", 3)  # no-op while the quota is disabled
+
+    def operator():
+        checkpoint("operator reconfigures")
+        gov.set_limits(max_inflight=0)   # disable
+        checkpoint("quota disabled")
+        gov.set_limits(max_inflight=4)   # re-enable
+
+    t1 = threading.Thread(target=tenant)
+    t2 = threading.Thread(target=operator)
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    # Whatever the interleaving, the tenant must not be throttled
+    # forever by charges stranded across the disable/re-enable cycle.
+    if gov.max_inflight > 0:
+        gov.admit("t", 2)
+        gov.release("t", 2)
+
+
+# -- historical race 1: the _rc_install epoch race (PR 5 review) --------------
+
+
+def _resp_server_stub():
+    from redisson_tpu.serve.resp import RespServer
+
+    stub = types.SimpleNamespace(
+        _write_epoch=0,
+        _epoch_lock=threading.Lock(),
+        obs=None,
+        response_cache_size=128,
+    )
+    return RespServer, stub
+
+
+@schedule_test(max_schedules=600, random_schedules=64, preemption_bound=2)
+def test_history_rc_install_drops_cross_epoch_frame():
+    """PR 5 review bug, re-encoded (CHANGES.md PR 5 'Review hardening'):
+    _rc_install used to RE-HOME a frame computed before a concurrent
+    write under the new epoch — a pre-write reply outlived the write.
+    The fix drops the frame when the epoch moved between probe and
+    install.  This drives the REAL RespServer methods; reverting the
+    drop (falling through to install after the epoch check) fails."""
+    RespServer, srv = _resp_server_stub()
+    truth = {"v": b"+0\r\n"}
+    acked = {"done": False}
+    rc: dict = {}
+    rc_state = [srv._write_epoch]
+    name, cmd = "GET", (b"GET", b"k")
+
+    def writer():
+        # The real server's ordering (_safe_dispatch): the command
+        # APPLIES, then the epoch bumps, then the reply is sent — the
+        # write is ACKED only after the bump (resp.py ~830).  Until
+        # the ack, a concurrent reader may legally see pre-write state
+        # (same as two independent Redis clients).
+        checkpoint("write arrives")
+        truth["v"] = b"+1\r\n"
+        RespServer._bump_write_epoch(srv)
+        acked["done"] = True
+
+    def connection():
+        hit = RespServer._rc_probe(srv, rc, rc_state, name, cmd)
+        if hit is None:
+            checkpoint("reply computed")
+            frame = truth["v"]  # may predate the concurrent write
+            checkpoint("install")
+            RespServer._rc_install(srv, rc, rc_state, name, cmd, frame)
+        # Second identical command in the same pipeline window: once
+        # the write is ACKED, a cached hit must never predate it.
+        hit2 = RespServer._rc_probe(srv, rc, rc_state, name, cmd)
+        if hit2 is not None and acked["done"]:
+            assert hit2 == truth["v"], \
+                f"stale cached reply {hit2!r} served after the acked " \
+                f"write (truth {truth['v']!r})"
+
+    w = threading.Thread(target=writer)
+    c = threading.Thread(target=connection)
+    w.start()
+    c.start()
+    w.join()
+    c.join()
+
+
+# -- historical race 2: mirror seeding vs reconcile (PR 3 round 2) ------------
+
+
+class _HealthStub:
+    def __init__(self):
+        self.degraded = {"bloom"}
+
+    @property
+    def any_degraded(self):
+        return bool(self.degraded)
+
+    def degraded_kind(self, kind):
+        return kind in self.degraded
+
+
+@schedule_test(max_schedules=800, random_schedules=64, preemption_bound=2,
+               max_steps=100000)
+def test_history_mirror_seed_epoch_guard():
+    """PR 3 second-round bug, re-encoded (CHANGES.md PR 3): mirror
+    seeding runs OUTSIDE the mirror lock.  The lost-acked-writes
+    schedule: a SLOW seeder snapshots the device row ("v0"), then a
+    faster op seeds+writes the mirror ("v1" = v0 + an acked write),
+    reconcile writes "v1" back to the device and drops the mirror
+    (bumping _mirror_epoch under the lock), the breaker re-opens —
+    and the slow seeder finally re-locks holding its ancient "v0"
+    snapshot.  The epoch guard in the REAL TpuSketchEngine._degraded
+    (`if self._mirror_epoch != epoch: continue`) discards the stale
+    row and re-seeds; reverting it installs "v0" as the mirror,
+    resurrecting pre-reconcile state — the acked write dies on the
+    next write-back."""
+    from redisson_tpu.objects.engines import TpuSketchEngine
+
+    device = {"row": "v0"}
+    health = _HealthStub()
+    entry = types.SimpleNamespace(name="t", kind="bloom")
+    stub = types.SimpleNamespace(
+        _mirrors={},
+        _mirror_lock=threading.RLock(),
+        _mirror_epoch=0,
+        health=health,
+    )
+
+    def seed_row(_entry):
+        checkpoint("seed read dispatched")
+        row = device["row"]
+        checkpoint("seed read resolves")
+        return row
+
+    def install_mirror(_entry, row):
+        stub._mirrors[_entry.name] = row
+
+    stub._seed_row = seed_row
+    stub._install_mirror = install_mirror
+
+    def slow_seeder():
+        TpuSketchEngine._degraded(stub, entry)
+
+    def mirror_write_reconcile_flap():
+        # A faster op's mirror takes an acked write...
+        checkpoint("fast op seeds the mirror")
+        with stub._mirror_lock:
+            stub._mirrors["t"] = "v1"  # v0 + an acked degraded write
+        checkpoint("reconcile starts")
+        # ...reconcile writes it back and drops it (the real
+        # _reconcile_kind's discipline: write-back, drop, epoch bump,
+        # clear — all under the mirror lock)...
+        with stub._mirror_lock:
+            for n in list(stub._mirrors):
+                device["row"] = stub._mirrors.pop(n)
+            stub._mirror_epoch += 1
+            health.degraded = set()
+        checkpoint("breaker re-opens")
+        # ...and the kind flaps back to degraded.
+        health.degraded = {"bloom"}
+
+    s = threading.Thread(target=slow_seeder)
+    r = threading.Thread(target=mirror_write_reconcile_flap)
+    s.start()
+    r.start()
+    s.join()
+    r.join()
+    mirror = stub._mirrors.get("t")
+    assert mirror is None or mirror == device["row"], (
+        f"stale row {mirror!r} installed as mirror while the device "
+        f"holds {device['row']!r} — the acked write would be lost on "
+        f"the next write-back"
+    )

@@ -1,0 +1,252 @@
+"""Static lock-order graph (ISSUE 9): catalog extraction, the
+whole-tree cycle gate, the artificial out-of-order fixture, runtime
+merge, and RT010 suppression semantics."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from redisson_tpu.analysis import lockgraph
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "redisson_tpu")
+
+
+# -- the CI gate on the shipped tree ------------------------------------------
+
+
+def test_shipped_tree_catalog_covers_all_tiers():
+    g = lockgraph.build_graph([PKG])
+    names = set(g.catalog)
+    # The original witness tier...
+    for expected in ("coalescer.queue", "coalescer.inflight",
+                     "engine.mirror", "resp.conn.send",
+                     "tenancy.governor", "tenancy.registry",
+                     "nearcache.epochs", "health.breakers"):
+        assert expected in names, f"missing {expected}"
+    # ...and the grid/serve tier this PR names (ROADMAP "witness
+    # coverage for grid/ locks").
+    for expected in ("grid.store", "grid.shared_pool",
+                     "grid.localmap.hub", "grid.topics.bus",
+                     "grid.services.executor", "serve.ingest",
+                     "serve.metrics", "serve.nodes.sweep",
+                     "serve.native_codec"):
+        assert expected in names, f"missing {expected}"
+
+
+def test_shipped_tree_has_no_lock_order_cycles():
+    """The acceptance criterion's clean half: the static gate passes on
+    the shipped tree (same check CI runs)."""
+    graph, violations = lockgraph.lint_tree([PKG])
+    assert violations == [], "\n".join(v.format() for v in violations)
+    assert len(graph.catalog) >= 30
+
+
+def test_shipped_tree_finds_the_known_real_edges():
+    """Interprocedural proof: the engine.mirror -> health.state edge
+    only exists through a call chain (_reconcile_kind under the mirror
+    lock calls health.clear_degraded, which takes health.state)."""
+    g = lockgraph.build_graph([PKG])
+    assert ("engine.mirror", "health.state") in g.edges
+    site = g.edges[("engine.mirror", "health.state")][0]
+    assert site.chain, "edge should carry its call chain"
+
+
+# -- artificial out-of-order acquisition (the failing half) -------------------
+
+
+_CYCLE_SRC = """
+    import threading
+
+    from redisson_tpu.analysis import witness as _witness
+
+    LOCK_A = _witness.named(threading.Lock(), "fix.a")
+    LOCK_B = _witness.named(threading.Lock(), "fix.b")
+
+
+    def forward():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+
+
+    def backward():
+        with LOCK_B:
+            with LOCK_A:
+                pass
+"""
+
+
+def test_artificial_out_of_order_acquisition_fails_the_gate(tmp_path):
+    """The acceptance criterion's failing half: an introduced
+    out-of-order acquisition trips RT010 even though no test ever runs
+    the bad schedule."""
+    mod = tmp_path / "crossed.py"
+    mod.write_text(textwrap.dedent(_CYCLE_SRC))
+    graph, violations = lockgraph.lint_tree([str(mod)])
+    assert ("fix.a", "fix.b") in graph.edges
+    assert ("fix.b", "fix.a") in graph.edges
+    assert len(violations) == 1
+    v = violations[0]
+    assert v.rule == "RT010"
+    assert "fix.a" in v.message and "fix.b" in v.message
+    assert "potential deadlock" in v.message
+
+
+def test_cross_function_cycle_via_call_chain(tmp_path):
+    """A cycle assembled across FUNCTIONS (neither function nests both
+    locks lexically) is still found through call resolution."""
+    mod = tmp_path / "chained.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+
+        from redisson_tpu.analysis import witness as _witness
+
+
+        class Left:
+            def __init__(self):
+                self._left_lock = _witness.named(
+                    threading.Lock(), "chain.left"
+                )
+
+            def outer(self, right):
+                with self._left_lock:
+                    right.take_right()
+
+            def take_left(self):
+                with self._left_lock:
+                    pass
+
+
+        class Right:
+            def __init__(self):
+                self._right_lock = _witness.named(
+                    threading.Lock(), "chain.right"
+                )
+
+            def outer(self, left):
+                with self._right_lock:
+                    left.take_left()
+
+            def take_right(self):
+                with self._right_lock:
+                    pass
+    """))
+    graph, violations = lockgraph.lint_tree([str(mod)])
+    assert len(violations) == 1
+    assert "chain.left" in violations[0].message
+    assert "chain.right" in violations[0].message
+
+
+def test_rt010_suppression_documents_a_by_design_edge(tmp_path):
+    mod = tmp_path / "allowed.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+
+        from redisson_tpu.analysis import witness as _witness
+
+        LOCK_A = _witness.named(threading.Lock(), "ok.a")
+        LOCK_B = _witness.named(threading.Lock(), "ok.b")
+
+
+        def forward():
+            with LOCK_A:
+                with LOCK_B:
+                    pass
+
+
+        def backward():
+            with LOCK_B:
+                # rtpulint: disable=RT010 teardown-only path, forward() can never run concurrently
+                with LOCK_A:
+                    pass
+    """))
+    graph, violations = lockgraph.lint_tree([str(mod)])
+    assert violations == []
+    assert ("ok.b", "ok.a") in graph.suppressed
+
+
+# -- runtime witness merge ----------------------------------------------------
+
+
+def test_runtime_edges_close_a_static_half_cycle(tmp_path):
+    """Static A->B + witness-OBSERVED B->A = reported cycle: schedules
+    the static pass cannot see (dynamic dispatch, getattr) still gate
+    CI when the witness recorded them."""
+    mod = tmp_path / "half.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+
+        from redisson_tpu.analysis import witness as _witness
+
+        LOCK_A = _witness.named(threading.Lock(), "half.a")
+        LOCK_B = _witness.named(threading.Lock(), "half.b")
+
+
+        def forward():
+            with LOCK_A:
+                with LOCK_B:
+                    pass
+    """))
+    graph, violations = lockgraph.lint_tree([str(mod)])
+    assert violations == []
+    graph, violations = lockgraph.lint_tree(
+        [str(mod)], runtime_edges=[("half.b", "half.a")]
+    )
+    assert len(violations) == 1
+    assert lockgraph.RUNTIME_SITE in violations[0].message
+
+
+def test_witness_export_edges_round_trip(tmp_path):
+    """witness.export_edges / export_to produce exactly the shape
+    load_runtime_edges reads."""
+    from redisson_tpu.analysis import witness
+
+    witness.force(True)
+    try:
+        import threading
+
+        a = witness.named(threading.Lock(), "xport.a")
+        b = witness.named(threading.Lock(), "xport.b")
+        with a:
+            with b:
+                pass
+        edges = witness.export_edges()
+        assert ("xport.a", "xport.b") in edges
+        path = tmp_path / "edges.json"
+        witness.export_to(str(path))
+        loaded = lockgraph.load_runtime_edges(str(path))
+        assert ("xport.a", "xport.b") in loaded
+    finally:
+        witness.force(False)
+        witness.reset()
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_lock_graph_gate_and_dump(tmp_path):
+    """`python -m redisson_tpu.analysis <dir>` runs the RT010 pass on
+    directories and exits 1 on a cycle; --dump-lock-graph emits the
+    catalog + edges JSON."""
+    pkgdir = tmp_path / "tree"
+    pkgdir.mkdir()
+    (pkgdir / "crossed.py").write_text(textwrap.dedent(_CYCLE_SRC))
+    proc = subprocess.run(
+        [sys.executable, "-m", "redisson_tpu.analysis", str(pkgdir)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "RT010" in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "redisson_tpu.analysis",
+         "--dump-lock-graph", str(pkgdir)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    dumped = json.loads(proc.stdout)
+    assert "fix.a" in dumped["catalog"]
+    assert "fix.a -> fix.b" in dumped["edges"]

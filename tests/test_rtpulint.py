@@ -20,7 +20,8 @@ FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures", "rtpulint")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _EXPECT_RE = re.compile(r"#\s*rtpulint-expect:\s*(RT\d{3})")
 
-CHECKED_RULES = ("RT001", "RT002", "RT003", "RT004", "RT005", "RT006")
+CHECKED_RULES = ("RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
+                 "RT007", "RT008", "RT009")
 
 
 def _expected(path):
