@@ -616,6 +616,65 @@ def bench_config6_frontdoor(make_client):
         client.shutdown()
 
 
+def bench_journal_ab(_make_client):
+    """ISSUE 10 acceptance: journal-on overhead A/B.  The same batched
+    bloom add pass (the acked-write hot path) runs with journaling off,
+    ``everysec``, and ``always`` — identical traffic, fresh directories.
+    ``always`` pays a group-commit fsync barrier per blocking call on a
+    single producer (no other writers to amortize with), so its key is
+    the honest worst case; ``everysec`` shows the steady-state serving
+    cost (append + background fsync)."""
+    import os
+    import shutil
+    import tempfile
+
+    import redisson_tpu
+    from redisson_tpu import Config
+    from redisson_tpu.codecs import LongCodec
+
+    N_CALLS, B = 48, 1024
+    rng = np.random.default_rng(17)
+    keys = rng.integers(0, 1 << 40, size=(N_CALLS, B), dtype=np.uint64)
+    out = {}
+    for label, fsync in (
+        ("off", None), ("everysec", "everysec"), ("always", "always")
+    ):
+        tmp = tempfile.mkdtemp(prefix="rtpu-journal-ab-")
+        cfg = Config().set_codec(LongCodec()).use_tpu_sketch(
+            min_bucket=256
+        )
+        if fsync is not None:
+            cfg.journal_dir = os.path.join(tmp, "journal")
+            cfg.journal_fsync = fsync
+        client = redisson_tpu.create(cfg)
+        try:
+            bf = client.get_bloom_filter("journal-ab")
+            bf.try_init(1_000_000, 0.01)
+            bf.add_all(keys[0])  # compile warm-up, excluded
+            t0 = time.perf_counter()
+            for i in range(1, N_CALLS):
+                bf.add_all(keys[i])
+            dt = time.perf_counter() - t0
+            out[f"journal_{label}_ops_per_sec"] = round(
+                (N_CALLS - 1) * B / dt
+            )
+            j = client._engine.journal
+            if j is not None:
+                st = j.stats()
+                out[f"journal_{label}_fsyncs"] = st["fsyncs"]
+                out[f"journal_{label}_bytes"] = st["bytes_written"]
+        finally:
+            client.shutdown()
+            shutil.rmtree(tmp, ignore_errors=True)
+    off = out.get("journal_off_ops_per_sec") or 0
+    for label in ("everysec", "always"):
+        on = out.get(f"journal_{label}_ops_per_sec")
+        out[f"journal_{label}_overhead_pct"] = (
+            round(100.0 * (1.0 - on / off), 1) if off and on else None
+        )
+    return out
+
+
 def bench_config7_overload(make_client):
     """Config 7 (ISSUE 7): open-loop overload A/B.  Offered load is held
     at ~2x the measured saturation throughput; the ON arm attaches an op
@@ -1217,6 +1276,9 @@ def main():
     # 2x offered load; OFF shows the queue-wait collapse.  Plus the
     # tenant-fairness mini-pass.
     overload_stats = bench_config7_overload(make_client)
+    # Durability tier A/B (ISSUE 10): journal off vs everysec vs always
+    # on the acked-write path (journal_* keys).
+    journal_stats = bench_journal_ab(make_client)
     host_ops = measure_host_baseline()
 
     # vs_baseline: the bench env ships no redis-server, so the Redis-backed
@@ -1268,6 +1330,10 @@ def main():
                     # Overload control plane (ISSUE 7): config7_overload
                     # open-loop A/B + fairness soak keys (overload_*).
                     **overload_stats,
+                    # Durability tier (ISSUE 10): journal-on overhead
+                    # A/B — off vs everysec vs always on the acked
+                    # bloom-add path, with fsync counts (journal_*).
+                    **journal_stats,
                     "hll_pfadd_ops_per_sec": round(hll_ops),
                     "config3_bitset_ops_per_sec": round(bitset_ops),
                     "config4_mixed_ops_per_sec": round(mixed_ops),

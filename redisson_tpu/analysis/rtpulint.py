@@ -98,7 +98,10 @@ RULES = {
 
 # Roles a rule applies to.  "*" = every non-test module.
 _RULE_ROLES = {
-    "RT001": {"dispatch", "engine", "cache", "serve", "tenancy"},
+    # "journal" (durability/): the group-commit writer and its waiters
+    # hold the queue lock around condition waits — blocking I/O under it
+    # would stall every producer's append (ISSUE 10 satellite).
+    "RT001": {"dispatch", "engine", "cache", "serve", "tenancy", "journal"},
     "RT002": {"serve"},
     "RT003": {"*"},
     "RT004": {"*"},  # self-scoping: only fires where a config table lives
@@ -118,6 +121,7 @@ _ROLE_BY_PATH = (
     ("cache", "cache"),
     ("serve", "serve"),
     ("tenancy", "tenancy"),
+    ("durability", "journal"),
     ("chaos", "chaos"),
     ("analysis", "analysis"),
 )

@@ -22,6 +22,16 @@ point                   where it fires
 ``prewarm``             AOT bucket pre-warm worker, before each warm call
 ``snapshot.save``       snapshot()/dump() I/O
 ``snapshot.load``       restore_snapshot()/restore() I/O
+``snapshot.rename``     between the snapshot tmp-file fsync and its rename
+                        (the crash window the ISSUE 10 satellite closes)
+``journal.write``       op-journal writer, before each group-commit batch
+                        write (durability/journal.py)
+``journal.fsync``       before each journal fsync (latency rules here
+                        inflate the admission lag estimate under
+                        appendfsync=always)
+``journal.torn_tail``   per journal frame: when it fires, HALF the frame
+                        reaches the file and the journal breaks — the
+                        crash-mid-write simulation recovery must truncate
 ======================  ====================================================
 
 Zero-overhead-when-disabled contract: every call site is guarded by the

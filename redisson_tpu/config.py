@@ -204,6 +204,19 @@ class Config:
         # Snapshot/restore (checkpoint row, SURVEY.md §5).
         self.snapshot_dir: Optional[str] = None
         self.snapshot_interval_s: float = 0.0  # 0 → no periodic snapshots
+        # Crash-safe durability tier (ISSUE 10): the AOF analog.  With a
+        # journal_dir set, every accepted sketch mutation appends a
+        # CRC32-framed record (durability/journal.py); recovery =
+        # restore_snapshot + deterministic tail replay through the host
+        # golden engine.  ``journal_fsync`` maps to appendfsync
+        # always|everysec|no (live-settable via CONFIG SET appendfsync):
+        # under ``always`` an op's ack resolves only after its record is
+        # fsynced.  Segments rotate at journal_max_segment_bytes; a
+        # completed snapshot retires covered segments (the BGREWRITEAOF
+        # analog).
+        self.journal_dir: Optional[str] = None
+        self.journal_fsync: str = "everysec"
+        self.journal_max_segment_bytes: int = 64 << 20
         # Front-door auth (→ the reference server configs' `password`
         # key, org/redisson/config/BaseConfig#setPassword): when set,
         # every RESP connection must AUTH (or HELLO ... AUTH) before any
@@ -288,6 +301,9 @@ class Config:
         "timeout_ms",
         "snapshot_dir",
         "snapshot_interval_s",
+        "journal_dir",
+        "journal_fsync",
+        "journal_max_segment_bytes",
         "requirepass",
         "enable_python_scripts",
         "script_timeout_ms",

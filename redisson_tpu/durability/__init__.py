@@ -1,0 +1,27 @@
+"""Crash-safe durability tier (ISSUE 10 tentpole).
+
+``journal.py`` — the append-only op journal (the AOF analog): every
+accepted mutation is a CRC32-framed record in segment files, written by
+a group-commit writer thread under the ``appendfsync always|everysec|no``
+policies, truncated in coordination with snapshots (the BGREWRITEAOF
+analog), and replayed deterministically through the host golden engine
+at recovery (``recovery.py``).
+"""
+
+from redisson_tpu.durability.journal import (
+    FSYNC_POLICIES,
+    JournalError,
+    OpJournal,
+    decode_record,
+    encode_record,
+)
+from redisson_tpu.durability.recovery import replay_journal
+
+__all__ = [
+    "FSYNC_POLICIES",
+    "JournalError",
+    "OpJournal",
+    "decode_record",
+    "encode_record",
+    "replay_journal",
+]

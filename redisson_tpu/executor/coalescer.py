@@ -244,6 +244,12 @@ class BatchCoalescer:
         # exceeds its residual budget (blocking at the queue bound stays
         # the no-deadline default).
         self.fetch_timeout_s = max(0.001, float(fetch_timeout_s))
+        # Durability tier (ISSUE 10): under appendfsync=always the
+        # engine points this at OpJournal.lag_s — the estimated wait
+        # until a NEW record fsyncs rides the admission estimate, so a
+        # slow journal disk sheds deadline-carrying load at the door
+        # instead of queueing acks unboundedly behind the fsync barrier.
+        self.journal_lag_s: Optional[Callable[[], float]] = None
         self._service_ewma_s = 0.0
         self._ops_per_launch_ewma = 0.0
         self.last_est_wait_s = 0.0  # rtpu_admission_est_wait_us gauge
@@ -490,6 +496,12 @@ class BatchCoalescer:
             opl = max(1.0, self._ops_per_launch_ewma)
             launches_ahead = self._queued_ops / opl + self._uncollected
             est = svc * launches_ahead / max(1, self._inflight_limit)
+        jl = self.journal_lag_s
+        if jl is not None:
+            try:
+                est += jl()
+            except Exception:  # pragma: no cover — broken journal
+                pass
         if _chaos.ENABLED:
             est += _chaos.bias("overload.pressure")
         self.last_est_wait_s = est

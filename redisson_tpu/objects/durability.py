@@ -37,6 +37,19 @@ _SNAP_META = "sketch_meta.json"
 _SNAP_POOLS = "sketch_pools.npz"
 
 
+def _crc_stream(f, chunk: int = 1 << 22) -> int:
+    """CRC32 of an open binary file in bounded chunks — a multi-GB pool
+    blob must not be read resident just to checksum it."""
+    import zlib
+
+    crc = 0
+    while True:
+        buf = f.read(chunk)
+        if not buf:
+            return crc
+        crc = zlib.crc32(buf, crc)
+
+
 def safe_load_npy(buf: io.BytesIO) -> np.ndarray:
     """np.load for UNTRUSTED dump payloads: a forged .npy header can
     declare an arbitrarily large shape and make np.load allocate
@@ -131,20 +144,30 @@ class SketchDurabilityMixin:
         return self.expire_at(name, time.time() + ttl_s)
 
     def expire_at(self, name: str, ts: float) -> bool:
-        entry = self._live_lookup(name)
-        if entry is None:
-            return False
-        entry.expire_at = float(ts)
-        self._ensure_sweeper()
-        return True
+        with self._journal_gate:
+            entry = self._live_lookup(name)
+            if entry is None:
+                return False
+            entry.expire_at = float(ts)
+            # Journaled as the absolute deadline (the PEXPIREAT form):
+            # replay re-arms it, and a deadline already past at recovery
+            # lazily reaps — replay interleaves with TTL expiry exactly
+            # like the live path.
+            seq = self._journal_rec("obj.expire", name, at=float(ts))
+            self._ensure_sweeper()
+        # Durability fence outside the gate: waiting on the fsync under
+        # it would serialize every writer behind one barrier.
+        return self._ack(True, seq)
 
     def clear_expire(self, name: str) -> bool:
         """PERSIST analog: True if a TTL was removed."""
-        entry = self._live_lookup(name)
-        if entry is None or entry.expire_at is None:
-            return False
-        entry.expire_at = None
-        return True
+        with self._journal_gate:
+            entry = self._live_lookup(name)
+            if entry is None or entry.expire_at is None:
+                return False
+            entry.expire_at = None
+            seq = self._journal_rec("obj.persist", name)
+        return self._ack(True, seq)  # fence outside the gate
 
     def remain_ttl_ms(self, name: str) -> int:
         """PTTL convention: -2 absent, -1 no TTL, else remaining ms."""
@@ -224,6 +247,16 @@ class SketchDurabilityMixin:
     def restore(self, name: str, data: bytes, replace: bool = False) -> None:
         """Recreate an object from ``dump`` bytes.  BUSYKEY analog: raises
         if the name exists and ``replace`` is False."""
+        with self._journal_gate:
+            self._restore_impl(name, data, replace)
+            # Journaled as the raw dump blob (wholesale state replace):
+            # replay routes back through restore() itself.
+            seq = self._journal_rec(
+                "obj.restore", name, data=data, replace=bool(replace)
+            )
+        self._ack(None, seq)  # fence outside the gate
+
+    def _restore_impl(self, name: str, data: bytes, replace: bool) -> None:
         if _chaos.ENABLED:  # snapshot-I/O fault point (ISSUE 3)
             _chaos.fire("snapshot.load", data=data)
         if len(data) < 8 or data[:4] != _DUMP_MAGIC:
@@ -267,12 +300,51 @@ class SketchDurabilityMixin:
 
     def snapshot(self, directory: str) -> None:
         """Atomic full-state snapshot: every pool array D2H + registry
-        metadata.  Written to tmp files then renamed, so a concurrent
-        restore never sees a torn snapshot."""
+        metadata.  Written to tmp files (fsynced) then renamed (directory
+        fsynced), so neither a concurrent restore nor a host crash after
+        the rename ever sees a torn or empty snapshot.
+
+        Journal coordination (ISSUE 10): the journal GATE is held across
+        drain → cut → capture, so the cut seq recorded in the metadata
+        exactly partitions records into snapshot-covered (retired by
+        mark_snapshot once the files are durable) and tail (replayed at
+        recovery).  See the gate comment in engines.__init__."""
         if _chaos.ENABLED:  # snapshot-I/O fault point (ISSUE 3)
             _chaos.fire("snapshot.save")
         os.makedirs(directory, exist_ok=True)
-        self._drain()
+        # ONE snapshot at a time, capture through mark_snapshot: two
+        # concurrent snapshot() calls (BGSAVE thread vs the periodic
+        # snapshotter vs shutdown) could otherwise install an OLDER
+        # capture over a newer one whose mark_snapshot already retired
+        # the journal segments between their cuts — losing acked writes
+        # on the next recovery (and corrupting the shared tmp files).
+        with self._snapshot_lock:
+            journal = getattr(self, "journal", None)
+            with self._journal_gate:
+                # rtpulint: disable=RT001 the drain barrier MUST run inside the snapshot lock: it is what makes the cut/capture consistent, and the only waiters on this lock are other whole-snapshot callers (BGSAVE/periodic/shutdown), never the write path
+                self._drain()
+                journal_cut = journal.cut() if journal is not None else 0
+                meta, arrays = self._snapshot_capture()
+            meta["journal_seq"] = journal_cut
+            self._snapshot_write(directory, meta, arrays)
+            self._last_save_ts = time.time()
+            if journal is not None:
+                # The snapshot covering records <= cut is durable on
+                # disk: retire the covered segments (the BGREWRITEAOF
+                # analog).
+                journal.mark_snapshot(journal_cut)
+            # Companion-state hook (the client wires the grid keyspace
+            # here): runs outside the engine locks (still inside the
+            # snapshot lock — the grid files race identically), so
+            # periodic snapshots persist the WHOLE logical keyspace,
+            # not just sketch pools.
+            hook = getattr(self, "snapshot_extra", None)
+            if hook is not None:
+                hook(directory)
+
+    def _snapshot_capture(self):
+        """Point-in-time capture of (meta, arrays) under the engine
+        locks; no file I/O here."""
         # Lock ORDER: mirror lock, then registry._lock, then the dispatch
         # lock — the registry/dispatch order is what try_create/
         # bloom_replicate use (registry then pool.alloc_row; inverting
@@ -351,19 +423,40 @@ class SketchDurabilityMixin:
                 self.config.tpu_sketch, "mbit_threshold_words", 0
             ),
         }
+        return meta, arrays
+
+    def _snapshot_write(self, directory: str, meta: dict, arrays) -> None:
+        """Crash-safe install (ISSUE 10 satellite): tmp files are
+        FSYNCED before the rename and the directory after — without
+        either, a host crash after os.replace could publish an empty or
+        torn snapshot that restore_snapshot then trusts (the rename is
+        only atomic against concurrent READERS, not against power loss
+        of un-flushed data).  The metadata also stamps the pool blob's
+        CRC: a crash in the tiny window between the two renames (new
+        pools + old meta) is then DETECTED at restore instead of
+        silently installing mismatched tenant tables."""
         tmp_npz = os.path.join(directory, _SNAP_POOLS + ".tmp.npz")
         tmp_meta = os.path.join(directory, _SNAP_META + ".tmp")
         np.savez(tmp_npz, **arrays)
+        with open(tmp_npz, "rb") as f:
+            crc = _crc_stream(f)
+            os.fsync(f.fileno())
+        meta = dict(meta)
+        meta["pools_crc"] = crc
         with open(tmp_meta, "w") as f:
             json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        if _chaos.ENABLED:
+            # Crash point between write and rename (the satellite's
+            # chaos test): a fault here must leave the PREVIOUS
+            # snapshot fully intact and loadable.
+            _chaos.fire("snapshot.rename")
         os.replace(tmp_npz, os.path.join(directory, _SNAP_POOLS))
         os.replace(tmp_meta, os.path.join(directory, _SNAP_META))
-        # Companion-state hook (the client wires the grid keyspace here):
-        # runs outside the engine locks, so periodic snapshots persist
-        # the WHOLE logical keyspace, not just sketch pools.
-        hook = getattr(self, "snapshot_extra", None)
-        if hook is not None:
-            hook(directory)
+        from redisson_tpu.durability.journal import _fsync_dir
+
+        _fsync_dir(directory)
 
     def restore_snapshot(self, directory: str) -> bool:
         """Load a snapshot written by ``snapshot``; True if one was found.
@@ -382,6 +475,22 @@ class SketchDurabilityMixin:
             _chaos.fire("snapshot.load")
         with open(meta_path) as f:
             meta = json.load(f)
+        if "pools_crc" in meta:
+            # Torn-install detection (ISSUE 10 satellite): a crash in
+            # the window between the pools and meta renames leaves a
+            # new blob under an old manifest — refusing beats silently
+            # installing mismatched tenant tables over live rows.
+            with open(pools_path, "rb") as f:
+                actual = _crc_stream(f)
+            if actual != int(meta["pools_crc"]):
+                raise ValueError(
+                    "torn snapshot: pool blob CRC does not match its "
+                    "metadata (crash between renames?) — refusing to "
+                    "restore"
+                )
+        # Journal recovery barrier: records with seq <= this are covered
+        # by the snapshot; the tail replays on top (ISSUE 10).
+        self._restored_journal_seq = int(meta.get("journal_seq") or 0)
         # Validate candidate tables before any mutation (see restore()).
         topk_decoded = type(self.topk).decode_state(meta.get("topk"))
         data = np.load(pools_path)

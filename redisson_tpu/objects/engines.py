@@ -269,6 +269,48 @@ class _MappedFuture:
         return self._fut.done()
 
 
+class _DurableResult:
+    """Ack gate for ``appendfsync=always`` (ISSUE 10): the caller's
+    ``.result()`` returns only after the op's journal record is fsynced
+    — group commit batches the fsyncs, so a burst of writers amortizes
+    one disk barrier.  Wraps any result-like (HintedFuture, LazyResult,
+    ImmediateResult, _MappedFuture)."""
+
+    __slots__ = ("_res", "_journal", "_seq")
+
+    def __init__(self, res, journal, seq):
+        self._res = res
+        self._journal = journal
+        self._seq = seq
+
+    def result(self, timeout=None):
+        v = self._res.result(timeout)
+        if not self._journal.wait_durable(self._seq, timeout):
+            # A timed-out durability wait must NOT ack: returning the
+            # value here would report a write durable that a crash can
+            # still lose — the one lie this class exists to prevent.
+            raise TimeoutError(
+                f"journal record {self._seq} not fsynced within "
+                f"{timeout}s (appendfsync=always durability fence)"
+            )
+        return v
+
+    def get(self):
+        return self.result()
+
+    def done(self):
+        inner = getattr(self._res, "done", None)
+        return (
+            (inner() if inner is not None else True)
+            and self._journal.is_durable(self._seq)
+        )
+
+    def add_done_callback(self, fn):
+        # Delegated un-gated: quota releases etc. key off the DEVICE
+        # resolution; the durability gate applies to the ack (result()).
+        self._res.add_done_callback(fn)
+
+
 class TpuSketchEngine(SketchDurabilityMixin):
     def __init__(self, config):
         from redisson_tpu.executor.coalescer import BatchCoalescer
@@ -449,11 +491,42 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 max_state_bytes=config.tpu_sketch.prewarm_max_state_bytes,
                 obs=self.obs,
             )
+        # Crash-safe durability tier (ISSUE 10): append-only op journal
+        # + point-in-time recovery (durability/journal.py).  The commit
+        # GATE makes one mutation's journal-append + dispatch atomic
+        # against the snapshot's drain → cut → capture sequence: without
+        # it a record could land before the cut while its device effect
+        # lands after the capture — truncated from the journal AND
+        # missing from the snapshot (a lost acked write).  A plain RLock
+        # (not witness-named) on purpose: it is strictly the OUTERMOST
+        # lock of every path that takes it (public mutation entry points
+        # and snapshot(), both entered lock-free), so it can never
+        # participate in an ordering cycle, and naming it would flag the
+        # drains/dispatches the gated bodies legitimately perform.
+        self.journal = None
+        self._journal_replaying = False
+        self._journal_gate = threading.RLock()
+        # Snapshot serialization: SAVE, BGSAVE's thread, the periodic
+        # snapshotter, BGREWRITEAOF and shutdown may all call snapshot()
+        # concurrently — without one writer at a time, an OLDER capture
+        # can overwrite a newer one AFTER the newer one already retired
+        # journal segments (mark_snapshot), losing the acked tail; the
+        # shared tmp paths would also interleave.  Plain Lock, strictly
+        # outermost (ordering: snapshot lock → journal gate → engine
+        # locks; no mutation path ever takes it).
+        self._snapshot_lock = threading.Lock()
+        self._restored_journal_seq = 0
+        self._last_save_ts = 0.0
         self._register_health_gauges()
         # Checkpoint/resume (SURVEY.md §5): restore device state from the
-        # configured snapshot dir, then arm periodic snapshots.
+        # configured snapshot dir, then recover the journal tail, then
+        # arm periodic snapshots (strictly in that order — the
+        # snapshotter must never run concurrently with replay).
         if config.snapshot_dir:
             self.restore_snapshot(config.snapshot_dir)
+        if getattr(config, "journal_dir", None):
+            self._journal_attach(config.journal_dir, recover=True)
+        if config.snapshot_dir:
             if config.snapshot_interval_s > 0:
                 import jax
 
@@ -549,6 +622,24 @@ class TpuSketchEngine(SketchDurabilityMixin):
             "entries resident in the sketch near cache",
             self.nearcache.store.entries,
         )
+        # Durability tier (ISSUE 10): journal lag + segment count.
+        # Registered unconditionally (0 while journaling is off) so a
+        # live CONFIG SET appendonly yes is visible without re-wiring.
+        reg.gauge_callback(
+            "rtpu_journal_lag_ops",
+            "journal records appended but not yet fsynced",
+            lambda: (
+                0 if self.journal is None else self.journal.lag_ops()
+            ),
+        )
+        reg.gauge_callback(
+            "rtpu_journal_segments",
+            "live journal segment files",
+            lambda: (
+                0 if self.journal is None
+                else self.journal.stats()["segments"]
+            ),
+        )
 
         # One registry.stats() snapshot serves BOTH gauges per scrape:
         # stats() holds the tenancy lock (contended by the serving
@@ -612,6 +703,18 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 self.snapshot(self.config.snapshot_dir)
             except Exception:  # pragma: no cover — best-effort persistence
                 pass
+        # Journal close AFTER the final snapshot (which cut+retired the
+        # covered segments): drain pending records + final fsync, so a
+        # clean shutdown leaves a zero-replay journal.
+        j = self.journal
+        if j is not None:
+            self.journal = None
+            if self.coalescer is not None:
+                self.coalescer.journal_lag_s = None
+            try:
+                j.close()
+            except Exception:  # pragma: no cover — best-effort persistence
+                pass
         if self.prewarmer is not None:
             self.prewarmer.shutdown()
         if self.coalescer is not None:
@@ -650,6 +753,121 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if self.prewarmer is None:
             return True
         return self.prewarmer.wait_idle(timeout)
+
+    # -- crash-safe durability tier (ISSUE 10): op journal -----------------
+
+    def _journal_attach(self, jdir: str, recover: bool,
+                        fresh: bool = False) -> None:
+        """Open (and optionally recover) the op journal.  ``recover``
+        replays the post-snapshot tail through the host golden engine
+        into device rows (durability/recovery.py); ``fresh`` wipes any
+        existing segments first (the live-enable path: pre-enable state
+        is covered by the coordinating snapshot, stale segments from an
+        earlier lineage must not replay on the next boot)."""
+        from redisson_tpu.durability import OpJournal, replay_journal
+
+        cfg = self.config
+        j = OpJournal(
+            jdir,
+            fsync_policy=getattr(cfg, "journal_fsync", "everysec"),
+            max_segment_bytes=getattr(
+                cfg, "journal_max_segment_bytes", 64 << 20
+            ),
+            obs=self.obs,
+            fresh=fresh,
+        )
+        if recover:
+            n = replay_journal(self, j, self._restored_journal_seq)
+            if n:
+                self.obs.journal_replayed.inc((), n)
+        self.journal = j
+        if self.coalescer is not None:
+            # Journal lag rides the admission estimate under ``always``
+            # (a slow disk sheds deadline-carrying load instead of
+            # queueing it unboundedly) — see coalescer.estimate_wait_s.
+            self.coalescer.journal_lag_s = j.lag_s
+
+    def journal_set_enabled(self, enabled: bool) -> None:
+        """Live ``CONFIG SET appendonly yes|no``.  Enabling starts a
+        FRESH journal lineage and, when a snapshot dir is configured,
+        takes a coordinating snapshot so recovery = snapshot + tail
+        (the Redis enable-appendonly-triggers-rewrite behavior);
+        without one, only post-enable mutations are recoverable.
+        Disabling closes the journal after a final drain+fsync."""
+        if enabled:
+            jdir = getattr(self.config, "journal_dir", None)
+            if not jdir:
+                raise ValueError(
+                    "journal_dir is not configured (set Config.journal_dir "
+                    "before enabling appendonly)"
+                )
+            with self._journal_gate:
+                # Idempotency re-checked INSIDE the gate: two racing
+                # enables must not both attach — the loser's fresh=True
+                # wipe would orphan the winner's live segments and leak
+                # a second writer on the same directory.
+                if self.journal is not None:
+                    return
+                self._journal_attach(jdir, recover=False, fresh=True)
+            if self.config.snapshot_dir:
+                self.snapshot(self.config.snapshot_dir)
+        else:
+            with self._journal_gate:
+                j, self.journal = self.journal, None
+                if self.coalescer is not None:
+                    self.coalescer.journal_lag_s = None
+            if j is not None:
+                j.close()
+
+    def journal_set_policy(self, policy: str) -> None:
+        """Live ``CONFIG SET appendfsync always|everysec|no``."""
+        self.config.journal_fsync = policy
+        j = self.journal
+        if j is not None:
+            j.set_policy(policy)
+
+    def journal_fence(self, timeout=None) -> bool:
+        """The WAIT fence: force an fsync covering every record appended
+        so far and block until it lands (True; False on timeout).
+        Trivially True with journaling off."""
+        j = self.journal
+        if j is None:
+            return True
+        return j.wait_durable(timeout=timeout)
+
+    def _journal_rec(self, op: str, name: str, **fields) -> Optional[int]:
+        """Append one ACCEPTED-mutation record; returns its seq, or None
+        when journaling is off (or this is recovery replay — a recovery
+        must never journal its own replay)."""
+        j = self.journal
+        if j is None or self._journal_replaying:
+            return None
+        rec = {"op": op, "name": name}
+        rec.update(fields)
+        return j.append(rec)
+
+    def _durable(self, res, seq: Optional[int]):
+        """Gate a result-like's ack on record durability under
+        ``appendfsync=always`` (no-op under the other policies: their
+        durability window is the fsync cadence, not the ack)."""
+        j = self.journal
+        if seq is None or j is None or j.policy != "always":
+            return res
+        return _DurableResult(res, j, seq)
+
+    def _ack(self, value, seq: Optional[int]):
+        """Durability fence for synchronously-returning mutations
+        (delete/rename/expire/merge/...): under ``always`` the method
+        returns — acks — only after its record is fsynced."""
+        j = self.journal
+        if seq is not None and j is not None and j.policy == "always":
+            j.wait_durable(seq)
+        return value
+
+    def _commit(self, res, op: str, name: str, **fields):
+        """Journal an accepted mutation and gate its ack: the one-call
+        form for result-returning engine methods."""
+        return self._durable(res, self._journal_rec(op, name, **fields))
 
     # -- graceful degradation (ISSUE 3): host golden-mirror failover -------
 
@@ -869,57 +1087,68 @@ class TpuSketchEngine(SketchDurabilityMixin):
         # detach and the epoch read would return this entry's rows to the
         # rebuilt free list AND bump the epoch — reading the bumped value
         # would defeat _reap_rows' stale-topology guard and double-free.
-        pre_pool = self.registry.lookup(name)
-        pre_epoch = pre_pool.pool.topology_epoch if pre_pool else 0
-        entry = self.registry.detach(name)
-        if entry is None:
-            return False
-        # An expired-but-unswept entry is already logically gone: free the
-        # row, but report False (Redis DEL on an expired key).  Checked
-        # inline — _live_lookup would recurse through _expire_if_due.
-        was_expired = (
-            entry.expire_at is not None and _time.time() >= entry.expire_at
-        )
-        epoch = pre_epoch if pre_pool and pre_pool.pool is entry.pool \
-            else entry.pool.topology_epoch
-        self._drain()
-        self._reap_rows(entry.pool, self._entry_rows(entry), epoch)
-        self.topk.drop(name)
-        # Structural epoch advance + entry drop: a successor object under
-        # this name continues the epoch sequence, so an in-flight read of
-        # the OLD object can never install as fresh.
-        self.nearcache.drop_object(name)
-        if self._mirrors:
-            with self._mirror_lock:
-                self._mirrors.pop(name, None)
-        return not was_expired
+        with self._journal_gate:
+            pre_pool = self.registry.lookup(name)
+            pre_epoch = pre_pool.pool.topology_epoch if pre_pool else 0
+            entry = self.registry.detach(name)
+            if entry is None:
+                return False
+            seq = self._journal_rec("obj.del", name)
+            # An expired-but-unswept entry is already logically gone: free
+            # the row, but report False (Redis DEL on an expired key).
+            # Checked inline — _live_lookup would recurse through
+            # _expire_if_due.
+            was_expired = (
+                entry.expire_at is not None
+                and _time.time() >= entry.expire_at
+            )
+            epoch = pre_epoch if pre_pool and pre_pool.pool is entry.pool \
+                else entry.pool.topology_epoch
+            self._drain()
+            self._reap_rows(entry.pool, self._entry_rows(entry), epoch)
+            self.topk.drop(name)
+            # Structural epoch advance + entry drop: a successor object
+            # under this name continues the epoch sequence, so an
+            # in-flight read of the OLD object can never install as fresh.
+            self.nearcache.drop_object(name)
+            if self._mirrors:
+                with self._mirror_lock:
+                    self._mirrors.pop(name, None)
+            result = not was_expired
+        # Durability fence OUTSIDE the gate: blocking on the fsync while
+        # holding it would serialize every writer behind one barrier
+        # (group commit amortizes exactly because waiters overlap).
+        return self._ack(result, seq)
 
     def rename(self, old: str, new: str) -> bool:
-        if old == new or self._live_lookup(old) is None:
-            return False
-        self._guard_foreign(new)
-        self._drain()
-        # Atomic rename FIRST: if the source vanished since the check
-        # (expiry race), the destination must be left untouched.  The
-        # displaced dest is zeroed before its row becomes reusable.
-        ok, dest = self.registry.rename_detach_dest(old, new)
-        if not ok:
-            return False
-        if dest is not None:
-            self._reap_rows(
-                dest.pool, self._entry_rows(dest), dest.pool.topology_epoch
-            )
-        self.topk.rename(old, new)
-        # Both names change identity: drop entries + structural bumps.
-        self.nearcache.drop_object(old)
-        self.nearcache.drop_object(new)
-        if self._mirrors:
-            with self._mirror_lock:
-                self._mirrors.pop(new, None)
-                m = self._mirrors.pop(old, None)
-                if m is not None:
-                    self._mirrors[new] = m
-        return True
+        with self._journal_gate:
+            if old == new or self._live_lookup(old) is None:
+                return False
+            self._guard_foreign(new)
+            self._drain()
+            # Atomic rename FIRST: if the source vanished since the check
+            # (expiry race), the destination must be left untouched.  The
+            # displaced dest is zeroed before its row becomes reusable.
+            ok, dest = self.registry.rename_detach_dest(old, new)
+            if not ok:
+                return False
+            seq = self._journal_rec("obj.rename", old, new=new)
+            if dest is not None:
+                self._reap_rows(
+                    dest.pool, self._entry_rows(dest),
+                    dest.pool.topology_epoch,
+                )
+            self.topk.rename(old, new)
+            # Both names change identity: drop entries + structural bumps.
+            self.nearcache.drop_object(old)
+            self.nearcache.drop_object(new)
+            if self._mirrors:
+                with self._mirror_lock:
+                    self._mirrors.pop(new, None)
+                    m = self._mirrors.pop(old, None)
+                    if m is not None:
+                        self._mirrors[new] = m
+        return self._ack(True, seq)  # fence outside the gate (see delete)
 
     def names(self, kind=None):
         for e in self.registry.entries():
@@ -1063,11 +1292,18 @@ class TpuSketchEngine(SketchDurabilityMixin):
             "expected_insertions": expected_insertions,
             "false_probability": false_probability,
         }
-        self._live_lookup(name)  # reap an expired holder before tryInit
-        self._guard_foreign(name)
-        entry, created = self.registry.try_create(
-            name, PoolKind.BLOOM, (class_words_for_bits(m),), params
-        )
+        with self._journal_gate:
+            self._live_lookup(name)  # reap an expired holder before tryInit
+            self._guard_foreign(name)
+            entry, created = self.registry.try_create(
+                name, PoolKind.BLOOM, (class_words_for_bits(m),), params
+            )
+            # Journaled only when the create WON (replay of a lost race
+            # must not re-parameterize the incumbent).
+            seq = self._journal_rec(
+                "bloom.init", name,
+                ei=int(expected_insertions), fp=float(false_probability),
+            ) if created else None
         if self.prewarmer is not None:
             from redisson_tpu.executor import prewarm
 
@@ -1077,7 +1313,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
             self.prewarmer.register(
                 entry.pool, ("bloom_mixed", k), prewarm.warm_bloom_mixed(k)
             )
-        return created
+        return self._ack(created, seq)
 
     def _bloom_reduce(self, entry, H1, H2):
         m = entry.params["size"]
@@ -1152,7 +1388,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
         return fut if gather is None else _MappedFuture(fut, gather)
 
     def bloom_add(self, name, H1, H2) -> LazyResult:
-        with self._nc_mutate(name):
+        with self._nc_mutate(name), self._journal_gate:
             entry = self._require(name, PoolKind.BLOOM)
             h1m, h2m = self._bloom_reduce(entry, H1, H2)
             m, k = entry.params["size"], entry.params["hash_iterations"]
@@ -1180,9 +1416,15 @@ class TpuSketchEngine(SketchDurabilityMixin):
                         entry, h1m, h2m, np.ones(len(H1), bool)
                     ),
                 )
-                return res
-            return self._bloom_dispatch_hashed(
-                entry, h1m, h2m, np.ones(len(H1), bool)
+            else:
+                res = self._bloom_dispatch_hashed(
+                    entry, h1m, h2m, np.ones(len(H1), bool)
+                )
+            # Journaled PRE-reduce (raw twins): replay re-reduces against
+            # the entry's params, same as the live path.
+            return self._commit(
+                res, "bloom.add", name,
+                h1=np.asarray(H1), h2=np.asarray(H2),
             )
 
     def bloom_contains(self, name, H1, H2) -> LazyResult:
@@ -1446,15 +1688,22 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def bloom_add_encoded(self, name, blocks, lengths) -> LazyResult:
         if self.executor.supports_device_hash:
-            with self._nc_mutate(name):
+            with self._nc_mutate(name), self._journal_gate:
                 entry = self._require(name, PoolKind.BLOOM)
                 if (
                     self.coalescer is not None
                     and self.config.tpu_sketch.exact_add_semantics
                 ) or entry.replica_rows or self._degraded(entry):
                     # The mixed-keys path owns the degraded-mirror failover.
-                    return self._bloom_submit_mixed_keys(
+                    res = self._bloom_submit_mixed_keys(
                         entry, blocks, lengths, True
+                    )
+                    # Journaled as raw key material (replay hashes
+                    # host-side — bit-identical to the device hash).
+                    return self._commit(
+                        res, "bloom.addk", name,
+                        blocks=np.asarray(blocks),
+                        lengths=np.asarray(lengths),
                     )
                 if not self.config.tpu_sketch.exact_add_semantics:
                     m, k = entry.params["size"], entry.params["hash_iterations"]
@@ -1469,7 +1718,13 @@ class TpuSketchEngine(SketchDurabilityMixin):
                             entry, blocks, lengths, True
                         ),
                     )
-                    return res
+                    return self._commit(
+                        res, "bloom.addk", name,
+                        blocks=np.asarray(blocks),
+                        lengths=np.asarray(lengths),
+                    )
+        # Host-hash fallback journals inside bloom_add (one record per
+        # accepted op — never two).
         return self.bloom_add(name, *hashing.hash128_np(blocks, lengths))
 
     def collect_results(self, lazies) -> None:
@@ -1536,17 +1791,28 @@ class TpuSketchEngine(SketchDurabilityMixin):
             return self.bloom_contains_encoded(name, blocks, lengths)
         if flags.all():
             return self.bloom_add_encoded(name, blocks, lengths)
-        with self._nc_mutate(name):
+        with self._nc_mutate(name), self._journal_gate:
             entry = self._require(name, PoolKind.BLOOM)
+            # Journal the ADD subset only (contains ops have no state
+            # effect to recover); replay order within the batch is
+            # preserved — adds of one call are order-independent.
+            lens_arr = np.asarray(lengths, np.uint32)
+            if lens_arr.ndim == 0:
+                lens_arr = np.full(blocks.shape[0], lens_arr, np.uint32)
             if not self.executor.supports_device_hash:
-                lens = np.asarray(lengths, np.uint32)
-                if lens.ndim == 0:
-                    lens = np.full(blocks.shape[0], lens, np.uint32)
                 h1m, h2m = self._bloom_reduce(
-                    entry, *hashing.hash128_np(blocks, lens)
+                    entry, *hashing.hash128_np(blocks, lens_arr)
                 )
-                return self._bloom_dispatch_hashed(entry, h1m, h2m, flags)
-            return self._bloom_submit_mixed_keys(entry, blocks, lengths, flags)
+                res = self._bloom_dispatch_hashed(entry, h1m, h2m, flags)
+            else:
+                res = self._bloom_submit_mixed_keys(
+                    entry, blocks, lengths, flags
+                )
+            return self._commit(
+                res, "bloom.addk", name,
+                blocks=np.asarray(blocks)[flags],
+                lengths=lens_arr[flags],
+            )
 
     # -- hll ---------------------------------------------------------------
 
@@ -1569,8 +1835,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
         return entry
 
     def hll_add(self, name, c0, c1, c2) -> LazyResult:
-        with self._nc_mutate(name):
-            return self._hll_add_impl(name, c0, c1, c2)
+        with self._nc_mutate(name), self._journal_gate:
+            res = self._hll_add_impl(name, c0, c1, c2)
+            return self._commit(
+                res, "hll.add", name,
+                c0=np.asarray(c0, np.uint32),
+                c1=np.asarray(c1, np.uint32),
+                c2=np.asarray(c2, np.uint32),
+            )
 
     def _hll_add_impl(self, name, c0, c1, c2) -> LazyResult:
         entry = self.hll_ensure(name)
@@ -1599,12 +1871,19 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def hll_add_encoded(self, name, blocks, lengths) -> LazyResult:
         if self.coalescer is None and self.executor.supports_device_hash:
-            with self._nc_mutate(name):
+            with self._nc_mutate(name), self._journal_gate:
                 entry = self.hll_ensure(name)
                 if not self._degraded(entry):
-                    return self.executor.hll_add_keys_single(
+                    res = self.executor.hll_add_keys_single(
                         entry.pool, entry.row, blocks, lengths
                     )
+                    # Raw key material; replay hashes host-side.
+                    return self._commit(
+                        res, "hll.addk", name,
+                        blocks=np.asarray(blocks),
+                        lengths=np.asarray(lengths),
+                    )
+        # Host-hash fallback journals inside hll_add.
         c0, c1, c2, _ = hashing.murmur3_x86_128(blocks, lengths)
         return self.hll_add(name, c0, c1, c2)
 
@@ -1659,8 +1938,12 @@ class TpuSketchEngine(SketchDurabilityMixin):
         return int(round(golden.ertl_estimate(hist)))
 
     def hll_merge_with(self, name, other_names) -> None:
-        with self._nc_mutate(name):
-            return self._hll_merge_with_impl(name, other_names)
+        with self._nc_mutate(name), self._journal_gate:
+            self._hll_merge_with_impl(name, other_names)
+            seq = self._journal_rec(
+                "hll.merge", name, srcs=[str(n) for n in other_names]
+            )
+        return self._ack(None, seq)  # fence outside the gate (see delete)
 
     def _hll_merge_with_impl(self, name, other_names) -> None:
         entry = self.hll_ensure(name)
@@ -1903,30 +2186,37 @@ class TpuSketchEngine(SketchDurabilityMixin):
         idx = np.asarray(idx, np.uint32)
         # Clearing bits retires monotone positives → structural bump;
         # setting bits is an ordinary (monotone-safe) write.
-        with self._nc_mutate(name, structural=not value):
+        with self._nc_mutate(name, structural=not value), \
+                self._journal_gate:
             entry = self.bitset_ensure(
                 name, int(idx.max()) + 1 if idx.size else 1
             )
             if value:
-                return self._bitset_rw(
+                res = self._bitset_rw(
                     bitset_ops.OP_SET, self.executor.bitset_set, entry, idx
                 )
-            return self._bitset_rw(
-                bitset_ops.OP_CLEAR, self.executor.bitset_clear_bits, entry,
-                idx,
+            else:
+                res = self._bitset_rw(
+                    bitset_ops.OP_CLEAR, self.executor.bitset_clear_bits,
+                    entry, idx,
+                )
+            return self._commit(
+                res, "bitset.set", name, idx=idx, value=bool(value)
             )
 
     def bitset_flip(self, name, idx) -> LazyResult:
         from redisson_tpu.ops import bitset as bitset_ops
 
         idx = np.asarray(idx, np.uint32)
-        with self._nc_mutate(name, structural=True):  # flips clear bits
+        with self._nc_mutate(name, structural=True), \
+                self._journal_gate:  # flips clear bits
             entry = self.bitset_ensure(
                 name, int(idx.max()) + 1 if idx.size else 1
             )
-            return self._bitset_rw(
+            res = self._bitset_rw(
                 bitset_ops.OP_FLIP, self.executor.bitset_flip, entry, idx
             )
+            return self._commit(res, "bitset.flip", name, idx=idx)
 
     def bitset_get(self, name, idx) -> LazyResult:
         idx = np.asarray(idx, np.uint32)
@@ -1967,17 +2257,21 @@ class TpuSketchEngine(SketchDurabilityMixin):
         return _MappedFuture(res, lambda v: v & in_range)
 
     def bitset_set_range(self, name, from_bit, to_bit, value: bool) -> LazyResult:
-        with self._nc_mutate(name, structural=not value):
+        with self._nc_mutate(name, structural=not value), \
+                self._journal_gate:
             entry = self.bitset_ensure(name, int(to_bit))
             res = self._serve_degraded(
                 entry, 1,
                 lambda mir: mir.set_range(int(from_bit), int(to_bit), bool(value)),
             )
-            if res is not None:
-                return res
-            self._drain()
-            return self.executor.bitset_set_range(
-                entry.pool, entry.row, int(from_bit), int(to_bit), value
+            if res is None:
+                self._drain()
+                res = self.executor.bitset_set_range(
+                    entry.pool, entry.row, int(from_bit), int(to_bit), value
+                )
+            return self._commit(
+                res, "bitset.range", name,
+                frm=int(from_bit), to=int(to_bit), value=bool(value),
             )
 
     def _nc_scalar(self, kind, name, key, dispatch, captured):
@@ -2058,8 +2352,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
         to the byte boundary too) and is masked there so tail bits of the
         size-class row stay 0.
         """
-        with self._nc_mutate(dest, structural=True):  # dest is REPLACED
-            return self._bitset_bitop_impl(dest, src_names, op)
+        with self._nc_mutate(dest, structural=True), \
+                self._journal_gate:  # dest is REPLACED
+            self._bitset_bitop_impl(dest, src_names, op)
+            seq = self._journal_rec(
+                "bitset.bitop", dest,
+                srcs=[str(n) for n in src_names], bop=str(op),
+            )
+        return self._ack(None, seq)  # fence outside the gate (see delete)
 
     def _bitset_bitop_impl(self, dest: str, src_names, op: str) -> None:
         max_bits = max(
@@ -2136,11 +2436,15 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def cms_try_init(self, name, depth: int, width: int) -> bool:
         params = {"depth": depth, "width": width}
-        self._live_lookup(name)  # reap an expired holder before tryInit
-        self._guard_foreign(name)
-        entry, created = self.registry.try_create(
-            name, PoolKind.CMS, (depth, width), params
-        )
+        with self._journal_gate:
+            self._live_lookup(name)  # reap an expired holder before tryInit
+            self._guard_foreign(name)
+            entry, created = self.registry.try_create(
+                name, PoolKind.CMS, (depth, width), params
+            )
+            seq = self._journal_rec(
+                "cms.init", name, depth=int(depth), width=int(width)
+            ) if created else None
         if self.prewarmer is not None:
             from redisson_tpu.executor import prewarm
 
@@ -2148,7 +2452,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 entry.pool, ("cms_updest", depth, width),
                 prewarm.warm_cms_update_estimate(depth, width),
             )
-        return created
+        return self._ack(created, seq)
 
     def cms_total(self, name) -> int:
         """Total inserted weight (CMS.INFO 'count'): every increment adds
@@ -2171,17 +2475,24 @@ class TpuSketchEngine(SketchDurabilityMixin):
     def cms_reset(self, name) -> None:
         """Zero a CMS's counters in place (CMS.MERGE overwrite semantics)
         — the registry entry and any top-K configuration survive."""
-        with self._nc_mutate(name, structural=True):  # counters REPLACED
+        with self._nc_mutate(name, structural=True), \
+                self._journal_gate:  # counters REPLACED
             entry = self._require(name, PoolKind.CMS)
             res = self._serve_degraded(entry, 1, lambda mir: mir.reset())
-            if res is not None:
-                return
-            self._drain()
-            self.executor.zero_row(entry.pool, entry.row)
+            if res is None:
+                self._drain()
+                self.executor.zero_row(entry.pool, entry.row)
+            seq = self._journal_rec("cms.reset", name)
+        self._ack(None, seq)  # fence outside the gate (see delete)
 
     def cms_add(self, name, H1, H2, weights) -> LazyResult:
-        with self._nc_mutate(name):
-            return self._cms_add_impl(name, H1, H2, weights)
+        with self._nc_mutate(name), self._journal_gate:
+            res = self._cms_add_impl(name, H1, H2, weights)
+            return self._commit(
+                res, "cms.add", name,
+                h1=np.asarray(H1), h2=np.asarray(H2),
+                w=np.asarray(weights, np.uint32),
+            )
 
     def _cms_add_impl(self, name, H1, H2, weights) -> LazyResult:
         entry = self._require(name, PoolKind.CMS)
@@ -2272,8 +2583,16 @@ class TpuSketchEngine(SketchDurabilityMixin):
         excluded.  Falls back to the vectorized XLA path where the kernel
         isn't available (sharded mode) or the geometry doesn't fit VMEM
         lane blocks; the fallback's estimates include the whole batch."""
-        with self._nc_mutate(name):
-            return self._cms_add_seq_impl(name, H1, H2, weights)
+        with self._nc_mutate(name), self._journal_gate:
+            res = self._cms_add_seq_impl(name, H1, H2, weights)
+            # Same record as cms_add: the STATE effect of seq vs
+            # vectorized add is identical (only the returned estimates'
+            # sequence point differs), so replay shares one path.
+            return self._commit(
+                res, "cms.add", name,
+                h1=np.asarray(H1), h2=np.asarray(H2),
+                w=np.asarray(weights, np.uint32),
+            )
 
     def _cms_add_seq_impl(self, name, H1, H2, weights) -> LazyResult:
         entry = self._require(name, PoolKind.CMS)
@@ -2281,14 +2600,18 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if self._degraded(entry):
             # Mirror fallback has whole-batch (vectorized) semantics,
             # like the non-Pallas fallback below.
-            return self.cms_add(name, H1, H2, weights)
+            # _cms_add_impl, not cms_add: the public wrapper already
+            # journals this call once (one record per accepted op).
+            return self._cms_add_impl(name, H1, H2, weights)
         if (
             not getattr(self.executor, "supports_pallas_cms", False)
             or (d * w) % 128 != 0  # VMEM lane-block geometry
             or d * w * 4 > (8 << 20)  # table must fit VMEM
             or len(H1) == 0
         ):
-            return self.cms_add(name, H1, H2, weights)
+            # _cms_add_impl, not cms_add: the public wrapper already
+            # journals this call once (one record per accepted op).
+            return self._cms_add_impl(name, H1, H2, weights)
         h1w, h2w = hashing.km_reduce_mod(H1, H2, w)
         weights = np.asarray(weights, np.uint32)
         self._drain()  # sequential semantics: all queued ops land first
@@ -2312,8 +2635,12 @@ class TpuSketchEngine(SketchDurabilityMixin):
         )
 
     def cms_merge(self, name, other_names) -> None:
-        with self._nc_mutate(name):
-            return self._cms_merge_impl(name, other_names)
+        with self._nc_mutate(name), self._journal_gate:
+            self._cms_merge_impl(name, other_names)
+            seq = self._journal_rec(
+                "cms.merge", name, srcs=[str(n) for n in other_names]
+            )
+        return self._ack(None, seq)  # fence outside the gate (see delete)
 
     def _cms_merge_impl(self, name, other_names) -> None:
         entry = self._require(name, PoolKind.CMS)

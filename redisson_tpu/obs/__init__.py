@@ -94,9 +94,28 @@ class Observability:
             ("cause",))
         self.resp_ingress_shed = r.counter(
             "rtpu_resp_ingress_shed",
-            "RESP commands (or transactions) refused at ingress by the "
-            "admission watermark — COMMAND-denominated, unlike the "
-            "ops-denominated rtpu_shed_ops")
+            "RESP commands (or transactions) refused at ingress, by "
+            "reason (pressure = admission watermark, tenant = over-quota "
+            "tenant peek) — COMMAND-denominated, unlike the "
+            "ops-denominated rtpu_shed_ops", ("reason",))
+        # Durability tier (ISSUE 10): the op journal's append volume,
+        # group-commit fsync latency, and recovery replay count.  Lag
+        # (appended-but-unfsynced records) and live segment count are
+        # render-time gauges the engine registers
+        # (rtpu_journal_lag_ops / rtpu_journal_segments).
+        self.journal_records = r.counter(
+            "rtpu_journal_records",
+            "op records appended to the durability journal")
+        self.journal_bytes = r.counter(
+            "rtpu_journal_bytes",
+            "bytes appended to the durability journal")
+        self.journal_fsync_us = r.histogram(
+            "rtpu_journal_fsync_us",
+            "journal group-commit fsync latency")
+        self.journal_replayed = r.counter(
+            "rtpu_journal_replayed",
+            "journal records replayed through the golden engine at "
+            "recovery")
         # Near cache (ISSUE 4): hit/miss by result kind; evictions and
         # live byte occupancy are store-side (evictions inc'd via the
         # store's on_evict hook, bytes a render-time gauge registered by

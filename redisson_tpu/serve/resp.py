@@ -138,7 +138,7 @@ _NONMUTATING = frozenset((
     "XPENDING", "GEOPOS", "GEODIST", "GEOHASH", "GEOSEARCH", "HSCAN",
     "SSCAN", "ZSCAN", "SCAN", "OBJECT", "DUMP", "PING", "ECHO", "SELECT",
     "TIME", "COMMAND", "CLIENT", "INFO", "SLOWLOG", "WAIT", "AUTH",
-    "HELLO", "QUIT",
+    "HELLO", "QUIT", "SAVE", "BGSAVE", "LASTSAVE", "BGREWRITEAOF",
 ))
 
 # Response-CACHEABLE subset: deterministic pure keyspace reads whose
@@ -886,27 +886,53 @@ class RespServer:
         w = self.admission_watermark
         return w > 0 and self._pressure() > w
 
-    def _count_ingress_shed(self) -> None:
+    def _count_ingress_shed(self, reason: str = "pressure") -> None:
         # Commands, not ops: a shed command's engine op count is
         # unknowable pre-parse, and mixing units into the ops-
         # denominated rtpu_shed_ops family would make its total
         # meaningless — ingress has its own command-denominated counter.
         self._ingress_shed += 1
         if self.obs is not None:
-            self.obs.resp_ingress_shed.inc()
+            self.obs.resp_ingress_shed.inc((reason,))
 
-    def _shed_at_ingress(self, name: str, ctx: "_ConnCtx") -> bool:
-        """True when this command must be refused with -BUSY: pressure
-        over the watermark, command not exempt, and not inside an
-        already-running transaction (EXEC completes atomically once
-        started; MULTI queueing is free — the whole transaction is
-        judged once, at EXEC, in _cmdctx_EXEC)."""
+    def _ingress_tenant(self, cmd: list) -> Optional[str]:
+        """Keyspace→tenant peek for the door (ROADMAP overload item
+        (b)): the first argument of a keyed command IS the tenant name
+        in this keyspace (per-tenant quotas are object-name-keyed), so
+        the door can judge a tenant BEFORE any command parse.  None for
+        keyless commands."""
+        if len(cmd) < 2:
+            return None
+        return cmd[1].decode("latin-1", "replace")
+
+    def _shed_at_ingress(self, name: str, cmd: list,
+                         ctx: "_ConnCtx") -> Optional[str]:
+        """The shed reason when this command must be refused with -BUSY
+        (None = admit): exempt commands and in-flight transactions
+        always pass (EXEC completes atomically once started; MULTI
+        queueing is free — the whole transaction is judged once, at
+        EXEC, in _cmdctx_EXEC).
+
+        Tenant-aware shedding comes FIRST (ISSUE 10 satellite / ROADMAP
+        overload item (b)): an over-quota tenant — token bucket empty or
+        in-flight quota full — is refused at the door before its command
+        even parses, so during one tenant's burst the burst is what gets
+        shed, not the well-behaved tenants' traffic.  The general
+        pressure watermark then sheds everyone non-exempt as before."""
         if name in _SHED_EXEMPT or ctx.in_exec or ctx.in_multi:
-            return False
+            return None
+        gov = getattr(
+            getattr(self._client, "_engine", None), "governor", None
+        )
+        if gov is not None and gov.active:
+            tenant = self._ingress_tenant(cmd)
+            if tenant is not None and gov.peek_over_quota(tenant):
+                self._count_ingress_shed("tenant")
+                return "tenant"
         if not self._pressure_over():
-            return False
-        self._count_ingress_shed()
-        return True
+            return None
+        self._count_ingress_shed("pressure")
+        return "pressure"
 
     def _note_slow_client(self, cause: str, pending: int) -> None:
         self._slow_client_kills += 1
@@ -1393,13 +1419,18 @@ class RespServer:
                 "BUSY Redis is busy running a script. You can only call "
                 "SCRIPT KILL or SHUTDOWN NOSAVE."
             )
-        if self._shed_at_ingress(name, ctx):
-            # Overload control plane (ISSUE 7): the coalescer queue is
-            # past the admission watermark — refuse engine-bound work at
-            # the door (the -BUSY retryable surface) instead of letting
-            # it buy unbounded queue wait.  Strictly pre-dispatch: a
-            # shed command was never executed, so no acked state is
-            # involved.
+        shed = self._shed_at_ingress(name, cmd, ctx)
+        if shed is not None:
+            # Overload control plane (ISSUE 7 + the ISSUE 10 tenant
+            # peek): refuse engine-bound work at the door (the -BUSY
+            # retryable surface) instead of letting it buy unbounded
+            # queue wait.  Strictly pre-dispatch: a shed command was
+            # never executed, so no acked state is involved.
+            if shed == "tenant":
+                raise RespError(
+                    "BUSY RTPU tenant over quota: command shed at "
+                    "ingress; retry later"
+                )
             raise RespError(
                 "BUSY RTPU overloaded: command shed at ingress (queue "
                 f"pressure {self._pressure():.2f} over watermark "
@@ -1538,7 +1569,11 @@ class RespServer:
         "maxmemory": "0",  # rtpulint: disable=RT004 client-compat stub, no live semantics
         "maxmemory-policy": "noeviction",  # rtpulint: disable=RT004 client-compat stub, no live semantics
         "save": "",  # rtpulint: disable=RT004 client-compat stub, no live semantics
-        "appendonly": "no",  # rtpulint: disable=RT004 client-compat stub, no live semantics
+        # appendonly/appendfsync: LIVE on an engine with the durability
+        # tier (ISSUE 10) — _config_table_init overrides from the
+        # journal state and CONFIG SET toggles it; this static row only
+        # serves the host engine (no journal to report).
+        "appendonly": "no",  # rtpulint: disable=RT004 live on the TPU engine (overridden in _config_table_init); host-engine stub only
         "databases": "1",  # rtpulint: disable=RT004 client-compat stub, no live semantics
         "timeout": "0",  # rtpulint: disable=RT004 client-compat stub, no live semantics
         "proto-max-bulk-len": "536870912",  # rtpulint: disable=RT004 client-compat stub, no live semantics
@@ -1583,6 +1618,18 @@ class RespServer:
                 f"{self.output_buffer_soft_seconds:g}",
         })
         eng = getattr(self._client, "_engine", None)
+        # Durability tier (ISSUE 10): appendonly/appendfsync are LIVE on
+        # an engine that carries the journal surface — CONFIG SET
+        # enables/disables journaling and switches the fsync policy on
+        # the running engine.
+        if hasattr(eng, "journal_set_enabled"):
+            table["appendonly"] = (
+                "yes" if getattr(eng, "journal", None) is not None
+                else "no"
+            )
+            table["appendfsync"] = str(
+                getattr(eng.config, "journal_fsync", "everysec")
+            )
         c = getattr(eng, "coalescer", None)
         if c is not None:
             table["fetch-timeout-ms"] = str(
@@ -1714,6 +1761,34 @@ class RespServer:
                     )
                 if key in self._OVERLOAD_KEYS:
                     self._validate_overload_config(key, pairs[i + 1])
+                elif key == "appendonly":
+                    v = pairs[i + 1].decode().lower()
+                    if v not in ("yes", "no"):
+                        raise RespError(
+                            f"Invalid argument '{pairs[i + 1].decode()}' "
+                            f"for CONFIG SET 'appendonly'"
+                        )
+                    eng = getattr(self._client, "_engine", None)
+                    if v == "yes" and (
+                        not hasattr(eng, "journal_set_enabled")
+                        or not getattr(eng.config, "journal_dir", None)
+                    ):
+                        # Refused BEFORE any table write: GET must never
+                        # report yes without a live journal behind it.
+                        raise RespError(
+                            "appendonly needs Config.journal_dir on an "
+                            "engine with the durability tier"
+                        )
+                elif key == "appendfsync":
+                    from redisson_tpu.durability import FSYNC_POLICIES
+
+                    v = pairs[i + 1].decode().lower()
+                    if v not in FSYNC_POLICIES:
+                        raise RespError(
+                            f"argument must be one of "
+                            f"{'|'.join(FSYNC_POLICIES)} for CONFIG SET "
+                            f"'appendfsync'"
+                        )
                 elif key.startswith("slowlog-") or (
                     key.startswith("nearcache-")
                 ):
@@ -1765,6 +1840,26 @@ class RespServer:
             for i in range(0, len(pairs), 2):
                 key = pairs[i].decode().lower()
                 val = pairs[i + 1].decode()
+                if key in ("appendonly", "appendfsync"):
+                    # APPLY before the table write: journal attach can
+                    # fail at runtime (unwritable dir, disk full) even
+                    # though validation passed — GET must never report
+                    # yes without a live journal behind it.
+                    eng = getattr(self._client, "_engine", None)
+                    if key == "appendonly":
+                        if hasattr(eng, "journal_set_enabled"):
+                            try:
+                                eng.journal_set_enabled(
+                                    val.lower() == "yes"
+                                )
+                            except (OSError, ValueError) as e:
+                                raise RespError(
+                                    f"appendonly failed to apply: {e}"
+                                ) from e
+                    elif hasattr(eng, "journal_set_policy"):
+                        eng.journal_set_policy(val.lower())
+                    self._config_table[key] = val
+                    continue
                 self._config_table[key] = val
                 # Live-apply the slowlog/nearcache tunables (validated
                 # above).
@@ -1784,9 +1879,82 @@ class RespServer:
         raise RespError(f"Unknown CONFIG subcommand {sub}")
 
     def _cmd_WAIT(self, args):
-        # Standalone server, no replicas: 0 acknowledged replicas is the
-        # honest Redis answer (writes are already locally durable).
+        """Standalone server, no replicas: 0 acknowledged replicas is
+        the honest Redis answer.  With the durability journal live,
+        WAIT is additionally a real JOURNAL-FSYNC FENCE (ISSUE 10): it
+        forces an fsync covering every record appended so far — under
+        any appendfsync policy — and blocks (up to the command's
+        timeout-ms argument) until it lands.  A client that issues
+        writes then WAIT gets local durability even under everysec/no."""
+        eng = getattr(self._client, "_engine", None)
+        fence = getattr(eng, "journal_fence", None)
+        if fence is not None:
+            timeout_s = None
+            if len(args) >= 2:
+                ms = int(args[1])
+                timeout_s = ms / 1000.0 if ms > 0 else None
+            from redisson_tpu.durability import JournalError
+
+            try:
+                if not fence(timeout=timeout_s):
+                    raise RespError(
+                        "BUSY RTPU journal fsync fence timed out"
+                    )
+            except JournalError as e:
+                raise RespError(f"journal is broken: {e}") from e
         return _encode_int(0)
+
+    # -- persistence commands (ISSUE 10): SAVE family goes live -----------
+
+    def _persist_engine(self):
+        eng = getattr(self._client, "_engine", None)
+        if eng is None or not hasattr(eng, "snapshot"):
+            raise RespError("engine has no snapshot support")
+        sdir = getattr(eng.config, "snapshot_dir", None)
+        if not sdir:
+            raise RespError(
+                "snapshot_dir is not configured (set Config.snapshot_dir)"
+            )
+        return eng, sdir
+
+    def _cmd_SAVE(self, args):
+        """Synchronous snapshot (the RDB SAVE analog): returns +OK only
+        after the snapshot files are fsynced and renamed in — and, with
+        a journal live, after covered segments retired."""
+        eng, sdir = self._persist_engine()
+        eng.snapshot(sdir)
+        return _encode_simple("OK")
+
+    def _bg_snapshot(self, eng, sdir) -> None:
+        try:
+            eng.snapshot(sdir)
+        except Exception:  # pragma: no cover — surfaced via LASTSAVE
+            pass
+
+    def _cmd_BGSAVE(self, args):
+        eng, sdir = self._persist_engine()
+        threading.Thread(
+            target=self._bg_snapshot, args=(eng, sdir),
+            name="rtpu-bgsave", daemon=True,
+        ).start()
+        return _encode_simple("Background saving started")
+
+    def _cmd_LASTSAVE(self, args):
+        eng = getattr(self._client, "_engine", None)
+        return _encode_int(int(getattr(eng, "_last_save_ts", 0.0) or 0))
+
+    def _cmd_BGREWRITEAOF(self, args):
+        """The journal's rewrite IS a snapshot: a completed snapshot
+        records the journal cut and retires every covered segment
+        (mark_snapshot), which is exactly the AOF-rewrite compaction."""
+        eng, sdir = self._persist_engine()
+        if getattr(eng, "journal", None) is None:
+            raise RespError("appendonly is off (no journal to rewrite)")
+        threading.Thread(
+            target=self._bg_snapshot, args=(eng, sdir),
+            name="rtpu-bgrewrite", daemon=True,
+        ).start()
+        return _encode_simple("Background append only file rewriting started")
 
     # -- script watchdog helpers (ISSUE 3 satellite) -----------------------
 
@@ -2606,8 +2774,8 @@ class RespServer:
     # (they can be wide); 'INFO all'/'everything' or the explicit section
     # name includes them.
     _INFO_DEFAULT = (
-        "server", "clients", "memory", "stats", "nearcache", "frontdoor",
-        "overload", "keyspace",
+        "server", "clients", "memory", "stats", "persistence", "nearcache",
+        "frontdoor", "overload", "keyspace",
     )
 
     def _cmd_INFO(self, args):
@@ -2688,6 +2856,36 @@ class RespServer:
                         f"p50={st['p50_us']:g},p99={st['p99_us']:g},"
                         f"p99.9={st['p999_us']:g}"
                     )
+            elif s == "persistence":
+                # Durability tier (ISSUE 10): snapshot + journal state —
+                # the aof_*/rdb_* vocabulary stock tooling expects, plus
+                # the journal-specific seq/lag/segment lines
+                # (docs/robustness.md "Persistence & crash recovery").
+                eng = getattr(self._client, "_engine", None)
+                j = getattr(eng, "journal", None)
+                lines += [
+                    "# Persistence",
+                    "loading:0",
+                    f"rdb_last_save_time:"
+                    f"{int(getattr(eng, '_last_save_ts', 0.0) or 0)}",
+                    f"aof_enabled:{0 if j is None else 1}",
+                ]
+                if j is not None:
+                    st = j.stats()
+                    lines += [
+                        f"appendfsync:{st['policy']}",
+                        f"aof_last_seq:{st['last_seq']}",
+                        f"aof_durable_seq:{st['durable_seq']}",
+                        f"aof_pending_records:{st['lag_ops']}",
+                        f"aof_segments:{st['segments']}",
+                        f"aof_bytes_written:{st['bytes_written']}",
+                        f"aof_records_written:{st['records_written']}",
+                        f"aof_fsyncs:{st['fsyncs']}",
+                        f"aof_fsync_ewma_us:{st['fsync_ewma_us']:g}",
+                        f"aof_broken:{1 if st['broken'] else 0}",
+                        f"aof_replayed_records:"
+                        f"{0 if obs is None else int(sum(c.value for _, c in obs.journal_replayed.items()))}",
+                    ]
             elif s == "nearcache":
                 # Sketch near cache (ISSUE 4): the epoch-guarded host
                 # read tier.  Section absent on the host engine (no tier

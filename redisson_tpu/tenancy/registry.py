@@ -278,6 +278,33 @@ class TenantGovernor:
             if self.max_inflight > 0:
                 self._inflight[tenant] = self._inflight.get(tenant, 0) + n
 
+    def peek_over_quota(self, tenant: str) -> bool:
+        """Non-consuming quota probe for the RESP ingress door (ROADMAP
+        overload item (b)): True when ``tenant`` would be refused right
+        now — its token bucket is empty after refill, or its in-flight
+        quota is full.  Reads only; no tokens are taken and no shed is
+        counted here (the DOOR counts its own command-denominated shed),
+        so a peek can never penalize a tenant that then doesn't submit."""
+        if not self.active:
+            return False
+        with self._lock:
+            if self.max_inflight > 0:
+                if self._inflight.get(tenant, 0) >= self.max_inflight:
+                    return True
+            if self.rate_limit > 0:
+                b = self._buckets.get(tenant)
+                if b is None:  # fresh tenant: full burst available
+                    return False
+                now = self._clock()
+                tokens = min(
+                    self.burst,
+                    b.tokens + (now - b.stamp) * self.rate_limit,
+                )
+                # Mirrors take(): a FULL bucket admits anything; below
+                # full, at least one token must be available.
+                return tokens < 1.0 and tokens < self.burst
+        return False
+
     def release(self, tenant: str, n: int) -> None:
         """Return ``n`` in-flight ops (the submit's futures resolved —
         success or failure, both free the quota)."""

@@ -1,0 +1,241 @@
+"""Kill-−9 crash-fault soak (ISSUE 10 acceptance): a child engine takes
+acked writes under load, dies at a random point, and recovery must
+restore exactly an acked-and-accepted prefix of the deterministic op
+stream — bit-identical device rows vs a golden engine fed the same
+prefix.
+
+- ``appendfsync always``: every ACKED write survives (recovered state
+  matches golden(R) for some R > the highest acked index).
+- ``appendfsync everysec``: loss is bounded by the policy window —
+  every write acked more than LOSS_WINDOW_S before the kill survives.
+
+Slow-marked: each run boots three engines (child subprocess, recovered,
+golden).  The CI ``crash-soak`` step runs this file with
+RTPU_LOCK_WITNESS=1 (tier1.yml).
+"""
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu.chaos import crashchild
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# everysec: the writer fsyncs at most ~1 s apart; generous slack for a
+# loaded CI box (the assertion is about the POLICY bound, not disk perf).
+LOSS_WINDOW_S = 2.5
+OPS = 300
+
+
+class _Matched(Exception):
+    def __init__(self, r):
+        self.r = r
+
+
+def _run_child(tmp, fsync, seed, kill_after_s):
+    """Spawn the soak child, collect ACK lines, SIGKILL it mid-stream.
+    Returns (acked: {index: unix_ts}, kill_time, finished_cleanly)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)  # single CPU device is enough for the child
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "redisson_tpu.chaos.crashchild",
+            "--dir", str(tmp), "--fsync", fsync,
+            "--seed", str(seed), "--ops", str(OPS),
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        cwd=_REPO, env=env, text=True,
+    )
+    acked = {}
+    kill_time = None
+    finished = False
+    first_ack_at = None
+    try:
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith("ACK "):
+                _tag, idx, ts = line.split()
+                acked[int(idx)] = float(ts)
+                if first_ack_at is None:
+                    first_ack_at = time.monotonic()
+                if time.monotonic() - first_ack_at >= kill_after_s:
+                    kill_time = time.time()
+                    os.kill(proc.pid, signal.SIGKILL)
+                    break
+            elif line == "DONE":
+                finished = True
+                kill_time = time.time()
+                os.kill(proc.pid, signal.SIGKILL)
+                break
+        # Drain whatever complete lines made it out before the kill.
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith("ACK ") and len(line.split()) == 3:
+                _tag, idx, ts = line.split()
+                acked[int(idx)] = float(ts)
+            elif line == "DONE":
+                finished = True
+    finally:
+        proc.stdout.close()
+        proc.wait(timeout=30)
+    return acked, kill_time, finished
+
+
+def _recovered_rows(tmp, fsync):
+    """Boot a fresh engine over the crashed directory (recovery runs at
+    init) and capture every tenant's device row by name."""
+    client = crashchild.build_client(str(tmp), fsync)
+    eng = client._engine
+    eng._drain()
+    rows = {}
+    for e in eng.registry.entries():
+        rows[e.name] = np.asarray(
+            eng.executor.read_row(e.pool, e.row)
+        ).copy()
+    replayed = eng.obs.journal_replayed.get(())
+    # Tear down without snapshotting over the evidence.
+    eng.config.snapshot_dir = None
+    client.config.snapshot_dir = None
+    j = eng.journal
+    if j is not None:
+        eng.journal = None
+        j.close()
+    client.shutdown()
+    return rows, replayed
+
+
+def _match_prefix(tmp_path, seed, target_rows, start_r):
+    """Find R in [start_r, OPS] with golden(R ops) == target_rows by
+    driving a journal-less golden engine through the same deterministic
+    stream and comparing after each op.  Returns R or None."""
+    import redisson_tpu as _rt
+    from redisson_tpu import Config
+    from redisson_tpu.codecs import LongCodec
+
+    cfg = Config().set_codec(LongCodec()).use_tpu_sketch(min_bucket=64)
+    golden = _rt.create(cfg)
+    eng = golden.engine if hasattr(golden, "engine") else golden._engine
+
+    def rows_now():
+        eng._drain()
+        out = {}
+        for e in eng.registry.entries():
+            out[e.name] = np.asarray(
+                eng.executor.read_row(e.pool, e.row)
+            )
+        return out
+
+    def same():
+        got = rows_now()
+        if set(got) != set(target_rows):
+            return False
+        return all(
+            np.array_equal(got[n], target_rows[n]) for n in got
+        )
+
+    matched = None
+
+    def ack(i):
+        nonlocal matched
+        r = i + 1
+        if r >= start_r and matched is None and same():
+            raise _Matched(r)
+
+    try:
+        crashchild.apply_ops(golden, seed, OPS, ack=ack)
+        if matched is None and same():
+            matched = OPS
+    except _Matched as m:
+        matched = m.r
+    finally:
+        golden.shutdown()
+    return matched
+
+
+@pytest.mark.parametrize("fsync", ["always", "everysec"])
+def test_kill9_soak_recovers_acked_prefix(tmp_path, fsync):
+    seed = random.randrange(1 << 30)
+    kill_after_s = random.uniform(0.2, 1.0)
+    acked, kill_time, finished = _run_child(
+        tmp_path, fsync, seed, kill_after_s
+    )
+    assert acked, "child never acked a write (startup failure?)"
+    max_acked = max(acked)
+    rows, replayed = _recovered_rows(tmp_path, fsync)
+    assert rows, "recovery produced an empty keyspace"
+    if fsync == "always":
+        # THE durability contract: every acked write survives, so the
+        # recovered state is golden(R) for some R covering all acks
+        # (accepted-but-unacked suffix ops may ride along).
+        lower = max_acked + 1
+    else:
+        # everysec: loss bounded by the policy window — every write
+        # acked LOSS_WINDOW_S before the kill must survive.
+        covered = [
+            i for i, ts in acked.items()
+            if ts <= kill_time - LOSS_WINDOW_S
+        ]
+        lower = (max(covered) + 1) if covered else 0
+    r = _match_prefix(tmp_path, seed, rows, lower)
+    assert r is not None, (
+        f"recovered state matches NO prefix >= {lower} of the op "
+        f"stream (max_acked={max_acked}, replayed={replayed}, "
+        f"finished={finished})"
+    )
+    assert lower <= r <= OPS
+
+
+def test_kill9_with_midstream_snapshot(tmp_path):
+    """Snapshot-coordinated truncation under load: the child snapshots
+    every 50 ops (retiring covered segments), dies, and recovery =
+    last snapshot + remaining tail still restores every acked write."""
+    seed = random.randrange(1 << 30)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "redisson_tpu.chaos.crashchild",
+            "--dir", str(tmp_path), "--fsync", "always",
+            "--seed", str(seed), "--ops", str(OPS),
+            "--snapshot-every", "50",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        cwd=_REPO, env=env, text=True,
+    )
+    acked = {}
+    try:
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith("ACK "):
+                _t, idx, ts = line.split()
+                acked[int(idx)] = float(ts)
+                if int(idx) >= 120:  # past at least two snapshot cuts
+                    os.kill(proc.pid, signal.SIGKILL)
+                    break
+            elif line == "DONE":
+                break
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith("ACK ") and len(line.split()) == 3:
+                _t, idx, ts = line.split()
+                acked[int(idx)] = float(ts)
+    finally:
+        proc.stdout.close()
+        proc.wait(timeout=30)
+    assert acked and max(acked) >= 120
+    rows, _replayed = _recovered_rows(tmp_path, "always")
+    r = _match_prefix(tmp_path, seed, rows, max(acked) + 1)
+    assert r is not None, "acked write lost across snapshot truncation"

@@ -609,3 +609,109 @@ def test_history_mirror_seed_epoch_guard():
         f"holds {device['row']!r} — the acked write would be lost on "
         f"the next write-back"
     )
+
+
+# -- model check 5 (ISSUE 10): journal group-commit state machine -------------
+
+
+def _journal_commit_body(journal_cls, segment_bytes=512,
+                         check_rotation=True):
+    """Two producers × a rotating group-commit writer.  Invariants, in
+    EVERY schedule: (a) under appendfsync=always, a wait_durable return
+    implies an fsync barrier actually ran (the ack-durability commit
+    barrier); (b) across writer park/flush/rotate no record is lost or
+    duplicated — the on-disk seqs are exactly 1..N, once each.
+
+    ``check_rotation=False`` runs the same machine with a large segment
+    (no size-rotation fsyncs inside a batch) — the configuration the
+    commit-barrier mutation guard needs, since a rotation's own fsync
+    would mask a reverted ack barrier."""
+    import os as _os
+    import tempfile
+
+    from redisson_tpu.durability.journal import _scan_segment
+
+    tmp = tempfile.mkdtemp()
+    # Tiny segment bound + fat records: the 6 records force rotations.
+    j = journal_cls(
+        tmp, fsync_policy="always", max_segment_bytes=segment_bytes
+    )
+    pad = np.arange(64, dtype=np.uint64)  # ~512B/record on the wire
+
+    def producer(base):
+        for i in range(3):
+            seq = j.append(
+                {"op": "x", "name": "p", "i": base + i, "pad": pad}
+            )
+            checkpoint(f"appended {base + i}")
+            assert j.wait_durable(seq, timeout=60.0)
+            # The commit barrier: an acked (durable-reported) record
+            # must be covered by a real fsync, never just a write.
+            assert j.stats()["fsyncs"] >= 1, (
+                "wait_durable returned before any fsync ran "
+                "(commit barrier reverted?)"
+            )
+            assert j.durable_seq() >= seq
+
+    t = threading.Thread(target=producer, args=(100,))
+    t.start()
+    producer(200)
+    t.join()
+    j.close()
+    names = sorted(
+        fn for fn in _os.listdir(tmp) if fn.endswith(".rtj")
+    )
+    seqs = []
+    payload_is = []
+    for fn in names:
+        first_seq, frames, _end, clean = _scan_segment(
+            _os.path.join(tmp, fn)
+        )
+        assert clean, f"segment {fn} torn after a clean close"
+        seqs.extend(range(first_seq, first_seq + len(frames)))
+        payload_is.append(len(frames))
+    assert sorted(seqs) == list(range(1, 7)), (
+        f"records lost/duplicated across park/flush/rotate: {seqs}"
+    )
+    if check_rotation:
+        assert len(names) >= 2, "tiny segments must have rotated"
+
+
+@schedule_test(max_schedules=40, random_schedules=16, preemption_bound=1,
+               max_steps=400000)
+def test_model_journal_group_commit_always():
+    from redisson_tpu.durability.journal import OpJournal
+
+    _journal_commit_body(OpJournal)
+
+
+def test_model_journal_commit_barrier_mutation_guard():
+    """Reverting the commit barrier — durability reported at WRITE time
+    instead of fsync time — must be CAUGHT by the model: some schedule
+    sees wait_durable return with zero fsyncs run."""
+    from redisson_tpu.durability.journal import OpJournal
+
+    class _BarrierReverted(OpJournal):
+        def _write_batch(self, batch):
+            super()._write_batch(batch)
+            with self._lock:
+                # The reverted commit barrier: durable == written.
+                self._durable_seq = self._written_seq
+                self._durable_cv.notify_all()
+
+        def _do_fsync(self):
+            # The fsync still happens eventually — the bug is ORDER
+            # (ack before barrier), which only a schedule can see.
+            import time as _t
+
+            _t.sleep(0.01)  # virtual: lets an ack overtake the fsync
+            super()._do_fsync()
+
+    with pytest.raises(ScheduleFailure):
+        explore(
+            lambda: _journal_commit_body(
+                _BarrierReverted, segment_bytes=1 << 20,
+                check_rotation=False,
+            ),
+            max_schedules=200, preemption_bound=1, max_steps=400000,
+        )

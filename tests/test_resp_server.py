@@ -957,13 +957,26 @@ class TestHandshakeAndModernCommands:
             resp.cmd("SINTERCARD", 1, "snl", "LIMIT", -1)
 
     def test_config_set_multi_pair(self, resp):
+        # (appendonly is no longer a free stub — it went LIVE with the
+        # durability tier and refuses without a journal_dir, so the
+        # multi-pair case rides two still-stubbed keys.)
         assert resp.cmd("CONFIG", "SET", "maxmemory", "1mb",
-                        "appendonly", "yes") == "OK"
-        assert resp.cmd("CONFIG", "GET", "appendonly") == [b"appendonly", b"yes"]
+                        "timeout", "10") == "OK"
+        assert resp.cmd("CONFIG", "GET", "timeout") == [b"timeout", b"10"]
         with pytest.raises(RuntimeError, match="Unknown option"):
             resp.cmd("CONFIG", "SET", "maxmemory", "2mb", "bogus", "1")
         # all-or-nothing: the valid pair before the bogus one not applied
         assert resp.cmd("CONFIG", "GET", "maxmemory") == [b"maxmemory", b"1mb"]
+
+    def test_config_set_appendonly_refused_without_journal_dir(self, resp):
+        # Durability tier (ISSUE 10): acking appendonly without a
+        # journal behind it would fake durability — refused, table
+        # untouched.
+        with pytest.raises(RuntimeError, match="journal_dir"):
+            resp.cmd("CONFIG", "SET", "appendonly", "yes")
+        assert resp.cmd("CONFIG", "GET", "appendonly") == [
+            b"appendonly", b"no"
+        ]
 
     def test_getex_strict_options(self, resp):
         resp.cmd("SET", "gx", "v")
