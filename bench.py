@@ -616,6 +616,283 @@ def bench_config6_frontdoor(make_client):
         client.shutdown()
 
 
+def bench_config8_reactor(make_client):
+    """Config 8 — reactor front door A/B (ISSUE 11).
+
+    (a) Unpipelined-client throughput: IDLE mostly-silent connections +
+    ACTIVE closed-loop clients each keeping ONE command in flight (the
+    client shape the reactor exists for — no pipeline window to fuse
+    within a connection), measured with the reactor ON vs the legacy
+    thread-per-connection path on separate same-config servers.  The ON
+    arm's win comes from cross-connection fusion + the merged window's
+    shared response cache + not context-switching IDLE+ACTIVE threads.
+    (b) Idle-connection scaling: with the reactor ON, ramp idle
+    connections toward 5k and record the serving THREAD count (fixed)
+    and process fd count — connections cost descriptors, not threads.
+    Publishes reactor_* BENCH keys."""
+    import os as _os
+    import socket as _socket
+
+    from redisson_tpu.serve.resp import RespServer
+
+    IDLE = 1000
+    ACTIVE = 32
+    PASS_S = 1.5
+    N_ITEMS = 512
+    IDLE_SCALE_TARGET = 5000
+
+    try:  # lift the fd soft limit toward the hard limit (5k sockets)
+        import resource as _resource
+
+        soft, hard = _resource.getrlimit(_resource.RLIMIT_NOFILE)
+        if soft < hard:
+            _resource.setrlimit(_resource.RLIMIT_NOFILE, (hard, hard))
+    except (ImportError, ValueError, OSError):
+        pass
+
+    def _seed(server):
+        sock = _socket.create_connection((server.host, server.port))
+        cmds = [[b"BF.RESERVE", b"rx-bf", b"0.01", b"100000"]]
+        cmds += [
+            [b"BF.MADD", b"rx-bf"] + [b"%d" % i for i in range(j, j + 64)]
+            for j in range(0, N_ITEMS, 64)
+        ]
+        cmds += [
+            [b"SET", b"rx-s%d" % i, b"value-%d" % i] for i in range(4)
+        ]
+        cmds += [
+            [b"SETBIT", b"rx-bs", b"%d" % i, b"1"] for i in range(0, 64, 2)
+        ]
+        # Deterministic fused-path warm (BOTH arms fuse pipelined
+        # batches): the fused bloom read/mixed and bitset kernels
+        # compile HERE, not inside a measured pass — without this the
+        # reactor arm pays first-touch compiles the thread arm never
+        # triggers (its unpipelined traffic never fuses).
+        for _ in range(3):
+            cmds += [
+                [b"BF.EXISTS", b"rx-bf", b"%d" % i] for i in range(32)
+            ]
+            cmds += [
+                [b"BF.ADD", b"rx-bf", b"%d" % i] if i % 4 == 0 else
+                [b"BF.EXISTS", b"rx-bf", b"%d" % i] for i in range(32)
+            ]
+            cmds += [
+                [b"GETBIT", b"rx-bs", b"%d" % (i % 64)] for i in range(32)
+            ]
+            cmds += [[b"GET", b"rx-s%d" % (i % 4)] for i in range(16)]
+        sock.sendall(b"".join(_resp_wire(c) for c in cmds))
+        buf = b""
+        got = pos = 0
+        while got < len(cmds):
+            buf += sock.recv(1 << 16)
+            while True:
+                try:
+                    pos = _resp_skip_frame(buf, pos)
+                    got += 1
+                except (IndexError, ValueError):
+                    break
+        sock.close()
+
+    def _open_idle(server, n, have=None):
+        socks = have if have is not None else []
+        try:
+            while len(socks) < n:
+                socks.append(
+                    _socket.create_connection(
+                        (server.host, server.port), timeout=10
+                    )
+                )
+        except OSError:
+            pass  # fd/limit ceiling: report what we achieved
+        return socks
+
+    def _serving_threads():
+        return sum(
+            1 for t in threading.enumerate()
+            if t.name.startswith("rtpu-resp")
+        )
+
+    N_PROCS = 8  # client processes (ACTIVE conns split across them)
+
+    def _client_proc(host, port, conns, stop_at, seed, q):
+        """Closed-loop unpipelined clients, one thread per connection,
+        in a FORKED process: in-process client threads would contend
+        for the server's GIL and cap BOTH arms at the client's own
+        throughput — the measurement must load the server from outside
+        its interpreter."""
+        counts = [0] * conns
+        lats: list = [[] for _ in range(conns)]
+
+        def worker(t):
+            rng = np.random.default_rng(seed * 100 + t)
+            sock = _socket.create_connection((host, port))
+            sock.setsockopt(
+                _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
+            )
+            try:
+                while time.time() < stop_at:
+                    # Hot-working-set read mix (the tentpole's target
+                    # client shape): mostly repeated reads over a small
+                    # hot set, a trickle of writes keeping the epochs
+                    # and fused write paths honest.
+                    hot = int((rng.zipf(1.3) - 1) % N_ITEMS)
+                    r = rng.random()
+                    if r < 0.03:
+                        cmd = [b"BF.ADD", b"rx-bf", b"%d" % hot]
+                    elif r < 0.38:
+                        cmd = [b"BF.EXISTS", b"rx-bf", b"%d" % hot]
+                    elif r < 0.88:
+                        cmd = [b"GET", b"rx-s%d" % (hot % 4)]
+                    else:
+                        cmd = [b"GETBIT", b"rx-bs", b"%d" % (hot % 64)]
+                    t0 = time.perf_counter()
+                    sock.sendall(_resp_wire(cmd))
+                    data = b""
+                    closed = False
+                    while True:
+                        chunk = sock.recv(1 << 16)
+                        if not chunk:
+                            closed = True  # server dropped us: stop,
+                            break          # don't spin past stop_at
+                        data += chunk
+                        try:
+                            _resp_skip_frame(data, 0)
+                            break
+                        except (IndexError, ValueError):
+                            # ValueError also covers a reply whose
+                            # first "\r\n" hasn't arrived yet
+                            # (bytes.index) — wait for more bytes like
+                            # every other wire loop in this file.
+                            continue
+                    if closed:
+                        break
+                    lats[t].append(time.perf_counter() - t0)
+                    counts[t] += 1
+            finally:
+                sock.close()
+
+        t0 = time.time()
+        threads = [
+            threading.Thread(target=worker, args=(t,))
+            for t in range(conns)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        q.put((sum(counts), time.time() - t0,
+               [x for la in lats for x in la]))
+
+    def _measure(server, duration_s):
+        """Closed-loop unpipelined pass: returns (cmds/s, p99 ms)."""
+        import multiprocessing as _mp
+
+        ctx = _mp.get_context("fork")
+        q = ctx.Queue()
+        stop_at = time.time() + duration_s + 0.3  # absorb fork startup
+        per = ACTIVE // N_PROCS
+        procs = [
+            ctx.Process(
+                target=_client_proc,
+                args=(server.host, server.port, per, stop_at, i, q),
+            )
+            for i in range(N_PROCS)
+        ]
+        for p in procs:
+            p.start()
+        results = [q.get(timeout=duration_s + 60) for _ in procs]
+        for p in procs:
+            p.join(timeout=30)
+        total = sum(r[0] for r in results)
+        dt = float(np.median([r[1] for r in results]))
+        all_lat = sorted(x for r in results for x in r[2])
+        p99 = all_lat[int(len(all_lat) * 0.99)] if all_lat else 0.0
+        return total / max(1e-9, dt), p99 * 1000
+
+    # Both arms live SIMULTANEOUSLY, measured in alternating passes
+    # (the config6 interleaving discipline): ambient load on a shared
+    # bench host would otherwise poison whichever arm ran in the bad
+    # window — interleaved A/B charges drift to both arms equally, and
+    # the published numbers are per-arm MEDIANS over 3 passes.
+    out = {}
+    arms = {}
+    try:
+        for arm in (True, False):
+            client = make_client(batch_window_us=200)
+            client.config.resp_reactor = arm
+            server = RespServer(
+                client,
+                max_connections=(
+                    max(IDLE, IDLE_SCALE_TARGET) + ACTIVE + 16
+                ),
+            )
+            _seed(server)
+            arms[arm] = (client, server, _open_idle(server, IDLE))
+        for arm in (True, False):  # warm (residual compiles, caches)
+            _measure(arms[arm][1], 1.0)
+        passes = {True: [], False: []}
+        for _ in range(3):
+            for arm in (True, False):
+                passes[arm].append(_measure(arms[arm][1], PASS_S))
+        for arm, label in ((True, "reactor"), (False, "reactor_off")):
+            cps = sorted(p[0] for p in passes[arm])[1]  # median of 3
+            p99 = sorted(p[1] for p in passes[arm])[1]
+            out[f"{label}_cmds_per_sec"] = round(cps)
+            out[f"{label}_passes"] = [
+                round(p[0]) for p in passes[arm]
+            ]
+            out[f"{label}_p99_ms"] = round(p99, 2)
+        server = arms[True][1]
+        out["reactor_cross_conn_fused_ops"] = sum(
+            int(c.value)
+            for _, c in server.obs.cross_conn_fused_ops.items()
+        )
+        out["reactor_off_serving_threads_at_idle"] = sum(
+            1 for t in threading.enumerate()
+            if t.name == "rtpu-resp-conn"
+        )
+        # (b) idle scaling, reactor arm only: ramp toward the 5k target
+        # and record the serving-thread + fd census.  The thread arm is
+        # shut down FIRST so its 1k per-connection threads don't sit in
+        # the census.
+        arms[False][1].close()
+        arms[False][0].shutdown()
+        for s in arms[False][2]:
+            s.close()
+        del arms[False]
+        idle = _open_idle(server, IDLE_SCALE_TARGET, have=arms[True][2])
+        for s in idle[:: max(1, len(idle) // 8)]:
+            s.sendall(_resp_wire([b"PING"]))
+            assert s.recv(64).startswith(b"+PONG")
+        try:
+            nfds = len(_os.listdir("/proc/self/fd"))
+        except OSError:
+            nfds = None
+        out["reactor_idle_scale"] = {
+            "target_conns": IDLE_SCALE_TARGET,
+            "achieved_conns": len(idle),
+            "serving_threads": _serving_threads(),
+            "reactor_threads": server.reactor.nthreads,
+            "process_fds": nfds,
+        }
+    finally:
+        for client, server, idle in arms.values():
+            for s in idle:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            server.close()
+            client.shutdown()
+    out["reactor_idle_conns"] = IDLE
+    out["reactor_active_conns"] = ACTIVE
+    out["reactor_speedup"] = round(
+        out["reactor_cmds_per_sec"]
+        / max(1.0, out["reactor_off_cmds_per_sec"]), 2
+    )
+    return out
+
+
 def bench_journal_ab(_make_client):
     """ISSUE 10 acceptance: journal-on overhead A/B.  The same batched
     bloom add pass (the acked-write hot path) runs with journaling off,
@@ -1276,6 +1553,10 @@ def main():
     # 2x offered load; OFF shows the queue-wait collapse.  Plus the
     # tenant-fairness mini-pass.
     overload_stats = bench_config7_overload(make_client)
+    # Reactor front door A/B (ISSUE 11): unpipelined-client cmds/s +
+    # p99 with the epoll reactor vs thread-per-connection, plus the
+    # idle-connection thread/fd census (reactor_* keys).
+    reactor_stats = bench_config8_reactor(make_client)
     # Durability tier A/B (ISSUE 10): journal off vs everysec vs always
     # on the acked-write path (journal_* keys).
     journal_stats = bench_journal_ab(make_client)
@@ -1330,6 +1611,10 @@ def main():
                     # Overload control plane (ISSUE 7): config7_overload
                     # open-loop A/B + fairness soak keys (overload_*).
                     **overload_stats,
+                    # Reactor front door (ISSUE 11): config8_reactor —
+                    # unpipelined cmds/s + p99 reactor ON/OFF, cross-
+                    # connection fused ops, 5k-idle thread/fd census.
+                    **reactor_stats,
                     # Durability tier (ISSUE 10): journal-on overhead
                     # A/B — off vs everysec vs always on the acked
                     # bloom-add path, with fsync counts (journal_*).

@@ -67,6 +67,17 @@ def main(argv=None) -> int:
         "per-connection response cache; docs/performance.md) — "
         "debugging escape hatch, semantics are identical either way",
     )
+    p.add_argument(
+        "--no-resp-reactor", action="store_true",
+        help="serve thread-per-connection instead of the epoll reactor "
+        "pool (ISSUE 11; docs/performance.md) — differential-testing "
+        "escape hatch, per-connection semantics are identical either "
+        "way but idle connections cost a thread each",
+    )
+    p.add_argument(
+        "--resp-reactor-threads", type=int, default=None,
+        help="reactor event-loop thread count (default from config, 1)",
+    )
     args = p.parse_args(argv)
 
     import redisson_tpu
@@ -99,6 +110,12 @@ def main(argv=None) -> int:
         cfg.enable_python_scripts = True
     if args.no_resp_vectorize:
         cfg.resp_vectorize = False
+    if args.no_resp_reactor:
+        cfg.resp_reactor = False
+    if args.resp_reactor_threads is not None:
+        if args.resp_reactor_threads < 1:
+            p.error("--resp-reactor-threads must be >= 1")
+        cfg.resp_reactor_threads = args.resp_reactor_threads
 
     client = redisson_tpu.create(cfg)
     server = RespServer(

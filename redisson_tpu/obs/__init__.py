@@ -152,6 +152,22 @@ class Observability:
         self.resp_cache_misses = r.counter(
             "rtpu_resp_response_cache_misses",
             "response-cache probes that executed the command")
+        # Reactor front door (ISSUE 11): epoll event-loop ticks, how many
+        # connections each tick found ready, and ops that fused into an
+        # engine launch TOGETHER WITH ops from other connections (the
+        # cross-connection batch-economics headline — within-connection
+        # fusion is already counted by rtpu_resp_fused_ops).
+        self.reactor_ticks = r.counter(
+            "rtpu_reactor_ticks",
+            "reactor event-loop ticks that processed at least one event")
+        self.reactor_ready_conns = r.counter(
+            "rtpu_reactor_ready_conns",
+            "connections found ready across reactor ticks (avg per tick "
+            "= this / rtpu_reactor_ticks)")
+        self.cross_conn_fused_ops = r.counter(
+            "rtpu_cross_conn_fused_ops",
+            "engine ops fused into a launch together with ops from OTHER "
+            "connections, by family", ("family",))
 
     # -- instrumentation helpers (one call per batch, never per op) --------
 

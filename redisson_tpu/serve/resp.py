@@ -625,9 +625,42 @@ class RespServer:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
-        self._sock.listen(64)
+        self._sock.listen(512)
         self.host, self.port = self._sock.getsockname()
         self._closed = False
+        # Connection-limit refusals (ISSUE 11 satellite): counted so
+        # reactor-mode capacity tuning is observable — INFO clients
+        # (rejected_connections) + rtpu_resp_ingress_shed{conn_limit}.
+        self._conns_refused = 0
+        # Reactor front door (ISSUE 11 tentpole): a small fixed pool of
+        # epoll/selector event-loop threads replaces thread-per-
+        # connection serving — each tick drains recv buffers across ALL
+        # ready connections and feeds one merged parse→vectorize→
+        # dispatch pass, so same-family ops from different connections
+        # fuse into single engine launches and idle connections cost a
+        # file descriptor, not a thread.  resp_reactor=False keeps the
+        # legacy path selectable for differential testing;
+        # RTPU_REQUIRE_REACTOR makes a silent fallback a hard error
+        # (the CI analog of RTPU_REQUIRE_NATIVE_RESP).
+        self.reactor = None
+        if bool(getattr(client.config, "resp_reactor", True)):
+            import os as _os
+
+            try:
+                from redisson_tpu.serve.reactor import ReactorPool
+
+                self.reactor = ReactorPool(
+                    self,
+                    nthreads=int(
+                        getattr(client.config, "resp_reactor_threads", 1)
+                        or 1
+                    ),
+                )
+            except Exception:
+                if _os.environ.get("RTPU_REQUIRE_REACTOR"):
+                    self._sock.close()
+                    raise
+                self.reactor = None
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="rtpu-resp-accept", daemon=True
         )
@@ -645,6 +678,14 @@ class RespServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            try:
+                # redis-server sets TCP_NODELAY on accepted sockets:
+                # small reply frames must not sit behind Nagle.
+                conn.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+            except OSError:
+                pass
             with self._conn_lock:
                 refused = self._nconn >= self.max_connections
                 if not refused:
@@ -652,6 +693,13 @@ class RespServer:
                     self._conns_accepted += 1
                     self._conns.add(conn)
             if refused:
+                # Count the refusal (ISSUE 11 satellite): reactor-mode
+                # capacity tuning needs conn-limit sheds visible next to
+                # the command-level ingress sheds, and INFO clients
+                # carries the lifetime total (rejected_connections).
+                self._conns_refused += 1
+                if self.obs is not None:
+                    self.obs.resp_ingress_shed.inc(("conn_limit",))
                 # Refusal send OUTSIDE _conn_lock (rtpulint RT001): a
                 # stalled rejected peer must not park the accept thread
                 # while it holds the lock every disconnecting
@@ -664,10 +712,13 @@ class RespServer:
                 except OSError:
                     pass
                 continue
-            threading.Thread(
-                target=self._serve_conn, args=(conn,),
-                name="rtpu-resp-conn", daemon=True,
-            ).start()
+            if self.reactor is not None:
+                self.reactor.assign(conn)
+            else:
+                threading.Thread(
+                    target=self._serve_conn, args=(conn,),
+                    name="rtpu-resp-conn", daemon=True,
+                ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
@@ -775,6 +826,10 @@ class RespServer:
                 if remaining <= 0:
                     break
                 self._conn_idle.wait(timeout=remaining)
+        # Reactors stop AFTER the drain: they are the threads that
+        # observe the shutdowns above and tear each connection down.
+        if self.reactor is not None:
+            self.reactor.close()
 
     # -- command dispatch ---------------------------------------------------
 
@@ -971,15 +1026,46 @@ class RespServer:
         return _encode_error(f"{type(e).__name__}: {e}")
 
     def _dispatch_pipeline(self, batch, ctx: "_ConnCtx"):
-        """Vectorized dispatch of one parsed-ahead batch.  Scans for runs
-        of adjacent same-family commands and fuses each run into one
+        """Vectorized dispatch of one parsed-ahead batch from ONE
+        connection (the thread-per-connection path): every item shares
+        the connection's ctx."""
+        return self._dispatch_merged(batch, [ctx] * len(batch))
+
+    @staticmethod
+    def _ctx_fusable(ctx: "_ConnCtx") -> bool:
+        """Whether this connection's items may join a fused run right
+        now: an unauthenticated connection must see NOAUTH per command,
+        and a MULTI-queued command must queue, not execute."""
+        return ctx.authed and not ctx.in_multi
+
+    @classmethod
+    def _fuse_compat(cls, head_ctx: "_ConnCtx", ctx: "_ConnCtx") -> bool:
+        """Whether ``ctx``'s items may join a run HEADED by
+        ``head_ctx``'s: fusable, and carrying the SAME per-connection
+        deadline override — the run executes under ONE deadline scope
+        (the head's), so a CLIENT DEADLINE connection fused into a
+        no-deadline run would silently lose its overload contract."""
+        return (
+            cls._ctx_fusable(ctx)
+            and ctx.op_deadline_ms == head_ctx.op_deadline_ms
+        )
+
+    def _dispatch_merged(self, batch, ctxs):
+        """Vectorized dispatch of one command window.  ``batch[i]``
+        belongs to connection ``ctxs[i]`` — the thread-per-connection
+        path passes one shared ctx, the reactor passes one tick's merged
+        cross-connection batch (each connection's items appear in its
+        own arrival order, so per-connection ordering is preserved by
+        construction).  Scans for runs of adjacent same-family commands
+        — ACROSS connection boundaries — and fuses each run into one
         engine call, demuxing the packed result into per-command replies
-        in command order; everything else (and every command while the
+        in window order; everything else (and every command while its
         connection is in MULTI / unauthenticated / script-BUSY state)
         dispatches sequentially, so per-connection semantics are
         bit-identical to the unfused path.  Returns (frames, consumed):
         ``consumed`` < len(batch) when the bounded reply buffer filled —
-        the caller re-queues the tail."""
+        the caller re-queues the tail (``frames[k]`` answers
+        ``batch[k]`` for k < consumed)."""
         out: list = []
         size = 0
         i = 0
@@ -991,19 +1077,24 @@ class RespServer:
         # check re-reads live pressure.)
         overloaded = self._pressure_over()
         # Per-window response cache: (name, *argv) -> reply frame, valid
-        # while the write epoch is unmoved.
+        # while the write epoch is unmoved.  Shared across the window's
+        # connections on purpose: entries key on exact argv and the
+        # server-wide write epoch only, so a frame one connection
+        # computed is exactly the frame any other would compute in the
+        # same epoch.
         rc: dict = {}
         rc_cap = self.response_cache_size
         rc_state = [self._write_epoch]
+        obs = self.obs
         while i < n:
             if size >= (1 << 20):
                 break
             cmd = batch[i]
+            ctx = ctxs[i]
             name = cmd[0].decode("latin-1", "replace").upper()
             plain = (
                 self.vectorize
-                and ctx.authed
-                and not ctx.in_multi
+                and self._ctx_fusable(ctx)
                 and not self._script_busy()
             )
             if plain and rc_cap > 0 and name in _CACHEABLE:
@@ -1014,11 +1105,86 @@ class RespServer:
                     i += 1
                     continue
             run = (
-                self._scan_run(batch, i)
+                self._scan_run(batch, i, ctxs)
                 if plain and not overloaded else None
             )
             if run is not None:
-                frames, j = self._exec_run(run, batch, i, ctx, rc, rc_state)
+                if (
+                    self._op_deadline_s(ctx) is None
+                    and self._run_readonly(run)
+                ):
+                    # Submit-ahead span: back-to-back READ-ONLY runs
+                    # submit their engine calls first, then resolve in
+                    # window order — the launches overlap in the
+                    # coalescer instead of the window serializing
+                    # behind one .result() at a time.  Read-only only:
+                    # a write run's epoch bump lands at resolve, and
+                    # submitting past it could let a later member's
+                    # cache probe serve a stale pre-write frame.
+                    # (Span members skip the loop-top response-cache
+                    # probe; the frames a run computes are identical to
+                    # what the cache held, so bytes cannot differ.)
+                    spans = [(i, run, self._submit_run(run))]
+                    jj = run[1]
+                    span_conns = {id(c) for c in ctxs[i:jj]}
+                    while jj < n and len(spans) < 8:
+                        if not (
+                            self.vectorize
+                            and self._ctx_fusable(ctxs[jj])
+                            and self._op_deadline_s(ctxs[jj]) is None
+                            and not self._script_busy()
+                        ):
+                            # (A deadline-carrying connection's run must
+                            # execute under its deadline_scope — the
+                            # _exec_run path — never as a bare span
+                            # member.)
+                            break
+                        nxt = self._scan_run(batch, jj, ctxs)
+                        if nxt is None or not self._run_readonly(nxt):
+                            break
+                        nxt_conns = {id(c) for c in ctxs[jj:nxt[1]]}
+                        if span_conns & nxt_conns:
+                            # One in-flight run per CONNECTION: a
+                            # connection's later run submitted before
+                            # its earlier run's observation point could
+                            # show a concurrent writer's effects out of
+                            # program order (later command reflecting
+                            # OLDER state).  Runs of disjoint
+                            # connections carry no mutual ordering
+                            # contract — they overlap freely.
+                            break
+                        spans.append((jj, nxt, self._submit_run(nxt)))
+                        span_conns |= nxt_conns
+                        jj = nxt[1]
+                    for pos, r, sub in spans:
+                        frames, rj = self._resolve_run(
+                            r, sub, batch, pos, ctxs, rc, rc_state
+                        )
+                        if obs is not None and len(
+                            {id(c) for c in ctxs[pos:rj]}
+                        ) > 1:
+                            obs.cross_conn_fused_ops.inc(
+                                (r[0],), self._run_nops(r, pos, rj)
+                            )
+                        out.extend(frames)
+                        size += sum(len(f) for f in frames)
+                        i = rj
+                        if rj < r[1]:
+                            # mget reply-byte cut: the tail (and any
+                            # later READ-ONLY span member — re-running
+                            # a read is free) re-queues.
+                            break
+                    continue
+                frames, j = self._exec_run(run, batch, i, ctxs, rc, rc_state)
+                if obs is not None and len(
+                    {id(c) for c in ctxs[i:j]}
+                ) > 1:
+                    # Cross-connection fusion (ISSUE 11): these ops
+                    # launched together with ops from other connections
+                    # — single-command clients got batch economics.
+                    obs.cross_conn_fused_ops.inc(
+                        (run[0],), self._run_nops(run, i, j)
+                    )
                 out.extend(frames)
                 size += sum(len(f) for f in frames)
                 i = j
@@ -1033,6 +1199,17 @@ class RespServer:
             size += len(frame)
             i += 1
         return out, i
+
+    @staticmethod
+    def _run_nops(run, i: int, end: int) -> int:
+        """Engine ops a fused-run descriptor carried — ``end`` is the
+        position execution actually reached (an mget run can be cut by
+        the reply-byte bound; its requeued tail must not be counted
+        here AND again when it re-dispatches)."""
+        fam = run[0]
+        if fam == "mget":
+            return end - i
+        return len(run[3])
 
     # response-cache plumbing: rc_state[0] holds the epoch the window's
     # entries were installed under; any bump wipes the window.
@@ -1075,23 +1252,27 @@ class RespServer:
 
     # -- run scanning ------------------------------------------------------
 
-    def _scan_run(self, batch, i):
+    def _scan_run(self, batch, i, ctxs):
         """A fused-run descriptor starting at ``batch[i]``, or None.
         Runs are maximal spans of adjacent commands of one family (same
-        target object for bf/bitset); any non-member — including a
-        malformed member whose sequential dispatch would error — ends
+        target object for bf/bitset/cms), possibly spanning CONNECTION
+        boundaries in a merged window; any non-member — including a
+        malformed member whose sequential dispatch would error, or a
+        member whose connection is mid-MULTI / unauthenticated — ends
         the run and dispatches sequentially (a run barrier)."""
         first = batch[i][0].upper()
         if first in _BF_RUN:
-            return self._collect_bf_run(batch, i)
+            return self._collect_bf_run(batch, i, ctxs)
         if first in _BIT_RUN:
-            return self._collect_bit_run(batch, i)
+            return self._collect_bit_run(batch, i, ctxs)
         if first in _GET_RUN:
-            return self._collect_get_run(batch, i)
+            return self._collect_get_run(batch, i, ctxs)
+        if first == b"CMS.QUERY":
+            return self._collect_cms_run(batch, i, ctxs)
         return None
 
-    @staticmethod
-    def _collect_bf_run(batch, i):
+    @classmethod
+    def _collect_bf_run(cls, batch, i, ctxs):
         cmd = batch[i]
         if len(cmd) < 3:
             return None
@@ -1103,7 +1284,10 @@ class RespServer:
         while j < len(batch) and len(items) < _RUN_MAX_OPS:
             c = batch[j]
             spec = _BF_RUN.get(c[0].upper())
-            if spec is None or len(c) < 3 or c[1] != key:
+            if (
+                spec is None or len(c) < 3 or c[1] != key
+                or not cls._fuse_compat(ctxs[i], ctxs[j])
+            ):
                 break
             is_add, many = spec
             ops = c[2:] if many else c[2:3]
@@ -1117,8 +1301,8 @@ class RespServer:
             return None
         return ("bloom", j, key, items, flags, shape)
 
-    @staticmethod
-    def _collect_bit_run(batch, i):
+    @classmethod
+    def _collect_bit_run(cls, batch, i, ctxs):
         key = batch[i][1] if len(batch[i]) >= 2 else None
         idx: list = []
         kinds: list = []  # 0 = get, 1 = clear, 2 = set
@@ -1127,6 +1311,8 @@ class RespServer:
         while j < len(batch) and len(idx) < _RUN_MAX_OPS:
             c = batch[j]
             nm = c[0].upper()
+            if not cls._fuse_compat(ctxs[i], ctxs[j]):
+                break
             if nm == b"GETBIT":
                 if len(c) < 3 or c[1] != key:
                     break
@@ -1157,33 +1343,146 @@ class RespServer:
             return None
         return ("bitset", j, key, idx, kinds, names)
 
-    @staticmethod
-    def _collect_get_run(batch, i):
+    @classmethod
+    def _collect_get_run(cls, batch, i, ctxs):
         j = i
         while j < len(batch):
             c = batch[j]
-            if c[0].upper() not in _GET_RUN or len(c) < 2:
+            if (
+                c[0].upper() not in _GET_RUN or len(c) < 2
+                or not cls._fuse_compat(ctxs[i], ctxs[j])
+            ):
                 break
             j += 1
         if j - i < 2:
             return None
         return ("mget", j, None, None, None, None)
 
+    @classmethod
+    def _collect_cms_run(cls, batch, i, ctxs):
+        """Adjacent CMS.QUERY commands on one sketch fuse into a single
+        ``estimate_all`` call (ISSUE 11 satellite / ROADMAP near-cache
+        reach): the merged item vector rides the engine's
+        ``lookup_batch`` partial-hit split — cached estimates answer
+        from the near cache, ONLY the misses ride the coalescer."""
+        cmd = batch[i]
+        if len(cmd) < 3:
+            return None
+        key = cmd[1]
+        items: list = []
+        shape: list = []  # nops per command
+        j = i
+        while j < len(batch) and len(items) < _RUN_MAX_OPS:
+            c = batch[j]
+            if (
+                c[0].upper() != b"CMS.QUERY" or len(c) < 3 or c[1] != key
+                or not cls._fuse_compat(ctxs[i], ctxs[j])
+            ):
+                break
+            items.extend(c[2:])
+            shape.append(len(c) - 2)
+            j += 1
+        if j - i < 2:
+            return None
+        return ("cms", j, key, items, shape, None)
+
     # -- run execution -----------------------------------------------------
 
-    def _exec_run(self, run, batch, i, ctx: "_ConnCtx", rc, rc_state):
+    def _exec_run(self, run, batch, i, ctxs, rc, rc_state):
         # The fused run is ONE engine call serving many commands: one
-        # shared deadline covers it (per-command scopes re-stamp inside
-        # the mget fam's _safe_dispatch calls).
-        dl_s = self._op_deadline_s(ctx)
+        # shared deadline covers it — the run's FIRST connection's
+        # deadline, when the run spans connections (per-command scopes
+        # re-stamp inside the mget fam's _safe_dispatch calls).
+        dl_s = self._op_deadline_s(ctxs[i])
         if dl_s is None:
-            return self._exec_run_inner(run, batch, i, ctx, rc, rc_state)
+            return self._exec_run_inner(run, batch, i, ctxs, rc, rc_state)
         with _overload.deadline_scope(dl_s):
-            return self._exec_run_inner(run, batch, i, ctx, rc, rc_state)
+            return self._exec_run_inner(run, batch, i, ctxs, rc, rc_state)
 
-    def _exec_run_inner(self, run, batch, i, ctx: "_ConnCtx", rc, rc_state):
-        fam, j = run[0], run[1]
+    def _exec_run_inner(self, run, batch, i, ctxs, rc, rc_state):
+        return self._resolve_run(
+            run, self._submit_run(run), batch, i, ctxs, rc, rc_state
+        )
+
+    @staticmethod
+    def _run_readonly(run) -> bool:
+        """True when executing this run cannot mutate keyspace state —
+        the submit-ahead span condition (_dispatch_merged): a WRITE
+        run's epoch bump lands at resolve time, so submitting past one
+        could let a later span member's response-cache probe serve a
+        stale pre-write frame."""
+        fam = run[0]
+        if fam in ("mget", "cms"):
+            return True
+        if fam == "bloom":
+            return not any(run[4])
+        return all(k == 0 for k in run[4])  # bitset
+
+    def _submit_run(self, run):
+        """Phase 1 of a fused run: build and SUBMIT the engine call(s)
+        without waiting; returns an opaque token for _resolve_run.
+        Back-to-back read-only runs submit ahead of the first resolve
+        (_dispatch_merged), so their launches overlap in the coalescer
+        instead of serializing the window behind one .result() at a
+        time (ISSUE 11: a reactor tick is the whole front door — a
+        blocked tick blocks every connection)."""
+        fam = run[0]
         t0 = time.perf_counter()
+        if fam == "mget":
+            return (t0, None, None)  # host-side: executes at resolve
+        if fam == "cms":
+            try:
+                return (t0, self._cms(run[2]).estimate_all_async(run[3]),
+                        None)
+            except Exception as e:
+                return (t0, None, e)
+        if fam == "bloom":
+            _, _, key, items, flags, _shape = run
+            try:
+                bf = self._client.get_bloom_filter(self._s(key))
+                if not any(flags):
+                    fut = bf.contains_all_async(items)
+                elif all(flags):
+                    fut = bf.add_all_async(items)
+                else:
+                    fut = bf.mixed_async(items, np.asarray(flags, bool))
+                return (t0, fut, None)
+            except Exception as e:
+                return (t0, None, e)
+        # fam == "bitset"
+        _, _, key, idx, kinds, _names = run
+        err = None
+        groups: list = []  # (start, end, future-or-exception)
+        try:
+            bs = self._client.get_bit_set(self._s(key))
+            p = 0
+            while p < len(kinds):
+                q = p + 1
+                while q < len(kinds) and kinds[q] == kinds[p]:
+                    q += 1
+                sel = idx[p:q]
+                if kinds[p] == 0:
+                    groups.append((p, q, bs.get_many_async(sel)))
+                else:
+                    groups.append(
+                        (p, q, bs.set_many_async(sel, kinds[p] == 2))
+                    )
+                p = q
+        except Exception as e:
+            # Submit-time failure: nothing later can have applied —
+            # every not-yet-grouped op fails with the same error.
+            err = e
+            done = groups[-1][1] if groups else 0
+            groups.append((done, len(kinds), e))
+        return (t0, groups, err)
+
+    def _resolve_run(self, run, sub, batch, i, ctxs, rc, rc_state):
+        """Phase 2 of a fused run: wait for the submission, demux
+        per-command reply frames in window order, feed the response
+        cache, bump the write epoch for runs that wrote, and record
+        stats."""
+        fam, j = run[0], run[1]
+        t0, handle, err = sub
         if fam == "mget":
             # One grid pass: the whole read run executes under a single
             # grid-lock hold (handlers re-enter the RLock for free), and
@@ -1213,7 +1512,7 @@ class RespServer:
                         frames.append(hit)
                         size += len(hit)
                         continue
-                    frame = self._safe_dispatch(cmd, ctx)
+                    frame = self._safe_dispatch(cmd, ctxs[k])
                     if (
                         self.response_cache_size > 0
                         and not frame.startswith(b"-")
@@ -1229,22 +1528,50 @@ class RespServer:
             # the per-family breakdown in rtpu_resp_fused_cmds).
             self._count_fused(fam, j - i, j - i, None, 0.0)
             return frames, j
+        if fam == "cms":
+            # One estimate_all call for the whole run: the merged item
+            # vector rides the near cache's lookup_batch partial-hit
+            # split, so cached estimates never touch the device and only
+            # misses ride the coalescer (ROADMAP near-cache reach).
+            _, _, key, items, shape, _ = run
+            vals = None
+            if err is None:
+                try:
+                    vals = np.asarray(handle.result())
+                except Exception as e:
+                    err = e
+            frames = []
+            pos = 0
+            names = []
+            for nops in shape:
+                names.append("CMS.QUERY")
+                if err is not None:
+                    frames.append(self._fused_error_frame(err))
+                else:
+                    frames.append(
+                        _encode_array(
+                            [int(v) for v in vals[pos : pos + nops]]
+                        )
+                    )
+                pos += nops
+            self._install_read_frames(
+                rc, rc_state, batch, i, names, frames,
+                readable=("CMS.QUERY",), err=err, wrote=False,
+            )
+            self._count_fused(
+                fam, j - i, len(items), names,
+                time.perf_counter() - t0, err=err,
+            )
+            return frames, j
         if fam == "bloom":
             _, _, key, items, flags, shape = run
-            err = None
             vals = None
             any_add = any(flags)
-            try:
-                bf = self._client.get_bloom_filter(self._s(key))
-                if not any_add:
-                    fut = bf.contains_all_async(items)
-                elif all(flags):
-                    fut = bf.add_all_async(items)
-                else:
-                    fut = bf.mixed_async(items, np.asarray(flags, bool))
-                vals = fut.result()
-            except Exception as e:
-                err = e
+            if err is None:
+                try:
+                    vals = handle.result()
+                except Exception as e:
+                    err = e
             if any_add:
                 self._bump_write_epoch()
             frames = []
@@ -1275,30 +1602,8 @@ class RespServer:
             return frames, j
         # fam == "bitset"
         _, _, key, idx, kinds, names = run
-        err = None
         any_write = any(k != 0 for k in kinds)
-        groups: list = []  # (start, end, future-or-exception)
-        try:
-            bs = self._client.get_bit_set(self._s(key))
-            p = 0
-            while p < len(kinds):
-                q = p + 1
-                while q < len(kinds) and kinds[q] == kinds[p]:
-                    q += 1
-                sel = idx[p:q]
-                if kinds[p] == 0:
-                    groups.append((p, q, bs.get_many_async(sel)))
-                else:
-                    groups.append(
-                        (p, q, bs.set_many_async(sel, kinds[p] == 2))
-                    )
-                p = q
-        except Exception as e:
-            # Submit-time failure: nothing later can have applied —
-            # every not-yet-grouped op fails with the same error.
-            err = e
-            done = groups[-1][1] if groups else 0
-            groups.append((done, len(kinds), e))
+        groups = handle  # (start, end, future-or-exception) spans
         if any_write:
             self._bump_write_epoch()
         frames: list = [None] * len(kinds)
@@ -2800,6 +3105,10 @@ class RespServer:
                     "# Clients",
                     f"connected_clients:{self._nconn}",
                     f"maxclients:{self.max_connections}",
+                    # Conn-limit refusals (ISSUE 11 satellite): the
+                    # accept-loop shed reactor-mode capacity tuning
+                    # watches (also rtpu_resp_ingress_shed{conn_limit}).
+                    f"rejected_connections:{self._conns_refused}",
                 ]
             elif s == "memory":
                 from redisson_tpu.serve.metrics import Profiler
@@ -2932,6 +3241,21 @@ class RespServer:
                     f"frontdoor_response_cache_misses:{rcm}",
                     f"frontdoor_response_cache_hit_rate:"
                     f"{round(rch / (rch + rcm), 4) if rch + rcm else 0.0}",
+                ]
+                # Reactor front door (ISSUE 11): tick cadence + the
+                # cross-connection fusion the merged pass achieved.
+                rx = self.reactor
+                ticks = _tot(obs.reactor_ticks)
+                ready = _tot(obs.reactor_ready_conns)
+                lines += [
+                    f"frontdoor_reactor:{1 if rx is not None else 0}",
+                    f"frontdoor_reactor_threads:"
+                    f"{0 if rx is None else rx.nthreads}",
+                    f"frontdoor_reactor_ticks:{ticks}",
+                    f"frontdoor_reactor_ready_conns_per_tick:"
+                    f"{round(ready / ticks, 2) if ticks else 0.0}",
+                    f"frontdoor_cross_conn_fused_ops:"
+                    f"{_tot(obs.cross_conn_fused_ops)}",
                 ]
             elif s == "overload" and obs is not None:
                 # Overload control plane (ISSUE 7): deadlines, admission

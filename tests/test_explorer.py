@@ -715,3 +715,241 @@ def test_model_journal_commit_barrier_mutation_guard():
             ),
             max_schedules=200, preemption_bound=1, max_steps=400000,
         )
+
+
+# -- reactor front door model (ISSUE 11 satellite) ----------------------------
+#
+# The RESP vectorizer's run fences + the reactor's tick machinery: the
+# merged pass collects each connection's commands in arrival order,
+# partial consumption (the reply-buffer bound) requeues the tail at the
+# FRONT, a detached worker freezes its connection, and cross-thread
+# reply enqueues ride the outbuf lock.  The model drives the REAL
+# _Reactor._run_pass/_flush/enqueue code under explored schedules and
+# asserts: no schedule reorders one connection's replies or loses an op
+# across a tick boundary.
+
+
+def _reactor_pass_body():
+    from collections import deque
+
+    from redisson_tpu.serve import reactor as rx
+
+    class _FakeSock:
+        def __init__(self, fd):
+            self._fd = fd
+            self.sent = bytearray()
+
+        def fileno(self):
+            return self._fd
+
+        def getpeername(self):
+            raise OSError("not connected")
+
+        def send(self, view):
+            checkpoint("wire send")
+            self.sent += bytes(view)
+            return len(view)
+
+        def close(self):
+            pass
+
+        def shutdown(self, how):
+            pass
+
+    class _StubServer:
+        _requirepass = None
+        idle_timeout_s = 0.0
+        output_buffer_limit = 0
+        output_buffer_soft_seconds = 0.0
+        obs = None
+
+        def _dispatch_merged(self, cmds, ctxs):
+            # Consume ONE command per pass: every tick with more than
+            # one command exercises the requeue-at-front path, and a
+            # cut can land on a connection that still has uncollected
+            # commands behind a detach barrier (where front-vs-back
+            # requeue order is actually observable).
+            checkpoint("merged dispatch")
+            return [b"+" + cmds[0][0] + b"\r\n"], 1
+
+        def _safe_dispatch(self, cmd, ctx):
+            checkpoint("detached dispatch")
+            return b"+" + cmd[0] + b"\r\n"
+
+    class _NoopWake:
+        def send(self, data):
+            return len(data)
+
+    server = _StubServer()
+    r = object.__new__(rx._Reactor)
+    r.server = server
+    r.conns = {}
+    r._new = deque()
+    r._stopping = False
+    r.tid = 0
+    r._attention = set()
+    r.want_flush = set()
+    r._wake_w = _NoopWake()
+
+    conn_a = rx._RConn(_FakeSock(1001), server, r)
+    conn_b = rx._RConn(_FakeSock(1002), server, r)
+    # BLPOP rides a detached worker: conn A freezes mid-stream, PING3
+    # must still follow the worker's reply.
+    conn_a.pending.extend(
+        [[b"PING1"], [b"PING2"], [b"BLPOP", b"q", b"1"], [b"PING3"]]
+    )
+    conn_b.pending.extend([[b"PING4"], [b"PING5"]])
+    conn_a.registered = conn_b.registered = False
+    r.conns = {1001: conn_a, 1002: conn_b}
+    # _read_ready would have flagged both as having framed commands.
+    r._attention = {conn_a, conn_b}
+
+    def done():
+        return all(
+            not c.pending and not c.busy and not c.outbuf
+            for c in (conn_a, conn_b)
+        )
+
+    for _ in range(60):
+        r._run_pass(0.0)
+        checkpoint("tick boundary")
+        if done():
+            break
+        # Virtual-clock sleep: blocks this thread so the scheduler can
+        # run a pending detached worker (costs µs — the clock only
+        # advances when every thread blocks).
+        time.sleep(0.001)
+    assert done(), (
+        f"ops lost across tick boundary: a={list(conn_a.pending)} "
+        f"busy={conn_a.busy} b={list(conn_b.pending)}"
+    )
+    # Per-connection reply streams: exact command order, nothing lost,
+    # nothing duplicated — whatever the tick/worker interleaving.
+    assert bytes(conn_a.sock.sent) == (
+        b"+PING1\r\n+PING2\r\n+BLPOP\r\n+PING3\r\n"
+    ), f"conn A replies reordered: {bytes(conn_a.sock.sent)!r}"
+    assert bytes(conn_b.sock.sent) == b"+PING4\r\n+PING5\r\n", (
+        f"conn B replies reordered: {bytes(conn_b.sock.sent)!r}"
+    )
+
+
+@schedule_test(max_schedules=150, random_schedules=32, preemption_bound=2,
+               max_steps=400000)
+def test_model_reactor_tick_ordering():
+    _reactor_pass_body()
+
+
+def test_model_reactor_requeue_mutation_guard():
+    """Reverting the requeue-at-FRONT discipline (appending the
+    unconsumed tail at the BACK, after newly-framed commands) must be
+    caught: the model's partial consumption makes some schedule emit
+    conn replies out of command order."""
+    from redisson_tpu.serve import reactor as rx
+
+    orig = rx._Reactor._run_pass
+
+    def run_pass_reverted(self, now):
+        # Monkeypatched deque whose appendleft APPENDS — exactly the
+        # bug class the model exists to catch.
+        for c in self.conns.values():
+            if not isinstance(c.pending, _TailAppendDeque):
+                c.pending = _TailAppendDeque(c.pending)
+        return orig(self, now)
+
+    from collections import deque as _deque
+
+    class _TailAppendDeque(_deque):
+        def appendleft(self, item):
+            self.append(item)
+
+    rx._Reactor._run_pass = run_pass_reverted
+    try:
+        with pytest.raises(ScheduleFailure):
+            explore(
+                _reactor_pass_body,
+                max_schedules=150, preemption_bound=2, max_steps=400000,
+            )
+    finally:
+        rx._Reactor._run_pass = orig
+
+
+# -- vectorizer run fences (ISSUE 11 satellite: the PR 9 leftover) ------------
+#
+# Property checks against the REAL collectors: a run may never cross a
+# key change, a malformed member, or a connection that is mid-MULTI /
+# unauthenticated (the fences that keep fused execution bit-identical
+# to sequential dispatch).
+
+
+def _fctx(**kw):
+    ns = types.SimpleNamespace(
+        authed=True, in_multi=False, op_deadline_ms=None
+    )
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_run_fence_key_change_barriers():
+    from redisson_tpu.serve.resp import RespServer
+
+    a = _fctx()
+    batch = [
+        [b"BF.ADD", b"k", b"x"], [b"BF.EXISTS", b"k", b"y"],
+        [b"BF.EXISTS", b"k2", b"z"],
+    ]
+    run = RespServer._collect_bf_run(batch, 0, [a, a, a])
+    assert run is not None and run[1] == 2  # k2 ends the run
+
+    cms = [
+        [b"CMS.QUERY", b"c", b"x"], [b"CMS.QUERY", b"c", b"y"],
+        [b"CMS.QUERY", b"c2", b"z"],
+    ]
+    run = RespServer._collect_cms_run(cms, 0, [a, a, a])
+    assert run is not None and run[1] == 2
+
+
+def test_run_fence_deadline_mismatch_barrier():
+    # A CLIENT DEADLINE connection's command must never fuse into a run
+    # headed by a different-deadline connection: the run executes under
+    # ONE deadline scope (the head's).
+    from redisson_tpu.serve.resp import RespServer
+
+    a, d = _fctx(), _fctx(op_deadline_ms=50)
+    batch = [[b"BF.EXISTS", b"k", b"x"], [b"BF.EXISTS", b"k", b"y"]]
+    assert RespServer._collect_bf_run(batch, 0, [a, d]) is None
+    assert RespServer._collect_bf_run(batch, 0, [d, d]) is not None
+
+
+def test_run_fence_multi_and_unauth_barrier():
+    from redisson_tpu.serve.resp import RespServer
+
+    a, m, u = _fctx(), _fctx(in_multi=True), _fctx(authed=False)
+    batch = [[b"BF.EXISTS", b"k", b"x"], [b"BF.EXISTS", b"k", b"y"]]
+    # A mid-MULTI (or unauthenticated) connection's command must QUEUE
+    # (or NOAUTH), never execute inside a fused run.
+    assert RespServer._collect_bf_run(batch, 0, [a, m]) is None
+    assert RespServer._collect_bf_run(batch, 0, [a, u]) is None
+    assert RespServer._collect_get_run(
+        [[b"GET", b"k"], [b"GET", b"k"]], 0, [a, m]
+    ) is None
+    assert RespServer._collect_cms_run(
+        [[b"CMS.QUERY", b"c", b"x"], [b"CMS.QUERY", b"c", b"y"]],
+        0, [a, u],
+    ) is None
+
+
+def test_run_fence_malformed_member_barriers():
+    from redisson_tpu.serve.resp import RespServer
+
+    a = _fctx()
+    # Non-integer SETBIT offset: sequential dispatch would error — it
+    # must barrier the run, not poison the fused launch.
+    batch = [
+        [b"SETBIT", b"k", b"1", b"1"], [b"SETBIT", b"k", b"oops", b"1"],
+        [b"GETBIT", b"k", b"1"],
+    ]
+    run = RespServer._collect_bit_run(batch, 0, [a, a, a])
+    assert run is None  # fence at index 1 leaves a 1-command non-run
+    short = [[b"CMS.QUERY", b"c", b"x"], [b"CMS.QUERY", b"c"]]
+    assert RespServer._collect_cms_run(short, 0, [a, a]) is None

@@ -123,3 +123,37 @@ def test_cli_flags_violations_nonzero(tmp_path):
     )
     assert proc.returncode == 1
     assert "RT006" in proc.stdout
+
+
+# -- reactor front door coverage (ISSUE 11 satellite) --------------------------
+
+
+class TestReactorModuleCoverage:
+    """serve/reactor.py is in RT001/RT002 scope (role `serve` resolves
+    from the path), and the shipped module lints clean."""
+
+    def test_rt001_applies_at_reactor_path(self):
+        src = (
+            "import threading\n"
+            "lock = threading.Lock()\n"
+            "def flush(sock, frame):\n"
+            "    with lock:\n"
+            "        sock.sendall(frame)\n"
+        )
+        got = lint_source(src, rel="redisson_tpu/serve/reactor.py")
+        assert any(v.rule == "RT001" for v in got)
+
+    def test_rt002_applies_at_reactor_path(self):
+        src = (
+            "class C:\n"
+            "    def poke(self):\n"
+            "        self.sock.settimeout(1.0)\n"
+        )
+        got = lint_source(src, rel="redisson_tpu/serve/reactor.py")
+        assert any(v.rule == "RT002" for v in got)
+
+    def test_shipped_reactor_module_lints_clean(self):
+        import redisson_tpu.serve.reactor as rx
+
+        live = [v for v in lint_file(rx.__file__) if not v.suppressed]
+        assert live == [], [v.format() for v in live]
