@@ -30,7 +30,19 @@ from redisson_tpu.config import Config
 
 __version__ = "0.1.0"
 
-__all__ = ["Config", "create", "__version__"]
+__all__ = ["Config", "connect_cluster", "create", "__version__"]
+
+
+def connect_cluster(seeds, **kwargs):
+    """Slot-aware cluster client (ISSUE 12): route commands across an
+    N-node redisson_tpu cluster by CRC16 keyslot, with scatter/gather
+    batching and MOVED/ASK redirect handling (docs/clustering.md).
+
+    Imports only the wire-client tier — a pure routing process (bench
+    client forks, sidecars) never pays for the grid/engine modules."""
+    from redisson_tpu.cluster.client import ClusterClient
+
+    return ClusterClient(seeds, **kwargs)
 
 
 def create(config=None):

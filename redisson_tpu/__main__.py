@@ -78,6 +78,31 @@ def main(argv=None) -> int:
         "--resp-reactor-threads", type=int, default=None,
         help="reactor event-loop thread count (default from config, 1)",
     )
+    p.add_argument(
+        "--cluster", action="store_true",
+        help="enable cluster mode (ISSUE 12; docs/clustering.md): the "
+        "door speaks the 16384-slot redirect protocol (CLUSTER, "
+        "-MOVED/-ASK, hash tags, live slot migration)",
+    )
+    p.add_argument(
+        "--cluster-slots", default=None,
+        help="slot range(s) this node owns when no topology file is "
+        "given, e.g. '0-5461' or '0-99,200-299' (default: all 16384)",
+    )
+    p.add_argument(
+        "--cluster-topology", default=None,
+        help="JSON topology file ({'nodes': [{'id','host','port',"
+        "'slots'}]}) shared by every node — the supervisor writes one",
+    )
+    p.add_argument(
+        "--cluster-myid", default=None,
+        help="this node's id in the topology (default: announce addr)",
+    )
+    p.add_argument(
+        "--cluster-announce", default=None,
+        help="host:port other nodes/clients are redirected to "
+        "(default: the bind address; set when behind NAT/containers)",
+    )
     args = p.parse_args(argv)
 
     import redisson_tpu
@@ -116,6 +141,19 @@ def main(argv=None) -> int:
         if args.resp_reactor_threads < 1:
             p.error("--resp-reactor-threads must be >= 1")
         cfg.resp_reactor_threads = args.resp_reactor_threads
+    if args.cluster:
+        cfg.cluster_enabled = True
+    for flag, key in (
+        (args.cluster_slots, "cluster_slots"),
+        (args.cluster_topology, "cluster_topology"),
+        (args.cluster_myid, "cluster_node_id"),
+        (args.cluster_announce, "cluster_announce"),
+    ):
+        if flag is not None:
+            if not cfg.cluster_enabled:
+                p.error("--cluster-* flags require --cluster (or a "
+                        "config file with cluster_enabled: true)")
+            setattr(cfg, key, flag)
 
     client = redisson_tpu.create(cfg)
     server = RespServer(
@@ -141,9 +179,16 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)
+    mode = ""
+    if server.cluster is not None:
+        mode = (
+            f" [cluster node {server.cluster.myid}, "
+            f"{server.cluster.slotmap.owned_count(server.cluster.myid)}"
+            f"/16384 slots]"
+        )
     print(
         f"redisson-tpu serving RESP on {server.host}:{server.port} "
-        f"(backend={client._engine.__class__.__name__})",
+        f"(backend={client._engine.__class__.__name__}){mode}",
         flush=True,
     )
     stop.wait()

@@ -120,6 +120,10 @@ _ROLE_BY_PATH = (
     ("objects", "engine"),
     ("cache", "cache"),
     ("serve", "serve"),
+    # Cluster tier (ISSUE 12): the door/client/supervisor modules hold
+    # locks around wire I/O decisions and own sockets — exactly the
+    # serve-role bug surface RT001/RT002 were distilled from.
+    ("cluster", "serve"),
     ("tenancy", "tenancy"),
     ("durability", "journal"),
     ("chaos", "chaos"),

@@ -60,6 +60,23 @@ from redisson_tpu.grid import (
 from redisson_tpu.grid.topics import TopicBus
 
 
+def connect_cluster(seeds, **kwargs):
+    """Connect a slot-aware routing client to a redisson_tpu cluster
+    (ISSUE 12): ``seeds`` is [(host, port), ...] of ANY subset of the
+    nodes — the slot table bootstraps via ``CLUSTER SLOTS`` and refreshes
+    itself on ``-MOVED``.  Single commands route by their keys' CRC16
+    slot; ``execute_many`` scatter/gathers a batch across nodes as
+    pipelined per-node legs (docs/clustering.md).
+
+        cc = connect_cluster([("127.0.0.1", 7000)])
+        cc.execute("SET", "{user:1}.name", "ada")
+        replies = cc.execute_many([("GET", k) for k in keys])
+    """
+    from redisson_tpu.cluster.client import ClusterClient
+
+    return ClusterClient(seeds, **kwargs)
+
+
 class RedissonTpuClient(CamelCompatMixin):
     def __init__(self, config: Config):
         import uuid

@@ -1,0 +1,62 @@
+"""Cluster mode (ISSUE 12): slot-sharded multi-process serving — the
+survey's L3 topology layer (16384-slot CRC16 cluster, PAPER.md §1).
+
+- ``slots`` — CRC16/keyslot math, hash tags, command→keys table;
+- ``slotmap`` — slot ownership + IMPORTING/MIGRATING states;
+- ``door`` — the server-side redirect protocol (MOVED/ASK/CROSSSLOT,
+  per-key migration atomicity);
+- ``client`` — the slot-aware routing client with pipelined multi-slot
+  scatter/gather;
+- ``supervisor`` — spawn/join/reshard/tear-down N node processes.
+
+Heavy halves (door, supervisor) import lazily: a client process that
+only routes must not pay for the serving tier.
+"""
+
+from __future__ import annotations
+
+from redisson_tpu.cluster.slots import (
+    NSLOTS,
+    command_keys,
+    crc16,
+    hash_tag,
+    key_slot,
+)
+
+
+def __getattr__(name):  # PEP 562: lazy heavy halves
+    if name in ("ClusterClient", "ClusterError", "CrossSlotError",
+                "ClusterDownError"):
+        from redisson_tpu.cluster import client
+
+        return getattr(client, name)
+    if name in ("ClusterSupervisor", "migrate_slot"):
+        from redisson_tpu.cluster import supervisor
+
+        return getattr(supervisor, name)
+    if name == "ClusterDoor":
+        from redisson_tpu.cluster.door import ClusterDoor
+
+        return ClusterDoor
+    if name == "SlotMap":
+        from redisson_tpu.cluster.slotmap import SlotMap
+
+        return SlotMap
+    raise AttributeError(name)
+
+
+__all__ = [
+    "NSLOTS",
+    "ClusterClient",
+    "ClusterDoor",
+    "ClusterDownError",
+    "ClusterError",
+    "ClusterSupervisor",
+    "CrossSlotError",
+    "SlotMap",
+    "command_keys",
+    "crc16",
+    "hash_tag",
+    "key_slot",
+    "migrate_slot",
+]

@@ -1,0 +1,205 @@
+"""Slot map + node registry — which node owns which of the 16384 slots,
+plus the per-slot migration states (``IMPORTING``/``MIGRATING``) the
+redirect protocol reads.
+
+One ``SlotMap`` per server process (the door's routing truth) and one
+per slot-aware client (its cached view, refreshed on ``-MOVED``).  All
+mutation goes through the named ``cluster.slotmap`` lock; readers take
+one consistent snapshot per routing decision (``lookup``) instead of
+re-reading fields that a concurrent ``SETSLOT`` could tear.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from redisson_tpu.analysis import witness as _witness
+from redisson_tpu.cluster.slots import NSLOTS
+
+
+class SlotDecision:
+    """One consistent routing read for a slot."""
+
+    __slots__ = ("slot", "owner", "owner_addr", "importing_from",
+                 "migrating_to", "migrating_addr")
+
+    def __init__(self, slot, owner, owner_addr, importing_from,
+                 migrating_to, migrating_addr):
+        self.slot = slot
+        self.owner = owner
+        self.owner_addr = owner_addr
+        self.importing_from = importing_from
+        self.migrating_to = migrating_to
+        self.migrating_addr = migrating_addr
+
+
+class SlotMap:
+    """Slot ownership table: node id per slot + node id -> (host, port).
+
+    Ranges serialize as ``{"nodes": [{"id", "host", "port",
+    "slots": [[start, end], ...]}, ...]}`` — the topology-file format the
+    supervisor writes and ``--cluster-topology`` loads, and the shape
+    ``CLUSTER SLOTS``/``SHARDS`` render from.
+    """
+
+    def __init__(self):
+        self._lock = _witness.named(threading.Lock(), "cluster.slotmap")
+        self._owner: list = [None] * NSLOTS
+        self._nodes: dict = {}  # id -> (host, port)
+        self.importing: dict = {}  # slot -> source node id
+        self.migrating: dict = {}  # slot -> target node id
+        self.epoch = 0  # bumped by every topology mutation
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SlotMap":
+        m = cls()
+        for n in d.get("nodes", ()):
+            nid = str(n["id"])
+            m._nodes[nid] = (str(n["host"]), int(n["port"]))
+            for start, end in n.get("slots", ()):
+                start, end = int(start), int(end)
+                if not (0 <= start <= end < NSLOTS):
+                    raise ValueError(
+                        f"slot range {start}-{end} out of 0..{NSLOTS - 1}"
+                    )
+                for s in range(start, end + 1):
+                    m._owner[s] = nid
+        return m
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "nodes": [
+                    {
+                        "id": nid,
+                        "host": host,
+                        "port": port,
+                        "slots": self._ranges_locked(nid),
+                    }
+                    for nid, (host, port) in sorted(self._nodes.items())
+                ]
+            }
+
+    # -- reads -------------------------------------------------------------
+
+    def lookup(self, slot: int) -> SlotDecision:
+        """One consistent (owner, migration-state, addresses) read."""
+        with self._lock:
+            owner = self._owner[slot]
+            mig = self.migrating.get(slot)
+            return SlotDecision(
+                slot,
+                owner,
+                self._nodes.get(owner),
+                self.importing.get(slot),
+                mig,
+                self._nodes.get(mig) if mig is not None else None,
+            )
+
+    def owner(self, slot: int) -> Optional[str]:
+        with self._lock:
+            return self._owner[slot]
+
+    def addr(self, node_id: str):
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def node_ids(self) -> list:
+        with self._lock:
+            return sorted(self._nodes)
+
+    def owned_count(self, node_id: str) -> int:
+        with self._lock:
+            return sum(1 for o in self._owner if o == node_id)
+
+    def assigned_count(self) -> int:
+        with self._lock:
+            return sum(1 for o in self._owner if o is not None)
+
+    def ranges(self, node_id: str) -> list:
+        """Contiguous [start, end] slot ranges owned by ``node_id``."""
+        with self._lock:
+            return self._ranges_locked(node_id)
+
+    def _ranges_locked(self, node_id: str) -> list:
+        out: list = []
+        start = None
+        for s in range(NSLOTS):
+            if self._owner[s] == node_id:
+                if start is None:
+                    start = s
+            elif start is not None:
+                out.append([start, s - 1])
+                start = None
+        if start is not None:
+            out.append([start, NSLOTS - 1])
+        return out
+
+    def slots_table(self) -> list:
+        """[(start, end, node_id, host, port)] for every assigned range
+        (the CLUSTER SLOTS reply source, ordered by start slot)."""
+        out = []
+        with self._lock:
+            nodes = dict(self._nodes)
+            run_owner = None
+            start = None
+            for s in range(NSLOTS):
+                o = self._owner[s]
+                if o != run_owner:
+                    if run_owner is not None:
+                        h, p = nodes[run_owner]
+                        out.append((start, s - 1, run_owner, h, p))
+                    run_owner, start = o, s
+            if run_owner is not None:
+                h, p = nodes[run_owner]
+                out.append((start, NSLOTS - 1, run_owner, h, p))
+        return out
+
+    # -- mutation (CLUSTER SETSLOT / client MOVED learning) ----------------
+
+    def add_node(self, node_id: str, host: str, port: int) -> None:
+        with self._lock:
+            self._nodes[node_id] = (host, int(port))
+            self.epoch += 1
+
+    def set_owner(self, slot: int, node_id: str) -> dict:
+        """Finalize ownership (SETSLOT NODE): returns the migration
+        state this closed ({"was_importing": ..., "was_migrating": ...})
+        so the door can count completed handoffs."""
+        with self._lock:
+            if node_id not in self._nodes:
+                raise KeyError(f"unknown node id {node_id!r}")
+            closed = {
+                "was_importing": self.importing.pop(slot, None),
+                "was_migrating": self.migrating.pop(slot, None),
+            }
+            self._owner[slot] = node_id
+            self.epoch += 1
+            return closed
+
+    def set_importing(self, slot: int, from_node: str) -> None:
+        with self._lock:
+            if from_node not in self._nodes:
+                raise KeyError(f"unknown node id {from_node!r}")
+            self.importing[slot] = from_node
+            self.epoch += 1
+
+    def set_migrating(self, slot: int, to_node: str) -> None:
+        with self._lock:
+            if to_node not in self._nodes:
+                raise KeyError(f"unknown node id {to_node!r}")
+            self.migrating[slot] = to_node
+            self.epoch += 1
+
+    def set_stable(self, slot: int) -> None:
+        with self._lock:
+            self.importing.pop(slot, None)
+            self.migrating.pop(slot, None)
+            self.epoch += 1
+
+    def migration_counts(self) -> tuple:
+        with self._lock:
+            return len(self.importing), len(self.migrating)

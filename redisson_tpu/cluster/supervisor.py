@@ -1,0 +1,322 @@
+"""Process supervisor — spawn, join, migrate across, and cleanly tear
+down an N-node cluster (ISSUE 12 tentpole).
+
+Each node is a full ``python -m redisson_tpu`` server process (its own
+engine, reactor door, GIL, and — on real hardware — its own device
+slice selected by the platform/visible-devices env the caller passes),
+booted with a shared topology file that partitions the 16384 slots
+contiguously.  The supervisor is what the cluster bench and the CI
+``cluster-smoke`` job drive; production deployments run the same CLI
+flags under their own process manager.
+
+``migrate_slot`` is the live-resharding driver (the redis-cli --cluster
+reshard analog): IMPORTING on the target, MIGRATING on the source, a
+``GETKEYSINSLOT``/``MIGRATE`` pump until the slot is empty, then
+``SETSLOT NODE`` broadcast to every node.  Per-key atomicity lives in
+the source's move guard (cluster/door.py) — the driver itself can die
+at any step and the slot stays serveable (source keeps ownership until
+the final SETSLOT NODE).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from redisson_tpu.analysis import witness as _witness
+from redisson_tpu.cluster.slots import NSLOTS
+from redisson_tpu.serve.wireutil import ReplyError, exchange
+
+
+def _request(addr, cmds, timeout_s=10.0):
+    """One short-lived control connection: send ``cmds`` pipelined,
+    return decoded replies (driver traffic — not the data path)."""
+    sock = socket.create_connection(addr, timeout=timeout_s)
+    try:
+        return exchange(sock, cmds)
+    finally:
+        sock.close()
+
+
+def _check(reply, what: str):
+    if isinstance(reply, ReplyError):
+        raise RuntimeError(f"{what} failed: {reply}")
+    return reply
+
+
+def migrate_slot(slot: int, src_addr, dst_addr, notify=(),
+                 batch: int = 64, timeout_s: float = 10.0) -> int:
+    """Live-migrate one slot from ``src_addr`` to ``dst_addr`` while
+    both keep serving.  ``notify`` lists OTHER nodes' addresses to
+    teach the final ownership (they would otherwise keep emitting stale
+    MOVED until a client refresh bounced off the new owner).  Returns
+    the number of keys moved."""
+    src_id = _check(
+        _request(src_addr, [[b"CLUSTER", b"MYID"]], timeout_s)[0],
+        "CLUSTER MYID (source)",
+    ).decode()
+    dst_id = _check(
+        _request(dst_addr, [[b"CLUSTER", b"MYID"]], timeout_s)[0],
+        "CLUSTER MYID (target)",
+    ).decode()
+    sslot = b"%d" % slot
+    # Pre-flight BEFORE any migration state exists: a slot holding an
+    # unmigratable container kind refuses cleanly (docs/clustering.md)
+    # instead of aborting half-pumped.  Should the driver still die
+    # mid-pump (crash, or a container created after this check), the
+    # slot stays fully serveable — present keys serve on the source,
+    # moved keys via -ASK to the target — and re-running migrate_slot
+    # resumes the pump (every step is idempotent).
+    bad = _check(_request(src_addr, [
+        [b"CLUSTER", b"MIGRATABLE", sslot],
+    ], timeout_s)[0], "CLUSTER MIGRATABLE")
+    if bad:
+        raise RuntimeError(
+            f"slot {slot} refuses to migrate: {len(bad)} key(s) of "
+            f"unmigratable kinds (container grid types are not "
+            f"RESP-dumpable), e.g. {[k.decode() for k in bad[:3]]}"
+        )
+    _check(_request(dst_addr, [
+        [b"CLUSTER", b"SETSLOT", sslot, b"IMPORTING", src_id.encode()],
+    ], timeout_s)[0], "SETSLOT IMPORTING")
+    _check(_request(src_addr, [
+        [b"CLUSTER", b"SETSLOT", sslot, b"MIGRATING", dst_id.encode()],
+    ], timeout_s)[0], "SETSLOT MIGRATING")
+    moved = 0
+    dst_host, dst_port = dst_addr
+    # ONE control connection for the whole pump (a connect per key
+    # would dominate the migration; the source additionally keeps its
+    # own persistent socket to the target — see door._mig_exchange).
+    pump = socket.create_connection(src_addr, timeout=timeout_s)
+    try:
+        while True:
+            keys = _check(exchange(pump, [
+                [b"CLUSTER", b"GETKEYSINSLOT", sslot, b"%d" % batch],
+            ])[0], "GETKEYSINSLOT")
+            if not keys:
+                break
+            for key in keys:
+                r = _check(exchange(pump, [[
+                    b"MIGRATE", dst_host.encode(), b"%d" % dst_port,
+                    key, b"0", b"%d" % int(timeout_s * 1000),
+                ]])[0], f"MIGRATE {key!r}")
+                if r == b"OK":
+                    moved += 1
+                # NOKEY: a concurrent DEL/expiry beat the pump — fine.
+    finally:
+        pump.close()
+    # Finalize everywhere: target first (so a MOVED emitted by a lagging
+    # node points at a node that already owns the slot).
+    finalize = [b"CLUSTER", b"SETSLOT", sslot, b"NODE", dst_id.encode()]
+    _check(_request(dst_addr, [finalize], timeout_s)[0],
+           "SETSLOT NODE (target)")
+    _check(_request(src_addr, [finalize], timeout_s)[0],
+           "SETSLOT NODE (source)")
+    for addr in notify:
+        if tuple(addr) in (tuple(src_addr), tuple(dst_addr)):
+            continue
+        _check(_request(tuple(addr), [finalize], timeout_s)[0],
+               f"SETSLOT NODE ({addr})")
+    return moved
+
+
+class ClusterSupervisor:
+    """Spawn and own N cluster node processes on this host."""
+
+    def __init__(self, n_nodes: int = 3, host: str = "127.0.0.1",
+                 platform: str = "cpu", node_args=(), env_extra=None,
+                 startup_timeout_s: float = 120.0):
+        if n_nodes < 1:
+            raise ValueError("n_nodes must be >= 1")
+        self.n_nodes = n_nodes
+        self.host = host
+        self.platform = platform
+        self.node_args = list(node_args)
+        self.env_extra = dict(env_extra or {})
+        self.startup_timeout_s = startup_timeout_s
+        self._lock = _witness.named(
+            threading.Lock(), "cluster.supervisor"
+        )
+        self._procs: list = []  # subprocess.Popen, index-aligned w/ addrs
+        self.addrs: list = []  # (host, port) per node
+        self.node_ids: list = []
+        self._tmpdir = None
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @staticmethod
+    def _free_ports(host: str, n: int) -> list:
+        """Reserve n ephemeral ports (bind/close — the usual best-effort
+        race window, narrowed by binding all before closing any)."""
+        socks = []
+        try:
+            for _ in range(n):
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind((host, 0))
+                socks.append(s)
+            return [s.getsockname()[1] for s in socks]
+        finally:
+            for s in socks:
+                s.close()
+
+    def topology(self) -> dict:
+        """Even contiguous slot partition across the nodes."""
+        per = NSLOTS // self.n_nodes
+        nodes = []
+        for i, (h, p) in enumerate(self.addrs):
+            start = i * per
+            end = (start + per - 1) if i < self.n_nodes - 1 else NSLOTS - 1
+            nodes.append({
+                "id": self.node_ids[i], "host": h, "port": p,
+                "slots": [[start, end]],
+            })
+        return {"nodes": nodes}
+
+    def start(self) -> "ClusterSupervisor":
+        if self._started:
+            return self
+        ports = self._free_ports(self.host, self.n_nodes)
+        self.addrs = [(self.host, p) for p in ports]
+        self.node_ids = ["node-%d-%d" % (i, p)
+                         for i, p in enumerate(ports)]
+        self._tmpdir = tempfile.mkdtemp(prefix="rtpu-cluster-")
+        topo_path = os.path.join(self._tmpdir, "topology.json")
+        with open(topo_path, "w") as f:
+            json.dump(self.topology(), f)
+        env = dict(os.environ)
+        # Nodes run on their own backend (default CPU): N processes
+        # cannot share one accelerator, and the cluster's win is N front
+        # doors / N GILs — per-node device placement is the deployer's
+        # JAX env (JAX_PLATFORMS / *_VISIBLE_DEVICES) to partition.
+        env["JAX_PLATFORMS"] = self.platform
+        env.pop("XLA_FLAGS", None)
+        env.update(self.env_extra)
+        procs = []
+        try:
+            for i, (h, p) in enumerate(self.addrs):
+                log = open(
+                    os.path.join(self._tmpdir, f"node{i}.log"), "wb"
+                )
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "redisson_tpu",
+                     "--host", h, "--port", str(p),
+                     "--platform", self.platform,
+                     "--cluster",
+                     "--cluster-topology", topo_path,
+                     "--cluster-myid", self.node_ids[i],
+                     ] + self.node_args,
+                    stdout=log, stderr=subprocess.STDOUT, env=env,
+                ))
+                log.close()  # the child holds its own fd now
+            self._await_ready(procs)
+        except Exception:
+            for pr in procs:
+                try:
+                    pr.kill()
+                except OSError:
+                    pass
+            raise
+        with self._lock:
+            self._procs = procs
+            self._started = True
+        return self
+
+    def _await_ready(self, procs) -> None:
+        deadline = time.monotonic() + self.startup_timeout_s
+        for i, addr in enumerate(self.addrs):
+            while True:
+                if procs[i].poll() is not None:
+                    raise RuntimeError(
+                        f"cluster node {i} ({addr}) exited rc="
+                        f"{procs[i].returncode} during startup; see "
+                        f"{self._tmpdir}/node{i}.log"
+                    )
+                try:
+                    replies = _request(
+                        addr,
+                        [[b"PING"], [b"CLUSTER", b"MYID"]],
+                        timeout_s=2.0,
+                    )
+                    if replies[0] == b"PONG":
+                        break
+                except (OSError, ValueError):
+                    pass
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"cluster node {i} ({addr}) not serving after "
+                        f"{self.startup_timeout_s:.0f}s"
+                    )
+                time.sleep(0.1)
+
+    # -- operations --------------------------------------------------------
+
+    def client(self, **kw):
+        from redisson_tpu.cluster.client import ClusterClient
+
+        return ClusterClient(self.addrs, **kw)
+
+    def migrate_slot(self, slot: int, dst_index: int,
+                     src_index=None, **kw) -> int:
+        """Drive a live migration of ``slot`` to node ``dst_index``
+        (source defaults to the slot's current owner per the static
+        partition)."""
+        if src_index is None:
+            per = NSLOTS // self.n_nodes
+            src_index = min(slot // per, self.n_nodes - 1)
+        if src_index == dst_index:
+            return 0
+        return migrate_slot(
+            slot, self.addrs[src_index], self.addrs[dst_index],
+            notify=self.addrs, **kw
+        )
+
+    def alive(self) -> list:
+        """Indices of nodes whose process is still running."""
+        with self._lock:
+            return [
+                i for i, p in enumerate(self._procs) if p.poll() is None
+            ]
+
+    def shutdown(self, timeout_s: float = 15.0) -> bool:
+        """SIGTERM every node, wait, SIGKILL stragglers.  True when ALL
+        nodes exited from the SIGTERM (the clean-shutdown assertion the
+        CI smoke job makes); the kill fallback guarantees no orphan
+        processes either way."""
+        with self._lock:
+            procs, self._procs = self._procs, []
+            self._started = False
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        clean = True
+        deadline = time.monotonic() + timeout_s
+        for p in procs:
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                clean = False
+                p.kill()
+                try:
+                    p.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    pass
+        return clean and all(p.poll() is not None for p in procs)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()

@@ -280,6 +280,23 @@ class Config:
         # timeout).  Both live-settable via CONFIG SET.
         self.client_output_buffer_limit = 0
         self.client_output_buffer_soft_seconds = 0.0
+        # Cluster mode (ISSUE 12): the 16384-slot CRC16 topology layer
+        # (docs/clustering.md).  When enabled the RESP door routes every
+        # keyed command by its keys' slot: wrong-slot keys get
+        # -MOVED/-ASK redirects, hash tags {...} co-locate multi-key
+        # ops, and live slot migration rides CLUSTER SETSLOT + MIGRATE.
+        # ``cluster_topology`` is a dict (or path to a JSON file) of
+        # {"nodes": [{"id", "host", "port", "slots": [[a, b], ...]}]};
+        # without one this node is a single-node cluster owning
+        # ``cluster_slots`` (e.g. "0-16383", default all).
+        # ``cluster_node_id`` must name an entry in the topology;
+        # ``cluster_announce`` ("host:port") is the address OTHER nodes
+        # and clients are redirected to (defaults to the bind address).
+        self.cluster_enabled = False
+        self.cluster_node_id: Optional[str] = None
+        self.cluster_topology = None
+        self.cluster_slots: Optional[str] = None
+        self.cluster_announce: Optional[str] = None
 
     # -- fluent setters, mirroring the Java builder idiom ------------------
 
@@ -333,6 +350,11 @@ class Config:
         "resp_reactor_threads",
         "client_output_buffer_limit",
         "client_output_buffer_soft_seconds",
+        "cluster_enabled",
+        "cluster_node_id",
+        "cluster_topology",
+        "cluster_slots",
+        "cluster_announce",
     )
 
     def to_dict(self) -> dict:

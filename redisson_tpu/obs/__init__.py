@@ -168,6 +168,25 @@ class Observability:
             "rtpu_cross_conn_fused_ops",
             "engine ops fused into a launch together with ops from OTHER "
             "connections, by family", ("family",))
+        # Cluster mode (ISSUE 12): redirect volume by kind (the door
+        # counts moved/ask/tryagain/crossslot/asking_served as it emits
+        # or honors them; the slot-aware client counts
+        # client_moved/client_ask as it follows them), slot-ownership
+        # handoffs this process finalized, and the scatter/gather
+        # client's fan-out (legs / batches = average nodes touched per
+        # multi-slot batch).
+        self.cluster_redirects = r.counter(
+            "rtpu_cluster_redirects",
+            "cluster redirects emitted by the door or followed by the "
+            "slot-aware client, by kind", ("kind",))
+        self.cluster_slot_migrations = r.counter(
+            "rtpu_cluster_slot_migrations",
+            "slot ownership handoffs finalized on this node (SETSLOT "
+            "NODE closing an IMPORTING/MIGRATING state)")
+        self.cluster_scatter_fanout = r.counter(
+            "rtpu_cluster_scatter_fanout",
+            "scatter/gather batches and the per-node pipeline legs they "
+            "fanned out to, by unit", ("unit",))
 
     # -- instrumentation helpers (one call per batch, never per op) --------
 

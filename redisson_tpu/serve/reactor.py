@@ -65,6 +65,12 @@ from redisson_tpu.serve.resp import (
 _DETACH = frozenset(_PIPELINE_STOP) | frozenset((
     b"EVAL", b"EVALSHA", b"SCRIPT", b"FCALL", b"FCALL_RO", b"FUNCTION",
     b"WAIT", b"SAVE", b"BGREWRITEAOF", b"DEBUG", b"EXEC",
+    # Cluster control plane (ISSUE 12): MIGRATE blocks on a cross-node
+    # RESTORE round trip under the move guard — inline it would freeze
+    # the whole front door per migrated key (and two nodes migrating
+    # toward each other would stall each other's loops); CLUSTER's
+    # GETKEYSINSLOT/COUNTKEYSINSLOT scan the full keyspace.
+    b"MIGRATE", b"CLUSTER",
 ))
 
 # Per-tick bounds: commands taken from one connection, commands in one

@@ -88,6 +88,9 @@ def _encode_simple(s: str) -> bytes:
 _ERROR_CODES = (
     "BUSYKEY", "NOPROTO", "WRONGTYPE", "NOSCRIPT", "EXECABORT",
     "NOAUTH", "WRONGPASS", "NOGROUP", "BUSYGROUP", "BUSY", "NOTBUSY",
+    # Cluster redirect protocol (ISSUE 12): these travel verbatim so
+    # stock cluster clients parse the slot/address payload.
+    "MOVED", "ASK", "CROSSSLOT", "TRYAGAIN", "CLUSTERDOWN",
 )
 
 # Commands whose bodies execute arbitrary Python server-side; gated
@@ -107,6 +110,10 @@ _SHED_EXEMPT = frozenset((
     "INFO", "CONFIG", "CLIENT", "COMMAND", "SLOWLOG", "DEBUG",
     "SHUTDOWN", "SCRIPT", "WAIT", "MULTI", "EXEC", "DISCARD",
     "SUBSCRIBE", "UNSUBSCRIBE",
+    # Cluster control plane (ISSUE 12): topology surgery and the
+    # per-key migration pump must keep running DURING an overload —
+    # resharding is how an operator relieves one.
+    "CLUSTER", "ASKING", "MIGRATE",
 ))
 
 # -- front-door vectorization tables (ISSUE 6 tentpole) ----------------------
@@ -139,6 +146,7 @@ _NONMUTATING = frozenset((
     "SSCAN", "ZSCAN", "SCAN", "OBJECT", "DUMP", "PING", "ECHO", "SELECT",
     "TIME", "COMMAND", "CLIENT", "INFO", "SLOWLOG", "WAIT", "AUTH",
     "HELLO", "QUIT", "SAVE", "BGSAVE", "LASTSAVE", "BGREWRITEAOF",
+    "ASKING",
 ))
 
 # Response-CACHEABLE subset: deterministic pure keyspace reads whose
@@ -408,6 +416,11 @@ class _ConnCtx:
         # Per-connection op-deadline override (CLIENT DEADLINE, ISSUE 7):
         # None = server default (op_deadline_ms), 0 = no deadline.
         self.op_deadline_ms: Optional[int] = None
+        # Cluster ASKING handshake (ISSUE 12): one-shot — set by the
+        # ASKING command, consumed by the next keyed command's routing
+        # decision (lets an ASK-redirected command be served from an
+        # IMPORTING slot this node does not own yet).
+        self.asking = False
 
     def _kill(self) -> None:
         try:
@@ -628,6 +641,23 @@ class RespServer:
         self._sock.listen(512)
         self.host, self.port = self._sock.getsockname()
         self._closed = False
+        # Cluster mode (ISSUE 12 tentpole): the slot-sharded topology
+        # door.  When enabled, every keyed command routes through
+        # ClusterDoor.route before its handler — wrong-slot keys get
+        # -MOVED/-ASK redirects, cross-slot multi-key ops -CROSSSLOT,
+        # and keys in a MIGRATING slot serialize with the per-key
+        # migration pump (zero acked-write loss under live reshard).
+        self.cluster = None
+        if bool(getattr(client.config, "cluster_enabled", False)):
+            from redisson_tpu.cluster.door import ClusterDoor
+
+            try:
+                self.cluster = ClusterDoor.from_config(
+                    self, client.config, obs=self.obs
+                )
+            except Exception:
+                self._sock.close()
+                raise
         # Connection-limit refusals (ISSUE 11 satellite): counted so
         # reactor-mode capacity tuning is observable — INFO clients
         # (rejected_connections) + rtpu_resp_ingress_shed{conn_limit}.
@@ -830,6 +860,8 @@ class RespServer:
         # observe the shutdowns above and tear each connection down.
         if self.reactor is not None:
             self.reactor.close()
+        if self.cluster is not None:
+            self.cluster.close()  # cached migration sockets
 
     # -- command dispatch ---------------------------------------------------
 
@@ -878,6 +910,13 @@ class RespServer:
             # the ONE shared helper the fused-run demux also uses.
             err = True
             reply = self._fused_error_frame(e)
+        if ctx.asking and name != "ASKING" and not queueing:
+            # Cluster ASKING is one-shot for ANY next command (Redis
+            # semantics): keyed commands consume it inside route();
+            # keyless ones (PING between ASKING and the redirected
+            # command) and errored dispatches consume it here so the
+            # license can never leak to a later unrelated command.
+            ctx.asking = False
         if not queueing and name not in _NONMUTATING:
             # Any executed command that may have changed keyspace state
             # retires every response-cache entry (coarse, cheap, safe —
@@ -1100,6 +1139,7 @@ class RespServer:
             if plain and rc_cap > 0 and name in _CACHEABLE:
                 hit = self._rc_probe(rc, rc_state, name, cmd)
                 if hit is not None:
+                    ctx.asking = False  # a served command consumes it
                     out.append(hit)
                     size += len(hit)
                     i += 1
@@ -1160,6 +1200,8 @@ class RespServer:
                         frames, rj = self._resolve_run(
                             r, sub, batch, pos, ctxs, rc, rc_state
                         )
+                        for c in ctxs[pos:rj]:
+                            c.asking = False  # served: license consumed
                         if obs is not None and len(
                             {id(c) for c in ctxs[pos:rj]}
                         ) > 1:
@@ -1176,6 +1218,8 @@ class RespServer:
                             break
                     continue
                 frames, j = self._exec_run(run, batch, i, ctxs, rc, rc_state)
+                for c in ctxs[i:j]:
+                    c.asking = False  # served: ASKING license consumed
                 if obs is not None and len(
                     {id(c) for c in ctxs[i:j]}
                 ) > 1:
@@ -1193,6 +1237,14 @@ class RespServer:
             if (
                 plain and rc_cap > 0 and name in _CACHEABLE
                 and not frame.startswith(b"-")
+                and (
+                    self.cluster is None
+                    or self.cluster.frame_cacheable(name, cmd)
+                )
+                # Cluster gate: a frame computed for a migrating/
+                # importing slot (an ASKING-served read, a mid-
+                # migration value) must not serve a later identical
+                # command that would have been redirected.
             ):
                 self._rc_install(rc, rc_state, name, cmd, frame)
             out.append(frame)
@@ -1261,6 +1313,24 @@ class RespServer:
         member whose connection is mid-MULTI / unauthenticated — ends
         the run and dispatches sequentially (a run barrier)."""
         first = batch[i][0].upper()
+        if self.cluster is not None and (
+            first in _BF_RUN or first in _BIT_RUN or first in _GET_RUN
+            or first == b"CMS.QUERY"
+        ):
+            # Cluster mode (ISSUE 12): fusing must never skip a redirect
+            # judgment.  bf/bit/cms runs share ONE key, so gating the
+            # head covers the whole run; GET/MGET runs mix keys (and so
+            # slots) AND resolve under the grid lock — routing them
+            # there would add a grid.store -> cluster.move edge against
+            # MIGRATE's cluster.move -> grid.store, so they dispatch
+            # per-command (the slot-aware scatter/gather client is the
+            # cluster-mode batching path).
+            if first in _GET_RUN:
+                return None
+            if len(batch[i]) < 2 or not self.cluster.serves_plainly(
+                batch[i][1]
+            ):
+                return None
         if first in _BF_RUN:
             return self._collect_bf_run(batch, i, ctxs)
         if first in _BIT_RUN:
@@ -1758,9 +1828,40 @@ class RespServer:
             ) is None:
                 ctx.queued = None  # poison: EXEC must abort
                 raise RespError(f"unknown command '{name}'")
+            if self.cluster is not None:
+                # Cluster routing at QUEUE time (Redis semantics): a
+                # wrong-slot member surfaces its redirect NOW and
+                # poisons the transaction (EXECABORT), so EXEC can
+                # never half-apply a transaction whose tail belonged
+                # to another node.  (EXEC re-routes each member too —
+                # defense against a reshard between queue and EXEC.)
+                frame, _ = self.cluster.route(name, cmd, ctx)
+                if frame is not None:
+                    ctx.queued = None  # poison: EXEC must abort
+                    return frame
             if ctx.queued is not None:
                 ctx.queued.append(cmd)
             return _encode_simple("QUEUED")
+        if self.cluster is not None:
+            # Cluster routing (ISSUE 12): redirect frames short-circuit
+            # the handler; commands on a MIGRATING slot run under the
+            # move guard WITH a presence re-check — a command that
+            # routed "serve locally" while the migration pump was
+            # mid-key must not proceed after the key shipped (it would
+            # resurrect the key on the source and strand the acked
+            # write when the slot finalizes).
+            frame, guarded = self.cluster.route(name, cmd, ctx)
+            if frame is not None:
+                return frame
+            if guarded:
+                with self.cluster.move_lock:
+                    frame = self.cluster.route_recheck(name, cmd)
+                    if frame is not None:
+                        return frame
+                    return self._invoke_handler(name, cmd, ctx)
+        return self._invoke_handler(name, cmd, ctx)
+
+    def _invoke_handler(self, name: str, cmd: list, ctx: "_ConnCtx") -> bytes:
         ctx_handler = getattr(self, "_cmdctx_" + name.replace(".", "_"), None)
         if ctx_handler is not None:  # connection-stateful (pub/sub)
             return ctx_handler([c for c in cmd[1:]], ctx)
@@ -3080,7 +3181,7 @@ class RespServer:
     # name includes them.
     _INFO_DEFAULT = (
         "server", "clients", "memory", "stats", "persistence", "nearcache",
-        "frontdoor", "overload", "keyspace",
+        "frontdoor", "overload", "cluster", "keyspace",
     )
 
     def _cmd_INFO(self, args):
@@ -3097,7 +3198,11 @@ class RespServer:
             if s == "server":
                 lines += [
                     "# Server", "redis_version:7.9.9",
-                    "redis_mode:standalone", "run_id:redisson-tpu",
+                    "redis_mode:%s" % (
+                        "cluster" if self.cluster is not None
+                        else "standalone"
+                    ),
+                    "run_id:redisson-tpu",
                     f"uptime_in_seconds:{int(time.monotonic() - self._started)}",
                 ]
             elif s == "clients":
@@ -3307,6 +3412,14 @@ class RespServer:
                     f"overload_output_buffer_soft_seconds:"
                     f"{self.output_buffer_soft_seconds:g}",
                 ]
+            elif s == "cluster":
+                # Cluster mode (ISSUE 12): slot ownership + migration
+                # states + redirect counters (docs/clustering.md).
+                lines.append("# Cluster")
+                if self.cluster is None:
+                    lines.append("cluster_enabled:0")
+                else:
+                    lines += self.cluster.info_lines()
             elif s == "keyspace":
                 n = self._client.get_keys().count()
                 lines += ["# Keyspace", f"db0:keys={n},expires=0,avg_ttl=0"]
@@ -3396,6 +3509,144 @@ class RespServer:
 
     def _cmd_COMMAND(self, args):
         return _encode_array([])  # stock-client handshake stub
+
+    # -- cluster protocol (ISSUE 12) ---------------------------------------
+
+    def _cmdctx_ASKING(self, args, ctx: _ConnCtx):
+        """One-shot import-side handshake: the NEXT keyed command may be
+        served from an IMPORTING slot this node does not own yet."""
+        if self.cluster is None:
+            raise RespError("This instance has cluster support disabled")
+        ctx.asking = True
+        return _encode_simple("OK")
+
+    def _cmd_MIGRATE(self, args):
+        """Atomic per-key handoff to another node (the migration pump's
+        unit of work): dump -> remote ASKING+RESTORE -> local delete,
+        one critical section vs writes to the moving key (see
+        cluster/door.py)."""
+        if self.cluster is None:
+            raise RespError("MIGRATE requires cluster mode")
+        if len(args) < 5:
+            raise RespError("wrong number of arguments for 'migrate' command")
+        host, port, key = self._s(args[0]), int(args[1]), args[2]
+        timeout_ms = int(args[4])  # args[3] = destination-db (single db)
+        try:
+            return _encode_simple(
+                self.cluster.migrate_key(host, port, key, timeout_ms)
+            )
+        except OSError as e:
+            raise RespError(f"IOERR MIGRATE to {host}:{port} failed: {e}")
+
+    def _cmd_CLUSTER(self, args):
+        if not args:
+            raise RespError(
+                "wrong number of arguments for 'cluster' command"
+            )
+        from redisson_tpu.cluster.slots import key_slot as _key_slot
+
+        sub = args[0].decode("latin-1", "replace").upper()
+        if sub == "KEYSLOT":
+            if len(args) != 2:
+                raise RespError("CLUSTER KEYSLOT needs exactly one key")
+            return _encode_int(_key_slot(args[1]))
+        door = self.cluster
+        if door is None:
+            if sub == "INFO":
+                return _encode_bulk("cluster_enabled:0\r\n")
+            raise RespError("This instance has cluster support disabled")
+        if sub == "MYID":
+            return _encode_bulk(door.myid)
+        if sub == "INFO":
+            return _encode_bulk("\r\n".join(door.info_lines()) + "\r\n")
+        if sub == "SLOTS":
+            table = door.slotmap.slots_table()
+            frames = [b"*%d\r\n" % len(table)]
+            for start, end, nid, host, port in table:
+                frames.append(b"*3\r\n")
+                frames.append(_encode_int(start))
+                frames.append(_encode_int(end))
+                frames.append(b"*3\r\n")
+                frames.append(_encode_bulk(host))
+                frames.append(_encode_int(port))
+                frames.append(_encode_bulk(nid))
+            return b"".join(frames)
+        if sub == "SHARDS":
+            nodes = door.slotmap.node_ids()
+            frames = [b"*%d\r\n" % len(nodes)]
+            for nid in nodes:
+                host, port = door.slotmap.addr(nid)
+                flat = [
+                    v
+                    for r in door.slotmap.ranges(nid)
+                    for v in r
+                ]
+                frames.append(b"*4\r\n")
+                frames.append(_encode_bulk("slots"))
+                frames.append(_encode_array(flat))
+                frames.append(_encode_bulk("nodes"))
+                frames.append(b"*1\r\n" + _encode_array([
+                    b"id", nid.encode(), b"endpoint", host.encode(),
+                    b"port", port, b"role", b"master",
+                ]))
+            return b"".join(frames)
+        if sub == "NODES":
+            lines = []
+            for nid in door.slotmap.node_ids():
+                host, port = door.slotmap.addr(nid)
+                slots = " ".join(
+                    ("%d-%d" % (a, b)) if a != b else str(a)
+                    for a, b in door.slotmap.ranges(nid)
+                )
+                me = ",myself" if nid == door.myid else ""
+                lines.append(
+                    f"{nid} {host}:{port}@{port} master{me} - 0 0 0 "
+                    f"connected {slots}".rstrip()
+                )
+            return _encode_bulk("\n".join(lines) + "\n")
+        if sub == "SETSLOT":
+            if len(args) < 3:
+                raise RespError("CLUSTER SETSLOT needs a slot and an action")
+            slot = int(args[1])
+            action = args[2].decode("latin-1", "replace").upper()
+            try:
+                if action == "IMPORTING":
+                    door.slotmap.set_importing(slot, self._s(args[3]))
+                elif action == "MIGRATING":
+                    door.slotmap.set_migrating(slot, self._s(args[3]))
+                elif action == "STABLE":
+                    door.slotmap.set_stable(slot)
+                elif action == "NODE":
+                    closed = door.slotmap.set_owner(slot, self._s(args[3]))
+                    if closed["was_importing"] or closed["was_migrating"]:
+                        # A finalize that closed a live migration state:
+                        # the slot handoff this node took part in.
+                        if self.obs is not None:
+                            self.obs.cluster_slot_migrations.inc()
+                else:
+                    raise RespError(
+                        f"Invalid CLUSTER SETSLOT action {action}"
+                    )
+            except KeyError as e:
+                raise RespError(f"Unknown node {e.args[0]}")
+            return _encode_simple("OK")
+        if sub == "MIGRATABLE":
+            # Driver pre-flight (cluster/supervisor.py): keys in the
+            # slot that MIGRATE would refuse; empty = safe to reshard.
+            return _encode_array([
+                k.encode() for k in door.undumpable_in_slot(int(args[1]))
+            ])
+        if sub == "COUNTKEYSINSLOT":
+            return _encode_int(len(door.keys_in_slot(int(args[1]))))
+        if sub == "GETKEYSINSLOT":
+            count = int(args[2]) if len(args) > 2 else 10
+            return _encode_array([
+                k.encode() for k in door.keys_in_slot(int(args[1]), count)
+            ])
+        raise RespError(
+            f"Unknown CLUSTER subcommand or wrong number of arguments "
+            f"for '{sub.lower()}'"
+        )
 
     # TOPK.* (RedisBloom Top-K shape) over the CMS heavy-hitter engine:
     # the candidate-table + device re-estimation design stands in for
@@ -3510,18 +3761,23 @@ class RespServer:
         format); string keys a tagged raw-bytes payload.  Container grid
         kinds are NOT dumpable over RESP: their Python dump() is
         pickle-based, which must never meet an untrusted socket."""
-        name = self._s(args[0])
+        return _encode_bulk(self._dump_payload(self._s(args[0])))
+
+    def _dump_payload(self, name: str) -> Optional[bytes]:
+        """The DUMP blob for one key, or None when absent — shared by
+        _cmd_DUMP and the cluster migration pump (cluster/door.py ships
+        exactly what DUMP would)."""
         blob = self._client._engine.dump(name)
         if blob is not None:
-            return _encode_bulk(blob)
+            return blob
         e = self._client._grid.get_entry(name)
         if e is None:
-            return _encode_bulk(None)
+            return None
         if e.kind == "bucket":
             v = e.value
             if isinstance(v, str):
                 v = v.encode()
-            return _encode_bulk(b"RTPS\x00" + v)
+            return b"RTPS\x00" + v
         raise RespError(f"DUMP unsupported for type {e.kind} over RESP")
 
     def _cmd_RESTORE(self, args):

@@ -42,3 +42,78 @@ def skip_reply_frame(buf: bytes, i: int) -> int:
             i = skip_reply_frame(buf, i)
         return i
     raise ValueError(f"bad reply frame type {t!r}")
+
+
+class ReplyError(Exception):
+    """A RESP error reply (``-...``), decoded but NOT raised by
+    ``decode_reply`` — scatter/gather callers must be able to place
+    per-command errors positionally without unwinding the batch."""
+
+    @property
+    def code(self) -> str:
+        """Leading word of the error ('MOVED', 'ASK', 'ERR', ...)."""
+        return str(self).split(" ", 1)[0]
+
+
+def decode_reply(buf: bytes, i: int = 0):
+    """Decode ONE RESP reply frame at ``i`` into (value, end_offset).
+
+    simple string -> bytes, integer -> int, bulk -> bytes|None,
+    array/push -> list, error -> a ReplyError INSTANCE (returned, not
+    raised).  IndexError/ValueError signal an incomplete frame, like
+    ``skip_reply_frame``.
+    """
+    j = buf.index(b"\r\n", i)
+    t, body = buf[i : i + 1], buf[i + 1 : j]
+    i = j + 2
+    if t == b"+":
+        return body, i
+    if t == b"-":
+        return ReplyError(body.decode("latin-1", "replace")), i
+    if t == b":":
+        return int(body), i
+    if t == b"$":
+        n = int(body)
+        if n < 0:
+            return None, i
+        if len(buf) < i + n + 2:
+            raise IndexError("incomplete bulk")
+        return buf[i : i + n], i + n + 2
+    if t in (b"*", b">"):
+        n = int(body)
+        if n < 0:
+            return None, i
+        out = []
+        for _ in range(n):
+            v, i = decode_reply(buf, i)
+            out.append(v)
+        return out, i
+    raise ValueError(f"bad reply frame type {t!r}")
+
+
+def exchange(sock, cmds) -> list:
+    """One pipelined request/response cycle on a CONNECTED socket:
+    ship ``cmds`` in one sendall, decode exactly ``len(cmds)`` replies
+    in order (error replies as ReplyError instances, never raised).
+
+    The one copy of the client-side framing loop (this module's
+    founding rule): the cluster client's pooled connections, the
+    supervisor's control requests, and the migration pump all ride it.
+    Raises OSError when the peer closes mid-reply — after which the
+    socket is DESYNCED and must be discarded, never reused."""
+    sock.sendall(b"".join(wire_command(c) for c in cmds))
+    buf = b""
+    out: list = []
+    pos = 0
+    while len(out) < len(cmds):
+        chunk = sock.recv(1 << 16)
+        if not chunk:
+            raise OSError("peer closed mid-reply")
+        buf += chunk
+        while len(out) < len(cmds):
+            try:
+                val, pos = decode_reply(buf, pos)
+            except (IndexError, ValueError):
+                break  # incomplete frame: recv more
+            out.append(val)
+    return out

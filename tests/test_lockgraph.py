@@ -34,6 +34,12 @@ def test_shipped_tree_catalog_covers_all_tiers():
                      "serve.metrics", "serve.nodes.sweep",
                      "serve.native_codec"):
         assert expected in names, f"missing {expected}"
+    # ...and the cluster tier (ISSUE 12): the slot map, the per-key
+    # move guard, the supervisor, and the client's table/conn locks.
+    for expected in ("cluster.slotmap", "cluster.move",
+                     "cluster.supervisor", "cluster.client.table",
+                     "cluster.client.conn"):
+        assert expected in names, f"missing {expected}"
 
 
 def test_shipped_tree_has_no_lock_order_cycles():
