@@ -1383,6 +1383,120 @@ def bench_config7_overload(make_client):
     }
 
 
+def bench_config10_trace(_make_client):
+    """Config 10 — fleet-tracing A/B (ISSUE 13).
+
+    3 forked cluster nodes under one scatter/gather client population;
+    alternating passes with the client tracer OFF vs ON at rate 1.0
+    (every batch head-sampled — the worst-case tracing cost, so the
+    published ratio bounds any real deployment's <1 rate).  Each batch
+    leads with one BF.ADD per node partition, so the traced leg heads
+    are ENGINE commands and the exemplar trace embedded in BENCH.json
+    shows the full fleet path: client root -> per-node legs -> ingress
+    spans -> device-launch phases."""
+    from redisson_tpu.cluster.client import ClusterClient
+    from redisson_tpu.cluster.slots import NSLOTS, key_slot
+    from redisson_tpu.cluster.supervisor import ClusterSupervisor
+    from redisson_tpu.obs.trace import Tracer
+
+    PASS_S = 1.2
+    BATCH = 96
+    N_NODES = 3
+
+    def node_key(prefix, idx):
+        per = NSLOTS // N_NODES
+        lo = idx * per
+        hi = NSLOTS - 1 if idx == N_NODES - 1 else lo + per - 1
+        for i in range(100_000):
+            k = f"{prefix}-{i}"
+            if lo <= key_slot(k.encode()) <= hi:
+                return k
+        raise RuntimeError("no key for partition")
+
+    sup = ClusterSupervisor(n_nodes=N_NODES).start()
+    tracer = Tracer(sample_rate=0.0, max_spans=8192)
+    try:
+        bloom_keys = [node_key("c10bf", i) for i in range(N_NODES)]
+        client = ClusterClient(sup.addrs, tracer=tracer)
+        try:
+            for k in bloom_keys:
+                client.execute("BF.RESERVE", k, "0.01", "10000")
+
+            seq = [0]
+
+            def one_pass():
+                ncmds = 0
+                stop = time.time() + PASS_S
+                while time.time() < stop:
+                    cmds = [
+                        ("BF.ADD", k, "it%d" % seq[0])
+                        for k in bloom_keys
+                    ]
+                    cmds += [
+                        ("SET", "c10k%d" % ((seq[0] + j) % 512), "v")
+                        for j in range(BATCH - len(cmds))
+                    ]
+                    seq[0] += BATCH
+                    client.execute_many(cmds)
+                    ncmds += len(cmds)
+                return ncmds / PASS_S
+
+            one_pass()  # warm both arms' pools/ladders
+            off_passes, on_passes = [], []
+            for i in range(6):
+                if i % 2 == 0:
+                    tracer.set_sample_rate(0.0)
+                    off_passes.append(one_pass())
+                else:
+                    tracer.set_sample_rate(1.0)
+                    on_passes.append(one_pass())
+            tracer.set_sample_rate(0.0)
+            off_med = float(np.median(off_passes))
+            on_med = float(np.median(on_passes))
+            # Exemplar multi-node trace: the newest client root whose
+            # fleet merge shows all three nodes' serving spans.
+            exemplar = None
+            roots = [
+                s for s in tracer.spans()
+                if s["name"] == "client:execute_many"
+            ]
+            deadline = time.time() + 10.0
+            while roots and exemplar is None and time.time() < deadline:
+                tid = roots[-1]["trace_id"]
+                merged = client.fleet_traces(tid).get(tid, [])
+                nodes = {
+                    s["attrs"].get("node")
+                    for s in merged
+                    if s["name"].startswith("resp:")
+                }
+                if len(nodes) >= N_NODES and any(
+                    s["name"].startswith("launch:") for s in merged
+                ):
+                    exemplar = {"trace_id": tid, "spans": merged[:48]}
+                else:
+                    time.sleep(0.2)
+            return {
+                "config10_trace_off_cmds_per_sec": round(off_med),
+                "config10_trace_on_cmds_per_sec": round(on_med),
+                "config10_trace_off_passes": [
+                    round(p) for p in off_passes
+                ],
+                "config10_trace_on_passes": [
+                    round(p) for p in on_passes
+                ],
+                "config10_trace_overhead_ratio": round(
+                    on_med / off_med, 4
+                ) if off_med else None,
+                "config10_trace_sampled_batches": tracer.sampled,
+                "config10_trace_exemplar": exemplar,
+            }
+        finally:
+            client.close()
+    finally:
+        tracer.set_sample_rate(0.0)
+        sup.shutdown()
+
+
 def bench_config3_bitset(client):
     """Config 3: 2^30-bit RBitSet, batched get/set (raw bitmap path).
 
@@ -1764,6 +1878,14 @@ def main():
     # Durability tier A/B (ISSUE 10): journal off vs everysec vs always
     # on the acked-write path (journal_* keys).
     journal_stats = bench_journal_ab(make_client)
+    # Fleet tracing A/B (ISSUE 13): 3-node scatter/gather cmds/s with
+    # the distributed tracer off vs sampled-on at rate 1.0, plus one
+    # exemplar multi-node trace embedded in the artifact.  Isolated
+    # like config9 (subprocess spawn).
+    try:
+        trace_stats = bench_config10_trace(make_client)
+    except Exception as e:  # pragma: no cover - env-dependent spawn
+        trace_stats = {"config10_trace_error": repr(e)}
     host_ops = measure_host_baseline()
 
     # vs_baseline: the bench env ships no redis-server, so the Redis-backed
@@ -1827,6 +1949,12 @@ def main():
                     # 3-pass medians + speedup, and the zero-acked-
                     # write-loss live-migration differential.
                     **cluster_stats,
+                    # Fleet telemetry (ISSUE 13): config10_trace —
+                    # tracing-off vs sampled-on cmds/s across a 3-node
+                    # scatter/gather population + one exemplar
+                    # multi-node trace (client legs, per-node ingress,
+                    # device-launch phases).
+                    **trace_stats,
                     "hll_pfadd_ops_per_sec": round(hll_ops),
                     "config3_bitset_ops_per_sec": round(bitset_ops),
                     "config4_mixed_ops_per_sec": round(mixed_ops),

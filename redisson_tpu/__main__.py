@@ -56,6 +56,24 @@ def main(argv=None) -> int:
         "/metrics (docs/observability.md); omitted = no endpoint",
     )
     p.add_argument(
+        "--federate", default=None, metavar="HOST:PORT,...",
+        help="federation-only mode (ISSUE 13): no engine, no RESP "
+        "door — scrape the listed member /metrics endpoints per "
+        "request and serve ONE merged exposition (node label per "
+        "member) on --metrics-port",
+    )
+    p.add_argument(
+        "--trace-sample-rate", type=float, default=None,
+        help="distributed-trace head-sampling probability in [0, 1] "
+        "(ISSUE 13; default 0 = tracing off; live via CONFIG SET "
+        "trace-sample-rate / TRACE SAMPLE)",
+    )
+    p.add_argument(
+        "--latency-monitor-threshold", type=int, default=None,
+        help="arm the LATENCY monitor at this many milliseconds "
+        "(0 = off, the redis default; live via CONFIG SET)",
+    )
+    p.add_argument(
         "--enable-python-scripts", action="store_true",
         help="allow RESP EVAL/EVALSHA/SCRIPT/FUNCTION/FCALL (script "
         "bodies are Python — RCE for anyone who can reach the socket; "
@@ -105,6 +123,33 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
 
+    if args.federate:
+        # Standalone federation mode: just the merged metrics endpoint
+        # — no engine import, no jax initialization, no RESP door.
+        if args.metrics_port is None:
+            p.error("--federate requires --metrics-port")
+        from redisson_tpu.obs.federate import start_federation_endpoint
+
+        targets = [t.strip() for t in args.federate.split(",") if t.strip()]
+        srv = start_federation_endpoint(
+            targets, host=args.host, port=args.metrics_port
+        )
+        stop = threading.Event()
+
+        def on_fed_signal(signum, frame):
+            stop.set()
+
+        signal.signal(signal.SIGINT, on_fed_signal)
+        signal.signal(signal.SIGTERM, on_fed_signal)
+        print(
+            f"federated metrics on http://{srv.host}:{srv.port}/metrics "
+            f"({len(targets)} member node(s))",
+            flush=True,
+        )
+        stop.wait()
+        srv.close()
+        return 0
+
     import redisson_tpu
     from redisson_tpu import Config
     from redisson_tpu.serve.resp import RespServer
@@ -129,6 +174,14 @@ def main(argv=None) -> int:
                     "(--snapshot-dir or config file)")
         cfg.snapshot_interval_s = args.snapshot_interval_s
 
+    if args.trace_sample_rate is not None:
+        if not 0.0 <= args.trace_sample_rate <= 1.0:
+            p.error("--trace-sample-rate must be in [0, 1]")
+        cfg.trace_sample_rate = args.trace_sample_rate
+    if args.latency_monitor_threshold is not None:
+        if args.latency_monitor_threshold < 0:
+            p.error("--latency-monitor-threshold must be >= 0")
+        cfg.latency_monitor_threshold_ms = args.latency_monitor_threshold
     if args.requirepass:
         cfg.requirepass = args.requirepass
     if args.enable_python_scripts:

@@ -61,6 +61,15 @@ RT010  Static lock-order cycle (whole-tree pass, analysis/lockgraph.py):
        cycle is a potential deadlock even if no test ever ran the
        schedule.  Suppress a by-design edge at its inner-acquisition
        line.
+RT011  Span-lifecycle completeness (the RT009 analog for OpSpan/trace
+       spans, ISSUE 13): a locally created span — ``*.spans.start``,
+       ``tracer.maybe_start``/``start``/``start_child``, or a direct
+       ``OpSpan``/``TraceSpan`` construction — must reach
+       ``finish``/``end``/``abandon`` on every path, or escape
+       (returned / stored / handed off).  A stranded span records
+       nothing: phase histograms silently under-count and the trace it
+       belonged to loses the hop.  Resolving inside a ``try`` whose
+       ``except`` swallows strands it the same way.
 
 Suppression: ``# rtpulint: disable=RT001 <reason>`` on the offending
 line, or alone on the line directly above it.  The reason is mandatory
@@ -94,6 +103,7 @@ RULES = {
     "RT008": "near-cache epoch bump not paired entry+exit",
     "RT009": "created future not resolved/handed off on all paths",
     "RT010": "static lock-order cycle (whole-tree pass)",
+    "RT011": "created span not ended/abandoned on all paths",
 }
 
 # Roles a rule applies to.  "*" = every non-test module.
@@ -110,6 +120,7 @@ _RULE_ROLES = {
     "RT007": {"*"},  # self-scoping: only fires in deadline-accepting funcs
     "RT008": {"*"},  # self-scoping: only fires next to epoch-bump calls
     "RT009": {"*"},  # self-scoping: only fires where a future is created
+    "RT011": {"*"},  # self-scoping: only fires where a span is created
     # RT010 is a WHOLE-TREE rule (analysis/lockgraph.py): it has no
     # per-file check here, but lives in RULES so disable=RT010
     # suppressions parse and the CLI can name it.
@@ -951,6 +962,137 @@ def _check_rt009(ctx) -> None:
                     )
 
 
+# -- RT011: span-lifecycle completeness (the RT009 analog for spans) ----------
+
+
+_SPAN_CTORS = ("OpSpan", "TraceSpan")
+_SPAN_BEGIN_ATTRS = ("maybe_start", "start_child", "span_scope")
+_SPAN_RESOLVERS = ("finish", "end", "abandon")
+
+
+def _is_span_begin(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in _SPAN_CTORS
+    if not isinstance(f, ast.Attribute):
+        return False
+    if f.attr in _SPAN_BEGIN_ATTRS or f.attr in _SPAN_CTORS:
+        return True
+    if f.attr == "start":
+        # ``<...>.spans.start(...)`` (the SpanRecorder begin) and
+        # ``tracer.start(...)`` / ``<...>.trace.start(...)`` (a forced
+        # trace span).  A bare ``x.start()`` (threads, servers) is NOT a
+        # span begin — the owner must look like a span source.
+        owner = f.value
+        owner_name = None
+        if isinstance(owner, ast.Attribute):
+            owner_name = owner.attr
+        elif isinstance(owner, ast.Name):
+            owner_name = owner.id
+        return owner_name in ("spans", "trace", "tracer", "tr")
+    return False
+
+
+def _check_rt011(ctx) -> None:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        created: dict = {}  # var -> creation line
+        for node in _walk_no_defs(fn):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name) and \
+                    node.value is not None:
+                target, value = node.target.id, node.value
+            else:
+                continue
+            if isinstance(value, ast.Call) and _is_span_begin(value):
+                created[target] = node.lineno
+        if not created:
+            continue
+        resolved: set = set()
+        escaped: set = set()
+        for node in _walk_no_defs(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in created
+                    and f.attr in _SPAN_RESOLVERS
+                ):
+                    resolved.add(f.value.id)
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    for v in created:
+                        if _mentions_name(arg, v):
+                            escaped.add(v)
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                val = node.value
+                if val is not None:
+                    for v in created:
+                        if _mentions_name(val, v):
+                            escaped.add(v)
+            elif isinstance(node, ast.Assign):
+                # aliasing / storing: seg.span = span, d[k] = span
+                for v in created:
+                    if _mentions_name(node.value, v):
+                        escaped.add(v)
+        for v, line in created.items():
+            if v not in resolved and v not in escaped:
+                ctx.report(
+                    "RT011", line,
+                    f"span {v!r} is begun but never finished/ended/"
+                    f"abandoned, returned, or handed off — it records "
+                    f"nothing: phase histograms under-count and its "
+                    f"trace loses this hop",
+                )
+        # Exception arms: ending inside a try whose handler swallows.
+        for node in _walk_no_defs(fn):
+            if not isinstance(node, ast.Try):
+                continue
+            ends_inside = set()
+            for sub in node.body:
+                for n in ast.walk(sub):
+                    if (
+                        isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id in created
+                        and n.func.attr in ("finish", "end")
+                    ):
+                        ends_inside.add(n.func.value.id)
+            if not ends_inside:
+                continue
+            for handler in node.handlers:
+                ok = False
+                for n in ast.walk(handler):
+                    if isinstance(n, (ast.Raise, ast.Return)):
+                        ok = True
+                        break
+                    if (
+                        isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id in ends_inside
+                        and n.func.attr in _SPAN_RESOLVERS
+                    ):
+                        ok = True
+                        break
+                if not ok:
+                    ctx.report(
+                        "RT011", handler.lineno,
+                        f"except arm swallows while the try body ends "
+                        f"span(s) {sorted(ends_inside)} — a failure "
+                        f"here strands the span: re-raise, return, or "
+                        f"end(error=True)/abandon",
+                    )
+
+
 _CHECKS = {
     "RT001": _check_rt001,
     "RT002": _check_rt002,
@@ -961,6 +1103,7 @@ _CHECKS = {
     "RT007": _check_rt007,
     "RT008": _check_rt008,
     "RT009": _check_rt009,
+    "RT011": _check_rt011,
 }
 
 

@@ -585,7 +585,19 @@ class RedissonTpuClient(CamelCompatMixin):
             out["tenants"] = obs.tenant_stats()
             out["phases"] = obs.phase_stats()
             out["slowlog_len"] = len(obs.slowlog)
+            # Distributed tracing (ISSUE 13): the bounded span ring
+            # grouped by trace id ({} while sampling is off).
+            out["traces"] = obs.trace.traces()
         return out
+
+    def trace(self, name: str = "client"):
+        """Direct-API trace minting (ISSUE 13): ``with client.trace(
+        "my-batch") as span:`` head-samples a root span and installs it
+        as the thread's ambient context, so every engine submit inside
+        links its coalescer launch (with the full phase breakdown) into
+        the trace.  Yields the span, or None when the dice missed /
+        sampling is off — zero further cost either way."""
+        return self.obs.trace.span_scope(name)
 
     def render_prometheus(self) -> str:
         """Full Prometheus text exposition: the legacy aggregate metrics

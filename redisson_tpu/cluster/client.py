@@ -34,6 +34,7 @@ import time
 
 from redisson_tpu.analysis import witness as _witness
 from redisson_tpu.cluster.slots import command_keys, key_slot
+from redisson_tpu.obs import trace as _trace
 from redisson_tpu.serve.wireutil import ReplyError, exchange
 
 
@@ -97,13 +98,21 @@ class ClusterClient:
     """Slot-aware RESP client over N cluster nodes."""
 
     def __init__(self, seeds, password=None, timeout_s=10.0, obs=None,
-                 tryagain_attempts=8, tryagain_backoff_s=0.02):
+                 tryagain_attempts=8, tryagain_backoff_s=0.02,
+                 tracer=None):
         if not seeds:
             raise ValueError("at least one seed (host, port) required")
         self._seeds = [tuple(s) for s in seeds]
         self._password = password
         self._timeout_s = timeout_s
         self.obs = obs
+        # Distributed tracing (ISSUE 13): with a Tracer attached, this
+        # client is the HEAD of the trace — execute/execute_many
+        # head-sample a client root span, mint one child span per
+        # scatter leg, and prepend the RTPU.TRACE wire prelude so each
+        # node's door stitches its serving-side spans (reactor tick,
+        # vectorizer, coalescer phases, launches) into the same trace.
+        self.tracer = tracer
         self._tryagain_attempts = tryagain_attempts
         self._tryagain_backoff_s = tryagain_backoff_s
         self._table_lock = _witness.named(
@@ -238,8 +247,34 @@ class ClusterClient:
         ReplyError."""
         cmd = self._norm(cmd)
         _, addr = self._route_addr(cmd)
-        reply = self._request(addr, [cmd])[0]
-        reply = self._chase(cmd, reply, moved_budget=1)
+        span = None
+        if self.tracer is not None and _trace.ENABLED:
+            span = self.tracer.maybe_start(
+                "client:" + cmd[0].decode("latin-1", "replace").upper()
+            )
+        try:
+            if span is not None:
+                span.annotate("node", "%s:%d" % addr)
+                reply = self._request(
+                    addr,
+                    [[b"RTPU.TRACE"] + span.ctx().wire_args(), cmd],
+                )[1]
+            else:
+                reply = self._request(addr, [cmd])[0]
+            if span is not None and isinstance(reply, ReplyError) and \
+                    reply.code in ("MOVED", "ASK", "TRYAGAIN"):
+                # A redirect is routing, not failure: the chase below
+                # retries (untraced on the retried hop — a known span
+                # gap, annotated so the trace explains itself instead
+                # of showing a failed command the caller saw succeed).
+                span.annotate("redirected", reply.code)
+            reply = self._chase(cmd, reply, moved_budget=1)
+        except BaseException:
+            if span is not None:
+                span.end(error=True)
+            raise
+        if span is not None:
+            span.end(error=isinstance(reply, ReplyError))
         if isinstance(reply, ReplyError):
             raise reply
         return reply
@@ -310,15 +345,43 @@ class ClusterClient:
         for i, cmd in enumerate(cmds):
             _, addr = self._route_addr(cmd)
             by_addr.setdefault(addr, []).append((i, cmd))
+        root = None
+        if self.tracer is not None and _trace.ENABLED:
+            # Head sampling for the whole batch: ONE decision covers
+            # every leg, so a sampled scatter/gather yields one trace
+            # spanning client legs + every node's serving spans.
+            # Minted AFTER routing: a CrossSlotError above aborts the
+            # batch before anything executes, and a root begun earlier
+            # would be stranded un-ended (the RT011 class).
+            root = self.tracer.maybe_start("client:execute_many")
         results: list = [None] * len(cmds)
         errors: list = []
 
         def leg(addr, entries):
+            wire = [c for _, c in entries]
+            lspan = None
+            if root is not None:
+                lspan = self.tracer.start_child(
+                    root, "leg:%s:%d" % tuple(addr)
+                )
+                lspan.annotate("cmds", len(entries))
+                # Wire prelude ahead of the pipelined leg: the leg's
+                # FIRST command joins the trace on that node (one-shot,
+                # the ASKING shape).  A plain server errors on the
+                # prelude — harmless, the leg's replies follow.
+                wire = (
+                    [[b"RTPU.TRACE"] + lspan.ctx().wire_args()] + wire
+                )
             try:
-                replies = self._request(addr, [c for _, c in entries])
+                replies = self._request(addr, wire)
             except (OSError, ClusterError) as e:
+                if lspan is not None:
+                    lspan.end(error=True)
                 errors.append(e)
                 return
+            if lspan is not None:
+                replies = replies[1:]  # drop the prelude's ack/error
+                lspan.end()
             for (i, _), r in zip(entries, replies):
                 results[i] = r
 
@@ -327,24 +390,31 @@ class ClusterClient:
         if self.obs is not None:
             self.obs.cluster_scatter_fanout.inc(("batches",))
             self.obs.cluster_scatter_fanout.inc(("legs",), len(by_addr))
-        if len(by_addr) == 1:
-            ((addr, entries),) = by_addr.items()
-            leg(addr, entries)
-        else:
-            # Persistent leg pool, largest leg inline on the calling
-            # thread: a thread SPAWN per leg per batch costs more than a
-            # small leg's whole round trip and inverted the scaling win
-            # at modest batch sizes (measured on config9).
-            items = sorted(
-                by_addr.items(), key=lambda kv: -len(kv[1])
-            )
-            futs = [
-                self._executor().submit(leg, addr, entries)
-                for addr, entries in items[1:]
-            ]
-            leg(*items[0])
-            for f in futs:
-                f.result()
+        try:
+            if len(by_addr) == 1:
+                ((addr, entries),) = by_addr.items()
+                leg(addr, entries)
+            else:
+                # Persistent leg pool, largest leg inline on the calling
+                # thread: a thread SPAWN per leg per batch costs more
+                # than a small leg's whole round trip and inverted the
+                # scaling win at modest batch sizes (measured on
+                # config9).
+                items = sorted(
+                    by_addr.items(), key=lambda kv: -len(kv[1])
+                )
+                futs = [
+                    self._executor().submit(leg, addr, entries)
+                    for addr, entries in items[1:]
+                ]
+                leg(*items[0])
+                for f in futs:
+                    f.result()
+        finally:
+            if root is not None:
+                root.annotate("legs", len(by_addr))
+                root.annotate("cmds", len(cmds))
+                root.end(error=bool(errors))
         if errors:
             raise ClusterError(
                 f"{len(errors)} scatter leg(s) failed: {errors[0]}"
@@ -367,6 +437,156 @@ class ClusterClient:
                     cmds[i], r, moved_budget=1, refresh=False
                 )
         return results
+
+    # -- fleet telemetry (ISSUE 13): cross-node INFO/SLOWLOG/TRACE ---------
+
+    def _fanout(self, cmd) -> dict:
+        """{addr: decoded reply | Exception} for one command sent to
+        every known data node (the slot table's node set; seeds when the
+        table is empty) — the cluster-wide observability primitive.
+        Nodes are queried CONCURRENTLY on the scatter-leg pool: one
+        dead node costs its own timeout, not timeout × fleet (the same
+        rationale as FederatedMetrics.render)."""
+        with self._table_lock:
+            addrs = sorted({a for a in self._slots if a is not None})
+        if not addrs:
+            addrs = list(self._seeds)
+        out: dict = {}
+
+        def one(addr):
+            try:
+                out[addr] = self._request(addr, [cmd])[0]
+            except (OSError, ClusterError) as e:
+                out[addr] = e
+
+        if len(addrs) == 1:
+            one(addrs[0])
+            return out
+        futs = [
+            self._executor().submit(one, addr) for addr in addrs[1:]
+        ]
+        one(addrs[0])
+        for f in futs:
+            f.result()
+        return out
+
+    # INFO keys whose fleet-wide SUM is meaningful (counters and
+    # occupancy).  Everything else (ports, uptimes, rates, thresholds,
+    # version strings that happen to parse numeric) stays per-node only
+    # — summing a threshold across nodes is a lie, not a total.
+    _ADDITIVE_INFO_PREFIXES = (
+        "total_",
+        "frontdoor_fused", "frontdoor_response_cache_hits",
+        "frontdoor_response_cache_misses", "frontdoor_reactor_ticks",
+        "frontdoor_cross_conn", "overload_shed",
+        "overload_deadline_exceeded", "overload_ingress",
+        "overload_tenant_throttled", "overload_fetch_timeouts",
+        "overload_slow_client", "cluster_slot_migrations",
+        "nearcache_hits", "nearcache_misses", "nearcache_evictions",
+        "nearcache_bytes", "nearcache_entries",
+    )
+    _ADDITIVE_INFO_KEYS = frozenset((
+        "connected_clients", "rejected_connections", "used_memory",
+        "degraded_objects", "breakers_open", "monitors", "slowlog_len",
+        "trace_spans", "trace_traces", "trace_sampled_total",
+        "trace_evicted_total", "latency_events", "latency_samples",
+        "aof_records_written", "aof_bytes_written", "aof_fsyncs",
+        "aof_pending_records", "aof_replayed_records", "aof_segments",
+    ))
+
+    @classmethod
+    def _info_additive(cls, key: str) -> bool:
+        return key in cls._ADDITIVE_INFO_KEYS or key.startswith(
+            cls._ADDITIVE_INFO_PREFIXES
+        )
+
+    def fleet_info(self, section=None) -> dict:
+        """Fleet-aggregated INFO: ``{"nodes": {node: {k: v}},
+        "totals": {k: sum}}`` — ADDITIVE numeric lines (counters,
+        occupancy — see _info_additive) sum across nodes (the
+        aggregated-telemetry view regression detection reads); raw
+        per-node sections stay available for drill-down."""
+        cmd = [b"INFO"] + ([section.encode()] if section else [])
+        per_node: dict = {}
+        totals: dict = {}
+        for addr, raw in self._fanout(cmd).items():
+            node = "%s:%d" % tuple(addr)
+            if isinstance(raw, (ReplyError, Exception)):
+                per_node[node] = {"error": str(raw)}
+                continue
+            parsed: dict = {}
+            for line in raw.decode("latin-1", "replace").splitlines():
+                line = line.strip()
+                if not line or line.startswith("#") or ":" not in line:
+                    continue
+                k, v = line.split(":", 1)
+                parsed[k] = v
+                if not self._info_additive(k):
+                    continue
+                try:
+                    fv = float(v)
+                except ValueError:
+                    continue
+                totals[k] = totals.get(k, 0.0) + fv
+            per_node[node] = parsed
+        totals = {
+            k: int(v) if float(v).is_integer() else v
+            for k, v in totals.items()
+        }
+        return {"nodes": per_node, "totals": totals}
+
+    def fleet_slowlog(self, count: int = 10) -> list:
+        """Cross-node SLOWLOG GET merge: every node's entries tagged
+        with their node label, merged newest-first; ``count < 0`` = all
+        (per node AND merged, like SLOWLOG GET -1)."""
+        merged: list = []
+        for addr, raw in self._fanout(
+            [b"SLOWLOG", b"GET", b"%d" % count]
+        ).items():
+            node = "%s:%d" % tuple(addr)
+            if isinstance(raw, (ReplyError, Exception)):
+                continue
+            for e in raw:
+                entry = {
+                    "node": node,
+                    "id": int(e[0]),
+                    "ts": int(e[1]),
+                    "duration_us": int(e[2]),
+                    "args": list(e[3]),
+                    "client": e[4].decode("latin-1", "replace"),
+                }
+                if len(e) > 6 and e[6]:
+                    entry["trace_id"] = e[6].decode(
+                        "latin-1", "replace"
+                    )
+                merged.append(entry)
+        merged.sort(
+            key=lambda d: (d["ts"], d["duration_us"]), reverse=True
+        )
+        return merged if count < 0 else merged[:count]
+
+    def fleet_traces(self, trace_id=None) -> dict:
+        """{trace_id: [span dicts]} merged across every node's TRACE
+        GET ring PLUS this client's own tracer — the one end-to-end view
+        of a scatter/gather: client root + leg spans, each node's
+        ingress/door spans, and the per-launch coalescer phases, parent
+        links intact across the wire."""
+        import json as _json
+
+        out: dict = {}
+        if self.tracer is not None:
+            for tid, spans in self.tracer.traces(trace_id).items():
+                out.setdefault(tid, []).extend(spans)
+        cmd = [b"TRACE", b"GET"] + (
+            [trace_id.encode()] if trace_id else []
+        )
+        for addr, raw in self._fanout(cmd).items():
+            if isinstance(raw, (ReplyError, Exception)):
+                continue
+            for doc in raw:
+                d = _json.loads(doc)
+                out.setdefault(d["trace_id"], []).extend(d["spans"])
+        return out
 
     def _executor(self):
         """Shared scatter-leg thread pool (threads spawn on demand and

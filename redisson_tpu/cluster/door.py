@@ -29,8 +29,11 @@ from __future__ import annotations
 import socket
 import threading
 
+import time
+
 from redisson_tpu.analysis import witness as _witness
 from redisson_tpu.cluster.slotmap import SlotMap
+from redisson_tpu.obs import trace as _trace
 from redisson_tpu.cluster.slots import NSLOTS, command_keys, key_slot
 from redisson_tpu.serve.wireutil import ReplyError, exchange
 
@@ -281,6 +284,7 @@ class ClusterDoor:
         timeout_s = (timeout_ms / 1000.0) if timeout_ms else (
             self.migrate_timeout_s
         )
+        t0 = time.monotonic()
         keysvc = self._server._client.get_keys()
         with self.move_lock:
             blob = self._server._dump_payload(name)
@@ -290,6 +294,17 @@ class ClusterDoor:
             cmds = []
             if self._requirepass:
                 cmds.append([b"AUTH", self._requirepass.encode()])
+            prelude_idx = None
+            tctx = _trace.current()
+            if tctx is not None and not isinstance(tctx, tuple):
+                # Migration-pump trace propagation (ISSUE 13): the
+                # remote RESTORE hop joins the traced MIGRATE's trace
+                # via the same wire prelude the cluster client uses.
+                # Unknown-command-safe: a plain target errors on the
+                # prelude (tolerated below) and the transfer still
+                # proceeds, just untraced on that hop.
+                prelude_idx = len(cmds)
+                cmds.append([b"RTPU.TRACE"] + tctx.wire_args())
             cmds.append([b"ASKING"])
             restore = [b"RESTORE", key,
                        b"%d" % (ttl_ms if ttl_ms > 0 else 0), blob]
@@ -304,10 +319,21 @@ class ClusterDoor:
             # socket persists across keys (a TCP connect per key would
             # stretch every guarded command's wait).
             replies = self._mig_exchange((host, port), cmds, timeout_s)
-            for r in replies:
+            for i, r in enumerate(replies):
                 if isinstance(r, ReplyError):
+                    if i == prelude_idx:
+                        continue  # plain target: prelude unknown, fine
                     raise OSError(f"target refused key transfer: {r}")
             keysvc.delete(name)
+        # LATENCY "migration" event (ISSUE 13): the per-key critical
+        # section every concurrent write to the migrating slot waited
+        # behind.
+        if self.obs is not None:
+            lat = getattr(self.obs, "latency", None)
+            if lat is not None and lat.threshold_ms > 0:
+                lat.record(
+                    "migration", (time.monotonic() - t0) * 1e3
+                )
         return "OK"
 
     def _mig_exchange(self, addr, cmds, timeout_s: float) -> list:

@@ -132,7 +132,7 @@ class ClusterSupervisor:
 
     def __init__(self, n_nodes: int = 3, host: str = "127.0.0.1",
                  platform: str = "cpu", node_args=(), env_extra=None,
-                 startup_timeout_s: float = 120.0):
+                 startup_timeout_s: float = 120.0, metrics: bool = False):
         if n_nodes < 1:
             raise ValueError("n_nodes must be >= 1")
         self.n_nodes = n_nodes
@@ -149,6 +149,13 @@ class ClusterSupervisor:
         self.node_ids: list = []
         self._tmpdir = None
         self._started = False
+        # Metrics federation (ISSUE 13): with metrics=True each node
+        # additionally serves /metrics on its own reserved port
+        # (metrics_addrs), and start_federation() serves ONE merged
+        # exposition with a node label per member.
+        self.metrics = bool(metrics)
+        self.metrics_addrs: list = []  # (host, port) per node
+        self._federation = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -184,10 +191,15 @@ class ClusterSupervisor:
     def start(self) -> "ClusterSupervisor":
         if self._started:
             return self
-        ports = self._free_ports(self.host, self.n_nodes)
-        self.addrs = [(self.host, p) for p in ports]
+        nports = self.n_nodes * (2 if self.metrics else 1)
+        ports = self._free_ports(self.host, nports)
+        self.addrs = [(self.host, p) for p in ports[: self.n_nodes]]
+        if self.metrics:
+            self.metrics_addrs = [
+                (self.host, p) for p in ports[self.n_nodes:]
+            ]
         self.node_ids = ["node-%d-%d" % (i, p)
-                         for i, p in enumerate(ports)]
+                         for i, p in enumerate(ports[: self.n_nodes])]
         self._tmpdir = tempfile.mkdtemp(prefix="rtpu-cluster-")
         topo_path = os.path.join(self._tmpdir, "topology.json")
         with open(topo_path, "w") as f:
@@ -206,14 +218,19 @@ class ClusterSupervisor:
                 log = open(
                     os.path.join(self._tmpdir, f"node{i}.log"), "wb"
                 )
+                argv = [sys.executable, "-m", "redisson_tpu",
+                        "--host", h, "--port", str(p),
+                        "--platform", self.platform,
+                        "--cluster",
+                        "--cluster-topology", topo_path,
+                        "--cluster-myid", self.node_ids[i]]
+                if self.metrics:
+                    argv += [
+                        "--metrics-port",
+                        str(self.metrics_addrs[i][1]),
+                    ]
                 procs.append(subprocess.Popen(
-                    [sys.executable, "-m", "redisson_tpu",
-                     "--host", h, "--port", str(p),
-                     "--platform", self.platform,
-                     "--cluster",
-                     "--cluster-topology", topo_path,
-                     "--cluster-myid", self.node_ids[i],
-                     ] + self.node_args,
+                    argv + self.node_args,
                     stdout=log, stderr=subprocess.STDOUT, env=env,
                 ))
                 log.close()  # the child holds its own fd now
@@ -264,6 +281,24 @@ class ClusterSupervisor:
 
         return ClusterClient(self.addrs, **kw)
 
+    def start_federation(self, host: str = "127.0.0.1", port: int = 0):
+        """Serve ONE merged /metrics over the member nodes' endpoints,
+        every series labeled ``node="host:port"`` (ISSUE 13 federation;
+        requires metrics=True).  Returns the HTTP server (``.host`` /
+        ``.port``); shut down with the supervisor."""
+        if not self.metrics or not self.metrics_addrs:
+            raise RuntimeError(
+                "federation needs ClusterSupervisor(metrics=True)"
+            )
+        if self._federation is not None:
+            return self._federation
+        from redisson_tpu.obs.federate import start_federation_endpoint
+
+        self._federation = start_federation_endpoint(
+            self.metrics_addrs, host=host, port=port
+        )
+        return self._federation
+
     def migrate_slot(self, slot: int, dst_index: int,
                      src_index=None, **kw) -> int:
         """Drive a live migration of ``slot`` to node ``dst_index``
@@ -294,6 +329,12 @@ class ClusterSupervisor:
         with self._lock:
             procs, self._procs = self._procs, []
             self._started = False
+            fed, self._federation = self._federation, None
+        if fed is not None:
+            try:
+                fed.close()
+            except Exception:
+                pass
         for p in procs:
             if p.poll() is None:
                 try:

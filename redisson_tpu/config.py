@@ -297,6 +297,22 @@ class Config:
         self.cluster_topology = None
         self.cluster_slots: Optional[str] = None
         self.cluster_announce: Optional[str] = None
+        # Fleet telemetry plane (ISSUE 13).  ``trace_sample_rate``:
+        # head-based sampling probability for distributed request traces
+        # (obs/trace.py) — 0.0 (default) disables tracing entirely; the
+        # module-level guard makes the off path one attribute read per
+        # hook.  Live-settable via CONFIG SET trace-sample-rate / TRACE
+        # SAMPLE.  ``trace_max_spans``: the HARD per-process span-ring
+        # bound (oldest spans evict — tracing is a recency window, never
+        # a leak).  ``latency_monitor_threshold_ms``: the redis
+        # latency-monitor-threshold analog — named latency events
+        # (command, slow-launch, fsync-stall, breaker-open, migration,
+        # reconcile) at or above this many ms are sampled into bounded
+        # per-event histories served by LATENCY LATEST|HISTORY|DOCTOR;
+        # 0 disables.
+        self.trace_sample_rate = 0.0
+        self.trace_max_spans = 2048
+        self.latency_monitor_threshold_ms = 0
 
     # -- fluent setters, mirroring the Java builder idiom ------------------
 
@@ -355,6 +371,9 @@ class Config:
         "cluster_topology",
         "cluster_slots",
         "cluster_announce",
+        "trace_sample_rate",
+        "trace_max_spans",
+        "latency_monitor_threshold_ms",
     )
 
     def to_dict(self) -> dict:

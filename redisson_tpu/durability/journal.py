@@ -646,9 +646,12 @@ class OpJournal:
             self._rotate()
 
     def _do_fsync(self) -> None:
-        if _chaos.ENABLED:  # crash-fault point: the fsync barrier
-            _chaos.fire("journal.fsync")
         t0 = time.monotonic()
+        # Crash-fault point BEFORE the barrier; timed WITH the fsync so
+        # an injected latency fault reads as a slow disk to the fsync
+        # EWMA and the LATENCY fsync-stall event (ISSUE 13).
+        if _chaos.ENABLED:
+            _chaos.fire("journal.fsync")
         os.fsync(self._file.fileno())
         dt = time.monotonic() - t0
         with self._lock:
@@ -667,6 +670,13 @@ class OpJournal:
         obs = self.obs
         if obs is not None:
             obs.journal_fsync_us.observe((), dt)
+            lat = getattr(obs, "latency", None)
+            if lat is not None and lat.threshold_ms > 0:
+                # LATENCY "fsync-stall" event (ISSUE 13): a group-commit
+                # fsync that outlived the monitor threshold — under
+                # appendfsync always every acked write in the batch rode
+                # this stall out.
+                lat.record("fsync-stall", dt * 1e3)
 
     def _rotate(self) -> None:
         """Close the live segment (already fsynced by the caller) and

@@ -50,6 +50,7 @@ import numpy as np
 
 from redisson_tpu import chaos as _chaos
 from redisson_tpu.analysis import witness as _witness
+from redisson_tpu.obs import trace as _trace
 from redisson_tpu.executor.failures import (
     DeadlineExceededError,
     DispatchTimeoutError,
@@ -432,6 +433,15 @@ class BatchCoalescer:
             seg.chunks.append(arrays)
             if meta is not None:
                 seg.metas.append((nops, meta))
+            if _trace.ENABLED and seg.span is not None:
+                # Distributed tracing (ISSUE 13): a sampled request's
+                # ambient context parents this launch — the span's
+                # finish hook records the launch (with its phase
+                # breakdown) into every linked trace.  One attr read +
+                # branch when tracing is off.
+                tctx = _trace.current()
+                if tctx is not None:
+                    seg.span.link(tctx)
             seg.futures.append((fut, seg.nops, nops, tenant, deadline))
             seg.nops += nops
             self._queued_ops += nops
@@ -624,7 +634,10 @@ class BatchCoalescer:
             del self._order[i]
             self._detach_locked(nxt)
             if nxt.span is not None:
-                nxt.span.abandon()  # its ops ride the head's span
+                # Its ops ride the head's span; trace parent links move
+                # with them (a merged launch still reports to every
+                # sampled request it serves).
+                nxt.span.abandon(into=head.span)
             head.chunks.extend(nxt.chunks)
             if head.metas is not None:
                 head.metas.extend(nxt.metas)

@@ -236,6 +236,12 @@ class DispatchHealth:
             else max(0.005, open_s / 4.0)
         )
         self._lock = _witness.named(threading.Lock(), "health.state")
+        # Optional Observability bundle (set by the engine): breaker
+        # opens record a LATENCY "breaker-open" event whose value is the
+        # open window — how long this (shard, op)'s dispatches will fail
+        # fast (ISSUE 13).
+        self.obs = None
+        self._open_ms = open_s * 1e3
         self._probes: dict[str, Callable] = {}  # kind -> probe dispatch
         self._degraded: set[str] = set()
         self.any_degraded = False  # lock-free fast-path flag
@@ -284,6 +290,9 @@ class DispatchHealth:
             self.any_degraded = bool(self._degraded)
 
     def _on_open(self, shard, opcode: str) -> None:
+        obs = self.obs
+        if obs is not None and obs.latency.threshold_ms > 0:
+            obs.latency.record("breaker-open", self._open_ms)
         kind = kind_of_op(opcode)
         with self._lock:
             if kind is not None and kind not in self._degraded:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -355,7 +356,13 @@ class TpuSketchEngine(SketchDurabilityMixin):
         # server fronting this client.
         from redisson_tpu.obs import Observability
 
-        self.obs = Observability()
+        self.obs = Observability(
+            trace_sample_rate=getattr(config, "trace_sample_rate", 0.0),
+            trace_max_spans=getattr(config, "trace_max_spans", 2048),
+            latency_threshold_ms=getattr(
+                config, "latency_monitor_threshold_ms", 0
+            ),
+        )
         self.executor.obs = self.obs
         # Near cache (ISSUE 4): the epoch-guarded host read tier — hot
         # single-key reads answer from host memory regardless of link
@@ -410,6 +417,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
             obs=self.obs,
         )
         self.health.reconcile_cb = self._reconcile_kind
+        self.health.obs = self.obs  # LATENCY breaker-open events
         self._mirrors: dict = {}  # name -> degraded-mode mirror
         self._mirror_lock = _witness.named(
             threading.RLock(), "engine.mirror"
@@ -983,6 +991,20 @@ class TpuSketchEngine(SketchDurabilityMixin):
         row of ``kind`` back to the device, then drop the mirrors — the
         device resumes from exactly the state the mirror served.  False
         (stay degraded, breaker re-opens) if any write fails."""
+        t0 = time.monotonic()
+        try:
+            return self._reconcile_kind_inner(kind)
+        finally:
+            # LATENCY "reconcile" event (ISSUE 13): the write-back stall
+            # every op of this kind rode out, visible next to
+            # fsync-stall/breaker-open in LATENCY LATEST.
+            lat = self.obs.latency
+            if lat.threshold_ms > 0:
+                lat.record(
+                    "reconcile", (time.monotonic() - t0) * 1e3
+                )
+
+    def _reconcile_kind_inner(self, kind: str) -> bool:
         with self._mirror_lock:
             names = [
                 n for n, m in self._mirrors.items() if m.kind == kind
@@ -2685,7 +2707,13 @@ class HostSketchEngine:
         # Same observability surface as the TPU engine (so a RESP server
         # or client fronting either backend finds one bundle to record
         # into); the host engine has no coalescer/executor to instrument.
-        self.obs = Observability()
+        self.obs = Observability(
+            trace_sample_rate=getattr(config, "trace_sample_rate", 0.0),
+            trace_max_spans=getattr(config, "trace_max_spans", 2048),
+            latency_threshold_ms=getattr(
+                config, "latency_monitor_threshold_ms", 0
+            ),
+        )
         self.topk = TopKStore()
         # Wired by the client to the grid store's lock-free ``probe`` (one
         # logical keyspace — same contract as TpuSketchEngine).  Called

@@ -15,17 +15,43 @@ the codebase uses; everything is lazy-cheap when nothing reads it.
 
 from __future__ import annotations
 
+from redisson_tpu.obs.latency import LatencyMonitor
 from redisson_tpu.obs.registry import Family, MetricsRegistry
 from redisson_tpu.obs.slowlog import SlowLog, SlowLogEntry
 from redisson_tpu.obs.spans import OpSpan, SpanRecorder
+from redisson_tpu.obs.trace import Tracer
 
 
 class Observability:
     def __init__(self, slowlog_max_len: int = 128,
-                 slowlog_threshold_us: int = 10_000):
+                 slowlog_threshold_us: int = 10_000,
+                 trace_sample_rate: float = 0.0,
+                 trace_max_spans: int = 2048,
+                 latency_threshold_ms: int = 0):
         r = MetricsRegistry()
         self.registry = r
-        self.spans = SpanRecorder(r)
+        # Fleet telemetry plane (ISSUE 13): latency monitor + tracer
+        # volume counters come FIRST so the recorders below can ride
+        # them.
+        self.latency_events = r.counter(
+            "rtpu_latency_events",
+            "latency-monitor samples recorded, by event "
+            "(command | slow-launch | fsync-stall | breaker-open | "
+            "migration | reconcile)", ("event",))
+        self.trace_sampled = r.counter(
+            "rtpu_trace_sampled",
+            "requests head-sampled into a distributed trace")
+        self.trace_spans = r.counter(
+            "rtpu_trace_spans",
+            "trace spans recorded into the bounded per-process ring")
+        self.latency = LatencyMonitor(
+            latency_threshold_ms, counter=self.latency_events)
+        self.trace = Tracer(
+            trace_sample_rate, max_spans=trace_max_spans,
+            sampled_counter=self.trace_sampled,
+            span_counter=self.trace_spans,
+        )
+        self.spans = SpanRecorder(r, latency=self.latency)
         self.slowlog = SlowLog(slowlog_max_len, slowlog_threshold_us)
         # RESP front door (per-command dimension).
         self.resp_commands = r.counter(
@@ -216,15 +242,13 @@ class Observability:
     def reset_op_stats(self) -> None:
         """Zero the span-derived families — benches call this after
         warmup so compile-era samples don't pollute the warm-path
-        evidence view (op_stats / phase_stats).  Counters reset with the
-        histograms: a snapshot mixing all-time op counts with
-        reset-window percentiles would misstate ops-per-launch."""
-        self.spans._phase_hist.reset()
-        self.spans._total_hist.reset()
-        self.spans._ops.reset()
-        self.spans._errors.reset()
-        with self.spans._lock:
-            self.spans._recent.clear()
+        evidence view (op_stats / phase_stats).  Delegates to the
+        recorder's PUBLIC reset() (ISSUE 13 satellite: reaching into
+        ``spans._phase_hist`` etc. from here coupled the bench lifecycle
+        to SpanRecorder privates); the trace ring shares the same
+        lifecycle call."""
+        self.spans.reset()
+        self.trace.reset()
 
     # -- snapshot views ----------------------------------------------------
 
@@ -308,10 +332,12 @@ class Observability:
 
 __all__ = [
     "Family",
+    "LatencyMonitor",
     "MetricsRegistry",
     "Observability",
     "OpSpan",
     "SlowLog",
     "SlowLogEntry",
     "SpanRecorder",
+    "Tracer",
 ]

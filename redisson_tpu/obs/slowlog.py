@@ -24,16 +24,21 @@ MAX_ARG_BYTES = 128
 
 class SlowLogEntry:
     __slots__ = ("id", "unix_ts", "duration_us", "args", "client_addr",
-                 "client_name")
+                 "client_name", "trace_id")
 
     def __init__(self, id, unix_ts, duration_us, args, client_addr,
-                 client_name):
+                 client_name, trace_id=""):
         self.id = id
         self.unix_ts = unix_ts
         self.duration_us = duration_us
         self.args = args
         self.client_addr = client_addr
         self.client_name = client_name
+        # Slow-trace auto-capture (ISSUE 13): when the command was
+        # sampled by the distributed tracer, its trace id rides the
+        # slowlog entry so TRACE GET <id> answers "where did this slow
+        # command's time go" directly from the SLOWLOG view.
+        self.trace_id = trace_id
 
 
 def _truncate_args(args) -> list[bytes]:
@@ -61,7 +66,7 @@ class SlowLog:
         self.max_len = max(1, max_len)
 
     def maybe_add(self, duration_s: float, args, client_addr: str = "",
-                  client_name: str = "") -> bool:
+                  client_name: str = "", trace_id: str = "") -> bool:
         dur_us = int(duration_s * 1e6)
         if self.threshold_us < 0 or dur_us < self.threshold_us:
             return False
@@ -69,7 +74,7 @@ class SlowLog:
         with self._lock:
             e = SlowLogEntry(
                 self._next_id, int(time.time()), dur_us, entry_args,
-                client_addr, client_name or "",
+                client_addr, client_name or "", trace_id or "",
             )
             self._next_id += 1
             self._ring.append(e)

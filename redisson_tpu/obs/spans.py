@@ -29,7 +29,7 @@ PHASES = ("coalesce_wait", "host_stage", "device_dispatch", "d2h_fetch")
 
 
 class OpSpan:
-    __slots__ = ("op", "nops", "t0", "stamps", "error", "_rec")
+    __slots__ = ("op", "nops", "t0", "stamps", "error", "_rec", "links")
 
     def __init__(self, op: str, nops: int, recorder: "SpanRecorder"):
         self.op = op
@@ -38,6 +38,12 @@ class OpSpan:
         self.stamps: list[tuple[str, float]] = []
         self.error = False
         self._rec = recorder
+        # Distributed-trace parent links (ISSUE 13): TraceContexts of
+        # sampled requests whose ops ride this launch.  A fused launch
+        # carries one link per traced parent; the finish hook records
+        # the launch span into EVERY linked trace.  None (not []) on the
+        # untraced path — the common case allocates nothing.
+        self.links = None
 
     def stamp(self, phase: str) -> None:
         """End the current phase NOW (phases are consecutive intervals:
@@ -46,6 +52,23 @@ class OpSpan:
 
     def add_ops(self, nops: int) -> None:
         self.nops += nops
+
+    def link(self, ctx) -> None:
+        """Attach a sampled request's TraceContext (or a tuple of them)
+        as a parent of this launch.  DEDUPED by (trace, span) identity:
+        one traced request whose K submits coalesce into this launch
+        links once, not K times — duplicate links would flood the span
+        ring with K identical launch spans per trace."""
+        if self.links is None:
+            self.links = []
+        if isinstance(ctx, tuple):
+            for c in ctx:
+                self.link(c)
+            return
+        for ex in self.links:
+            if ex.span_id == ctx.span_id and ex.trace_id == ctx.trace_id:
+                return
+        self.links.append(ctx)
 
     def phases(self) -> dict:
         out = {}
@@ -64,9 +87,15 @@ class OpSpan:
         self.error = error
         self._rec._finish(self)
 
-    def abandon(self) -> None:
-        """Merged-away segment: its ops ride another span — record nothing."""
+    def abandon(self, into: "OpSpan" = None) -> None:
+        """Merged-away segment: its ops ride another span — record
+        nothing.  ``into`` (the surviving head span) inherits any trace
+        parent links, so a merged launch still reports to every sampled
+        request it serves."""
         self._rec = None
+        if into is not None and self.links:
+            into.link(tuple(self.links))
+            self.links = None
 
 
 class SpanRecorder:
@@ -74,8 +103,12 @@ class SpanRecorder:
     the last ``keep`` spans for inspection (client.get_metrics views and
     the span-sum sanity test)."""
 
-    def __init__(self, registry, keep: int = 256):
+    def __init__(self, registry, keep: int = 256, latency=None):
         self._registry = registry
+        # Optional LatencyMonitor (ISSUE 13): launches whose end-to-end
+        # time meets latency-monitor-threshold record a "slow-launch"
+        # event.  One compare per finish when disarmed.
+        self.latency = latency
         self._phase_hist = registry.histogram(
             "rtpu_op_phase_seconds",
             "per-launch lifecycle phase durations", ("op", "phase"),
@@ -97,17 +130,61 @@ class SpanRecorder:
 
     def _finish(self, span: OpSpan) -> None:
         span._rec = None
-        for phase, dur in span.phases().items():
+        phases = span.phases()
+        e2e = span.end_to_end()
+        for phase, dur in phases.items():
             self._phase_hist.observe((span.op, phase), dur)
-        self._total_hist.observe((span.op,), span.end_to_end())
+        self._total_hist.observe((span.op,), e2e)
         if span.error:
             self._errors.inc((span.op,))
         else:
             self._ops.inc((span.op,), max(1, span.nops))
+        lat = self.latency
+        if lat is not None and lat.threshold_ms > 0:
+            lat.record("slow-launch", e2e * 1e3)
+        if span.links:
+            self._feed_traces(span, phases, e2e)
         with self._lock:
             self._recent.append(span)
+
+    @staticmethod
+    def _feed_traces(span: OpSpan, phases: dict, e2e: float) -> None:
+        """Record this launch into every linked trace (ISSUE 13): one
+        span per sampled parent, each carrying the full phase breakdown
+        and the total parent-link count — a fused launch stays visible
+        as fused from inside any single trace."""
+        nlinks = len(span.links)
+        attrs = {
+            "nops": span.nops,
+            "links": nlinks,
+        }
+        for name, dur in phases.items():
+            attrs[name + "_us"] = int(dur * 1e6)
+        ts = time.time() - e2e  # wall start ≈ now - span length
+        for ctx in span.links:
+            try:
+                ctx.tracer.record_span(
+                    ctx, "launch:" + span.op, ts, e2e, attrs,
+                    error=span.error,
+                )
+            except Exception:
+                pass  # a dying tracer must not fail the completer
 
     def recent(self, op: Optional[str] = None) -> list[OpSpan]:
         with self._lock:
             spans = list(self._recent)
         return spans if op is None else [s for s in spans if s.op == op]
+
+    def reset(self) -> None:
+        """Zero the span-derived histograms/counters and the recent
+        ring — the PUBLIC lifecycle surface (benches reset after warmup
+        so compile-era samples don't pollute the warm-path evidence
+        view; counters reset WITH the histograms — a snapshot mixing
+        all-time op counts with reset-window percentiles would misstate
+        ops-per-launch)."""
+        self._phase_hist.reset()
+        self._total_hist.reset()
+        self._ops.reset()
+        self._errors.reset()
+        with self._lock:
+            self._recent.clear()

@@ -260,6 +260,13 @@ class _Reactor(threading.Thread):
     connections, a self-pipe for cross-thread wakeups, and the merged
     dispatch pass."""
 
+    # Dispatch-pass sequence number (ISSUE 13): traced commands
+    # annotate which tick carried them, correlating a trace with the
+    # cross-connection fusion window it rode.  CLASS attribute (the
+    # journal `_rotate_req` idiom) so model-check harnesses that drive
+    # _run_pass without __init__ still read 0.
+    tick_seq = 0
+
     def __init__(self, server, idx: int):
         super().__init__(name=f"rtpu-resp-reactor-{idx}", daemon=True)
         self.server = server
@@ -571,6 +578,7 @@ class _Reactor(threading.Thread):
                     ctxs.append(rconn.ctx)
                     owners.append(rconn)
         if cmds:
+            self.tick_seq += 1
             obs = server.obs
             if obs is not None:
                 obs.reactor_ticks.inc()
@@ -745,12 +753,13 @@ class _Reactor(threading.Thread):
                 and now - rconn.last_activity > idle_s
             ):
                 if (
-                    rconn.ctx.subs
+                    (rconn.ctx.subs or rconn.ctx.monitor)
                     and rconn.framer.at_frame_boundary()
                     and not rconn.pending
                 ):
-                    # Subscribers may idle legitimately — but only at a
-                    # frame boundary (same exemption as _serve_conn).
+                    # Subscribers/monitors may idle legitimately — but
+                    # only at a frame boundary (same exemption as
+                    # _serve_conn).
                     rconn.last_activity = now
                 else:
                     self._close_conn(rconn)
@@ -823,6 +832,7 @@ class _Reactor(threading.Thread):
                 pass
             rconn.registered = False
         self._unsubscribe_all(rconn)
+        self.server._monitors.discard(rconn.ctx)
         try:
             rconn.sock.close()
         except OSError:

@@ -56,6 +56,7 @@ import numpy as np
 from redisson_tpu import chaos
 from redisson_tpu import overload as _overload
 from redisson_tpu.analysis import witness as _witness
+from redisson_tpu.obs import trace as _trace
 from redisson_tpu.executor.failures import (
     DeadlineExceededError,
     TenantThrottledError,
@@ -114,6 +115,10 @@ _SHED_EXEMPT = frozenset((
     # per-key migration pump must keep running DURING an overload —
     # resharding is how an operator relieves one.
     "CLUSTER", "ASKING", "MIGRATE",
+    # Fleet telemetry plane (ISSUE 13): the trace/latency/monitor
+    # surfaces are exactly what an operator reads DURING the overload,
+    # and the RTPU.TRACE prelude is metadata, not work.
+    "LATENCY", "TRACE", "MONITOR", "RTPU.TRACE",
 ))
 
 # -- front-door vectorization tables (ISSUE 6 tentpole) ----------------------
@@ -125,6 +130,10 @@ _SHED_EXEMPT = frozenset((
 _PIPELINE_STOP = frozenset((
     b"BLPOP", b"BRPOP", b"XREAD", b"XREADGROUP",
     b"SUBSCRIBE", b"UNSUBSCRIBE",
+    # MONITOR (ISSUE 13) turns the connection into a push stream, like
+    # SUBSCRIBE — its ack must not overtake buffered replies, and the
+    # reactor hands it to a worker through the same _DETACH gate.
+    b"MONITOR",
 ))
 
 # NON-MUTATING commands: dispatching one cannot change any keyspace-read
@@ -146,7 +155,7 @@ _NONMUTATING = frozenset((
     "SSCAN", "ZSCAN", "SCAN", "OBJECT", "DUMP", "PING", "ECHO", "SELECT",
     "TIME", "COMMAND", "CLIENT", "INFO", "SLOWLOG", "WAIT", "AUTH",
     "HELLO", "QUIT", "SAVE", "BGSAVE", "LASTSAVE", "BGREWRITEAOF",
-    "ASKING",
+    "ASKING", "LATENCY", "TRACE", "MONITOR", "RTPU.TRACE",
 ))
 
 # Response-CACHEABLE subset: deterministic pure keyspace reads whose
@@ -421,6 +430,14 @@ class _ConnCtx:
         # decision (lets an ASK-redirected command be served from an
         # IMPORTING slot this node does not own yet).
         self.asking = False
+        # Distributed-trace wire prelude (ISSUE 13): one-shot (the
+        # ASKING shape) — RTPU.TRACE <trace_id> <span_id> parks the
+        # remote parent here; the NEXT command joins that trace (head
+        # sampling already happened at the remote hop) and consumes it.
+        self.trace_next = None
+        # MONITOR mode (ISSUE 13): every dispatched command streams to
+        # this connection as a +<ts> [db addr] "CMD" ... push.
+        self.monitor = False
 
     def _kill(self) -> None:
         try:
@@ -626,6 +643,13 @@ class RespServer:
 
             self.obs = Observability()
         self._started = time.monotonic()
+        # MONITOR mode (ISSUE 13): live monitor connections' ctxs.  Read
+        # lock-free per command (GIL-atomic set ops; the common case is
+        # the empty set — one falsy check).  While any monitor is
+        # attached, front-door fusion is disabled so EVERY command flows
+        # through _safe_dispatch and feeds the stream (redis documents
+        # MONITOR as expensive for the same reason).
+        self._monitors: set = set()
         self._conns_accepted = 0
         self._nconn = 0
         self._conn_lock = _witness.named(threading.Lock(), "resp.conns")
@@ -771,10 +795,12 @@ class RespServer:
                 try:
                     cmd = reader.read_command()
                 except socket.timeout:
-                    # Subscribers may idle legitimately — but only at a
-                    # frame boundary; a timeout mid-frame (or with bytes
-                    # buffered) would desync the protocol on resume.
-                    if ctx.subs and reader.at_frame_boundary():
+                    # Subscribers (and monitors) may idle legitimately —
+                    # but only at a frame boundary; a timeout mid-frame
+                    # (or with bytes buffered) would desync the protocol
+                    # on resume.
+                    if (ctx.subs or ctx.monitor) and \
+                            reader.at_frame_boundary():
                         continue
                     return  # reclaim the slot
                 except OSError:
@@ -822,9 +848,11 @@ class RespServer:
                 else:
                     ctx.send(self._safe_dispatch(cmd, ctx))
         finally:
-            # Drop this connection's subscriptions with it.
+            # Drop this connection's subscriptions (and monitor slot)
+            # with it.
             for channel, lid in list(ctx.subs.items()):
                 self._client._topic_bus.unsubscribe(channel, lid)
+            self._monitors.discard(ctx)
             conn.close()
             with self._conn_lock:
                 self._nconn -= 1
@@ -884,17 +912,30 @@ class RespServer:
         queueing = ctx.in_multi and name not in (
             "EXEC", "DISCARD", "MULTI", "RESET",
         )
+        if self._monitors and not queueing:
+            # MONITOR stream (ISSUE 13): fed at dispatch, before
+            # execution (redis feeds on command processing).
+            self._monitor_feed(name, cmd, ctx)
+        # Distributed tracing (ISSUE 13): a remote RTPU.TRACE prelude
+        # forces the span into that trace even when this node's own
+        # sampling is off (head-based: the first hop's decision binds
+        # every downstream hop); otherwise head-sample here.  Off path:
+        # two attribute reads.
+        tspan = (
+            self._trace_begin(name, ctx)
+            if not queueing
+            and (ctx.trace_next is not None or _trace.ENABLED)
+            else None
+        )
         try:
-            # Deadline attach (ISSUE 7): every command gets its own
-            # fresh end-to-end deadline — connection override first,
-            # else the server default; 0/None → no deadline (ops block,
-            # the pre-overload behavior).
-            dl_s = self._op_deadline_s(ctx)
-            if dl_s is not None:
-                with _overload.deadline_scope(dl_s):
-                    reply = self._dispatch(cmd, ctx, name)
+            if tspan is None:
+                reply = self._dispatch_deadlined(cmd, ctx, name)
             else:
-                reply = self._dispatch(cmd, ctx, name)
+                # Ambient scope: engine submits inside link this span,
+                # so the trace stitches through the coalescer's launch
+                # lifecycle (client leg → ingress → launch phases).
+                with _trace.scope(tspan.ctx()):
+                    reply = self._dispatch_deadlined(cmd, ctx, name)
         except ScriptKilledError:
             # SCRIPT KILL's async exception can land AFTER the script
             # body left its guarded block (next bytecode boundary):
@@ -910,19 +951,35 @@ class RespServer:
             # the ONE shared helper the fused-run demux also uses.
             err = True
             reply = self._fused_error_frame(e)
-        if ctx.asking and name != "ASKING" and not queueing:
+        if ctx.asking and name not in ("ASKING", "RTPU.TRACE") \
+                and not queueing:
             # Cluster ASKING is one-shot for ANY next command (Redis
             # semantics): keyed commands consume it inside route();
             # keyless ones (PING between ASKING and the redirected
             # command) and errored dispatches consume it here so the
             # license can never leak to a later unrelated command.
+            # RTPU.TRACE is transparent (the two preludes compose in
+            # either order — the traced hop is the command after both).
             ctx.asking = False
+        if ctx.trace_next is not None and name not in (
+            "RTPU.TRACE", "ASKING",
+        ) and not queueing:
+            # The trace prelude is one-shot for ANY next command (the
+            # ASKING shape): normally consumed inside _trace_begin, but
+            # an errored/untraceable dispatch must still burn it so the
+            # context can never leak to a later unrelated command.
+            # ASKING is transparent — it is itself a prelude, and the
+            # migration pump sends RTPU.TRACE + ASKING + RESTORE: the
+            # traced hop must be the RESTORE, not the ASKING ack.
+            ctx.trace_next = None
         if not queueing and name not in _NONMUTATING:
             # Any executed command that may have changed keyspace state
             # retires every response-cache entry (coarse, cheap, safe —
             # the cache's whole window is one parsed-ahead batch).
             self._bump_write_epoch()
         dt = time.perf_counter() - t0
+        if tspan is not None:
+            tspan.end(error=err)
         obs = self.obs
         if obs is not None and not queueing:
             if self._blocked(name, cmd, ctx):
@@ -935,14 +992,95 @@ class RespServer:
                     obs.resp_errors.inc((name,))
             else:
                 obs.record_resp_command(name, dt, err)
+                if obs.latency.threshold_ms > 0:
+                    # LATENCY "command" event (ISSUE 13 parity).
+                    obs.latency.record("command", dt * 1e3)
                 sl = obs.slowlog
                 if 0 <= sl.threshold_us <= dt * 1e6:
-                    # Sanitize only for entries that will be kept.
+                    # Sanitize only for entries that will be kept.  A
+                    # sampled command's trace id rides the entry
+                    # (slow-trace auto-capture): TRACE GET <id> answers
+                    # where the time went.
                     sl.maybe_add(
                         dt, self._slowlog_sanitize(name, cmd), ctx.addr,
                         ctx.client_name or "",
+                        trace_id=(
+                            tspan.trace_id if tspan is not None else ""
+                        ),
                     )
         return reply
+
+    def _dispatch_deadlined(self, cmd: list, ctx: "_ConnCtx",
+                            name: str) -> bytes:
+        """Deadline attach (ISSUE 7): every command gets its own fresh
+        end-to-end deadline — connection override first, else the server
+        default; 0/None → no deadline (ops block, the pre-overload
+        behavior)."""
+        dl_s = self._op_deadline_s(ctx)
+        if dl_s is not None:
+            with _overload.deadline_scope(dl_s):
+                return self._dispatch(cmd, ctx, name)
+        return self._dispatch(cmd, ctx, name)
+
+    # -- fleet telemetry plane (ISSUE 13) ----------------------------------
+
+    def _node_label(self) -> str:
+        if self.cluster is not None:
+            return self.cluster.myid
+        return f"{self.host}:{self.port}"
+
+    def _trace_begin(self, name: str, ctx: "_ConnCtx"):
+        """Mint one command's ingress span: a parked RTPU.TRACE prelude
+        forces it into the remote trace (one-shot consume), else
+        head-sample against the live rate.  None = dice missed.
+        ASKING never consumes the prelude — it is itself a prelude, and
+        the migration pump's RTPU.TRACE + ASKING + RESTORE sequence
+        must trace the RESTORE."""
+        tr = self.obs.trace
+        nxt = ctx.trace_next
+        if nxt is not None and name != "ASKING":
+            ctx.trace_next = None  # one-shot, like ASKING
+            span = tr.start("resp:" + name, nxt[0], nxt[1])
+        else:
+            span = tr.maybe_start("resp:" + name)
+            if span is None:
+                return None
+        span.annotate("node", self._node_label())
+        if ctx.addr:
+            span.annotate("addr", ctx.addr)
+        rc = getattr(ctx, "_rconn", None)
+        if rc is not None:
+            # Reactor front door: which event-loop tick carried this
+            # command (correlates the span with cross-connection batch
+            # fusion inside that tick).
+            span.annotate("tick", rc.reactor.tick_seq)
+        return span
+
+    def _monitor_feed(self, name: str, cmd: list,
+                      ctx: "_ConnCtx") -> None:
+        """Stream one dispatched command to every MONITOR connection
+        (the redis monitor wire shape: ``+<unix.micros> [0 <addr>]
+        "CMD" "arg" ...``).  Credentials are redacted exactly as in the
+        slowlog; MONITOR itself and a monitor's own commands are not
+        echoed.  Cross-thread sends ride each connection's ordered send
+        path (reactor outbuf / conn write lock) — the same mechanism as
+        pub/sub pushes."""
+        if name == "MONITOR" or ctx.monitor:
+            return
+        shown = self._slowlog_sanitize(name, cmd)
+        args = " ".join(
+            '"%s"' % a.decode("latin-1", "replace")
+            .replace("\\", "\\\\").replace('"', '\\"')
+            for a in shown
+        )
+        line = (
+            "+%.6f [0 %s] %s\r\n" % (time.time(), ctx.addr or "?", args)
+        ).encode("latin-1", "replace")
+        for mctx in tuple(self._monitors):
+            try:
+                mctx.send(line)
+            except Exception:
+                self._monitors.discard(mctx)
 
     @staticmethod
     def _blocked(name: str, cmd: list, ctx: "_ConnCtx") -> bool:
@@ -1083,10 +1221,16 @@ class RespServer:
         ``head_ctx``'s: fusable, and carrying the SAME per-connection
         deadline override — the run executes under ONE deadline scope
         (the head's), so a CLIENT DEADLINE connection fused into a
-        no-deadline run would silently lose its overload contract."""
+        no-deadline run would silently lose its overload contract.  A
+        member carrying a trace prelude never fuses: its ingress span
+        (and the prelude's one-shot consume) live on the sequential
+        path (ISSUE 13)."""
         return (
             cls._ctx_fusable(ctx)
             and ctx.op_deadline_ms == head_ctx.op_deadline_ms
+            # getattr: model-check harnesses drive the collectors with
+            # minimal fake ctxs that predate the trace field.
+            and getattr(ctx, "trace_next", None) is None
         )
 
     def _dispatch_merged(self, batch, ctxs):
@@ -1135,6 +1279,13 @@ class RespServer:
                 self.vectorize
                 and self._ctx_fusable(ctx)
                 and not self._script_busy()
+                # Telemetry barriers (ISSUE 13): while a MONITOR is
+                # attached every command must flow through
+                # _safe_dispatch to feed the stream; a command carrying
+                # a trace prelude takes the sequential path so its
+                # ingress span (and the one-shot consume) happen there.
+                and not self._monitors
+                and getattr(ctx, "trace_next", None) is None
             )
             if plain and rc_cap > 0 and name in _CACHEABLE:
                 hit = self._rc_probe(rc, rc_state, name, cmd)
@@ -1173,6 +1324,9 @@ class RespServer:
                             and self._ctx_fusable(ctxs[jj])
                             and self._op_deadline_s(ctxs[jj]) is None
                             and not self._script_busy()
+                            and getattr(
+                                ctxs[jj], "trace_next", None
+                            ) is None
                         ):
                             # (A deadline-carrying connection's run must
                             # execute under its deadline_scope — the
@@ -1959,6 +2113,9 @@ class RespServer:
         ctx.subs.clear()
         ctx.proto = 2
         ctx.client_name = None
+        ctx.monitor = False  # RESET exits MONITOR mode (Redis parity)
+        self._monitors.discard(ctx)
+        ctx.trace_next = None
         if self._requirepass:
             ctx.authed = False
         return _encode_simple("RESET")
@@ -2022,6 +2179,12 @@ class RespServer:
             "client-output-buffer-limit": str(self.output_buffer_limit),
             "client-output-buffer-soft-seconds":
                 f"{self.output_buffer_soft_seconds:g}",
+            # Fleet telemetry plane (ISSUE 13): live head-sampling rate
+            # (also settable via TRACE SAMPLE) and the latency-monitor
+            # arm threshold (0 = off, redis semantics).
+            "trace-sample-rate": f"{self.obs.trace.sample_rate:g}",
+            "latency-monitor-threshold":
+                str(self.obs.latency.threshold_ms),
         })
         eng = getattr(self._client, "_engine", None)
         # Durability tier (ISSUE 10): appendonly/appendfsync are LIVE on
@@ -2074,6 +2237,46 @@ class RespServer:
         "tenant-rate-limit", "tenant-burst-ops", "tenant-max-inflight",
         "client-output-buffer-limit", "client-output-buffer-soft-seconds",
     ))
+
+    # Telemetry knobs (ISSUE 13) with bounds validation before apply
+    # (the overload-knob pattern): a nonsense rate/threshold must be
+    # refused, never acked into the table.
+    _TELEMETRY_KEYS = frozenset((
+        "trace-sample-rate", "latency-monitor-threshold",
+    ))
+
+    def _validate_telemetry_config(self, key: str, raw: bytes) -> None:
+        if key == "trace-sample-rate":
+            try:
+                fv = float(raw)
+            except ValueError:
+                raise RespError(
+                    f"Invalid argument '{raw.decode()}' for CONFIG SET "
+                    f"'{key}'"
+                )
+            if not 0.0 <= fv <= 1.0:
+                raise RespError(
+                    f"argument must be in [0, 1] for CONFIG SET '{key}'"
+                )
+        elif key == "latency-monitor-threshold":
+            try:
+                iv = int(raw)
+            except ValueError:
+                raise RespError(
+                    f"Invalid argument '{raw.decode()}' for CONFIG SET "
+                    f"'{key}'"
+                )
+            if iv < 0:
+                raise RespError(
+                    f"argument must be >= 0 for CONFIG SET '{key}' "
+                    f"(0 disables the latency monitor)"
+                )
+
+    def _apply_telemetry_config(self, key: str, val: str) -> None:
+        if key == "trace-sample-rate":
+            self.obs.trace.set_sample_rate(float(val))
+        elif key == "latency-monitor-threshold":
+            self.obs.latency.set_threshold_ms(int(val))
 
     def _validate_overload_config(self, key: str, raw: bytes) -> None:
         def bad(msg: str):
@@ -2167,6 +2370,8 @@ class RespServer:
                     )
                 if key in self._OVERLOAD_KEYS:
                     self._validate_overload_config(key, pairs[i + 1])
+                elif key in self._TELEMETRY_KEYS:
+                    self._validate_telemetry_config(key, pairs[i + 1])
                 elif key == "appendonly":
                     v = pairs[i + 1].decode().lower()
                     if v not in ("yes", "no"):
@@ -2275,6 +2480,8 @@ class RespServer:
                     self.obs.slowlog.set_max_len(int(val))
                 elif key in self._OVERLOAD_KEYS:
                     self._apply_overload_config(key, val)
+                elif key in self._TELEMETRY_KEYS:
+                    self._apply_telemetry_config(key, val)
                 elif key.startswith("nearcache"):
                     self._apply_nearcache_config(key, val)
             return _encode_simple("OK")
@@ -2301,6 +2508,7 @@ class RespServer:
                 timeout_s = ms / 1000.0 if ms > 0 else None
             from redisson_tpu.durability import JournalError
 
+            t0 = time.perf_counter()
             try:
                 if not fence(timeout=timeout_s):
                     raise RespError(
@@ -2308,6 +2516,15 @@ class RespServer:
                     )
             except JournalError as e:
                 raise RespError(f"journal is broken: {e}") from e
+            tctx = _trace.current()
+            if tctx is not None and not isinstance(tctx, tuple):
+                # Traced WAIT: the fsync fence becomes its own child
+                # span, so a trace shows exactly how much of the
+                # command was durability wait (ISSUE 13).
+                dur = time.perf_counter() - t0
+                tctx.tracer.record_span(
+                    tctx, "journal_fsync_fence", time.time() - dur, dur,
+                )
         return _encode_int(0)
 
     # -- persistence commands (ISSUE 10): SAVE family goes live -----------
@@ -3181,7 +3398,7 @@ class RespServer:
     # name includes them.
     _INFO_DEFAULT = (
         "server", "clients", "memory", "stats", "persistence", "nearcache",
-        "frontdoor", "overload", "cluster", "keyspace",
+        "frontdoor", "overload", "cluster", "telemetry", "keyspace",
     )
 
     def _cmd_INFO(self, args):
@@ -3420,6 +3637,26 @@ class RespServer:
                     lines.append("cluster_enabled:0")
                 else:
                     lines += self.cluster.info_lines()
+            elif s == "telemetry" and obs is not None:
+                # Fleet telemetry plane (ISSUE 13): the distributed
+                # tracer's live knob/ring state and the latency
+                # monitor's arm state — what an operator checks before
+                # asking "why is TRACE GET empty".
+                ts = obs.trace.stats()
+                ls = obs.latency.stats()
+                lines += [
+                    "# Telemetry",
+                    f"trace_sample_rate:{ts['sample_rate']:g}",
+                    f"trace_spans:{ts['spans']}",
+                    f"trace_traces:{ts['traces']}",
+                    f"trace_max_spans:{ts['max_spans']}",
+                    f"trace_sampled_total:{ts['sampled']}",
+                    f"trace_evicted_total:{ts['evicted']}",
+                    f"latency_monitor_threshold:{ls['threshold_ms']}",
+                    f"latency_events:{ls['events']}",
+                    f"latency_samples:{ls['samples']}",
+                    f"monitors:{len(self._monitors)}",
+                ]
             elif s == "keyspace":
                 n = self._client.get_keys().count()
                 lines += ["# Keyspace", f"db0:keys={n},expires=0,avg_ttl=0"]
@@ -3440,14 +3677,24 @@ class RespServer:
             entries = sl.entries(count)
             out = b"*" + str(len(entries)).encode() + b"\r\n"
             for e in entries:
+                fields = [
+                    _encode_int(e.id),
+                    _encode_int(e.unix_ts),
+                    _encode_int(e.duration_us),
+                    _encode_array(list(e.args)),
+                    _encode_bulk(e.client_addr),
+                    _encode_bulk(e.client_name),
+                ]
+                if getattr(e, "trace_id", ""):
+                    # Slow-trace auto-capture (ISSUE 13): a sampled slow
+                    # command carries its trace id as a 7th element
+                    # (clients tolerate per-version slowlog arity; the
+                    # classic 6-element shape is unchanged when tracing
+                    # is off).
+                    fields.append(_encode_bulk(e.trace_id))
                 out += (
-                    b"*6\r\n"
-                    + _encode_int(e.id)
-                    + _encode_int(e.unix_ts)
-                    + _encode_int(e.duration_us)
-                    + _encode_array(list(e.args))
-                    + _encode_bulk(e.client_addr)
-                    + _encode_bulk(e.client_name)
+                    b"*" + str(len(fields)).encode() + b"\r\n"
+                    + b"".join(fields)
                 )
             return out
         if sub == "RESET":
@@ -3466,6 +3713,132 @@ class RespServer:
             f"Unknown SLOWLOG subcommand or wrong number of arguments "
             f"for '{sub.lower()}'"
         )
+
+    # -- fleet telemetry plane (ISSUE 13): TRACE / LATENCY / MONITOR -------
+
+    def _cmdctx_RTPU_TRACE(self, args, ctx: _ConnCtx):
+        """Trace-context wire prelude: ``RTPU.TRACE <trace_id>
+        <parent_span_id>`` parks the remote parent on the connection;
+        the NEXT command joins that trace (head sampling already
+        happened at the first hop) and consumes it — one-shot, the
+        ASKING shape.  Unknown-command-safe by design: a plain server
+        errors on RTPU.TRACE and the traced command still executes,
+        just untraced on that hop."""
+        if len(args) < 2:
+            raise RespError(
+                "wrong number of arguments for 'rtpu.trace' command"
+            )
+        tid = args[0].decode("latin-1", "replace")
+        sid = args[1].decode("latin-1", "replace")
+        if not (8 <= len(tid) <= 64 and 4 <= len(sid) <= 32):
+            raise RespError("RTPU.TRACE trace/span id out of range")
+        ctx.trace_next = (tid, sid)
+        return _encode_simple("OK")
+
+    def _cmd_TRACE(self, args):
+        """TRACE GET [trace_id] | SAMPLE <rate> | RESET | LEN | HELP —
+        the distributed-trace ring's RESP surface.  GET replies one JSON
+        document per trace (spans grouped by trace id), chosen so a
+        cross-node merge is a list concat (cluster client
+        fleet_traces)."""
+        if not args:
+            raise RespError(
+                "wrong number of arguments for 'trace' command"
+            )
+        sub = args[0].decode().upper()
+        tr = self.obs.trace
+        if sub == "GET":
+            tid = args[1].decode() if len(args) > 1 else None
+            return _encode_array(
+                [d.encode() for d in tr.traces_json(tid)]
+            )
+        if sub == "SAMPLE":
+            if len(args) < 2:
+                raise RespError(
+                    "wrong number of arguments for 'trace|sample'"
+                )
+            try:
+                tr.set_sample_rate(float(args[1]))
+            except ValueError as e:
+                raise RespError(str(e)) from e
+            if hasattr(self, "_config_table"):
+                self._config_table["trace-sample-rate"] = (
+                    f"{tr.sample_rate:g}"
+                )
+            return _encode_simple("OK")
+        if sub == "RESET":
+            tr.reset()
+            return _encode_simple("OK")
+        if sub == "LEN":
+            return _encode_int(tr.stats()["spans"])
+        if sub == "HELP":
+            return _encode_array([
+                b"TRACE GET [<trace-id>]",
+                b"TRACE SAMPLE <rate 0..1>",
+                b"TRACE RESET",
+                b"TRACE LEN",
+                b"TRACE HELP",
+            ])
+        raise RespError(f"Unknown TRACE subcommand {sub}")
+
+    def _cmd_LATENCY(self, args):
+        """LATENCY LATEST | HISTORY <event> | RESET [event ...] |
+        DOCTOR | HELP — redis-server's latency monitor surface, fed by
+        span phases and the named events (slow-launch, fsync-stall,
+        breaker-open, migration, reconcile, command)."""
+        if not args:
+            raise RespError(
+                "wrong number of arguments for 'latency' command"
+            )
+        sub = args[0].decode().upper()
+        lat = self.obs.latency
+        if sub == "LATEST":
+            rows = []
+            for name, ts, ms, mx in lat.latest():
+                rows.append(
+                    b"*4\r\n" + _encode_bulk(name) + _encode_int(ts)
+                    + _encode_int(ms) + _encode_int(mx)
+                )
+            return (
+                b"*" + str(len(rows)).encode() + b"\r\n" + b"".join(rows)
+            )
+        if sub == "HISTORY":
+            if len(args) < 2:
+                raise RespError(
+                    "wrong number of arguments for 'latency|history'"
+                )
+            pairs = lat.history(args[1].decode())
+            rows = [
+                b"*2\r\n" + _encode_int(ts) + _encode_int(ms)
+                for ts, ms in pairs
+            ]
+            return (
+                b"*" + str(len(rows)).encode() + b"\r\n" + b"".join(rows)
+            )
+        if sub == "RESET":
+            return _encode_int(
+                lat.reset(*[a.decode() for a in args[1:]])
+            )
+        if sub == "DOCTOR":
+            return _encode_bulk(lat.doctor())
+        if sub == "HELP":
+            return _encode_array([
+                b"LATENCY LATEST",
+                b"LATENCY HISTORY <event>",
+                b"LATENCY RESET [<event> ...]",
+                b"LATENCY DOCTOR",
+                b"LATENCY HELP",
+            ])
+        raise RespError(f"Unknown LATENCY subcommand {sub}")
+
+    def _cmdctx_MONITOR(self, args, ctx: _ConnCtx):
+        """MONITOR: stream every dispatched command to this connection
+        (redis parity).  Rides the reactor's blocking-handoff path (the
+        _DETACH set) like SUBSCRIBE; the feed itself is the pub/sub
+        push mechanism.  RESET (or disconnect) leaves monitor mode."""
+        ctx.monitor = True
+        self._monitors.add(ctx)
+        return _encode_simple("OK")
 
     def _cmdctx_CLIENT(self, args, ctx: _ConnCtx):
         sub = args[0].decode().upper() if args else ""

@@ -1,0 +1,38 @@
+# rtpulint: role=dispatch
+"""RT011 known-bad corpus: spans begun and stranded on some path.
+
+The ISSUE 13 class: an OpSpan / trace span someone begins is dropped on
+an exit path (or its end lives in a try whose except swallows), so the
+launch records no phases and the trace silently loses the hop."""
+
+
+class Recorder:
+    def __init__(self, obs, tracer):
+        self.obs = obs
+        self.tracer = tracer
+
+    def begun_and_dropped(self, op):
+        span = self.obs.spans.start(op)  # rtpulint-expect: RT011
+        if op is None:
+            return None
+        return None
+
+    def trace_begun_and_dropped(self, name):
+        span = self.tracer.maybe_start(name)  # rtpulint-expect: RT011
+        if span is None:
+            return False
+        return True
+
+    def forced_span_dropped(self, tid):
+        span = self.tracer.start("hop", tid)  # rtpulint-expect: RT011
+        self.counter = (self.counter or 0) + 1
+        return self.counter
+
+    def swallowing_except_arm(self, op, work):
+        span = self.obs.spans.start(op)
+        try:
+            work()
+            span.finish()
+        except Exception:  # rtpulint-expect: RT011
+            pass
+        return True

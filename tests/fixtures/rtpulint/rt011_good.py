@@ -1,0 +1,53 @@
+# rtpulint: role=dispatch
+"""RT011 known-good corpus: every begun span is finished/ended/
+abandoned, returned, or handed off — exception arms included."""
+
+
+class Recorder:
+    def __init__(self, obs, tracer):
+        self.obs = obs
+        self.tracer = tracer
+        self.segments = []
+
+    def finished_locally(self, op, work):
+        span = self.obs.spans.start(op)
+        try:
+            work()
+            span.finish()
+        except Exception:
+            span.finish(error=True)
+            raise
+        return True
+
+    def ended_trace_span(self, name):
+        span = self.tracer.maybe_start(name)
+        if span is None:
+            return None
+        span.annotate("k", 1)
+        span.end()
+        return span.trace_id
+
+    def abandoned_on_merge(self, op):
+        span = self.obs.spans.start(op)
+        span.abandon()
+        return None
+
+    def escaped_by_store(self, op, seg):
+        # The coalescer shape: the segment owns the span's lifecycle.
+        span = self.obs.spans.start(op)
+        seg.span = span
+        return seg
+
+    def escaped_by_return(self, name):
+        span = self.tracer.start_child(self.root, name)
+        return span
+
+    def handed_off_in_call(self, op):
+        span = self.obs.spans.start(op)
+        self.segments.append(span)
+        return True
+
+    def plain_thread_start_is_not_a_span(self, thread):
+        # `.start()` on things that are not span sources must not fire.
+        worker = thread.start()
+        return worker

@@ -1,0 +1,213 @@
+"""Metrics federation (ISSUE 13 tentpole part 2): exposition merging
+with node relabeling (unit), and the slow-marked 3-node supervisor test
+— federated /metrics serving all nodes' rtpu_* series under distinct
+node labels, fleet-aggregated INFO, and the cross-node SLOWLOG merge."""
+
+import re
+import time
+import urllib.request
+
+import pytest
+
+from redisson_tpu.obs.federate import (
+    FederatedMetrics,
+    merge_expositions,
+    start_federation_endpoint,
+)
+
+PAGE_A = """\
+# HELP rtpu_x_total things
+# TYPE rtpu_x_total counter
+rtpu_x_total{cmd="GET"} 3
+rtpu_x_total{cmd="SET"} 1
+# TYPE rtpu_up gauge
+rtpu_up 1
+"""
+
+PAGE_B = """\
+# HELP rtpu_x_total things
+# TYPE rtpu_x_total counter
+rtpu_x_total{cmd="GET"} 7
+# TYPE rtpu_up gauge
+rtpu_up 1
+"""
+
+
+def test_merge_expositions_relabels_and_regroups():
+    merged = merge_expositions([("n1:1", PAGE_A), ("n2:2", PAGE_B)])
+    # Node label injected FIRST, existing labels preserved.
+    assert 'rtpu_x_total{node="n1:1",cmd="GET"} 3' in merged
+    assert 'rtpu_x_total{node="n2:2",cmd="GET"} 7' in merged
+    # Label-less samples get a fresh label set.
+    assert 'rtpu_up{node="n1:1"} 1' in merged
+    assert 'rtpu_up{node="n2:2"} 1' in merged
+    # ONE TYPE block per family (duplicate TYPE lines are a Prometheus
+    # parse error), with all nodes' samples under it.
+    assert merged.count("# TYPE rtpu_x_total counter") == 1
+    assert merged.count("# TYPE rtpu_up gauge") == 1
+    type_pos = merged.index("# TYPE rtpu_x_total counter")
+    up_pos = merged.index("# TYPE rtpu_up gauge")
+    for node in ("n1:1", "n2:2"):
+        sample = merged.index(f'rtpu_x_total{{node="{node}"')
+        assert type_pos < sample < up_pos
+
+
+def test_unreachable_node_degrades_to_node_up_zero():
+    # A port nothing listens on: the page still renders, with the
+    # member marked down instead of a 500.
+    fm = FederatedMetrics(["127.0.0.1:1"], timeout_s=0.5)
+    page = fm.render()
+    assert 'rtpu_federation_node_up{node="127.0.0.1:1"} 0' in page
+
+
+def test_federation_requires_targets():
+    with pytest.raises(ValueError):
+        FederatedMetrics([])
+
+
+def test_standalone_endpoint_over_fake_members():
+    """--federate mode wiring, no engine involved: two stub member
+    endpoints, one merged page."""
+    from redisson_tpu.obs.promhttp import MetricsHTTPServer
+
+    m1 = MetricsHTTPServer(lambda: PAGE_A)
+    m2 = MetricsHTTPServer(lambda: PAGE_B)
+    fed = start_federation_endpoint([
+        f"{m1.host}:{m1.port}", f"{m2.host}:{m2.port}",
+    ])
+    try:
+        with urllib.request.urlopen(
+            f"http://{fed.host}:{fed.port}/metrics", timeout=10
+        ) as r:
+            body = r.read().decode()
+        assert f'node="{m1.host}:{m1.port}"' in body
+        assert f'node="{m2.host}:{m2.port}"' in body
+        assert body.count("# TYPE rtpu_x_total counter") == 1
+        assert 'rtpu_federation_node_up' in body
+    finally:
+        fed.close()
+        m1.close()
+        m2.close()
+
+
+# -- 3-node supervisor federation (the CI cluster-smoke assertion) ----------
+
+
+@pytest.mark.slow
+def test_three_node_federated_metrics_and_fleet_merges():
+    """ISSUE 13 acceptance: the supervisor's federated endpoint serves
+    all three nodes' rtpu_* series under distinct node labels; the
+    cluster client merges SLOWLOG and INFO across nodes."""
+    from redisson_tpu.cluster.supervisor import ClusterSupervisor
+
+    sup = ClusterSupervisor(n_nodes=3, metrics=True).start()
+    try:
+        client = sup.client()
+        try:
+            # Traffic on every node (keyless commands fan out per node
+            # via _fanout; keyed traffic rides the slot split).
+            assert len(sup.metrics_addrs) == 3
+            for addr, r in client._fanout(
+                [b"CONFIG", b"SET", b"slowlog-log-slower-than", b"0"]
+            ).items():
+                assert not isinstance(r, Exception), (addr, r)
+            for i in range(30):
+                client.execute("SET", f"fed-key-{i}", f"v{i}")
+            fed = sup.start_federation()
+            assert sup.start_federation() is fed  # idempotent
+            with urllib.request.urlopen(
+                f"http://{fed.host}:{fed.port}/metrics", timeout=10
+            ) as r:
+                body = r.read().decode()
+            node_labels = {
+                "%s:%d" % a for a in sup.metrics_addrs
+            }
+            for label in node_labels:
+                # Every node's command counters appear under its label.
+                assert re.search(
+                    r'rtpu_resp_commands_total\{node="%s"'
+                    % re.escape(label), body
+                ), f"no series for {label}"
+                assert (
+                    f'rtpu_federation_node_up{{node="{label}"}} 1'
+                    in body
+                )
+            # Regrouped: one TYPE block for the command family.
+            assert body.count(
+                "# TYPE rtpu_resp_commands_total counter"
+            ) == 1
+            # Cross-node SLOWLOG merge: entries from all 3 nodes,
+            # newest-first, node-tagged.
+            merged = client.fleet_slowlog(-1)
+            nodes_seen = {e["node"] for e in merged}
+            assert len(nodes_seen) == 3, nodes_seen
+            ts = [e["ts"] for e in merged]
+            assert ts == sorted(ts, reverse=True)
+            assert all(e["duration_us"] >= 0 for e in merged)
+            # Bounded form returns the newest `count` across the fleet.
+            assert len(client.fleet_slowlog(5)) == 5
+            # Fleet INFO: per-node sections + summed ADDITIVE totals.
+            fi = client.fleet_info("stats")
+            assert len(fi["nodes"]) == 3
+            total = fi["totals"]["total_commands_processed"]
+            assert total >= 30
+            assert total == sum(
+                int(n["total_commands_processed"])
+                for n in fi["nodes"].values()
+            )
+            # Non-additive numerics never enter totals (review
+            # regression: summing an uptime/port across nodes is a lie).
+            full = client.fleet_info()
+            assert "uptime_in_seconds" in next(
+                iter(full["nodes"].values())
+            )
+            assert "uptime_in_seconds" not in full["totals"]
+            assert "maxclients" not in full["totals"]
+            assert "trace_sample_rate" not in full["totals"]
+        finally:
+            client.close()
+    finally:
+        assert sup.shutdown()
+    # Federation server is torn down with the supervisor.
+    assert sup._federation is None
+
+
+@pytest.mark.slow
+def test_federate_cli_mode():
+    """`python -m redisson_tpu --federate ... --metrics-port N` serves
+    the merged page without booting an engine."""
+    import socket
+    import subprocess
+    import sys
+
+    from redisson_tpu.obs.promhttp import MetricsHTTPServer
+
+    member = MetricsHTTPServer(lambda: PAGE_A)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "redisson_tpu",
+         "--federate", f"{member.host}:{member.port}",
+         "--metrics-port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.monotonic() + 30
+        body = None
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=2
+                ) as r:
+                    body = r.read().decode()
+                break
+            except OSError:
+                time.sleep(0.2)
+        assert body is not None, "federation endpoint never came up"
+        assert f'node="{member.host}:{member.port}"' in body
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        member.close()

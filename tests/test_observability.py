@@ -416,6 +416,78 @@ def test_metrics_http_endpoint(tpu_client):
     conn.close()
 
 
+# -- metric-catalog doc sync (ISSUE 13 satellite) ---------------------------
+
+
+def test_metric_catalog_matches_doc():
+    """docs/observability.md's labeled-registry table is CANONICAL:
+    every family/gauge a fully-featured engine registers must appear in
+    the table, and every table row must exist in the registry — the
+    catalog can never drift again (it was missing the PR 10-12
+    families when ISSUE 13 landed)."""
+    import os
+
+    doc_path = os.path.join(
+        os.path.dirname(__file__), "..", "docs", "observability.md"
+    )
+    with open(doc_path) as f:
+        doc = f.read()
+    # Rows of the "Labeled registry" table only (the legacy aggregate
+    # table and prose mentions don't count).
+    section = doc.split("### Labeled registry", 1)[1]
+    section = section.split("\n## ", 1)[0]
+    doc_names = set()
+    for line in section.splitlines():
+        m = __import__("re").match(r"\|\s*`(rtpu_[a-z0-9_]+)`", line)
+        if m:
+            doc_names.add(m.group(1))
+    assert doc_names, "doc table parse found no rows"
+
+    # A fully-featured engine: coalescer + prewarmer + journal gauges.
+    cfg = Config().set_codec(LongCodec()).use_tpu_sketch(
+        min_bucket=64, prewarm=True
+    )
+    cl = redisson_tpu.create(cfg)
+    try:
+        reg = cl.obs.registry
+        registered = set(reg._families)
+        registered |= {name for name, _, _, _ in reg._callbacks}
+    finally:
+        cl.shutdown()
+
+    missing_from_doc = registered - doc_names
+    assert not missing_from_doc, (
+        f"families registered but absent from the "
+        f"docs/observability.md table: {sorted(missing_from_doc)}"
+    )
+    stale_in_doc = doc_names - registered
+    assert not stale_in_doc, (
+        f"doc table rows with no registered family: "
+        f"{sorted(stale_in_doc)}"
+    )
+
+
+def test_spanrecorder_public_reset():
+    """Satellite 6: the bench lifecycle reset is a PUBLIC SpanRecorder
+    surface — no more reaching into ``spans._phase_hist`` privates."""
+    obs = Observability()
+    s = obs.spans.start("op-x", 8)
+    s.stamp("d2h_fetch")
+    s.finish()
+    assert obs.spans.recent()
+    assert obs.spans._total_hist.items()
+    obs.spans.reset()
+    assert obs.spans.recent() == []
+    assert not obs.spans._total_hist.items()
+    assert not obs.spans._ops.items()
+    # Observability.reset_op_stats delegates to it (bench call site).
+    s2 = obs.spans.start("op-y", 1)
+    s2.stamp("d2h_fetch")
+    s2.finish()
+    obs.reset_op_stats()
+    assert obs.spans.recent() == []
+
+
 # -- overhead guard ---------------------------------------------------------
 
 
@@ -502,3 +574,92 @@ def test_metrics_overhead_under_ten_percent():
         if ratio <= 1.10:
             return
     raise AssertionError(f"instrumented submit >10% slower: {history}")
+
+
+@pytest.mark.slow
+def test_trace_off_overhead_under_five_percent():
+    """ISSUE 13 overhead guard, same harness as the ≤10% guard above:
+    with sampling OFF, the trace hooks on the submit path must cost
+    ≤5% over the same path with the trace module stubbed out entirely.
+
+    The stub arm replaces the coalescer's ``_trace`` module with a
+    bare ``ENABLED = False`` namespace — identical flag-read cost, but
+    any future regression that does REAL work on the off path (calling
+    current(), minting contexts, taking locks) shows up only in the
+    live arm and trips the ratio."""
+    import gc
+
+    from redisson_tpu.executor import coalescer as co_mod
+    from redisson_tpu.executor.coalescer import BatchCoalescer
+
+    assert not co_mod._trace.ENABLED, (
+        "a tracer leaked an armed sample rate into this test"
+    )
+
+    class _Lazy:
+        def __init__(self, v):
+            self._v = v
+
+        def result(self):
+            return self._v
+
+    def dispatch(cols):
+        return _Lazy(np.concatenate(cols))
+
+    class _Stub:
+        ENABLED = False
+
+    arr = np.arange(64, dtype=np.int64)
+    N = 2000
+
+    def make():
+        return BatchCoalescer(
+            batch_window_us=30_000_000, max_batch=1 << 22,
+            max_queued_ops=1 << 24, obs=Observability(),
+        )
+
+    def round_time(c):
+        t0 = time.perf_counter()
+        for _ in range(N):
+            c.submit(("op",), dispatch, (arr,), 64, tenant="t")
+        return time.perf_counter() - t0
+
+    def measure():
+        live, stubbed = [], []
+        coalescers = []
+        real = co_mod._trace
+        gc.disable()
+        try:
+            for r in range(12):
+                ca, cb = make(), make()
+                coalescers += [ca, cb]
+                round_time(ca)
+                round_time(cb)
+                if r % 2 == 0:
+                    co_mod._trace = real
+                    live.append(round_time(ca))
+                    co_mod._trace = _Stub
+                    stubbed.append(round_time(cb))
+                else:
+                    co_mod._trace = _Stub
+                    stubbed.append(round_time(cb))
+                    co_mod._trace = real
+                    live.append(round_time(ca))
+        finally:
+            co_mod._trace = real
+            gc.enable()
+            for c in coalescers:
+                c.shutdown()
+        return stubbed, live
+
+    history = []
+    for _ in range(4):
+        stubbed, live = measure()
+        ratio = min(q / p for p, q in zip(stubbed, live))
+        ratio = min(ratio, min(live) / min(stubbed))
+        history.append(ratio)
+        if ratio <= 1.05:
+            return
+    raise AssertionError(
+        f"sampling-off tracing >5% over stubbed hooks: {history}"
+    )

@@ -21,7 +21,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _EXPECT_RE = re.compile(r"#\s*rtpulint-expect:\s*(RT\d{3})")
 
 CHECKED_RULES = ("RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
-                 "RT007", "RT008", "RT009")
+                 "RT007", "RT008", "RT009", "RT011")
 
 
 def _expected(path):

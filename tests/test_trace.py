@@ -1,0 +1,626 @@
+"""Fleet telemetry plane (ISSUE 13): distributed tracing (obs/trace.py),
+the LATENCY monitor (obs/latency.py), MONITOR, the RTPU.TRACE wire
+prelude, bounded-store churn guards, and the slow-marked 3-node
+subprocess trace test (one trace across client legs, per-node serving
+spans, and device launches)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.codecs import LongCodec
+from redisson_tpu.obs import Observability
+from redisson_tpu.obs import trace as trace_mod
+from redisson_tpu.obs.latency import MAX_EVENTS, MAX_SAMPLES, LatencyMonitor
+from redisson_tpu.obs.trace import Tracer
+from redisson_tpu.serve.resp import RespServer
+
+from test_resp_server import RespClient
+
+
+# -- tracer core ------------------------------------------------------------
+
+
+def test_sampling_off_is_disabled_and_free():
+    t = Tracer()
+    assert t.sample_rate == 0.0
+    # rate 0 -> maybe_start never samples.
+    assert t.maybe_start("x") is None
+    with pytest.raises(ValueError):
+        t.set_sample_rate(1.5)
+    with pytest.raises(ValueError):
+        t.set_sample_rate(-0.1)
+
+
+def test_head_sampling_and_span_recording():
+    t = Tracer(sample_rate=1.0)
+    try:
+        assert trace_mod.ENABLED is True
+        root = t.maybe_start("root")
+        assert root is not None
+        assert len(root.trace_id) == 32 and len(root.span_id) == 16
+        child = t.start_child(root, "child")
+        child.annotate("k", 7)
+        child.end()
+        root.end()
+        traces = t.traces()
+        assert list(traces) == [root.trace_id]
+        spans = traces[root.trace_id]
+        assert [s["name"] for s in spans] == ["child", "root"]
+        assert spans[0]["parent_id"] == root.span_id
+        assert spans[0]["attrs"]["k"] == 7
+        assert spans[1]["parent_id"] == ""
+        # JSON wire form round-trips.
+        doc = json.loads(t.traces_json()[0])
+        assert doc["trace_id"] == root.trace_id
+    finally:
+        t.set_sample_rate(0.0)
+    assert trace_mod.ENABLED is False
+
+
+def test_forced_span_ignores_local_rate():
+    """Head-based sampling: a remote hop's decision binds this process
+    even with local sampling off."""
+    t = Tracer()  # rate 0
+    span = t.start("hop", "ab" * 16, "cd" * 8)
+    span.end()
+    assert t.traces("ab" * 16)
+
+
+def test_scope_nesting_and_current():
+    t = Tracer(sample_rate=1.0)
+    try:
+        a = t.maybe_start("a")
+        b = t.maybe_start("b")
+        assert trace_mod.current() is None
+        with trace_mod.scope(a.ctx()) as ca:
+            assert trace_mod.current() is ca
+            with trace_mod.scope(b.ctx()) as cb:
+                assert trace_mod.current() is cb
+            assert trace_mod.current() is ca
+        assert trace_mod.current() is None
+        a.end()
+        b.abandon()
+    finally:
+        t.set_sample_rate(0.0)
+
+
+def test_trace_ring_hard_bound_under_churn():
+    """ISSUE 13 satellite: 100k-op churn cannot grow the span ring past
+    its bound (no RT006-class leak)."""
+    t = Tracer(max_spans=256)
+    ctx = trace_mod.TraceContext(t, "ff" * 16, "ee" * 8)
+    for i in range(100_000):
+        t.record_span(ctx, f"n{i}", 0.0, 0.001)
+    assert len(t.spans()) == 256
+    assert t.evicted == 100_000 - 256
+    st = t.stats()
+    assert st["spans"] == 256 and st["max_spans"] == 256
+    t.reset()
+    assert t.spans() == []
+
+
+def test_latency_monitor_semantics_and_bounds():
+    lat = LatencyMonitor()
+    # Disarmed (threshold 0): records nothing, one-compare cheap.
+    assert not lat.record("command", 5000)
+    assert lat.latest() == []
+    lat.set_threshold_ms(100)
+    assert not lat.record("command", 99)  # below threshold
+    assert lat.record("command", 150)
+    assert lat.record("command", 300)
+    ((name, ts, last, mx),) = lat.latest()
+    assert name == "command" and last == 300 and mx == 300
+    assert [ms for _, ms in lat.history("command")] == [150, 300]
+    # DOCTOR mentions the event and advice.
+    assert "command" in lat.doctor()
+    assert lat.reset("command") == 1
+    assert lat.history("command") == []
+    # 100k-op churn: per-event ring and event-name space both bounded.
+    for i in range(100_000):
+        lat.record(f"evt-{i % 100}", 200 + i % 7)
+    st = lat.stats()
+    assert st["events"] <= MAX_EVENTS
+    assert st["samples"] <= MAX_EVENTS * MAX_SAMPLES
+    with pytest.raises(ValueError):
+        lat.set_threshold_ms(-1)
+
+
+def test_observability_bundle_wires_telemetry():
+    obs = Observability(trace_sample_rate=0.0, latency_threshold_ms=0)
+    assert obs.trace.sample_rate == 0.0
+    assert obs.latency.threshold_ms == 0
+    # reset_op_stats rides the PUBLIC SpanRecorder.reset (satellite 6)
+    # and clears the trace ring too.
+    span = obs.spans.start("op", 4)
+    span.stamp("d2h_fetch")
+    span.finish()
+    assert obs.spans.recent()
+    obs.reset_op_stats()
+    assert obs.spans.recent() == []
+    assert obs.trace.spans() == []
+
+
+# -- engine stitching -------------------------------------------------------
+
+
+@pytest.fixture
+def traced_client():
+    cfg = Config().set_codec(LongCodec()).use_tpu_sketch(
+        batch_window_us=100, min_bucket=64
+    )
+    cfg.trace_sample_rate = 1.0
+    cl = redisson_tpu.create(cfg)
+    yield cl
+    cl.obs.trace.set_sample_rate(0.0)
+    cl.shutdown()
+
+
+def test_direct_api_trace_links_launch_phases(traced_client):
+    cl = traced_client
+    with cl.trace("batch") as span:
+        assert span is not None
+        bf = cl.get_bloom_filter("tr-bf")
+        bf.try_init(10_000, 0.01)
+        bf.add_all(np.arange(256, dtype=np.uint64))
+    traces = cl.get_metrics()["traces"]
+    spans = traces[span.trace_id]
+    names = [s["name"] for s in spans]
+    assert "batch" in names
+    launches = [s for s in spans if s["name"].startswith("launch:")]
+    assert launches, names
+    for ls in launches:
+        assert ls["parent_id"] == span.span_id  # parent link intact
+        assert ls["attrs"]["links"] >= 1
+        assert "device_dispatch_us" in ls["attrs"]
+
+
+def test_fused_launch_records_n_parent_links():
+    """Two traced requests whose ops ride ONE launch: the launch span
+    lands in BOTH traces, each copy reporting links=2 (the
+    cross-request batch-fusion economics, visible per trace)."""
+    # Fixed long window (adaptive OFF — the controller would shrink it
+    # under light load and flush request 1 before request 2 submits).
+    cfg = Config().set_codec(LongCodec()).use_tpu_sketch(
+        batch_window_us=300_000, adaptive_window=False, min_bucket=64
+    )
+    cfg.trace_sample_rate = 1.0
+    cl = redisson_tpu.create(cfg)
+    try:
+        bf = cl.get_bloom_filter("fuse-bf")
+        bf.try_init(10_000, 0.01)
+        bf.add_all(np.arange(64, dtype=np.uint64))  # pool/ladder warm
+        tids = []
+        futs = []
+        for i in range(2):
+            with cl.trace("req") as span:
+                assert span is not None
+                tids.append(span.trace_id)
+                futs.append(
+                    bf.contains_all_async(
+                        np.arange(10_000 + i * 64,
+                                  10_000 + i * 64 + 64,
+                                  dtype=np.uint64)
+                    )
+                )
+        for f in futs:
+            f.result()
+        # Launch spans land from the COMPLETER thread — poll briefly.
+        deadline = time.monotonic() + 5.0
+        fused: list = []
+        traces: dict = {}
+        while not fused and time.monotonic() < deadline:
+            traces = cl.obs.trace.traces()
+            fused = [
+                s
+                for tid in tids
+                for s in traces.get(tid, ())
+                if s["name"].startswith("launch:")
+                and s["attrs"]["links"] >= 2
+            ]
+            if not fused:
+                time.sleep(0.02)
+        assert fused, {
+            t: [s["name"] for s in ss] for t, ss in traces.items()
+        }
+        # The fused launch appears in EVERY parent's trace.
+        assert len({s["trace_id"] for s in fused}) == len(set(tids))
+    finally:
+        cl.obs.trace.set_sample_rate(0.0)
+        cl.shutdown()
+
+
+def test_coalesced_submits_link_once_per_trace():
+    """One traced request whose K submits coalesce into one launch must
+    record ONE launch span, not K duplicates (review regression: the
+    per-submit link had no dedup and flooded the ring)."""
+    cfg = Config().set_codec(LongCodec()).use_tpu_sketch(
+        batch_window_us=300_000, adaptive_window=False, min_bucket=64
+    )
+    cfg.trace_sample_rate = 1.0
+    cl = redisson_tpu.create(cfg)
+    try:
+        bf = cl.get_bloom_filter("dedup-bf")
+        bf.try_init(10_000, 0.01)
+        bf.add_all(np.arange(64, dtype=np.uint64))
+        with cl.trace("one") as span:
+            futs = [
+                bf.contains_all_async(
+                    np.arange(20_000 + i * 64, 20_000 + i * 64 + 64,
+                              dtype=np.uint64)
+                )
+                for i in range(4)
+            ]
+        for f in futs:
+            f.result()
+        deadline = time.monotonic() + 5.0
+        launches: list = []
+        while time.monotonic() < deadline:
+            spans = cl.obs.trace.traces(span.trace_id).get(
+                span.trace_id, []
+            )
+            launches = [
+                s for s in spans if s["name"].startswith("launch:")
+            ]
+            if launches:
+                break
+            time.sleep(0.02)
+        assert launches
+        # 4 submits, shared segments: one span per LAUNCH, with no
+        # duplicate (trace, parent) pairs.
+        keys = [(s["name"], s["parent_id"]) for s in launches]
+        assert len(keys) == len(set(keys)), keys
+        assert all(s["attrs"]["links"] == 1 for s in launches)
+    finally:
+        cl.obs.trace.set_sample_rate(0.0)
+        cl.shutdown()
+
+
+def test_execute_many_crossslot_does_not_strand_root_span():
+    """Client-side CrossSlotError aborts the batch before anything
+    executes — no sampled-but-never-recorded root span may leak
+    (review regression, the RT011 class)."""
+    from redisson_tpu.cluster.client import ClusterClient, CrossSlotError
+
+    cc = ClusterClient.__new__(ClusterClient)  # no live cluster needed
+    cc.tracer = Tracer(sample_rate=1.0)
+    try:
+        cc._slots = [None] * 16384
+        cc._seeds = [("127.0.0.1", 1)]
+        import threading as _th
+
+        cc._table_lock = _th.Lock()
+        cc._conns = {}
+        cc._pool = None
+        cc.obs = None
+        cc.stats = {"scatter_batches": 0, "scatter_legs": 0}
+        before = cc.tracer.sampled
+        with pytest.raises(CrossSlotError):
+            cc.execute_many([("MSET", "a", "1", "b", "2")])
+        # Routing failed before the root span was minted: nothing was
+        # sampled, nothing is stranded.
+        assert cc.tracer.sampled == before
+        assert cc.tracer.spans() == []
+    finally:
+        cc.tracer.set_sample_rate(0.0)
+
+
+# -- RESP wire surface ------------------------------------------------------
+
+
+@pytest.fixture
+def resp():
+    cl = redisson_tpu.create(Config())
+    srv = RespServer(cl)
+    conn = RespClient(srv.host, srv.port)
+    yield conn, srv, cl
+    cl.obs.trace.set_sample_rate(0.0)
+    srv.close()
+    cl.shutdown()
+
+
+def test_trace_commands_and_config_over_resp(resp):
+    conn, srv, cl = resp
+    # Off by default: INFO telemetry reports rate 0, TRACE GET empty.
+    info = conn.cmd("INFO", "telemetry").decode()
+    assert "trace_sample_rate:0" in info
+    assert "latency_monitor_threshold:0" in info
+    assert conn.cmd("TRACE", "GET") == []
+    # Arm via CONFIG SET; bounds are validated.
+    with pytest.raises(RuntimeError):
+        conn.cmd("CONFIG", "SET", "trace-sample-rate", "1.5")
+    with pytest.raises(RuntimeError):
+        conn.cmd("CONFIG", "SET", "trace-sample-rate", "nope")
+    assert conn.cmd("CONFIG", "SET", "trace-sample-rate", "1") == "OK"
+    assert conn.cmd("CONFIG", "GET", "trace-sample-rate") == [
+        b"trace-sample-rate", b"1",
+    ]
+    conn.cmd("SET", "tk", "tv")
+    assert conn.cmd("GET", "tk") == b"tv"
+    docs = [json.loads(d) for d in conn.cmd("TRACE", "GET")]
+    names = [s["name"] for d in docs for s in d["spans"]]
+    assert "resp:SET" in names and "resp:GET" in names
+    for d in docs:
+        for s in d["spans"]:
+            assert s["attrs"]["node"]  # node label rides every span
+    assert conn.cmd("TRACE", "LEN") >= 2
+    # TRACE SAMPLE mirrors CONFIG SET.
+    assert conn.cmd("TRACE", "SAMPLE", "0") == "OK"
+    assert conn.cmd("CONFIG", "GET", "trace-sample-rate") == [
+        b"trace-sample-rate", b"0",
+    ]
+    assert conn.cmd("TRACE", "RESET") == "OK"
+    assert conn.cmd("TRACE", "GET") == []
+    assert any(b"SAMPLE" in h for h in conn.cmd("TRACE", "HELP"))
+
+
+def test_rtpu_trace_prelude_is_one_shot(resp):
+    """The wire prelude forces the NEXT command into the remote trace
+    even with local sampling off, then burns (the ASKING shape)."""
+    conn, srv, cl = resp
+    tid, sid = "ab" * 16, "cd" * 8
+    assert conn.cmd("RTPU.TRACE", tid, sid) == "OK"
+    conn.cmd("SET", "pk", "pv")
+    conn.cmd("GET", "pk")  # NOT traced: the prelude was consumed
+    traces = cl.obs.trace.traces(tid)
+    assert list(traces) == [tid]
+    spans = traces[tid]
+    assert [s["name"] for s in spans] == ["resp:SET"]
+    assert spans[0]["parent_id"] == sid  # parent link intact
+    # Malformed preludes refuse.
+    with pytest.raises(RuntimeError):
+        conn.cmd("RTPU.TRACE", "x", sid)
+    with pytest.raises(RuntimeError):
+        conn.cmd("RTPU.TRACE", tid)
+
+
+def test_prelude_passes_over_asking(resp):
+    """The migration pump sends RTPU.TRACE + ASKING + <cmd>: ASKING is
+    itself a prelude and must not consume the trace context — the
+    traced hop is the command AFTER both (review regression)."""
+    conn, srv, cl = resp
+    tid, sid = "12" * 16, "34" * 8
+    assert conn.cmd("RTPU.TRACE", tid, sid) == "OK"
+    # Non-cluster server refuses ASKING — the prelude must survive even
+    # an ERRORED ASKING (the burn block skips it by name, not outcome).
+    with pytest.raises(RuntimeError):
+        conn.cmd("ASKING")
+    conn.cmd("SET", "ask-k", "v")
+    spans = cl.obs.trace.traces(tid).get(tid, [])
+    assert [s["name"] for s in spans] == ["resp:SET"], spans
+    assert spans[0]["parent_id"] == sid
+
+
+def test_gc_of_armed_tracer_recomputes_enabled():
+    """Dropping an armed tracer without disarming it must not leave the
+    module guard stuck True (review regression: every hook in the
+    process would pay the traced path forever)."""
+    import gc
+
+    t = Tracer(sample_rate=1.0)
+    assert trace_mod.ENABLED is True
+    del t
+    gc.collect()
+    assert trace_mod.ENABLED is False
+
+
+def test_slowlog_captures_trace_id(resp):
+    conn, srv, cl = resp
+    assert conn.cmd("CONFIG", "SET", "slowlog-log-slower-than", "0") == "OK"
+    # Untraced entries keep the classic 6-element shape.
+    conn.cmd("PING")
+    entry = conn.cmd("SLOWLOG", "GET", "1")[0]
+    assert len(entry) == 6
+    assert conn.cmd("CONFIG", "SET", "trace-sample-rate", "1") == "OK"
+    conn.cmd("SET", "sk", "sv")
+    entries = conn.cmd("SLOWLOG", "GET", "-1")
+    traced = [e for e in entries if len(e) == 7 and e[3][0] == b"SET"]
+    assert traced, entries
+    tid = traced[0][6].decode()
+    assert cl.obs.trace.traces(tid)  # the id resolves in the ring
+    conn.cmd("TRACE", "SAMPLE", "0")
+
+
+def test_latency_monitor_over_resp(resp):
+    conn, srv, cl = resp
+    # Disarmed: DOCTOR says so; LATEST empty.
+    assert "disabled" in conn.cmd("LATENCY", "DOCTOR").decode()
+    assert conn.cmd("LATENCY", "LATEST") == []
+    with pytest.raises(RuntimeError):
+        conn.cmd("CONFIG", "SET", "latency-monitor-threshold", "-5")
+    assert conn.cmd(
+        "CONFIG", "SET", "latency-monitor-threshold", "10"
+    ) == "OK"
+    conn.cmd("DEBUG", "SLEEP", "0.05")
+    conn.cmd("PING")  # under threshold: no event
+    rows = conn.cmd("LATENCY", "LATEST")
+    assert rows and rows[0][0] == b"command"
+    assert rows[0][2] >= 50 and rows[0][3] >= rows[0][2]
+    hist = conn.cmd("LATENCY", "HISTORY", "command")
+    assert len(hist) == 1 and hist[0][1] >= 50
+    assert conn.cmd("LATENCY", "HISTORY", "absent") == []
+    info = conn.cmd("INFO", "telemetry").decode()
+    assert "latency_monitor_threshold:10" in info
+    assert conn.cmd("LATENCY", "RESET", "command") == 1
+    assert conn.cmd("LATENCY", "LATEST") == []
+    assert any(b"DOCTOR" in h for h in conn.cmd("LATENCY", "HELP"))
+
+
+def test_latency_fsync_stall_event_via_chaos(tmp_path):
+    """Acceptance criterion: LATENCY HISTORY fsync-stall returns events
+    after a chaos-injected journal.fsync latency fault."""
+    cfg = Config().set_codec(LongCodec()).use_tpu_sketch(min_bucket=64)
+    cfg.journal_dir = str(tmp_path / "journal")
+    cfg.journal_fsync = "always"
+    cfg.latency_monitor_threshold_ms = 20
+    cl = redisson_tpu.create(cfg)
+    srv = RespServer(cl)
+    conn = RespClient(srv.host, srv.port)
+    try:
+        assert conn.cmd(
+            "DEBUG", "INJECT", "journal.fsync", "latency", "1", "7",
+            "0.05",
+        ) == "OK"
+        bf = cl.get_bloom_filter("fs-bf")
+        bf.try_init(1000, 0.01)
+        bf.add(1)  # acked only after the (stalled) fsync
+        conn.cmd("WAIT", "0", "0")  # explicit fence rides another fsync
+        hist = conn.cmd("LATENCY", "HISTORY", "fsync-stall")
+        assert hist, conn.cmd("LATENCY", "LATEST")
+        assert all(ms >= 20 for _, ms in hist)
+        rows = {r[0]: r for r in conn.cmd("LATENCY", "LATEST")}
+        assert b"fsync-stall" in rows
+    finally:
+        conn.cmd("DEBUG", "INJECT", "OFF")
+        srv.close()
+        cl.shutdown()
+
+
+def test_monitor_streams_other_connections(resp):
+    conn, srv, cl = resp
+    mon = RespClient(srv.host, srv.port)
+    try:
+        assert mon.cmd("MONITOR") == "OK"
+        conn.cmd("SET", "mk", "mval")
+        conn.cmd("GET", "mk")
+        # Monitor lines are +simple pushes; read two.
+        lines = [mon._read_reply(), mon._read_reply()]
+        assert any('"SET" "mk" "mval"' in ln for ln in lines), lines
+        assert any('"GET" "mk"' in ln for ln in lines), lines
+        # Credentials are redacted on the stream.
+        with pytest.raises(RuntimeError):
+            conn.cmd("AUTH", "monitor-secret")
+        line = mon._read_reply()
+        assert "monitor-secret" not in line and "(redacted)" in line
+        info = conn.cmd("INFO", "telemetry").decode()
+        assert "monitors:1" in info
+        # Drain the INFO command's own feed line before leaving monitor
+        # mode (the stream echoes it too).
+        assert '"INFO"' in mon._read_reply()
+        # RESET leaves monitor mode; subsequent commands are not fed.
+        assert mon.cmd("RESET") == "RESET"
+        conn.cmd("SET", "mk2", "v2")
+        assert mon.cmd("PING") == "PONG"  # no buffered pushes in between
+    finally:
+        mon.close()
+
+
+def test_monitor_disables_fusion_while_attached(resp):
+    conn, srv, cl = resp
+    assert not srv._monitors
+    mon = RespClient(srv.host, srv.port)
+    try:
+        assert mon.cmd("MONITOR") == "OK"
+        assert srv._monitors
+    finally:
+        mon.close()
+    # Disconnect reclaims the monitor slot (poll: teardown is async).
+    deadline = time.monotonic() + 5.0
+    while srv._monitors and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not srv._monitors
+
+
+# -- 3-node subprocess scatter/gather trace (acceptance) --------------------
+
+
+def _slot_key(prefix, node_idx, n_nodes=3):
+    """A key whose CRC16 slot lands in node ``node_idx``'s contiguous
+    partition (the supervisor's even split)."""
+    from redisson_tpu.cluster.slots import NSLOTS, key_slot
+
+    per = NSLOTS // n_nodes
+    lo = node_idx * per
+    hi = NSLOTS - 1 if node_idx == n_nodes - 1 else lo + per - 1
+    for i in range(100_000):
+        k = f"{prefix}-{i}".encode()
+        if lo <= key_slot(k) <= hi:
+            return k
+    raise AssertionError("no key found for node partition")
+
+
+@pytest.mark.slow
+def test_three_node_scatter_gather_yields_one_trace():
+    """ISSUE 13 acceptance: a 3-node execute_many under the supervisor
+    yields ONE trace whose spans cover client legs, per-node serving
+    spans, and device launches, with parent links intact across the
+    wire."""
+    from redisson_tpu.cluster.supervisor import ClusterSupervisor
+
+    sup = ClusterSupervisor(n_nodes=3).start()
+    tracer = Tracer(sample_rate=1.0)
+    try:
+        client = sup.client(tracer=tracer)
+        try:
+            keys = [_slot_key("trace", i) for i in range(3)]
+            for k in keys:
+                r = client.execute("BF.RESERVE", k, "0.01", "1000")
+                assert r == b"OK" or r == "OK" or not isinstance(
+                    r, Exception
+                )
+            tracer.reset()  # the batch below is the traced exemplar
+            cmds = [["BF.ADD", k, b"item-%d" % i]
+                    for i, k in enumerate(keys * 4)]
+            replies = client.execute_many(cmds)
+            assert all(not isinstance(r, Exception) for r in replies)
+            roots = [
+                s for s in tracer.spans()
+                if s["name"] == "client:execute_many"
+            ]
+            assert roots, tracer.spans()
+            tid = roots[-1]["trace_id"]
+            # The per-node rings fill asynchronously (completer threads
+            # finish launch spans) — poll briefly.
+            merged = {}
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                merged = client.fleet_traces(tid).get(tid, [])
+                if (
+                    sum(1 for s in merged
+                        if s["name"].startswith("leg:")) >= 3
+                    and sum(1 for s in merged
+                            if s["name"] == "resp:BF.ADD") >= 3
+                    and any(s["name"].startswith("launch:")
+                            for s in merged)
+                ):
+                    break
+                time.sleep(0.2)
+            by_id = {s["span_id"]: s for s in merged}
+            root = next(
+                s for s in merged if s["name"] == "client:execute_many"
+            )
+            legs = [s for s in merged if s["name"].startswith("leg:")]
+            ingresses = [
+                s for s in merged if s["name"] == "resp:BF.ADD"
+            ]
+            launches = [
+                s for s in merged if s["name"].startswith("launch:")
+            ]
+            assert len(legs) == 3, [s["name"] for s in merged]
+            assert len(ingresses) >= 3
+            assert launches
+            # ONE trace end to end.
+            assert {s["trace_id"] for s in merged} == {tid}
+            # Parent links intact across the wire: leg -> root,
+            # ingress -> its leg, launch -> its ingress.
+            leg_ids = {s["span_id"] for s in legs}
+            for leg in legs:
+                assert leg["parent_id"] == root["span_id"]
+            nodes = set()
+            for ing in ingresses:
+                assert ing["parent_id"] in leg_ids
+                nodes.add(ing["attrs"]["node"])
+            assert len(nodes) == 3  # one serving span per node
+            ingress_ids = {s["span_id"] for s in ingresses}
+            for ls in launches:
+                assert ls["parent_id"] in ingress_ids
+                assert "device_dispatch_us" in ls["attrs"]
+        finally:
+            client.close()
+    finally:
+        tracer.set_sample_rate(0.0)
+        assert sup.shutdown()
