@@ -125,14 +125,28 @@ def bench_bloom_contains(client):
     iters = max(2, TOTAL // B)
     passes = []
     pass_rt_ms = []
+    # Phase BRACKETS on the headline itself (ROADMAP measurement-debt
+    # note, ISSUE 14 satellite): each measured pass travels with
+    # [pre, post] samples of BOTH link probes, so an r03->r05-style
+    # headline decline is attributable to the link phase from
+    # BENCH.json alone — the config4 pass-link discipline applied to
+    # the headline keys.
+    pass_link = []
+    bracket = measure_pass_link_sample()
     for _pass in range(3):
         passes.append(run_pass(B, iters))
-        pass_rt_ms.append(measure_rt_sample())
+        post = measure_pass_link_sample()
+        pass_link.append({
+            k: [bracket[k], post[k]]
+            for k in ("link_h2d_put_rt_ms", "link_resident_rt_ms")
+        })
+        pass_rt_ms.append(post["link_resident_rt_ms"])
+        bracket = post
 
     # Measured FPP: probe keys strictly outside the loaded range.
     fp_keys = rng.integers(3 * n_load, 8 * n_load, size=1 << 17).astype(np.uint64)
     fpp = float(np.mean(bf.contains_each(fp_keys)))
-    return max(passes), fpp, passes, B, iters * B, pass_rt_ms
+    return max(passes), fpp, passes, B, iters * B, pass_rt_ms, pass_link
 
 
 def bench_hll_pfadd(client):
@@ -1497,6 +1511,150 @@ def bench_config10_trace(_make_client):
         sup.shutdown()
 
 
+def bench_config11_tiered(make_client):
+    """Config 11 — tiered sketch storage (ISSUE 14): a zipf(1.1)
+    tenant population 100x the configured device-row budget served
+    through the residency ladder (DEVICE rows as a cache over host
+    golden mirrors over disk blobs).
+
+    Three claims, measured:
+    - the WHOLE population serves WITHOUT ERROR (config11_errors=0 —
+      cold tenants answer from host mirrors, not exhaustion errors);
+    - after the ladder converges, hot-set throughput is the device's:
+      the same hot-only pass runs against an ALL-RESIDENT client
+      holding only the hot set (no budget, pre-ISSUE-14 shape), and
+      config11_hot_ratio = resident/tiered must stay near 1 (the
+      acceptance bar is 1.25x);
+    - pay-for-use: the ladder OFF path is the headline/config4 runs
+      themselves (budget 0 arms nothing — no thread, no alloc gate),
+      so cross-PR BENCH.json trajectories ARE the no-regression arm.
+
+    Residency tier counters travel in config11_residency so the JSON
+    shows the ladder actually moved (demotions from budget pressure,
+    promotions of the hot set, host-tier serves for the cold tail)."""
+    import shutil
+    import tempfile
+
+    BUDGET = 16                 # device-row budget (fast tier)
+    POP = 100 * BUDGET          # tenant population: 100x device capacity
+    N_HOT = 8                   # zipf(1.1) head the ladder must keep fast
+    MIX_STEPS = 1024            # mixed-phase ops across the population
+    MIX_B = 128                 # keys per mixed op
+    HOT_B = 1 << 14             # keys per hot-pass op
+    HOT_PASSES = 4
+    blob_dir = tempfile.mkdtemp(prefix="rtpu-bench-resid-")
+    out = {
+        "config11_tiered_population": POP,
+        "config11_device_rows_budget": BUDGET,
+    }
+    rng = np.random.default_rng(11)
+    # zipf(1.1) tenant stream; the measured hot set is the stream's
+    # actual head (what the heat tracker sees), not an assumption.
+    stream = (rng.zipf(1.1, size=MIX_STEPS) % POP).astype(np.int64)
+    counts = np.bincount(stream, minlength=POP)
+    hot_ids = np.argsort(counts)[::-1][:N_HOT]
+
+    def hot_pass(filters):
+        keys = [
+            rng.integers(0, 1 << 18, HOT_B).astype(np.uint64)
+            for _ in range(HOT_PASSES)
+        ]
+        for f in filters:  # warm (compile + promote) outside the clock
+            f.contains_all_async(keys[0]).result(timeout=600.0)
+        t0 = time.perf_counter()
+        for kp in keys:
+            futs = [f.contains_all_async(kp) for f in filters]
+            for fu in futs:
+                fu.result(timeout=600.0)
+        return HOT_PASSES * len(filters) * HOT_B / (
+            time.perf_counter() - t0
+        )
+
+    try:
+        # -- tiered arm: POP tenants over a BUDGET-row fast tier ------
+        client = make_client(
+            coalesce=True,
+            residency_device_rows=BUDGET,
+            residency_dir=blob_dir,
+            # Host cap low enough that the cold tail spills — the
+            # bench proves all THREE tiers serve, not two.
+            residency_max_host_bytes=POP * 256,
+            residency_heat_half_life_s=30.0,
+        )
+        eng = client._engine
+        filters = []
+        for i in range(POP):
+            bf = client.get_bloom_filter(f"t11-{i}")
+            bf.try_init(10_000, 0.01)
+            filters.append(bf)
+        errors = 0
+        from collections import deque
+        futs = deque()
+        t0 = time.perf_counter()
+        for step, t in enumerate(stream):
+            keys = rng.integers(0, 1 << 18, MIX_B).astype(np.uint64)
+            if step % 3 == 0:
+                futs.append(filters[t].add_all_async(keys))
+            else:
+                futs.append(filters[t].contains_all_async(keys))
+            while futs and futs[0].done():
+                try:
+                    futs.popleft().result()
+                except Exception:
+                    errors += 1
+        for fu in futs:
+            try:
+                fu.result(timeout=600.0)
+            except Exception:
+                errors += 1
+        mixed_dt = time.perf_counter() - t0
+        out["config11_tiered_mixed_ops_per_sec"] = round(
+            MIX_STEPS * MIX_B / mixed_dt
+        )
+        out["config11_errors"] = errors
+        # Let the ladder converge (the background thread is live too;
+        # driving maintain() here bounds the bench's wall-clock
+        # instead of sleeping on the interval).
+        for _ in range(8):
+            eng.residency.maintain()
+        out["config11_tiered_hot_ops_per_sec"] = round(
+            hot_pass([filters[i] for i in hot_ids])
+        )
+        st = eng.residency.stats()
+        out["config11_residency"] = {
+            k: st[k] for k in (
+                "device_rows_used", "host_objects", "host_bytes",
+                "disk_objects", "disk_bytes", "promotions",
+                "demotions", "spills", "loads", "host_serves",
+            )
+        }
+        out["config11_hot_device_resident"] = sum(
+            1 for i in hot_ids
+            if eng.registry.lookup(f"t11-{i}").row >= 0
+        )
+        client.shutdown()
+
+        # -- all-resident arm: ONLY the hot set, no ladder ------------
+        client = make_client(coalesce=True)
+        res_filters = []
+        for i in hot_ids:
+            bf = client.get_bloom_filter(f"t11-{i}")
+            bf.try_init(10_000, 0.01)
+            res_filters.append(bf)
+        out["config11_resident_hot_ops_per_sec"] = round(
+            hot_pass(res_filters)
+        )
+        client.shutdown()
+        out["config11_hot_ratio"] = round(
+            out["config11_resident_hot_ops_per_sec"]
+            / max(1, out["config11_tiered_hot_ops_per_sec"]), 3
+        )
+        out["config11_pass_link"] = measure_pass_link_sample()
+    finally:
+        shutil.rmtree(blob_dir, ignore_errors=True)
+    return out
+
+
 def bench_config3_bitset(client):
     """Config 3: 2^30-bit RBitSet, batched get/set (raw bitmap path).
 
@@ -1808,6 +1966,7 @@ def main():
         headline_B,
         ops_per_sync,
         headline_pass_rt_ms,
+        headline_pass_link,
     ) = bench_bloom_contains(client)
     hll_ops = bench_hll_pfadd(client)
     bitset_ops = bench_config3_bitset(client)
@@ -1886,6 +2045,15 @@ def main():
         trace_stats = bench_config10_trace(make_client)
     except Exception as e:  # pragma: no cover - env-dependent spawn
         trace_stats = {"config10_trace_error": repr(e)}
+    # Tiered residency (ISSUE 14): config11_tiered — a zipf(1.1)
+    # population 100x the device-row budget through the ladder, hot-set
+    # throughput vs an all-resident hot-set-only run, tier transition
+    # counters.  Isolated: a failure degrades to an attributed error
+    # key, never a dead bench.
+    try:
+        tiered_stats = bench_config11_tiered(make_client)
+    except Exception as e:  # pragma: no cover - env-dependent
+        tiered_stats = {"config11_tiered_error": repr(e)}
     host_ops = measure_host_baseline()
 
     # vs_baseline: the bench env ships no redis-server, so the Redis-backed
@@ -1907,6 +2075,12 @@ def main():
                     "headline_batch_ops": headline_B,
                     "ops_per_sync": ops_per_sync,
                     "headline_pass_rt_ms": headline_pass_rt_ms,
+                    # Headline phase brackets (ISSUE 14 satellite):
+                    # [pre, post] link probes per measured pass — a
+                    # slow-link regression is attributed to the
+                    # environment phase, not the code, in the JSON
+                    # itself (ROADMAP measurement-debt note).
+                    "headline_pass_link": headline_pass_link,
                     "config4_passes": config4_passes,
                     # Warm/cold split (ISSUE 2): cold passes run while
                     # the AOT pre-warmer is still compiling; warm passes
@@ -1955,6 +2129,10 @@ def main():
                     # multi-node trace (client legs, per-node ingress,
                     # device-launch phases).
                     **trace_stats,
+                    # Tiered residency (ISSUE 14): config11_tiered —
+                    # population 100x device capacity, zero errors,
+                    # hot-set ratio vs all-resident, tier counters.
+                    **tiered_stats,
                     "hll_pfadd_ops_per_sec": round(hll_ops),
                     "config3_bitset_ops_per_sec": round(bitset_ops),
                     "config4_mixed_ops_per_sec": round(mixed_ops),

@@ -136,6 +136,10 @@ _ROLE_BY_PATH = (
     # serve-role bug surface RT001/RT002 were distilled from.
     ("cluster", "serve"),
     ("tenancy", "tenancy"),
+    # Residency ladder (ISSUE 14): transition code holds engine locks
+    # around device reads/writes and blob I/O — the engine-role RT001
+    # blocking-under-lock surface.
+    ("storage", "engine"),
     ("durability", "journal"),
     ("chaos", "chaos"),
     ("analysis", "analysis"),

@@ -54,7 +54,7 @@ def op_stream(seed: int, n: int):
             yield ("expire_far", None)  # TTL far in the future: replayed
 
 
-def build_client(directory: str, fsync: str):
+def build_client(directory: str, fsync: str, residency: bool = False):
     import redisson_tpu
     from redisson_tpu import Config
     from redisson_tpu.codecs import LongCodec
@@ -63,13 +63,25 @@ def build_client(directory: str, fsync: str):
     cfg.snapshot_dir = directory + "/snap"
     cfg.journal_dir = directory + "/journal"
     cfg.journal_fsync = fsync
+    if residency:
+        # Residency soak (ISSUE 14): blob dir armed; transitions are
+        # FORCED from the op stream (budget stays 0 — no background
+        # thread, so parent and child stay deterministic).
+        cfg.tpu_sketch.residency_dir = directory + "/blobs"
     return redisson_tpu.create(cfg)
 
 
-def apply_ops(client, seed: int, n: int, ack=None, snapshot_every: int = 0):
+def apply_ops(client, seed: int, n: int, ack=None, snapshot_every: int = 0,
+              residency_every: int = 0):
     """Apply the deterministic stream; calls ``ack(i)`` after each op's
     result resolves.  ``snapshot_every`` > 0 takes a mid-stream
-    snapshot (exercises snapshot-coordinated truncation under load)."""
+    snapshot (exercises snapshot-coordinated truncation under load);
+    ``residency_every`` > 0 forces a deterministic residency-ladder
+    transition (demote / demote+spill / promote, rotating over the four
+    objects) every that-many ops — the kill -9 can land mid-demotion or
+    mid-spill, which is exactly the window the ISSUE 14 soak proves
+    safe.  Transitions never change logical state (exact codecs), so
+    the golden comparison engine needs none of this."""
     bf = client.get_bloom_filter("soak-bf")
     bf.try_init(100_000, 0.01)
     h = client.get_hyper_log_log("soak-hll")
@@ -94,6 +106,17 @@ def apply_ops(client, seed: int, n: int, ack=None, snapshot_every: int = 0):
             client._engine.expire_at("soak-bs", time.time() + 3600.0)
         if ack is not None:
             ack(i)
+        if residency_every and (i + 1) % residency_every == 0:
+            rm = client._engine.residency
+            k = (i + 1) // residency_every
+            name = ("soak-bf", "soak-hll", "soak-bs", "soak-cms")[k % 4]
+            if k % 3 == 0:
+                rm.demote(name)
+            elif k % 3 == 1:
+                rm.demote(name)
+                rm.spill(name)
+            else:
+                rm.promote(name)
         if snapshot_every and (i + 1) % snapshot_every == 0:
             client._engine.snapshot(client.config.snapshot_dir)
 
@@ -105,8 +128,11 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--ops", type=int, default=400)
     ap.add_argument("--snapshot-every", type=int, default=0)
+    ap.add_argument("--residency-every", type=int, default=0)
     args = ap.parse_args(argv)
-    client = build_client(args.dir, args.fsync)
+    client = build_client(
+        args.dir, args.fsync, residency=args.residency_every > 0
+    )
 
     def ack(i: int) -> None:
         # One complete line per acked op; flush so the parent's pipe
@@ -117,7 +143,8 @@ def main(argv=None) -> int:
 
     print("READY", flush=True)
     apply_ops(client, args.seed, args.ops, ack=ack,
-              snapshot_every=args.snapshot_every)
+              snapshot_every=args.snapshot_every,
+              residency_every=args.residency_every)
     print("DONE", flush=True)
     client.shutdown()
     return 0

@@ -104,6 +104,16 @@ class RedissonTpuClient(CamelCompatMixin):
         # deadlock (AB-BA).
         self._engine.foreign_exists = self._grid.probe
         self._grid.foreign_exists = self._engine.probe
+        # Near-cache reach (ISSUE 14 satellite): grid scalar reads
+        # (XLEN, GEOPOS-class) ride the engine near cache under
+        # "grid:"-prefixed tenants; store-level identity changes must
+        # invalidate them (per-object mutators bump their own epochs).
+        nc = getattr(self._engine, "nearcache", None)
+        if nc is not None:
+            self._grid.on_invalidate = (
+                lambda name: nc.drop_object("grid:" + name)
+            )
+            self._grid.on_invalidate_all = nc.invalidate_all
         # Restore-on-create for the HOST keyspace too (the sketch side
         # restores inside its engine init): one snapshot dir carries the
         # whole logical keyspace — including through the engine's

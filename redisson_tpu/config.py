@@ -140,6 +140,28 @@ class TpuSketchConfig:
         self.tenant_rate_limit = 0
         self.tenant_burst_ops = 0
         self.tenant_max_inflight = 0
+        # Tiered sketch storage (ISSUE 14): the heat-based residency
+        # ladder (storage/residency.py) — device rows become a CACHE
+        # over host golden mirrors over per-object disk blobs, so the
+        # addressable tenant population is bounded by host+disk, not
+        # HBM.  ``residency_device_rows``: the fast-tier row budget
+        # across all sketch pools (0 = unlimited, ladder passive —
+        # every tenant stays device-resident, the pre-ISSUE-14
+        # behavior; pay-for-use).  Cold rows demote to exact host
+        # mirrors; frozen mirrors spill to ``residency_dir`` once host
+        # bytes exceed ``residency_max_host_bytes`` (0 = never spill);
+        # ``residency_max_disk_bytes`` caps the blob tier (0 =
+        # unlimited); objects whose decayed access heat (half-life
+        # ``residency_heat_half_life_s``) reaches
+        # ``residency_promote_heat`` promote back through the prewarmed
+        # pools, admission-aware.  All budgets live via CONFIG SET.
+        self.residency_device_rows = 0
+        self.residency_max_host_bytes = 0
+        self.residency_max_disk_bytes = 0
+        self.residency_promote_heat = 4.0
+        self.residency_heat_half_life_s = 10.0
+        self.residency_interval_ms = 200
+        self.residency_dir: Optional[str] = None
         # Device-side result mailbox: the completer concatenates pending
         # launches' packed results on device and fetches them in ONE D2H
         # (PROFILE.md remaining-lever 2) — each host fetch costs a full

@@ -67,9 +67,10 @@ class _ReplaySession:
         if mir is None:
             from redisson_tpu.objects.degraded import mirror_for_entry
 
-            row = np.asarray(
-                self.engine.executor.read_row(entry.pool, entry.row)
-            )
+            # _host_row, not a raw read_row: a HOST/DISK-resident
+            # entry (ISSUE 14) seeds from its mirror/blob — a
+            # DISK-resident sketch replays without touching the device.
+            row = np.asarray(self.engine._host_row(entry))
             mir = mirror_for_entry(entry, row)
             self.mirrors[name] = mir
         return mir
@@ -84,9 +85,7 @@ class _ReplaySession:
         mir = self.mirrors.get(name)
         if mir is not None:
             return np.asarray(mir.encode(entry.pool.row_units))
-        return np.asarray(
-            self.engine.executor.read_row(entry.pool, entry.row)
-        )
+        return np.asarray(self.engine._host_row(entry))
 
     def drop(self, name: str) -> None:
         self.mirrors.pop(name, None)
@@ -343,6 +342,14 @@ class _ReplaySession:
         for name, mir in self.mirrors.items():
             entry = _live_entry(eng, name)
             if entry is None:
+                continue
+            if entry.row is None or entry.row < 0:
+                # HOST/DISK tier (ISSUE 14): the replayed mirror IS the
+                # recovered truth — install it as the entry's residency
+                # mirror (no device write; a DISK sketch touched by the
+                # tail lands HOST-resident with its blob retired).
+                eng._install_residency_mirror(entry, mirror=mir)
+                wrote += 1
                 continue
             row = np.asarray(mir.encode(entry.pool.row_units))
             for r in eng._entry_rows(entry):

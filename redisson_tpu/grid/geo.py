@@ -119,6 +119,7 @@ class Geo(GridObject):
             mb = self._enc(member)
             new = mb not in e.value
             e.value[mb] = float(_geohash_int52(longitude, latitude))
+            self._nc_bump()  # GEOPOS/GEODIST cached scalars retire
             return int(new)
 
     def add_entries(self, *entries: tuple) -> int:
@@ -137,35 +138,59 @@ class Geo(GridObject):
     def remove(self, member: Any) -> bool:
         with self._store.lock:
             e = self._entry(create=False)
-            return e is not None and e.value.pop(self._enc(member), None) is not None
+            gone = (
+                e is not None
+                and e.value.pop(self._enc(member), None) is not None
+            )
+            if gone:
+                self._nc_bump()
+            return gone
 
     # -- reads -------------------------------------------------------------
 
     def pos(self, *members: Any) -> dict:
-        """→ RGeo#pos (GEOPOS): member -> (lon, lat), absent skipped."""
-        with self._store.lock:
-            e = self._entry(create=False)
-            if e is None:
-                return {}
-            out = {}
-            for m in members:
-                got = e.value.get(self._enc(m))
-                if got is not None:
-                    out[m] = self._coords(got)
-            return out
+        """→ RGeo#pos (GEOPOS): member -> (lon, lat), absent skipped.
+        Rides the engine near cache keyed by the exact member set
+        (ISSUE 14 satellite) — repeated position polls of the same
+        members skip the grid lock."""
+
+        def compute():
+            with self._store.lock:
+                e = self._entry(create=False)
+                if e is None:
+                    return {}
+                out = {}
+                for m in members:
+                    got = e.value.get(self._enc(m))
+                    if got is not None:
+                        out[m] = self._coords(got)
+                return out
+
+        key = ("pos", *(self._enc(m) for m in members))
+        # Copy on the way out: the cached dict must never be mutated
+        # by a caller into a poisoned hit.
+        return dict(self._nc_scalar("geo", key, compute))
 
     def dist(self, a: Any, b: Any, unit: str = "m") -> Optional[float]:
-        """→ RGeo#dist (GEODIST)."""
+        """→ RGeo#dist (GEODIST).  Near-cached like pos()."""
         scale = _UNITS[unit]
-        with self._store.lock:
-            e = self._entry(create=False)
-            if e is None:
-                return None
-            pa = e.value.get(self._enc(a))
-            pb = e.value.get(self._enc(b))
-            if pa is None or pb is None:
-                return None
-            return _haversine_m(*self._coords(pa), *self._coords(pb)) / scale
+
+        def compute():
+            with self._store.lock:
+                e = self._entry(create=False)
+                if e is None:
+                    return None
+                pa = e.value.get(self._enc(a))
+                pb = e.value.get(self._enc(b))
+                if pa is None or pb is None:
+                    return None
+                return (
+                    _haversine_m(*self._coords(pa), *self._coords(pb))
+                    / scale
+                )
+
+        key = ("dist", self._enc(a), self._enc(b), unit)
+        return self._nc_scalar("geo", key, compute)
 
     def hash(self, *members: Any) -> dict:
         """→ RGeo#hash (GEOHASH)."""

@@ -49,6 +49,19 @@ class GridStore:
         # backend — each side calls it while holding its own lock, so a
         # locking probe would be an AB-BA deadlock (found in r3 review).
         self.foreign_exists = None
+        # Near-cache reach (ISSUE 14 satellite): the client wires these
+        # to the engine near cache's grid-tenant invalidation, so
+        # store-level identity changes (delete / rename / expiry /
+        # snapshot restore) retire cached grid scalars (XLEN, GEOPOS)
+        # the per-object mutators can't see.  Both must be leaf-safe:
+        # they are called under ``self.lock``.
+        self.on_invalidate = None
+        self.on_invalidate_all = None
+
+    def _note_invalidate(self, name: str) -> None:
+        hook = self.on_invalidate
+        if hook is not None:
+            hook(name)
 
     def _guard_foreign(self, name: str) -> None:
         if self.foreign_exists is not None and self.foreign_exists(name):
@@ -72,6 +85,7 @@ class GridStore:
             e = self._data.get(name)
             if e is not None and e.expired(time.time()):
                 del self._data[name]
+                self._note_invalidate(name)
                 e = None
             if e is not None and kind is not None and e.kind != kind:
                 raise TypeError(f"object {name!r} holds a {e.kind}, not a {kind}")
@@ -115,6 +129,7 @@ class GridStore:
             if e is None:
                 return False
             del self._data[name]
+            self._note_invalidate(name)
             self.cond.notify_all()
             return True
 
@@ -130,6 +145,8 @@ class GridStore:
             self._guard_foreign(new)
             del self._data[old]
             self._data[new] = e
+            self._note_invalidate(old)
+            self._note_invalidate(new)
             return True
 
     def names(self, pattern: Optional[str] = None) -> list[str]:
@@ -139,6 +156,7 @@ class GridStore:
             for n, e in list(self._data.items()):
                 if e.expired(now):
                     del self._data[n]
+                    self._note_invalidate(n)
                     continue
                 if pattern is None or fnmatch.fnmatchcase(n, pattern):
                     out.append(n)
@@ -152,6 +170,7 @@ class GridStore:
             if e is None:
                 return False
             e.expire_at = time.time() + ttl_s
+            self._note_invalidate(name)
             self._ensure_sweeper()
             return True
 
@@ -161,6 +180,7 @@ class GridStore:
             if e is None:
                 return False
             e.expire_at = float(epoch_s)
+            self._note_invalidate(name)
             self._ensure_sweeper()
             return True
 
@@ -170,7 +190,16 @@ class GridStore:
             if e is None or e.expire_at is None:
                 return False
             e.expire_at = None
+            self._note_invalidate(name)
             return True
+
+    def peek_expire_at(self, name: str):
+        """The entry's TTL deadline (or None) WITHOUT reaping — the
+        near-cache reach tags cached scalars with it so a hit can
+        observe the deadline exactly, not at the next sweep."""
+        with self.lock:
+            e = self._data.get(name)
+            return None if e is None else e.expire_at
 
     def remain_ttl_ms(self, name: str) -> int:
         """→ RExpirable#remainTimeToLive: -2 absent, -1 no TTL, else ms."""
@@ -199,6 +228,7 @@ class GridStore:
                 dead = [n for n, e in self._data.items() if e.expired(now)]
                 for n in dead:
                     del self._data[n]
+                    self._note_invalidate(n)
                 if dead:
                     self.cond.notify_all()
                 # Let map-entry TTL structures prune themselves too.
@@ -398,6 +428,11 @@ class GridStore:
         head = json.loads(data[8 : 8 + hlen].decode())
         if head.get("v") != self._SNAP_VERSION:
             raise ValueError(f"unsupported grid snapshot v{head.get('v')}")
+        # Whole-keyspace replacement: every cached grid scalar predates
+        # the restored state (near-cache reach, ISSUE 14 satellite).
+        hook = self.on_invalidate_all
+        if hook is not None:
+            hook()
         blobs: list[bytes] = []
         off = 8 + hlen
         while off < len(data):

@@ -86,6 +86,7 @@ class Stream(GridObject):
             st.added += 1
             if maxlen is not None:
                 self._trim_locked(st, maxlen)
+            self._nc_bump()  # XLEN-class cached scalars retire
             self._store.cond.notify_all()  # wake blocked readers
             return _fmt_id(new_id)
 
@@ -102,7 +103,10 @@ class Stream(GridObject):
         """→ XTRIM MAXLEN: number of evicted entries."""
         with self._store.lock:
             e = self._entry(create=False)
-            return 0 if e is None else self._trim_locked(e.value, maxlen)
+            n = 0 if e is None else self._trim_locked(e.value, maxlen)
+            if n:
+                self._nc_bump()
+            return n
 
     def remove(self, *ids: str) -> int:
         """→ XDEL."""
@@ -117,6 +121,8 @@ class Stream(GridObject):
                 if st.entries.pop(t, None) is not None:
                     st.max_deleted_id = max(st.max_deleted_id, t)
                     n += 1
+            if n:
+                self._nc_bump()
             return n
 
     # -- reads -------------------------------------------------------------
@@ -125,10 +131,16 @@ class Stream(GridObject):
         return {self._dec_key(k): self._dec(v) for k, v in fields.items()}
 
     def size(self) -> int:
-        """→ XLEN."""
-        with self._store.lock:
-            e = self._entry(create=False)
-            return 0 if e is None else len(e.value.entries)
+        """→ XLEN.  Rides the engine near cache (ISSUE 14 satellite):
+        the hottest stream-length polls answer from the host tier
+        without the grid lock."""
+
+        def compute():
+            with self._store.lock:
+                e = self._entry(create=False)
+                return 0 if e is None else len(e.value.entries)
+
+        return self._nc_scalar("stream", ("xlen",), compute)
 
     def range(self, start: str = "-", end: str = "+",
               count: Optional[int] = None) -> list:

@@ -89,7 +89,10 @@ class SketchDurabilityMixin:
     def _entry_rows(entry) -> list:
         """Every device row an entry owns (primary + read replicas) — the
         ONE place this enumeration lives (delete/expiry/rename/restore
-        all free through it)."""
+        all free through it).  A HOST/DISK-resident entry (ISSUE 14,
+        row < 0) owns none."""
+        if entry.row is None or entry.row < 0:
+            return []
         return list(entry.replica_rows) if entry.replica_rows else [entry.row]
 
     def _reap_rows(self, pool, rows, epoch: int) -> None:
@@ -130,6 +133,14 @@ class SketchDurabilityMixin:
                     nc = getattr(self, "nearcache", None)
                     if nc is not None:
                         nc.drop_object(entry.name)
+                    # Residency state (heat / host mirror accounting /
+                    # disk blob) dies with the object too.
+                    rm = getattr(self, "residency", None)
+                    if rm is not None:
+                        rm.drop(entry.name)
+                    if self._mirrors:
+                        with self._mirror_lock:
+                            self._mirrors.pop(entry.name, None)
                 return True
         return False
 
@@ -286,7 +297,13 @@ class SketchDurabilityMixin:
                 f"dump row has {row.shape[0]} units, pool expects "
                 f"{entry.pool.row_units}"
             )
-        self.executor.write_row(entry.pool, entry.row, row)
+        if entry.row < 0:
+            # Born cold (created past the device budget, ISSUE 14):
+            # the restored state lives in a HOST mirror until heat
+            # promotes it.
+            self._install_residency_mirror(entry, row=row)
+        else:
+            self.executor.write_row(entry.pool, entry.row, row)
         # Unconditional: also CLEARS any ghost table when the dump
         # carries no candidates.
         self.topk.import_decoded(topk_decoded, name)
@@ -333,6 +350,12 @@ class SketchDurabilityMixin:
                 # disk: retire the covered segments (the BGREWRITEAOF
                 # analog).
                 journal.mark_snapshot(journal_cut)
+            # Residency-blob GC barrier (ISSUE 14): the latest durable
+            # snapshot now names exactly these blob files — retired
+            # blobs outside the set may delete.
+            rm = getattr(self, "residency", None)
+            if rm is not None:
+                rm.note_snapshot_refs(meta.get("residency_blobs", ()))
             # Companion-state hook (the client wires the grid keyspace
             # here): runs outside the engine locks (still inside the
             # snapshot lock — the grid files race identically), so
@@ -395,8 +418,20 @@ class SketchDurabilityMixin:
                             arrays[f"pool_{i}"], pool_meta[i],
                             s_cur, thresh, r, data,
                         )
-            tenants = [
-                {
+            # Residency tiers (ISSUE 14): a HOST-resident tenant's
+            # truth is its mirror — captured as a standalone array; a
+            # DISK-resident tenant's truth is its blob — captured by
+            # exact filename + CRC (blobs are versioned, and GC never
+            # deletes a file the latest snapshot names, so a restore +
+            # journal-tail replay can never double-apply).  A born-cold
+            # tenant with neither has all-zero state and restores as a
+            # first-touch zero mirror.
+            rm = getattr(self, "residency", None)
+            disk_index = rm.disk_index() if rm is not None else {}
+            blob_refs = []
+            tenants = []
+            for j, e in enumerate(self.registry.entries()):
+                t = {
                     "name": e.name,
                     "kind": e.kind,
                     "pool_key": list(e.pool.spec.key),
@@ -404,10 +439,29 @@ class SketchDurabilityMixin:
                     "params": e.params,
                     "expire_at": e.expire_at,
                     "replica_rows": e.replica_rows,
+                    "residency": getattr(e, "residency", "device"),
                 }
-                for e in self.registry.entries()
-            ]
+                if e.row is not None and e.row < 0:
+                    mirror = self._mirrors.get(e.name)
+                    info = disk_index.get(e.name)
+                    if mirror is not None:
+                        key = f"tier_{j}"
+                        arrays[key] = np.asarray(
+                            mirror.encode(e.pool.row_units)
+                        )
+                        t["residency"] = "host"
+                        t["tier_array"] = key
+                    elif info is not None:
+                        t["residency"] = "disk"
+                        t["blob"] = info["file"]
+                        t["blob_crc"] = int(info["crc"])
+                        t["blob_nbytes"] = int(info["nbytes"])
+                        blob_refs.append(info["file"])
+                    else:
+                        t["residency"] = "host"  # born cold: zeros
+                tenants.append(t)
         meta = {
+            "residency_blobs": blob_refs,
             "version": _DUMP_VERSION,
             "pools": pool_meta,
             "tenants": tenants,
@@ -516,6 +570,13 @@ class SketchDurabilityMixin:
         from typing import Callable
 
         remap_rows: dict[tuple, Callable[[int], np.ndarray]] = {}
+        # Residency tiers (ISSUE 14): HOST/DISK tenants install AFTER
+        # the registry/dispatch locks release — mirror installs take
+        # the mirror lock, which orders BEFORE registry/dispatch
+        # engine-wide (snapshot capture), and restore runs at engine
+        # init, single-threaded, so deferred install loses nothing.
+        pending_mirrors: list = []  # (entry, row_array | None)
+        pending_disk: list = []     # (name, file, crc, nbytes)
         # Same lock order as snapshot(): registry before dispatch.
         with self.registry._lock, self.executor._dispatch_lock:
             if same_topology and self.registry.entries():
@@ -562,6 +623,36 @@ class SketchDurabilityMixin:
             for t in meta["tenants"]:
                 from redisson_tpu.tenancy.registry import TenantEntry
 
+                tier = t.get("residency", "device")
+                if tier != "device" or int(t["row"]) < 0:
+                    # HOST/DISK tenant: no device row in ANY topology —
+                    # tier state is layout-independent, so the same
+                    # install serves both restore paths.
+                    pool = by_key.get(tuple(t["pool_key"]))
+                    if pool is None:
+                        pool = self.registry.pool_for(
+                            t["kind"], tuple(t["pool_key"])[1:]
+                        )
+                    entry = TenantEntry(
+                        t["name"], t["kind"], pool, -1,
+                        dict(t["params"]), t.get("expire_at"), None,
+                        residency=tier,
+                    )
+                    self.registry._tenants[t["name"]] = entry
+                    if tier == "disk":
+                        pending_disk.append((
+                            t["name"], t["blob"],
+                            int(t.get("blob_crc", 0)),
+                            int(t.get("blob_nbytes", 0)),
+                        ))
+                    elif t.get("tier_array"):
+                        pending_mirrors.append(
+                            (entry, np.asarray(data[t["tier_array"]]))
+                        )
+                    # else: born cold — zeros on first touch.
+                    if t.get("expire_at") is not None:
+                        self._ensure_sweeper()
+                    continue
                 if same_topology:
                     pool = by_key[tuple(t["pool_key"])]
                     row = int(t["row"])
@@ -597,6 +688,17 @@ class SketchDurabilityMixin:
                     )
                 if t.get("expire_at") is not None:
                     self._ensure_sweeper()
+        for entry, rowdata in pending_mirrors:
+            self._install_residency_mirror(entry, row=rowdata)
+        if pending_disk:
+            rm = getattr(self, "residency", None)
+            if rm is None:
+                raise ValueError(
+                    "snapshot names DISK-resident tenants but this "
+                    "engine has no residency manager"
+                )
+            for name, fname, crc, nb in pending_disk:
+                rm.adopt_blob(name, fname, crc, nb)
         self.topk.import_decoded(topk_decoded)
         # Whole-keyspace event: every cached read predates the restored
         # state (nearcache may be absent: engine init builds it AFTER

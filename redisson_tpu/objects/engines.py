@@ -418,7 +418,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
         )
         self.health.reconcile_cb = self._reconcile_kind
         self.health.obs = self.obs  # LATENCY breaker-open events
-        self._mirrors: dict = {}  # name -> degraded-mode mirror
+        self._mirrors: dict = {}  # name -> degraded-mode OR demoted mirror
         self._mirror_lock = _witness.named(
             threading.RLock(), "engine.mirror"
         )
@@ -511,6 +511,18 @@ class TpuSketchEngine(SketchDurabilityMixin):
         # and snapshot(), both entered lock-free), so it can never
         # participate in an ordering cycle, and naming it would flag the
         # drains/dispatches the gated bodies legitimately perform.
+        # Tiered sketch storage (ISSUE 14): the heat-based residency
+        # ladder — device rows are a CACHE over host golden mirrors
+        # over disk blobs (storage/residency.py).  Built BEFORE the
+        # restore/recovery block below so a snapshot can reinstate
+        # HOST/DISK tenants; the alloc gate and the background thread
+        # arm AFTER recovery (replay must see the pre-crash tiers, not
+        # race a budget enforcer).
+        from redisson_tpu.storage import ResidencyManager
+
+        self.residency = ResidencyManager(
+            self, config.tpu_sketch, obs=self.obs
+        )
         self.journal = None
         self._journal_replaying = False
         self._journal_gate = threading.RLock()
@@ -534,6 +546,15 @@ class TpuSketchEngine(SketchDurabilityMixin):
             self.restore_snapshot(config.snapshot_dir)
         if getattr(config, "journal_dir", None):
             self._journal_attach(config.journal_dir, recover=True)
+        # Residency ladder goes LIVE only after recovery: creates past
+        # the device budget now birth HOST-resident, and the
+        # maintenance thread starts once a budget is armed.
+        self.registry.alloc_gate = self.residency.device_full
+        if (
+            config.tpu_sketch.residency_device_rows > 0
+            or config.tpu_sketch.residency_max_host_bytes > 0
+        ):
+            self.residency.start()
         if config.snapshot_dir:
             if config.snapshot_interval_s > 0:
                 import jax
@@ -615,8 +636,32 @@ class TpuSketchEngine(SketchDurabilityMixin):
         )
         reg.gauge_callback(
             "rtpu_degraded_objects",
-            "sketches currently serving from the host golden mirror",
-            lambda: len(self._mirrors),
+            "sketches currently serving from the host golden mirror "
+            "because a breaker is open (demoted-tier mirrors are NOT "
+            "degraded and count in rtpu_residency_host_bytes instead)",
+            lambda: max(
+                0, len(self._mirrors) - self.residency.host_objects()
+            ),
+        )
+        # Tiered residency (ISSUE 14): fast-tier occupancy + the host/
+        # disk tier footprints (SWAPIN/SWAPOUT-style observability; the
+        # promotion/demotion/spill/load counters live in the obs
+        # bundle).
+        reg.gauge_callback(
+            "rtpu_residency_device_rows",
+            "device rows in use across all sketch pools (the residency "
+            "ladder's fast tier; compare residency_device_rows budget)",
+            self.residency.device_rows_used,
+        )
+        reg.gauge_callback(
+            "rtpu_residency_host_bytes",
+            "host bytes held by demoted-tier golden mirrors",
+            self.residency.host_bytes,
+        )
+        reg.gauge_callback(
+            "rtpu_residency_disk_bytes",
+            "bytes held by spilled per-object disk blobs",
+            self.residency.disk_bytes,
         )
         # Near cache (ISSUE 4): live occupancy (hits/misses/evictions
         # are counters registered by the obs bundle itself).
@@ -704,6 +749,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
     def shutdown(self) -> None:
         _chaos.unset_observer(self._chaos_observer)
         self.health.shutdown()
+        self.residency.shutdown()
         self._stop_snapshotter()
         self._stop_sweeper()
         if self.config.snapshot_dir:
@@ -894,8 +940,21 @@ class TpuSketchEngine(SketchDurabilityMixin):
         mid-seed routes back to the device, and a reconcile that WROTE
         mirrors back mid-seed (epoch bump) discards the possibly-stale
         row and retries — installing it would resurrect pre-reconcile
-        state and lose acked writes on the next write-back."""
-        if not self._mirrors and not self.health.any_degraded:
+        state and lose acked writes on the next write-back.
+
+        Residency ladder (ISSUE 14): the same boundary serves DEMOTED
+        sketches — a HOST-resident entry's mirror answers here (no
+        breaker, no degraded flag), a DISK-resident or born-cold entry
+        loads its mirror first.  The membership probe is lock-free
+        (dict probe, GIL-atomic): a stale True is re-checked by
+        _mirror_call under the lock, and a promote racing a stale
+        False repoints entry.row to a fully-written device row BEFORE
+        dropping the mirror."""
+        if entry.row < 0 and entry.name not in self._mirrors:
+            self._ensure_resident(entry)
+        if entry.name in self._mirrors:
+            return True
+        if not self.health.any_degraded:
             return False
         for _ in range(4):
             with self._mirror_lock:
@@ -917,6 +976,43 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 self._install_mirror(entry, row)
                 return True
         return False  # flapping hard: let the device surface the failure
+
+    def _ensure_resident(self, entry) -> None:
+        """Row-less entry (DISK-resident, or born cold past the device
+        budget): install its HOST mirror — from the CRC-checked blob,
+        or from zeros for a never-touched tenant.  A corrupt/missing
+        blob raises (the op fails typed; serving garbage state is the
+        one thing a tier must never do)."""
+        self.residency.load(entry.name)
+
+    def _tier_row(self, entry, row0: int) -> int:
+        """Resolve the device row for a READ dispatch that captured
+        ``row0`` BEFORE its residency check and then got no mirror
+        result.  Readers do not hold the journal gate, so a transition
+        can interleave with their check→dispatch window:
+
+        - a PROMOTE racing the check leaves row0 at -1 while entry.row
+          is already live (promote repoints the row before dropping
+          the mirror) — re-read it;
+        - a DEMOTE racing it leaves row0 pointing at the QUARANTINED
+          row, whose contents stay bit-identical to the pre-demotion
+          state until a later maintenance cycle's post-drain reclaim —
+          dispatching against it is linearizable (the read began
+          before the demotion completed).
+
+        Every read site must capture entry.row before its
+        _serve_degraded/_degraded check and resolve through this
+        helper — reading entry.row AFTER the check races the demote's
+        row retirement."""
+        return entry.row if row0 < 0 else row0
+
+    def _install_residency_mirror(self, entry, row=None, mirror=None):
+        """Install ``entry`` as HOST-resident from a row array or a
+        ready-made mirror — the snapshot-restore / journal-writeback
+        install path (engine init, or under the journal gate).
+        Delegates to the residency manager, which owns the mirror
+        install + host-bytes accounting in one place."""
+        self.residency.install_host(entry, row=row, mirror=mirror)
 
     def _seed_row(self, entry):
         """Fetch the entry's device row for mirror seeding (no lock
@@ -952,14 +1048,23 @@ class TpuSketchEngine(SketchDurabilityMixin):
         )
 
     def _mirror_call(self, entry, nops: int, fn):
-        """Apply a degraded-mode op to the entry's mirror (serialized by
-        the mirror lock) and account it; returns an ImmediateResult."""
+        """Apply a degraded-mode or demoted-tier op to the entry's
+        mirror (serialized by the mirror lock) and account it; returns
+        an ImmediateResult.  Demoted is NOT degraded: a residency
+        mirror's serves count to the host tier, never to
+        rtpu_degraded_ops."""
         with self._mirror_lock:
             mirror = self._mirrors.get(entry.name)
-            if mirror is None:  # reconciled between check and apply: retry
+            if mirror is None:  # reconciled/promoted between check+apply
                 return None
             out = fn(mirror)
-        self.obs.degraded_ops.inc((entry.kind,), nops)
+            demoted = getattr(mirror, "residency", None) is not None
+            if demoted:
+                # Under the mirror lock: += is a read-modify-write and
+                # every demoted serve already holds this lock.
+                self.residency.host_serves += nops
+        if not demoted:
+            self.obs.degraded_ops.inc((entry.kind,), nops)
         return ImmediateResult(out)
 
     def _serve_degraded(self, entry, nops: int, fn):
@@ -977,14 +1082,20 @@ class TpuSketchEngine(SketchDurabilityMixin):
         """``entry``'s current truth in device-row layout: its mirror's
         encoding while one is live (the device row is stale during
         degradation), else the device row itself.  Serves merge sources
-        and DUMP during degradation."""
+        and DUMP during degradation (and the demoted/spilled tiers —
+        a DISK-resident entry loads its mirror first)."""
+        row0 = entry.row  # BEFORE the residency check (see _tier_row)
+        if row0 < 0 and entry.name not in self._mirrors:
+            self._ensure_resident(entry)
         if self._mirrors:
             with self._mirror_lock:
                 mirror = self._mirrors.get(entry.name)
                 if mirror is not None:
                     return np.asarray(mirror.encode(entry.pool.row_units))
         self._drain()
-        return np.asarray(self.executor.read_row(entry.pool, entry.row))
+        return np.asarray(
+            self.executor.read_row(entry.pool, self._tier_row(entry, row0))
+        )
 
     def _reconcile_kind(self, kind: str) -> bool:
         """Breaker-close hook (health.reconcile_cb): write every mirrored
@@ -1006,8 +1117,13 @@ class TpuSketchEngine(SketchDurabilityMixin):
 
     def _reconcile_kind_inner(self, kind: str) -> bool:
         with self._mirror_lock:
+            # Residency mirrors (ISSUE 14) are NOT breaker state: a
+            # demoted sketch has no device row to write back to, and
+            # its mirror stays the truth after the breaker closes.
             names = [
-                n for n, m in self._mirrors.items() if m.kind == kind
+                n for n, m in self._mirrors.items()
+                if m.kind == kind
+                and getattr(m, "residency", None) is None
             ]
             for n in names:
                 mirror = self._mirrors[n]
@@ -1136,6 +1252,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
             if self._mirrors:
                 with self._mirror_lock:
                     self._mirrors.pop(name, None)
+            # Residency state dies with the object: heat, host-bytes
+            # accounting, and the disk blob (retired into blob GC).
+            self.residency.drop(name)
             result = not was_expired
         # Durability fence OUTSIDE the gate: blocking on the fsync while
         # holding it would serialize every writer behind one barrier
@@ -1170,6 +1289,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
                     m = self._mirrors.pop(old, None)
                     if m is not None:
                         self._mirrors[new] = m
+            # Residency state follows the rename (heat, host-bytes,
+            # disk-blob index; the displaced dest's blob retires).
+            self.residency.rename(old, new)
         return self._ack(True, seq)  # fence outside the gate (see delete)
 
     def names(self, kind=None):
@@ -1197,6 +1319,12 @@ class TpuSketchEngine(SketchDurabilityMixin):
         entry = self._live_lookup(name)
         if entry is not None and entry.kind != kind:
             raise TypeError(f"object {name!r} holds a {entry.kind}, not a {kind}")
+        if entry is not None:
+            # Residency heat feed (ISSUE 14): every read and write path
+            # resolves its entry here (or via the ensure paths, which
+            # also touch) — one decayed-counter bump per API call, the
+            # same choke points the near-cache epoch hooks mark.
+            self.residency.touch(name)
         return entry
 
     def _guard_foreign(self, name: str) -> None:
@@ -1246,6 +1374,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
         entry = self._lookup_kind(name, PoolKind.BLOOM)
         if entry is None:
             raise RuntimeError(f"bloom filter {name!r} is not initialized")
+        if entry.row < 0:
+            # Replication spreads DEVICE rows across shards; promote
+            # the demoted/spilled filter back to the fast tier first.
+            if not self.residency.promote(name):
+                raise RuntimeError(
+                    f"bloom filter {name!r} could not promote to the "
+                    f"device tier for replication"
+                )
         # Topology change for this object's reads: defensively retire
         # every cached entry (structural bump) while replicas publish.
         self.nearcache.note_structural(name)
@@ -1367,6 +1503,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
         m, k = entry.params["size"], entry.params["hash_iterations"]
         B = len(h1m)
         is_add = np.asarray(is_add, bool)
+        row0 = entry.row  # BEFORE the residency check (see _tier_row)
         res = self._serve_degraded(
             entry, B, lambda mir: mir.mixed(h1m, h2m, is_add)
         )
@@ -1379,7 +1516,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
             h1m, h2m, is_add = h1m[eidx], h2m[eidx], is_add[eidx]
             gather = lambda v: v[ppos]  # noqa: E731
         else:
-            rows = np.full(B, entry.row, np.int32)
+            rows = np.full(B, self._tier_row(entry, row0), np.int32)
             gather = None
         m_arr = np.full(len(rows), m, np.uint32)
         pool = entry.pool
@@ -1472,6 +1609,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
     def _bloom_contains_dispatch(self, entry, H1, H2) -> LazyResult:
         h1m, h2m = self._bloom_reduce(entry, H1, H2)
         m, k = entry.params["size"], entry.params["hash_iterations"]
+        row0 = entry.row  # BEFORE the residency check (see _tier_row)
         if (
             self.coalescer is not None
             or entry.replica_rows
@@ -1481,7 +1619,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 entry, h1m, h2m, np.zeros(len(H1), bool)
             )
         return self.executor.bloom_contains_st(
-            entry.pool, entry.row, m, k, h1m, h2m
+            entry.pool, self._tier_row(entry, row0), m, k, h1m, h2m
         )
 
     def bloom_count(self, name) -> LazyResult:
@@ -1497,12 +1635,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
         return self._bloom_count_dispatch(entry)
 
     def _bloom_count_dispatch(self, entry) -> LazyResult:
+        row0 = entry.row  # BEFORE the residency check (see _tier_row)
         res = self._serve_degraded(entry, 1, lambda mir: mir.count())
         if res is not None:
             return res
         self._drain()
         return self.executor.bloom_count(
-            entry.pool, entry.row, entry.params["size"], entry.params["hash_iterations"]
+            entry.pool, self._tier_row(entry, row0),
+            entry.params["size"], entry.params["hash_iterations"]
         )
 
     # Encoded entry points: the object layer hands down raw codec lanes and
@@ -1601,6 +1741,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
             if uniform else np.asarray(is_add, bool)
         )
         any_add = bool(orig_flags.any())
+        row0 = entry.row  # BEFORE the residency check (see _tier_row)
         if self._degraded(entry):
             # Degraded: hash host-side (the mirror consumes reduced
             # hashes) and serve from the golden mirror.
@@ -1650,7 +1791,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 (blocks,),
                 B,
                 pool_key=id(pool),
-                meta=(entry.row, m, is_add, len_meta),
+                meta=(self._tier_row(entry, row0), m, is_add, len_meta),
                 tenant=entry.name,
             )
             if any_add:
@@ -1673,7 +1814,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
             blocks, lengths, flags = blocks[eidx], lengths[eidx], flags[eidx]
             gather = lambda v: v[ppos]  # noqa: E731
         else:
-            rows = np.full(B, entry.row, np.int32)
+            rows = np.full(B, self._tier_row(entry, row0), np.int32)
             gather = None
         if self.coalescer is not None:
             m_arr = np.full(len(rows), m, np.uint32)
@@ -1790,6 +1931,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
         return self._bloom_contains_encoded_dispatch(entry, blocks, lengths)
 
     def _bloom_contains_encoded_dispatch(self, entry, blocks, lengths):
+        row0 = entry.row  # BEFORE the residency check (see _tier_row)
         if (
             self.coalescer is not None
             or entry.replica_rows
@@ -1798,7 +1940,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
             return self._bloom_submit_mixed_keys(entry, blocks, lengths, False)
         m, k = entry.params["size"], entry.params["hash_iterations"]
         return self.executor.bloom_contains_keys_st(
-            entry.pool, entry.row, m, k, blocks, lengths
+            entry.pool, self._tier_row(entry, row0), m, k, blocks, lengths
         )
 
     def bloom_mixed_encoded(self, name, blocks, lengths, flags) -> LazyResult:
@@ -1842,6 +1984,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
         self._live_lookup(name)  # reap an expired holder first
         self._guard_foreign(name)
         entry, _ = self.registry.try_create(name, PoolKind.HLL, (), {})
+        self.residency.touch(name)  # heat feed (see _lookup_kind)
         if self.prewarmer is not None:
             # Seen-set gate: hll_ensure runs on EVERY op — the closure
             # build + prewarmer lock belong off the hot path (register
@@ -1924,11 +2067,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
         return self._hll_count_dispatch(entry)
 
     def _hll_count_dispatch(self, entry) -> LazyResult:
+        row0 = entry.row  # BEFORE the residency check (see _tier_row)
         res = self._serve_degraded(entry, 1, lambda mir: mir.count())
         if res is not None:
             return res
         self._drain()
-        return self.executor.hll_count(entry.pool, entry.row)
+        return self.executor.hll_count(
+            entry.pool, self._tier_row(entry, row0)
+        )
 
     def hll_count_with(self, name, other_names) -> int:
         """PFCOUNT over several keys = cardinality of the union: merge
@@ -1945,6 +2091,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         regs = None
         for e in entries:
             r = None
+            row0 = e.row  # BEFORE the residency check (see _tier_row)
+            if e.row < 0 and e.name not in self._mirrors:
+                self._ensure_resident(e)  # DISK/born-cold union source
             if self._mirrors:
                 # Snapshot under the mirror lock (degraded.py's
                 # external-synchronization contract): a concurrent
@@ -1954,7 +2103,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                     if mir is not None and mir.kind == PoolKind.HLL:
                         r = mir.regs.copy()
             if r is None:
-                r = self.executor.read_row(e.pool, e.row)
+                r = self.executor.read_row(e.pool, self._tier_row(e, row0))
             regs = r if regs is None else np.maximum(regs, r)
         hist = np.bincount(regs, minlength=golden.HLL_Q + 2)
         return int(round(golden.ertl_estimate(hist)))
@@ -2003,6 +2152,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
         entry, created = self.registry.try_create(
             name, PoolKind.BITSET, (class_words_for_bits(min_bits),), {"nbits": 0}
         )
+        self.residency.touch(name)  # heat feed (see _lookup_kind)
         if not created:
             self._bitset_grow(entry, min_bits)
         return entry
@@ -2048,6 +2198,17 @@ class TpuSketchEngine(SketchDurabilityMixin):
         # bumps bracket the whole commit so no read captured mid-
         # migration can install.
         with self._nc_mutate(entry.name, structural=True):
+            if entry.row < 0:
+                # HOST/DISK residency (ISSUE 14): no device row to
+                # migrate — repoint the entry to the larger size class.
+                # The mirror's golden model grows on demand, the blob
+                # loader zero-pads, and promote/encode size to the
+                # entry's CURRENT pool.  Mutating callers hold the
+                # journal gate, so no transition can interleave.
+                entry.pool = self.registry.pool_for(
+                    PoolKind.BITSET, (need_words,)
+                )
+                return
             self._bitset_migrate(entry, need_words)
 
     def _bitset_migrate(self, entry, need_words: int) -> None:
@@ -2135,28 +2296,92 @@ class TpuSketchEngine(SketchDurabilityMixin):
         that now holds the data."""
 
         def dispatch(cols, metas):
+            offs = [0]
+            for nops, _m in metas:
+                offs.append(offs[-1] + nops)
+            # Residency stragglers (ISSUE 14): a chunk whose entry
+            # DEMOTED between submit and flush serves from the mirror —
+            # flush-time residency resolution, the same discipline as
+            # the flush-time row resolution below.  Applied OUTSIDE the
+            # dispatch lock: mirror→dispatch is the engine-wide lock
+            # order (snapshot capture, reconcile, promote); inverting
+            # it here would be an AB-BA.  A None from _mirror_call
+            # means the entry promoted mid-flight — its row is live
+            # again and the group pass re-reads it under the lock.
+            mirror_parts = {}
+            for mi, (nops, (e, op)) in enumerate(metas):
+                if e.row >= 0:
+                    continue
+                gidx = np.asarray(cols[0][offs[mi]:offs[mi + 1]])
+                ops_col = np.full(nops, op, np.uint32)
+                res = None
+                for _ in range(4):
+                    res = self._mirror_call(
+                        e, nops,
+                        lambda mir, g=gidx, o=ops_col: mir.mixed(g, o),
+                    )
+                    if res is not None or e.row >= 0:
+                        break
+                    # Row-less with no mirror: the entry SPILLED
+                    # between this chunk queueing and the flush (spill
+                    # drains first, but readers enqueue gate-free) —
+                    # reload the mirror and re-apply.  Falling through
+                    # to the device branch would dispatch row -1 into
+                    # another tenant's row.  load_nowait, never load:
+                    # the gate holder may be draining on THIS flush
+                    # (blocking would be flush→gate vs gate→drain).
+                    if not self.residency.load_nowait(e):
+                        time.sleep(0.001)
+                if res is not None:
+                    mirror_parts[mi] = res
+                elif e.row < 0:  # pragma: no cover — load kept failing
+                    from redisson_tpu.executor.failures import (
+                        NonRetryableDispatchError,
+                    )
+
+                    raise NonRetryableDispatchError(
+                        f"bitset chunk for {e.name!r} has neither a "
+                        f"device row nor a loadable mirror"
+                    )
             with self.executor._dispatch_lock:  # atomic vs migration commit
-                # Group CONSECUTIVE chunks by their resolved pool (op
-                # order is preserved — groups split only at chunk
-                # boundaries).  More than one group only when a migration
-                # committed mid-segment.
-                groups = []  # (pool, [(nops, row, opcode)], idx_lo, idx_hi)
+                # Group CONSECUTIVE device chunks by their resolved pool
+                # (op order is preserved — groups split at chunk
+                # boundaries and at mirror-served chunks).  More than one
+                # group only when a migration or demotion committed
+                # mid-segment.
+                groups = []  # ("dev", pool, runs, lo, hi) | ("mir", res,...)
                 off = 0
-                for nops, (e, op) in metas:
-                    pool, row = e.pool, e.row
-                    if groups and groups[-1][0] is pool:
-                        groups[-1][1].append((nops, row, op))
-                        groups[-1][3] = off + nops
+                for mi, (nops, (e, op)) in enumerate(metas):
+                    part = mirror_parts.get(mi)
+                    if part is not None:
+                        groups.append(("mir", part, None, off, off + nops))
                     else:
-                        groups.append([pool, [(nops, row, op)], off, off + nops])
+                        pool, row = e.pool, e.row
+                        if (
+                            groups and groups[-1][0] == "dev"
+                            and groups[-1][1] is pool
+                        ):
+                            groups[-1][2].append((nops, row, op))
+                            groups[-1][4] = off + nops
+                        else:
+                            groups.append(
+                                ["dev", pool, [(nops, row, op)],
+                                 off, off + nops]
+                            )
                     off += nops
                 results = []
-                for gi, (pool, runs, lo, hi) in enumerate(groups):
+                # Mirror parts already applied: any later failure must
+                # not blind-retry the whole segment (re-applying them).
+                applied = bool(mirror_parts)
+                for tag, pool, runs, lo, hi in groups:
+                    if tag == "mir":
+                        results.append(pool)  # the ImmediateResult
+                        continue
                     gidx = cols[0][lo:hi]
-                    if gi > 0:
-                        # Earlier groups already mutated device state: a
-                        # failure from here on must NOT be blind-retried
-                        # (double-applying OP_FLIP/OP_SET of group 0).
+                    if applied:
+                        # Earlier groups/mirror parts already mutated
+                        # state: a failure from here on must NOT be
+                        # blind-retried (double-applying OP_FLIP/OP_SET).
                         try:
                             results.append(
                                 self._bitset_dispatch_group(
@@ -2169,11 +2394,13 @@ class TpuSketchEngine(SketchDurabilityMixin):
                             )
 
                             raise NonRetryableDispatchError(
-                                f"group {gi} of a migration-split launch "
-                                f"failed after earlier groups applied"
+                                "a later group of a split mixed-bit "
+                                "launch failed after earlier groups "
+                                "applied"
                             ) from exc
                         continue
                     results.append(self._bitset_dispatch_group(pool, gidx, runs))
+                    applied = True
                 return results[0] if len(results) == 1 else _ConcatLazy(results)
 
         return self._submit(
@@ -2264,6 +2491,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
         cap = entry.pool.row_units * 32
         in_range = idx < cap
         safe_idx = np.where(in_range, idx, 0).astype(np.uint32)
+        row0 = entry.row  # BEFORE the residency check (see _tier_row)
         res = self._serve_degraded(
             entry, len(idx), lambda mir: mir.mixed(
                 safe_idx, np.full(len(idx), bitset_ops.OP_GET, np.uint32)
@@ -2274,7 +2502,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if self.coalescer is not None:
             fut = self._bitset_submit_mixed(entry, safe_idx, bitset_ops.OP_GET)
             return _MappedFuture(fut, lambda v: v & in_range)
-        rows = np.full(len(idx), entry.row, np.int32)
+        rows = np.full(len(idx), self._tier_row(entry, row0), np.int32)
         res = self.executor.bitset_get(entry.pool, rows, safe_idx)
         return _MappedFuture(res, lambda v: v & in_range)
 
@@ -2317,11 +2545,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
             return 0
 
         def dispatch():
+            row0 = entry.row  # BEFORE the residency check
             res = self._serve_degraded(entry, 1, lambda mir: mir.cardinality())
             if res is not None:
                 return res
             self._drain()
-            return self.executor.bitset_cardinality(entry.pool, entry.row)
+            return self.executor.bitset_cardinality(
+                entry.pool, self._tier_row(entry, row0)
+            )
 
         return self._nc_scalar("bitset", name, ("card",), dispatch, captured)
 
@@ -2332,11 +2563,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
             return 0
 
         def dispatch():
+            row0 = entry.row  # BEFORE the residency check
             res = self._serve_degraded(entry, 1, lambda mir: mir.length())
             if res is not None:
                 return res
             self._drain()
-            return self.executor.bitset_length(entry.pool, entry.row)
+            return self.executor.bitset_length(
+                entry.pool, self._tier_row(entry, row0)
+            )
 
         return self._nc_scalar("bitset", name, ("len",), dispatch, captured)
 
@@ -2347,6 +2581,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
             return -1 if target_bit else 0
 
         def dispatch():
+            row0 = entry.row  # BEFORE the residency check
             res = self._serve_degraded(
                 entry, 1, lambda mir: mir.bitpos(int(target_bit))
             )
@@ -2354,7 +2589,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 return res
             self._drain()
             return self.executor.bitset_bitpos(
-                entry.pool, entry.row, target_bit
+                entry.pool, self._tier_row(entry, row0), target_bit
             )
 
         return self._nc_scalar(
@@ -2443,6 +2678,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if entry is None:
             return b""
         nbytes = -(-entry.params.get("nbits", 0) // 8)
+        row0 = entry.row  # BEFORE the residency check (see _tier_row)
         res = self._serve_degraded(
             entry, 1,
             lambda mir: np.packbits(
@@ -2452,7 +2688,9 @@ class TpuSketchEngine(SketchDurabilityMixin):
         if res is not None:
             return res.result()
         self._drain()
-        return self.executor.read_row(entry.pool, entry.row).tobytes()[:nbytes]
+        return self.executor.read_row(
+            entry.pool, self._tier_row(entry, row0)
+        ).tobytes()[:nbytes]
 
     # -- cms ---------------------------------------------------------------
 
@@ -2485,11 +2723,14 @@ class TpuSketchEngine(SketchDurabilityMixin):
         w = entry.params["width"]
 
         def dispatch():
+            row0 = entry.row  # BEFORE the residency check
             res = self._serve_degraded(entry, 1, lambda mir: mir.total())
             if res is not None:
                 return res
             self._drain()
-            row = self.executor.read_row(entry.pool, entry.row)
+            row = self.executor.read_row(
+                entry.pool, self._tier_row(entry, row0)
+            )
             return ImmediateResult(int(np.asarray(row[:w], np.uint64).sum()))
 
         return self._nc_scalar("cms", name, ("total",), dispatch, captured)
@@ -2569,7 +2810,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
     def _cms_estimate_dispatch(self, entry, H1, H2) -> LazyResult:
         d, w = entry.params["depth"], entry.params["width"]
         h1w, h2w = hashing.km_reduce_mod(H1, H2, w)
-        rows = np.full(len(H1), entry.row, np.int32)
+        row0 = entry.row  # BEFORE the residency check (see _tier_row)
         res = self._serve_degraded(
             entry, len(H1),
             lambda mir: mir.update_estimate(
@@ -2578,6 +2819,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
         )
         if res is not None:
             return res
+        rows = np.full(len(H1), self._tier_row(entry, row0), np.int32)
         if self.coalescer is not None:
             pool = entry.pool
             zeros = np.zeros(len(H1), np.uint32)

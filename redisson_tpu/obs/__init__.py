@@ -157,6 +157,25 @@ class Observability:
         self.nearcache_evictions = r.counter(
             "rtpu_nearcache_evictions",
             "near-cache entries evicted (quota or budget pressure)")
+        # Tiered residency (ISSUE 14): SWAPIN/SWAPOUT-style transition
+        # volume for the heat-based ladder (storage/residency.py);
+        # tier occupancy (device rows in use, host/disk bytes) is a
+        # set of render-time gauges the engine registers.
+        self.residency_promotions = r.counter(
+            "rtpu_residency_promotions",
+            "sketches promoted back to a device row (host/disk tier "
+            "→ device, through the prewarmed size-class pools)")
+        self.residency_demotions = r.counter(
+            "rtpu_residency_demotions",
+            "sketches demoted from a device row to an exact host "
+            "golden mirror (demoted is NOT degraded)")
+        self.residency_spills = r.counter(
+            "rtpu_residency_spills",
+            "host mirrors spilled to CRC-framed per-object disk blobs")
+        self.residency_loads = r.counter(
+            "rtpu_residency_loads",
+            "disk blobs loaded back into host mirrors (first touch of "
+            "a DISK-resident sketch)")
         # Front door vectorization (ISSUE 6): pipelined command runs fused
         # into single engine launches, plus the per-connection response
         # cache for repeated identical reads inside one pipeline window.

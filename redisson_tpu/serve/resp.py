@@ -111,6 +111,10 @@ _SHED_EXEMPT = frozenset((
     "INFO", "CONFIG", "CLIENT", "COMMAND", "SLOWLOG", "DEBUG",
     "SHUTDOWN", "SCRIPT", "WAIT", "MULTI", "EXEC", "DISCARD",
     "SUBSCRIBE", "UNSUBSCRIBE",
+    # Residency introspection (ISSUE 14): OBJECT FREQ/IDLETIME/ENCODING
+    # is how an operator reads the tier ladder DURING the overload that
+    # heat-based demotion exists to survive.
+    "OBJECT",
     # Cluster control plane (ISSUE 12): topology surgery and the
     # per-key migration pump must keep running DURING an overload —
     # resharding is how an operator relieves one.
@@ -2155,6 +2159,12 @@ class RespServer:
         return getattr(getattr(self._client, "_engine", None),
                        "nearcache", None)
 
+    def _residency(self):
+        """The fronted engine's residency manager (ISSUE 14), or None
+        on the host engine (no ladder to report or tune)."""
+        return getattr(getattr(self._client, "_engine", None),
+                       "residency", None)
+
     def _config_table_init(self) -> dict:
         table = dict(self._CONFIG_KEYS)
         nc = self._nearcache()
@@ -2186,6 +2196,17 @@ class RespServer:
             "latency-monitor-threshold":
                 str(self.obs.latency.threshold_ms),
         })
+        rm = self._residency()
+        if rm is not None:
+            # Tiered residency (ISSUE 14): budgets and the promotion
+            # threshold live-apply to the manager (arming a budget
+            # starts the maintenance thread).
+            table.update({
+                "residency-device-rows": str(rm.device_rows),
+                "residency-max-host-bytes": str(rm.max_host_bytes),
+                "residency-max-disk-bytes": str(rm.max_disk_bytes),
+                "residency-promote-heat": f"{rm.promote_heat:g}",
+            })
         eng = getattr(self._client, "_engine", None)
         # Durability tier (ISSUE 10): appendonly/appendfsync are LIVE on
         # an engine that carries the journal surface — CONFIG SET
@@ -2228,6 +2249,43 @@ class RespServer:
             nc.store.resize(tenant_quota_bytes=int(val))
         elif key == "nearcache-max-batch":
             nc.max_batch = int(val)
+
+    # Residency-ladder knobs (ISSUE 14) with bounds validation before
+    # apply (the nearcache/overload pattern): budgets are >= 0 ints
+    # (0 disables that tier bound), the promote threshold a >= 0 float.
+    _RESIDENCY_KEYS = frozenset((
+        "residency-device-rows", "residency-max-host-bytes",
+        "residency-max-disk-bytes", "residency-promote-heat",
+    ))
+
+    def _validate_residency_config(self, key: str, raw: bytes) -> None:
+        try:
+            fv = float(raw)
+            if key != "residency-promote-heat":
+                fv = int(raw)
+        except ValueError:
+            raise RespError(
+                f"Invalid argument '{raw.decode()}' for CONFIG SET "
+                f"'{key}'"
+            )
+        if fv < 0:
+            raise RespError(
+                f"argument must be >= 0 for CONFIG SET '{key}' "
+                f"(0 disables this bound)"
+            )
+
+    def _apply_residency_config(self, key: str, val: str) -> None:
+        rm = self._residency()
+        if rm is None:  # validated against the table: can't happen
+            return
+        if key == "residency-device-rows":
+            rm.set_budget(device_rows=int(val))
+        elif key == "residency-max-host-bytes":
+            rm.set_budget(max_host_bytes=int(val))
+        elif key == "residency-max-disk-bytes":
+            rm.set_budget(max_disk_bytes=int(val))
+        elif key == "residency-promote-heat":
+            rm.set_budget(promote_heat=float(val))
 
     # Overload knobs (ISSUE 7) with bounds validation: CONFIG SET
     # rejects nonsense (negative deadline, zero watermark) instead of
@@ -2370,6 +2428,8 @@ class RespServer:
                     )
                 if key in self._OVERLOAD_KEYS:
                     self._validate_overload_config(key, pairs[i + 1])
+                elif key in self._RESIDENCY_KEYS:
+                    self._validate_residency_config(key, pairs[i + 1])
                 elif key in self._TELEMETRY_KEYS:
                     self._validate_telemetry_config(key, pairs[i + 1])
                 elif key == "appendonly":
@@ -2480,6 +2540,8 @@ class RespServer:
                     self.obs.slowlog.set_max_len(int(val))
                 elif key in self._OVERLOAD_KEYS:
                     self._apply_overload_config(key, val)
+                elif key in self._RESIDENCY_KEYS:
+                    self._apply_residency_config(key, val)
                 elif key in self._TELEMETRY_KEYS:
                     self._apply_telemetry_config(key, val)
                 elif key.startswith("nearcache"):
@@ -2686,6 +2748,48 @@ class RespServer:
 
             _time.sleep(float(args[1]))
             return _encode_simple("OK")
+        if sub == "RESIDENCY":
+            # DEBUG RESIDENCY DEMOTE|PROMOTE|SPILL|LOAD <key> | TICK —
+            # the residency ladder's forcing surface (ISSUE 14): soak
+            # tests and operators drive exact transitions without
+            # waiting out heat decay.  Admin-gated like DEBUG INJECT.
+            if not self._inject_allowed:
+                raise RespError(
+                    "DEBUG RESIDENCY on a non-loopback bind requires "
+                    "requirepass (tier forcing is an admin surface)"
+                )
+            rm = self._residency()
+            if rm is None:
+                raise RespError(
+                    "this engine has no residency manager (host engine)"
+                )
+            if len(args) < 2:
+                raise RespError(
+                    "DEBUG RESIDENCY DEMOTE|PROMOTE|SPILL|LOAD <key> "
+                    "| TICK"
+                )
+            verb = args[1].decode().upper()
+            if verb == "TICK":
+                out = rm.maintain()
+                return _encode_array([
+                    f"{k} {v}".encode() for k, v in sorted(out.items())
+                ])
+            if verb not in ("DEMOTE", "PROMOTE", "SPILL", "LOAD") or (
+                len(args) < 3
+            ):
+                raise RespError(
+                    "DEBUG RESIDENCY DEMOTE|PROMOTE|SPILL|LOAD <key> "
+                    "| TICK"
+                )
+            fn = {
+                "DEMOTE": rm.demote, "PROMOTE": rm.promote,
+                "SPILL": rm.spill, "LOAD": rm.load,
+            }[verb]
+            try:
+                ok = fn(self._s(args[2]))
+            except (OSError, ValueError) as e:
+                raise RespError(f"residency {verb.lower()}: {e}") from e
+            return _encode_int(1 if ok else 0)
         if sub == "INJECT":
             # DEBUG INJECT <point> <kind> <rate> [seed] | DEBUG INJECT OFF
             # — the chaos engine's RESP admin surface (docs/robustness.md),
@@ -2729,9 +2833,16 @@ class RespServer:
         raise RespError(f"unsupported DEBUG subcommand {sub}")
 
     def _cmd_OBJECT(self, args):
-        """Minimal OBJECT surface (clients probe ENCODING for display):
-        one in-memory representation per kind, reported with the closest
-        Redis encoding name."""
+        """OBJECT introspection (ISSUE 14 satellite): for sketch
+        objects the answers come from the residency ladder's live
+        state — FREQ is the decayed access heat (the exact counter the
+        demotion/promotion ranking uses), IDLETIME the seconds since
+        the last engine-entry touch, and ENCODING reports the
+        residency TIER (``device`` | ``host`` | ``disk``) so an
+        operator can see where a key lives without DEBUG access.  Grid
+        kinds keep the closest Redis encoding name.  Shed-exempt like
+        the other introspection commands — it answers during the
+        incident it helps debug."""
         sub = args[0].decode().upper()
         if sub == "HELP":
             return _encode_array([
@@ -2743,10 +2854,21 @@ class RespServer:
             raise RespError(
                 "wrong number of arguments for 'object' command"
             )
-        kind = self._kind_of(self._s(args[1]))
+        name = self._s(args[1])
+        kind = self._kind_of(name)
         if kind is None:
             raise RespError("no such key")
+        rm = self._residency()
+        sketch_entry = None
+        if rm is not None:
+            reg = getattr(self._client._engine, "registry", None)
+            if reg is not None:
+                sketch_entry = reg.lookup(name)
         if sub == "ENCODING":
+            if sketch_entry is not None:
+                return _encode_bulk(
+                    getattr(sketch_entry, "residency", "device").encode()
+                )
             enc = {
                 "string": "embstr", "list": "quicklist",
                 "hash": "hashtable", "set": "hashtable",
@@ -2756,8 +2878,12 @@ class RespServer:
         if sub == "REFCOUNT":
             return _encode_int(1)
         if sub == "IDLETIME":
+            if sketch_entry is not None:
+                return _encode_int(int(rm.heat.idle_s(name)))
             return _encode_int(0)
         if sub == "FREQ":
+            if sketch_entry is not None:
+                return _encode_int(int(round(rm.heat.heat(name))))
             return _encode_int(0)
         raise RespError(f"Unknown OBJECT subcommand {sub}")
 
@@ -3445,6 +3571,30 @@ class RespServer:
                     "maxmemory:0",
                     "maxmemory_policy:noeviction",
                 ]
+                # Tiered residency (ISSUE 14): where the keyspace
+                # actually lives — fast-tier occupancy vs budget, the
+                # host-mirror and disk-blob footprints, and lifetime
+                # transition counts (the SWAPIN/SWAPOUT view).
+                rm = self._residency()
+                if rm is not None:
+                    st = rm.stats()
+                    lines += [
+                        f"residency_device_rows:{st['device_rows_used']}",
+                        f"residency_device_rows_budget:"
+                        f"{st['device_rows_budget']}",
+                        f"residency_host_objects:{st['host_objects']}",
+                        f"residency_host_bytes:{st['host_bytes']}",
+                        f"residency_max_host_bytes:{rm.max_host_bytes}",
+                        f"residency_disk_objects:{st['disk_objects']}",
+                        f"residency_disk_bytes:{st['disk_bytes']}",
+                        f"residency_max_disk_bytes:{rm.max_disk_bytes}",
+                        f"residency_promote_heat:{rm.promote_heat:g}",
+                        f"residency_promotions:{st['promotions']}",
+                        f"residency_demotions:{st['demotions']}",
+                        f"residency_spills:{st['spills']}",
+                        f"residency_loads:{st['loads']}",
+                        f"residency_host_serves:{st['host_serves']}",
+                    ]
             elif s == "stats":
                 total_cmds = (
                     sum(int(c.value) for _, c in obs.resp_commands.items())

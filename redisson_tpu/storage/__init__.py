@@ -1,0 +1,26 @@
+"""Tiered sketch storage (ISSUE 14): the heat-based residency ladder.
+
+Device rows become a CACHE over host golden mirrors over per-object
+disk blobs — the addressable tenant population is bounded by host+disk,
+not HBM.  ``heat.py`` tracks decayed access heat per object;
+``residency.py`` drives demotion/promotion/spill/load against a
+device-rows budget.
+"""
+
+from redisson_tpu.storage.heat import HeatTracker
+from redisson_tpu.storage.residency import (
+    DEVICE,
+    DISK,
+    HOST,
+    ROW_NONE,
+    ResidencyManager,
+)
+
+__all__ = [
+    "HeatTracker",
+    "ResidencyManager",
+    "DEVICE",
+    "HOST",
+    "DISK",
+    "ROW_NONE",
+]

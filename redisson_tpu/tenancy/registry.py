@@ -352,6 +352,10 @@ class TenantEntry:
     # Read replication (SURVEY §2.4 replication row): one row per mesh
     # shard (index s holds the copy with row % S == s); None = single copy.
     replica_rows: Optional[list] = None
+    # Residency ladder (ISSUE 14, storage/residency.py): "device" —
+    # ``row`` is live; "host" — row is ROW_NONE (-1) and the truth is a
+    # golden mirror; "disk" — row is ROW_NONE and the truth is a blob.
+    residency: str = "device"
 
 
 class TenantRegistry:
@@ -362,6 +366,12 @@ class TenantRegistry:
         self._lock = _witness.named(threading.RLock(), "tenancy.registry")
         self._tenants: dict[str, TenantEntry] = {}
         self._pools: dict[tuple, SizeClassPool] = {}
+        # Residency alloc gate (ISSUE 14): when set and True at create
+        # time, try_create births the tenant HOST-resident (row -1, a
+        # zero-seeded mirror installs on first touch) instead of
+        # growing a pool past the device-rows budget — HBM holds the
+        # working set, not the keyspace.
+        self.alloc_gate = None
 
     def lookup(self, name: str) -> Optional[TenantEntry]:
         with self._lock:
@@ -395,8 +405,18 @@ class TenantRegistry:
                     )
                 return entry, False
             pool = self.pool_for(kind, class_key)
-            row = pool.alloc_row()
-            entry = TenantEntry(name, kind, pool, row, dict(params))
+            gate = self.alloc_gate
+            if gate is not None and gate():
+                # Born cold: device budget full — no row; the engine's
+                # first-touch load installs a zero-seeded host mirror.
+                entry = TenantEntry(
+                    name, kind, pool, -1, dict(params),
+                    residency="host",
+                )
+            else:
+                entry = TenantEntry(
+                    name, kind, pool, pool.alloc_row(), dict(params)
+                )
             self._tenants[name] = entry
             return entry, True
 

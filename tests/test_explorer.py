@@ -556,7 +556,10 @@ def test_history_mirror_seed_epoch_guard():
 
     device = {"row": "v0"}
     health = _HealthStub()
-    entry = types.SimpleNamespace(name="t", kind="bloom")
+    # row=0: a DEVICE-resident entry (ISSUE 14 gave _degraded a
+    # row-less fast path that would short-circuit the seeding under
+    # test here).
+    entry = types.SimpleNamespace(name="t", kind="bloom", row=0)
     stub = types.SimpleNamespace(
         _mirrors={},
         _mirror_lock=threading.RLock(),
@@ -953,3 +956,219 @@ def test_run_fence_malformed_member_barriers():
     assert run is None  # fence at index 1 leaves a 1-command non-run
     short = [[b"CMS.QUERY", b"c", b"x"], [b"CMS.QUERY", b"c"]]
     assert RespServer._collect_cms_run(short, 0, [a, a]) is None
+
+
+# -- model check 8 (ISSUE 14): residency-ladder state machine -----------------
+
+
+def _residency_ladder_body(promote_repoints_before_drop=True,
+                           full_cast=True):
+    """Faithful miniature of storage/residency.py's transition protocol
+    on ONE object: a writer (the engine's gate-held check->submit
+    discipline), a mover cycling demote -> promote, a breaker flap
+    (open -> epoch-guarded seed -> reconcile write-back), and a
+    snapshot reader using the capture-row-BEFORE-check + _tier_row
+    read discipline.  The object's value is a monotone counter, so
+    every invariant is a one-liner:
+
+    - a read must resolve to a REAL location (mirror or row >= 0) and
+      must see every write acked before the read began (no stale
+      reads, single-register linearizability);
+    - after quiescence the truth equals the acked count (no schedule
+      loses an acked write).
+
+    ``promote_repoints_before_drop=False`` mutates promotion into the
+    drop-mirror-THEN-repoint ordering the shipped code forbids
+    (residency.py repoints ``entry.row`` while still holding the
+    mirror lock, before ``del _mirrors[name]``) — under that mutation
+    a reader can catch the object with no mirror AND no row, the
+    exact window the real ordering closes.  ``full_cast=False`` spawns
+    only the mover + reader — the focused cast the mutation hunt
+    needs (4 threads push the failing interleaving past the bounded
+    search's horizon; 2 keep it a few hundred schedules deep)."""
+    gate = threading.RLock()    # the engine's journal gate
+    mlock = threading.RLock()   # the engine's mirror lock
+    name = "t"
+    st = {
+        "row": 0,               # entry.row (-1 = no device row)
+        "rows": {0: 0},         # device storage; quarantined rows keep
+        "next_row": 1,          # their pre-demotion contents (reclaim
+        "quarantine": [],       # is a later, post-drain cycle)
+        "mirrors": {},          # name -> {"v": int, "res": bool}
+        "epoch": 0,             # _mirror_epoch
+        "acked": 0,
+        "degraded": False,      # the kind's breaker
+    }
+
+    def writer():
+        # The engine's mutating-op discipline: the WHOLE
+        # check-residency -> submit window runs under the gate, so no
+        # write is in flight while a transition holds it.
+        for _ in range(2):
+            with gate:
+                with mlock:
+                    mir = st["mirrors"].get(name)
+                    if mir is None and st["degraded"] and st["row"] >= 0:
+                        # Degraded write: seed the breaker mirror from
+                        # the (gate-stable) row, then apply to it.
+                        mir = {"v": st["rows"][st["row"]], "res": False}
+                        st["mirrors"][name] = mir
+                    if mir is not None:
+                        mir["v"] += 1
+                        st["acked"] += 1
+                        continue_to_next = True
+                    else:
+                        continue_to_next = False
+                if not continue_to_next:
+                    # Apply is modeled atomic with the gate-held
+                    # submit: every row reader that could observe the
+                    # gap (demote's capture, the breaker seed, the
+                    # snapshot's _host_row) DRAINS the coalescer before
+                    # reading, so a gate-submitted op has landed by the
+                    # time any of them sees the row.
+                    st["rows"][st["row"]] += 1
+                    st["acked"] += 1
+            checkpoint("between writes")
+
+    def mover():
+        # demote (residency.py demote(), line for line) ...
+        with gate:
+            if st["row"] >= 0 and not st["degraded"] \
+                    and name not in st["mirrors"]:
+                checkpoint("demote: row captured after drain")
+                val = st["rows"][st["row"]]
+                checkpoint("demote: mirror built, pre-install")
+                with mlock:
+                    if name not in st["mirrors"] and not st["degraded"]:
+                        st["mirrors"][name] = {"v": val, "res": True}
+                        st["epoch"] += 1
+                        st["quarantine"].append(st["row"])
+                        st["row"] = -1
+        checkpoint("between demote and promote")
+        # ... then promote (residency.py promote())
+        with gate:
+            if st["row"] < 0 and not st["degraded"]:
+                with mlock:
+                    mir = st["mirrors"].get(name)
+                    if mir is not None and mir["res"]:
+                        row = st["next_row"]
+                        st["next_row"] += 1
+                        st["rows"][row] = mir["v"]
+                        if promote_repoints_before_drop:
+                            # Shipped ordering: row live BEFORE the
+                            # mirror drops (still under mlock).
+                            st["row"] = row
+                            del st["mirrors"][name]
+                            st["epoch"] += 1
+                            drop_late = False
+                        else:
+                            del st["mirrors"][name]
+                            st["epoch"] += 1
+                            drop_late = True
+                if not promote_repoints_before_drop and drop_late:
+                    # MUTATION: the repoint happens in a second lock
+                    # section — readers can interleave into the gap.
+                    checkpoint("BUG window: no mirror, no row")
+                    with mlock:
+                        st["row"] = row
+
+    def breaker_flap():
+        st["degraded"] = True
+        checkpoint("breaker opens")
+        # The epoch-guarded seeding loop (_degraded's discipline).
+        for _ in range(2):
+            with mlock:
+                if name in st["mirrors"]:
+                    break
+                epoch = st["epoch"]
+            row0 = st["row"]
+            if row0 < 0:
+                break  # row retired mid-seed: demoted mirror serves
+            checkpoint("seed read dispatched")
+            val = st["rows"][row0]
+            checkpoint("seed read resolves")
+            with mlock:
+                if st["epoch"] != epoch:
+                    continue  # stale row snapshot: discard, re-seed
+                if name not in st["mirrors"]:
+                    st["mirrors"][name] = {"v": val, "res": False}
+                break
+        checkpoint("breaker closes, reconcile runs")
+        # Reconcile writes back BREAKER mirrors only — a demoted-tier
+        # mirror has no device row and stays the truth (engines.py
+        # _reconcile_kind_inner's residency guard).
+        with gate:
+            with mlock:
+                mir = st["mirrors"].get(name)
+                if mir is not None and not mir["res"]:
+                    st["rows"][st["row"]] = mir["v"]
+                    del st["mirrors"][name]
+                    st["epoch"] += 1
+            st["degraded"] = False
+
+    def snapshot_reader():
+        # The read discipline every engine read site follows: capture
+        # entry.row BEFORE the residency check, resolve via _tier_row.
+        for _ in range(2):
+            lo = st["acked"]    # acked before the read began
+            row0 = st["row"]    # capture BEFORE the mirror check
+            checkpoint("snapshot: row captured")
+            with mlock:
+                mir = st["mirrors"].get(name)
+                v = None if mir is None else mir["v"]
+            if v is None:
+                r = st["row"] if row0 < 0 else row0  # _tier_row
+                assert r >= 0, (
+                    "read dispatched with no mirror and no device row "
+                    "(row -1) — the promote repoint-before-drop "
+                    "ordering was violated"
+                )
+                checkpoint("snapshot: device read in flight")
+                v = st["rows"][r]
+            assert v >= lo, (
+                f"stale read: saw {v} but {lo} writes were acked "
+                f"before the read began"
+            )
+            checkpoint("between snapshot reads")
+
+    cast = (
+        (writer, mover, breaker_flap, snapshot_reader)
+        if full_cast else (mover, snapshot_reader)
+    )
+    threads = [threading.Thread(target=f) for f in cast]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with mlock:
+        mir = st["mirrors"].get(name)
+        truth = mir["v"] if mir is not None else st["rows"][st["row"]]
+    assert truth == st["acked"], (
+        f"acked-write loss: truth={truth}, acked={st['acked']} "
+        f"(tier={'mirror' if mir is not None else 'device'})"
+    )
+
+
+@schedule_test(max_schedules=1200, random_schedules=128,
+               preemption_bound=2, max_steps=200000)
+def test_model_residency_ladder_no_lost_write_no_stale_read():
+    _residency_ladder_body()
+
+
+def test_model_residency_promote_drop_order_found_and_replayed():
+    """The replay-token test the ISSUE 14 satellite asks for: mutate
+    promotion into drop-mirror-before-repoint and the explorer FINDS a
+    schedule where a reader resolves to row -1 (or a write lands in a
+    dropped mirror), prints a token, and the token replays exactly
+    that schedule."""
+    def buggy():
+        _residency_ladder_body(promote_repoints_before_drop=False,
+                               full_cast=False)
+
+    with pytest.raises(ScheduleFailure) as ei:
+        explore(buggy, max_schedules=3000, random_schedules=256,
+                preemption_bound=2, max_steps=200000)
+    token = ei.value.token
+    with pytest.raises(ScheduleFailure) as ei2:
+        explore(buggy, replay=token)
+    assert ei2.value.token == token

@@ -40,6 +40,10 @@ def test_shipped_tree_catalog_covers_all_tiers():
                      "cluster.supervisor", "cluster.client.table",
                      "cluster.client.conn"):
         assert expected in names, f"missing {expected}"
+    # ...and the residency ladder (ISSUE 14): the heat table and the
+    # manager's tier-accounting lock.
+    for expected in ("storage.heat", "storage.residency"):
+        assert expected in names, f"missing {expected}"
 
 
 def test_shipped_tree_has_no_lock_order_cycles():
