@@ -33,7 +33,8 @@ checks that can never regress:
 # loads only when something actually lints or explores (the CLI,
 # tests).
 _ANALYZER_EXPORTS = frozenset((
-    "RULES", "Violation", "lint_file", "lint_paths", "lint_source",
+    "RULES", "StaleSuppression", "Violation", "audit_paths",
+    "lint_file", "lint_paths", "lint_source",
 ))
 _LOCKGRAPH_EXPORTS = frozenset((
     "build_graph", "find_cycles", "lint_tree", "load_runtime_edges",

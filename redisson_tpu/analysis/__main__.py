@@ -32,6 +32,15 @@ def main(argv=None) -> int:
                     help="files/directories to lint (default: redisson_tpu)")
     ap.add_argument("--rule", action="append", dest="rules", metavar="RTnnn",
                     help="run only these rules (repeatable)")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parallel per-file analysis on N processes "
+                         "(0 = cpu count; findings are byte-identical "
+                         "to --jobs 1)")
+    ap.add_argument("--audit-suppressions", action="store_true",
+                    help="also report STALE '# rtpulint: disable=' "
+                         "comments (their rule no longer fires when "
+                         "removed) and exit 1 on any — dead armor "
+                         "silences real future findings")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print suppressed findings")
     ap.add_argument("--list-rules", action="store_true")
@@ -119,7 +128,7 @@ def main(argv=None) -> int:
     )
     violations = []
     if file_rules is None or file_rules:
-        violations = lint_paths(paths, rules=file_rules)
+        violations = lint_paths(paths, rules=file_rules, jobs=args.jobs)
 
     graph = None
     if run_graph:
@@ -135,10 +144,30 @@ def main(argv=None) -> int:
         )
         violations.extend(cycle_violations)
 
+    stale = []
+    if args.audit_suppressions:
+        from redisson_tpu.analysis.rtpulint import audit_paths
+
+        # RT010-naming comments verify against the lock graph's
+        # consumed sites; without the whole-tree pass they are skipped
+        # (the audit never guesses).
+        rt010_sites = graph.suppressed_sites if graph is not None \
+            else None
+        stale = audit_paths(
+            paths, jobs=args.jobs, rt010_sites=rt010_sites,
+            # The all-rules pass above already holds every
+            # suppressed hit — reuse it rather than linting the
+            # tree a second time (only when no --rule filter
+            # narrowed it).
+            violations=violations if file_rules is None else None,
+        )
+
     live = [v for v in violations if not v.suppressed]
     suppressed = [v for v in violations if v.suppressed]
     for v in live:
         print(v.format())
+    for s in stale:
+        print(s.format())
     if args.show_suppressed:
         for v in suppressed:
             print(v.format())
@@ -149,12 +178,14 @@ def main(argv=None) -> int:
             f"{len(graph.edges)} edges, "
             f"{len(graph.suppressed)} suppressed edges"
         )
+    if args.audit_suppressions:
+        tail += f"; suppression audit: {len(stale)} stale"
     print(
         f"rtpulint: {len(live)} violation(s), "
         f"{len(suppressed)} suppressed{tail}",
         file=sys.stderr,
     )
-    return 1 if live else 0
+    return 1 if live or stale else 0
 
 
 if __name__ == "__main__":

@@ -533,6 +533,13 @@ class ExpLock:
                 self._count -= 1
                 if self._count <= 0:
                     self._owner = None
+                    if self in st.held:
+                        st.held.remove(self)
+                    # A killed thread unwinding its with-blocks must
+                    # still hand the lock on, or every healthy waiter
+                    # deadlocks on a lock nobody holds (the netsim
+                    # crash-injection path kills mid-protocol).
+                    self._wake_waiters()
             return
         run.yield_point(st, f"release {self._created_at}")
         if self._owner is not st:
@@ -906,6 +913,42 @@ def vclock() -> float:
     return _active.clock if _active is not None else _real_monotonic()
 
 
+def decide(nalts: int, label: str = "choice") -> int:
+    """A MODEL-level decision point: pick one of ``nalts`` branches
+    from the schedule's decision source, so fault injection (drop this
+    message?  crash here?  torn or clean kill?) is explored/replayed by
+    the SAME DFS + replay-token machinery as thread interleavings — one
+    ``RTPU_SCHEDULE_REPLAY`` token pins both.  Returns 0 outside an
+    explorer run (models default to the fault-free branch), so model
+    code can be exercised without the scheduler."""
+    if nalts <= 1:
+        return 0
+    st = _cur_sim()
+    if st is None:
+        return 0
+    return st.run.decisions.pick(nalts)
+
+
+def kill(thread) -> bool:
+    """Kill a simulated thread from model code — the netsim crash
+    primitive (a node dying mid-protocol).  Accepts the patched
+    ``threading.Thread`` wrapper or a raw ``_SimThread``.  The victim
+    dies at its NEXT sync point (``_Killed`` unwinds its frames, so
+    ``with`` blocks release their locks and wake waiters); a blocked
+    victim is woken to die.  Returns False when the thread was not a
+    live simulated thread."""
+    sim = thread if isinstance(thread, _SimThread) else getattr(
+        thread, "_sim", None
+    )
+    if sim is None or sim.state == "done":
+        return False
+    sim.killed = True
+    if sim.state == "blocked":
+        sim.state = "runnable"
+        sim.wake_at = None
+    return True
+
+
 def _run_schedule(fn, decisions: _Decisions, *, preemption_bound,
                   max_steps) -> Optional[tuple]:
     """One schedule; returns the first failure (thread, exc) or None."""
@@ -1021,7 +1064,9 @@ __all__ = [
     "ScheduleFailure",
     "ScheduleOverrun",
     "checkpoint",
+    "decide",
     "explore",
+    "kill",
     "schedule_test",
     "vclock",
 ]

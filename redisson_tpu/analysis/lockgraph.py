@@ -112,6 +112,9 @@ class LockGraph:
     edges: Dict[Tuple[str, str], List[EdgeSite]] = field(default_factory=dict)
     # edges dropped by a reasoned RT010 suppression: (src, dst) -> reason
     suppressed: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    # (file, comment_line) of every RT010 suppression that actually
+    # swallowed an edge — the stale-suppression audit's ground truth
+    suppressed_sites: Set[Tuple[str, int]] = field(default_factory=set)
 
     def add_edge(self, src: str, dst: str, site: EdgeSite) -> None:
         if src == dst:
@@ -503,8 +506,12 @@ def build_graph(paths: Iterable[str],
                 supp, _role, _bad = _scan_comments(sources.get(fp, ""))
                 supp_cache[fp] = supp
             table = supp_cache[fp]
-        for rules, reason in table.get(line, ()):
+        for rules, reason, cline in table.get(line, ()):
             if "RT010" in rules:
+                # Consumed-site record: the stale-suppression audit
+                # (--audit-suppressions) verifies RT010 comments
+                # against exactly this set.
+                graph.suppressed_sites.add((fp, cline))
                 return reason
         return None
 

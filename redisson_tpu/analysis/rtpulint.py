@@ -70,6 +70,32 @@ RT011  Span-lifecycle completeness (the RT009 analog for OpSpan/trace
        nothing: phase histograms silently under-count and the trace it
        belonged to loses the hop.  Resolving inside a ``try`` whose
        ``except`` swallows strands it the same way.
+RT012  One-shot connection licenses (ISSUE 15; the PR 12/13 review
+       class: ASKING leaking past PING, the trace prelude surviving an
+       errored dispatch): a function that READS a license attribute
+       (``.asking``, ``.trace_next`` — incl. the ``getattr`` form)
+       must also BURN it (store a falsy constant to the same
+       attribute, or call the shared burner
+       ``consume_one_shot_licenses``) — or be the granting site (a
+       truthy store).  A read-without-burn dispatch path serves a
+       later unrelated command under a stale license; fused runs and
+       cache hits are dispatch paths too.
+RT013  Pooled-socket desync discipline (the PR 12 review class): an
+       ``except OSError``-family arm around wire I/O (``sendall``/
+       ``recv``/``connect``/``exchange``/pooled ``request``) must
+       DROP the socket — close/abort it, pop it from its pool, call a
+       ``*drop*``/``*discard*`` helper, or re-raise.  A swallowed
+       OSError leaves unread reply bytes in flight; the next command
+       on that socket reads them as its OWN replies (silent
+       cross-command corruption).
+RT014  Tmp-file persistence discipline (the snapshot/blob/journal
+       publish rule): an ``os.replace``/``os.rename`` whose SOURCE is
+       a tmp file must be preceded by an ``os.fsync`` in the same
+       function (rename-before-fsync publishes a name whose bytes a
+       crash can void), and the FINAL path must not escape (return /
+       store into shared state / non-path call) before the rename —
+       a reference that escapes early points at a file that does not
+       durably exist yet.
 
 Suppression: ``# rtpulint: disable=RT001 <reason>`` on the offending
 line, or alone on the line directly above it.  The reason is mandatory
@@ -104,6 +130,9 @@ RULES = {
     "RT009": "created future not resolved/handed off on all paths",
     "RT010": "static lock-order cycle (whole-tree pass)",
     "RT011": "created span not ended/abandoned on all paths",
+    "RT012": "one-shot license read without a burn on the dispatch path",
+    "RT013": "pooled socket kept after an except-OSError arm",
+    "RT014": "tmp-file rename without fsync / final path escapes early",
 }
 
 # Roles a rule applies to.  "*" = every non-test module.
@@ -121,6 +150,11 @@ _RULE_ROLES = {
     "RT008": {"*"},  # self-scoping: only fires next to epoch-bump calls
     "RT009": {"*"},  # self-scoping: only fires where a future is created
     "RT011": {"*"},  # self-scoping: only fires where a span is created
+    "RT012": {"*"},  # self-scoping: only fires where a license is read
+    # Wire-I/O modules only: serve/ and cluster/ own the pooled sockets
+    # (journal/host OSError arms are file-I/O cleanup, not wire desync).
+    "RT013": {"serve"},
+    "RT014": {"*"},  # self-scoping: only fires at tmp-file renames
     # RT010 is a WHOLE-TREE rule (analysis/lockgraph.py): it has no
     # per-file check here, but lives in RULES so disable=RT010
     # suppressions parse and the CLI can name it.
@@ -171,10 +205,11 @@ _ROLE_RE = re.compile(r"#\s*rtpulint:\s*role=([a-z]+)")
 def _scan_comments(source: str):
     """(suppressions, role, bad_suppressions).
 
-    ``suppressions``: line -> list[(frozenset_of_rules, reason)].  A
-    comment sharing a line with code applies to that line; a
-    comment-only line applies to the next line (so a long offending
-    line can carry its reason above itself)."""
+    ``suppressions``: target line -> list[(frozenset_of_rules, reason,
+    comment_line)].  A comment sharing a line with code applies to that
+    line; a comment-only line applies to the next line (so a long
+    offending line can carry its reason above itself).  The comment's
+    OWN line rides along for the stale-suppression audit."""
     suppressions: dict[int, list] = {}
     bad: list[tuple[int, str]] = []
     role: Optional[str] = None
@@ -211,7 +246,7 @@ def _scan_comments(source: str):
             bad.append((line, "suppression has no reason"))
             continue
         target = line if line in code_lines else line + 1
-        suppressions.setdefault(target, []).append((rules, reason))
+        suppressions.setdefault(target, []).append((rules, reason, line))
     return suppressions, role, bad
 
 
@@ -289,6 +324,10 @@ _BLOCKING_ATTRS = {
     "device_put": "H2D transfer",
     "read_row": "device row read",
     "write_row": "device row write",
+    # zero_row blocks exactly like write_row (a device row store); its
+    # absence left two residency suppressions dead from day one — the
+    # first thing --audit-suppressions caught (ISSUE 15).
+    "zero_row": "device row zero",
     "drain": "coalescer drain barrier",
     "_drain": "coalescer drain barrier",
     "_jit": "jit compilation",
@@ -1097,6 +1136,288 @@ def _check_rt011(ctx) -> None:
                     )
 
 
+# -- RT012: one-shot connection licenses --------------------------------------
+
+# The license attributes of the one-shot class (extend here when a new
+# prelude flag lands — the rule then covers it tree-wide for free).
+_LICENSE_ATTRS = ("asking", "trace_next")
+# Calling any of these burns EVERY license (the shared discipline in
+# serve/resp.py that _safe_dispatch and the netsim harnesses ride).
+_LICENSE_BURNERS = ("consume_one_shot_licenses",)
+
+
+def _license_read(node):
+    """License attr name a node READS: ``x.asking`` (Load) or
+    ``getattr(x, "asking", ...)``."""
+    if (
+        isinstance(node, ast.Attribute)
+        and node.attr in _LICENSE_ATTRS
+        and isinstance(node.ctx, ast.Load)
+    ):
+        return node.attr
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "getattr"
+        and len(node.args) >= 2
+        and isinstance(node.args[1], ast.Constant)
+        and node.args[1].value in _LICENSE_ATTRS
+    ):
+        return node.args[1].value
+    return None
+
+
+def _check_rt012(ctx) -> None:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        reads: dict = {}   # attr -> first read line
+        burned: set = set()
+        granted: set = set()
+        calls_burner = False
+        for node in _walk_no_defs(fn):
+            attr = _license_read(node)
+            if attr is not None:
+                # Lexically FIRST read (walk order is not line order).
+                reads[attr] = min(
+                    reads.get(attr, node.lineno), node.lineno
+                )
+            if isinstance(node, ast.Call):
+                f = node.func
+                callee = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None
+                )
+                if callee in _LICENSE_BURNERS:
+                    calls_burner = True
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr in _LICENSE_ATTRS:
+                        v = node.value
+                        if isinstance(v, ast.Constant) and not v.value:
+                            burned.add(t.attr)
+                        else:
+                            granted.add(t.attr)
+        if not reads or calls_burner:
+            continue
+        for attr, line in sorted(reads.items(), key=lambda kv: kv[1]):
+            if attr in burned or attr in granted:
+                continue
+            ctx.report(
+                "RT012", line,
+                f"one-shot license {attr!r} is read but never burned "
+                f"on this dispatch path (no falsy store, no "
+                f"consume_one_shot_licenses call): a stale license "
+                f"leaks to a later unrelated command — burn it, or "
+                f"route the consumption through the shared burner",
+            )
+
+
+# -- RT013: pooled-socket desync discipline -----------------------------------
+
+# EAGAIN (BlockingIOError) / EINTR (InterruptedError) are RETRYABLE
+# nonblocking outcomes, not desync — deliberately absent.
+_RT013_ERRORS = frozenset((
+    "OSError", "IOError", "ConnectionError", "ConnectionResetError",
+    "ConnectionAbortedError", "BrokenPipeError", "TimeoutError",
+    "timeout", "error",
+))
+# Calls on a receiver that put REPLY-BEARING bytes in flight on it
+# (accept/connect carry no replies yet — a failed one cannot desync).
+_RT013_IO_ATTRS = frozenset((
+    "sendall", "send", "recv", "recv_into", "makefile", "request",
+    "exchange",
+))
+_RT013_IO_FUNCS = frozenset(("exchange",))
+_RT013_DROP_RE = re.compile(
+    r"close|abort|drop|discard|invalidate|reset|shutdown|kill",
+    re.IGNORECASE,
+)
+# A truthy flag like ``dead = True`` / ``eof = True`` defers the drop
+# to the teardown path the flag drives — the reactor idiom.
+_RT013_DOOM_FLAG_RE = re.compile(r"dead|eof|closed|broken|gone|fail")
+
+
+def _rt013_catches_oserror(handler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except swallows OSError too
+    names = []
+    nodes = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in nodes:
+        name = _terminal_name(n)
+        if name is not None:
+            names.append(name)
+    return any(n in _RT013_ERRORS for n in names)
+
+
+def _rt013_try_touches_wire(body) -> bool:
+    for stmt in body:
+        for node in _walk_no_defs(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _RT013_IO_ATTRS:
+                # Constant receivers (str.join-style) never carry wire.
+                if not isinstance(f.value, ast.Constant):
+                    return True
+            if isinstance(f, ast.Name) and f.id in _RT013_IO_FUNCS:
+                return True
+        # The statement itself may BE the wire call (walk above covers
+        # expressions; nothing else needed).
+    return False
+
+
+def _rt013_handler_drops(handler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True  # propagates: the caller's discipline applies
+        if isinstance(node, ast.Delete):
+            return True  # del pool[...]: dropped
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and \
+                        _RT013_DOOM_FLAG_RE.search(t.id.lower()) and \
+                        isinstance(node.value, ast.Constant) and \
+                        node.value.value:
+                    return True  # doom flag: teardown path drops it
+        if isinstance(node, ast.Call):
+            f = node.func
+            callee = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None
+            )
+            if callee is not None and (
+                callee in ("pop", "clear")
+                or _RT013_DROP_RE.search(callee)
+            ):
+                return True
+    return False
+
+
+def _check_rt013(ctx) -> None:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        if not _rt013_try_touches_wire(node.body):
+            continue
+        if node.finalbody and _rt013_handler_drops(
+            ast.Module(body=list(node.finalbody), type_ignores=[])
+        ):
+            continue  # a finally that drops covers every arm
+        for handler in node.handlers:
+            if not _rt013_catches_oserror(handler):
+                continue
+            if _rt013_handler_drops(handler):
+                continue
+            ctx.report(
+                "RT013", handler.lineno,
+                "except-OSError arm around wire I/O neither drops the "
+                "socket (close/abort/pop/*drop*) nor re-raises: unread "
+                "reply bytes stay in flight and the next command on "
+                "this socket reads them as its OWN replies — drop the "
+                "connection, never return it to the pool",
+            )
+
+
+# -- RT014: tmp-file fsync-then-rename discipline ------------------------------
+
+# Path-shaping calls a final-path name may feed BEFORE the rename
+# without "escaping" (building the path is not publishing it).
+_RT014_PATH_FUNCS = frozenset((
+    "join", "replace", "rename", "fspath", "basename", "dirname",
+    "abspath", "realpath", "encode", "fsync", "stat", "exists",
+))
+
+
+def _rt014_tmpish(node) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and "tmp" in n.id.lower():
+            return True
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) and \
+                "tmp" in n.value.lower():
+            return True
+    return False
+
+
+def _check_rt014(ctx) -> None:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        replaces: list = []  # (lineno, dst node)
+        fsync_lines: list = []
+        for node in _walk_no_defs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in (
+                "replace", "rename",
+            ) and _base_name(f.value) == "os" and len(node.args) >= 2:
+                if _rt014_tmpish(node.args[0]):
+                    replaces.append((node.lineno, node.args[1]))
+            if isinstance(f, ast.Attribute) and f.attr == "fsync":
+                fsync_lines.append(node.lineno)
+        if not replaces:
+            continue
+        for line, dst in replaces:
+            if not any(fl < line for fl in fsync_lines):
+                ctx.report(
+                    "RT014", line,
+                    "tmp-file rename without a preceding fsync: the "
+                    "rename publishes a name whose bytes a crash can "
+                    "void — fsync the tmp file (and the directory) "
+                    "BEFORE os.replace",
+                )
+            # Escape analysis only when the final path is a plain
+            # variable (a composed join(...) never materialized, so it
+            # cannot have escaped).  ALL-CAPS names are module-level
+            # constant paths — globally known by definition, so a
+            # pre-rename read (a staleness check on the EXISTING file)
+            # is not an escape of the fresh one.
+            if not isinstance(dst, ast.Name) or dst.id.isupper():
+                continue
+            dname = dst.id
+            for node in _walk_no_defs(fn):
+                nline = getattr(node, "lineno", None)
+                if nline is None or nline >= line:
+                    continue
+                if isinstance(node, ast.Return) and node.value is not None \
+                        and _mentions_name(node.value, dname):
+                    ctx.report(
+                        "RT014", nline,
+                        f"final path {dname!r} returned before the "
+                        f"rename: callers hold a name that does not "
+                        f"durably exist yet",
+                    )
+                elif isinstance(node, ast.Assign) and any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in node.targets
+                ) and _mentions_name(node.value, dname):
+                    ctx.report(
+                        "RT014", nline,
+                        f"final path {dname!r} stored into shared "
+                        f"state before the rename — the reference "
+                        f"escapes ahead of the durable publish",
+                    )
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    callee = f.attr if isinstance(f, ast.Attribute) \
+                        else (f.id if isinstance(f, ast.Name) else None)
+                    if callee in _RT014_PATH_FUNCS or callee is None:
+                        continue
+                    if any(
+                        _mentions_name(a, dname)
+                        for a in list(node.args)
+                        + [kw.value for kw in node.keywords]
+                    ):
+                        ctx.report(
+                            "RT014", nline,
+                            f"final path {dname!r} passed to "
+                            f"{callee}() before the rename — the "
+                            f"reference escapes ahead of the durable "
+                            f"publish",
+                        )
+
+
 _CHECKS = {
     "RT001": _check_rt001,
     "RT002": _check_rt002,
@@ -1108,6 +1429,9 @@ _CHECKS = {
     "RT008": _check_rt008,
     "RT009": _check_rt009,
     "RT011": _check_rt011,
+    "RT012": _check_rt012,
+    "RT013": _check_rt013,
+    "RT014": _check_rt014,
 }
 
 
@@ -1124,7 +1448,7 @@ class _FileCtx:
     violations: list = field(default_factory=list)
 
     def report(self, rule: str, line: int, message: str) -> None:
-        for rules, reason in self.suppressions.get(line, ()):
+        for rules, reason, _cline in self.suppressions.get(line, ()):
             if rule in rules:
                 self.violations.append(Violation(
                     self.rel, line, rule, message,
@@ -1191,11 +1515,148 @@ def _iter_py(path: str):
                 yield os.path.join(dirpath, fn)
 
 
-def lint_paths(paths: Iterable[str],
-               rules: Optional[Iterable[str]] = None) -> list:
-    out = []
+def _files_of(paths: Iterable[str]) -> list:
+    files: list = []
     for path in paths:
-        for fp in _iter_py(path):
-            out.append((fp, lint_file(fp, rules=rules)))
-    violations = [v for _, vs in out for v in vs]
-    return violations
+        files.extend(_iter_py(path))
+    return files
+
+
+def _lint_one(args) -> list:
+    """Module-level per-file worker (picklable for --jobs)."""
+    path, rules = args
+    return lint_file(path, rules=list(rules) if rules else None)
+
+
+def _map_files(worker, files: list, rules, jobs: int) -> list:
+    """Run ``worker`` over the files — serially, or on ``jobs``
+    processes (0 = cpu count).  Results come back in FILE ORDER either
+    way, so parallel findings are byte-identical to serial (asserted
+    in tests/test_rtpulint.py)."""
+    rules_t = tuple(rules) if rules else None
+    tasks = [(fp, rules_t) for fp in files]
+    if jobs == 1 or len(files) < 2:
+        return [worker(t) for t in tasks]
+    if jobs <= 0:
+        jobs = os.cpu_count() or 1
+    try:
+        from concurrent.futures import ProcessPoolExecutor
+
+        with ProcessPoolExecutor(
+            max_workers=min(jobs, len(files))
+        ) as ex:
+            return list(ex.map(worker, tasks))
+    except (OSError, ImportError, NotImplementedError):
+        # Platforms without fork/semaphores: serial fallback, same
+        # findings.
+        return [worker(t) for t in tasks]
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[Iterable[str]] = None,
+               jobs: int = 1) -> list:
+    files = _files_of(paths)
+    results = _map_files(_lint_one, files, rules, jobs)
+    return [v for vs in results for v in vs]
+
+
+# -- stale-suppression audit (--audit-suppressions) ---------------------------
+
+
+@dataclass
+class StaleSuppression:
+    """A ``# rtpulint: disable=`` comment whose named rule(s) no longer
+    fire at its target line — dead armor that silences nothing today
+    and could silence a REAL future finding at that line."""
+
+    path: str
+    line: int        # the comment's own line
+    rules: tuple
+    reason: str
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}: stale suppression "
+            f"disable={','.join(self.rules)} — no named rule fires "
+            f"here anymore (reason was: {self.reason})"
+        )
+
+
+def _stale_of(path: str, suppressions, used) -> list:
+    """Suppression table vs the (line, rule) pairs that actually
+    fired suppressed at ``path`` — whatever survives is stale."""
+    out = []
+    for target, entries in suppressions.items():
+        for rules, reason, cline in entries:
+            if any((target, r) in used for r in rules):
+                continue
+            out.append(StaleSuppression(
+                path, cline, tuple(sorted(rules)), reason
+            ))
+    return out
+
+
+def _audit_one(args) -> list:
+    """Per-file stale scan: every suppression comment vs the rules
+    that actually fired at its target line.  RT010-naming comments are
+    returned with a ``pending_rt010`` marker — only the whole-tree
+    lock-graph pass knows whether they swallowed an edge."""
+    path, _rules = args
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    suppressions, _role, _bad = _scan_comments(source)
+    if not suppressions:
+        return []
+    vs = lint_source(source, rel=path)
+    used = {(v.line, v.rule) for v in vs if v.suppressed}
+    return _stale_of(path, suppressions, used)
+
+
+def _audit_from_violations(path: str, used) -> list:
+    """The no-relint variant: the caller already ran an all-rules
+    lint pass over ``path`` and hands us its suppressed-hit set."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    suppressions, _role, _bad = _scan_comments(source)
+    if not suppressions:
+        return []
+    return _stale_of(path, suppressions, used)
+
+
+def audit_paths(paths: Iterable[str], jobs: int = 1,
+                rt010_sites: Optional[set] = None,
+                violations: Optional[list] = None) -> list:
+    """Every stale suppression under ``paths`` (see
+    :class:`StaleSuppression`).  ``rt010_sites`` is the lock-graph
+    pass's consumed-comment set (``LockGraph.suppressed_sites``);
+    comments naming RT010 count as live when their site is in it —
+    when None (no whole-tree pass ran), RT010-naming comments are
+    skipped rather than guessed at.  ``violations`` is a completed
+    ALL-RULES lint pass over the same paths: when given, the audit
+    reuses its suppressed-hit set instead of re-linting every file
+    (the CLI's case — never pass a ``--rule``-filtered result, whose
+    missing rules would all read as stale)."""
+    files = _files_of(paths)
+    if violations is not None:
+        used_by_file: dict = {}
+        for v in violations:
+            if v.suppressed:
+                used_by_file.setdefault(v.path, set()).add(
+                    (v.line, v.rule)
+                )
+        results = [
+            _audit_from_violations(fp, used_by_file.get(fp, ()))
+            for fp in files
+        ]
+    else:
+        results = _map_files(_audit_one, files, None, jobs)
+    out = []
+    for stales in results:
+        for s in stales:
+            if "RT010" in s.rules:
+                if rt010_sites is None:
+                    continue  # unverifiable without the tree pass
+                if (s.path, s.line) in rt010_sites:
+                    continue  # the graph consumed it: live
+            out.append(s)
+    return out

@@ -199,8 +199,23 @@ class ClusterDoor:
             return extra
         keys = extra
         d = self.slotmap.lookup(slot)
-        if d.owner != self.myid or d.migrating_to is None:
-            return None  # finalized under us: serve if still owner...
+        if d.owner != self.myid:
+            # The slot finalized AWAY while this command waited on the
+            # move guard: serving now would land an acked write on a
+            # node that no longer owns the slot — the new owner never
+            # sees it, and every future read goes there (found by the
+            # netsim finalize-race model, ISSUE 15: the write
+            # resurrected the key on the source with the ack already
+            # on the wire).  Redirect; the client re-runs against the
+            # authoritative owner.
+            if d.owner is None:
+                return _err("CLUSTERDOWN Hash slot not served")
+            self._count("moved")
+            return _err(
+                "MOVED %d %s:%d" % ((slot,) + tuple(d.owner_addr))
+            )
+        if d.migrating_to is None:
+            return None  # migration closed with us still owner: serve
         present = sum(1 for k in keys if self._exists(k))
         if present == len(keys):
             return None

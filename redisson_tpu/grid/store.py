@@ -406,7 +406,16 @@ class GridStore:
         tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
         with open(tmp, "wb") as f:
             f.write(buf.getvalue())
+            f.flush()
+            # fsync-then-rename (RT014): without the barrier a host
+            # crash can publish the snapshot NAME over void bytes —
+            # restore_from would then load a torn grid snapshot where
+            # the pre-rename file was still intact.
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        from redisson_tpu.durability.journal import _fsync_dir
+
+        _fsync_dir(parent)
         return len(meta)
 
     def restore_from(self, path: str) -> bool:

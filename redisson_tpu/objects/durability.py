@@ -107,6 +107,7 @@ class SketchDurabilityMixin:
             if pool.topology_epoch != epoch:
                 return
             for row in rows:
+                # rtpulint: disable=RT001 zero-then-free must be atomic vs reallocation under the dispatch lock (THE _reap_rows discipline residency.reclaim cites): releasing between would hand out a dirty row
                 self.executor.zero_row(pool, row)  # RLock: reentrant
                 pool.free_row(row)
 

@@ -2249,6 +2249,7 @@ class TpuSketchEngine(SketchDurabilityMixin):
                 padded[: len(data)] = data
                 # rtpulint: disable=RT001 same atomic migration window as the read above
                 self.executor.write_row(new_pool, new_row, padded)
+                # rtpulint: disable=RT001 zero-then-free must be atomic vs reallocation (the _reap_rows discipline): releasing between would hand out a dirty row
                 self.executor.zero_row(old_pool, old_row)
                 old_pool.free_row(old_row)
                 entry.pool, entry.row = new_pool, new_row

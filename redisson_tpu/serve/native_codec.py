@@ -53,6 +53,15 @@ def _build() -> bool:
         except (OSError, subprocess.TimeoutExpired):
             continue
         if r.returncode == 0:
+            # fsync-then-rename (RT014): a host crash between the
+            # rename and the page-cache writeback would publish a name
+            # whose bytes are void — dlopen of a torn .so can crash the
+            # process instead of falling back to the Python parser.
+            fd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
             os.replace(tmp, _SO)
             return True
     return False

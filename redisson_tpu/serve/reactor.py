@@ -299,6 +299,7 @@ class _Reactor(threading.Thread):
     def wake(self) -> None:
         try:
             self._wake_w.send(b"x")
+        # rtpulint: disable=RT013 self-pipe wake channel: no replies ever ride it, and a full pipe already guarantees a pending wakeup — there is nothing to desync or drop
         except (BlockingIOError, OSError):
             pass  # pipe already full: a wakeup is pending anyway
 
@@ -392,6 +393,7 @@ class _Reactor(threading.Thread):
         try:
             while self._wake_r.recv(4096):
                 pass
+        # rtpulint: disable=RT013 self-pipe wake channel: drained opportunistically, carries no reply stream — a failed drain cannot desync anything
         except (BlockingIOError, OSError):
             pass
 

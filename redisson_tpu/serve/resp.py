@@ -190,6 +190,38 @@ _GET_RUN = frozenset((b"GET", b"MGET"))
 # the pre-warmed bucket ladder; a longer run simply splits).
 _RUN_MAX_OPS = 1 << 14
 
+# One-shot connection licenses (the RT012 class): per-connection flags a
+# prelude command grants for EXACTLY the next command — cluster ASKING
+# (serve one command from an IMPORTING slot) and the RTPU.TRACE wire
+# prelude (stitch one command into a remote trace).  The preludes
+# themselves are transparent to EACH OTHER (the migration pump sends
+# RTPU.TRACE + ASKING + RESTORE: the licensed hop is the RESTORE,
+# whichever order the preludes arrived in).
+_LICENSE_TRANSPARENT = frozenset(("ASKING", "RTPU.TRACE"))
+
+
+def consume_one_shot_licenses(ctx, name: str) -> None:
+    """Burn every one-shot license after a dispatched command.
+
+    Keyed commands consume ASKING inside the cluster door's ``route()``
+    and traced commands consume the prelude inside ``_trace_begin`` —
+    but keyless commands (a PING between ASKING and the redirected
+    command), errored dispatches, and untraceable commands must ALL
+    still burn the licenses here, or a license leaks to a later
+    unrelated command (the PR 12/13 review class: ASKING leaking past
+    PING served a foreign-slot command; netsim's redirect model drives
+    this function directly and its mutation guard reverts it).
+
+    Called once per non-queueing dispatch (``_safe_dispatch``) and by
+    the netsim node harnesses, so the consumption discipline is ONE
+    piece of code on both the serving and the model-checking path."""
+    if name in _LICENSE_TRANSPARENT:
+        return
+    if getattr(ctx, "asking", False):
+        ctx.asking = False
+    if getattr(ctx, "trace_next", None) is not None:
+        ctx.trace_next = None
+
 
 def _encode_error(s: str) -> bytes:
     if s.split(" ", 1)[0] in _ERROR_CODES:
@@ -766,9 +798,13 @@ class RespServer:
                     conn.sendall(
                         b"-ERR max number of clients reached\r\n"
                     )
-                    conn.close()
                 except OSError:
                     pass
+                finally:
+                    # close in finally (RT013): a refusal send that
+                    # raises must still release the fd — the old shape
+                    # leaked it to GC time.
+                    conn.close()
                 continue
             if self.reactor is not None:
                 self.reactor.assign(conn)
@@ -955,27 +991,12 @@ class RespServer:
             # the ONE shared helper the fused-run demux also uses.
             err = True
             reply = self._fused_error_frame(e)
-        if ctx.asking and name not in ("ASKING", "RTPU.TRACE") \
-                and not queueing:
-            # Cluster ASKING is one-shot for ANY next command (Redis
-            # semantics): keyed commands consume it inside route();
-            # keyless ones (PING between ASKING and the redirected
-            # command) and errored dispatches consume it here so the
-            # license can never leak to a later unrelated command.
-            # RTPU.TRACE is transparent (the two preludes compose in
-            # either order — the traced hop is the command after both).
-            ctx.asking = False
-        if ctx.trace_next is not None and name not in (
-            "RTPU.TRACE", "ASKING",
-        ) and not queueing:
-            # The trace prelude is one-shot for ANY next command (the
-            # ASKING shape): normally consumed inside _trace_begin, but
-            # an errored/untraceable dispatch must still burn it so the
-            # context can never leak to a later unrelated command.
-            # ASKING is transparent — it is itself a prelude, and the
-            # migration pump sends RTPU.TRACE + ASKING + RESTORE: the
-            # traced hop must be the RESTORE, not the ASKING ack.
-            ctx.trace_next = None
+        if not queueing:
+            # One-shot licenses (ASKING, trace prelude) burn after ANY
+            # dispatched command — see consume_one_shot_licenses (the
+            # one copy of the discipline, shared with the netsim
+            # protocol models).
+            consume_one_shot_licenses(ctx, name)
         if not queueing and name not in _NONMUTATING:
             # Any executed command that may have changed keyspace state
             # retires every response-cache entry (coarse, cheap, safe —
@@ -1234,6 +1255,7 @@ class RespServer:
             and ctx.op_deadline_ms == head_ctx.op_deadline_ms
             # getattr: model-check harnesses drive the collectors with
             # minimal fake ctxs that predate the trace field.
+            # rtpulint: disable=RT012 fusion FENCE, not a dispatch: a prelude-carrying command never fuses — it is barriered to the sequential path where _safe_dispatch burns the license via consume_one_shot_licenses
             and getattr(ctx, "trace_next", None) is None
         )
 
@@ -1289,6 +1311,7 @@ class RespServer:
                 # a trace prelude takes the sequential path so its
                 # ingress span (and the one-shot consume) happen there.
                 and not self._monitors
+                # rtpulint: disable=RT012 fusion FENCE, not a dispatch: the prelude-carrying command falls through to _safe_dispatch below, which burns every license via consume_one_shot_licenses
                 and getattr(ctx, "trace_next", None) is None
             )
             if plain and rc_cap > 0 and name in _CACHEABLE:

@@ -91,6 +91,59 @@ def decode_reply(buf: bytes, i: int = 0):
     raise ValueError(f"bad reply frame type {t!r}")
 
 
+def decode_command(buf: bytes, i: int = 0):
+    """Decode ONE RESP multibulk REQUEST frame at ``i`` into
+    (argv list of bytes, end_offset) — the inverse of
+    :func:`wire_command`, for harnesses that play the SERVER side of
+    the wire (the netsim protocol models' node actors).
+    IndexError/ValueError signal an incomplete frame, like
+    ``decode_reply``; a frame that is complete but not a multibulk
+    command raises ValueError (corrupt stream, never resync)."""
+    j = buf.index(b"\r\n", i)
+    if buf[i : i + 1] != b"*":
+        raise ValueError(
+            f"bad command frame type {buf[i:i + 1]!r} (want multibulk)"
+        )
+    n = int(buf[i + 1 : j])
+    i = j + 2
+    out: list = []
+    for _ in range(n):
+        j = buf.index(b"\r\n", i)
+        if buf[i : i + 1] != b"$":
+            raise ValueError("command args must be bulk strings")
+        ln = int(buf[i + 1 : j])
+        i = j + 2
+        if len(buf) < i + ln + 2:
+            raise IndexError("incomplete bulk")
+        out.append(buf[i : i + ln])
+        i += ln + 2
+    return out, i
+
+
+def encode_reply(v) -> bytes:
+    """Encode one decoded-reply-shaped value back into a RESP frame —
+    the server half the netsim node harnesses speak.  The mapping is
+    ``decode_reply``'s inverse: int -> ``:``, bytes -> bulk, None ->
+    nil bulk, list -> array, ReplyError -> ``-``, str -> simple
+    string (use bytes for data, str only for ``+OK``-class acks)."""
+    if isinstance(v, bool):
+        return b":%d\r\n" % int(v)
+    if isinstance(v, int):
+        return b":%d\r\n" % v
+    if v is None:
+        return b"$-1\r\n"
+    if isinstance(v, ReplyError):
+        return b"-" + str(v).encode("latin-1", "replace") + b"\r\n"
+    if isinstance(v, str):
+        return b"+" + v.encode("latin-1", "replace") + b"\r\n"
+    if isinstance(v, (bytes, bytearray)):
+        v = bytes(v)
+        return b"$%d\r\n%s\r\n" % (len(v), v)
+    if isinstance(v, (list, tuple)):
+        return b"*%d\r\n" % len(v) + b"".join(encode_reply(x) for x in v)
+    raise TypeError(f"cannot encode reply value of type {type(v)!r}")
+
+
 def exchange(sock, cmds) -> list:
     """One pipelined request/response cycle on a CONNECTED socket:
     ship ``cmds`` in one sendall, decode exactly ``len(cmds)`` replies

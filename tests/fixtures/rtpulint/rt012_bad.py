@@ -1,0 +1,33 @@
+# rtpulint: role=serve
+"""RT012 known-bad corpus: one-shot connection licenses read on a
+dispatch path without being burned (the PR 12/13 review class: ASKING
+leaking past PING, the trace prelude surviving an errored dispatch)."""
+
+
+def serve_importing_slot(door, name, cmd, ctx):
+    # Reads the license to decide serving, never burns it: the NEXT
+    # command on this connection inherits it.
+    if ctx.asking and door.is_importing(cmd):  # rtpulint-expect: RT012
+        return door.serve(name, cmd)
+    return door.redirect(name, cmd)
+
+
+def cache_hit_path(server, rc, ctx, name, cmd):
+    # The cache-hit shape: a served-from-cache command is still a
+    # dispatch — skipping the burn leaks the license past the hit.
+    hit = rc.get((name, tuple(cmd)))
+    if hit is not None and getattr(ctx, "asking", False):  # rtpulint-expect: RT012
+        return hit
+    return server.dispatch(name, cmd, ctx)
+
+
+def fused_run_path(server, batch, ctxs):
+    out = []
+    for cmd, ctx in zip(batch, ctxs):
+        # Fused runs are dispatch paths too: serving under the flag
+        # without consuming it re-opens the leak for the run's tail.
+        if ctx.trace_next is not None:  # rtpulint-expect: RT012
+            out.append(server.traced_dispatch(cmd, ctx))
+        else:
+            out.append(server.dispatch(cmd, ctx))
+    return out

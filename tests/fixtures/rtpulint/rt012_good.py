@@ -1,0 +1,37 @@
+# rtpulint: role=serve
+"""RT012 known-good corpus: every license read is paired with a burn
+(falsy store or the shared burner), and granting sites are exempt."""
+
+
+def consume_one_shot_licenses(ctx, name):
+    # The shared burner itself: reads paired with falsy stores.
+    if getattr(ctx, "asking", False):
+        ctx.asking = False
+    if getattr(ctx, "trace_next", None) is not None:
+        ctx.trace_next = None
+
+
+def route(door, name, cmd, ctx):
+    # Read + burn in the same dispatch path (the door's shape).
+    asking = getattr(ctx, "asking", False)
+    ctx.asking = False  # one-shot: consumed by this keyed command
+    if asking and door.is_importing(cmd):
+        return door.serve(name, cmd)
+    return door.redirect(name, cmd)
+
+
+def safe_dispatch(server, cmd, ctx, name):
+    # Reads gate the traced path; the shared burner closes the loop.
+    if ctx.trace_next is not None:
+        reply = server.traced_dispatch(cmd, ctx)
+    else:
+        reply = server.dispatch(cmd, ctx)
+    consume_one_shot_licenses(ctx, name)
+    return reply
+
+
+def cmd_asking(ctx):
+    # The granting site: a truthy store is the license's birth, not a
+    # leak.
+    ctx.asking = True
+    return b"+OK\r\n"

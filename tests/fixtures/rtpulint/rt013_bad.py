@@ -1,0 +1,31 @@
+# rtpulint: role=serve
+"""RT013 known-bad corpus: pooled sockets kept after an except-OSError
+arm (the PR 12 review class: a timed-out request leaves unread reply
+bytes in flight — a reused socket returns them as a LATER command's
+replies)."""
+
+from redisson_tpu.serve.wireutil import exchange
+
+
+class PooledConn:
+    def __init__(self, sock):
+        self._sock = sock
+
+    def request_swallowing(self, cmds):
+        try:
+            return exchange(self._sock, cmds)
+        except OSError:  # rtpulint-expect: RT013
+            return None  # socket silently back in the pool, desynced
+
+
+class ClientPool:
+    def __init__(self):
+        self._conns = {}
+
+    def roundtrip(self, addr, payload):
+        conn = self._conns[addr]
+        try:
+            conn.sendall(payload)
+            return conn.recv(4096)
+        except (OSError, TimeoutError):  # rtpulint-expect: RT013
+            return b""  # timeout swallowed, connection still pooled

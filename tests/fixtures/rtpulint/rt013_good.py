@@ -1,0 +1,62 @@
+# rtpulint: role=serve
+"""RT013 known-good corpus: every except-OSError arm around wire I/O
+drops the socket (close/pop/*drop* helper), re-raises, or flags the
+connection doomed for the teardown path; EAGAIN/EINTR retry arms and
+non-wire cleanup arms are out of scope."""
+
+from redisson_tpu.serve.wireutil import exchange
+
+
+class PooledConn:
+    def __init__(self, sock):
+        self._sock = sock
+
+    def request(self, cmds):
+        # Re-raise: the caller's drop discipline applies (the shipped
+        # _NodeConn/_request shape).
+        try:
+            return exchange(self._sock, cmds)
+        except OSError:
+            self._sock.close()
+            raise
+
+    def close(self):
+        # Non-wire cleanup arm: close() carries no reply stream.
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ClientPool:
+    def __init__(self):
+        self._conns = {}
+
+    def _drop_conn(self, addr):
+        conn = self._conns.pop(addr, None)
+        if conn is not None:
+            conn.close()
+
+    def roundtrip(self, addr, payload):
+        conn = self._conns[addr]
+        try:
+            conn.sendall(payload)
+            return conn.recv(4096)
+        except OSError:
+            self._drop_conn(addr)  # desynced: out of the pool
+            raise
+
+
+def read_ready(rconn):
+    # The reactor idiom: EAGAIN/EINTR retry arms are clean, and a real
+    # OSError sets the doom flag the teardown path drives.
+    eof = False
+    try:
+        data = rconn.sock.recv(1 << 16)
+        if not data:
+            eof = True
+    except (BlockingIOError, InterruptedError):
+        pass
+    except OSError:
+        eof = True
+    return eof

@@ -1,0 +1,36 @@
+# rtpulint: role=host
+"""RT014 known-bad corpus: tmp-file persistence writes that rename
+before fsync, or let the final path escape before the rename."""
+
+import os
+
+
+def publish_without_fsync(directory, payload):
+    path = os.path.join(directory, "blob.bin")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)  # rtpulint-expect: RT014
+    return path
+
+
+class BlobIndex:
+    def __init__(self):
+        self.by_name = {}
+
+    def publish_escaping_early(self, directory, name, payload):
+        final = os.path.join(directory, name)
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        # The reference escapes BEFORE the rename: a reader chasing the
+        # index finds a name that does not durably exist yet.
+        self.by_name[name] = final  # rtpulint-expect: RT014
+        notify_watchers(final)  # rtpulint-expect: RT014
+        os.replace(tmp, final)
+
+
+def notify_watchers(path):
+    pass

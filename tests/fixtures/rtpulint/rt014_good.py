@@ -1,0 +1,44 @@
+# rtpulint: role=host
+"""RT014 known-good corpus: fsync-then-rename, final path escapes only
+AFTER the durable publish (the residency blob / snapshot discipline)."""
+
+import os
+
+
+def publish(directory, payload):
+    path = os.path.join(directory, "blob.bin")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path  # escape AFTER the rename: the name is durable
+
+
+class BlobIndex:
+    def __init__(self):
+        self.by_name = {}
+
+    def publish_then_index(self, directory, name, payload):
+        final = os.path.join(directory, name)
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        self.by_name[name] = final  # indexed only once durable
+
+
+def composed_destination(directory, seq, payload):
+    # The residency _write_blob shape: the final path is composed
+    # inline at the rename — it never existed as a variable to escape.
+    fname = f"obj-{seq}.rts"
+    tmp = os.path.join(directory, fname + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(directory, fname))
+    return fname

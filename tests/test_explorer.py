@@ -1155,6 +1155,228 @@ def test_model_residency_ladder_no_lost_write_no_stale_read():
     _residency_ladder_body()
 
 
+# -- model check 9 (ISSUE 15 satellite): the prewarm ladder --------------------
+#
+# The REAL BucketPrewarmer (executor/prewarm.py) driven under explored
+# schedules: a compile thread popping ladder tasks, racing registration,
+# pool growth, and a dispatcher taking the pool's dispatch lock for
+# launches.  Invariants, in EVERY schedule: (a) no launch ever waits on
+# a compile holding the dispatch lock (warm calls go through the
+# UNWRAPPED methods against scratch state — the module's founding
+# rule); (b) racing registrations of one signature enqueue ONE ladder
+# (no bucket compiled twice at a given shape); (c) after a pool growth,
+# every bucket is compiled at the NEW capacity (the capacity-tag fix in
+# _warm_pool_for: a growth landing between the scratch-state snapshot
+# and the cache tag must not pin the stale layout).
+
+
+_COMPILE_S = 10.0  # virtual seconds one "XLA compile" takes in the model
+
+
+def _prewarm_ladder_body(warm_takes_dispatch_lock=False,
+                         warmer_cls=None, grow_during_build=False):
+    from redisson_tpu.executor import prewarm as pw
+
+    warmer_cls = warmer_cls or pw.BucketPrewarmer
+    compiles: list = []  # (scratch capacity, bucket) per warm call
+    build_hook: list = [None]
+
+    class _Pool:
+        capacity = 4
+        row_units = 8
+        spec = types.SimpleNamespace(dtype="uint32", kind="bloom")
+        on_grow = None
+
+    pool = _Pool()
+    pool._dispatch_lock = threading.Lock()
+
+    class _Exec:
+        _retired = False
+
+        @staticmethod
+        def _bucket(n):
+            return 1 << max(0, (n - 1).bit_length())
+
+        @staticmethod
+        def make_pool_state(cap, row_units, dtype, kind=None):
+            # The H2D allocation pause: the real scratch-state build
+            # crosses the device boundary, so a growth may land here.
+            checkpoint("scratch state allocating")
+            if build_hook[0] is not None:
+                build_hook[0]()
+            return ("state", cap)
+
+    def warm(ex, wpool, bucket):
+        compiles.append((wpool.capacity, bucket))
+        if warm_takes_dispatch_lock:
+            # MUTATION: warming through the WRAPPED method — the
+            # compile runs inside the dispatch lock.
+            with pool._dispatch_lock:
+                time.sleep(_COMPILE_S)
+        else:
+            time.sleep(_COMPILE_S)  # virtual: the compile itself
+
+    warmer = warmer_cls(_Exec(), max_batch=4)
+    ladder = warmer.ladder()
+    grown = [False]
+
+    def grow():
+        pool.capacity = 8
+        warmer.on_pool_grow(pool)
+
+    if grow_during_build:
+        # Deterministic placement of the race window: the growth lands
+        # INSIDE the first scratch-state build (between the capacity
+        # snapshot and the cache tag) — the 1-in-~20 interleaving from
+        # CHANGES.md PR 2, pinned so every schedule walks it.
+        def _grow_once():
+            if not grown[0]:
+                grown[0] = True
+                grow()
+
+        build_hook[0] = _grow_once
+    try:
+        def registrar():
+            warmer.register(pool, "sig", warm)
+
+        def grower():
+            if grow_during_build:
+                return
+            checkpoint("growth lands")
+            grow()
+
+        def dispatcher():
+            for _ in range(2):
+                t0 = time.monotonic()
+                with pool._dispatch_lock:
+                    checkpoint("launch")
+                dt = time.monotonic() - t0
+                assert dt < _COMPILE_S, (
+                    f"a launch waited {dt:.1f}s on a compile holding "
+                    f"the dispatch lock"
+                )
+
+        warmer.register(pool, "sig", warm)
+        threads = [threading.Thread(target=f)
+                   for f in (registrar, grower, dispatcher)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert warmer.wait_idle(timeout=600.0), "ladder never drained"
+        # (b) one ladder per signature at the initial shape: the racing
+        # re-register enqueued NOTHING.
+        at4 = [b for cap, b in compiles if cap == 4]
+        assert sorted(set(at4)) == sorted(at4), (
+            f"bucket compiled twice at one shape: {sorted(at4)}"
+        )
+        # (c) the growth re-warm covers every bucket at the NEW shape.
+        at8 = {b for cap, b in compiles if cap == 8}
+        assert at8 == set(ladder), (
+            f"buckets missing at the grown capacity: "
+            f"{sorted(set(ladder) - at8)} (compiled {sorted(compiles)})"
+        )
+    finally:
+        warmer.shutdown(timeout=60.0)
+
+
+@schedule_test(max_schedules=200, random_schedules=64, preemption_bound=2,
+               max_steps=400000)
+def test_model_prewarm_ladder_growth_and_lock_discipline():
+    import redisson_tpu.executor.prewarm as pw
+
+    orig = pw._ensure_listener
+    pw._ensure_listener = lambda: None  # no jax inside the explored body
+    try:
+        _prewarm_ladder_body()
+    finally:
+        pw._ensure_listener = orig
+
+
+@schedule_test(max_schedules=60, random_schedules=32, preemption_bound=2,
+               max_steps=400000)
+def test_model_prewarm_growth_inside_scratch_build():
+    """The focused window the capacity-tag fix closes: growth lands
+    between the scratch state's capacity snapshot and its cache tag.
+    The shipped tag (the capacity the state was BUILT at) rebuilds on
+    the next task and every bucket still compiles at the new shape."""
+    import redisson_tpu.executor.prewarm as pw
+
+    orig = pw._ensure_listener
+    pw._ensure_listener = lambda: None
+    try:
+        _prewarm_ladder_body(grow_during_build=True)
+    finally:
+        pw._ensure_listener = orig
+
+
+def test_model_prewarm_compile_under_dispatch_lock_mutation_guard():
+    """Warming through the WRAPPED executor methods (the design the
+    module exists to forbid: the dispatch lock held across a 10-60s
+    compile) must be caught — some schedule has a launch waiting out
+    the whole compile."""
+    import redisson_tpu.executor.prewarm as pw
+
+    orig = pw._ensure_listener
+    pw._ensure_listener = lambda: None
+    try:
+        with pytest.raises(ScheduleFailure) as ei:
+            explore(
+                lambda: _prewarm_ladder_body(
+                    warm_takes_dispatch_lock=True
+                ),
+                max_schedules=200, random_schedules=64,
+                preemption_bound=2, max_steps=400000,
+            )
+        token = ei.value.token
+        with pytest.raises(ScheduleFailure) as ei2:
+            explore(
+                lambda: _prewarm_ladder_body(
+                    warm_takes_dispatch_lock=True
+                ),
+                replay=token, max_steps=400000,
+            )
+        assert ei2.value.token == token
+    finally:
+        pw._ensure_listener = orig
+
+
+def test_model_prewarm_capacity_tag_mutation_guard():
+    """Reverting the _warm_pool_for capacity-tag fix (tagging the
+    scratch cache with a RE-READ of pool.capacity instead of the
+    capacity the state was built at) must be caught: a growth landing
+    between the snapshot and the tag pins the stale layout and the
+    new-capacity buckets never compile (the measured 1-in-~20
+    interleaving from CHANGES.md PR 2)."""
+    import redisson_tpu.executor.prewarm as pw
+
+    class _TagRereadsCapacity(pw.BucketPrewarmer):
+        def _warm_pool_for(self, pool):
+            cached = self._warm_pools.get(id(pool))
+            if cached is not None and cached[0] == pool.capacity:
+                return cached[1]
+            wp = pw._WarmPool(pool, self._executor)
+            # The reverted bug: re-read AFTER the build.
+            self._warm_pools[id(pool)] = (pool.capacity, wp)
+            return wp
+
+    orig = pw._ensure_listener
+    pw._ensure_listener = lambda: None
+    body = lambda: _prewarm_ladder_body(  # noqa: E731
+        warmer_cls=_TagRereadsCapacity, grow_during_build=True
+    )
+    try:
+        with pytest.raises(ScheduleFailure) as ei:
+            explore(body, max_schedules=200, random_schedules=64,
+                    preemption_bound=2, max_steps=400000)
+        token = ei.value.token
+        with pytest.raises(ScheduleFailure) as ei2:
+            explore(body, replay=token, max_steps=400000)
+        assert ei2.value.token == token
+    finally:
+        pw._ensure_listener = orig
+
+
 def test_model_residency_promote_drop_order_found_and_replayed():
     """The replay-token test the ISSUE 14 satellite asks for: mutate
     promotion into drop-mirror-before-repoint and the explorer FINDS a

@@ -1,0 +1,838 @@
+"""netsim cluster-protocol models (ISSUE 15 tentpole): the REAL shipped
+cluster code — ``ClusterDoor.route``/``route_recheck``/``migrate_key``
+(the move guard), ``ClusterClient``'s MOVED/ASK chase and scatter/
+gather demux, ``supervisor.migrate_slot`` (the live-resharding pump),
+``wireutil.exchange``, and ``resp.consume_one_shot_licenses`` — driven
+over a simulated network under the schedule explorer, so the
+delivery × fault × crash interleavings are ENUMERATED, not sampled.
+
+Every failing schedule prints an ``RTPU_SCHEDULE_REPLAY`` token that
+replays it exactly.  The mutation guards revert the historical fixes
+(the ``route_recheck`` presence re-check, the MOVED one-retry budget,
+the one-shot ASKING burn, the pooled-socket drop-on-OSError
+discipline) and assert the models CATCH them with a replayable token.
+
+The node harness (:class:`MiniClusterNode`) is deliberately thin: a
+dict store + the real door/slotmap/license code, wired through
+``wireutil``'s server-side framing.  Everything protocol-bearing is
+the shipped code (the netsim transport-seam contract,
+docs/static_analysis.md).
+"""
+
+import threading
+import time
+import types
+
+import pytest
+
+from redisson_tpu.analysis import explorer, netsim
+from redisson_tpu.analysis.explorer import (
+    ScheduleFailure,
+    checkpoint,
+    explore,
+    schedule_test,
+)
+from redisson_tpu.cluster import supervisor as supervisor_mod
+from redisson_tpu.cluster.client import ClusterClient, ClusterError
+from redisson_tpu.cluster.door import ClusterDoor
+from redisson_tpu.cluster.slotmap import SlotMap
+from redisson_tpu.cluster.slots import NSLOTS, key_slot
+from redisson_tpu.serve import resp as resp_mod
+from redisson_tpu.serve.wireutil import (
+    ReplyError,
+    decode_command,
+    encode_reply,
+    exchange,
+)
+
+pytestmark = pytest.mark.netsim
+
+
+@pytest.fixture(autouse=True)
+def _unpatch_network():
+    """A failing schedule abandons the explored body mid-``with Net()``
+    (its __exit__ never runs), which would leave every LATER test in
+    this process dialing the sim and getting ConnectionRefusedError."""
+    yield
+    netsim.restore_patches()
+
+
+KEY = b"k"
+SLOT = key_slot(KEY)
+
+ADDR_A = ("node-a", 7001)
+ADDR_B = ("node-b", 7002)
+
+
+def _topology(a_slots, b_slots):
+    return {"nodes": [
+        {"id": "A", "host": ADDR_A[0], "port": ADDR_A[1],
+         "slots": a_slots},
+        {"id": "B", "host": ADDR_B[0], "port": ADDR_B[1],
+         "slots": b_slots},
+    ]}
+
+
+# ---------------------------------------------------------------------------
+# the node harness (thin: real door + real license burn over a dict store)
+# ---------------------------------------------------------------------------
+
+
+class _KeysShim:
+    """The keyspace surface ``ClusterDoor`` uses (dump/delete/ttl)."""
+
+    def __init__(self, node):
+        self._node = node
+
+    def get_keys(self):
+        return list(self._node.store)
+
+    def delete(self, name):
+        self._node.store.pop(name, None)
+
+    def remain_time_to_live(self, name):
+        return -1
+
+
+class MiniClusterNode:
+    """One simulated cluster node: dict store + REAL ClusterDoor."""
+
+    _DUMP_MAGIC = b"MDMP"
+
+    def __init__(self, net, addr, myid, topo, slow_first_get_s=0.0):
+        self.host, self.port = addr
+        self.addr = addr
+        self.store: dict = {}
+        self.slotmap = SlotMap.from_dict(topo)
+        self.door = ClusterDoor(self, self.slotmap, myid, announce=addr)
+        self.counts: dict = {}
+        self._keys = _KeysShim(self)
+        self._client = types.SimpleNamespace(get_keys=lambda: self._keys)
+        self._slow_first_get_s = slow_first_get_s
+        self._slowed = False
+        net.listen(addr, self.serve, name=myid)
+
+    # -- the surface the REAL door calls back into --------------------------
+
+    def _exists_any(self, name: str) -> bool:
+        return name in self.store
+
+    def _dump_payload(self, name: str):
+        v = self.store.get(name)
+        return None if v is None else self._DUMP_MAGIC + v
+
+    # -- wire loop ----------------------------------------------------------
+
+    def serve(self, sock, peer) -> None:
+        ctx = types.SimpleNamespace(asking=False, trace_next=None)
+        buf = b""
+        pos = 0
+        while True:
+            chunk = sock.recv(1 << 16)
+            if not chunk:
+                return
+            buf += chunk
+            while True:
+                try:
+                    cmd, end = decode_command(buf, pos)
+                except (IndexError, ValueError):
+                    break
+                pos = end
+                sock.sendall(self.dispatch(cmd, ctx))
+
+    # -- dispatch (mirrors RespServer._dispatch's cluster slice) ------------
+
+    def dispatch(self, cmd, ctx) -> bytes:
+        name = cmd[0].decode("latin-1", "replace").upper()
+        self.counts[name] = self.counts.get(name, 0) + 1
+        try:
+            if name == "ASKING":
+                ctx.asking = True
+                return b"+OK\r\n"
+            if name == "CLUSTER":
+                return self._cluster(cmd)
+            frame, guarded = self.door.route(name, cmd, ctx)
+            if frame is not None:
+                return frame
+            if guarded:
+                with self.door.move_lock:
+                    frame = self.door.route_recheck(name, cmd)
+                    if frame is not None:
+                        return frame
+                    return self._execute(name, cmd)
+            return self._execute(name, cmd)
+        except Exception as e:  # noqa: BLE001 - the -ERR contract
+            return encode_reply(ReplyError(f"ERR {e}"))
+        finally:
+            # The REAL one-shot license discipline (serve/resp.py): a
+            # keyless command between ASKING and its redirected command
+            # must burn the license.
+            resp_mod.consume_one_shot_licenses(ctx, name)
+
+    def _execute(self, name: str, cmd) -> bytes:
+        if name == "PING":
+            return b"+PONG\r\n"
+        if name == "SET":
+            self.store[cmd[1].decode()] = cmd[2]
+            return b"+OK\r\n"
+        if name == "GET":
+            if self._slow_first_get_s and not self._slowed:
+                # One slow reply: the cross-command desync trap the
+                # pooled-socket drop discipline exists for.
+                self._slowed = True
+                time.sleep(self._slow_first_get_s)
+            return encode_reply(self.store.get(cmd[1].decode()))
+        if name == "DEL":
+            n = 0
+            for k in cmd[1:]:
+                n += 1 if self.store.pop(k.decode(), None) is not None \
+                    else 0
+            return encode_reply(n)
+        if name == "EXISTS":
+            return encode_reply(
+                sum(1 for k in cmd[1:] if k.decode() in self.store)
+            )
+        if name == "RESTORE":
+            blob = cmd[3]
+            if not blob.startswith(self._DUMP_MAGIC):
+                return encode_reply(ReplyError("ERR bad dump payload"))
+            self.store[cmd[1].decode()] = blob[len(self._DUMP_MAGIC):]
+            return b"+OK\r\n"
+        if name == "MIGRATE":
+            r = self.door.migrate_key(
+                cmd[1].decode(), int(cmd[2]), cmd[3], int(cmd[5])
+            )
+            return encode_reply(r)
+        return encode_reply(ReplyError(f"ERR unknown command '{name}'"))
+
+    def _cluster(self, cmd) -> bytes:
+        sub = cmd[1].decode("latin-1", "replace").upper()
+        if sub == "MYID":
+            return encode_reply(self.door.myid.encode())
+        if sub == "SLOTS":
+            return encode_reply([
+                [start, end, [host.encode(), port, nid.encode()]]
+                for start, end, nid, host, port
+                in self.slotmap.slots_table()
+            ])
+        if sub == "SETSLOT":
+            slot = int(cmd[2])
+            mode = cmd[3].decode().upper()
+            if mode == "IMPORTING":
+                self.slotmap.set_importing(slot, cmd[4].decode())
+            elif mode == "MIGRATING":
+                self.slotmap.set_migrating(slot, cmd[4].decode())
+            elif mode == "NODE":
+                self.slotmap.set_owner(slot, cmd[4].decode())
+            elif mode == "STABLE":
+                self.slotmap.set_stable(slot)
+            else:
+                return encode_reply(ReplyError("ERR bad SETSLOT"))
+            return b"+OK\r\n"
+        if sub == "GETKEYSINSLOT":
+            return encode_reply([
+                k.encode()
+                for k in self.door.keys_in_slot(int(cmd[2]), int(cmd[3]))
+            ])
+        if sub == "COUNTKEYSINSLOT":
+            return encode_reply(len(self.door.keys_in_slot(int(cmd[2]))))
+        if sub == "MIGRATABLE":
+            return encode_reply([
+                k.encode()
+                for k in self.door.undumpable_in_slot(int(cmd[2]))
+            ])
+        return encode_reply(ReplyError(f"ERR unknown CLUSTER {sub}"))
+
+
+def _client(*seeds, timeout_s=30.0) -> ClusterClient:
+    c = ClusterClient(list(seeds), timeout_s=timeout_s)
+    # The executor seam (netsim transport-seam contract): scatter legs
+    # on SIMULATED threads, so leg delivery order is explored.
+    c._pool = netsim.SimThreadExecutor()
+    return c
+
+
+# ---------------------------------------------------------------------------
+# model 1: live slot migration under concurrent acked writes
+# ---------------------------------------------------------------------------
+
+
+def _write_retrying(client, val, attempts=60):
+    """One acked write, retried through drops/crashes (idempotent SET:
+    un-acked attempts are unconstrained, the ACK is the contract)."""
+    for _ in range(attempts):
+        try:
+            r = client.execute(b"SET", KEY, val)
+        except (OSError, ClusterError):
+            time.sleep(0.05)  # virtual: let the fault window pass
+            continue
+        except ReplyError as e:
+            if e.code in ("TRYAGAIN", "CLUSTERDOWN"):
+                time.sleep(0.05)
+                continue
+            raise
+        assert r == b"OK"
+        return True
+    raise AssertionError("write never acked within the retry budget")
+
+
+def _migration_body(drop_budget=0, writes=2, wait_for_migrating=False):
+    """A writer keeps SETting a key in SLOT while the REAL migrate_slot
+    pump moves that slot A -> B.  Invariant, in EVERY schedule: after
+    the pump finishes, the last ACKED value is what a read returns —
+    zero acked-write loss across the migrated slot.
+
+    ``wait_for_migrating`` gates the writer until the source shows the
+    slot MIGRATING, focusing the search on the route-vs-move-guard
+    window (the mutation hunts need the write to land mid-handoff)."""
+    with netsim.Net(drop_budget=drop_budget) as net:
+        topo = _topology([[0, NSLOTS - 1]], [])
+        na = MiniClusterNode(net, ADDR_A, "A", topo)
+        nb = MiniClusterNode(net, ADDR_B, "B", topo)
+        na.store[KEY.decode()] = b"0"
+        client = _client(ADDR_A, ADDR_B)
+        acked = [b"0"]
+
+        def writer():
+            if wait_for_migrating:
+                while True:
+                    d = na.slotmap.lookup(SLOT)
+                    if d.migrating_to is not None or d.owner != "A":
+                        break
+                    time.sleep(0.01)  # virtual
+            for i in range(1, writes + 1):
+                val = b"%d" % i
+                _write_retrying(client, val)
+                acked.append(val)
+
+        def pump():
+            # The driver is resumable by design (every step idempotent,
+            # per-key atomicity lives in the source's move guard): a
+            # dropped control connection re-runs the pump.
+            for _ in range(4):
+                try:
+                    moved = supervisor_mod.migrate_slot(
+                        SLOT, ADDR_A, ADDR_B,
+                        notify=(ADDR_A, ADDR_B), batch=4,
+                    )
+                except (OSError, RuntimeError):
+                    time.sleep(0.05)  # virtual
+                    continue
+                assert moved >= 0
+                return
+            raise AssertionError("pump never completed")
+
+        wt = threading.Thread(target=writer)
+        pt = threading.Thread(target=pump)
+        wt.start()
+        pt.start()
+        wt.join()
+        pt.join()
+        assert na.slotmap.owner(SLOT) == "B"
+        assert nb.slotmap.owner(SLOT) == "B"
+        final = client.execute(b"GET", KEY)
+        assert final == acked[-1], (
+            f"acked write lost across the migration: read {final!r}, "
+            f"last acked {acked[-1]!r}"
+        )
+        client.close()
+
+
+@schedule_test(max_schedules=60, random_schedules=32, preemption_bound=2,
+               max_steps=200000)
+def test_model_migration_no_acked_write_lost():
+    _migration_body()
+
+
+@schedule_test(max_schedules=30, random_schedules=16, preemption_bound=1,
+               max_steps=200000)
+def test_model_migration_survives_connection_drops():
+    _migration_body(drop_budget=1, writes=1)
+
+
+@schedule_test(max_schedules=200, random_schedules=64, preemption_bound=2,
+               max_steps=200000)
+def test_model_migration_write_lands_mid_handoff():
+    """The focused variant the mutation guard hunts on: the write is
+    gated into the MIGRATING window, so every schedule exercises the
+    route -> move-guard -> recheck path against a mid-flight key."""
+    _migration_body(writes=1, wait_for_migrating=True)
+
+
+def _finalize_race_body():
+    """The tightest loss window the slot-handoff protocol has: a write
+    routed 'serve locally, guarded' waits on the move guard while the
+    mover ships the key AND the driver finalizes ownership.  When the
+    writer finally holds the guard, serving locally would land an
+    acked write on a node that no longer owns the slot (lost for every
+    future reader).  The REAL route_recheck must turn it away (ASK
+    while still owner+migrating, MOVED once ownership changed).
+
+    The mover here is a compressed driver: one MIGRATE then the
+    SETSLOT NODE broadcast, no empty-slot re-check — legal (the slot
+    has exactly one key) and exactly the window a concurrent write
+    can hit even under the full pump, since a write can always land
+    between the pump's last GETKEYSINSLOT and its finalize."""
+    with netsim.Net() as net:
+        topo = _topology([[0, NSLOTS - 1]], [])
+        na = MiniClusterNode(net, ADDR_A, "A", topo)
+        nb = MiniClusterNode(net, ADDR_B, "B", topo)
+        na.store[KEY.decode()] = b"0"
+        na.slotmap.set_migrating(SLOT, "B")
+        nb.slotmap.set_importing(SLOT, "A")
+        import socket as socket_mod
+
+        # The mover's control connections dial FIRST (low scheduler
+        # tids): the default DFS path then drives the finalize chain
+        # ahead of the woken writer — the deepest loss interleaving is
+        # an EARLY schedule, not a needle.
+        mover_a = socket_mod.create_connection(ADDR_A, timeout=30.0)
+        mover_b = socket_mod.create_connection(ADDR_B, timeout=30.0)
+        # Seed from B only: the writer's data connection to A then
+        # dials at WRITE time (highest scheduler tid), so the default
+        # schedule already defers the woken writer past the whole
+        # finalize chain — the deepest loss window is schedule #1.
+        client = _client(ADDR_B)
+        acked = [b"0"]
+
+        def writer():
+            _write_retrying(client, b"1")
+            acked.append(b"1")
+
+        def mover():
+            r = exchange(mover_a, [[
+                b"MIGRATE", ADDR_B[0].encode(), b"%d" % ADDR_B[1],
+                KEY, b"0", b"30000",
+            ]])
+            assert r[0] == b"OK", r
+            fin = [b"CLUSTER", b"SETSLOT", b"%d" % SLOT, b"NODE", b"B"]
+            assert exchange(mover_b, [fin])[0] == b"OK"
+            assert exchange(mover_a, [fin])[0] == b"OK"
+
+        wt = threading.Thread(target=writer)
+        mt = threading.Thread(target=mover)
+        wt.start()
+        mt.start()
+        wt.join()
+        mt.join()
+        mover_a.close()
+        mover_b.close()
+        final = client.execute(b"GET", KEY)
+        assert final == acked[-1], (
+            f"acked write lost across the finalize race: read {final!r}, "
+            f"last acked {acked[-1]!r} (source store={na.store!r}, "
+            f"target store={nb.store!r})"
+        )
+        client.close()
+
+
+@schedule_test(max_schedules=250, random_schedules=64, preemption_bound=2,
+               max_steps=200000)
+def test_model_migration_finalize_races_guarded_write():
+    _finalize_race_body()
+
+
+def test_model_migration_recheck_mutation_guard():
+    """Reverting the move guard's re-check (route_recheck -> serve
+    unconditionally) must be CAUGHT: some schedule lets a write that
+    routed 'serve locally' proceed after the mover shipped the key —
+    the acked write resurrects on the source and dies when the slot
+    finalizes.  The failing schedule prints a replay token that
+    reproduces it exactly."""
+    orig = ClusterDoor.route_recheck
+    ClusterDoor.route_recheck = lambda self, name, cmd: None
+    try:
+        with pytest.raises(ScheduleFailure) as ei:
+            explore(_finalize_race_body, max_schedules=250,
+                    random_schedules=64, preemption_bound=2,
+                    max_steps=200000)
+        token = ei.value.token
+        with pytest.raises(ScheduleFailure) as ei2:
+            explore(_finalize_race_body, replay=token, max_steps=200000)
+        assert ei2.value.token == token
+    finally:
+        ClusterDoor.route_recheck = orig
+
+
+# -- crash + retry: the pump dies mid-slot, the slot stays serveable ---------
+
+
+def _pump_death_body():
+    """The target node CRASHES mid-migration (netsim crash injection:
+    its actors die at their next sync point, every connection resets).
+    Invariants: the half-migrated slot stays serveable (writes keep
+    acking through ASK once the target restarts), re-running the pump
+    RESUMES, and no acked write is lost end to end."""
+    with netsim.Net() as net:
+        topo = _topology([[0, NSLOTS - 1]], [])
+        na = MiniClusterNode(net, ADDR_A, "A", topo)
+        nb = MiniClusterNode(net, ADDR_B, "B", topo)
+        na.store[KEY.decode()] = b"0"
+        client = _client(ADDR_A, ADDR_B)
+        acked = [b"0"]
+        pump_failed = []
+
+        def writer():
+            for i in range(1, 3):
+                val = b"%d" % i
+                _write_retrying(client, val)
+                acked.append(val)
+
+        def pump():
+            try:
+                supervisor_mod.migrate_slot(
+                    SLOT, ADDR_A, ADDR_B, notify=(ADDR_A, ADDR_B),
+                    batch=4,
+                )
+            except (OSError, RuntimeError) as e:
+                pump_failed.append(e)  # driver death mid-pump: allowed
+
+        def crasher():
+            checkpoint("crash lands here")
+            net.crash(ADDR_B)
+            checkpoint("target down")
+            net.restart(ADDR_B)
+
+        threads = [threading.Thread(target=f)
+                   for f in (writer, pump, crasher)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if pump_failed or na.slotmap.owner(SLOT) != "B":
+            # Mid-pump death leaves the slot serveable; re-running the
+            # pump RESUMES (every step idempotent) and finishes.
+            supervisor_mod.migrate_slot(
+                SLOT, ADDR_A, ADDR_B, notify=(ADDR_A, ADDR_B), batch=4
+            )
+        assert na.slotmap.owner(SLOT) == "B"
+        assert nb.slotmap.owner(SLOT) == "B"
+        final = client.execute(b"GET", KEY)
+        assert final == acked[-1], (
+            f"acked write lost across crash+retry: read {final!r}, "
+            f"last acked {acked[-1]!r} (pump_failed={bool(pump_failed)})"
+        )
+        client.close()
+
+
+@schedule_test(max_schedules=40, random_schedules=32, preemption_bound=1,
+               max_steps=300000)
+def test_model_migration_pump_crash_retry():
+    _pump_death_body()
+
+
+# ---------------------------------------------------------------------------
+# model 2: the redirect protocol (MOVED exactly-once, ASK, ASKING one-shot)
+# ---------------------------------------------------------------------------
+
+
+def _moved_once_body():
+    """A stale client table: the owner finalized A -> B after bootstrap.
+    The REAL client must refresh the table ONCE and retry ONCE — both
+    nodes see exactly one arrival of the command."""
+    with netsim.Net() as net:
+        topo = _topology([[0, NSLOTS - 1]], [])
+        na = MiniClusterNode(net, ADDR_A, "A", topo)
+        nb = MiniClusterNode(net, ADDR_B, "B", topo)
+        client = _client(ADDR_A)
+        # Ownership finalizes AFTER the client bootstrapped its table.
+        na.slotmap.set_owner(SLOT, "B")
+        nb.slotmap.set_owner(SLOT, "B")
+        nb.store[KEY.decode()] = b"v"
+        refreshes0 = client.stats["table_refreshes"]
+        assert client.execute(b"GET", KEY) == b"v"
+        assert client.stats["moved"] == 1
+        assert client.stats["table_refreshes"] == refreshes0 + 1
+        assert na.counts.get("GET", 0) == 1, "retry must go to B, not A"
+        assert nb.counts.get("GET", 0) == 1, "exactly one retry"
+        client.close()
+
+
+@schedule_test(max_schedules=20, random_schedules=8, preemption_bound=1)
+def test_model_moved_refreshes_and_retries_exactly_once():
+    _moved_once_body()
+
+
+def _moved_bounce_body():
+    """Two nodes misconfigured to MOVED-bounce at each other: the
+    bounded chase gives up after ONE retry (total two arrivals) and
+    surfaces the redirect as an error instead of looping."""
+    with netsim.Net() as net:
+        # A's map says B owns the slot; B's map says A does.
+        na = MiniClusterNode(
+            net, ADDR_A, "A", _topology([[0, NSLOTS - 1]], [])
+        )
+        nb = MiniClusterNode(
+            net, ADDR_B, "B", _topology([[0, NSLOTS - 1]], [])
+        )
+        na.slotmap.set_owner(SLOT, "B")
+        client = _client(ADDR_A)
+        with pytest.raises(ReplyError) as ei:
+            client.execute(b"GET", KEY)
+        assert ei.value.code == "MOVED"
+        total = na.counts.get("GET", 0) + nb.counts.get("GET", 0)
+        assert total == 2, (
+            f"bounded chase must stop after one retry, saw {total} "
+            f"arrivals"
+        )
+        client.close()
+
+
+@schedule_test(max_schedules=20, random_schedules=8, preemption_bound=1)
+def test_model_moved_bounce_gives_up_after_one_retry():
+    _moved_bounce_body()
+
+
+def test_model_moved_budget_mutation_guard():
+    """Reverting the one-retry MOVED budget (unbounded chase) must be
+    caught: the bounce scenario loops forever and the scheduler's step
+    bound fails the schedule with a replayable token."""
+    orig = ClusterClient._chase
+
+    def unbounded(self, cmd, reply, moved_budget, refresh=True):
+        return orig(self, cmd, reply, 1 << 30, refresh)
+
+    ClusterClient._chase = unbounded
+    try:
+        with pytest.raises(ScheduleFailure) as ei:
+            explore(_moved_bounce_body, max_schedules=4,
+                    random_schedules=0, preemption_bound=0,
+                    max_steps=4000)
+        token = ei.value.token
+        with pytest.raises(ScheduleFailure) as ei2:
+            explore(_moved_bounce_body, replay=token,
+                    preemption_bound=0, max_steps=4000)
+        assert ei2.value.token == token
+    finally:
+        ClusterClient._chase = orig
+
+
+def _ask_body():
+    """ASK mid-migration: the key already shipped to B.  The client
+    follows with ASKING + command and must NOT update its table."""
+    with netsim.Net() as net:
+        topo = _topology([[0, NSLOTS - 1]], [])
+        na = MiniClusterNode(net, ADDR_A, "A", topo)
+        nb = MiniClusterNode(net, ADDR_B, "B", topo)
+        na.slotmap.set_migrating(SLOT, "B")
+        nb.slotmap.set_importing(SLOT, "A")
+        nb.store[KEY.decode()] = b"shipped"
+        client = _client(ADDR_A)
+        assert client.execute(b"GET", KEY) == b"shipped"
+        assert client.stats["ask"] == 1
+        assert client.stats["moved"] == 0
+        assert client.slot_addr(SLOT) == ADDR_A, \
+            "ASK must not touch the slot table"
+        assert nb.counts.get("ASKING", 0) == 1
+        client.close()
+
+
+@schedule_test(max_schedules=20, random_schedules=8, preemption_bound=1)
+def test_model_ask_handshake_no_table_update():
+    _ask_body()
+
+
+def _asking_one_shot_body():
+    """The ASKING license is one-shot against ANY next command: a
+    keyless PING between ASKING and the keyed command burns it, so the
+    keyed command gets MOVED, not served (the PR 12 review leak,
+    driven through the REAL consume_one_shot_licenses)."""
+    with netsim.Net() as net:
+        topo = _topology([[0, NSLOTS - 1]], [])
+        MiniClusterNode(net, ADDR_A, "A", topo)
+        nb = MiniClusterNode(net, ADDR_B, "B", topo)
+        nb.slotmap.set_importing(SLOT, "A")
+        nb.store[KEY.decode()] = b"early"
+        import socket as socket_mod
+
+        # License honored when fresh: ASKING + GET serves.
+        s1 = socket_mod.create_connection(ADDR_B)
+        r1 = exchange(s1, [[b"ASKING"], [b"GET", KEY]])
+        assert r1[0] == b"OK" and r1[1] == b"early"
+        s1.close()
+        # A PING in between must BURN it: the keyed command redirects.
+        s2 = socket_mod.create_connection(ADDR_B)
+        r2 = exchange(s2, [[b"ASKING"], [b"PING"], [b"GET", KEY]])
+        assert r2[0] == b"OK" and r2[1] == b"PONG"
+        assert isinstance(r2[2], ReplyError) and r2[2].code == "MOVED", (
+            f"ASKING license leaked past PING: keyed command replied "
+            f"{r2[2]!r} instead of MOVED"
+        )
+        s2.close()
+
+
+@schedule_test(max_schedules=20, random_schedules=8, preemption_bound=1)
+def test_model_asking_license_is_one_shot():
+    _asking_one_shot_body()
+
+
+def test_model_asking_burn_mutation_guard():
+    """Reverting the keyless-command license burn (the shipped
+    consume_one_shot_licenses) must be caught: the PING no longer
+    consumes ASKING and the later keyed command is served under the
+    stale license."""
+    orig = resp_mod.consume_one_shot_licenses
+    resp_mod.consume_one_shot_licenses = lambda ctx, name: None
+    try:
+        with pytest.raises(ScheduleFailure) as ei:
+            explore(_asking_one_shot_body, max_schedules=20,
+                    random_schedules=8, preemption_bound=1)
+        token = ei.value.token
+        with pytest.raises(ScheduleFailure) as ei2:
+            explore(_asking_one_shot_body, replay=token)
+        assert ei2.value.token == token
+    finally:
+        resp_mod.consume_one_shot_licenses = orig
+
+
+# ---------------------------------------------------------------------------
+# scatter/gather demux across reordered legs
+# ---------------------------------------------------------------------------
+
+
+def _scatter_key_for(lo: int, hi: int) -> bytes:
+    for i in range(100000):
+        k = b"sk%d" % i
+        if lo <= key_slot(k) <= hi:
+            return k
+    raise AssertionError("no key found in range")
+
+
+_HALF = NSLOTS // 2
+KEY_A = _scatter_key_for(0, _HALF - 1)
+KEY_B = _scatter_key_for(_HALF, NSLOTS - 1)
+
+
+def _scatter_body():
+    """execute_many across two nodes with a deferrable link: whatever
+    order the legs' replies land in, the demux returns results in
+    SUBMISSION order, and a mid-batch MOVED is chased with ONE table
+    refresh for the whole batch."""
+    with netsim.Net(defer_budget=1, defer_s=0.5) as net:
+        topo = _topology([[0, _HALF - 1]], [[_HALF, NSLOTS - 1]])
+        na = MiniClusterNode(net, ADDR_A, "A", topo)
+        nb = MiniClusterNode(net, ADDR_B, "B", topo)
+        client = _client(ADDR_A, ADDR_B)
+        r = client.execute_many([
+            [b"SET", KEY_A, b"va"], [b"SET", KEY_B, b"vb"],
+            [b"GET", KEY_A], [b"GET", KEY_B], [b"PING"],
+        ])
+        assert r == [b"OK", b"OK", b"va", b"vb", b"PONG"], r
+        assert client.stats["scatter_legs"] >= 2
+        # A finalize the client has not seen: the batch's KEY_A replies
+        # come back MOVED, the chase refreshes ONCE and lands them.
+        na.slotmap.set_owner(key_slot(KEY_A), "B")
+        nb.slotmap.set_owner(key_slot(KEY_A), "B")
+        nb.store[KEY_A.decode()] = b"moved-va"
+        refreshes0 = client.stats["table_refreshes"]
+        r2 = client.execute_many([[b"GET", KEY_A], [b"GET", KEY_B]])
+        assert r2 == [b"moved-va", b"vb"], r2
+        assert client.stats["table_refreshes"] == refreshes0 + 1, \
+            "one refresh per batch, not per MOVED reply"
+        client.close()
+
+
+@schedule_test(max_schedules=60, random_schedules=32, preemption_bound=2,
+               max_steps=300000)
+def test_model_scatter_gather_demux_under_reordering():
+    _scatter_body()
+
+
+# ---------------------------------------------------------------------------
+# pooled-socket desync discipline (timeout -> drop, never reuse)
+# ---------------------------------------------------------------------------
+
+
+def _desync_body():
+    """A reply outliving its request's timeout: the pooled connection
+    must be DROPPED (the PR 12 review fix) — a later command on a kept
+    socket would read the late reply as its OWN (silent cross-command
+    corruption).  The slow node delays its first GET reply past the
+    client timeout; the retry must see the RIGHT key's value."""
+    with netsim.Net() as net:
+        topo = _topology([[0, NSLOTS - 1]], [])
+        na = MiniClusterNode(net, ADDR_A, "A", topo,
+                             slow_first_get_s=5.0)
+        MiniClusterNode(net, ADDR_B, "B", topo)
+        na.store["d1"] = b"v1"
+        na.store["d2"] = b"v2"
+        client = _client(ADDR_A, timeout_s=1.0)
+        with pytest.raises(OSError):
+            client.execute(b"GET", b"d1")  # reply lands at t+5, too late
+        time.sleep(6.0)  # virtual: the stale reply is in flight/buffered
+        got = client.execute(b"GET", b"d2")
+        assert got == b"v2", (
+            f"cross-command corruption: GET d2 answered {got!r} (the "
+            f"timed-out GET d1's late reply) — desynced socket reused"
+        )
+        client.close()
+
+
+@schedule_test(max_schedules=20, random_schedules=8, preemption_bound=1)
+def test_model_pooled_socket_dropped_after_timeout():
+    _desync_body()
+
+
+def test_model_socket_drop_mutation_guard():
+    """Reverting the drop-on-OSError discipline (reuse the pooled
+    socket after a timeout) must be caught as cross-command reply
+    corruption, with a replayable token."""
+    orig = ClusterClient._request
+
+    def keep_on_error(self, addr, cmds):
+        return self._conn(addr).request(cmds)  # no drop, ever
+
+    ClusterClient._request = keep_on_error
+    try:
+        with pytest.raises(ScheduleFailure) as ei:
+            explore(_desync_body, max_schedules=20, random_schedules=8,
+                    preemption_bound=1)
+        token = ei.value.token
+        with pytest.raises(ScheduleFailure) as ei2:
+            explore(_desync_body, replay=token)
+        assert ei2.value.token == token
+    finally:
+        ClusterClient._request = orig
+
+
+# ---------------------------------------------------------------------------
+# crash contract: outbound connections reset too
+# ---------------------------------------------------------------------------
+
+
+@schedule_test(max_schedules=40, random_schedules=16, preemption_bound=2)
+def test_crash_resets_outbound_connections():
+    """net.crash(A) resets connections A's handler actors DIALED (the
+    door-pump shape: a persistent migration socket to another node),
+    not just inbound ones — the peer's parked recv fails promptly
+    instead of hanging the schedule on a pipe nobody will ever feed."""
+    import socket as sk
+
+    done = threading.Event()
+    seen = {}
+
+    def b_handler(sock, peer):
+        try:
+            seen["result"] = "data" if sock.recv(16) else "eof"
+        except OSError as e:
+            # ConnectionResetError when parked in recv at crash time,
+            # bare OSError when the abort landed before the first recv
+            # — either way the failure is prompt, which is the contract.
+            seen["result"] = "reset" if isinstance(
+                e, ConnectionResetError) else "closed"
+        finally:
+            done.set()
+
+    def a_handler(sock, peer):
+        conn = sk.create_connection(ADDR_B)  # outbound from node A
+        sock.sendall(b"+dialed\r\n")
+        conn.recv(16)  # parked holding the outbound socket
+
+    with netsim.Net() as net:
+        net.listen(ADDR_A, a_handler, name="A")
+        net.listen(ADDR_B, b_handler, name="B")
+        c = sk.create_connection(ADDR_A)
+        assert c.recv(16) == b"+dialed\r\n"
+        net.crash(ADDR_A)
+        assert done.wait(5.0), "B never observed A's crash"
+        assert seen["result"] in ("reset", "closed"), seen

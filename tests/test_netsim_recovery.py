@@ -1,0 +1,492 @@
+"""netsim recovery models (ISSUE 15 tentpole, models 3+4): the REAL
+group-commit journal crash-recovered at schedule-chosen points, and the
+REAL residency transition protocol racing snapshots.
+
+Model 3 — group-commit + recovery: producers append to a real
+``OpJournal`` (``appendfsync always``) while a crash actor kills the
+writer thread at a schedule-chosen point, optionally arming the
+torn-tail fault first, and with a schedule-chosen SEVERITY (process
+kill -9: flushed bytes survive; host crash: ``HostCrashDisk`` rolls
+every file back to its last fsynced size).  Recovery (a fresh journal
+scan over the same directory) must yield a contiguous prefix that
+covers EVERY acked record, wherever the crash landed in the
+append → write → fsync → ack pipeline.  The mutation guard reverts the
+commit barrier (ack at write time instead of fsync time) and the model
+catches it with a replayable token.
+
+Model 4 — residency × snapshot: the REAL ``ResidencyManager.demote``/
+``promote`` transition code (drain → capture → install, repoint-row-
+BEFORE-drop-mirror, quarantine) runs against a stub engine while a
+gate-disciplined writer, a gate-free reader, and a gate-held snapshot
+reader race it.  No schedule may serve a read from nowhere (no mirror
+AND no row) or a state missing an acked write; the snapshot must equal
+the acked set exactly.  The mutation guard re-orders promotion into
+drop-mirror-then-repoint (the ordering the shipped code forbids) and
+the model catches the gap with a replayable token.
+"""
+
+import os
+import tempfile
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from redisson_tpu import chaos as _chaos
+from redisson_tpu.analysis import explorer, netsim
+from redisson_tpu.analysis.explorer import (
+    ScheduleFailure,
+    checkpoint,
+    explore,
+    schedule_test,
+)
+from redisson_tpu.durability.journal import JournalError, OpJournal
+from redisson_tpu.objects import degraded as degraded_mod
+from redisson_tpu.ops import bitset as bitset_ops
+from redisson_tpu.ops import golden  # noqa: F401  (pre-import for sim threads)
+from redisson_tpu.storage import residency as rsd
+from redisson_tpu.tenancy import PoolKind
+
+pytestmark = pytest.mark.netsim
+
+
+@pytest.fixture(autouse=True)
+def _unpatch_netsim():
+    """A failing schedule abandons the explored body mid-``with``
+    (Net/HostCrashDisk __exit__ never runs), which would leave the
+    sim patches live for every LATER test in this process."""
+    yield
+    netsim.restore_patches()
+
+
+# ---------------------------------------------------------------------------
+# model 3: group-commit journal vs crash, at every pipeline stage
+# ---------------------------------------------------------------------------
+
+
+def _journal_crash_body(journal_cls):
+    tmp = tempfile.mkdtemp(prefix="rtpu-netsim-journal-")
+    acked: list = []
+    with netsim.HostCrashDisk() as disk:
+        j = journal_cls(tmp, fsync_policy="always",
+                        max_segment_bytes=1 << 20)
+
+        def producer(base):
+            for i in range(2):
+                try:
+                    seq = j.append({"op": "x", "i": base + i})
+                except JournalError:
+                    return  # broken journal refuses: not acked, fine
+                try:
+                    ok = j.wait_durable(seq, timeout=3.0)
+                except JournalError:
+                    ok = False
+                if ok:
+                    acked.append(seq)
+
+        def crasher():
+            checkpoint("crash lands here")
+            if explorer.decide(2, "torn-tail?") == 1:
+                # Crash MID-FRAME: the writer emits half a frame and
+                # breaks (the chaos torn-tail point, rate 1.0 = the
+                # very next frame).
+                _chaos.inject("journal.torn_tail", "error", rate=1.0)
+                checkpoint("armed: next frame tears")
+            explorer.kill(j._writer)
+            # Severity: kill -9 (OS survives, flushed bytes incl. the
+            # torn half-frame remain) vs host crash (everything past
+            # the last fsync is gone).
+            keep = explorer.decide(2, "kill9-vs-host-crash") == 0
+            disk.crash(tmp, keep_written=keep)
+
+        threads = [
+            threading.Thread(target=producer, args=(100,)),
+            threading.Thread(target=producer, args=(200,)),
+            threading.Thread(target=crasher),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        _chaos.clear()
+    # "Restart": a fresh journal scans the directory — torn tails
+    # truncate, later segments drop (durability/journal.py recovery).
+    r = OpJournal(tmp, fsync_policy="always")
+    recovered = [seq for seq, _rec in r.records_after(0)]
+    r.close()
+    assert recovered == list(range(1, len(recovered) + 1)), (
+        f"recovery is not a contiguous prefix: {recovered}"
+    )
+    missing = [s for s in acked if s not in recovered]
+    assert not missing, (
+        f"acked records lost across the crash: {missing} "
+        f"(acked={sorted(acked)}, recovered through "
+        f"{len(recovered)})"
+    )
+
+
+@schedule_test(max_schedules=150, random_schedules=64, preemption_bound=2,
+               max_steps=400000)
+def test_model_journal_recovery_covers_acked_prefix():
+    _journal_crash_body(OpJournal)
+
+
+def _journal_slow_fsync_crash_body(journal_cls):
+    """The ack-vs-fsync ORDER under a slow disk: chaos latency pins
+    every group-commit fsync at 30 virtual seconds, a crash actor
+    kills the node mid-fsync, and the host-crash severity rolls the
+    files back to the last fsync.  The real journal acks only AFTER
+    the fsync, so nothing acked can be lost; the reverted barrier
+    (ack at write) acks into exactly this window."""
+    tmp = tempfile.mkdtemp(prefix="rtpu-netsim-journal-")
+    acked: list = []
+    with netsim.HostCrashDisk() as disk:
+        _chaos.inject("journal.fsync", "latency", rate=1.0,
+                      latency_s=30.0)
+        try:
+            j = journal_cls(tmp, fsync_policy="always",
+                            max_segment_bytes=1 << 20)
+
+            def producer(base):
+                for i in range(2):
+                    try:
+                        seq = j.append({"op": "x", "i": base + i})
+                    except JournalError:
+                        return
+                    try:
+                        ok = j.wait_durable(seq, timeout=3.0)
+                    except JournalError:
+                        ok = False
+                    if ok:
+                        acked.append(seq)
+
+            def crasher():
+                time.sleep(1.0)  # virtual: the writer is mid-fsync
+                explorer.kill(j._writer)
+                keep = explorer.decide(2, "kill9-vs-host-crash") == 0
+                disk.crash(tmp, keep_written=keep)
+
+            threads = [
+                threading.Thread(target=producer, args=(100,)),
+                threading.Thread(target=producer, args=(200,)),
+                threading.Thread(target=crasher),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            _chaos.clear()
+    r = OpJournal(tmp, fsync_policy="always")
+    recovered = [seq for seq, _rec in r.records_after(0)]
+    r.close()
+    assert recovered == list(range(1, len(recovered) + 1)), (
+        f"recovery is not a contiguous prefix: {recovered}"
+    )
+    missing = [s for s in acked if s not in recovered]
+    assert not missing, (
+        f"acked records lost across the mid-fsync crash: {missing} "
+        f"(acked={sorted(acked)}, recovered={recovered})"
+    )
+
+
+@schedule_test(max_schedules=60, random_schedules=32, preemption_bound=2,
+               max_steps=400000)
+def test_model_journal_ack_waits_out_the_slow_fsync():
+    _journal_slow_fsync_crash_body(OpJournal)
+
+
+class _AckAtWrite(OpJournal):
+    """The reverted commit barrier: durability reported at WRITE time.
+    Correct-looking under a clean run (the fsync still happens soon) —
+    only a crash landing between the write-ack and the fsync shows the
+    lie, which is exactly the schedule the model hunts."""
+
+    def _write_batch(self, batch):
+        super()._write_batch(batch)
+        with self._lock:
+            self._durable_seq = self._written_seq
+            self._durable_cv.notify_all()
+
+
+def test_model_journal_ack_barrier_mutation_guard():
+    with pytest.raises(ScheduleFailure) as ei:
+        explore(lambda: _journal_slow_fsync_crash_body(_AckAtWrite),
+                max_schedules=300, random_schedules=128,
+                preemption_bound=2, max_steps=400000)
+    token = ei.value.token
+    with pytest.raises(ScheduleFailure) as ei2:
+        explore(lambda: _journal_slow_fsync_crash_body(_AckAtWrite),
+                replay=token, max_steps=400000)
+    assert ei2.value.token == token
+
+
+# ---------------------------------------------------------------------------
+# model 4: residency transitions vs concurrent reads and snapshots
+# ---------------------------------------------------------------------------
+
+_ROW_UNITS = 4  # 128 bits
+
+
+class _StubPool:
+    def __init__(self, rows):
+        self.spec = types.SimpleNamespace(
+            dtype=np.uint32, kind=PoolKind.BITSET
+        )
+        self.row_units = _ROW_UNITS
+        self.topology_epoch = 0
+        self._dispatch_lock = threading.Lock()
+        self._rows = rows
+        self._free = [1, 2, 3]
+
+    def alloc_row(self) -> int:
+        r = self._free.pop(0)
+        self._rows[r] = np.zeros(_ROW_UNITS, np.uint32)
+        return r
+
+    def free_row(self, r) -> None:
+        self._free.append(r)
+
+
+class _StubExecutor:
+    """Device rows as host arrays, with scheduling points where the
+    real executor would cross the device boundary."""
+
+    def __init__(self, rows):
+        self._rows = rows
+
+    def read_row(self, pool, row):
+        checkpoint("device read in flight")
+        return np.array(self._rows[row])
+
+    def write_row(self, pool, row, arr):
+        checkpoint("device write in flight")
+        self._rows[row] = np.array(arr, dtype=np.uint32)
+
+    def zero_row(self, pool, row):
+        self._rows[row] = np.zeros(_ROW_UNITS, np.uint32)
+
+
+class _StubHealth:
+    @staticmethod
+    def degraded_kind(kind):
+        return False
+
+
+def _stub_engine():
+    rows = {0: np.zeros(_ROW_UNITS, np.uint32)}
+    pool = _StubPool(rows)
+    eng = types.SimpleNamespace(
+        _journal_gate=threading.RLock(),
+        _mirror_lock=threading.RLock(),
+        _mirrors={},
+        _mirror_epoch=0,
+        health=_StubHealth(),
+        executor=_StubExecutor(rows),
+        _drain=lambda: checkpoint("coalescer drain"),
+    )
+    entry = types.SimpleNamespace(
+        name="t", kind=PoolKind.BITSET, row=0, replica_rows=(),
+        pool=pool, residency=rsd.DEVICE, params={},
+    )
+    eng._live_lookup = lambda name: entry if name == "t" else None
+    return eng, entry, rows
+
+
+def _bits_of(row: np.ndarray) -> set:
+    out = set()
+    for w, word in enumerate(np.asarray(row, np.uint32)):
+        for b in range(32):
+            if int(word) & (1 << b):
+                out.add(w * 32 + b)
+    return out
+
+
+def _set_bit(row: np.ndarray, bit: int) -> None:
+    row[bit // 32] |= np.uint32(1 << (bit % 32))
+
+
+def _rm_for(eng, manager_cls=rsd.ResidencyManager):
+    cfg = types.SimpleNamespace(
+        residency_device_rows=1, residency_max_host_bytes=0,
+        residency_max_disk_bytes=0, residency_promote_heat=1.0,
+        residency_interval_ms=100, residency_dir=None,
+        residency_heat_half_life_s=10.0,
+    )
+    return manager_cls(eng, cfg)
+
+
+def _read_location(eng, entry, rows):
+    """The engine read discipline: capture row BEFORE the mirror
+    check, resolve via the mirror or the (possibly quarantined,
+    contents-intact) captured row — residency.py's read contract."""
+    row0 = entry.row
+    checkpoint("reader captured row")
+    with eng._mirror_lock:
+        mir = eng._mirrors.get("t")
+        if mir is not None:
+            return _bits_of(mir.encode(_ROW_UNITS))
+    r = entry.row if row0 < 0 else row0
+    assert r >= 0, (
+        "read dispatched with NO mirror and NO device row — the "
+        "promote repoint-before-drop ordering was violated"
+    )
+    checkpoint("device read in flight")
+    return _bits_of(rows[r])
+
+
+def _residency_body(manager_cls=rsd.ResidencyManager, full_cast=True):
+    eng, entry, rows = _stub_engine()
+    rm = _rm_for(eng, manager_cls)
+    acked: list = []
+
+    def writer():
+        # The engine's mutating-op discipline: the whole
+        # check-residency -> submit window under the journal gate.
+        for bit in (1, 66):
+            with eng._journal_gate:
+                with eng._mirror_lock:
+                    mir = eng._mirrors.get("t")
+                    if mir is not None:
+                        # HOST-resident: the mirror IS the truth —
+                        # the REAL kind mirror applies the op.
+                        mir.mixed(
+                            np.array([bit]),
+                            np.array([bitset_ops.OP_SET], np.uint32),
+                        )
+                        applied = True
+                    else:
+                        applied = False
+                if not applied:
+                    r = entry.row
+                    assert r >= 0, "write dispatched with no tier"
+                    checkpoint("write queued behind the gate")
+                    _set_bit(rows[r], bit)
+                acked.append(bit)
+            checkpoint("between writes")
+
+    def mover():
+        # The REAL transitions (drain -> capture -> install; write-row
+        # -> repoint -> drop; quarantine instead of free).
+        rm.demote("t")
+        checkpoint("demoted")
+        rm.promote("t")
+
+    def reader():
+        lo = list(acked)  # acked before this read began
+        got = _read_location(eng, entry, rows)
+        for b in lo:
+            assert b in got, (
+                f"stale read: bit {b} was acked before the read began "
+                f"but is missing (got {sorted(got)})"
+            )
+
+    def snapshotter():
+        # The snapshot capture discipline: gate + drain quiesce writers
+        # AND transitions, so the captured state equals the acked set.
+        with eng._journal_gate:
+            eng._drain()
+            with eng._mirror_lock:
+                mir = eng._mirrors.get("t")
+                if mir is not None:
+                    got = _bits_of(mir.encode(_ROW_UNITS))
+                else:
+                    assert entry.row >= 0, \
+                        "snapshot found no mirror and no row"
+                    got = _bits_of(rows[entry.row])
+            assert got == set(acked), (
+                f"snapshot diverges from the acked set: captured "
+                f"{sorted(got)}, acked {sorted(set(acked))}"
+            )
+
+    cast = (
+        (writer, mover, reader, snapshotter) if full_cast
+        else (mover, reader)
+    )
+    if not full_cast:
+        # The focused mutation-hunt cast starts HOST-resident so the
+        # first transition is the promotion under test.
+        rm.demote("t")
+    threads = [threading.Thread(target=f) for f in cast]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # Quiescent reclaim: the quarantined demotion row zeroes and frees
+    # only now (the real post-drain cycle); then the final truth must
+    # hold every acked write on whatever tier serves.
+    rm.reclaim()
+    with eng._mirror_lock:
+        mir = eng._mirrors.get("t")
+        truth = (
+            _bits_of(mir.encode(_ROW_UNITS)) if mir is not None
+            else _bits_of(rows[entry.row])
+        )
+    assert truth == set(acked), (
+        f"acked-write loss across transitions: truth {sorted(truth)}, "
+        f"acked {sorted(set(acked))}"
+    )
+
+
+@schedule_test(max_schedules=800, random_schedules=128,
+               preemption_bound=2, max_steps=200000)
+def test_model_residency_transitions_vs_snapshot():
+    _residency_body()
+
+
+class _PromoteDropsMirrorFirst(rsd.ResidencyManager):
+    """The named mutation: promotion drops the mirror BEFORE the row
+    is written and repointed (and repoints in a second lock section) —
+    the ordering storage/residency.py's promote() exists to forbid."""
+
+    def promote(self, name):
+        eng = self._eng
+        with eng._journal_gate:
+            entry = eng._live_lookup(name)
+            if entry is None or entry.row >= 0:
+                return False
+            with eng._mirror_lock:
+                mirror = eng._mirrors.get(name)
+                if mirror is None or getattr(
+                    mirror, "residency", None
+                ) != rsd.HOST:
+                    return False
+                row = entry.pool.alloc_row()
+                enc = np.asarray(mirror.encode(entry.pool.row_units))
+                del eng._mirrors[name]
+                eng._mirror_epoch += 1
+            checkpoint("BUG window: no mirror, no row")
+            eng.executor.write_row(entry.pool, row, enc)
+            with eng._mirror_lock:
+                entry.row = row
+                entry.residency = rsd.DEVICE
+            with self._lock:
+                self._host_nbytes.pop(name, None)
+            self.promotions += 1
+        return True
+
+
+def test_model_residency_promote_order_mutation_guard():
+    body = lambda: _residency_body(  # noqa: E731
+        manager_cls=_PromoteDropsMirrorFirst, full_cast=False
+    )
+    with pytest.raises(ScheduleFailure) as ei:
+        explore(body, max_schedules=800, random_schedules=128,
+                preemption_bound=2, max_steps=200000)
+    token = ei.value.token
+    with pytest.raises(ScheduleFailure) as ei2:
+        explore(body, replay=token, max_steps=200000)
+    assert ei2.value.token == token
+
+
+def test_mirror_for_entry_is_the_real_codec():
+    """Sanity pin: the model's mirror IS objects/degraded.py's (the
+    transition protocol under test round-trips through the real
+    codec, not a test double)."""
+    eng, entry, rows = _stub_engine()
+    _set_bit(rows[0], 7)
+    m = degraded_mod.mirror_for_entry(entry, np.array(rows[0]))
+    assert isinstance(m, degraded_mod.BitsetMirror)
+    assert _bits_of(m.encode(_ROW_UNITS)) == {7}

@@ -21,7 +21,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _EXPECT_RE = re.compile(r"#\s*rtpulint-expect:\s*(RT\d{3})")
 
 CHECKED_RULES = ("RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
-                 "RT007", "RT008", "RT009", "RT011")
+                 "RT007", "RT008", "RT009", "RT011", "RT012", "RT013",
+                 "RT014")
 
 
 def _expected(path):
@@ -157,3 +158,117 @@ class TestReactorModuleCoverage:
 
         live = [v for v in lint_file(rx.__file__) if not v.suppressed]
         assert live == [], [v.format() for v in live]
+
+
+# -- suppression audit + parallel jobs (ISSUE 15 satellites) -------------------
+
+
+class TestSuppressionAudit:
+    """``--audit-suppressions``: a disable comment whose rule no longer
+    fires at its target line is STALE (dead armor), and CI fails on it."""
+
+    STALE_SRC = (
+        "# rtpulint: role=dispatch\n"
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def f():\n"
+        "    with _lock:\n"
+        "        # rtpulint: disable=RT001 the blocking call was removed long ago\n"
+        "        x = 1\n"
+    )
+    LIVE_SRC = (
+        "# rtpulint: role=dispatch\n"
+        "import time\n"
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def f():\n"
+        "    with _lock:\n"
+        "        # rtpulint: disable=RT001 fixture reason\n"
+        "        time.sleep(1)\n"
+    )
+
+    def test_stale_suppression_reported(self, tmp_path):
+        from redisson_tpu.analysis.rtpulint import audit_paths
+
+        p = tmp_path / "frag.py"
+        p.write_text(self.STALE_SRC)
+        stale = audit_paths([str(p)])
+        assert [(s.line, s.rules) for s in stale] == [(6, ("RT001",))]
+        assert "removed long ago" in stale[0].format()
+
+    def test_live_suppression_not_stale(self, tmp_path):
+        from redisson_tpu.analysis.rtpulint import audit_paths
+
+        p = tmp_path / "frag.py"
+        p.write_text(self.LIVE_SRC)
+        assert audit_paths([str(p)]) == []
+
+    def test_rt010_comments_skipped_without_tree_pass(self, tmp_path):
+        # RT010-naming comments verify against the lock graph's
+        # consumed-site set; without it the audit must not guess.
+        from redisson_tpu.analysis.rtpulint import audit_paths
+
+        p = tmp_path / "frag.py"
+        p.write_text("x = 1  # rtpulint: disable=RT010 ordered via catalog\n")
+        assert audit_paths([str(p)]) == []
+        # With an (empty) consumed-site set the same comment IS stale.
+        stale = audit_paths([str(p)], rt010_sites=set())
+        assert [s.rules for s in stale] == [("RT010",)]
+
+    def test_cli_audit_fails_on_stale(self, tmp_path):
+        bad = tmp_path / "frag.py"
+        bad.write_text(self.STALE_SRC)
+        proc = subprocess.run(
+            [sys.executable, "-m", "redisson_tpu.analysis",
+             str(bad), "--audit-suppressions"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1
+        assert "stale suppression" in proc.stdout
+        assert "audit: 1 stale" in proc.stderr
+
+    def test_cli_audit_passes_on_tree(self):
+        """Acceptance: every reasoned suppression in the shipping
+        package still suppresses a live finding."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "redisson_tpu.analysis",
+             "redisson_tpu", "--audit-suppressions"],
+            cwd=REPO, capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "audit: 0 stale" in proc.stderr
+
+
+class TestParallelJobs:
+    """``--jobs N``: per-file analysis fans out to N processes with
+    findings byte-identical to the serial pass."""
+
+    def test_lint_paths_jobs_byte_identical(self):
+        serial = lint_paths([FIXDIR], jobs=1)
+        parallel = lint_paths([FIXDIR], jobs=4)
+        fmt = lambda vs: [(v.format(), v.suppressed) for v in vs]
+        assert fmt(parallel) == fmt(serial)
+        assert serial, "fixture corpus produced no findings at all"
+
+    def test_audit_paths_jobs_byte_identical(self, tmp_path):
+        from redisson_tpu.analysis.rtpulint import audit_paths
+
+        for i in range(6):
+            p = tmp_path / f"frag{i}.py"
+            p.write_text(TestSuppressionAudit.STALE_SRC)
+        serial = audit_paths([str(tmp_path)], jobs=1)
+        parallel = audit_paths([str(tmp_path)], jobs=3)
+        fmt = lambda ss: [s.format() for s in ss]
+        assert fmt(parallel) == fmt(serial)
+        assert len(serial) == 6
+
+    def test_cli_jobs_output_identical(self, tmp_path):
+        def run(jobs):
+            return subprocess.run(
+                [sys.executable, "-m", "redisson_tpu.analysis",
+                 FIXDIR, "--jobs", jobs, "--show-suppressed"],
+                cwd=REPO, capture_output=True, text=True, timeout=300,
+            )
+        one, four = run("1"), run("4")
+        assert one.returncode == four.returncode == 1
+        assert one.stdout == four.stdout
