@@ -492,6 +492,13 @@ class ClusterClient:
         "trace_evicted_total", "latency_events", "latency_samples",
         "aof_records_written", "aof_bytes_written", "aof_fsyncs",
         "aof_pending_records", "aof_replayed_records", "aof_segments",
+        # Load-attribution totals (ISSUE 16) — counters/occupancy only;
+        # loadmap_enabled and loadmap_key_sample_rate stay per-node
+        # (summing a rate across nodes is a lie, not a total).
+        "loadmap_ops", "loadmap_reads", "loadmap_writes",
+        "loadmap_bytes_in", "loadmap_bytes_out", "loadmap_shed_ops",
+        "loadmap_device_us", "loadmap_keys", "loadmap_sampled_keys",
+        "loadmap_tracked_keys",
     ))
 
     @classmethod
@@ -587,6 +594,91 @@ class ClusterClient:
                 d = _json.loads(doc)
                 out.setdefault(d["trace_id"], []).extend(d["spans"])
         return out
+
+    def fleet_latency(self) -> list:
+        """Cross-node LATENCY LATEST merge: one row per (node, event),
+        node-tagged, worst latest-ms first — the fleet-wide view of the
+        latency monitor (arm it with CONFIG SET
+        latency-monitor-threshold on every node)."""
+        merged: list = []
+        for addr, raw in self._fanout([b"LATENCY", b"LATEST"]).items():
+            node = "%s:%d" % tuple(addr)
+            if isinstance(raw, (ReplyError, Exception)):
+                continue
+            for e in raw:
+                merged.append({
+                    "node": node,
+                    "event": e[0].decode("latin-1", "replace"),
+                    "ts": int(e[1]),
+                    "latest_ms": int(e[2]),
+                    "max_ms": int(e[3]),
+                })
+        merged.sort(
+            key=lambda d: (d["latest_ms"], d["max_ms"]), reverse=True
+        )
+        return merged
+
+    def fleet_loadmap(self, hot_keys: int = 16) -> dict:
+        """The fleet load map: every node's CLUSTER LOADMAP snapshot
+        merged into ``{"slots": {slot: {"node", "load vector…"}},
+        "top_slots": […], "hot_keys": […], "tenants": {…},
+        "nodes": {node: totals}}``.
+
+        Slots are node-disjoint (each slot is served by its owner), so
+        the merge keeps the reporting node as the slot's owner tag and
+        ranks slots by ops.  Hot keys merge by summed decayed estimate
+        across nodes; tenant device-time shares re-normalize over the
+        fleet-wide device_us total."""
+        import json as _json
+
+        slots: dict = {}
+        key_heat: dict = {}
+        tenants: dict = {}
+        nodes: dict = {}
+        for addr, raw in self._fanout([b"CLUSTER", b"LOADMAP"]).items():
+            node = "%s:%d" % tuple(addr)
+            if isinstance(raw, (ReplyError, Exception)):
+                nodes[node] = {"error": str(raw)}
+                continue
+            snap = _json.loads(raw)
+            fields = snap["fields"]
+            nodes[node] = snap.get("totals", {})
+            for s, vec in snap["slots"].items():
+                row = dict(zip(fields, vec))
+                row["node"] = node
+                prev = slots.get(int(s))
+                if prev is None or row["ops"] >= prev["ops"]:
+                    # A slot mid-migration can appear on two nodes;
+                    # the busier report wins the owner tag.
+                    slots[int(s)] = row
+            for k, c in snap.get("hot_keys", []):
+                key_heat[k] = key_heat.get(k, 0.0) + c
+            for t, d in snap.get("tenants", {}).items():
+                agg = tenants.setdefault(
+                    t, {"device_us": 0.0, "ops": 0}
+                )
+                agg["device_us"] += d.get("device_us", 0.0)
+                agg["ops"] += d.get("ops", 0)
+        total_us = sum(d["device_us"] for d in tenants.values())
+        for d in tenants.values():
+            d["share"] = (
+                round(d["device_us"] / total_us, 4) if total_us else 0.0
+            )
+        top_slots = sorted(
+            slots, key=lambda s: slots[s]["ops"], reverse=True
+        )
+        hot = sorted(
+            key_heat.items(), key=lambda kv: kv[1], reverse=True
+        )[:hot_keys]
+        return {
+            "slots": slots,
+            "top_slots": top_slots,
+            "hot_keys": [
+                {"key": k, "est": round(c, 2)} for k, c in hot
+            ],
+            "tenants": tenants,
+            "nodes": nodes,
+        }
 
     def _executor(self):
         """Shared scatter-leg thread pool (threads spawn on demand and

@@ -155,18 +155,28 @@ class ClusterDoor:
         asking = getattr(ctx, "asking", False)
         slot, extra = self.command_slot(name, cmd)
         if slot is None:
+            # Keyless / local-always commands attribute to slot 0, the
+            # loadmap's "unslotted" bucket; cross-slot errors (extra is
+            # a frame) attribute nowhere.
+            ctx.load_slot = 0 if extra is None else None
             return extra, False
+        # Load attribution (ISSUE 16): the dispatch path reads this
+        # back after the handler runs — only decisions that SERVE here
+        # leave a slot; every redirect/error path below clears it.
+        ctx.load_slot = None
         ctx.asking = False  # one-shot: consumed by this keyed command
         keys = extra
         d = self.slotmap.lookup(slot)
         if d.owner == self.myid:
             if d.migrating_to is None:
+                ctx.load_slot = slot
                 return None, False
             # Presence probe OUTSIDE the slotmap lock (lookup returned a
             # snapshot); the authoritative re-check happens under the
             # move guard in route_recheck.
             present = sum(1 for k in keys if self._exists(k))
             if present == len(keys):
+                ctx.load_slot = slot
                 return None, name not in _NEVER_GUARD
             if present == 0:
                 self._count("ask")
@@ -179,6 +189,7 @@ class ClusterDoor:
             ), False
         if d.importing_from is not None and asking:
             self._count("asking_served")
+            ctx.load_slot = slot
             return None, False
         if d.owner is None:
             return _err(

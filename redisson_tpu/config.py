@@ -335,6 +335,14 @@ class Config:
         self.trace_sample_rate = 0.0
         self.trace_max_spans = 2048
         self.latency_monitor_threshold_ms = 0
+        # Load-attribution plane (ISSUE 16).  Probability that a served
+        # command's keys are fed into the node's hot-key sketches
+        # (decayed CMS + space-saving top-k in obs/loadmap.py) — the
+        # per-slot load vectors are always maintained (O(1) array bumps);
+        # only KEY sampling is probabilistic, since it takes the loadmap
+        # lock.  Live-settable via CONFIG SET loadmap-key-sample-rate;
+        # surfaced through HOTKEYS and INFO loadstats.
+        self.loadmap_key_sample_rate = 0.01
 
     # -- fluent setters, mirroring the Java builder idiom ------------------
 
@@ -396,6 +404,7 @@ class Config:
         "trace_sample_rate",
         "trace_max_spans",
         "latency_monitor_threshold_ms",
+        "loadmap_key_sample_rate",
     )
 
     def to_dict(self) -> dict:

@@ -1027,6 +1027,18 @@ class BatchCoalescer:
                     )
                     if seg.span is not None:
                         seg.span.nops = seg.nops
+                        # Load attribution (ISSUE 16): stash the
+                        # (tenant, nops) composition so the recorder can
+                        # split the launch's device time per tenant.
+                        # Only when a loadmap is armed — the common path
+                        # allocates nothing extra.
+                        if (self.obs is not None
+                                and self.obs.spans.loadmap is not None
+                                and self.obs.spans.loadmap.enabled):
+                            seg.span.tenants = [
+                                (t, n) for _, _, n, t, _ in seg.futures
+                                if t is not None
+                            ] or None
                         seg.span.stamp("d2h_fetch")
                         seg.span.finish()
                     if self.obs is not None:

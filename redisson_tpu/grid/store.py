@@ -57,11 +57,22 @@ class GridStore:
         # they are called under ``self.lock``.
         self.on_invalidate = None
         self.on_invalidate_all = None
+        # Load-attribution reach (ISSUE 16): the serve layer wires this
+        # to the loadmap's exact per-slot key counters.  Called as
+        # ``on_keyspace(name, +1/-1)`` at every point the set of live
+        # names changes, UNDER ``self.lock`` — the hook must be
+        # leaf-safe, like the invalidation hooks above.
+        self.on_keyspace = None
 
     def _note_invalidate(self, name: str) -> None:
         hook = self.on_invalidate
         if hook is not None:
             hook(name)
+
+    def _note_keyspace(self, name: str, delta: int) -> None:
+        hook = self.on_keyspace
+        if hook is not None:
+            hook(name, delta)
 
     def _guard_foreign(self, name: str) -> None:
         if self.foreign_exists is not None and self.foreign_exists(name):
@@ -86,6 +97,7 @@ class GridStore:
             if e is not None and e.expired(time.time()):
                 del self._data[name]
                 self._note_invalidate(name)
+                self._note_keyspace(name, -1)
                 e = None
             if e is not None and kind is not None and e.kind != kind:
                 raise TypeError(f"object {name!r} holds a {e.kind}, not a {kind}")
@@ -98,6 +110,7 @@ class GridStore:
                 self._guard_foreign(name)
                 e = GridEntry(kind, factory())
                 self._data[name] = e
+                self._note_keyspace(name, +1)
             return e
 
     def put_entry(self, name: str, kind: str, value: Any) -> GridEntry:
@@ -109,6 +122,11 @@ class GridStore:
                 # which may have legitimately created it meanwhile.
                 self._guard_foreign(name)
             e = GridEntry(kind, value)
+            # An expired-unreaped prior still holds its +1 (only the
+            # reap paths decrement), so the overwrite transfers it: the
+            # count moves only when the name was genuinely absent.
+            if prior is None:
+                self._note_keyspace(name, +1)
             self._data[name] = e
             self.cond.notify_all()
             return e
@@ -130,6 +148,7 @@ class GridStore:
                 return False
             del self._data[name]
             self._note_invalidate(name)
+            self._note_keyspace(name, -1)
             self.cond.notify_all()
             return True
 
@@ -143,10 +162,14 @@ class GridStore:
             # One logical keyspace: renaming ONTO a sketch-held name would
             # leave it live on both backends.
             self._guard_foreign(new)
+            displaced = new in self._data
             del self._data[old]
             self._data[new] = e
             self._note_invalidate(old)
             self._note_invalidate(new)
+            self._note_keyspace(old, -1)
+            if not displaced:  # overwrite transfers the displaced +1
+                self._note_keyspace(new, +1)
             return True
 
     def names(self, pattern: Optional[str] = None) -> list[str]:
@@ -157,6 +180,7 @@ class GridStore:
                 if e.expired(now):
                     del self._data[n]
                     self._note_invalidate(n)
+                    self._note_keyspace(n, -1)
                     continue
                 if pattern is None or fnmatch.fnmatchcase(n, pattern):
                     out.append(n)
@@ -229,6 +253,7 @@ class GridStore:
                 for n in dead:
                     del self._data[n]
                     self._note_invalidate(n)
+                    self._note_keyspace(n, -1)
                 if dead:
                     self.cond.notify_all()
                 # Let map-entry TTL structures prune themselves too.
@@ -470,6 +495,8 @@ class GridStore:
                     continue
                 ge = GridEntry(ent["kind"], self._dec_entry(ent["value"], blobs))
                 ge.expire_at = exp
+                if ent["name"] not in self._data:
+                    self._note_keyspace(ent["name"], +1)
                 self._data[ent["name"]] = ge
                 if exp is not None:
                     self._ensure_sweeper()

@@ -16,6 +16,7 @@ the codebase uses; everything is lazy-cheap when nothing reads it.
 from __future__ import annotations
 
 from redisson_tpu.obs.latency import LatencyMonitor
+from redisson_tpu.obs.loadmap import LoadMap
 from redisson_tpu.obs.registry import Family, MetricsRegistry
 from redisson_tpu.obs.slowlog import SlowLog, SlowLogEntry
 from redisson_tpu.obs.spans import OpSpan, SpanRecorder
@@ -232,6 +233,36 @@ class Observability:
             "rtpu_cluster_scatter_fanout",
             "scatter/gather batches and the per-node pipeline legs they "
             "fanned out to, by unit", ("unit",))
+        # Load-attribution plane (ISSUE 16): billing-grade device-time
+        # split per (tenant, op) — label cardinality is bounded TWICE
+        # (the loadmap folds cold tenants into "other" before the bump,
+        # max_children backstops it), and the per-slot planes export as
+        # render-time gauges over the top-N busiest slots only (a
+        # 16384-label family would melt any scrape).
+        self.tenant_device_us = r.counter(
+            "rtpu_tenant_device_us",
+            "device-side launch microseconds attributed by tenant and "
+            "op (top-N tenants, cold ones fold into 'other')",
+            ("tenant", "op"), max_children=256)
+        self.loadmap = LoadMap()
+        self.loadmap.tenant_device_us_family = self.tenant_device_us
+        self.spans.loadmap = self.loadmap
+        _lm = self.loadmap
+        r.gauge_callback(
+            "rtpu_loadmap_slot_ops",
+            "commands accounted to the busiest slots (top-8 by ops — "
+            "bounded export of the 16384-slot load vector)",
+            lambda: {(str(s),): float(v) for s, v in _lm.top_slots(8)},
+            labelnames=("slot",))
+        r.gauge_callback(
+            "rtpu_loadmap_sampled_keys",
+            "keys sampled into the hot-key sketches at RESP ingress",
+            _lm.sampled_keys)
+        r.gauge_callback(
+            "rtpu_loadmap_tracked_keys",
+            "candidate keys currently monitored by the space-saving "
+            "top-k",
+            _lm.tracked_keys)
 
     # -- instrumentation helpers (one call per batch, never per op) --------
 
@@ -352,6 +383,7 @@ class Observability:
 __all__ = [
     "Family",
     "LatencyMonitor",
+    "LoadMap",
     "MetricsRegistry",
     "Observability",
     "OpSpan",

@@ -29,7 +29,8 @@ PHASES = ("coalesce_wait", "host_stage", "device_dispatch", "d2h_fetch")
 
 
 class OpSpan:
-    __slots__ = ("op", "nops", "t0", "stamps", "error", "_rec", "links")
+    __slots__ = ("op", "nops", "t0", "stamps", "error", "_rec", "links",
+                 "tenants")
 
     def __init__(self, op: str, nops: int, recorder: "SpanRecorder"):
         self.op = op
@@ -44,6 +45,11 @@ class OpSpan:
         # the launch span into EVERY linked trace.  None (not []) on the
         # untraced path — the common case allocates nothing.
         self.links = None
+        # Load-attribution composition (ISSUE 16): [(tenant, nops)]
+        # stashed by the coalescer's completer just before finish, so
+        # the recorder can split this launch's device time per tenant.
+        # None when no loadmap is armed — again allocates nothing.
+        self.tenants = None
 
     def stamp(self, phase: str) -> None:
         """End the current phase NOW (phases are consecutive intervals:
@@ -109,6 +115,11 @@ class SpanRecorder:
         # time meets latency-monitor-threshold record a "slow-launch"
         # event.  One compare per finish when disarmed.
         self.latency = latency
+        # Optional LoadMap (ISSUE 16): retiring launches attribute
+        # their device-side time (dispatch + fetch phases) to the
+        # tenant composition stashed on the span.  One None-check per
+        # finish when disarmed.
+        self.loadmap = None
         self._phase_hist = registry.histogram(
             "rtpu_op_phase_seconds",
             "per-launch lifecycle phase durations", ("op", "phase"),
@@ -142,6 +153,18 @@ class SpanRecorder:
         lat = self.latency
         if lat is not None and lat.threshold_ms > 0:
             lat.record("slow-launch", e2e * 1e3)
+        lm = self.loadmap
+        if lm is not None and span.tenants and not span.error:
+            # Device-side share of the launch: the dispatch (launch
+            # wait + enqueue) and d2h fetch phases — host-side
+            # coalesce/stage time is not device time and would inflate
+            # a billing signal.
+            us = (phases.get("device_dispatch", 0.0)
+                  + phases.get("d2h_fetch", 0.0)) * 1e6
+            try:
+                lm.attribute_launch(span.op, span.tenants, us)
+            except Exception:
+                pass  # attribution must not fail the completer
         if span.links:
             self._feed_traces(span, phases, e2e)
         with self._lock:

@@ -46,6 +46,7 @@ the embedded RedissonTpuClient.
 
 from __future__ import annotations
 
+import random as _random
 import socket
 import threading
 import time
@@ -56,6 +57,8 @@ import numpy as np
 from redisson_tpu import chaos
 from redisson_tpu import overload as _overload
 from redisson_tpu.analysis import witness as _witness
+from redisson_tpu.cluster.slots import command_keys as _command_keys
+from redisson_tpu.cluster.slots import key_slot as _key_slot
 from redisson_tpu.obs import trace as _trace
 from redisson_tpu.executor.failures import (
     DeadlineExceededError,
@@ -123,6 +126,9 @@ _SHED_EXEMPT = frozenset((
     # surfaces are exactly what an operator reads DURING the overload,
     # and the RTPU.TRACE prelude is metadata, not work.
     "LATENCY", "TRACE", "MONITOR", "RTPU.TRACE",
+    # Load-attribution plane (ISSUE 16): HOTKEYS is how an operator
+    # finds the key causing the overload being shed.
+    "HOTKEYS",
 ))
 
 # -- front-door vectorization tables (ISSUE 6 tentpole) ----------------------
@@ -159,7 +165,7 @@ _NONMUTATING = frozenset((
     "SSCAN", "ZSCAN", "SCAN", "OBJECT", "DUMP", "PING", "ECHO", "SELECT",
     "TIME", "COMMAND", "CLIENT", "INFO", "SLOWLOG", "WAIT", "AUTH",
     "HELLO", "QUIT", "SAVE", "BGSAVE", "LASTSAVE", "BGREWRITEAOF",
-    "ASKING", "LATENCY", "TRACE", "MONITOR", "RTPU.TRACE",
+    "ASKING", "LATENCY", "TRACE", "MONITOR", "RTPU.TRACE", "HOTKEYS",
 ))
 
 # Response-CACHEABLE subset: deterministic pure keyspace reads whose
@@ -722,6 +728,37 @@ class RespServer:
         # reactor-mode capacity tuning is observable — INFO clients
         # (rejected_connections) + rtpu_resp_ingress_shed{conn_limit}.
         self._conns_refused = 0
+        # Load-attribution plane (ISSUE 16): the obs bundle's loadmap
+        # gains its serving-side wiring here — cluster flag (per-slot
+        # attribution only means something behind the door; standalone
+        # degrades to slot 0), the ingress key-sample rate, the exact
+        # keyspace hooks on both backends, and a one-scan seed of the
+        # per-slot key counters.  `_loadmap_keys_exact` gates the O(1)
+        # CLUSTER COUNTKEYSINSLOT: only when BOTH backends report every
+        # keyspace change may the counters replace the scan.
+        lm = self.obs.loadmap
+        self.loadmap = lm
+        lm.cluster = self.cluster is not None
+        lm.sample_rate = float(
+            getattr(client.config, "loadmap_key_sample_rate", 0.01) or 0.0
+        )
+        self._loadmap_keys_exact = False
+        try:
+            grid = getattr(client, "_grid", None)
+            reg = getattr(
+                getattr(client, "_engine", None), "registry", None
+            )
+            if grid is not None:
+                grid.on_keyspace = lm.note_key
+            if reg is not None:
+                reg.on_keyspace = lm.note_key
+            if grid is not None or reg is not None:
+                lm.seed_keys(client.get_keys().get_keys())
+                self._loadmap_keys_exact = (
+                    grid is not None and reg is not None
+                )
+        except Exception:
+            self._loadmap_keys_exact = False
         # Reactor front door (ISSUE 11 tentpole): a small fixed pool of
         # epoll/selector event-loop threads replaces thread-per-
         # connection serving — each tick drains recv buffers across ALL
@@ -949,6 +986,11 @@ class RespServer:
         t0 = time.perf_counter()
         err = False
         name = cmd[0].decode("latin-1", "replace").upper()
+        # Load attribution (ISSUE 16): slot 0 is the standalone /
+        # unslotted bucket; the cluster door's route() overwrites it
+        # with the real slot (or None on redirects — nothing served, so
+        # nothing attributed), and the shed branch clears it too.
+        ctx.load_slot = 0
         queueing = ctx.in_multi and name not in (
             "EXEC", "DISCARD", "MULTI", "RESET",
         )
@@ -1007,6 +1049,23 @@ class RespServer:
             tspan.end(error=err)
         obs = self.obs
         if obs is not None and not queueing:
+            lm = obs.loadmap
+            if lm is not None and lm.enabled:
+                slot = getattr(ctx, "load_slot", 0)
+                if slot is not None:
+                    # O(1) per-slot accounting: lock-free array bumps
+                    # (see obs/loadmap.py).  Bytes are the parsed argv
+                    # and the encoded reply — wire-close without
+                    # re-serializing anything.
+                    lm.note_command(
+                        slot, name not in _NONMUTATING,
+                        sum(map(len, cmd)), len(reply),
+                    )
+                    rate = lm.sample_rate
+                    if rate > 0.0 and _random.random() < rate:
+                        keys = _command_keys(cmd)
+                        if keys:
+                            lm.sample_keys(keys)
             if self._blocked(name, cmd, ctx):
                 # Condvar-parked wait is not execution time: a routine
                 # `BLPOP q 30` would otherwise file a 30s SLOWLOG entry
@@ -1813,6 +1872,7 @@ class RespServer:
                 fam, j - i, len(items), names,
                 time.perf_counter() - t0, err=err,
             )
+            self._note_run_load(run, batch, i, frames, write=False)
             return frames, j
         if fam == "bloom":
             _, _, key, items, flags, shape = run
@@ -1850,6 +1910,7 @@ class RespServer:
                 fam, j - i, len(items), names,
                 time.perf_counter() - t0, err=err,
             )
+            self._note_run_load(run, batch, i, frames, write=any_add)
             return frames, j
         # fam == "bitset"
         _, _, key, idx, kinds, names = run
@@ -1886,7 +1947,32 @@ class RespServer:
         self._count_fused(
             fam, j - i, len(idx), names, time.perf_counter() - t0, err=err,
         )
+        self._note_run_load(run, batch, i, frames, write=any_write)
         return frames, j
+
+    def _note_run_load(self, run, batch, i, frames, write: bool) -> None:
+        """Per-slot accounting for one fused engine run (ISSUE 16): the
+        run is ONE O(1) accounting event carrying all its ops — its
+        member commands never pass _safe_dispatch.  mget runs are
+        excluded (their members DO dispatch through _safe_dispatch,
+        which accounts each one; they also only exist standalone).
+        The run key stands in for the sampled key stream, weighted by
+        the run's op count."""
+        lm = self.loadmap
+        if lm is None or not lm.enabled:
+            return
+        key, end = run[2], run[1]
+        nops = self._run_nops(run, i, end)
+        slot = _key_slot(key) if self.cluster is not None else 0
+        lm.note_command(
+            slot, write,
+            sum(sum(map(len, batch[k])) for k in range(i, end)),
+            sum(len(f) for f in frames if f is not None),
+            nops=nops,
+        )
+        rate = lm.sample_rate
+        if rate > 0.0 and _random.random() < rate:
+            lm.sample_keys([key], nops)
 
     def _install_read_frames(self, rc, rc_state, batch, i, names, frames,
                              readable, err, wrote) -> None:
@@ -1982,6 +2068,20 @@ class RespServer:
             # retryable surface) instead of letting it buy unbounded
             # queue wait.  Strictly pre-dispatch: a shed command was
             # never executed, so no acked state is involved.
+            lm = getattr(self, "loadmap", None)
+            if lm is not None and lm.enabled:
+                # Shed accounting (ISSUE 16): a shed command is demand
+                # the node refused — the rebalancer needs it ON the
+                # slot (a slot whose load is all shed is the hottest
+                # signal there is).  The route point never ran, so
+                # hash the keys here; keyless shed lands in slot 0.
+                slot = 0
+                if self.cluster is not None:
+                    keys = _command_keys(cmd)
+                    if keys:
+                        slot = _key_slot(keys[0])
+                lm.note_shed(slot)
+                ctx.load_slot = None  # refused, not served: no op bump
             if shed == "tenant":
                 raise RespError(
                     "BUSY RTPU tenant over quota: command shed at "
@@ -2219,6 +2319,14 @@ class RespServer:
             "latency-monitor-threshold":
                 str(self.obs.latency.threshold_ms),
         })
+        lm = getattr(self, "loadmap", None)
+        if lm is not None:
+            # Load-attribution plane (ISSUE 16): the key-sampling rate
+            # and master switch live-apply to the node's LoadMap.
+            table.update({
+                "loadmap-key-sample-rate": f"{lm.sample_rate:g}",
+                "loadmap-enabled": "yes" if lm.enabled else "no",
+            })
         rm = self._residency()
         if rm is not None:
             # Tiered residency (ISSUE 14): budgets and the promotion
@@ -2359,6 +2467,42 @@ class RespServer:
         elif key == "latency-monitor-threshold":
             self.obs.latency.set_threshold_ms(int(val))
 
+    # Load-attribution knobs (ISSUE 16): the key-sampling rate and the
+    # master accounting switch, live-applied to the node's LoadMap
+    # (same bounds discipline as the telemetry knobs).
+    _LOADMAP_KEYS = frozenset((
+        "loadmap-key-sample-rate", "loadmap-enabled",
+    ))
+
+    def _validate_loadmap_config(self, key: str, raw: bytes) -> None:
+        if key == "loadmap-key-sample-rate":
+            try:
+                fv = float(raw)
+            except ValueError:
+                raise RespError(
+                    f"Invalid argument '{raw.decode()}' for CONFIG SET "
+                    f"'{key}'"
+                )
+            if not 0.0 <= fv <= 1.0:
+                raise RespError(
+                    f"argument must be in [0, 1] for CONFIG SET '{key}'"
+                )
+        elif key == "loadmap-enabled":
+            if raw.decode("latin-1", "replace").lower() not in (
+                    "yes", "no", "1", "0", "true", "false", "on", "off"):
+                raise RespError(
+                    f"argument must be yes or no for CONFIG SET '{key}'"
+                )
+
+    def _apply_loadmap_config(self, key: str, val: str) -> None:
+        lm = getattr(self, "loadmap", None)
+        if lm is None:
+            return
+        if key == "loadmap-key-sample-rate":
+            lm.sample_rate = float(val)
+        elif key == "loadmap-enabled":
+            lm.enabled = val.lower() in ("yes", "1", "true", "on")
+
     def _validate_overload_config(self, key: str, raw: bytes) -> None:
         def bad(msg: str):
             raise RespError(
@@ -2455,6 +2599,8 @@ class RespServer:
                     self._validate_residency_config(key, pairs[i + 1])
                 elif key in self._TELEMETRY_KEYS:
                     self._validate_telemetry_config(key, pairs[i + 1])
+                elif key in self._LOADMAP_KEYS:
+                    self._validate_loadmap_config(key, pairs[i + 1])
                 elif key == "appendonly":
                     v = pairs[i + 1].decode().lower()
                     if v not in ("yes", "no"):
@@ -2567,6 +2713,8 @@ class RespServer:
                     self._apply_residency_config(key, val)
                 elif key in self._TELEMETRY_KEYS:
                     self._apply_telemetry_config(key, val)
+                elif key in self._LOADMAP_KEYS:
+                    self._apply_loadmap_config(key, val)
                 elif key.startswith("nearcache"):
                     self._apply_nearcache_config(key, val)
             return _encode_simple("OK")
@@ -2853,6 +3001,22 @@ class RespServer:
             except ValueError as e:
                 raise RespError(str(e)) from e
             return _encode_simple("OK")
+        if sub == "COUNTKEYSINSLOT":
+            # ISSUE 16 satellite: the SCAN-based cross-check for the
+            # O(1) per-slot key counters behind CLUSTER COUNTKEYSINSLOT
+            # — re-hashes every live key name, so tests (and a
+            # suspicious operator) can diff the counter against ground
+            # truth without trusting the hook coverage.
+            if len(args) < 2:
+                raise RespError("DEBUG COUNTKEYSINSLOT <slot>")
+            try:
+                slot = int(args[1])
+            except ValueError:
+                raise RespError("value is not an integer or out of range")
+            if self.cluster is not None:
+                return _encode_int(len(self.cluster.keys_in_slot(slot)))
+            n = self._client.get_keys().count()
+            return _encode_int(n if slot == 0 else 0)
         raise RespError(f"unsupported DEBUG subcommand {sub}")
 
     def _cmd_OBJECT(self, args):
@@ -2906,7 +3070,21 @@ class RespServer:
             return _encode_int(0)
         if sub == "FREQ":
             if sketch_entry is not None:
-                return _encode_int(int(round(rm.heat.heat(name))))
+                import math
+
+                # Redis parity (ISSUE 16 satellite): OBJECT FREQ is an
+                # LFU counter on a 0-255 LOGARITHMIC scale, not a raw
+                # count.  Map the unbounded decayed heat h through
+                # min(255, round(32·log2(1+h))) — 32 points per heat
+                # doubling, saturating at h ≈ 255 — so redis-cli
+                # --hotkeys (which ranks by OBJECT FREQ) reads sane
+                # values.  The raw decayed heat stays inspectable
+                # through the residency surfaces (docs/observability.md
+                # documents the mapping).
+                h = max(0.0, rm.heat.heat(name))
+                return _encode_int(
+                    min(255, int(round(32.0 * math.log2(1.0 + h))))
+                )
             return _encode_int(0)
         raise RespError(f"Unknown OBJECT subcommand {sub}")
 
@@ -3547,7 +3725,8 @@ class RespServer:
     # name includes them.
     _INFO_DEFAULT = (
         "server", "clients", "memory", "stats", "persistence", "nearcache",
-        "frontdoor", "overload", "cluster", "telemetry", "keyspace",
+        "frontdoor", "overload", "cluster", "telemetry", "loadstats",
+        "keyspace",
     )
 
     def _cmd_INFO(self, args):
@@ -3830,6 +4009,37 @@ class RespServer:
                     f"latency_samples:{ls['samples']}",
                     f"monitors:{len(self._monitors)}",
                 ]
+            elif s == "loadstats":
+                # Load-attribution plane (ISSUE 16): the loadmap's
+                # totals, hottest slots/keys, and the per-tenant
+                # device-time shares — the single-node view of what
+                # CLUSTER LOADMAP / fleet_loadmap() aggregate.
+                lm = self.loadmap
+                st = lm.stats()
+                lines += ["# Loadstats"] + [
+                    f"{k}:{v:g}" if isinstance(v, float) else f"{k}:{v}"
+                    for k, v in st.items()
+                    # Emitted below as literals so the served-config
+                    # coherence pass (RT004) sees the knob names.
+                    if k not in ("loadmap_enabled",
+                                 "loadmap_key_sample_rate")
+                ]
+                lines.append("loadmap_top_slots:" + ",".join(
+                    f"{s_}={v}" for s_, v in lm.top_slots(8)
+                ))
+                lines.append("loadmap_hot_keys:" + ",".join(
+                    f"{k}={c:g}" for k, c in lm.hot_keys(8)
+                ))
+                shares = lm.tenant_shares()
+                lines.append("loadmap_tenant_shares:" + ",".join(
+                    f"{t}={d['share']:g}" for t, d in shares.items()
+                ))
+                lines.append(
+                    "loadmap_keys_exact:"
+                    f"{1 if self._loadmap_keys_exact else 0}"
+                )
+                lines.append(f"loadmap_enabled:{1 if lm.enabled else 0}")
+                lines.append(f"loadmap_key_sample_rate:{lm.sample_rate:g}")
             elif s == "keyspace":
                 n = self._client.get_keys().count()
                 lines += ["# Keyspace", f"db0:keys={n},expires=0,avg_ttl=0"]
@@ -4003,6 +4213,31 @@ class RespServer:
                 b"LATENCY HELP",
             ])
         raise RespError(f"Unknown LATENCY subcommand {sub}")
+
+    def _cmd_HOTKEYS(self, args):
+        """HOTKEYS [count] (ISSUE 16): the hottest keys by the loadmap's
+        dogfooded sketches — a decayed count-min sketch feeding a
+        space-saving top-k over the sampled ingress key stream
+        (redis-cli --hotkeys parity, without the SCAN+OBJECT FREQ round
+        trips).  Flat [key, count, key, count, ...] reply, hottest
+        first; counts are decayed CMS estimates scaled by the sample
+        rate's inverse would be a lie (the estimate is of the SAMPLED
+        stream), so they are reported raw and documented as relative
+        weights.  Shed-exempt: finding the hot key IS the overload
+        diagnosis."""
+        count = 16
+        if args:
+            try:
+                count = int(args[0])
+            except ValueError:
+                raise RespError("value is not an integer or out of range")
+            if count < 0:
+                raise RespError("value is not an integer or out of range")
+        flat = []
+        for key, est in self.loadmap.hot_keys(count):
+            flat.append(key.encode())
+            flat.append(int(round(est)))
+        return _encode_array(flat)
 
     def _cmdctx_MONITOR(self, args, ctx: _ConnCtx):
         """MONITOR: stream every dispatched command to this connection
@@ -4183,7 +4418,26 @@ class RespServer:
                 k.encode() for k in door.undumpable_in_slot(int(args[1]))
             ])
         if sub == "COUNTKEYSINSLOT":
-            return _encode_int(len(door.keys_in_slot(int(args[1]))))
+            # O(1) from the load-map per-slot key counters when keyspace
+            # hooks are wired; DEBUG COUNTKEYSINSLOT keeps the O(keys)
+            # scan as a cross-check.
+            slot = int(args[1])
+            lm = getattr(self, "loadmap", None)
+            if lm is not None and self._loadmap_keys_exact:
+                return _encode_int(lm.keys_in_slot(slot))
+            return _encode_int(len(door.keys_in_slot(slot)))
+        if sub == "LOADMAP":
+            # Node-local load snapshot as one JSON bulk: per-slot load
+            # vectors (non-zero slots only), hot keys, tenant shares.
+            # ClusterClient.fleet_loadmap() merges these across nodes.
+            import json
+
+            lm = getattr(self, "loadmap", None)
+            if lm is None:
+                raise RespError("LOADMAP requires telemetry")
+            snap = lm.snapshot()
+            snap["node"] = door.myid
+            return _encode_bulk(json.dumps(snap).encode())
         if sub == "GETKEYSINSLOT":
             count = int(args[2]) if len(args) > 2 else 10
             return _encode_array([

@@ -372,6 +372,16 @@ class TenantRegistry:
         # growing a pool past the device-rows budget — HBM holds the
         # working set, not the keyspace.
         self.alloc_gate = None
+        # Load-attribution reach (ISSUE 16): wired by the serve layer
+        # to the loadmap's exact per-slot key counters.  Called as
+        # ``on_keyspace(name, +1/-1)`` wherever the set of live tenant
+        # names changes, UNDER ``self._lock`` — must be leaf-safe.
+        self.on_keyspace = None
+
+    def _note_keyspace(self, name: str, delta: int) -> None:
+        hook = self.on_keyspace
+        if hook is not None:
+            hook(name, delta)
 
     def lookup(self, name: str) -> Optional[TenantEntry]:
         with self._lock:
@@ -418,6 +428,7 @@ class TenantRegistry:
                     name, kind, pool, pool.alloc_row(), dict(params)
                 )
             self._tenants[name] = entry
+            self._note_keyspace(name, +1)
             return entry, True
 
     def detach(self, name: str) -> Optional[TenantEntry]:
@@ -427,7 +438,10 @@ class TenantRegistry:
         the row cannot be reallocated (and then wrongly zeroed) while a
         stale deleter still holds it."""
         with self._lock:
-            return self._tenants.pop(name, None)
+            entry = self._tenants.pop(name, None)
+            if entry is not None:
+                self._note_keyspace(name, -1)
+            return entry
 
     def detach_if(self, name: str, entry: TenantEntry) -> Optional[TenantEntry]:
         """detach() guarded on entry identity: a no-op if the name was
@@ -436,7 +450,9 @@ class TenantRegistry:
         with self._lock:
             if self._tenants.get(name) is not entry:
                 return None
-            return self._tenants.pop(name)
+            popped = self._tenants.pop(name)
+            self._note_keyspace(name, -1)
+            return popped
 
     def rename_detach_dest(self, old: str, new: str):
         """Atomic rename; the displaced destination entry (if any) is
@@ -452,6 +468,9 @@ class TenantRegistry:
             dest = self._tenants.pop(new, None)
             entry.name = new
             self._tenants[new] = entry
+            self._note_keyspace(old, -1)
+            if dest is None:  # overwrite transfers the displaced +1
+                self._note_keyspace(new, +1)
             return True, dest
 
     def names(self, kind: Optional[str] = None) -> list[str]:

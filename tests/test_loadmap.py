@@ -1,0 +1,480 @@
+"""Load-attribution plane (ISSUE 16): LoadMap unit coverage (dogfooded
+decayed CMS + space-saving top-k, bounded tenant attribution, exact
+per-slot key counters), the RESP surface (HOTKEYS, INFO loadstats,
+CONFIG loadmap-*), the bounded-cardinality export guard, the 3-node
+fleet merge (CLUSTER LOADMAP / fleet_loadmap / fleet_latency /
+federated visibility), and the accounting-overhead A/B guard."""
+
+import json
+import re
+import socket
+import sys
+import time
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.cluster.slots import NSLOTS, key_slot
+from redisson_tpu.obs import Observability
+from redisson_tpu.obs.loadmap import (
+    OTHER_TENANT,
+    SLOT_FIELDS,
+    DecayedCMS,
+    LoadMap,
+    SpaceSavingTopK,
+)
+from redisson_tpu.serve.resp import RespServer
+
+from test_resp_server import RespClient
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- sketch units -----------------------------------------------------------
+
+
+def test_decayed_cms_estimates_and_halves_on_half_life():
+    clk = _FakeClock()
+    cms = DecayedCMS(width=256, depth=4, half_life_s=10.0, clock=clk)
+    for _ in range(8):
+        cms.add("hot")
+    cms.add("cold")
+    assert cms.estimate("hot") >= 8.0  # CMS only ever overestimates
+    assert cms.estimate("cold") >= 1.0
+    assert cms.estimate("never") == 0.0
+    # One half-life later the pending decay halves every cell.
+    clk.t += 10.0
+    factor = cms.maybe_decay(clk.t)
+    assert factor == pytest.approx(0.5)
+    assert cms.estimate("hot") == pytest.approx(4.0, rel=0.26)
+    # No double decay: immediately re-asking applies nothing.
+    assert cms.maybe_decay(clk.t) == 1.0
+
+
+def test_space_saving_topk_is_bounded_and_newcomer_inherits_floor():
+    tk = SpaceSavingTopK(capacity=4)
+    for i in range(4):
+        tk.offer(f"k{i}", 10 - i)  # k3 is the minimum at 7
+    assert len(tk) == 4
+    tk.offer("newcomer", 1)
+    assert len(tk) == 4  # bounded: the table never grows past capacity
+    assert "k3" not in tk  # minimum evicted ...
+    assert "newcomer" in tk  # ... and the newcomer inherits its floor
+    top = dict(tk.top(4))
+    assert top["newcomer"] == pytest.approx(8.0)  # floor 7 + offered 1
+    tk.scale(0.5)
+    assert dict(tk.top(1))["k0"] == pytest.approx(5.0)
+
+
+def test_loadmap_hot_keys_decay_in_lockstep():
+    clk = _FakeClock()
+    lm = LoadMap(sample_rate=1.0, half_life_s=10.0, clock=clk)
+    lm.sample_keys([b"a"] * 6 + [b"b"] * 2)
+    hot = dict(lm.hot_keys(4))
+    assert hot["a"] == pytest.approx(6.0)
+    clk.t += 10.0
+    hot = dict(lm.hot_keys(4))
+    # CMS and top-k halve together, so estimates stay comparable.
+    assert hot["a"] == pytest.approx(3.0)
+    assert hot["b"] == pytest.approx(1.0)
+    assert lm.sampled_keys() == 8
+    assert lm.tracked_keys() == 2
+
+
+# -- slot accounting + snapshot ---------------------------------------------
+
+
+def test_loadmap_slot_accounting_and_snapshot_roundtrip():
+    lm = LoadMap(cluster=True)
+    s = key_slot("user:1")
+    lm.note_command(s, True, 100, 5)
+    lm.note_command(s, False, 40, 60, nops=3)
+    lm.note_shed(s)
+    lm.note_command(None, True, 9, 9)  # redirected: not served here
+    lm.note_key("user:1", +1)
+    t = lm.totals()
+    assert t["ops"] == 4 and t["writes"] == 1 and t["reads"] == 3
+    assert t["bytes_in"] == 140 and t["bytes_out"] == 65
+    assert t["shed"] == 1 and t["keys"] == 1
+    assert lm.top_slots(2) == [(s, 4)]
+    snap = json.loads(json.dumps(lm.snapshot()))  # JSON-clean payload
+    assert snap["fields"] == list(SLOT_FIELDS)
+    row = dict(zip(snap["fields"], snap["slots"][str(s)]))
+    assert row["ops"] == 4 and row["shed"] == 1 and row["keys"] == 1
+    # Disabled: every plane freezes.
+    lm.enabled = False
+    lm.note_command(s, True, 1, 1)
+    lm.note_shed(s)
+    assert lm.sample_keys([b"x"]) == 0
+    assert lm.totals()["ops"] == 4
+    # reset() zeroes the load counters but PRESERVES the key-count
+    # plane — live keys are a gauge of present state, not accumulated
+    # load, and zeroing them would silently break COUNTKEYSINSLOT.
+    lm.reset()
+    assert lm.totals() == {
+        "ops": 0, "reads": 0, "writes": 0, "bytes_in": 0,
+        "bytes_out": 0, "shed": 0, "device_us": 0, "keys": 1,
+    }
+
+
+def test_loadmap_exact_key_counters_seed_and_clamp():
+    lm = LoadMap(cluster=True)
+    lm.seed_keys(["a", "b", "{tag}x", "{tag}y"])
+    assert lm.keys_in_slot(key_slot("a")) == 1
+    assert lm.keys_in_slot(key_slot("{tag}x")) == 2
+    lm.note_key("{tag}x", -1)
+    assert lm.keys_in_slot(key_slot("{tag}x")) == 1
+    # A transient hook/seed race can dip below zero; reads clamp.
+    lm.note_key("a", -1)
+    lm.note_key("a", -1)
+    assert lm.keys_in_slot(key_slot("a")) == 0
+    assert lm.totals()["keys"] == 2
+    # Standalone mode degrades every key to slot 0.
+    lm2 = LoadMap(cluster=False)
+    lm2.seed_keys(["a", "b"])
+    lm2.note_key("c", +1)
+    assert lm2.keys_in_slot(0) == 3
+
+
+# -- bounded tenant attribution ---------------------------------------------
+
+
+def test_tenant_attribution_folds_past_max_tenants():
+    lm = LoadMap(max_tenants=8)
+    for i in range(40):
+        lm.attribute_launch("bloom_add", [(f"t{i}", 2)], 100.0)
+    shares = lm.tenant_shares()
+    assert len(shares) <= 8  # bounded: top-N plus the fold bucket
+    assert OTHER_TENANT in shares
+    # Conservation: folding moves time/ops, it never drops them.
+    assert sum(d["device_us"] for d in shares.values()) == pytest.approx(
+        40 * 100.0
+    )
+    assert sum(d["ops"] for d in shares.values()) == 80
+    assert sum(d["share"] for d in shares.values()) == pytest.approx(
+        1.0, abs=0.01
+    )
+    # The fold bucket itself is never evicted by later folds.
+    for i in range(40, 60):
+        lm.attribute_launch("bloom_add", [(f"t{i}", 1)], 50.0)
+    assert OTHER_TENANT in lm.tenant_shares()
+
+
+def test_attribute_launch_splits_by_op_share_and_slots():
+    lm = LoadMap(cluster=True)
+    lm.attribute_launch("cms_add", [("alpha", 3), ("beta", 1)], 400.0)
+    shares = lm.tenant_shares()
+    assert shares["alpha"]["device_us"] == pytest.approx(300.0)
+    assert shares["beta"]["device_us"] == pytest.approx(100.0)
+    # The tenant label IS the sketch name: device time lands on its slot.
+    assert lm.device_us[key_slot("alpha")] == pytest.approx(300.0)
+    assert lm.device_us[key_slot("beta")] == pytest.approx(100.0)
+
+
+# -- bounded-cardinality export guard ---------------------------------------
+
+
+def test_export_cardinality_is_bounded():
+    """The guard the ISSUE names: no 16384-slot label explosion and no
+    unbounded per-tenant series, no matter how wide the traffic."""
+    obs = Observability()
+    lm = obs.loadmap
+    lm.cluster = True
+    for s in range(0, NSLOTS, 16):  # 1024 busy slots
+        lm.note_command(s, False, 10, 10)
+    for i in range(500):  # 500 distinct tenants
+        lm.attribute_launch("bloom_add", [(f"tenant-{i}", 1)], 10.0)
+    body = obs.registry.render_prometheus()
+    slot_series = re.findall(r"rtpu_loadmap_slot_ops\{[^}]*\}", body)
+    assert 0 < len(slot_series) <= 8  # top-N view, never per-slot
+    tenant_series = {
+        m for m in re.findall(
+            r'rtpu_tenant_device_us_total\{tenant="([^"]+)"', body
+        )
+    }
+    assert len(tenant_series) <= lm.max_tenants + 1
+    assert OTHER_TENANT in tenant_series  # the fold label absorbed the tail
+    assert len(lm.tenant_shares()) <= lm.max_tenants
+
+
+# -- RESP surface (standalone) ----------------------------------------------
+
+
+@pytest.fixture
+def resp_host():
+    cl = redisson_tpu.create(Config())
+    srv = RespServer(cl)
+    conn = RespClient(srv.host, srv.port)
+    yield conn, srv, cl
+    srv.close()
+    cl.shutdown()
+
+
+def test_resp_hotkeys_info_and_config(resp_host):
+    conn, srv, cl = resp_host
+    assert conn.cmd("CONFIG", "GET", "loadmap-key-sample-rate") == [
+        b"loadmap-key-sample-rate", b"0.01",
+    ]
+    # Bounds are validated before any table write (telemetry pattern).
+    with pytest.raises(RuntimeError):
+        conn.cmd("CONFIG", "SET", "loadmap-key-sample-rate", "1.5")
+    with pytest.raises(RuntimeError):
+        conn.cmd("CONFIG", "SET", "loadmap-key-sample-rate", "nope")
+    with pytest.raises(RuntimeError):
+        conn.cmd("CONFIG", "SET", "loadmap-enabled", "maybe")
+    assert conn.cmd(
+        "CONFIG", "SET", "loadmap-key-sample-rate", "1"
+    ) == "OK"
+    for _ in range(9):
+        conn.cmd("SET", "hotkey", "v")
+    conn.cmd("SET", "coldkey", "v")
+    # HOTKEYS: flat [key, count, ...] pairs, hottest first.
+    flat = conn.cmd("HOTKEYS", "2")
+    assert flat[0] == b"hotkey" and flat[1] >= 9
+    assert flat[2] == b"coldkey"
+    with pytest.raises(RuntimeError):
+        conn.cmd("HOTKEYS", "x")
+    info = conn.cmd("INFO", "loadstats").decode()
+    assert "# Loadstats" in info
+    for needle in (
+        "loadmap_enabled:1", "loadmap_key_sample_rate:1",
+        "loadmap_ops:", "loadmap_shed_ops:", "loadmap_device_us:",
+        "loadmap_top_slots:", "loadmap_hot_keys:hotkey=",
+        "loadmap_keys_exact:",
+    ):
+        assert needle in info, needle
+    # Default INFO includes the section; the master switch freezes it.
+    assert "# Loadstats" in conn.cmd("INFO").decode()
+    assert conn.cmd("CONFIG", "SET", "loadmap-enabled", "no") == "OK"
+    ops_before = srv.loadmap.totals()["ops"]
+    conn.cmd("SET", "hotkey", "v")
+    assert srv.loadmap.totals()["ops"] == ops_before
+    assert "loadmap_enabled:0" in conn.cmd("INFO", "loadstats").decode()
+
+
+def test_resp_counts_reads_writes_and_sheds():
+    cl = redisson_tpu.create(Config())
+    srv = RespServer(cl)
+    conn = RespClient(srv.host, srv.port)
+    try:
+        lm = srv.loadmap
+        base = lm.totals()
+        conn.cmd("SET", "k", "v")
+        conn.cmd("GET", "k")
+        t = lm.totals()
+        assert t["writes"] == base["writes"] + 1
+        assert t["reads"] >= base["reads"] + 1
+        # Shed accounting: forced queue pressure over the watermark
+        # refuses the write and bumps the SHED plane, not the ops plane.
+        srv._pressure = lambda: 1.0
+        srv.admission_watermark = 0.5
+        ops_before = lm.totals()["ops"]
+        with pytest.raises(RuntimeError):
+            conn.cmd("SET", "k2", "v")
+        del srv._pressure
+        srv.admission_watermark = 1.0
+        t = lm.totals()
+        assert t["shed"] == base["shed"] + 1
+        assert t["ops"] == ops_before  # refused != served
+    finally:
+        srv.close()
+        cl.shutdown()
+
+
+def test_resp_exact_key_counters_on_engine_path():
+    """TPU-path engine (jax on CPU): BOTH keyspace backends hook the
+    counters, so loadmap_keys is exact and DEBUG COUNTKEYSINSLOT's scan
+    agrees with the O(1) plane."""
+    cfg = Config().use_tpu_sketch(min_bucket=64)
+    cl = redisson_tpu.create(cfg)
+    srv = RespServer(cl)
+    conn = RespClient(srv.host, srv.port)
+    try:
+        assert srv._loadmap_keys_exact
+        conn.cmd("CMS.INITBYDIM", "sk0", "64", "2")
+        conn.cmd("CMS.INCRBY", "sk0", "item", "1")
+        conn.cmd("SET", "grid0", "v")
+        info = conn.cmd("INFO", "loadstats").decode()
+        assert "loadmap_keys_exact:1" in info
+        assert "loadmap_keys:2" in info
+        assert conn.cmd("DEBUG", "COUNTKEYSINSLOT", "0") == 2
+        assert srv.loadmap.keys_in_slot(0) == 2
+        conn.cmd("DEL", "grid0")
+        assert srv.loadmap.keys_in_slot(0) == 1
+        # Device attribution rode the engine commands (completer path).
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if srv.loadmap.tenant_shares().get("sk0"):
+                break
+            time.sleep(0.05)
+        shares = srv.loadmap.tenant_shares()
+        assert shares["sk0"]["device_us"] > 0
+        assert "loadmap_tenant_shares:sk0=" in conn.cmd(
+            "INFO", "loadstats"
+        ).decode()
+    finally:
+        srv.close()
+        cl.shutdown()
+
+
+# -- 3-node fleet (the CI cluster-smoke surface) ----------------------------
+
+
+@pytest.mark.slow
+def test_three_node_fleet_loadmap_latency_and_federation():
+    """ISSUE 16 acceptance: CLUSTER LOADMAP per node, fleet_loadmap
+    ranking the true hot slot first with the hot key found,
+    COUNTKEYSINSLOT answered O(1) and agreeing with the DEBUG scan,
+    fleet_latency node-tagged, and the new series visible through the
+    federated endpoint under node labels."""
+    from redisson_tpu.cluster.supervisor import ClusterSupervisor
+
+    sup = ClusterSupervisor(n_nodes=3, metrics=True).start()
+    try:
+        client = sup.client()
+        try:
+            for addr, r in client._fanout(
+                [b"CONFIG", b"SET", b"loadmap-key-sample-rate", b"1",
+                 b"latency-monitor-threshold", b"1"]
+            ).items():
+                assert r == b"OK", (addr, r)
+            client.execute("CMS.INITBYDIM", "lmt0", "64", "2")
+            for _ in range(30):
+                client.execute("CMS.INCRBY", "lmt0", "item", "1")
+            for i in range(10):
+                client.execute("SET", f"lmcold{i}", "v")
+            hot_slot = key_slot("lmt0")
+
+            # Raw per-node snapshots: JSON bulk, node-stamped.
+            seen_hot = 0
+            for addr, raw in client._fanout(
+                [b"CLUSTER", b"LOADMAP"]
+            ).items():
+                assert not isinstance(raw, Exception), (addr, raw)
+                snap = json.loads(raw)
+                assert snap["fields"] == list(SLOT_FIELDS)
+                assert snap["node"]
+                if str(hot_slot) in snap["slots"]:
+                    seen_hot += 1
+            assert seen_hot == 1  # exactly the owner accounted it
+
+            fl = client.fleet_loadmap()
+            assert fl["top_slots"][0] == hot_slot
+            assert fl["slots"][hot_slot]["writes"] >= 30
+            assert fl["slots"][hot_slot]["keys"] == 1
+            assert fl["slots"][hot_slot]["device_us"] > 0
+            assert fl["hot_keys"][0]["key"] == "lmt0"
+            assert "lmt0" in fl["tenants"]
+            assert len(fl["nodes"]) == 3
+
+            # O(1) counters agree with the DEBUG cross-check scan.
+            for cmdname in (b"CLUSTER", b"DEBUG"):
+                counts = client._fanout(
+                    [cmdname, b"COUNTKEYSINSLOT",
+                     str(hot_slot).encode()]
+                )
+                assert sorted(
+                    v for v in counts.values()
+                    if not isinstance(v, Exception)
+                ) == [0, 0, 1], (cmdname, counts)
+
+            # Engine launches on a CPU backend clear 1 ms easily, so
+            # the armed latency monitor saw events on the hot node.
+            lat = client.fleet_latency()
+            assert lat and all("node" in e and e["event"] for e in lat)
+
+            fed = sup.start_federation()
+            with urllib.request.urlopen(
+                f"http://{fed.host}:{fed.port}/metrics", timeout=10
+            ) as r:
+                body = r.read().decode()
+            assert re.search(
+                r'rtpu_loadmap_slot_ops\{node="[^"]+",slot="%d"\}'
+                % hot_slot, body
+            )
+            assert re.search(
+                r'rtpu_tenant_device_us_total\{node="[^"]+",'
+                r'tenant="lmt0"', body
+            )
+            assert re.search(
+                r'rtpu_loadmap_sampled_keys\{node="[^"]+"\}', body
+            )
+        finally:
+            client.close()
+    finally:
+        sup.shutdown()
+
+
+# -- overhead guard ---------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_loadmap_accounting_overhead_under_five_percent():
+    """ISSUE 16 acceptance: per-slot accounting ON (production default:
+    sampling at 0.01) must cost <=5% on the dispatch path vs the master
+    switch OFF.  Same discipline as the metrics/trace overhead guards:
+    interleaved rounds, GC paused, min of paired ratios (external load
+    only ever inflates a sample), a few attempts for a quiet window."""
+    import gc
+
+    from redisson_tpu.serve.resp import _ConnCtx
+
+    cl = redisson_tpu.create(Config())
+    srv = RespServer(cl)
+    try:
+        ctx = _ConnCtx(socket.socket(), server=srv)
+        lm = srv.loadmap
+        lm.sample_rate = 0.01
+        cmd = [b"SET", b"ovh-key", b"v"]
+        N = 1500
+
+        def round_time():
+            t0 = time.perf_counter()
+            for _ in range(N):
+                srv._safe_dispatch(cmd, ctx)
+            return time.perf_counter() - t0
+
+        def measure():
+            on, off = [], []
+            gc.disable()
+            try:
+                for r in range(10):
+                    lm.enabled = False
+                    round_time()  # warm
+                    if r % 2 == 0:
+                        off.append(round_time())
+                        lm.enabled = True
+                        on.append(round_time())
+                    else:
+                        lm.enabled = True
+                        on.append(round_time())
+                        lm.enabled = False
+                        off.append(round_time())
+            finally:
+                gc.enable()
+            return off, on
+
+        history = []
+        for _ in range(4):
+            off, on = measure()
+            ratio = min(q / p for p, q in zip(off, on))
+            ratio = min(ratio, min(on) / min(off))
+            history.append(ratio)
+            if ratio <= 1.05:
+                return
+        raise AssertionError(
+            f"loadmap accounting >5% dispatch overhead: {history}"
+        )
+    finally:
+        srv.close()
+        cl.shutdown()

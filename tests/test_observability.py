@@ -455,6 +455,13 @@ def test_metric_catalog_matches_doc():
     finally:
         cl.shutdown()
 
+    # Load-attribution families (ISSUE 16): pinned by name so a rename
+    # that dodges the generic diff below still fails loudly here.
+    assert {
+        "rtpu_tenant_device_us_total", "rtpu_loadmap_slot_ops",
+        "rtpu_loadmap_sampled_keys", "rtpu_loadmap_tracked_keys",
+    } <= registered
+
     missing_from_doc = registered - doc_names
     assert not missing_from_doc, (
         f"families registered but absent from the "
