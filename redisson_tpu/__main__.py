@@ -17,6 +17,91 @@ import sys
 import threading
 
 
+def _serve_multicore(args, nworkers: int) -> int:
+    """Per-core front-door parent (ISSUE 17): a pure supervisor — no
+    engine, no RESP door of its own.  Spawns K worker processes sharing
+    (host, port) via SO_REUSEPORT, optionally fronts their per-worker
+    metrics endpoints with ONE federated exposition (worker labels ride
+    the federation plane's node label), forwards SIGTERM/SIGINT, and
+    reaps every child before exiting (the CI no-orphans gate)."""
+    from redisson_tpu.serve.multicore import MulticoreNode
+
+    extra = [
+        "--max-connections", str(args.max_connections),
+        "--idle-timeout-s", str(args.idle_timeout_s),
+    ]
+    if args.config:
+        extra += ["--config", args.config]
+    if args.snapshot_dir:
+        extra += ["--snapshot-dir", args.snapshot_dir]
+    if args.snapshot_interval_s:
+        extra += ["--snapshot-interval-s", str(args.snapshot_interval_s)]
+    if args.requirepass:
+        extra += ["--requirepass", args.requirepass]
+    if args.enable_python_scripts:
+        extra += ["--enable-python-scripts"]
+    if args.no_resp_vectorize:
+        extra += ["--no-resp-vectorize"]
+    if args.no_resp_reactor:
+        extra += ["--no-resp-reactor"]
+    if args.resp_reactor_threads is not None:
+        extra += ["--resp-reactor-threads", str(args.resp_reactor_threads)]
+    if args.trace_sample_rate is not None:
+        extra += ["--trace-sample-rate", str(args.trace_sample_rate)]
+    if args.latency_monitor_threshold is not None:
+        extra += [
+            "--latency-monitor-threshold",
+            str(args.latency_monitor_threshold),
+        ]
+    if args.cluster:
+        extra += ["--cluster"]
+    for val, flag in (
+        (args.cluster_slots, "--cluster-slots"),
+        (args.cluster_topology, "--cluster-topology"),
+        (args.cluster_myid, "--cluster-myid"),
+        (args.cluster_announce, "--cluster-announce"),
+    ):
+        if val is not None:
+            extra += [flag, val]
+
+    node = MulticoreNode(
+        nworkers, host=args.host, port=args.port,
+        platform=args.platform, metrics_port=args.metrics_port,
+        extra_args=extra,
+    )
+    fed = None
+    if args.metrics_port is not None:
+        from redisson_tpu.obs.federate import start_federation_endpoint
+
+        fed = start_federation_endpoint(
+            [f"{args.host}:{mp}" for mp in node.metrics_ports],
+            host=args.host, port=args.metrics_port,
+        )
+        print(
+            f"federated worker metrics on "
+            f"http://{fed.host}:{fed.port}/metrics",
+            flush=True,
+        )
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    print(
+        f"redisson-tpu serving RESP on {node.host}:{node.port} "
+        f"[{nworkers} SO_REUSEPORT front-door workers]",
+        flush=True,
+    )
+    stop.wait()
+    print("shutting down front-door workers", flush=True)
+    if fed is not None:
+        fed.close()
+    clean = node.shutdown()
+    return 0 if clean else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m redisson_tpu",
@@ -121,6 +206,22 @@ def main(argv=None) -> int:
         help="host:port other nodes/clients are redirected to "
         "(default: the bind address; set when behind NAT/containers)",
     )
+    p.add_argument(
+        "--frontdoor-processes", type=int, default=None,
+        help="per-core front door (ISSUE 17): serve with this many "
+        "reactor processes sharing the port via SO_REUSEPORT, each "
+        "owning 1/K of the slot range behind an in-node handoff map "
+        "(docs/performance.md); platforms without SO_REUSEPORT fall "
+        "back to 1 with a logged INFO line",
+    )
+    # Internal worker-mode flags: the supervisor parent stamps these
+    # into each spawned worker (serve/multicore.py MulticoreNode).
+    p.add_argument("--frontdoor-workers", type=int, default=1,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--frontdoor-index", type=int, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--frontdoor-dir", default=None,
+                   help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
     if args.federate:
@@ -207,6 +308,57 @@ def main(argv=None) -> int:
                 p.error("--cluster-* flags require --cluster (or a "
                         "config file with cluster_enabled: true)")
             setattr(cfg, key, flag)
+
+    # Per-core front door (ISSUE 17).  Parent shape: K > 1 and no
+    # worker index — this process becomes a pure supervisor that spawns
+    # K worker children sharing the port via SO_REUSEPORT (no engine of
+    # its own).  Worker shape: the internal flags stamp this process as
+    # worker i of K.  No-SO_REUSEPORT platforms degrade to K=1 here
+    # (effective_processes logs the INFO frontdoor line).
+    fd_req = (
+        args.frontdoor_processes
+        if args.frontdoor_processes is not None
+        else getattr(cfg, "frontdoor_processes", 1)
+    )
+    if args.frontdoor_index is None and (fd_req or 1) > 1:
+        from redisson_tpu.serve import multicore
+
+        fd_k = multicore.effective_processes(fd_req)
+        if fd_k > 1:
+            return _serve_multicore(args, fd_k)
+    if args.frontdoor_index is not None:
+        import os
+
+        cfg.frontdoor_workers = max(2, int(args.frontdoor_workers))
+        cfg.frontdoor_index = args.frontdoor_index
+        cfg.frontdoor_dir = args.frontdoor_dir
+        # Durability dirs split per worker — K journals/snapshot sets,
+        # one per slot-range owner, never one contended set.
+        sub = f"worker{args.frontdoor_index}"
+        if cfg.snapshot_dir:
+            cfg.snapshot_dir = os.path.join(cfg.snapshot_dir, sub)
+            os.makedirs(cfg.snapshot_dir, exist_ok=True)
+        if getattr(cfg, "journal_dir", None):
+            cfg.journal_dir = os.path.join(cfg.journal_dir, sub)
+            os.makedirs(cfg.journal_dir, exist_ok=True)
+        # Device pinning (satellite): each worker takes a contiguous
+        # 1/K of the local devices when the node has that many; the
+        # spawn env already fixed JAX_PLATFORMS, so enumerating here is
+        # safe.
+        if cfg.tpu_sketch.device_indices is None:
+            from redisson_tpu.serve.multicore import device_slice_for_worker
+
+            if args.platform and "JAX_PLATFORMS" not in os.environ:
+                os.environ["JAX_PLATFORMS"] = args.platform
+            try:
+                import jax
+
+                cfg.tpu_sketch.device_indices = device_slice_for_worker(
+                    args.frontdoor_index, cfg.frontdoor_workers,
+                    len(jax.devices()),
+                )
+            except Exception:
+                pass  # backend unavailable: first-come allocation
 
     client = redisson_tpu.create(cfg)
     server = RespServer(

@@ -132,12 +132,19 @@ class ClusterSupervisor:
 
     def __init__(self, n_nodes: int = 3, host: str = "127.0.0.1",
                  platform: str = "cpu", node_args=(), env_extra=None,
-                 startup_timeout_s: float = 120.0, metrics: bool = False):
+                 startup_timeout_s: float = 120.0, metrics: bool = False,
+                 frontdoor_processes: int = 1):
         if n_nodes < 1:
             raise ValueError("n_nodes must be >= 1")
         self.n_nodes = n_nodes
         self.host = host
         self.platform = platform
+        # Per-core front door (ISSUE 17): each node serves its shard
+        # with this many SO_REUSEPORT reactor processes — the spawned
+        # node process becomes a worker supervisor itself (__main__
+        # handles the fan-out; no-SO_REUSEPORT platforms degrade to 1
+        # per node with a logged line, so this stays safe everywhere).
+        self.frontdoor_processes = max(1, int(frontdoor_processes))
         self.node_args = list(node_args)
         self.env_extra = dict(env_extra or {})
         self.startup_timeout_s = startup_timeout_s
@@ -228,6 +235,11 @@ class ClusterSupervisor:
                     argv += [
                         "--metrics-port",
                         str(self.metrics_addrs[i][1]),
+                    ]
+                if self.frontdoor_processes > 1:
+                    argv += [
+                        "--frontdoor-processes",
+                        str(self.frontdoor_processes),
                     ]
                 procs.append(subprocess.Popen(
                     argv + self.node_args,

@@ -188,6 +188,14 @@ class TpuSketchConfig:
         # Only meaningful with num_shards > 1.
         self.mbit_threshold_words = 1 << 22
         self.platform: Optional[str] = None  # None → jax default backend
+        # Explicit device pinning (ISSUE 17 satellite, ROADMAP
+        # carry-over): the pool attach uses EXACTLY these local device
+        # indices (in order) instead of first-come enumeration — each
+        # front-door worker (and later each replica) owns a disjoint
+        # slice of the node's devices.  None → all local devices, the
+        # old behavior.  With num_shards > 1 the slice length must be
+        # >= num_shards.
+        self.device_indices: Optional[list] = None
         # Multi-host (DCN) — docs/MULTIHOST.md.  When coordinator_address
         # is set the engine joins the standard JAX distributed runtime
         # before device discovery; num_shards then counts GLOBAL shards.
@@ -343,6 +351,21 @@ class Config:
         # lock.  Live-settable via CONFIG SET loadmap-key-sample-rate;
         # surfaced through HOTKEYS and INFO loadstats.
         self.loadmap_key_sample_rate = 0.01
+        # Per-core front door (ISSUE 17).  ``frontdoor_processes``: K
+        # reactor processes share this node's listen port via
+        # SO_REUSEPORT, each owning a contiguous 1/K of the slot range
+        # behind an in-node slot→process map (serve/multicore.py).
+        # 1 (default) = the single-process door; >1 on a platform
+        # without SO_REUSEPORT degrades to 1 with an INFO log line,
+        # never a bind-time crash.  The ``frontdoor_workers`` /
+        # ``frontdoor_index`` / ``frontdoor_dir`` triple is INTERNAL —
+        # the supervisor parent stamps it into each worker child
+        # (--frontdoor-workers/--frontdoor-index/--frontdoor-dir);
+        # setting it by hand spawns one bare worker of a K-party door.
+        self.frontdoor_processes = 1
+        self.frontdoor_workers = 1
+        self.frontdoor_index: Optional[int] = None
+        self.frontdoor_dir: Optional[str] = None
 
     # -- fluent setters, mirroring the Java builder idiom ------------------
 
@@ -405,6 +428,10 @@ class Config:
         "trace_max_spans",
         "latency_monitor_threshold_ms",
         "loadmap_key_sample_rate",
+        "frontdoor_processes",
+        "frontdoor_workers",
+        "frontdoor_index",
+        "frontdoor_dir",
     )
 
     def to_dict(self) -> dict:

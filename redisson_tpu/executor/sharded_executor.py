@@ -124,7 +124,10 @@ class ShardedTpuCommandExecutor(TpuCommandExecutor):
     def __init__(self, config):
         super().__init__(config)
         n = config.tpu_sketch.num_shards
-        self.ctx = pm.MeshContext(n_shards=n)
+        # Device pinning (ISSUE 17 satellite): an explicit device_indices
+        # slice builds the mesh from EXACTLY those devices (order kept);
+        # otherwise the enumeration order, as before.
+        self.ctx = pm.MeshContext(devices=self.devices, n_shards=n)
         if self.ctx.n_shards < n:
             raise RuntimeError(
                 f"num_shards={n} but only {self.ctx.n_shards} devices are "

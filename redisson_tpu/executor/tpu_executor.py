@@ -291,6 +291,37 @@ def bloom_count_from_bitcount(x, m: int, k: int) -> int:
     return int(round(-m / k * math.log(1 - x / m)))
 
 
+def resolve_device_slice(indices, devices=None) -> list:
+    """Map ``device_indices`` config to actual device objects (ISSUE 17
+    satellite, ROADMAP carry-over): an explicit, ordered, duplicate-free
+    slice of the local device enumeration, so each front-door worker
+    (and later each replica) pins its own devices instead of first-come
+    allocation.  ``devices`` overrides the enumeration for tests (fake
+    multi-device lists)."""
+    if devices is None:
+        import jax as _jax
+
+        devices = _jax.devices()
+    if indices is None:
+        return list(devices)
+    out = []
+    seen = set()
+    for i in indices:
+        i = int(i)
+        if not (0 <= i < len(devices)):
+            raise ValueError(
+                f"device_indices entry {i} out of range: "
+                f"{len(devices)} local devices"
+            )
+        if i in seen:
+            raise ValueError(f"device_indices entry {i} repeated")
+        seen.add(i)
+        out.append(devices[i])
+    if not out:
+        raise ValueError("device_indices must not be empty")
+    return out
+
+
 class TpuCommandExecutor:
     """All dispatch methods are serialized by a global lock (see module
     docstring): pool.state buffers are donated, so two concurrent dispatches
@@ -322,6 +353,18 @@ class TpuCommandExecutor:
         # comment): the hot coalesced methods pack whole batches into one
         # block here; everything else pads into reusable column buffers.
         self._staging = _StagingRings()
+        # Explicit device pinning (ISSUE 17 satellite): when the config
+        # names a device slice, every allocation this executor makes —
+        # pool-state factory jnp.zeros, staging device_puts — lands on
+        # its FIRST device via the process default-device, instead of
+        # whatever device 0 happens to be.  Each front-door worker is
+        # its own process, so a process-wide default is exactly the
+        # per-worker pin the slot→process map wants.
+        self.devices = None
+        idx = getattr(self._cfg, "device_indices", None)
+        if idx is not None:
+            self.devices = resolve_device_slice(idx)
+            jax.config.update("jax_default_device", self.devices[0])
 
     # -- pool-state factory (the executor owns array layout; pools only
     # hand out row numbers) ------------------------------------------------

@@ -24,8 +24,10 @@
  * (loaded via ctypes — redisson_tpu/serve/native_codec.py).
  */
 
+#include <errno.h>
 #include <stdint.h>
 #include <string.h>
+#include <unistd.h>
 
 /* The build probes cc/gcc/g++/clang in order; under a C++ compiler the
  * symbols must not mangle (ctypes looks them up by C name). */
@@ -33,10 +35,62 @@
 extern "C" {
 #endif
 
-long rtpu_resp_parse(const unsigned char *buf, long len,
-                     long max_frames, long max_args_total,
-                     long *counts, long *offs, long *lens,
-                     long *consumed, long *err)
+/* Family classification for the reactor's merged window (must mirror
+ * _Reactor._family_key in serve/reactor.py): commands of one fusable
+ * family chunk together inside a dispatch round.  Returns the family
+ * class only — the grouping OBJECT (argv[1]) is already a parsed
+ * descriptor on the Python side. */
+static long rtpu_classify(const unsigned char *p, long n)
+{
+    unsigned char u[10];
+    if (n < 3 || n > 10)
+        return 0;
+    for (long i = 0; i < n; i++) {
+        unsigned char c = p[i];
+        if (c >= 'a' && c <= 'z')
+            c = (unsigned char)(c - 32);
+        u[i] = c;
+    }
+    switch (n) {
+    case 3:
+        if (!memcmp(u, "GET", 3))
+            return 3;
+        break;
+    case 4:
+        if (!memcmp(u, "MGET", 4))
+            return 3;
+        break;
+    case 6:
+        if (!memcmp(u, "BF.ADD", 6))
+            return 1;
+        if (!memcmp(u, "SETBIT", 6) || !memcmp(u, "GETBIT", 6))
+            return 2;
+        break;
+    case 7:
+        if (!memcmp(u, "BF.MADD", 7))
+            return 1;
+        break;
+    case 9:
+        if (!memcmp(u, "BF.EXISTS", 9))
+            return 1;
+        if (!memcmp(u, "CMS.QUERY", 9))
+            return 4;
+        break;
+    case 10:
+        if (!memcmp(u, "BF.MEXISTS", 10))
+            return 1;
+        break;
+    }
+    return 0;
+}
+
+/* Shared frame scan: rtpu_resp_parse with an optional per-frame family
+ * output (fams != 0 additionally classifies argv[0] of every complete
+ * frame — the run-detection half of the tick loop). */
+static long rtpu_parse_core(const unsigned char *buf, long len,
+                            long max_frames, long max_args_total,
+                            long *counts, long *offs, long *lens,
+                            long *fams, long *consumed, long *err)
 {
     long pos = 0, nframes = 0, nargs = 0;
     *err = 0;
@@ -119,6 +173,9 @@ long rtpu_resp_parse(const unsigned char *buf, long len,
         if (!ok)
             break; /* incomplete frame: wait for more bytes */
         counts[nframes] = n;
+        if (fams)
+            fams[nframes] =
+                (n > 0) ? rtpu_classify(buf + offs[nargs], lens[nargs]) : 0;
         nframes++;
         nargs += n;
         pos = q;
@@ -126,6 +183,65 @@ long rtpu_resp_parse(const unsigned char *buf, long len,
 out:
     *consumed = pos;
     return nframes;
+}
+
+long rtpu_resp_parse(const unsigned char *buf, long len,
+                     long max_frames, long max_args_total,
+                     long *counts, long *offs, long *lens,
+                     long *consumed, long *err)
+{
+    return rtpu_parse_core(buf, len, max_frames, max_args_total, counts,
+                           offs, lens, (long *)0, consumed, err);
+}
+
+/* One reactor tick for one readable connection: drain the fd into the
+ * caller's buffer (read(2) loop — nonblocking socket), then parse every
+ * complete frame AND classify each frame's command family, all in one
+ * native call.  Python is left holding only dispatch decisions.
+ *
+ * In:  buf[0..have) holds leftover bytes from the previous tick; cap is
+ *      the buffer capacity; budget caps bytes read this call.
+ * Out: *nread    bytes appended by read(2) (buf now holds have+*nread);
+ *      *eof      1 when the peer closed (read returned 0) or the socket
+ *                errored fatally (anything but EAGAIN/EWOULDBLOCK/EINTR);
+ *      *consumed bytes occupied by the returned frames (caller compacts);
+ *      *err      as rtpu_resp_parse (0 clean / 1 protocol / 2 fallback).
+ * Returns the number of complete frames described in counts/offs/lens,
+ * with fams[i] holding each frame's family class.
+ *
+ * The read loop stops at EAGAIN, at the byte budget, or when the buffer
+ * fills (the caller grows it when a single frame exceeds cap). */
+long rtpu_resp_tick(long fd, unsigned char *buf, long cap, long have,
+                    long budget, long max_frames, long max_args_total,
+                    long *counts, long *offs, long *lens, long *fams,
+                    long *consumed, long *nread, long *eof, long *err)
+{
+    long got = 0;
+    *eof = 0;
+    while (got < budget && have + got < cap) {
+        long want = budget - got;
+        if (want > cap - (have + got))
+            want = cap - (have + got);
+        long n = (long)read((int)fd, buf + have + got, (size_t)want);
+        if (n > 0) {
+            got += n;
+            if (n < want)
+                break; /* short read: socket drained for now */
+            continue;
+        }
+        if (n == 0) {
+            *eof = 1;
+            break;
+        }
+        if (errno == EINTR)
+            continue;
+        if (errno != EAGAIN && errno != EWOULDBLOCK)
+            *eof = 1; /* fatal socket error: treat as peer-gone */
+        break;
+    }
+    *nread = got;
+    return rtpu_parse_core(buf, have + got, max_frames, max_args_total,
+                           counts, offs, lens, fams, consumed, err);
 }
 
 /* Serialize a batch of integer replies (`:n\r\n`) — the common reply shape

@@ -95,6 +95,27 @@ _SWEEP_S = 1.0
 _GATHER_S = 0.0003
 _GATHER_MAX = 1
 
+# Fusable-family classification (pure-Python mirror of the native
+# classifier in resp_codec.c:rtpu_classify — the two MUST agree, or a
+# connection's chunking would depend on which parser framed it).
+_FAM_BF = frozenset((b"BF.ADD", b"BF.MADD", b"BF.EXISTS", b"BF.MEXISTS"))
+
+
+def _family_code(cmd) -> int:
+    """Family class of one command (0 = non-fusable)."""
+    if not cmd:
+        return 0
+    name = cmd[0].upper()
+    if name in _FAM_BF:
+        return 1
+    if name in (b"SETBIT", b"GETBIT"):
+        return 2
+    if name in (b"GET", b"MGET"):
+        return 3
+    if name == b"CMS.QUERY":
+        return 4
+    return 0
+
 
 class _StreamFramer:
     """Incremental RESP request framer over a growing byte buffer — the
@@ -205,12 +226,26 @@ class _ReactorCtx(_ConnCtx):
 class _RConn:
     """Per-connection reactor state."""
 
-    def __init__(self, sock: socket.socket, server, reactor: "_Reactor"):
+    def __init__(
+        self,
+        sock: socket.socket,
+        server,
+        reactor: "_Reactor",
+        peer: bool = False,
+    ):
         self.sock = sock
         self.fd = sock.fileno()
         self.reactor = reactor
-        self.framer = _StreamFramer()
-        self.pending: deque = deque()  # parsed, not-yet-dispatched cmds
+        self.peer = peer  # in-node handoff leg from a sibling worker
+        # Native tick path: the per-connection leftover buffer for
+        # rtpu_resp_tick (drain+frame+classify in one native call).  The
+        # slow-path framer is built lazily, only when this connection
+        # falls off the native path (inline commands, proto errors) or
+        # the ticker is unavailable.
+        ticker = getattr(reactor, "ticker", None)
+        self.tickbuf = ticker.new_buf() if ticker is not None else None
+        self.framer = None if self.tickbuf is not None else _StreamFramer()
+        self.pending: deque = deque()  # (family, argv) not-yet-dispatched
         # Guards outbuf + progress stamps: enqueue() runs cross-thread
         # (pub/sub pushes, detached workers), flush on the reactor.
         self.wlock = _witness.named(
@@ -229,6 +264,17 @@ class _RConn:
         self.registered = False
         self.cur_mask = 0  # interest set currently in the selector
         self.ctx = _ReactorCtx(sock, server, self)
+        if peer:
+            # Sibling-worker legs are pre-trusted (same process tree,
+            # unix socket under the node's private rundir) and carry
+            # already-authed client traffic.
+            self.ctx.is_peer = True
+            self.ctx.authed = True
+
+    def at_frame_boundary(self) -> bool:
+        if self.tickbuf is not None and self.tickbuf.have:
+            return False
+        return self.framer is None or self.framer.at_frame_boundary()
 
     def enqueue(self, frame: bytes) -> None:
         """Append a reply/push frame to the ordered output backlog
@@ -270,6 +316,12 @@ class _Reactor(threading.Thread):
     def __init__(self, server, idx: int):
         super().__init__(name=f"rtpu-resp-reactor-{idx}", daemon=True)
         self.server = server
+        from redisson_tpu.serve import native_codec
+
+        # One native ticker per reactor thread (its descriptor arrays
+        # are single-threaded scratch); None degrades every connection
+        # to the Python framer path.
+        self.ticker = native_codec.get_ticker()
         self.sel = selectors.DefaultSelector()
         self.conns: dict = {}  # fd -> _RConn
         self._new: deque = deque()  # sockets awaiting registration
@@ -292,8 +344,8 @@ class _Reactor(threading.Thread):
 
     # -- cross-thread surface ------------------------------------------------
 
-    def add_conn(self, sock: socket.socket) -> None:
-        self._new.append(sock)
+    def add_conn(self, sock: socket.socket, peer: bool = False) -> None:
+        self._new.append((sock, peer))
         self.wake()
 
     def wake(self) -> None:
@@ -399,14 +451,14 @@ class _Reactor(threading.Thread):
 
     def _admit_new(self) -> None:
         while self._new:
-            sock = self._new.popleft()
+            sock, peer = self._new.popleft()
             try:
                 sock.setblocking(False)
-                rconn = _RConn(sock, self.server, self)
+                rconn = _RConn(sock, self.server, self, peer=peer)
             except OSError:
                 self._teardown_slot(sock)
                 continue
-            if self.server._requirepass:
+            if self.server._requirepass and not peer:
                 rconn.ctx.authed = False
             try:
                 self.sel.register(sock, selectors.EVENT_READ, rconn)
@@ -418,6 +470,9 @@ class _Reactor(threading.Thread):
             self.conns[rconn.fd] = rconn
 
     def _read_ready(self, rconn: _RConn, now: float) -> None:
+        if rconn.tickbuf is not None:
+            self._read_ready_native(rconn, now)
+            return
         got = False
         eof = False
         budget = 1 << 20
@@ -441,16 +496,7 @@ class _Reactor(threading.Thread):
             eof = True
         if got:
             rconn.last_activity = now
-            try:
-                rconn.framer.pop_into(rconn.pending)
-            except ProtocolError as e:
-                # Desynced stream: reply once, then close (Redis-style;
-                # mirrors _serve_conn's ProtocolError arm).
-                rconn.enqueue(
-                    _encode_error(f"Protocol error: {e}")
-                )
-                self._flush(rconn)
-                self._close_conn(rconn)
+            if not self._pop_framed(rconn):
                 return
             if rconn.pending:
                 self._attention.add(rconn)
@@ -468,6 +514,55 @@ class _Reactor(threading.Thread):
             self._update_mask(rconn)
             self._maybe_close_eof(rconn)
 
+    def _pop_framed(self, rconn: _RConn) -> bool:
+        """Pop slow-path framer output into pending as (family, argv)
+        tuples.  False when the stream desynced and the conn closed."""
+        tmp: deque = deque()
+        try:
+            rconn.framer.pop_into(tmp)
+        except ProtocolError as e:
+            # Desynced stream: reply once, then close (Redis-style;
+            # mirrors _serve_conn's ProtocolError arm).
+            rconn.enqueue(_encode_error(f"Protocol error: {e}"))
+            self._flush(rconn)
+            self._close_conn(rconn)
+            return False
+        for cmd in tmp:
+            rconn.pending.append((_family_code(cmd), cmd))
+        return True
+
+    def _read_ready_native(self, rconn: _RConn, now: float) -> None:
+        """Native per-tick hot loop: one rtpu_resp_tick call drains the
+        fd, frames every complete command, and classifies its family —
+        Python sees only the parsed (family, argv) stream."""
+        from redisson_tpu.serve import native_codec
+
+        got, eof, err = self.ticker.tick(
+            rconn.fd, rconn.tickbuf, rconn.pending
+        )
+        if got:
+            rconn.last_activity = now
+        if err != native_codec.PARSE_OK:
+            # Inline command, oversized frame, or malformed bytes:
+            # retire the native path for this connection and let the
+            # slow-path framer reproduce the blocking reader's behavior
+            # (including the precise protocol-error message).
+            rconn.framer = _StreamFramer()
+            rconn.framer.feed(rconn.tickbuf.take())
+            rconn.tickbuf = None
+            if not self._pop_framed(rconn):
+                return
+        if rconn.pending:
+            self._attention.add(rconn)
+            if len(rconn.pending) > _PENDING_HWM and not rconn.read_paused:
+                rconn.read_paused = True
+                self._update_mask(rconn)
+        if eof:
+            rconn.eof = True
+            rconn.read_paused = True
+            self._update_mask(rconn)
+            self._maybe_close_eof(rconn)
+
     def _maybe_close_eof(self, rconn: _RConn) -> None:
         if (
             rconn.eof and not rconn.closing and not rconn.busy
@@ -477,32 +572,41 @@ class _Reactor(threading.Thread):
 
     # -- merged dispatch pass ------------------------------------------------
 
-    def _needs_detach(self, rconn: _RConn, cmd) -> bool:
-        name = cmd[0].upper()
-        if rconn.ctx.in_multi:
-            # Queued-under-MULTI commands just queue (fast, inline);
-            # only EXEC executes — and may replay scripts — so it rides
-            # a worker.
-            return name == b"EXEC"
-        return name in _DETACH
+    def _needs_detach(self, rconn: _RConn, fam: int, cmd) -> bool:
+        ctx = rconn.ctx
+        if fam == 0:
+            name = cmd[0].upper()
+            if ctx.in_multi:
+                # Queued-under-MULTI commands just queue (fast, inline);
+                # only EXEC executes — and may replay scripts — so it
+                # rides a worker.  EXEC's replay re-enters _dispatch per
+                # member, so the multicore hook still applies to each.
+                return name == b"EXEC"
+            if name in _DETACH:
+                return True
+        elif ctx.in_multi:
+            return False  # fusable-family member queueing under MULTI
+        # Per-core front door (ISSUE 17): a keyed command owned by a
+        # sibling worker rides a worker thread too — its in-node handoff
+        # leg blocks on the peer's reply, which must never park the
+        # event loop.  Peer legs themselves always execute locally.
+        mc = getattr(self.server, "multicore", None)
+        return (
+            mc is not None
+            and not ctx.is_peer
+            and mc.needs_handoff(cmd)
+        )
 
     @staticmethod
-    def _family_key(cmd):
+    def _family_key(fam: int, cmd):
         """Grouping key for cross-connection adjacency: commands of one
         fusable family (and target object) sort together inside a
         round, so the vectorizer's adjacency scan sees them as one run.
         Non-fusable commands share a bucket that preserves arrival
         order (the sort is stable)."""
-        name = cmd[0].upper()
-        if name in (b"BF.ADD", b"BF.MADD", b"BF.EXISTS", b"BF.MEXISTS"):
-            return (1, cmd[1] if len(cmd) > 1 else b"")
-        if name in (b"SETBIT", b"GETBIT"):
-            return (2, cmd[1] if len(cmd) > 1 else b"")
-        if name in (b"GET", b"MGET"):
-            return (3, b"")
-        if name == b"CMS.QUERY":
-            return (4, cmd[1] if len(cmd) > 1 else b"")
-        return (0, b"")
+        if fam in (1, 2, 4):
+            return (fam, cmd[1] if len(cmd) > 1 else b"")
+        return (fam, b"")
 
     def _run_pass(self, now: float) -> None:
         server = self.server
@@ -522,11 +626,11 @@ class _Reactor(threading.Thread):
                 rconn.pending and len(taken) < _MAX_PER_CONN
                 and total < _MAX_PER_TICK
             ):
-                cmd = rconn.pending[0]
+                fam, cmd = rconn.pending[0]
                 if not cmd:
                     rconn.pending.popleft()  # empty frame: no reply
                     continue
-                if self._needs_detach(rconn, cmd):
+                if self._needs_detach(rconn, fam, cmd):
                     if not taken:
                         handoffs.append(rconn)
                     break
@@ -551,18 +655,19 @@ class _Reactor(threading.Thread):
         # stay in arrival order: chunks concatenate in order, and a
         # chunk is an order-preserving slice.
         cmds: list = []
+        fams: list = []
         ctxs: list = []
         owners: list = []
         chunked: list = []  # (rconn, [[cmds of chunk 0], [chunk 1], ...])
         for rconn, taken in per_conn:
             chunks: list = []
             key = None
-            for cmd in taken:
-                k = self._family_key(cmd)
-                if key is not None and k == key and k[0] != 0:
-                    chunks[-1][1].append(cmd)
+            for fam, cmd in taken:
+                k = self._family_key(fam, cmd)
+                if key is not None and k == key and fam != 0:
+                    chunks[-1][1].append((fam, cmd))
                 else:
-                    chunks.append((k, [cmd]))
+                    chunks.append((k, [(fam, cmd)]))
                     key = k
             chunked.append((rconn, chunks))
         depth = max((len(ch) for _, ch in chunked), default=0)
@@ -575,8 +680,9 @@ class _Reactor(threading.Thread):
             if len(round_items) > 1:
                 round_items.sort(key=lambda it: it[1][0])
             for rconn, (_k, chunk) in round_items:
-                for cmd in chunk:
+                for fam, cmd in chunk:
                     cmds.append(cmd)
+                    fams.append(fam)
                     ctxs.append(rconn.ctx)
                     owners.append(rconn)
         if cmds:
@@ -600,7 +706,7 @@ class _Reactor(threading.Thread):
             # Unconsumed tail (reply-buffer bound) back to the FRONT of
             # each owner's queue, in order.
             for k in range(len(cmds) - 1, consumed - 1, -1):
-                owners[k].pending.appendleft(cmds[k])
+                owners[k].pending.appendleft((fams[k], cmds[k]))
             for k in range(consumed):
                 frame = frames[k]
                 if frame:
@@ -612,7 +718,7 @@ class _Reactor(threading.Thread):
         for rconn in handoffs:
             if rconn.busy or rconn.closing or not rconn.pending:
                 continue
-            cmd = rconn.pending.popleft()
+            _fam, cmd = rconn.pending.popleft()
             rconn.busy = True
             # One thread PER DETACHED COMMAND (not a pool): a pool
             # bounds concurrency, and blocking pops parked in every
@@ -755,13 +861,14 @@ class _Reactor(threading.Thread):
                 and now - rconn.last_activity > idle_s
             ):
                 if (
-                    (rconn.ctx.subs or rconn.ctx.monitor)
-                    and rconn.framer.at_frame_boundary()
+                    (rconn.ctx.subs or rconn.ctx.monitor or rconn.peer)
+                    and rconn.at_frame_boundary()
                     and not rconn.pending
                 ):
                     # Subscribers/monitors may idle legitimately — but
                     # only at a frame boundary (same exemption as
-                    # _serve_conn).
+                    # _serve_conn).  Sibling-worker handoff legs are
+                    # pooled and long-lived by design.
                     rconn.last_activity = now
                 else:
                     self._close_conn(rconn)
@@ -861,10 +968,17 @@ class ReactorPool:
         for r in self._reactors:
             r.start()
 
-    def assign(self, sock: socket.socket) -> None:
+    def assign(self, sock: socket.socket, peer: bool = False) -> None:
         r = self._reactors[self._rr % self.nthreads]
         self._rr += 1
-        r.add_conn(sock)
+        r.add_conn(sock, peer=peer)
+
+    @property
+    def native_tick(self) -> bool:
+        """True when the reactors run the fused native drain+frame loop
+        (rtpu_resp_tick) — INFO frontdoor surfaces this so the bench's
+        mini-A/B can verify which arm it measured."""
+        return any(r.ticker is not None for r in self._reactors)
 
     def connection_count(self) -> int:
         return sum(len(r.conns) for r in self._reactors)

@@ -95,6 +95,9 @@ _ERROR_CODES = (
     # Cluster redirect protocol (ISSUE 12): these travel verbatim so
     # stock cluster clients parse the slot/address payload.
     "MOVED", "ASK", "CROSSSLOT", "TRYAGAIN", "CLUSTERDOWN",
+    # Per-core front door (ISSUE 17): a broken in-node handoff leg
+    # surfaces with its own code so clients can retry-distinguish it.
+    "HANDOFFBROKEN",
 )
 
 # Commands whose bodies execute arbitrary Python server-side; gated
@@ -454,7 +457,11 @@ class _ConnCtx:
         self.server = server  # live output-buffer limits (CONFIG SET)
         self.lock = _witness.named(threading.Lock(), "resp.conn.send")
         try:  # for SLOWLOG entries; the peer may already be gone
-            self.addr = "%s:%d" % sock.getpeername()[:2]
+            peer = sock.getpeername()
+            if isinstance(peer, tuple):
+                self.addr = "%s:%d" % peer[:2]
+            else:  # AF_UNIX peername is a (often empty) path string
+                self.addr = "unix:%s" % (peer or "peer")
         except OSError:
             self.addr = ""
         self.subs: dict[str, int] = {}  # channel -> bus listener id
@@ -480,6 +487,11 @@ class _ConnCtx:
         # MONITOR mode (ISSUE 13): every dispatched command streams to
         # this connection as a +<ts> [db addr] "CMD" ... push.
         self.monitor = False
+        # Per-core front door (ISSUE 17): True on in-node handoff legs
+        # from sibling workers — peer legs always execute locally (the
+        # no-proxy-loops invariant), skip auth (the unix socket lives in
+        # a mode-0700 rundir), and are exempt from the idle sweep.
+        self.is_peer = False
 
     def _kill(self) -> None:
         try:
@@ -701,8 +713,29 @@ class RespServer:
         self._scan_states: dict[int, str] = {}
         self._scan_next = 0
         self._scan_lock = _witness.named(threading.Lock(), "resp.scan")
+        # Per-core front door (ISSUE 17): in worker mode this process is
+        # one of K siblings sharing the SAME (host, port) via
+        # SO_REUSEPORT — the kernel load-balances accepts across the
+        # workers' listen sockets.  __main__ probes availability before
+        # spawning workers, so a failed setsockopt here means direct
+        # misconfiguration: fail loudly, not at first accept.
+        fd_i = getattr(client.config, "frontdoor_index", None)
+        fd_k = int(getattr(client.config, "frontdoor_workers", 1) or 1)
+        self._fd_workers = fd_k if (fd_k > 1 and fd_i is not None) else 1
+        self._fd_index = int(fd_i) if fd_i is not None else 0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if self._fd_workers > 1:
+            try:
+                self._sock.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
+                )
+            except (AttributeError, OSError) as e:
+                self._sock.close()
+                raise ValueError(
+                    "frontdoor worker mode requires SO_REUSEPORT "
+                    f"(probe with serve.multicore.reuseport_available): {e}"
+                )
         self._sock.bind((host, port))
         self._sock.listen(512)
         self.host, self.port = self._sock.getsockname()
@@ -788,6 +821,32 @@ class RespServer:
                     self._sock.close()
                     raise
                 self.reactor = None
+        # Per-core front door (ISSUE 17 tentpole): the in-node
+        # slot→process map.  Keyed commands owned by a sibling worker
+        # take a loopback handoff over persistent unix-domain legs —
+        # invisible to the client (no MOVED from inside a node).  Must
+        # init AFTER the reactor (peer legs are admitted into it) and
+        # BEFORE the accept thread (a client command must never race a
+        # half-built router).
+        self.multicore = None
+        if self._fd_workers > 1:
+            from redisson_tpu.serve.multicore import MulticoreRouter
+
+            try:
+                self.multicore = MulticoreRouter(
+                    self, self._fd_workers, self._fd_index,
+                    getattr(client.config, "frontdoor_dir", None),
+                    obs=self.obs,
+                )
+            except Exception:
+                self._sock.close()
+                raise
+        if self.obs is not None:
+            try:
+                self.obs.frontdoor_processes.set((), float(self._fd_workers))
+                self.obs.frontdoor_worker_index.set((), float(self._fd_index))
+            except AttributeError:
+                pass  # obs bundle predates the frontdoor families
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="rtpu-resp-accept", daemon=True
         )
@@ -851,11 +910,40 @@ class RespServer:
                     name="rtpu-resp-conn", daemon=True,
                 ).start()
 
-    def _serve_conn(self, conn: socket.socket) -> None:
+    def _admit_peer(self, conn: socket.socket) -> None:
+        """Admit an in-node handoff leg from a sibling front-door worker
+        (ISSUE 17).  Peer legs bypass max_connections — refusing one
+        would wedge the sibling's forwarded CLIENT command, turning a
+        conn-limit shed into a cross-worker stall — but join the normal
+        connection set so the shutdown drain covers them."""
+        with self._conn_lock:
+            if self._closed:
+                conn.close()
+                return
+            self._nconn += 1
+            self._conns_accepted += 1
+            self._conns.add(conn)
+        if self.obs is not None:
+            try:
+                self.obs.frontdoor_peer_accepts.inc(())
+            except AttributeError:
+                pass
+        if self.reactor is not None:
+            self.reactor.assign(conn, peer=True)
+        else:
+            threading.Thread(
+                target=self._serve_conn, args=(conn, True),
+                name="rtpu-resp-peer", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket, peer: bool = False) -> None:
         try:
             reader = _Reader(conn)
             ctx = _ConnCtx(conn, server=self)
-            if self._requirepass:
+            if peer:
+                ctx.is_peer = True
+                ctx.authed = True
+            elif self._requirepass:
                 ctx.authed = False
         except Exception:
             # Constructor failure must not leak the connection slot.
@@ -876,7 +964,7 @@ class RespServer:
                     # but only at a frame boundary; a timeout mid-frame
                     # (or with bytes buffered) would desync the protocol
                     # on resume.
-                    if (ctx.subs or ctx.monitor) and \
+                    if (ctx.subs or ctx.monitor or peer) and \
                             reader.at_frame_boundary():
                         continue
                     return  # reclaim the slot
@@ -967,6 +1055,9 @@ class RespServer:
             self.reactor.close()
         if self.cluster is not None:
             self.cluster.close()  # cached migration sockets
+        mc = getattr(self, "multicore", None)
+        if mc is not None:
+            mc.close()  # peer listener + pooled handoff legs
 
     # -- command dispatch ---------------------------------------------------
 
@@ -2123,6 +2214,17 @@ class RespServer:
             if ctx.queued is not None:
                 ctx.queued.append(cmd)
             return _encode_simple("QUEUED")
+        mc = self.multicore
+        if mc is not None:
+            # Per-core front door (ISSUE 17): keyed commands owned by a
+            # sibling worker take the in-node handoff leg; fan-out
+            # commands merge across the workers.  Runs BEFORE the
+            # cluster door so a handed-off command is judged by the
+            # slot OWNER's door — the in-node map itself never emits
+            # -MOVED (redirects describe the cluster, not node guts).
+            frame = mc.route(name, cmd, ctx)
+            if frame is not None:
+                return frame
         if self.cluster is not None:
             # Cluster routing (ISSUE 12): redirect frames short-circuit
             # the handler; commands on a MIGRATING slot run under the
@@ -3931,6 +4033,18 @@ class RespServer:
                     f"frontdoor_cross_conn_fused_ops:"
                     f"{_tot(obs.cross_conn_fused_ops)}",
                 ]
+                # Per-core front door (ISSUE 17): worker identity (bench
+                # clients probe this to pin worker-local traffic) + the
+                # in-node handoff counters.
+                mc = getattr(self, "multicore", None)
+                lines += [
+                    f"frontdoor_processes:{self._fd_workers}",
+                    f"frontdoor_worker_index:{self._fd_index}",
+                    "frontdoor_native_tick:"
+                    f"{1 if rx is not None and rx.native_tick else 0}",
+                ]
+                if mc is not None:
+                    lines += mc.info_lines()
             elif s == "overload" and obs is not None:
                 # Overload control plane (ISSUE 7): deadlines, admission
                 # control, tenant quotas, slow-client limits — the

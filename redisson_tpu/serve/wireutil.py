@@ -25,7 +25,9 @@ def skip_reply_frame(buf: bytes, i: int) -> int:
     Raises IndexError when the frame is incomplete (read more bytes and
     retry) and ValueError on an unparseable frame type — callers must
     treat the latter as a corrupt stream, never silently resync."""
-    j = buf.index(b"\r\n", i)
+    j = buf.find(b"\r\n", i)
+    if j < 0:
+        raise IndexError("incomplete header")
     t, body = buf[i : i + 1], buf[i + 1 : j]
     i = j + 2
     if t in (b"+", b"-", b":"):

@@ -798,10 +798,13 @@ def _reactor_pass_body():
     conn_b = rx._RConn(_FakeSock(1002), server, r)
     # BLPOP rides a detached worker: conn A freezes mid-stream, PING3
     # must still follow the worker's reply.
+    # Pending entries are (family, argv) pairs (ISSUE 17 native tick):
+    # family 0 = non-fusable, which is all this model needs.
     conn_a.pending.extend(
-        [[b"PING1"], [b"PING2"], [b"BLPOP", b"q", b"1"], [b"PING3"]]
+        [(0, [b"PING1"]), (0, [b"PING2"]),
+         (0, [b"BLPOP", b"q", b"1"]), (0, [b"PING3"])]
     )
-    conn_b.pending.extend([[b"PING4"], [b"PING5"]])
+    conn_b.pending.extend([(0, [b"PING4"]), (0, [b"PING5"])])
     conn_a.registered = conn_b.registered = False
     r.conns = {1001: conn_a, 1002: conn_b}
     # _read_ready would have flagged both as having framed commands.
@@ -874,6 +877,178 @@ def test_model_reactor_requeue_mutation_guard():
             )
     finally:
         rx._Reactor._run_pass = orig
+
+
+# -- in-node handoff model (ISSUE 17 satellite) -------------------------------
+#
+# The per-core front door's handoff leg rides the reactor's detach path:
+# a sibling-owned command freezes its connection (busy) until the unix
+# leg's relayed frame is enqueued, so NO schedule may lose or reorder
+# one connection's replies across a worker handoff — local commands
+# queued behind the handoff wait for its reply, whatever the worker
+# thread's timing.
+
+
+def _handoff_pass_body(conn_cls=None, small=False):
+    from collections import deque
+
+    from redisson_tpu.serve import reactor as rx
+
+    class _FakeSock:
+        def __init__(self, fd):
+            self._fd = fd
+            self.sent = bytearray()
+
+        def fileno(self):
+            return self._fd
+
+        def getpeername(self):
+            raise OSError("not connected")
+
+        def send(self, view):
+            checkpoint("wire send")
+            self.sent += bytes(view)
+            return len(view)
+
+        def close(self):
+            pass
+
+        def shutdown(self, how):
+            pass
+
+    class _StubMulticore:
+        # Stand-in for MulticoreRouter.needs_handoff: HOP* commands are
+        # owned by a sibling worker, everything else is worker-local.
+        def needs_handoff(self, cmd):
+            return cmd[0].startswith(b"HOP")
+
+    class _StubServer:
+        _requirepass = None
+        idle_timeout_s = 0.0
+        output_buffer_limit = 0
+        output_buffer_soft_seconds = 0.0
+        obs = None
+        multicore = _StubMulticore()
+
+        def _dispatch_merged(self, cmds, ctxs):
+            checkpoint("merged dispatch")
+            return [b"+" + cmds[0][0] + b"\r\n"], 1
+
+        def _safe_dispatch(self, cmd, ctx):
+            # The handoff leg: ship to the sibling, block on its reply,
+            # relay the frame verbatim.  The checkpoint is the leg's
+            # round-trip window — the schedule explorer interleaves the
+            # event loop against it.
+            checkpoint("handoff leg rtt")
+            return b"+" + cmd[0] + b"\r\n"
+
+    class _NoopWake:
+        def send(self, data):
+            return len(data)
+
+    server = _StubServer()
+    r = object.__new__(rx._Reactor)
+    r.server = server
+    r.conns = {}
+    r._new = deque()
+    r._stopping = False
+    r.tid = 0
+    r._attention = set()
+    r.want_flush = set()
+    r._wake_w = _NoopWake()
+
+    cls = conn_cls or rx._RConn
+    conn_a = cls(_FakeSock(1001), server, r)
+    if small:
+        # Minimal shape for the mutation guard's exploration: one
+        # handoff with one local command queued behind it.
+        conn_a.pending.extend([(0, [b"HOP2"]), (0, [b"PING3"])])
+        conns = (conn_a,)
+        want_a = b"+HOP2\r\n+PING3\r\n"
+    else:
+        conn_b = cls(_FakeSock(1002), server, r)
+        conn_a.pending.extend(
+            [(0, [b"PING1"]), (0, [b"HOP2"]), (0, [b"PING3"]),
+             (0, [b"HOP4"]), (0, [b"PING5"])]
+        )
+        conn_b.pending.extend([(0, [b"HOP6"]), (0, [b"PING7"])])
+        conn_b.registered = False
+        conns = (conn_a, conn_b)
+        want_a = b"+PING1\r\n+HOP2\r\n+PING3\r\n+HOP4\r\n+PING5\r\n"
+    conn_a.registered = False
+    r.conns = {c.fd: c for c in conns}
+    r._attention = set(conns)
+
+    def done():
+        return all(
+            not c.pending and not c.busy and not c.outbuf
+            for c in conns
+        )
+
+    def _state():
+        return tuple(
+            (len(c.pending), len(c.outbuf), len(c.sock.sent))
+            for c in conns
+        )
+
+    prev = _state()
+    for _ in range(80):
+        r._run_pass(0.0)
+        checkpoint("tick boundary")
+        if done():
+            break
+        # Stay RUNNABLE while the loop is making progress (so schedules
+        # where the event loop races an in-flight handoff leg are
+        # explorable); only block on the virtual clock when a pass was
+        # a no-op (waiting on the worker thread).
+        cur = _state()
+        if cur == prev:
+            time.sleep(0.001)
+        prev = cur
+    assert done(), (
+        f"ops lost across handoff: a={list(conn_a.pending)} "
+        f"busy={conn_a.busy}"
+    )
+    assert bytes(conn_a.sock.sent) == want_a, (
+        f"conn A replies reordered across handoff: {bytes(conn_a.sock.sent)!r}"
+    )
+    if not small:
+        assert bytes(conn_b.sock.sent) == b"+HOP6\r\n+PING7\r\n", (
+            f"conn B replies reordered: {bytes(conn_b.sock.sent)!r}"
+        )
+
+
+@schedule_test(max_schedules=150, random_schedules=32, preemption_bound=2,
+               max_steps=400000)
+def test_model_handoff_no_lost_or_reordered_replies():
+    _handoff_pass_body()
+
+
+def test_model_handoff_busy_freeze_mutation_guard():
+    """Reverting the handoff busy-freeze — the event loop keeps
+    dispatching a connection's local commands while its handoff leg is
+    still in flight on the worker thread — must be caught: some schedule
+    emits PING3's reply before HOP2's, and the failure carries a replay
+    token."""
+    from redisson_tpu.serve import reactor as rx
+
+    class _NoFreezeConn(rx._RConn):
+        # The reverted fix: the busy flag never sticks, so the loop
+        # races the in-flight leg.
+        @property
+        def busy(self):
+            return False
+
+        @busy.setter
+        def busy(self, v):
+            pass
+
+    with pytest.raises(ScheduleFailure) as ei:
+        explore(
+            lambda: _handoff_pass_body(conn_cls=_NoFreezeConn, small=True),
+            max_schedules=600, preemption_bound=3, max_steps=400000,
+        )
+    assert ei.value.token, "failing schedule must carry a replay token"
 
 
 # -- vectorizer run fences (ISSUE 11 satellite: the PR 9 leftover) ------------

@@ -44,6 +44,8 @@ def test_shipped_tree_catalog_covers_all_tiers():
     # manager's tier-accounting lock.
     for expected in ("storage.heat", "storage.residency"):
         assert expected in names, f"missing {expected}"
+    # ...and the per-core front door (ISSUE 17): the peer-socket pool.
+    assert "serve.multicore.pool" in names, "missing serve.multicore.pool"
 
 
 def test_shipped_tree_has_no_lock_order_cycles():

@@ -227,3 +227,110 @@ def test_require_native_guard():
     # fall back to the Python parser) when the codec did not build.
     if os.environ.get("RTPU_REQUIRE_NATIVE_RESP"):
         assert get_parser() is not None
+        assert native_codec.get_ticker() is not None, (
+            "require mode: the tick entry point must be active too"
+        )
+
+
+# -- rtpu_resp_tick (ISSUE 17): the fused drain loop --------------------------
+
+
+def test_ticker_drains_frames_and_classifies(parser):
+    ticker = native_codec.get_ticker()
+    assert ticker is not None, "fresh .so must carry rtpu_resp_tick"
+    a, b = socket.socketpair()
+    try:
+        b.sendall(
+            _wire([b"GET", b"k"]) + _wire([b"BF.ADD", b"f", b"x"])
+            + _wire([b"PING"])
+        )
+        a.setblocking(False)
+        tbuf = ticker.new_buf()
+        out = []
+        nread, eof, err = ticker.tick(a.fileno(), tbuf, out)
+        assert err == native_codec.PARSE_OK
+        assert not eof
+        assert [(f, cmd) for f, cmd in out] == [
+            (3, [b"GET", b"k"]),
+            (1, [b"BF.ADD", b"f", b"x"]),
+            (0, [b"PING"]),
+        ]
+        assert tbuf.have == 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_ticker_partial_frame_stays_buffered(parser):
+    ticker = native_codec.get_ticker()
+    assert ticker is not None
+    a, b = socket.socketpair()
+    try:
+        whole = _wire([b"SET", b"k", b"v"])
+        b.sendall(whole[: len(whole) - 3])
+        a.setblocking(False)
+        tbuf = ticker.new_buf()
+        out = []
+        ticker.tick(a.fileno(), tbuf, out)
+        assert out == [] and tbuf.have == len(whole) - 3
+        b.sendall(whole[len(whole) - 3:])
+        ticker.tick(a.fileno(), tbuf, out)
+        assert out == [(0, [b"SET", b"k", b"v"])]
+        assert tbuf.have == 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_no_native_tick_env_disables_only_the_ticker(parser, monkeypatch):
+    # The A/B lever: RTPU_NO_NATIVE_TICK turns off the fused drain loop
+    # while the per-frame parser stays native.
+    monkeypatch.setenv("RTPU_NO_NATIVE_TICK", "1")
+    assert native_codec.get_ticker() is None
+    assert get_parser() is not None
+
+
+class _HidingLib:
+    """A .so proxy that pretends chosen symbols were never exported —
+    the stale-library simulation (an old _resp_codec.so with no
+    compiler available to rebuild it)."""
+
+    def __init__(self, real, hidden):
+        self._real = real
+        self._hidden = frozenset(hidden)
+
+    def __getattr__(self, name):
+        if name in self._hidden:
+            raise AttributeError(name)
+        return getattr(self._real, name)
+
+
+def test_stale_so_missing_tick_symbol_fails_hard(parser, monkeypatch):
+    """Satellite: RTPU_REQUIRE_NATIVE_RESP must fail hard — not
+    silently drop to the Python drain loop — when the loaded .so
+    predates rtpu_resp_tick."""
+    stale = type(parser)(parser._lib)  # fresh instance over the same lib
+    stale._lib = _HidingLib(parser._lib, ("rtpu_resp_tick",))
+    monkeypatch.setattr(native_codec, "get_parser", lambda: stale)
+    monkeypatch.delenv("RTPU_NO_NATIVE_TICK", raising=False)
+    monkeypatch.delenv("RTPU_NO_NATIVE_RESP", raising=False)
+    # Without require mode: quiet degrade to the Python tick loop.
+    monkeypatch.delenv("RTPU_REQUIRE_NATIVE_RESP", raising=False)
+    assert native_codec.get_ticker() is None
+    # With it: a hard error naming the stale symbol.
+    monkeypatch.setenv("RTPU_REQUIRE_NATIVE_RESP", "1")
+    with pytest.raises(RuntimeError, match="rtpu_resp_tick"):
+        native_codec.get_ticker()
+
+
+def test_stale_so_missing_encode_bulks_fails_hard(parser, monkeypatch):
+    """Same contract for rtpu_resp_encode_bulks: parser construction
+    refuses a stale .so under require mode, degrades one call without."""
+    hidden = _HidingLib(parser._lib, ("rtpu_resp_encode_bulks",))
+    monkeypatch.delenv("RTPU_NO_NATIVE_RESP", raising=False)
+    monkeypatch.delenv("RTPU_REQUIRE_NATIVE_RESP", raising=False)
+    p = type(parser)(hidden)
+    assert p._enc_bulks is None  # quiet degrade of that one call
+    monkeypatch.setenv("RTPU_REQUIRE_NATIVE_RESP", "1")
+    with pytest.raises(RuntimeError, match="rtpu_resp_encode_bulks"):
+        type(parser)(hidden)
