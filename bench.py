@@ -2065,6 +2065,192 @@ def bench_config13_multicore(_make_client):
     return out
 
 
+def bench_config14_failover(_make_client):
+    """Config 14 — failover drill (ISSUE 18 tentpole).
+
+    3 journaled primaries × 1 replica each (node timeout 1s); forked
+    closed-loop writers stream zipf-keyed acked SETs through the
+    redirect-chasing ClusterClient, and mid-stream primary 0 dies by
+    SIGKILL.  Published:
+
+    - config14_time_to_recovered_goodput_s: wall time from the kill to
+      the first half-second bucket whose ack rate recovers to >= 50%
+      of the pre-kill median — detection + election + takeover +
+      client reconvergence, measured as the CLIENT sees it.
+    - config14_time_to_promotion_s: kill → the dead shard's replica
+      reporting role:master (the server-side half of the window).
+    - config14_acked_write_loss: acked writes that fail to read back
+      after recovery, counted over the loss-guaranteed set (writes
+      fenced by WAIT 1 before the kill + writes acked after it).
+      MUST be 0 — the differential zero-acked-write-loss criterion.
+    - config14_replica_staleness_lag_ops_{p50,p99,max}: replica-read
+      staleness (slave_lag_ops) sampled across the surviving replicas
+      under load — the bounded-staleness read gate's operating range.
+
+    Nodes run on the CPU backend like config9/10/12/13 (N processes
+    cannot share the one bench accelerator; this config measures the
+    recovery plane, not kernel rate)."""
+    import threading as _threading
+
+    from redisson_tpu.cluster.supervisor import (
+        ClusterSupervisor,
+        _request,
+    )
+
+    PRE_S = 3.0
+    POST_S = 12.0
+    BUCKET_S = 0.5
+    N_THREADS = 4
+    out = {}
+    sup = ClusterSupervisor(
+        n_nodes=3, replicas_per_shard=1, node_timeout_ms=1000,
+        startup_timeout_s=180.0,
+    )
+    try:
+        sup.start()
+        from redisson_tpu.cluster.client import ClusterClient
+
+        stop_evt = _threading.Event()
+        acked = [dict() for _ in range(N_THREADS)]  # seq -> ack time
+        buckets: dict = {}
+        blk = _threading.Lock()
+
+        def writer(t):
+            cc = ClusterClient(sup.addrs)
+            rng = np.random.default_rng(t)
+            seq = t * 10_000_000
+            try:
+                while not stop_evt.is_set():
+                    seq += 1
+                    hot = int((rng.zipf(1.2) - 1) % 4096)
+                    key = "c14-%d-%d" % (hot, seq)
+                    try:
+                        r = cc.execute("SET", key, "v%d" % seq)
+                    except Exception:
+                        continue  # retry budget exhausted mid-failover
+                    if r == b"OK":
+                        now = time.time()
+                        acked[t][key] = now
+                        b = int(now / BUCKET_S)
+                        with blk:
+                            buckets[b] = buckets.get(b, 0) + 1
+            finally:
+                cc.close()
+
+        lag_samples: list = []
+        promoted_at: list = []
+
+        def sampler(kill_at_box):
+            raddr0 = sup.replica_addrs[0]
+            survivors = sup.replica_addrs[1:]
+            while not stop_evt.is_set():
+                for addr in survivors:
+                    try:
+                        (info,) = _request(
+                            addr, [("INFO", "replication")], timeout_s=2.0
+                        )
+                        for ln in info.decode().splitlines():
+                            if ln.startswith("slave_lag_ops:"):
+                                lag_samples.append(int(ln.split(":")[1]))
+                    except (OSError, ValueError):
+                        pass
+                if kill_at_box and not promoted_at:
+                    try:
+                        (info,) = _request(
+                            raddr0, [("INFO", "replication")],
+                            timeout_s=2.0,
+                        )
+                        if b"role:master" in info:
+                            promoted_at.append(time.time())
+                    except OSError:
+                        pass
+                time.sleep(0.05)
+
+        kill_at_box: list = []
+        threads = [
+            _threading.Thread(target=writer, args=(t,))
+            for t in range(N_THREADS)
+        ]
+        st = _threading.Thread(target=sampler, args=(kill_at_box,))
+        for th in threads:
+            th.start()
+        st.start()
+        time.sleep(PRE_S)
+        # Fence everything acked so far: WAIT 1 on every primary means
+        # each shard's replica holds the prefix — the writes whose
+        # survival the kill must not threaten.
+        fence_t = time.time()  # BEFORE the fence: a write acked after a
+        # primary's WAIT returned (while later primaries' WAITs run) is
+        # not covered by that fence, so the cutoff is conservative.
+        for addr in sup.addrs:
+            (n,) = _request(addr, [("WAIT", "1", "8000")])
+            assert n >= 1, f"{addr}: no replica ack for the fence"
+        sup.kill_node(0)
+        kill_t = time.time()
+        kill_at_box.append(kill_t)
+        time.sleep(POST_S)
+        stop_evt.set()
+        for th in threads:
+            th.join(timeout=30)
+        st.join(timeout=10)
+
+        # Goodput timeline -> recovery point.
+        kb = int(kill_t / BUCKET_S)
+        pre = [v for b, v in buckets.items()
+               if b < kb and (kb - b) * BUCKET_S <= PRE_S]
+        pre_med = float(np.median(pre)) if pre else 0.0
+        rec_b = next(
+            (b for b in sorted(buckets) if b > kb
+             and buckets[b] >= 0.5 * pre_med), None
+        )
+        out["config14_prekill_acked_per_sec"] = round(pre_med / BUCKET_S)
+        out["config14_time_to_recovered_goodput_s"] = (
+            None if rec_b is None
+            else round(rec_b * BUCKET_S - kill_t, 2)
+        )
+        out["config14_time_to_promotion_s"] = (
+            round(promoted_at[0] - kill_t, 2) if promoted_at else None
+        )
+
+        # Zero acked-write loss over the guaranteed set: fenced-before-
+        # kill plus acked-after-promotion.  The fence->promotion window
+        # holds acks the guarantee does NOT cover: the unfenced sliver
+        # before the kill, and in-flight acks the dying primary sent
+        # that its replica never received (client-side ack timestamps
+        # can land just past kill_t for ops served just before it).
+        post_t = promoted_at[0] if promoted_at else float("inf")
+        guaranteed = [
+            k for d in acked for k, ts in d.items()
+            if ts <= fence_t or ts >= post_t
+        ]
+        cc = sup.client()
+        lost = 0
+        try:
+            for i in range(0, len(guaranteed), 512):
+                chunk = guaranteed[i:i + 512]
+                got = cc.execute_many([("GET", k) for k in chunk])
+                lost += sum(1 for g in got if g is None)
+        finally:
+            cc.close()
+        out["config14_acked_writes_checked"] = len(guaranteed)
+        out["config14_acked_write_loss"] = lost
+        assert lost == 0, f"{lost} acked writes lost across failover"
+
+        if lag_samples:
+            lag = sorted(lag_samples)
+            out["config14_replica_staleness_lag_ops_p50"] = int(
+                lag[len(lag) // 2]
+            )
+            out["config14_replica_staleness_lag_ops_p99"] = int(
+                lag[min(len(lag) - 1, int(len(lag) * 0.99))]
+            )
+            out["config14_replica_staleness_lag_ops_max"] = int(lag[-1])
+            out["config14_replica_staleness_samples"] = len(lag)
+    finally:
+        sup.shutdown()
+    return out
+
+
 def bench_config3_bitset(client):
     """Config 3: 2^30-bit RBitSet, batched get/set (raw bitmap path).
 
@@ -2381,6 +2567,25 @@ def main():
         write_bench_artifact(result, line)
         return
 
+    if "--config14" in sys.argv:
+        # CI smoke mode (ISSUE 18): the failover drill alone — kill -9
+        # a primary under acked zipf load, time-to-recovered-goodput,
+        # zero acked-write loss, replica staleness histogram — written
+        # as a BENCH.json artifact so the workflow can assert the
+        # published keys exist without paying for the full bench.
+        stats = bench_config14_failover(make_client)
+        result = {
+            "metric": "config14_failover_smoke",
+            "value": stats.get("config14_time_to_recovered_goodput_s"),
+            "unit": "s to recovered goodput",
+            "vs_baseline": None,
+            "extra": stats,
+        }
+        line = json.dumps(result)
+        print(line)
+        write_bench_artifact(result, line)
+        return
+
     if "--config13" in sys.argv:
         # CI smoke mode (ISSUE 17): the per-core front door A/B alone,
         # written as a BENCH.json artifact so the workflow can assert
@@ -2514,6 +2719,14 @@ def main():
         multicore_stats = bench_config13_multicore(make_client)
     except Exception as e:  # pragma: no cover - env-dependent spawn
         multicore_stats = {"config13_multicore_error": repr(e)}
+    # Failover drill (ISSUE 18): config14_failover — kill -9 a primary
+    # under acked zipf load; time-to-recovered-goodput, zero
+    # acked-write loss, replica staleness histogram.  Isolated like
+    # config9/10/12/13 (subprocess spawn).
+    try:
+        failover_stats = bench_config14_failover(make_client)
+    except Exception as e:  # pragma: no cover - env-dependent spawn
+        failover_stats = {"config14_failover_error": repr(e)}
     host_ops = measure_host_baseline()
 
     # vs_baseline: the bench env ships no redis-server, so the Redis-backed
@@ -2604,6 +2817,10 @@ def main():
                     # 3-pass medians), native-tick A/B, host-core
                     # attribution.
                     **multicore_stats,
+                    # Failover drill (ISSUE 18): time-to-recovered-
+                    # goodput, promotion time, zero acked-write loss,
+                    # replica staleness percentiles.
+                    **failover_stats,
                     "hll_pfadd_ops_per_sec": round(hll_ops),
                     "config3_bitset_ops_per_sec": round(bitset_ops),
                     "config4_mixed_ops_per_sec": round(mixed_ops),

@@ -44,6 +44,10 @@ def _serve_multicore(args, nworkers: int) -> int:
         extra += ["--no-resp-vectorize"]
     if args.no_resp_reactor:
         extra += ["--no-resp-reactor"]
+    if args.journal_dir:
+        extra += ["--journal-dir", args.journal_dir]
+    if args.replica_of:
+        extra += ["--replica-of", args.replica_of]
     if args.resp_reactor_threads is not None:
         extra += ["--resp-reactor-threads", str(args.resp_reactor_threads)]
     if args.trace_sample_rate is not None:
@@ -119,6 +123,19 @@ def main(argv=None) -> int:
     p.add_argument(
         "--snapshot-interval-s", type=float, default=0.0,
         help="arm periodic snapshots (requires --snapshot-dir)",
+    )
+    p.add_argument(
+        "--journal-dir",
+        help="op-journal directory: tail-of-log durability between "
+        "snapshots, and the replication stream's source on a primary "
+        "(docs/robustness.md)",
+    )
+    p.add_argument(
+        "--replica-of", default=None, metavar="HOST:PORT",
+        help="boot as a read-only replica of this primary (ISSUE 18): "
+        "full-resync bootstrap (snapshot + stream tail), then follow "
+        "the replication stream; eligible for automatic failover in "
+        "cluster mode (docs/clustering.md)",
     )
     p.add_argument(
         "--max-connections", type=int, default=256,
@@ -207,6 +224,12 @@ def main(argv=None) -> int:
         "(default: the bind address; set when behind NAT/containers)",
     )
     p.add_argument(
+        "--cluster-node-timeout-ms", type=int, default=None,
+        help="failure-detection window for the cluster bus (ISSUE 18): "
+        "a peer silent this long is marked failed; replicas of a "
+        "failed primary start a failover election (default 1500)",
+    )
+    p.add_argument(
         "--frontdoor-processes", type=int, default=None,
         help="per-core front door (ISSUE 17): serve with this many "
         "reactor processes sharing the port via SO_REUSEPORT, each "
@@ -274,6 +297,10 @@ def main(argv=None) -> int:
             p.error("--snapshot-interval-s requires a snapshot dir "
                     "(--snapshot-dir or config file)")
         cfg.snapshot_interval_s = args.snapshot_interval_s
+    if args.journal_dir:
+        cfg.journal_dir = args.journal_dir
+    if args.replica_of:
+        cfg.replica_of = args.replica_of
 
     if args.trace_sample_rate is not None:
         if not 0.0 <= args.trace_sample_rate <= 1.0:
@@ -302,6 +329,7 @@ def main(argv=None) -> int:
         (args.cluster_topology, "cluster_topology"),
         (args.cluster_myid, "cluster_node_id"),
         (args.cluster_announce, "cluster_announce"),
+        (args.cluster_node_timeout_ms, "cluster_node_timeout_ms"),
     ):
         if flag is not None:
             if not cfg.cluster_enabled:
@@ -360,6 +388,39 @@ def main(argv=None) -> int:
             except Exception:
                 pass  # backend unavailable: first-come allocation
 
+    repl_master = getattr(cfg, "replica_of", None)
+    if repl_master:
+        # Replica boot (ISSUE 18): pull the primary's snapshot and wipe
+        # local durability state BEFORE the engine restores, so the
+        # process always comes up at one consistent (replid, offset)
+        # and never replays stale local segments over the primary's
+        # snapshot.  Runs after the worker-subdir split above — the
+        # extracted files land in the dirs the engine actually reads.
+        host_m, _, port_m = str(repl_master).rpartition(":")
+        if not host_m or not port_m.isdigit():
+            p.error("--replica-of needs HOST:PORT")
+        if not cfg.snapshot_dir:
+            p.error("--replica-of requires a snapshot dir "
+                    "(--snapshot-dir or config file)")
+        from redisson_tpu.durability.replica import bootstrap_full_resync
+
+        ident = (getattr(cfg, "cluster_node_id", None)
+                 or f"{args.host}:{args.port}")
+        replid, snap_seq = bootstrap_full_resync(
+            host_m, int(port_m), cfg.snapshot_dir,
+            getattr(cfg, "journal_dir", None), ident,
+            listening_port=args.port,
+        )
+        # The RESP door hands this to the ReplicaLink so its first
+        # PSYNC continues from the restored cut instead of re-shipping
+        # the snapshot it was just built from.
+        cfg._repl_bootstrap_id = replid
+        print(
+            f"replica of {repl_master}: FULLRESYNC {replid} "
+            f"at seq {snap_seq}",
+            flush=True,
+        )
+
     client = redisson_tpu.create(cfg)
     server = RespServer(
         client,
@@ -368,6 +429,22 @@ def main(argv=None) -> int:
         max_connections=args.max_connections,
         idle_timeout_s=args.idle_timeout_s,
     )
+    if server.cluster is not None:
+        # Automatic failover (ISSUE 18): every cluster node runs the
+        # bus agent — primaries to vote, replicas to detect their
+        # primary's death and run the election.  server.close() stops
+        # it.
+        from redisson_tpu.cluster.failover import FailoverAgent
+
+        FailoverAgent(
+            server,
+            node_timeout_s=float(
+                getattr(cfg, "cluster_node_timeout_ms", 1500) or 1500
+            ) / 1000.0,
+            ping_interval_s=float(
+                getattr(cfg, "cluster_ping_interval_ms", 300) or 300
+            ) / 1000.0,
+        ).start()
     metrics_srv = None
     if args.metrics_port is not None:
         metrics_srv = client.start_metrics_endpoint(

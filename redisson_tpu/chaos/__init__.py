@@ -32,6 +32,14 @@ point                   where it fires
 ``journal.torn_tail``   per journal frame: when it fires, HALF the frame
                         reaches the file and the journal breaks — the
                         crash-mid-write simulation recovery must truncate
+``repl.stream``         primary side, per RTPU.REPLFETCH batch
+                        (serve/resp.py): ``error`` drops the batch (an
+                        empty reply — the replica retries), ``corrupt``
+                        flips a payload byte so the replica's CRC check
+                        rejects the batch, ``latency`` delays the reply
+``repl.ack``            primary side, per REPLCONF ACK: ``error``/
+                        ``corrupt`` drop the ack (the WAIT fence and
+                        INFO lag stay stale until the next one lands)
 ======================  ====================================================
 
 Zero-overhead-when-disabled contract: every call site is guarded by the

@@ -151,6 +151,24 @@ class RedissonTpuClient(CamelCompatMixin):
                         os.path.join(d, "grid_store.bin")
                     )
                 )
+        # Grid keyspace journaling (ISSUE 18 satellite): grid mutations
+        # enter the engine's op journal — the same total order the
+        # replication stream ships — via full-state records.  The host
+        # sketch engine has no journal seam, so the grid tier stays
+        # unjournaled there (exactly like its snapshot warning above).
+        eng = self._engine
+        if hasattr(eng, "_journal_rec"):
+            self._grid.on_journal = eng._journal_rec
+            self._grid.on_journal_ack = lambda seq: eng._ack(None, seq)
+            # Records the engine-init replay deferred (the grid store
+            # did not exist yet): apply them now, AFTER the grid
+            # snapshot restore — they are the post-cut tail, in seq
+            # order, and full-state records make re-application safe.
+            pending = getattr(eng, "_pending_grid_replay", None)
+            if pending:
+                for rec in pending:
+                    self._grid.apply_journal_record(rec)
+                eng._pending_grid_replay = []
         self._topic_bus = TopicBus(n_threads=config.threads)
         import threading
 

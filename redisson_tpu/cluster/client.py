@@ -99,7 +99,8 @@ class ClusterClient:
 
     def __init__(self, seeds, password=None, timeout_s=10.0, obs=None,
                  tryagain_attempts=8, tryagain_backoff_s=0.02,
-                 tracer=None):
+                 tracer=None, deadnode_attempts=10,
+                 deadnode_backoff_s=0.1):
         if not seeds:
             raise ValueError("at least one seed (host, port) required")
         self._seeds = [tuple(s) for s in seeds]
@@ -115,6 +116,12 @@ class ClusterClient:
         self.tracer = tracer
         self._tryagain_attempts = tryagain_attempts
         self._tryagain_backoff_s = tryagain_backoff_s
+        # Failover window (ISSUE 18): a dead node costs a connect
+        # failure per touch until the takeover broadcast lands in some
+        # survivor's CLUSTER SLOTS — execute() rides it out with
+        # refresh-and-retry instead of surfacing the first OSError.
+        self._deadnode_attempts = deadnode_attempts
+        self._deadnode_backoff_s = deadnode_backoff_s
         self._table_lock = _witness.named(
             threading.Lock(), "cluster.client.table"
         )
@@ -124,7 +131,7 @@ class ClusterClient:
         self.stats = {
             "moved": 0, "ask": 0, "tryagain": 0,
             "scatter_batches": 0, "scatter_legs": 0,
-            "table_refreshes": 0,
+            "table_refreshes": 0, "deadnode_retries": 0,
         }
         self.refresh_slots()
 
@@ -244,8 +251,36 @@ class ClusterClient:
         """Route + execute one command; follows MOVED (one table refresh
         + one retry), ASK (ASKING handshake, no table update) and
         TRYAGAIN (bounded backoff).  Non-redirect error replies raise
-        ReplyError."""
+        ReplyError.
+
+        A DEAD node (connect refused / socket error / unserved slot) is
+        retried with backoff + a slot-table refresh from the surviving
+        nodes — the redirect chase through an automatic failover: the
+        retries span the detection + election window, and the refresh
+        picks up the promoted replica once the takeover broadcast
+        lands.  At-least-once during that window (the reply for an
+        applied write can die with the node), exactly like a restarted
+        redis-cluster client."""
         cmd = self._norm(cmd)
+        attempt = 0
+        while True:
+            try:
+                return self._execute_routed(cmd)
+            except (OSError, ClusterDownError):
+                attempt += 1
+                if attempt > self._deadnode_attempts:
+                    raise
+                self.stats["deadnode_retries"] += 1
+                time.sleep(self._deadnode_backoff_s * attempt)
+                try:
+                    self.refresh_slots()
+                except ClusterDownError:
+                    pass  # everyone unreachable right now: keep trying
+
+    def _execute_routed(self, cmd):
+        """One route + execute + redirect-chase pass (the pre-ISSUE 18
+        execute body); raises OSError/ClusterDownError on a dead node
+        for execute()'s retry loop."""
         _, addr = self._route_addr(cmd)
         span = None
         if self.tracer is not None and _trace.ENABLED:

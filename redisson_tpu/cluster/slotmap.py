@@ -50,6 +50,13 @@ class SlotMap:
         self.importing: dict = {}  # slot -> source node id
         self.migrating: dict = {}  # slot -> target node id
         self.epoch = 0  # bumped by every topology mutation
+        # Replication topology (ISSUE 18): node roles + whose shard a
+        # replica backs, and the per-slot CONFIG EPOCH the failover
+        # takeover is gated on — a stale takeover (lost election, stale
+        # broadcast) must never overwrite a newer assignment.
+        self._roles: dict = {}  # id -> "master" | "replica"
+        self._replica_of: dict = {}  # replica id -> primary id
+        self._slot_epoch: list = [0] * NSLOTS
 
     # -- construction ------------------------------------------------------
 
@@ -59,6 +66,10 @@ class SlotMap:
         for n in d.get("nodes", ()):
             nid = str(n["id"])
             m._nodes[nid] = (str(n["host"]), int(n["port"]))
+            role = str(n.get("role") or "master")
+            m._roles[nid] = role
+            if n.get("replica_of"):
+                m._replica_of[nid] = str(n["replica_of"])
             for start, end in n.get("slots", ()):
                 start, end = int(start), int(end)
                 if not (0 <= start <= end < NSLOTS):
@@ -71,17 +82,22 @@ class SlotMap:
 
     def to_dict(self) -> dict:
         with self._lock:
-            return {
-                "nodes": [
-                    {
-                        "id": nid,
-                        "host": host,
-                        "port": port,
-                        "slots": self._ranges_locked(nid),
-                    }
-                    for nid, (host, port) in sorted(self._nodes.items())
-                ]
-            }
+            out = []
+            for nid, (host, port) in sorted(self._nodes.items()):
+                n = {
+                    "id": nid,
+                    "host": host,
+                    "port": port,
+                    "slots": self._ranges_locked(nid),
+                }
+                # Role fields only when non-default: the topology-file
+                # format stays byte-compatible for primary-only maps.
+                if self._roles.get(nid, "master") != "master":
+                    n["role"] = self._roles[nid]
+                if nid in self._replica_of:
+                    n["replica_of"] = self._replica_of[nid]
+                out.append(n)
+            return {"nodes": out}
 
     # -- reads -------------------------------------------------------------
 
@@ -203,3 +219,93 @@ class SlotMap:
     def migration_counts(self) -> tuple:
         with self._lock:
             return len(self.importing), len(self.migrating)
+
+    # -- replication topology + failover takeover (ISSUE 18) ---------------
+
+    def role(self, node_id: str) -> str:
+        with self._lock:
+            return self._roles.get(node_id, "master")
+
+    def set_role(self, node_id: str, role: str,
+                 replica_of: Optional[str] = None) -> None:
+        if role not in ("master", "replica"):
+            raise ValueError(f"bad role {role!r}")
+        with self._lock:
+            self._roles[node_id] = role
+            if role == "replica" and replica_of:
+                self._replica_of[node_id] = replica_of
+            else:
+                self._replica_of.pop(node_id, None)
+            self.epoch += 1
+
+    def replica_of(self, node_id: str) -> Optional[str]:
+        with self._lock:
+            return self._replica_of.get(node_id)
+
+    def replicas_of(self, primary_id: str) -> list:
+        with self._lock:
+            return sorted(
+                rid for rid, pid in self._replica_of.items()
+                if pid == primary_id
+            )
+
+    def primary_ids(self) -> list:
+        """Node ids with the master role — the failover electorate
+        (majority = len//2 + 1, counting unreachable primaries)."""
+        with self._lock:
+            return sorted(
+                nid for nid in self._nodes
+                if self._roles.get(nid, "master") == "master"
+            )
+
+    def slot_epoch(self, slot: int) -> int:
+        with self._lock:
+            return self._slot_epoch[slot]
+
+    def apply_takeover(self, old_id: str, new_id: str,
+                       epoch: int, slots=None) -> int:
+        """Failover takeover (the SETSLOT-broadcast analog): the claimed
+        slots move to ``new_id`` stamped with ``epoch``; roles flip (new
+        primary is a master, the dead one is demoted to a slotless
+        replica entry).  Returns the slot count moved — 0 means the
+        broadcast was stale and changed NOTHING.
+
+        The claim set: the winner (``slots=None``) claims whatever its
+        OWN map still shows ``old_id`` owning; its broadcast then
+        carries those ranges explicitly, and receivers pass them here
+        as ``slots`` ([start, end] pairs).  Receivers resolve purely by
+        per-slot epoch — NOT by who they currently believe owns the
+        slot — so two takeovers of the same primary in successive
+        epochs converge to the higher epoch on every node regardless
+        of broadcast delivery order (an owner-match rule here diverges:
+        a node that applied the epoch-1 claim first would refuse the
+        epoch-2 winner, while a node seeing them reversed accepts it).
+        A claim's epoch is majority-minted, so a higher epoch always
+        supersedes; reverting the ``_slot_epoch[s] < epoch`` gate is
+        the netsim dual-primary delivery-order mutation guard."""
+        epoch = int(epoch)
+        with self._lock:
+            if new_id not in self._nodes:
+                raise KeyError(f"unknown node id {new_id!r}")
+            if slots is None:
+                claim = [
+                    s for s in range(NSLOTS) if self._owner[s] == old_id
+                ]
+            else:
+                claim = []
+                for start, end in slots:
+                    claim.extend(range(int(start), int(end) + 1))
+            moved = 0
+            for s in claim:
+                if self._slot_epoch[s] < epoch:
+                    self._owner[s] = new_id
+                    self._slot_epoch[s] = epoch
+                    moved += 1
+            if moved:
+                self._roles[new_id] = "master"
+                self._replica_of.pop(new_id, None)
+                if old_id in self._nodes:
+                    self._roles[old_id] = "replica"
+                    self._replica_of[old_id] = new_id
+                self.epoch += 1
+            return moved

@@ -133,10 +133,22 @@ class ClusterSupervisor:
     def __init__(self, n_nodes: int = 3, host: str = "127.0.0.1",
                  platform: str = "cpu", node_args=(), env_extra=None,
                  startup_timeout_s: float = 120.0, metrics: bool = False,
-                 frontdoor_processes: int = 1):
+                 frontdoor_processes: int = 1,
+                 replicas_per_shard: int = 0,
+                 node_timeout_ms: int = 1500):
         if n_nodes < 1:
             raise ValueError("n_nodes must be >= 1")
         self.n_nodes = n_nodes
+        # Replication + failover (ISSUE 18): each primary additionally
+        # gets this many --replica-of processes (own snapshot/journal
+        # dirs under the supervisor tmpdir; primaries get durability
+        # dirs too — the replication stream is journal-fed).  Replicas
+        # spawn AFTER the primaries are serving (their boot runs a
+        # FULLRESYNC bootstrap against a live primary).
+        self.replicas_per_shard = max(0, int(replicas_per_shard))
+        self.node_timeout_ms = int(node_timeout_ms)
+        self.replica_addrs: list = []  # (host, port) per replica
+        self.replica_ids: list = []
         self.host = host
         self.platform = platform
         # Per-core front door (ISSUE 17): each node serves its shard
@@ -183,7 +195,8 @@ class ClusterSupervisor:
                 s.close()
 
     def topology(self) -> dict:
-        """Even contiguous slot partition across the nodes."""
+        """Even contiguous slot partition across the primaries, plus a
+        slotless role=replica entry per replica process."""
         per = NSLOTS // self.n_nodes
         nodes = []
         for i, (h, p) in enumerate(self.addrs):
@@ -193,20 +206,39 @@ class ClusterSupervisor:
                 "id": self.node_ids[i], "host": h, "port": p,
                 "slots": [[start, end]],
             })
+        for j, (h, p) in enumerate(self.replica_addrs):
+            pi = j // self.replicas_per_shard
+            nodes.append({
+                "id": self.replica_ids[j], "host": h, "port": p,
+                "slots": [], "role": "replica",
+                "replica_of": self.node_ids[pi],
+            })
         return {"nodes": nodes}
 
     def start(self) -> "ClusterSupervisor":
         if self._started:
             return self
-        nports = self.n_nodes * (2 if self.metrics else 1)
+        nreplicas = self.n_nodes * self.replicas_per_shard
+        nports = self.n_nodes * (2 if self.metrics else 1) + nreplicas
         ports = self._free_ports(self.host, nports)
         self.addrs = [(self.host, p) for p in ports[: self.n_nodes]]
+        base = self.n_nodes
         if self.metrics:
             self.metrics_addrs = [
-                (self.host, p) for p in ports[self.n_nodes:]
+                (self.host, p) for p in ports[base:base + self.n_nodes]
             ]
+            base += self.n_nodes
+        self.replica_addrs = [(self.host, p) for p in ports[base:]]
         self.node_ids = ["node-%d-%d" % (i, p)
                          for i, p in enumerate(ports[: self.n_nodes])]
+        self.replica_ids = [
+            "node-%d-replica-%d-%d" % (
+                j // self.replicas_per_shard,
+                j % self.replicas_per_shard,
+                p,
+            )
+            for j, (_, p) in enumerate(self.replica_addrs)
+        ]
         self._tmpdir = tempfile.mkdtemp(prefix="rtpu-cluster-")
         topo_path = os.path.join(self._tmpdir, "topology.json")
         with open(topo_path, "w") as f:
@@ -231,6 +263,10 @@ class ClusterSupervisor:
                         "--cluster",
                         "--cluster-topology", topo_path,
                         "--cluster-myid", self.node_ids[i]]
+                if self.replicas_per_shard:
+                    # Replication is journal-fed and PSYNC serves the
+                    # durable snapshot — primaries need both dirs.
+                    argv += self._durability_args(f"node{i}")
                 if self.metrics:
                     argv += [
                         "--metrics-port",
@@ -246,7 +282,31 @@ class ClusterSupervisor:
                     stdout=log, stderr=subprocess.STDOUT, env=env,
                 ))
                 log.close()  # the child holds its own fd now
-            self._await_ready(procs)
+            self._await_ready(procs, self.addrs, "node")
+            # Replicas spawn once every primary serves: their boot runs
+            # a FULLRESYNC bootstrap against a live primary.
+            for j, (h, p) in enumerate(self.replica_addrs):
+                pi = j // self.replicas_per_shard
+                log = open(
+                    os.path.join(self._tmpdir, f"replica{j}.log"), "wb"
+                )
+                argv = [sys.executable, "-m", "redisson_tpu",
+                        "--host", h, "--port", str(p),
+                        "--platform", self.platform,
+                        "--cluster",
+                        "--cluster-topology", topo_path,
+                        "--cluster-myid", self.replica_ids[j],
+                        "--replica-of", "%s:%d" % self.addrs[pi]]
+                argv += self._durability_args(f"replica{j}")
+                procs.append(subprocess.Popen(
+                    argv + self.node_args,
+                    stdout=log, stderr=subprocess.STDOUT, env=env,
+                ))
+                log.close()
+            if self.replica_addrs:
+                self._await_ready(
+                    procs[self.n_nodes:], self.replica_addrs, "replica"
+                )
         except Exception:
             for pr in procs:
                 try:
@@ -259,15 +319,27 @@ class ClusterSupervisor:
             self._started = True
         return self
 
-    def _await_ready(self, procs) -> None:
+    def _durability_args(self, name: str) -> list:
+        """--snapshot-dir/--journal-dir under the supervisor tmpdir
+        (replication needs both on every member) + the failure-
+        detection timeout every bus agent runs with."""
+        ddir = os.path.join(self._tmpdir, name)
+        snap = os.path.join(ddir, "snap")
+        journal = os.path.join(ddir, "journal")
+        os.makedirs(snap, exist_ok=True)
+        os.makedirs(journal, exist_ok=True)
+        return ["--snapshot-dir", snap, "--journal-dir", journal,
+                "--cluster-node-timeout-ms", str(self.node_timeout_ms)]
+
+    def _await_ready(self, procs, addrs, kind: str = "node") -> None:
         deadline = time.monotonic() + self.startup_timeout_s
-        for i, addr in enumerate(self.addrs):
+        for i, addr in enumerate(addrs):
             while True:
                 if procs[i].poll() is not None:
                     raise RuntimeError(
-                        f"cluster node {i} ({addr}) exited rc="
+                        f"cluster {kind} {i} ({addr}) exited rc="
                         f"{procs[i].returncode} during startup; see "
-                        f"{self._tmpdir}/node{i}.log"
+                        f"{self._tmpdir}/{kind}{i}.log"
                     )
                 try:
                     replies = _request(
@@ -325,6 +397,26 @@ class ClusterSupervisor:
             slot, self.addrs[src_index], self.addrs[dst_index],
             notify=self.addrs, **kw
         )
+
+    def replica_index(self, primary_index: int, k: int = 0) -> int:
+        """Roster index of ``primary_index``'s k-th replica — the
+        process roster lists primaries first, replicas after in spawn
+        order (kill_node/alive numbering)."""
+        return self.n_nodes + primary_index * self.replicas_per_shard + k
+
+    def kill_node(self, index: int, wait_s: float = 10.0) -> None:
+        """SIGKILL one spawned process (the failover soak's crash
+        hammer); the roster keeps its slot so indices stay stable."""
+        with self._lock:
+            p = self._procs[index]
+        try:
+            p.kill()
+        except OSError:
+            pass
+        try:
+            p.wait(timeout=wait_s)
+        except subprocess.TimeoutExpired:
+            pass
 
     def alive(self) -> list:
         """Indices of nodes whose process is still running."""

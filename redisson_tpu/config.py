@@ -366,6 +366,26 @@ class Config:
         self.frontdoor_workers = 1
         self.frontdoor_index: Optional[int] = None
         self.frontdoor_dir: Optional[str] = None
+        # Replication + automatic failover (ISSUE 18).  ``replica_of``
+        # ("host:port") makes this node a READ replica: it bootstraps
+        # via RTPU.PSYNC (snapshot tar + stream tail), applies the
+        # primary's journal stream, and serves reads only (-READONLY on
+        # writes).  ``repl_backlog_bytes`` bounds the primary-side
+        # partial-resync ring; a replica whose offset falls off it (and
+        # off the retired journal segments) full-resyncs.
+        # ``repl_max_staleness_ops``: a replica more than this many ops
+        # behind its primary refuses keyed reads with -STALEREAD
+        # (0 = serve reads at any staleness — the Redis default).
+        # ``cluster_node_timeout_ms`` / ``cluster_ping_interval_ms``:
+        # the failover agent's failure-detection clock — a peer silent
+        # for node-timeout is marked failed, and a failed primary's
+        # replicas run the epoch election (docs/clustering.md
+        # "Replication & failover").
+        self.replica_of: Optional[str] = None
+        self.repl_backlog_bytes = 4 << 20
+        self.repl_max_staleness_ops = 0
+        self.cluster_node_timeout_ms = 1500
+        self.cluster_ping_interval_ms = 300
 
     # -- fluent setters, mirroring the Java builder idiom ------------------
 
@@ -432,6 +452,11 @@ class Config:
         "frontdoor_workers",
         "frontdoor_index",
         "frontdoor_dir",
+        "replica_of",
+        "repl_backlog_bytes",
+        "repl_max_staleness_ops",
+        "cluster_node_timeout_ms",
+        "cluster_ping_interval_ms",
     )
 
     def to_dict(self) -> dict:

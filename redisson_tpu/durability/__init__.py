@@ -1,4 +1,5 @@
-"""Crash-safe durability tier (ISSUE 10 tentpole).
+"""Crash-safe durability tier (ISSUE 10 tentpole) + replication
+stream (ISSUE 18 tentpole).
 
 ``journal.py`` — the append-only op journal (the AOF analog): every
 accepted mutation is a CRC32-framed record in segment files, written by
@@ -6,6 +7,13 @@ a group-commit writer thread under the ``appendfsync always|everysec|no``
 policies, truncated in coordination with snapshots (the BGREWRITEAOF
 analog), and replayed deterministically through the host golden engine
 at recovery (``recovery.py``).
+
+``replication.py`` / ``replica.py`` — the journal generalized into a
+subscribable change stream: the primary's :class:`ReplicationHub`
+taps every append into a backlog ring replicas drain over the RESP
+door (``RTPU.PSYNC`` / ``RTPU.REPLFETCH`` / ``REPLCONF ACK``), and a
+:class:`ReplicaLink` applies the stream through the SAME replay path
+crash recovery uses — one definition of "state from the journal".
 """
 
 from redisson_tpu.durability.journal import (
@@ -16,11 +24,22 @@ from redisson_tpu.durability.journal import (
     encode_record,
 )
 from redisson_tpu.durability.recovery import replay_journal
+from redisson_tpu.durability.replica import (
+    ReplicaLink,
+    ReplicaStreamError,
+    bootstrap_full_resync,
+)
+from redisson_tpu.durability.replication import ReplBacklog, ReplicationHub
 
 __all__ = [
     "FSYNC_POLICIES",
     "JournalError",
     "OpJournal",
+    "ReplBacklog",
+    "ReplicaLink",
+    "ReplicaStreamError",
+    "ReplicationHub",
+    "bootstrap_full_resync",
     "decode_record",
     "encode_record",
     "replay_journal",

@@ -253,6 +253,11 @@ class OpJournal:
         self._cv = threading.Condition(self._lock)  # writer wake
         self._durable_cv = threading.Condition(self._lock)
         self._pending: list[bytes] = []  # encoded payloads awaiting write
+        # Replication tap: when set (ReplicationHub), called as
+        # tap(seq, payload) under self._lock from append() — the lock
+        # is what guarantees the stream sees seqs contiguous and in
+        # order.  The tap must not call back into journal methods.
+        self.tap = None
         self._policy = fsync_policy
         self._fsync_req = 0  # explicit fence target seq (WAIT / close)
         self._broken: Optional[BaseException] = None
@@ -399,6 +404,8 @@ class OpJournal:
             seq = self._next_seq
             self._next_seq += 1
             self._pending.append(payload)
+            if self.tap is not None:
+                self.tap(seq, payload)
             self._cv.notify()
         return seq
 
@@ -411,6 +418,17 @@ class OpJournal:
 
     def last_seq(self) -> int:
         return self.cut()
+
+    def min_available_seq(self) -> int:
+        """Smallest seq still readable from on-disk segments — the
+        floor of the partial-resync disk fallback.  Snapshots retire
+        covered segments, so this climbs over time; an offset below it
+        can only be served by a FULLRESYNC."""
+        with self._lock:
+            for seg in self._segments:
+                if seg.count:
+                    return seg.first_seq
+            return self._next_seq
 
     def durable_seq(self) -> int:
         with self._lock:

@@ -332,6 +332,36 @@ class _ReplaySession:
             # way without mutating — a faithful no-op.
             pass
 
+    # grid keyspace (ISSUE 18 satellite) -----------------------------------
+    #
+    # Grid records are full-entry-state and idempotent.  They cannot
+    # apply here directly: during engine-init replay the client's
+    # GridStore does not exist yet (the engine is constructed first).
+    # They queue on the ENGINE, and the client applies them — in seq
+    # order, latest-wins — right after its grid snapshot restore.  The
+    # replica stream-apply path calls GridStore.apply_journal_record
+    # directly and never routes through this deferral.
+
+    def _defer_grid(self, rec):
+        pend = getattr(self.engine, "_pending_grid_replay", None)
+        if pend is None:
+            pend = self.engine._pending_grid_replay = []
+        pend.append(rec)
+
+    def _op_grid_state(self, rec):
+        self._defer_grid(rec)
+
+    def _op_grid_del(self, rec):
+        self._defer_grid(rec)
+
+    def _op_repl_mark(self, rec):
+        # Replica stream bookmark (durability/replica.py): the highest
+        # replayed mark is the primary offset this node had applied.
+        self.engine._last_repl_mark = max(
+            int(getattr(self.engine, "_last_repl_mark", 0)),
+            int(rec["offset"]),
+        )
+
     # -- write-back --------------------------------------------------------
 
     def writeback(self) -> int:

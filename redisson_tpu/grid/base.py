@@ -14,6 +14,41 @@ from typing import Any, Optional
 from redisson_tpu.objects.base import CamelCompatMixin
 
 
+def _journal_wrap(fn):
+    """After the wrapped mutator returns, journal the object's full
+    current state through the store (capture is atomic under the store
+    lock, so seq order equals state order even when the method already
+    released the lock — see GridStore._journal_capture)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        res = fn(self, *args, **kwargs)
+        store = self._store
+        if store.on_journal is not None and not store.journal_suspended:
+            store.journal_entry(self._name)
+        return res
+
+    return wrapper
+
+
+def journaled(*method_names):
+    """Class decorator: route the named MUTATOR methods through the op
+    journal (ISSUE 18 satellite — grid mutations previously bypassed
+    it, so replicas and crash recovery could not mirror them).  Grid
+    records are full-entry-state and idempotent; read-only methods must
+    NOT be listed (every record costs an encode + a journal append).
+    The ``_async`` twins wrap the sync methods via ``__getattr__``, so
+    decorating the sync form covers both."""
+
+    def deco(cls):
+        for n in method_names:
+            setattr(cls, n, _journal_wrap(getattr(cls, n)))
+        return cls
+
+    return deco
+
+
 class GridObject(CamelCompatMixin):
     KIND: str = ""
 

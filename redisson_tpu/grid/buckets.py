@@ -11,11 +11,13 @@ from __future__ import annotations
 import io
 from typing import Any, Optional
 
-from redisson_tpu.grid.base import GridObject
+from redisson_tpu.grid.base import GridObject, journaled
 
 _MISSING = object()
 
 
+@journaled("set", "set_if_absent", "set_if_exists", "get_and_set",
+           "get_and_delete", "compare_and_set")
 class Bucket(GridObject):
     KIND = "bucket"
 
@@ -113,6 +115,7 @@ class Buckets:
             return True
 
 
+@journaled("set")
 class BinaryStream(GridObject):
     """→ org/redisson/RedissonBinaryStream.java: raw byte-string key with
     stream-style IO."""
@@ -150,6 +153,7 @@ class BinaryStream(GridObject):
         return io.BytesIO(self.get())
 
 
+@journaled("set_path", "array_append", "string_append", "increment")
 class JsonBucket(Bucket):
     """→ RJsonBucket (RedisJSON-backed bucket): JSON value with dot-path
     reads/writes (`$` or empty = root, `a.b.0.c` walks objects/arrays)."""

@@ -14,9 +14,11 @@ import fnmatch
 import time
 from typing import Any, Iterable, Optional
 
-from redisson_tpu.grid.base import GridObject
+from redisson_tpu.grid.base import GridObject, journaled
 
 
+@journaled("add", "add_all", "remove", "remove_random", "union",
+           "intersection", "diff")
 class Set_(GridObject):
     KIND = "set"
 
@@ -145,6 +147,7 @@ class Set_(GridObject):
         return self.size()
 
 
+@journaled("add", "remove")
 class SetCache(GridObject):
     """→ RedissonSetCache: set with per-element TTL."""
 
@@ -217,6 +220,8 @@ class SetCache(GridObject):
             return [self._dec(vb) for vb in e.value.data]
 
 
+@journaled("add", "add_all", "insert", "set", "remove", "remove_at",
+           "trim")
 class List_(GridObject):
     KIND = "list"
 
@@ -388,6 +393,8 @@ class SortedSet(GridObject):
             return [] if e is None else [v for v, _ in e.value]
 
 
+@journaled("add", "add_all", "add_score", "remove",
+           "remove_range_by_score", "poll_first", "poll_last")
 class ScoredSortedSet(GridObject):
     """→ RedissonScoredSortedSet (Redis ZSET)."""
 
@@ -523,6 +530,7 @@ class ScoredSortedSet(GridObject):
             return [self._dec(b) for b, _ in self._sorted()]
 
 
+@journaled("add", "add_all", "remove")
 class LexSortedSet(GridObject):
     """→ RedissonLexSortedSet: string ZSET, all scores 0, lexicographic
     range ops."""

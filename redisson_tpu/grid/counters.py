@@ -6,7 +6,7 @@ ranges).
 
 from __future__ import annotations
 
-from redisson_tpu.grid.base import GridObject
+from redisson_tpu.grid.base import GridObject, journaled
 
 
 def _as_int(v) -> int:
@@ -21,6 +21,9 @@ def _as_int(v) -> int:
     return int(v)
 
 
+@journaled("set", "add_and_get", "get_and_add", "increment_and_get",
+           "decrement_and_get", "get_and_increment", "get_and_decrement",
+           "get_and_set", "compare_and_set", "get_and_delete")
 class AtomicLong(GridObject):
     KIND = "atomiclong"
     # One counter FAMILY on read: RESP INCR/INCRBYFLOAT may legitimately
@@ -100,6 +103,8 @@ class AtomicLong(GridObject):
             return old
 
 
+@journaled("set", "add_and_get", "get_and_add", "get_and_set",
+           "compare_and_set")
 class AtomicDouble(AtomicLong):
     """→ RedissonAtomicDouble — same surface over float."""
 
@@ -149,6 +154,7 @@ class AtomicDouble(AtomicLong):
             return True
 
 
+@journaled("add", "increment", "decrement", "reset")
 class LongAdder(GridObject):
     """→ RedissonLongAdder.  The reference keeps per-client local counters
     synced over a topic; in-process the shared cell is the sum itself."""
@@ -178,6 +184,7 @@ class LongAdder(GridObject):
         self._store.put_entry(self._name, self.KIND, 0)
 
 
+@journaled("add", "reset")
 class DoubleAdder(GridObject):
     KIND = "doubleadder"
 
@@ -198,6 +205,7 @@ class DoubleAdder(GridObject):
         self._store.put_entry(self._name, self.KIND, 0.0)
 
 
+@journaled("try_init", "next_id")
 class IdGenerator(GridObject):
     """→ org/redisson/RedissonIdGenerator.java: ids handed out from locally
     cached allocation blocks reserved atomically from the shared counter."""

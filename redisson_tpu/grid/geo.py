@@ -11,7 +11,7 @@ from __future__ import annotations
 import math
 from typing import Any, Optional
 
-from redisson_tpu.grid.base import GridObject
+from redisson_tpu.grid.base import GridObject, journaled
 
 _EARTH_M = 6372797.560856  # Redis's earth radius (meters)
 _UNITS = {"m": 1.0, "km": 1000.0, "mi": 1609.34, "ft": 0.3048}
@@ -91,6 +91,7 @@ def _geohash(lon: float, lat: float, precision: int = 11) -> str:
     return "".join(out)
 
 
+@journaled("add", "add_entries", "remove")
 class Geo(GridObject):
     """A geo key IS a zset whose scores are 52-bit geohash cell ids —
     the Redis representation, verbatim: TYPE reports zset, ZSCORE/ZRANGE

@@ -12,7 +12,7 @@ from __future__ import annotations
 import time
 from typing import Any, Iterable, Optional
 
-from redisson_tpu.grid.base import GridObject
+from redisson_tpu.grid.base import GridObject, journaled
 
 _MISSING = object()
 
@@ -65,6 +65,8 @@ class _MapValue:
             self.live(kb, now)
 
 
+@journaled("put", "fast_put", "put_if_absent", "put_all", "remove",
+           "fast_remove", "replace", "add_and_get", "clear")
 class Map(GridObject):
     KIND = "map"
 
@@ -329,6 +331,7 @@ class Map(GridObject):
         return self.size()
 
 
+@journaled("put", "fast_put", "put_if_absent", "add_and_get")
 class MapCache(Map):
     """→ org/redisson/RedissonMapCache.java: RMap + per-entry TTL/max-idle.
     The grid sweeper calls ``prune_expired`` (the MapCacheEvictionTask

@@ -13,7 +13,7 @@ import threading
 import time
 from typing import Any, Iterable, Optional
 
-from redisson_tpu.grid.base import GridObject
+from redisson_tpu.grid.base import GridObject, journaled
 
 
 # "No element" marker distinct from a stored None: codecs encode None
@@ -23,6 +23,8 @@ from redisson_tpu.grid.base import GridObject
 _EMPTY = object()
 
 
+@journaled("offer", "offer_all", "poll", "poll_last_and_offer_first_to",
+           "remove", "clear")
 class Queue(GridObject):
     KIND = "list"  # queues are lists in Redis; share the kind (RQueue over RList)
 
@@ -80,6 +82,11 @@ class Queue(GridObject):
             vb = e.value.pop()
             dest = self._client.get_queue(dest_name)
             dest._entry().value.insert(0, vb)
+            # The destination is mutated RAW (not through a decorated
+            # method), so it journals here; the wrapper's own capture of
+            # self follows with a higher seq, and its durability ack
+            # covers this record too (fsync is seq-ordered).
+            self._store._journal_capture(dest_name)
             self._store.notify()
             return self._dec(vb)
 
@@ -119,6 +126,7 @@ class Queue(GridObject):
         return self.size()
 
 
+@journaled("add_first", "add_last", "poll_first", "poll_last")
 class Deque(Queue):
     """→ RedissonDeque: double-ended ops."""
 
@@ -154,6 +162,7 @@ class Deque(Queue):
             return self._dec(e.value[-1])
 
 
+@journaled("poll", "take", "put", "drain_to", "poll_from_any")
 class BlockingQueue(Queue):
     """→ RedissonBlockingQueue: poll with timeout parks on the store
     condition until an offer lands (the BLPOP pub/sub-wakeup analog)."""
@@ -210,6 +219,7 @@ class BlockingQueue(Queue):
                 self._store.cond.wait(timeout=remaining)
 
 
+@journaled("poll_first", "poll_last")
 class BlockingDeque(BlockingQueue, Deque):
     """→ RedissonBlockingDeque."""
 
@@ -362,6 +372,9 @@ class PriorityQueue(GridObject):
             return [] if e is None else [v for v, _ in e.value]
 
 
+@journaled("offer", "offer_all", "poll", "remove",
+           "poll_last_and_offer_first_to", "try_set_capacity",
+           "set_capacity")
 class RingBuffer(Queue):
     """→ RedissonRingBuffer: bounded queue; offers past capacity evict the
     oldest elements.
@@ -542,6 +555,7 @@ class _TransferHandle(bytes):
     __slots__ = ()
 
 
+@journaled("transfer", "try_transfer", "poll", "take", "drain_to")
 class TransferQueue(BlockingQueue):
     """→ RTransferQueue (java.util.concurrent.TransferQueue semantics):
     ``transfer`` blocks until a consumer takes the element; plain

@@ -63,6 +63,23 @@ class GridStore:
         # names changes, UNDER ``self.lock`` — the hook must be
         # leaf-safe, like the invalidation hooks above.
         self.on_keyspace = None
+        # Op-journal reach (ISSUE 18 satellite): the client wires these
+        # to the sketch engine's journal seam so grid mutations enter
+        # the SAME total order the replication stream ships.  Grid
+        # records are full-entry-state (idempotent, last-write-wins on
+        # replay), captured+appended atomically under ``self.lock`` —
+        # so seq order equals capture order and the highest seq for a
+        # name always carries its newest state.  ``on_journal(op, name,
+        # **fields) -> seq|None`` is called under the lock (it only
+        # takes the journal's queue lock — ordering grid.store →
+        # journal.queue, never reversed); ``on_journal_ack(seq)`` is
+        # called OUTSIDE it (under appendfsync=always it blocks on the
+        # fsync fence).
+        self.on_journal = None
+        self.on_journal_ack = None
+        # True while applying replicated/replayed records: the apply
+        # path must never re-journal what it applies.
+        self.journal_suspended = False
 
     def _note_invalidate(self, name: str) -> None:
         hook = self.on_invalidate
@@ -73,6 +90,117 @@ class GridStore:
         hook = self.on_keyspace
         if hook is not None:
             hook(name, delta)
+
+    # -- op-journal capture/apply (ISSUE 18 satellite) ---------------------
+
+    @staticmethod
+    def _pack_blobs(blobs) -> bytes:
+        """Length-prefixed blob list as one bytes field (the record
+        codec ships bytes as a uint8 array)."""
+        import struct
+
+        parts = [struct.pack("<I", len(blobs))]
+        for b in blobs:
+            parts.append(struct.pack("<I", len(b)))
+            parts.append(bytes(b))
+        return b"".join(parts)
+
+    @staticmethod
+    def _unpack_blobs(data) -> list:
+        import struct
+
+        if hasattr(data, "tobytes"):  # decoded records carry uint8 arrays
+            data = data.tobytes()
+        (n,) = struct.unpack_from("<I", data, 0)
+        off = 4
+        out = []
+        for _ in range(n):
+            (ln,) = struct.unpack_from("<I", data, off)
+            off += 4
+            out.append(bytes(data[off : off + ln]))
+            off += ln
+        if len(out) != n:
+            raise ValueError("truncated grid blob pack")
+        return out
+
+    def _journal_capture(self, name: str):
+        """Append one full-state record for ``name`` as it stands RIGHT
+        NOW (a ``grid.del`` when absent/expired) and return the seq.
+        MUST run under ``self.lock`` — capture+append atomicity is what
+        makes seq order equal state order.  Returns None when journaling
+        is off, suspended, or the kind has no codec (such kinds don't
+        snapshot either, so replicas/recovery can't see them anyway)."""
+        hook = self.on_journal
+        if hook is None or self.journal_suspended:
+            return None
+        e = self._data.get(name)
+        if e is None or e.expired(time.time()):
+            return hook("grid.del", name)
+        blobs: list = []
+
+        def add_blob(b) -> int:
+            blobs.append(bytes(b))
+            return len(blobs) - 1
+
+        desc = self._enc_entry(e.kind, e.value, add_blob)
+        if desc is None:
+            return None
+        import json
+
+        return hook(
+            "grid.state", name,
+            kind=e.kind,
+            desc=json.dumps(desc, separators=(",", ":")),
+            expire_at=e.expire_at,
+            blobs=self._pack_blobs(blobs),
+        )
+
+    def _journal_ack(self, seq) -> None:
+        """Durability fence for a captured record — call OUTSIDE the
+        store lock (blocks on fsync under appendfsync=always)."""
+        ack = self.on_journal_ack
+        if ack is not None and seq is not None:
+            ack(seq)
+
+    def journal_entry(self, name: str) -> None:
+        """Capture + ack one name's state: the per-mutator hook the
+        ``journaled`` decorator (grid/base.py) calls after a mutation
+        returns."""
+        with self.lock:
+            seq = self._journal_capture(name)
+        self._journal_ack(seq)
+
+    def apply_journal_record(self, rec: dict) -> None:
+        """Install one ``grid.state``/``grid.del`` record — the replica
+        stream-apply and journal-recovery entry point.  Full-state
+        semantics: idempotent, latest-seq-wins."""
+        op = rec["op"]
+        name = rec["name"]
+        prev = self.journal_suspended
+        self.journal_suspended = True
+        try:
+            if op == "grid.del":
+                self.delete(name)
+                return
+            if op != "grid.state":
+                raise ValueError(f"not a grid journal record: {op!r}")
+            import json
+
+            blobs = self._unpack_blobs(rec["blobs"])
+            value = self._dec_entry(json.loads(rec["desc"]), blobs)
+            exp = rec.get("expire_at")
+            with self.lock:
+                e = GridEntry(str(rec["kind"]), value)
+                e.expire_at = exp
+                if name not in self._data:
+                    self._note_keyspace(name, +1)
+                self._data[name] = e
+                self._note_invalidate(name)
+                if exp is not None:
+                    self._ensure_sweeper()
+                self.cond.notify_all()
+        finally:
+            self.journal_suspended = prev
 
     def _guard_foreign(self, name: str) -> None:
         if self.foreign_exists is not None and self.foreign_exists(name):
@@ -150,7 +278,9 @@ class GridStore:
             self._note_invalidate(name)
             self._note_keyspace(name, -1)
             self.cond.notify_all()
-            return True
+            seq = self._journal_capture(name)
+        self._journal_ack(seq)
+        return True
 
     def rename(self, old: str, new: str) -> bool:
         with self.lock:
@@ -170,7 +300,12 @@ class GridStore:
             self._note_keyspace(old, -1)
             if not displaced:  # overwrite transfers the displaced +1
                 self._note_keyspace(new, +1)
-            return True
+            # Two full-state records (old absent, new present) — rename
+            # needs no dedicated record type under last-write-wins.
+            self._journal_capture(old)
+            seq = self._journal_capture(new)
+        self._journal_ack(seq)
+        return True
 
     def names(self, pattern: Optional[str] = None) -> list[str]:
         with self.lock:
@@ -196,7 +331,9 @@ class GridStore:
             e.expire_at = time.time() + ttl_s
             self._note_invalidate(name)
             self._ensure_sweeper()
-            return True
+            seq = self._journal_capture(name)
+        self._journal_ack(seq)
+        return True
 
     def expire_at(self, name: str, epoch_s: float) -> bool:
         with self.lock:
@@ -206,7 +343,9 @@ class GridStore:
             e.expire_at = float(epoch_s)
             self._note_invalidate(name)
             self._ensure_sweeper()
-            return True
+            seq = self._journal_capture(name)
+        self._journal_ack(seq)
+        return True
 
     def clear_expire(self, name: str) -> bool:
         with self.lock:
@@ -215,7 +354,9 @@ class GridStore:
                 return False
             e.expire_at = None
             self._note_invalidate(name)
-            return True
+            seq = self._journal_capture(name)
+        self._journal_ack(seq)
+        return True
 
     def peek_expire_at(self, name: str):
         """The entry's TTL deadline (or None) WITHOUT reaping — the
@@ -272,10 +413,15 @@ class GridStore:
     # Values reference blobs by index.  Persisted kinds: bucket,
     # binarystream, set, setcache, zset, lexset, map, mapcache, list
     # (queues/deques share it), ringbuffer, atomic counters/adders,
-    # idgenerator.  NOT persisted (skipped with a summary warning):
-    # coordination state (locks, latches, semaphores), streams, delayed/
-    # priority queues, geo, timeseries, multimaps, and sortedset (its
-    # in-memory order is codec-decoded, which the store cannot rebuild).
+    # idgenerator, stream (entries + consumer groups/PELs — the
+    # replication stream needs full stream state, ISSUE 18).  NOT
+    # persisted (skipped with a summary warning): coordination state
+    # (locks, latches, semaphores), delayed/priority queues, geo,
+    # timeseries, multimaps, and sortedset (its in-memory order is
+    # codec-decoded, which the store cannot rebuild).  The same codec
+    # backs per-mutation ``grid.state`` journal records — an
+    # unsupported kind is skipped in BOTH tiers, so replicas and
+    # recovery stay consistent with snapshots.
     # ----------------------------------------------------------------------
 
     _SNAP_MAGIC = b"RTPG"
@@ -330,6 +476,35 @@ class GridStore:
                 "cap": value["cap"],
                 "m": [add_blob(vb) for vb in value["items"]],
             }
+        if kind == "stream":
+            # Full _StreamValue state incl. consumer groups and PELs —
+            # required by the replication stream (ISSUE 18): XADD on a
+            # primary must materialize on its replicas.
+            rows = [
+                [ms, sq,
+                 [[add_blob(fk), add_blob(fv)] for fk, fv in fields.items()]]
+                for (ms, sq), fields in value.entries.items()
+            ]
+            groups = [
+                {
+                    "n": gname,
+                    "ld": list(g["last_delivered"]),
+                    "p": [
+                        [ms, sq, p["consumer"], p["time_ms"], p["count"]]
+                        for (ms, sq), p in g["pending"].items()
+                    ],
+                    "c": sorted(g["consumers"]),
+                }
+                for gname, g in value.groups.items()
+            ]
+            return {
+                "t": "stream",
+                "m": rows,
+                "last": list(value.last_id),
+                "maxdel": list(value.max_deleted_id),
+                "added": value.added,
+                "g": groups,
+            }
         return None
 
     @staticmethod
@@ -372,6 +547,33 @@ class GridStore:
             return {"next": int(desc["next"]), "block": int(desc["block"])}
         if t == "ring":
             return {"cap": int(desc["cap"]), "items": [blobs[i] for i in desc["m"]]}
+        if t == "stream":
+            from redisson_tpu.grid.streams import _StreamValue
+
+            v = _StreamValue()
+            v.entries = {
+                (int(ms), int(sq)): {blobs[ki]: blobs[vi] for ki, vi in fm}
+                for ms, sq, fm in desc["m"]
+            }
+            v.last_id = tuple(int(x) for x in desc["last"])
+            v.max_deleted_id = tuple(int(x) for x in desc["maxdel"])
+            v.added = int(desc["added"])
+            v.groups = {
+                g["n"]: {
+                    "last_delivered": tuple(int(x) for x in g["ld"]),
+                    "pending": {
+                        (int(ms), int(sq)): {
+                            "consumer": cons,
+                            "time_ms": int(tms),
+                            "count": int(cnt),
+                        }
+                        for ms, sq, cons, tms, cnt in g["p"]
+                    },
+                    "consumers": set(g["c"]),
+                }
+                for g in desc["g"]
+            }
+            return v
         raise ValueError(f"unknown grid snapshot value type {t!r}")
 
     def snapshot_to(self, path: str) -> int:

@@ -15,7 +15,7 @@ import threading
 import time
 from typing import Any, Iterable, Optional
 
-from redisson_tpu.grid.base import GridObject
+from redisson_tpu.grid.base import GridObject, journaled
 
 
 def _parse_id(s, *, default_seq: int = 0) -> tuple[int, int]:
@@ -46,6 +46,8 @@ class _StreamValue:
         self.added = 0  # entries-added counter (XINFO entries-added)
 
 
+@journaled("add", "trim", "remove", "create_group", "remove_group",
+           "read_group", "ack", "claim", "auto_claim")
 class Stream(GridObject):
     KIND = "stream"
 

@@ -293,6 +293,47 @@ class Observability:
             "candidate keys currently monitored by the space-saving "
             "top-k",
             _lm.tracked_keys)
+        # Replication + failover plane (ISSUE 18): stream/ack volume
+        # counters plus offset/lag gauges.  The gauges read through
+        # source callables the RESP door wires once the replication hub
+        # or replica link exists — 0.0 until then, so the families are
+        # present (and doc-tabled) on every process regardless of role.
+        self.repl_acks = r.counter(
+            "rtpu_repl_acks",
+            "REPLCONF ACK frames accepted from replicas (primary side)")
+        self.repl_fullresyncs = r.counter(
+            "rtpu_repl_fullresyncs",
+            "full resynchronizations served (snapshot + stream tail "
+            "bootstrap) or performed (replica side)")
+        self.repl_partial_resyncs = r.counter(
+            "rtpu_repl_partial_resyncs",
+            "partial resynchronizations (PSYNC CONTINUE on a matching "
+            "replication id + backlog-covered offset)")
+        self.repl_stream_records = r.counter(
+            "rtpu_repl_stream_records",
+            "journal records applied from the replication stream "
+            "(replica side)")
+        self.failover_elections = r.counter(
+            "rtpu_failover_elections",
+            "failover elections this node started as a candidate")
+        self.failover_takeovers = r.counter(
+            "rtpu_failover_takeovers",
+            "slot takeovers this node performed after winning an "
+            "election (or via manual FAILOVER promotion)")
+        self.repl_offset_source = None  # wired by the RESP door
+        self.repl_lag_source = None
+        r.gauge_callback(
+            "rtpu_repl_offset",
+            "replication offset: journal head seq on a primary, last "
+            "applied stream seq on a replica",
+            lambda: float(self.repl_offset_source())
+            if self.repl_offset_source is not None else 0.0)
+        r.gauge_callback(
+            "rtpu_repl_lag_ops",
+            "replica staleness in journal records (master_offset - "
+            "applied; 0 on a primary)",
+            lambda: float(self.repl_lag_source())
+            if self.repl_lag_source is not None else 0.0)
 
     # -- instrumentation helpers (one call per batch, never per op) --------
 

@@ -71,6 +71,11 @@ _DETACH = frozenset(_PIPELINE_STOP) | frozenset((
     # toward each other would stall each other's loops); CLUSTER's
     # GETKEYSINSLOT/COUNTKEYSINSLOT scan the full keyspace.
     b"MIGRATE", b"CLUSTER",
+    # Replication stream (ISSUE 18): REPLFETCH long-polls (parks up to
+    # its timeout-ms when the replica is caught up) and PSYNC's
+    # FULLRESYNC branch takes a whole snapshot — both would freeze the
+    # event loop inline.
+    b"RTPU.PSYNC", b"RTPU.REPLFETCH",
 ))
 
 # Per-tick bounds: commands taken from one connection, commands in one

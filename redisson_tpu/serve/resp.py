@@ -98,6 +98,12 @@ _ERROR_CODES = (
     # Per-core front door (ISSUE 17): a broken in-node handoff leg
     # surfaces with its own code so clients can retry-distinguish it.
     "HANDOFFBROKEN",
+    # Replication + failover (ISSUE 18): NOJOURNAL = the primary has no
+    # journal to stream; NOBACKLOG = the requested offset fell off every
+    # retention tier (replica must FULLRESYNC); READONLY = write against
+    # a replica (verbatim Redis code); STALEREAD = the bounded-staleness
+    # gate refused a replica read whose lag exceeds the configured bound.
+    "NOJOURNAL", "NOBACKLOG", "READONLY", "STALEREAD",
 )
 
 # Commands whose bodies execute arbitrary Python server-side; gated
@@ -132,6 +138,12 @@ _SHED_EXEMPT = frozenset((
     # Load-attribution plane (ISSUE 16): HOTKEYS is how an operator
     # finds the key causing the overload being shed.
     "HOTKEYS",
+    # Replication + failover plane (ISSUE 18): the stream, the acks,
+    # and the cluster bus must keep flowing DURING an overload — a shed
+    # replication fetch turns node pressure into replica lag, and a
+    # shed CLUSTERPING turns it into a spurious failover.
+    "REPLCONF", "RTPU.PSYNC", "RTPU.REPLFETCH", "RTPU.CLUSTERPING",
+    "RTPU.FAILOVER.AUTH", "RTPU.TAKEOVER", "FAILOVER",
 ))
 
 # -- front-door vectorization tables (ISSUE 6 tentpole) ----------------------
@@ -169,6 +181,11 @@ _NONMUTATING = frozenset((
     "TIME", "COMMAND", "CLIENT", "INFO", "SLOWLOG", "WAIT", "AUTH",
     "HELLO", "QUIT", "SAVE", "BGSAVE", "LASTSAVE", "BGREWRITEAOF",
     "ASKING", "LATENCY", "TRACE", "MONITOR", "RTPU.TRACE", "HOTKEYS",
+    # Replication plane (ISSUE 18): stream/ack/bus verbs never change a
+    # keyspace-read result on THIS node (a replica's keyspace changes
+    # through the apply path, not through the dispatched verb).
+    "REPLCONF", "RTPU.PSYNC", "RTPU.REPLFETCH", "RTPU.CLUSTERPING",
+    "RTPU.FAILOVER.AUTH",
 ))
 
 # Response-CACHEABLE subset: deterministic pure keyspace reads whose
@@ -198,6 +215,16 @@ _GET_RUN = frozenset((b"GET", b"MGET"))
 # Bound on ops one fused run may carry (memory + keeps fused launches in
 # the pre-warmed bucket ladder; a longer run simply splits).
 _RUN_MAX_OPS = 1 << 14
+
+# Commands a READ-ONLY replica still serves beyond the _NONMUTATING
+# read surface (ISSUE 18): admin/topology/replication control.  NOT the
+# write surface — a replica's keyspace mutates only through its
+# replication link, or the -READONLY contract (and the no-dual-primary
+# invariant it underwrites) is fiction.
+_REPLICA_ADMIN = frozenset((
+    "CONFIG", "DEBUG", "CLUSTER", "REPLCONF", "SHUTDOWN", "RESET",
+    "MULTI", "EXEC", "DISCARD", "SUBSCRIBE", "UNSUBSCRIBE", "FAILOVER",
+))
 
 # One-shot connection licenses (the RT012 class): per-connection flags a
 # prelude command grants for EXACTLY the next command — cluster ASKING
@@ -492,6 +519,11 @@ class _ConnCtx:
         # no-proxy-loops invariant), skip auth (the unix socket lives in
         # a mode-0700 rundir), and are exempt from the idle sweep.
         self.is_peer = False
+        # Replication (ISSUE 18): set by REPLCONF IDENT — this
+        # connection belongs to a replica with that id; its ACKs land in
+        # the hub's per-replica table under this name.
+        self.repl_ident: Optional[str] = None
+        self.repl_listening_port = 0
 
     def _kill(self) -> None:
         try:
@@ -847,6 +879,23 @@ class RespServer:
                 self.obs.frontdoor_worker_index.set((), float(self._fd_index))
             except AttributeError:
                 pass  # obs bundle predates the frontdoor families
+        # Replication plane (ISSUE 18 tentpole): the primary-side hub
+        # (journal tap → backlog ring → RTPU.REPLFETCH) exists whenever
+        # a journal does — a node is a streaming-capable primary by
+        # default.  `replica_link` is set when THIS node replicates from
+        # a primary (config.replica_of or start_replication_from); the
+        # link's presence IS the role bit (role:slave, -READONLY gate,
+        # bounded-staleness refusals).  `failover` is the cluster-bus
+        # agent (cluster/failover.py) when armed.
+        self.repl_hub = None
+        self.replica_link = None
+        self.failover = None
+        self._repl_hub()  # eager when the journal is already attached
+        self._obs_wire_repl_gauges()
+        master = getattr(client.config, "replica_of", None)
+        if master:
+            host_m, _, port_m = str(master).rpartition(":")
+            self.start_replication_from(host_m, int(port_m))
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="rtpu-resp-accept", daemon=True
         )
@@ -1049,6 +1098,19 @@ class RespServer:
                 if remaining <= 0:
                     break
                 self._conn_idle.wait(timeout=remaining)
+        # Replication plane down BEFORE the client engine can shut down
+        # under it: the link thread applies into the engine, the
+        # failover agent dials peers, the hub taps the journal.
+        fo = getattr(self, "failover", None)
+        if fo is not None:
+            fo.stop()
+        link = getattr(self, "replica_link", None)
+        if link is not None:
+            self.replica_link = None
+            link.stop()
+        hub = getattr(self, "repl_hub", None)
+        if hub is not None:
+            hub.detach()
         # Reactors stop AFTER the drain: they are the threads that
         # observe the shutdowns above and tear each connection down.
         if self.reactor is not None:
@@ -2133,6 +2195,29 @@ class RespServer:
             # Pre-auth surface is AUTH/HELLO/QUIT/RESET, like Redis
             # (pooled clients RESET connections before authenticating).
             raise RespError("NOAUTH Authentication required.")
+        link = self.replica_link
+        if link is not None and not ctx.is_peer:
+            # Replica role (ISSUE 18): reads-only.  Writes arrive solely
+            # over the replication link — a client write accepted here
+            # would fork this replica's history from its primary's.
+            if (name not in _NONMUTATING and name not in _REPLICA_ADMIN
+                    and not name.startswith("RTPU.")):
+                raise RespError(
+                    "READONLY You can't write against a read only replica."
+                )
+            bound = int(getattr(
+                self._client.config, "repl_max_staleness_ops", 0
+            ) or 0)
+            if (bound > 0 and name in _NONMUTATING
+                    and link.lag_ops() > bound and _command_keys(cmd)):
+                # Bounded staleness: a keyed read on a replica that has
+                # fallen more than the configured op count behind is
+                # refused (retryable) instead of served silently stale.
+                raise RespError(
+                    f"STALEREAD replica is {link.lag_ops()} ops behind "
+                    f"its primary (bound {bound}); retry or read the "
+                    "primary"
+                )
         if name in _SCRIPT_CMDS and not self._scripts_enabled:
             # Script bodies are Python: gated off by default (see
             # __init__).  Checked at dispatch so MULTI-queued scripts hit
@@ -2833,14 +2918,19 @@ class RespServer:
         forces an fsync covering every record appended so far — under
         any appendfsync policy — and blocks (up to the command's
         timeout-ms argument) until it lands.  A client that issues
-        writes then WAIT gets local durability even under everysec/no."""
+        writes then WAIT gets local durability even under everysec/no.
+
+        With replicas attached (ISSUE 18), WAIT <numreplicas> is a REAL
+        replica-ack fence: after the local fsync it blocks until that
+        many replicas have ``REPLCONF ACK``ed an offset covering every
+        record appended so far, and replies with the count that did."""
         eng = getattr(self._client, "_engine", None)
         fence = getattr(eng, "journal_fence", None)
+        timeout_s = None
+        if len(args) >= 2:
+            ms = int(args[1])
+            timeout_s = ms / 1000.0 if ms > 0 else None
         if fence is not None:
-            timeout_s = None
-            if len(args) >= 2:
-                ms = int(args[1])
-                timeout_s = ms / 1000.0 if ms > 0 else None
             from redisson_tpu.durability import JournalError
 
             t0 = time.perf_counter()
@@ -2860,7 +2950,374 @@ class RespServer:
                 tctx.tracer.record_span(
                     tctx, "journal_fsync_fence", time.time() - dur, dur,
                 )
-        return _encode_int(0)
+        hub = self._repl_hub()
+        if hub is None:
+            return _encode_int(0)
+        # Fence offset: everything appended up to now.  Captured AFTER
+        # the fsync fence — records appended while we waited are the
+        # next WAIT's problem, exactly Redis's WAIT contract.
+        fence_seq = hub.journal.last_seq()
+        numreplicas = int(args[0]) if args else 0
+        if numreplicas <= 0:
+            return _encode_int(hub.count_acked(fence_seq))
+        return _encode_int(hub.wait_acked(
+            fence_seq, numreplicas,
+            timeout_s if timeout_s is not None else float("inf"),
+        ))
+
+    # -- replication plane (ISSUE 18 tentpole) -----------------------------
+
+    def _repl_hub(self):
+        """The primary-side ReplicationHub over the CURRENT journal —
+        rebuilt when the journal object changes (a ``CONFIG SET
+        appendonly`` re-attach or a promotion makes a NEW lineage: a
+        fresh repl_id, so stale offsets can never partial-resync
+        against a different history)."""
+        eng = getattr(self._client, "_engine", None)
+        j = getattr(eng, "journal", None)
+        hub = self.repl_hub
+        if j is None:
+            if hub is not None:
+                hub.detach()
+                self.repl_hub = None
+            return None
+        if hub is None or hub.journal is not j:
+            from redisson_tpu.durability.replication import ReplicationHub
+
+            if hub is not None:
+                hub.detach()
+            hub = self.repl_hub = ReplicationHub(
+                j, obs=self.obs,
+                backlog_bytes=int(getattr(
+                    self._client.config, "repl_backlog_bytes", 4 << 20
+                ) or (4 << 20)),
+            )
+        return hub
+
+    def _repl_offset(self) -> int:
+        """This node's replication offset: a replica reports what it
+        APPLIED; a primary reports its journal head."""
+        link = self.replica_link
+        if link is not None:
+            return int(link.applied)
+        eng = getattr(self._client, "_engine", None)
+        j = getattr(eng, "journal", None)
+        return int(j.last_seq()) if j is not None else 0
+
+    def _repl_lag(self) -> int:
+        link = self.replica_link
+        return int(link.lag_ops()) if link is not None else 0
+
+    def _obs_wire_repl_gauges(self) -> None:
+        obs = self.obs
+        if obs is None:
+            return
+        try:
+            obs.repl_offset_source = self._repl_offset
+            obs.repl_lag_source = self._repl_lag
+        except AttributeError:
+            pass  # obs bundle predates the replication families
+
+    def start_replication_from(self, host: str, port: int,
+                               ident: Optional[str] = None,
+                               replid: Optional[str] = None):
+        """Turn this node into a replica of ``host:port``: start the
+        pull link (durability/replica.py).  The link's existence flips
+        the role to ``slave`` — the -READONLY gate and the bounded-
+        staleness refusals in _dispatch key off it."""
+        from redisson_tpu.durability.replica import ReplicaLink
+
+        if ident is None:
+            if self.cluster is not None:
+                ident = self.cluster.myid
+            else:
+                import uuid
+
+                ident = uuid.uuid4().hex[:16]
+        cfg = self._client.config
+        link = ReplicaLink(
+            self._client, host, int(port), ident,
+            listening_port=self.port, obs=self.obs,
+            batch=int(getattr(cfg, "repl_fetch_batch", 512) or 512),
+            poll_timeout_ms=int(
+                getattr(cfg, "repl_poll_timeout_ms", 500) or 500
+            ),
+            replid=replid or getattr(cfg, "_repl_bootstrap_id", None),
+        )
+        self.replica_link = link
+        link.start()
+        return link
+
+    def promote_to_primary(self, epoch: int = 0) -> None:
+        """Failover takeover: stop applying the (dead) primary's
+        stream, snapshot the promoted state — the local journal was
+        EMPTY while replicating (the apply path never re-journals), so
+        the snapshot is what makes this node's own crash recovery
+        self-contained — and start a fresh replication lineage for the
+        replicas that will re-home here."""
+        link, self.replica_link = self.replica_link, None
+        if link is not None:
+            link.stop()
+        eng = getattr(self._client, "_engine", None)
+        sdir = getattr(getattr(eng, "config", None), "snapshot_dir", None)
+        if sdir and hasattr(eng, "snapshot"):
+            try:
+                self._client.snapshot(sdir)
+            except Exception:  # pragma: no cover — promotion never fails
+                pass           # on snapshot IO; LASTSAVE surfaces it
+        hub = self.repl_hub
+        if hub is not None:
+            # Fresh lineage: replicas of the dead primary carry ITS
+            # repl_id, which never matches a rebuilt hub — they full-
+            # resync against the promoted state instead of splicing
+            # foreign offsets into this journal.
+            hub.detach()
+            self.repl_hub = None
+        self._repl_hub()
+        self._promote_epoch = int(epoch)
+        if self.obs is not None:
+            try:
+                self.obs.failover_takeovers.inc((), 1)
+            except AttributeError:
+                pass
+
+    def _cmdctx_REPLCONF(self, args, ctx: "_ConnCtx"):
+        if not args:
+            raise RespError(
+                "wrong number of arguments for 'replconf' command"
+            )
+        sub = args[0].decode("latin-1", "replace").upper()
+        if sub == "IDENT":
+            # REPLCONF IDENT <replica-id> [listening-port] — names this
+            # connection's replica so its ACKs land in the hub table.
+            if len(args) < 2:
+                raise RespError(
+                    "REPLCONF IDENT <replica-id> [listening-port]"
+                )
+            ctx.repl_ident = self._s(args[1])
+            if len(args) > 2:
+                ctx.repl_listening_port = int(args[2])
+            return _encode_simple("OK")
+        if sub == "LISTENING-PORT":
+            ctx.repl_listening_port = int(args[1])
+            return _encode_simple("OK")
+        if sub == "ACK":
+            offset = int(args[1])
+            if chaos.ENABLED:
+                try:
+                    chaos.fire("repl.ack", {"offset": offset})
+                except (chaos.FaultInjected, chaos.CorruptionDetected):
+                    # A dropped/garbled ack is LOST, not an error — the
+                    # replica's next ack supersedes it (acks are
+                    # max-merged).  WAIT fences simply see it later.
+                    return _encode_simple("OK")
+            hub = self._repl_hub()
+            if hub is not None and ctx.repl_ident:
+                addr = ctx.addr
+                if ctx.repl_listening_port and ":" in addr:
+                    addr = "%s:%d" % (
+                        addr.rsplit(":", 1)[0], ctx.repl_listening_port
+                    )
+                hub.ack(ctx.repl_ident, offset, addr=addr)
+            return _encode_simple("OK")
+        if sub == "GETACK":
+            return _encode_simple("OK")
+        raise RespError(f"Unknown REPLCONF subcommand {sub}")
+
+    def _snapshot_tar(self) -> tuple:
+        """FULLRESYNC payload: take a REAL durable snapshot into the
+        configured snapshot_dir (engine.snapshot retires journal
+        segments, so shipping a temp-dir snapshot would break THIS
+        node's crash recovery), then tar the directory.  Returns
+        (snapshot's journal cut, tar bytes)."""
+        import io
+        import json
+        import os
+        import tarfile
+
+        eng, sdir = self._persist_engine()
+        self._client.snapshot(sdir)
+        # Exclude concurrent snapshots (BGSAVE / the periodic
+        # snapshotter) while reading meta + taring, so the cut seq and
+        # the files describe the SAME capture.
+        lock = getattr(eng, "_snapshot_lock", None)
+        if lock is not None:
+            lock.acquire()
+        try:
+            snap_seq = 0
+            meta_path = os.path.join(sdir, "sketch_meta.json")
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    snap_seq = int(json.load(f).get("journal_seq") or 0)
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w") as tf:
+                for fn in sorted(os.listdir(sdir)):
+                    if fn.endswith(".tmp"):
+                        continue  # a concurrent write's scratch files
+                    tf.add(os.path.join(sdir, fn), arcname=fn)
+        finally:
+            if lock is not None:
+                lock.release()
+        return snap_seq, buf.getvalue()
+
+    def _cmd_RTPU_PSYNC(self, args):
+        """RTPU.PSYNC <replid|?> <offset> → [CONTINUE, replid] when the
+        (lineage, offset) can partial-resync, else [FULLRESYNC, replid,
+        snap_seq, snapshot-tar]."""
+        if len(args) < 2:
+            raise RespError("RTPU.PSYNC <replid|?> <offset>")
+        hub = self._repl_hub()
+        if hub is None:
+            raise RespError(
+                "NOJOURNAL replication requires the op journal "
+                "(set Config.journal_dir / appendonly yes)"
+            )
+        replid = self._s(args[0])
+        offset = int(args[1])
+        if replid != "?" and hub.can_continue(replid, offset):
+            hub.note_partial_resync()
+            return b"".join([
+                b"*2\r\n", _encode_bulk(b"CONTINUE"),
+                _encode_bulk(hub.repl_id.encode()),
+            ])
+        hub.note_full_resync()
+        snap_seq, tar_bytes = self._snapshot_tar()
+        return b"".join([
+            b"*4\r\n", _encode_bulk(b"FULLRESYNC"),
+            _encode_bulk(hub.repl_id.encode()),
+            _encode_int(snap_seq), _encode_bulk(tar_bytes),
+        ])
+
+    def _cmd_RTPU_REPLFETCH(self, args):
+        """RTPU.REPLFETCH <after> [maxn] [timeout-ms] → [replid,
+        master_offset, [[seq, crc, payload], ...]] — the stream's pull
+        verb.  Long-polls up to timeout-ms when the replica is caught
+        up (reactor detaches it like the other blocking commands)."""
+        if not args:
+            raise RespError("RTPU.REPLFETCH <after> [maxn] [timeout-ms]")
+        hub = self._repl_hub()
+        if hub is None:
+            raise RespError(
+                "NOJOURNAL replication requires the op journal "
+                "(set Config.journal_dir / appendonly yes)"
+            )
+        after = int(args[0])
+        maxn = int(args[1]) if len(args) > 1 else 512
+        timeout_ms = int(args[2]) if len(args) > 2 else 0
+        corrupt = False
+        if chaos.ENABLED:
+            try:
+                chaos.fire("repl.stream", {"after": after})
+            except chaos.FaultInjected:
+                # Dropped batch: the replica sees an empty fetch and
+                # re-polls — lost FRAMES are a latency event, never a
+                # lost write (the journal retains them).
+                return b"".join([
+                    b"*3\r\n", _encode_bulk(hub.repl_id.encode()),
+                    _encode_int(hub.journal.last_seq()), b"*0\r\n",
+                ])
+            except chaos.CorruptionDetected:
+                corrupt = True  # flip a byte in one OUTGOING payload
+        status, frames = hub.fetch(
+            after, max_n=maxn, timeout_s=max(0, timeout_ms) / 1000.0
+        )
+        if status != "CONTINUE":
+            raise RespError(
+                "NOBACKLOG offset fell off the replication backlog; "
+                "FULLRESYNC required"
+            )
+        if corrupt and frames:
+            seq0, crc0, payload0 = frames[0]
+            garbled = bytearray(payload0)
+            garbled[len(garbled) // 2] ^= 0x40
+            frames = [(seq0, crc0, bytes(garbled))] + list(frames[1:])
+        out = [
+            b"*3\r\n", _encode_bulk(hub.repl_id.encode()),
+            _encode_int(hub.journal.last_seq()),
+            b"*%d\r\n" % len(frames),
+        ]
+        for seq, crc, payload in frames:
+            out.append(b"*3\r\n")
+            out.append(_encode_int(seq))
+            out.append(_encode_int(crc))
+            out.append(_encode_bulk(payload))
+        return b"".join(out)
+
+    def _cmd_FAILOVER(self, args):
+        """Manual FAILOVER (operator surface): on a replica, promote it
+        to primary immediately (FAILOVER TAKEOVER semantics)."""
+        if self.replica_link is None:
+            raise RespError("FAILOVER requires a replica role")
+        self.promote_to_primary(
+            epoch=self.failover.state.current_epoch + 1
+            if self.failover is not None else 0
+        )
+        return _encode_simple("OK")
+
+    def _cmd_RTPU_CLUSTERPING(self, args):
+        """Cluster bus liveness probe: RTPU.CLUSTERPING <sender-id>
+        <sender-epoch> → [PONG, myid, epoch, offset, role].  Answered
+        by every node (armed or not) — liveness is the point."""
+        sender = self._s(args[0]) if args else ""
+        epoch = int(args[1]) if len(args) > 1 else 0
+        fo = self.failover
+        my_epoch = epoch
+        if fo is not None:
+            # A ping from a peer proves the PEER is alive too.
+            my_epoch = fo.state.note_ping(sender, epoch, time.monotonic())
+        myid = self.cluster.myid if self.cluster is not None else ""
+        role = "slave" if self.replica_link is not None else "master"
+        return _encode_array([
+            b"PONG", myid.encode(), int(my_epoch),
+            int(self._repl_offset()), role.encode(),
+        ])
+
+    def _cmd_RTPU_FAILOVER_AUTH(self, args):
+        """Election vote request: RTPU.FAILOVER.AUTH <candidate-id>
+        <epoch> <failed-primary-id> → :1 granted / :0 denied.  Only a
+        PRIMARY holding a failover agent may grant, at most once per
+        epoch (the no-dual-primary invariant's load-bearing rule)."""
+        if len(args) < 3:
+            raise RespError(
+                "RTPU.FAILOVER.AUTH <candidate-id> <epoch> <failed-id>"
+            )
+        fo = self.failover
+        if fo is None or self.replica_link is not None:
+            return _encode_int(0)
+        granted = fo.state.grant_vote(
+            self._s(args[0]), int(args[1]), self._s(args[2])
+        )
+        return _encode_int(1 if granted else 0)
+
+    def _cmd_RTPU_TAKEOVER(self, args):
+        """Takeover broadcast: RTPU.TAKEOVER <new-primary-id>
+        <old-primary-id> <epoch> [ranges] — reassign the claimed slots
+        to the new primary, per-slot epoch-gated (a STALE takeover from
+        a lost election must never un-assign a newer one).  ``ranges``
+        is the winner's explicit claim ("0-100,200-300"); without it
+        the receiver falls back to whatever ITS map still shows the old
+        primary owning (pre-claim wire compatibility)."""
+        if len(args) < 3:
+            raise RespError(
+                "RTPU.TAKEOVER <new-id> <old-id> <epoch> [ranges]"
+            )
+        new_id, old_id = self._s(args[0]), self._s(args[1])
+        epoch = int(args[2])
+        if self.cluster is None:
+            raise RespError("This instance has cluster support disabled")
+        slots = None
+        if len(args) > 3 and args[3]:
+            slots = []
+            for part in self._s(args[3]).split(","):
+                a, _, b = part.partition("-")
+                slots.append([int(a), int(b or a)])
+        moved = self.cluster.slotmap.apply_takeover(
+            old_id, new_id, epoch, slots=slots
+        )
+        fo = self.failover
+        if fo is not None:
+            fo.state.note_takeover(new_id, old_id, epoch)
+        return _encode_int(moved)
 
     # -- persistence commands (ISSUE 10): SAVE family goes live -----------
 
@@ -3826,9 +4283,9 @@ class RespServer:
     # (they can be wide); 'INFO all'/'everything' or the explicit section
     # name includes them.
     _INFO_DEFAULT = (
-        "server", "clients", "memory", "stats", "persistence", "nearcache",
-        "frontdoor", "overload", "cluster", "telemetry", "loadstats",
-        "keyspace",
+        "server", "clients", "memory", "stats", "persistence",
+        "replication", "nearcache", "frontdoor", "overload", "cluster",
+        "telemetry", "loadstats", "keyspace",
     )
 
     def _cmd_INFO(self, args):
@@ -3970,6 +4427,60 @@ class RespServer:
                         f"aof_broken:{1 if st['broken'] else 0}",
                         f"aof_replayed_records:"
                         f"{0 if obs is None else int(sum(c.value for _, c in obs.journal_replayed.items()))}",
+                    ]
+            elif s == "replication":
+                # Replication plane (ISSUE 18): role + offsets on BOTH
+                # ends — a primary lists its replicas' acked offsets
+                # (the WAIT fence's inputs), a replica its applied
+                # offset, link state and lag (the staleness bound's
+                # input).  Redis vocabulary where one exists.
+                link = self.replica_link
+                lines += [
+                    "# Replication",
+                    "role:%s" % ("slave" if link is not None else "master"),
+                ]
+                if link is not None:
+                    lines += [
+                        f"master_host:{link.master_host}",
+                        f"master_port:{link.master_port}",
+                        "master_link_status:%s" % (
+                            "up" if link.link_up else "down"
+                        ),
+                        f"master_replid:{link.replid or '-'}",
+                        f"slave_repl_offset:{link.applied}",
+                        f"master_repl_offset:{link.master_offset}",
+                        f"slave_lag_ops:{link.lag_ops()}",
+                        "slave_read_only:1",
+                        f"slave_full_resyncs:{link.full_resyncs}",
+                        f"slave_partial_resyncs:{link.partial_resyncs}",
+                        "connected_slaves:0",
+                    ]
+                else:
+                    hub = self._repl_hub()
+                    rows = hub.replica_rows() if hub is not None else []
+                    lines.append(f"connected_slaves:{len(rows)}")
+                    head = self._repl_offset()
+                    for i, (rid, addr, offset, age_s) in enumerate(rows):
+                        ip, _, rport = (addr or ":0").rpartition(":")
+                        lines.append(
+                            f"slave{i}:ip={ip},port={rport},"
+                            f"state=online,offset={offset},"
+                            f"lag={age_s:.3f},id={rid}"
+                        )
+                    lines += [
+                        "master_replid:%s" % (
+                            hub.repl_id if hub is not None else "-"
+                        ),
+                        f"master_repl_offset:{head}",
+                        "repl_backlog_active:%d" % (
+                            0 if hub is None else 1
+                        ),
+                        "repl_full_resyncs:%d" % (
+                            0 if hub is None else hub.fullresyncs
+                        ),
+                        "repl_partial_resyncs:%d" % (
+                            0 if hub is None else hub.partial_resyncs
+                        ),
                     ]
             elif s == "nearcache":
                 # Sketch near cache (ISSUE 4): the epoch-guarded host
@@ -4482,7 +4993,8 @@ class RespServer:
                 frames.append(_encode_bulk("nodes"))
                 frames.append(b"*1\r\n" + _encode_array([
                     b"id", nid.encode(), b"endpoint", host.encode(),
-                    b"port", port, b"role", b"master",
+                    b"port", port,
+                    b"role", door.slotmap.role(nid).encode(),
                 ]))
             return b"".join(frames)
         if sub == "NODES":
@@ -4494,9 +5006,12 @@ class RespServer:
                     for a, b in door.slotmap.ranges(nid)
                 )
                 me = ",myself" if nid == door.myid else ""
+                role = door.slotmap.role(nid)
+                flag = "master" if role == "master" else "slave"
+                primary = door.slotmap.replica_of(nid) or "-"
                 lines.append(
-                    f"{nid} {host}:{port}@{port} master{me} - 0 0 0 "
-                    f"connected {slots}".rstrip()
+                    f"{nid} {host}:{port}@{port} {flag}{me} {primary} "
+                    f"0 0 0 connected {slots}".rstrip()
                 )
             return _encode_bulk("\n".join(lines) + "\n")
         if sub == "SETSLOT":
@@ -5420,7 +5935,8 @@ class RespServer:
             (b"proto", ctx.proto),
             (b"id", 1),
             (b"mode", b"standalone"),
-            (b"role", b"master"),
+            (b"role",
+             b"slave" if self.replica_link is not None else b"master"),
             (b"modules", []),
         ]
         if ctx.proto == 3:

@@ -1,0 +1,233 @@
+"""Automatic failover (ISSUE 18): failure-detection + election state
+units, the epoch-gated takeover slot math, and the slow kill -9
+supervisor soak — a real 3-primary × 1-replica cluster loses a primary
+to SIGKILL under load and must promote, reconverge every client, and
+lose ZERO replica-acked writes.
+
+The election protocol's interleavings are modeled exhaustively in
+tests/test_netsim_failover.py; the single-link stream mechanics live
+in tests/test_repl_stream.py."""
+
+import time
+
+import pytest
+
+from redisson_tpu.cluster.failover import FailoverState
+from redisson_tpu.cluster.slotmap import SlotMap
+from redisson_tpu.cluster.slots import NSLOTS
+
+
+def _map(n_primaries=3, replicas=(("R1", "A"), ("R2", "A"))):
+    nodes = []
+    for i in range(n_primaries):
+        nid = chr(ord("A") + i)
+        nodes.append({
+            "id": nid, "host": f"h{i}", "port": 7000 + i,
+            "slots": [[0, NSLOTS - 1]] if i == 0 else [],
+        })
+    for j, (rid, parent) in enumerate(replicas):
+        nodes.append({
+            "id": rid, "host": f"r{j}", "port": 7100 + j, "slots": [],
+            "role": "replica", "replica_of": parent,
+        })
+    return SlotMap.from_dict({"nodes": nodes})
+
+
+class TestFailureDetection:
+    def test_timeout_marks_failed_and_pong_revives(self):
+        sm = _map()
+        st = FailoverState("B", sm, node_timeout=1.0)
+        assert st.check_timeouts(now=10.0) == []  # first sight = grace
+        newly = st.check_timeouts(now=11.5)
+        assert set(newly) == {"A", "C", "R1", "R2"}
+        assert st.is_failed("A")
+        # A PONG un-fails and restarts the clock.
+        st.note_pong("A", now=12.0)
+        assert not st.is_failed("A")
+        assert st.check_timeouts(now=12.5) == []
+        assert st.check_timeouts(now=13.5) == ["A"]
+
+    def test_never_marks_self(self):
+        sm = _map()
+        st = FailoverState("B", sm, node_timeout=1.0)
+        st.check_timeouts(now=0.0)
+        st.check_timeouts(now=100.0)
+        assert not st.is_failed("B")
+
+    def test_note_ping_learns_cluster_epoch(self):
+        sm = _map()
+        st = FailoverState("B", sm, node_timeout=1.0)
+        assert st.note_ping("C", 7, now=1.0) == 7
+        assert st.current_epoch == 7
+        assert st.note_ping("C", 3, now=2.0) == 7  # monotonic max
+        assert not st.is_failed("C")
+
+
+class TestElectionRules:
+    def test_majority_is_over_all_primaries(self):
+        assert FailoverState("B", _map(3)).majority() == 2
+        assert FailoverState("B", _map(5)).majority() == 3
+        # 2 primaries: majority 2, but a dead primary leaves ONE live
+        # voter — automatic failover is impossible by design (the
+        # docs/clustering.md "needs >= 3 primaries" rule).
+        assert FailoverState("B", _map(2)).majority() == 2
+
+    def test_one_vote_per_epoch(self):
+        sm = _map()
+        st = FailoverState("B", sm, node_timeout=1.0)
+        st.mark_failed("A")
+        assert st.grant_vote("R1", 1, "A")
+        assert not st.grant_vote("R2", 1, "A"), "second grant in epoch 1"
+        assert not st.grant_vote("R2", 1, "A")
+        assert st.grant_vote("R2", 2, "A"), "a NEWER epoch votes again"
+        assert not st.grant_vote("R1", 2, "A")
+
+    def test_no_vote_while_primary_looks_alive(self):
+        st = FailoverState("B", _map(), node_timeout=1.0)
+        assert not st.grant_vote("R1", 1, "A"), "we still see A alive"
+        st.mark_failed("A")
+        assert st.grant_vote("R1", 2, "A")
+
+    def test_only_own_replicas_may_succeed(self):
+        sm = _map(replicas=(("R1", "A"), ("RB", "B")))
+        st = FailoverState("C", sm, node_timeout=1.0)
+        st.mark_failed("A")
+        assert not st.grant_vote("RB", 1, "A"), "RB replicates B, not A"
+        assert not st.grant_vote("B", 2, "A"), "a primary is no successor"
+        assert st.grant_vote("R1", 3, "A")
+
+    def test_start_election_bumps_epoch(self):
+        st = FailoverState("R1", _map(), node_timeout=1.0)
+        st.current_epoch = 4
+        assert st.start_election() == 5
+        assert st.start_election() == 6
+
+    def test_note_takeover_learns_epoch_and_revives_winner(self):
+        st = FailoverState("B", _map(), node_timeout=1.0)
+        st.mark_failed("R1")
+        st.note_takeover("R1", "A", 9)
+        assert st.current_epoch == 9
+        assert not st.is_failed("R1")
+
+
+class TestApplyTakeover:
+    def test_claimant_moves_slots_and_flips_roles(self):
+        sm = _map()
+        moved = sm.apply_takeover("A", "R1", 1)
+        assert moved == NSLOTS
+        assert sm.owner(0) == "R1" and sm.owner(NSLOTS - 1) == "R1"
+        assert sm.role("R1") == "master"
+        assert sm.role("A") == "replica"
+        assert sm.replica_of("A") == "R1"
+        assert sm.slot_epoch(0) == 1
+
+    def test_stale_broadcast_is_a_noop(self):
+        sm = _map()
+        assert sm.apply_takeover("A", "R1", 2) == NSLOTS
+        # A lost election's late broadcast (lower epoch) changes nothing
+        # — whether it names the old owner or carries explicit ranges.
+        assert sm.apply_takeover("A", "R2", 1) == 0
+        assert sm.apply_takeover(
+            "A", "R2", 1, slots=[[0, NSLOTS - 1]]
+        ) == 0
+        assert sm.owner(0) == "R1"
+
+    def test_explicit_claim_converges_regardless_of_order(self):
+        """The delivery-order contract (netsim's double-takeover
+        model): two successive-epoch claims over the same slots settle
+        on the HIGHER epoch whichever arrives last."""
+        claim = [[0, NSLOTS - 1]]
+        sm1 = _map()  # epoch 1 first, then epoch 2
+        sm1.apply_takeover("A", "R2", 1, slots=claim)
+        assert sm1.apply_takeover("A", "R1", 2, slots=claim) == NSLOTS
+        sm2 = _map()  # reversed delivery
+        sm2.apply_takeover("A", "R1", 2, slots=claim)
+        assert sm2.apply_takeover("A", "R2", 1, slots=claim) == 0
+        for sm in (sm1, sm2):
+            assert sm.owner(0) == "R1"
+            assert sm.slot_epoch(0) == 2
+
+    def test_partial_explicit_claim(self):
+        sm = _map()
+        assert sm.apply_takeover("A", "R1", 1, slots=[[0, 9]]) == 10
+        assert sm.owner(5) == "R1"
+        assert sm.owner(10) == "A"
+
+    def test_unknown_winner_is_refused(self):
+        sm = _map()
+        with pytest.raises(KeyError):
+            sm.apply_takeover("A", "nobody", 1)
+
+
+# -- the kill -9 soak (the CI failover-soak job's core) ----------------------
+
+
+@pytest.mark.slow
+def test_supervisor_kill9_primary_promotes_replica_no_acked_loss():
+    """3 primaries × 1 replica each.  Writes are fenced through WAIT 1
+    (replica-acked) on every primary, then primary 0 dies by SIGKILL.
+    Its replica must win the election and take over, every fenced
+    write must read back (zero acked-write loss), clients must
+    reconverge through the transition, and shutdown must leave no
+    orphan processes."""
+    from redisson_tpu.cluster.supervisor import (
+        ClusterSupervisor,
+        _request,
+    )
+
+    sup = ClusterSupervisor(
+        n_nodes=3, replicas_per_shard=1, node_timeout_ms=1000,
+        startup_timeout_s=180.0,
+    )
+    procs = None
+    try:
+        sup.start()
+        cc = sup.client()
+        try:
+            keys = {f"fo{i}": f"v{i}" for i in range(40)}
+            for k, v in keys.items():
+                assert cc.execute("SET", k, v) == b"OK"
+            # Fence: every primary has its replica's ack for the above.
+            for addr in sup.addrs:
+                (acked,) = _request(addr, [("WAIT", "1", "8000")])
+                assert acked == 1, f"{addr} never got a replica ack"
+
+            sup.kill_node(0)
+
+            # The replica must take over within a few node timeouts.
+            raddr = sup.replica_addrs[0]
+            deadline = time.monotonic() + 30.0
+            promoted = False
+            while time.monotonic() < deadline and not promoted:
+                try:
+                    (info,) = _request(raddr, [("INFO", "replication")])
+                    promoted = b"role:master" in info
+                except OSError:
+                    pass
+                if not promoted:
+                    time.sleep(0.25)
+            assert promoted, "replica never promoted after kill -9"
+
+            # Zero acked-write loss: every fenced key reads back its
+            # fenced value through the redirect-chasing client.
+            lost = [
+                k for k, v in keys.items()
+                if cc.execute("GET", k) != v.encode()
+            ]
+            assert not lost, f"acked writes lost across failover: {lost}"
+
+            # The cluster accepts NEW writes on the taken-over slots.
+            for i in range(12):
+                assert cc.execute("SET", f"post{i}", "new") == b"OK"
+                assert cc.execute("GET", f"post{i}") == b"new"
+            assert 0 not in sup.alive()
+        finally:
+            cc.close()
+    finally:
+        with sup._lock:
+            procs = list(sup._procs)
+        sup.shutdown()
+    # No orphans: every spawned process (primaries, replicas, any
+    # front-door workers they supervise) is genuinely gone.
+    for p in procs:
+        assert p.poll() is not None, f"orphan process pid={p.pid}"

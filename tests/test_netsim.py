@@ -245,7 +245,12 @@ class MiniClusterNode:
 
 
 def _client(*seeds, timeout_s=30.0) -> ClusterClient:
-    c = ClusterClient(list(seeds), timeout_s=timeout_s)
+    # deadnode_attempts=0: these models probe single-attempt semantics
+    # (a timeout must SURFACE, not ride the failover retry loop — the
+    # desync model's OSError contract).  The retry-through-takeover
+    # behavior is modeled in tests/test_netsim_failover.py instead.
+    c = ClusterClient(list(seeds), timeout_s=timeout_s,
+                      deadnode_attempts=0)
     # The executor seam (netsim transport-seam contract): scatter legs
     # on SIMULATED threads, so leg delivery order is explored.
     c._pool = netsim.SimThreadExecutor()

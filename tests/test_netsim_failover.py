@@ -1,0 +1,515 @@
+"""netsim failover-election models (ISSUE 18 tentpole): the REAL
+shipped election code — :class:`FailoverState` (grant_vote's
+one-vote-per-epoch record, the majority-over-ALL-primaries quorum),
+:class:`FailoverAgent`'s ``_try_failover``/``_takeover`` (the vote
+collection and the explicit-claim takeover broadcast, over the
+patched ``socket.create_connection``), and
+:meth:`SlotMap.apply_takeover`'s per-slot epoch gate — driven over a
+simulated network under the schedule explorer, so the
+partition × primary-crash × stale-replica-election interleavings are
+ENUMERATED, not sampled.
+
+Invariants, in EVERY schedule:
+
+- **no-dual-primary** — no epoch has two winners, and after the dust
+  settles every live node's slot map names the SAME owner for the dead
+  primary's slots: the highest-epoch winner (or the dead primary
+  itself when no election succeeded — safety, not liveness).
+- **no-acked-write-loss** — the final owner's replication offset is at
+  least the fully-acked fence (the offset every replica had acked via
+  the WAIT discipline before the primary died): only replicas of the
+  dead primary may succeed it, so the acked prefix is always held.
+
+Each invariant has a reverted-fix mutation guard that puts back the
+bug and asserts the model CATCHES it with a replayable token:
+
+- reverting grant_vote's record-the-vote-BEFORE-granting line lets two
+  candidates win ONE epoch (dual primary);
+- reverting apply_takeover's ``_slot_epoch[s] < epoch`` gate makes the
+  final owner depend on broadcast delivery order (divergent maps);
+- reverting grant_vote's only-its-own-replicas check lets a replica of
+  a DIFFERENT primary win the slots with none of the acked writes.
+"""
+
+import threading
+import time
+import types
+
+import pytest
+
+from redisson_tpu.analysis import netsim
+from redisson_tpu.analysis.explorer import (
+    ScheduleFailure,
+    explore,
+    schedule_test,
+)
+from redisson_tpu.cluster.failover import FailoverAgent, FailoverState
+from redisson_tpu.cluster.slotmap import SlotMap
+from redisson_tpu.cluster.slots import NSLOTS
+from redisson_tpu.serve.wireutil import (
+    ReplyError,
+    decode_command,
+    encode_reply,
+)
+
+# slow: bounded-exhaustive exploration is the protocol-check CI
+# job's work (`-m netsim` selects regardless of slow); keeping the
+# models out of tier-1 preserves its runtime budget.
+pytestmark = [pytest.mark.netsim, pytest.mark.slow]
+
+
+@pytest.fixture(autouse=True)
+def _unpatch_network():
+    """A failing schedule abandons the explored body mid-``with Net()``
+    (its __exit__ never runs), which would leave every LATER test in
+    this process dialing the sim and getting ConnectionRefusedError."""
+    yield
+    netsim.restore_patches()
+
+
+ADDRS = {
+    "A": ("prim-a", 7001),
+    "B": ("prim-b", 7002),
+    "C": ("prim-c", 7003),
+    "R1": ("repl-1", 7004),
+    "R2": ("repl-2", 7005),
+    "D": ("repl-d", 7006),
+}
+
+# Replication offsets at the moment A dies.  FENCE is the fully-acked
+# prefix: the highest offset EVERY replica of A had acked (the WAIT
+# <all-replicas> discipline) — the loss bound failover must honor.
+# R1 additionally holds a tail only IT acked; D replicates B, so it
+# holds NONE of A's writes.
+OFFSETS = {"R1": 100, "R2": 50, "D": 0}
+FENCE = 50
+
+
+def _topology(with_rogue=False):
+    nodes = [
+        {"id": "A", "host": ADDRS["A"][0], "port": ADDRS["A"][1],
+         "slots": [[0, NSLOTS - 1]]},
+        {"id": "B", "host": ADDRS["B"][0], "port": ADDRS["B"][1],
+         "slots": []},
+        {"id": "C", "host": ADDRS["C"][0], "port": ADDRS["C"][1],
+         "slots": []},
+        {"id": "R1", "host": ADDRS["R1"][0], "port": ADDRS["R1"][1],
+         "slots": [], "role": "replica", "replica_of": "A"},
+        {"id": "R2", "host": ADDRS["R2"][0], "port": ADDRS["R2"][1],
+         "slots": [], "role": "replica", "replica_of": "A"},
+    ]
+    if with_rogue:
+        nodes.append(
+            {"id": "D", "host": ADDRS["D"][0], "port": ADDRS["D"][1],
+             "slots": [], "role": "replica", "replica_of": "B"}
+        )
+    return {"nodes": nodes}
+
+
+class ModelNode:
+    """One simulated node: its OWN copies of the real SlotMap and
+    FailoverState, serving the election wire surface the REAL
+    FailoverAgent dials (AUTH votes, TAKEOVER broadcasts, pings)."""
+
+    def __init__(self, net, myid, topo, applied=0):
+        self.myid = myid
+        self.slotmap = SlotMap.from_dict(topo)
+        self.state = FailoverState(myid, self.slotmap, node_timeout=60.0)
+        self.applied = applied
+        net.listen(ADDRS[myid], self.serve, name=myid)
+
+    def serve(self, sock, peer) -> None:
+        buf = b""
+        pos = 0
+        while True:
+            chunk = sock.recv(1 << 16)
+            if not chunk:
+                return
+            buf += chunk
+            while True:
+                try:
+                    cmd, end = decode_command(buf, pos)
+                except (IndexError, ValueError):
+                    break
+                pos = end
+                sock.sendall(self.dispatch(cmd))
+
+    def dispatch(self, cmd) -> bytes:
+        name = cmd[0].decode("latin-1", "replace").upper()
+        try:
+            if name == "RTPU.FAILOVER.AUTH":
+                granted = self.state.grant_vote(
+                    cmd[1].decode(), int(cmd[2]), cmd[3].decode()
+                )
+                return encode_reply(1 if granted else 0)
+            if name == "RTPU.TAKEOVER":
+                new, old = cmd[1].decode(), cmd[2].decode()
+                epoch = int(cmd[3])
+                slots = None
+                if len(cmd) > 4 and cmd[4]:
+                    slots = []
+                    for part in cmd[4].decode().split(","):
+                        a, _, b = part.partition("-")
+                        slots.append([int(a), int(b or a)])
+                moved = self.slotmap.apply_takeover(
+                    old, new, epoch, slots=slots
+                )
+                self.state.note_takeover(new, old, epoch)
+                return encode_reply(moved)
+            if name == "RTPU.CLUSTERPING":
+                e = self.state.note_ping(cmd[1].decode(), int(cmd[2]))
+                return encode_reply([
+                    b"PONG", self.myid.encode(), e, self.applied,
+                    self.slotmap.role(self.myid).encode(),
+                ])
+            return encode_reply(ReplyError(f"ERR unknown '{name}'"))
+        except Exception as e:  # noqa: BLE001 - the -ERR contract
+            return encode_reply(ReplyError(f"ERR {e}"))
+
+
+def _make_candidate(node, wins):
+    """Wrap a ModelNode in the REAL FailoverAgent (not started as a
+    thread — the model drives ``_try_failover`` directly, which is the
+    whole election: rank, vote collection over the sim net, promote,
+    claim, broadcast).  ``promote_to_primary`` records the win with
+    its epoch — the dual-primary invariant's evidence."""
+    server = types.SimpleNamespace(
+        cluster=types.SimpleNamespace(myid=node.myid, slotmap=node.slotmap),
+        obs=None,
+        replica_link=types.SimpleNamespace(applied=node.applied),
+        promote_to_primary=lambda epoch, m=node.myid: wins.append((m, epoch)),
+    )
+    agent = FailoverAgent(
+        server, node_timeout_s=60.0, ping_interval_s=0.05,
+        election_rank_delay_s=0.0,
+    )
+    agent.state = node.state  # one state per node, shared with its wire
+    return agent
+
+
+def _campaign(agent, rounds=3):
+    """The standing-retry election loop (the agent _tick gate in
+    miniature): campaign while the dead primary still owns slots ON
+    THIS NODE'S MAP, stop as soon as this node won or the slots moved
+    (a rival's broadcast landed)."""
+    agent.state.mark_failed("A")
+    for _ in range(rounds):
+        if not agent.slotmap.ranges("A"):
+            return
+        agent._try_failover("A")
+        if agent.takeovers:
+            return
+        time.sleep(0.01)  # virtual: let rival broadcasts land
+
+
+def _check_invariants(nodes, wins):
+    # no-dual-primary, half 1: an epoch is majority-minted with
+    # one-vote-per-epoch voters — it can have at most ONE winner.
+    epochs = [e for _, e in wins]
+    assert len(epochs) == len(set(epochs)), (
+        f"two candidates won one epoch: {wins}"
+    )
+    # no-dual-primary, half 2: every live map converges on ONE owner
+    # for the dead primary's slots — the highest-epoch winner, or A
+    # itself if no election succeeded (safety, not liveness).
+    expect = max(wins, key=lambda t: t[1])[0] if wins else "A"
+    for node in nodes:
+        owners = {node.slotmap.owner(s) for s in (0, NSLOTS // 2,
+                                                  NSLOTS - 1)}
+        assert owners == {expect}, (
+            f"{node.myid} routes A's slots to {owners}, expected "
+            f"{expect!r} (wins={wins})"
+        )
+    # no-acked-write-loss: the final owner holds the fully-acked
+    # prefix.  Only a replica of A can win, and every replica of A
+    # acked through FENCE before A died.
+    if wins:
+        assert OFFSETS[expect] >= FENCE, (
+            f"winner {expect} is {FENCE - OFFSETS[expect]} ops short "
+            f"of the acked fence: acked writes lost"
+        )
+
+
+def _election_race_body():
+    """Primary A crashes; its two replicas (one fresh, one stale) race
+    the election against voters B and C."""
+    with netsim.Net() as net:
+        topo = _topology()
+        wins: list = []
+        nodes = [
+            ModelNode(net, nid, topo, applied=OFFSETS.get(nid, 0))
+            for nid in ("B", "C", "R1", "R2")
+        ]
+        by_id = {n.myid: n for n in nodes}
+        for v in ("B", "C"):
+            by_id[v].state.mark_failed("A")
+        cands = [
+            _make_candidate(by_id["R1"], wins),
+            _make_candidate(by_id["R2"], wins),
+        ]
+        threads = [
+            threading.Thread(target=_campaign, args=(a,)) for a in cands
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        _check_invariants(nodes, wins)
+
+
+def _rogue_candidate_body():
+    """A replica of a DIFFERENT primary (D replicates B — it holds
+    none of A's writes) campaigns for A's slots alongside the
+    legitimate stale replica.  grant_vote's only-its-own-replicas
+    check must shut D out in every schedule."""
+    with netsim.Net() as net:
+        topo = _topology(with_rogue=True)
+        wins: list = []
+        nodes = [
+            ModelNode(net, nid, topo, applied=OFFSETS.get(nid, 0))
+            for nid in ("B", "C", "R1", "R2", "D")
+        ]
+        by_id = {n.myid: n for n in nodes}
+        for v in ("B", "C"):
+            by_id[v].state.mark_failed("A")
+        cands = [
+            _make_candidate(by_id["R2"], wins),
+            _make_candidate(by_id["D"], wins),
+        ]
+        threads = [
+            threading.Thread(target=_campaign, args=(a,)) for a in cands
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert "D" not in [w for w, _ in wins], (
+            f"a replica of ANOTHER primary deposed A: {wins}"
+        )
+        _check_invariants(nodes, wins)
+
+
+def _partition_body():
+    """A crashes AND voter B is unreachable (the candidate's side of a
+    partition holds one of three primaries).  Majority counts ALL
+    primaries — dead and unreachable ones included — so the minority
+    side must never assemble a quorum: no takeover, A's slots stay
+    put (a partitioned observer keeps routing to A and fails, rather
+    than being told a lie)."""
+    with netsim.Net() as net:
+        topo = _topology()
+        wins: list = []
+        nodes = [
+            ModelNode(net, nid, topo, applied=OFFSETS.get(nid, 0))
+            for nid in ("C", "R1", "R2")
+        ]  # B never listens: partitioned away with A dead
+        by_id = {n.myid: n for n in nodes}
+        by_id["C"].state.mark_failed("A")
+        cands = [
+            _make_candidate(by_id["R1"], wins),
+            _make_candidate(by_id["R2"], wins),
+        ]
+        threads = [
+            threading.Thread(target=_campaign, args=(a,)) for a in cands
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert wins == [], f"minority partition elected a primary: {wins}"
+        _check_invariants(nodes, wins)
+
+
+def _double_takeover_body():
+    """The compressed delivery-order window the per-slot epoch gate
+    exists for: TWO legitimate takeovers of A happened in successive
+    epochs (the stale replica won epoch 1, then the fresh one — whose
+    map hadn't yet seen that broadcast — won epoch 2; both quorums are
+    reachable in the full race model, just far down the search tree).
+    Their claim broadcasts race to the observers in explored order.
+    Invariant: every observer converges on the HIGHER epoch's winner
+    no matter which broadcast lands last."""
+    import socket as socket_mod
+
+    from redisson_tpu.serve.wireutil import exchange
+
+    with netsim.Net() as net:
+        topo = _topology()
+        wins = [("R2", 1), ("R1", 2)]
+        nodes = [
+            ModelNode(net, nid, topo, applied=OFFSETS.get(nid, 0))
+            for nid in ("B", "C")
+        ]
+        spec = f"0-{NSLOTS - 1}"
+
+        def broadcast(winner, epoch):
+            # The _takeover broadcast loop in miniature: sequential
+            # sends, one short-lived connection per observer.
+            for nid in ("B", "C"):
+                sock = socket_mod.create_connection(ADDRS[nid],
+                                                    timeout=30.0)
+                try:
+                    exchange(sock, [(
+                        "RTPU.TAKEOVER", winner, "A", str(epoch), spec,
+                    )])
+                finally:
+                    sock.close()
+
+        threads = [
+            threading.Thread(target=broadcast, args=w) for w in wins
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        _check_invariants(nodes, wins)
+
+
+# ---------------------------------------------------------------------------
+# the models
+# ---------------------------------------------------------------------------
+
+
+@schedule_test(max_schedules=150, random_schedules=48, preemption_bound=2,
+               max_steps=200000)
+def test_model_election_race_single_winner():
+    _election_race_body()
+
+
+@schedule_test(max_schedules=100, random_schedules=32, preemption_bound=2,
+               max_steps=200000)
+def test_model_rogue_candidate_never_wins():
+    _rogue_candidate_body()
+
+
+@schedule_test(max_schedules=60, random_schedules=24, preemption_bound=2,
+               max_steps=200000)
+def test_model_minority_partition_never_elects():
+    _partition_body()
+
+
+@schedule_test(max_schedules=100, random_schedules=32, preemption_bound=2,
+               max_steps=200000)
+def test_model_double_takeover_delivery_order_converges():
+    _double_takeover_body()
+
+
+# ---------------------------------------------------------------------------
+# mutation guards: revert each fix, watch the model catch it, replay it
+# ---------------------------------------------------------------------------
+
+
+def _explore_expect_caught(body, **opts):
+    """Run the explorer expecting a ScheduleFailure; re-run its replay
+    token and check it reproduces the SAME failing schedule."""
+    with pytest.raises(ScheduleFailure) as ei:
+        explore(body, **opts)
+    token = ei.value.token
+    with pytest.raises(ScheduleFailure) as ei2:
+        explore(body, replay=token, max_steps=opts.get("max_steps", 200000))
+    assert ei2.value.token == token
+
+
+def test_model_mutation_unrecorded_vote_dual_primary():
+    """Revert grant_vote's record-the-vote-BEFORE-granting line: a
+    voter hands BOTH candidates its vote in one epoch, both assemble a
+    majority, and two primaries serve one slot range.  The model must
+    catch it with a replayable token."""
+    orig = FailoverState.grant_vote
+
+    def grant_without_recording(self, candidate_id, epoch,
+                                failed_primary_id):
+        epoch = int(epoch)
+        with self._lock:
+            if epoch <= self.last_vote_epoch:
+                return False
+            if failed_primary_id not in self.failed:
+                return False
+            if self.slotmap.replica_of(candidate_id) != failed_primary_id:
+                return False
+            # MUTATION: the vote is never recorded.
+            self.current_epoch = max(self.current_epoch, epoch)
+            return True
+
+    FailoverState.grant_vote = grant_without_recording
+    try:
+        _explore_expect_caught(
+            _election_race_body, max_schedules=150, random_schedules=48,
+            preemption_bound=2, max_steps=200000,
+        )
+    finally:
+        FailoverState.grant_vote = orig
+
+
+def test_model_mutation_unranked_takeover_diverges():
+    """Revert apply_takeover's per-slot epoch gate (apply every claim
+    unconditionally): when two candidates win successive epochs, the
+    final owner on each node becomes whichever broadcast arrived LAST
+    — maps diverge, two primaries each serve the slots for part of
+    the cluster.  The model must catch the divergence."""
+    orig = SlotMap.apply_takeover
+
+    def apply_unconditionally(self, old_id, new_id, epoch, slots=None):
+        epoch = int(epoch)
+        with self._lock:
+            if new_id not in self._nodes:
+                raise KeyError(f"unknown node id {new_id!r}")
+            if slots is None:
+                claim = [
+                    s for s in range(NSLOTS) if self._owner[s] == old_id
+                ]
+            else:
+                claim = []
+                for start, end in slots:
+                    claim.extend(range(int(start), int(end) + 1))
+            moved = 0
+            for s in claim:
+                # MUTATION: no `_slot_epoch[s] < epoch` gate.
+                self._owner[s] = new_id
+                self._slot_epoch[s] = epoch
+                moved += 1
+            if moved:
+                self._roles[new_id] = "master"
+                self._replica_of.pop(new_id, None)
+                if old_id in self._nodes:
+                    self._roles[old_id] = "replica"
+                    self._replica_of[old_id] = new_id
+                self.epoch += 1
+            return moved
+
+    SlotMap.apply_takeover = apply_unconditionally
+    try:
+        _explore_expect_caught(
+            _double_takeover_body, max_schedules=100, random_schedules=32,
+            preemption_bound=2, max_steps=200000,
+        )
+    finally:
+        SlotMap.apply_takeover = orig
+
+
+def test_model_mutation_unchecked_lineage_loses_acked_writes():
+    """Revert grant_vote's only-its-own-replicas check: D (a replica
+    of B, holding NONE of A's acked writes) can win A's slots — every
+    acked write on that range is gone.  The model must catch it."""
+    orig = FailoverState.grant_vote
+
+    def grant_any_lineage(self, candidate_id, epoch, failed_primary_id):
+        epoch = int(epoch)
+        with self._lock:
+            if epoch <= self.last_vote_epoch:
+                return False
+            if failed_primary_id not in self.failed:
+                return False
+            # MUTATION: no replica-of-the-failed-primary check.
+            self.last_vote_epoch = epoch
+            self.current_epoch = max(self.current_epoch, epoch)
+            return True
+
+    FailoverState.grant_vote = grant_any_lineage
+    try:
+        _explore_expect_caught(
+            _rogue_candidate_body, max_schedules=100, random_schedules=32,
+            preemption_bound=2, max_steps=200000,
+        )
+    finally:
+        FailoverState.grant_vote = orig
