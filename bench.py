@@ -2251,6 +2251,374 @@ def bench_config14_failover(_make_client):
     return out
 
 
+def bench_config15_rebalance(_make_client):
+    """Config 15 — autonomous rebalancer A/B (ISSUE 19 tentpole).
+
+    3 primaries with the rebalancer armed on every node (``--rebalance``);
+    closed-loop writers stream acked zipf SETs whose hot-spot is a set of
+    hash tags that all land on ONE node, and the hot-spot SHIFTS to a
+    fresh single-owner tag set each round.  Rounds interleave an
+    assigner-OFF pass (``CLUSTER REBALANCE PAUSE`` fleet-wide), a WAVE
+    window (resume, shed runs to completion under live traffic), and an
+    assigner-ON pass in the rebalanced steady state — the A/B is
+    measured on the same fleet under the same churn.  Published:
+
+    - config15_goodput_{off,on}_per_sec + config15_goodput_on_vs_off:
+      acked SET rate with the hot-spot pinned vs shed.  The closed-loop
+      goodput win needs >= (nodes + clients) host cores — on a 1-core
+      box every process shares one CPU, so placement cannot change
+      total throughput and the armed agent's scrape/plan ticks show up
+      as pure overhead (the config13 situation: publish the measured
+      ratio ATTRIBUTED via config15_host_cores, never extrapolated).
+    - config15_imbalance_peak_post: per round ``[peak, post]`` of the
+      coordinator's observed max/mean load ratio — the placement-plane
+      win that holds on ANY host: peak must clear the 1.3 trigger (the
+      planner saw the skew) and the round must end back inside the
+      dead band under live traffic.
+    - config15_set_p99_{off,on,wave}_ms: client-observed SET p99 per
+      window; the WAVE number is p99-during-waves and must stay bounded
+      (no multi-second stall while slots migrate under traffic).
+    - config15_slots_moved / config15_keys_moved / config15_waves /
+      config15_migration_seconds_{sum,count}: harvested from
+      ``CLUSTER REBALANCE STATUS`` + the ``rtpu_rebalancer_*`` metric
+      families — migration work attributed in the artifact itself.
+    - config15_acked_write_loss: every acked write must read back after
+      the final wave settles (zero-acked-write-loss differential, the
+      config14 discipline under planned moves instead of failover).
+    - config15_pass_link: [pre, post] link-probe brackets around each
+      ON pass (the config4/headline phase-attribution discipline).
+
+    Nodes run on the CPU backend like config9/10/12/13/14 (N processes
+    cannot share the one bench accelerator; this config measures the
+    placement plane, not kernel rate)."""
+    import multiprocessing as _mp
+    import os
+    import threading as _threading
+    import urllib.request as _urlreq
+
+    from redisson_tpu.cluster.client import ClusterClient
+    from redisson_tpu.cluster.slots import key_slot
+    from redisson_tpu.cluster.supervisor import ClusterSupervisor
+
+    PASS_S = 4.0
+    WAVE_S = 8.0
+    ROUNDS = 3
+    N_PROCS = 6
+    CONNS = 2
+    out = {}
+    sup = ClusterSupervisor(
+        n_nodes=3, node_args=["--rebalance"], metrics=True,
+        startup_timeout_s=180.0,
+    )
+    try:
+        sup.start()
+        ctl = ClusterClient(sup.addrs)
+        # Bench cadence: fast ticks, short cooldown, no pacing — the
+        # dead-band + cooldown damping is what keeps this honest, not a
+        # slow clock.
+        for addr, r in ctl._fanout(
+            [b"CONFIG", b"SET",
+             b"rebalance-interval-ms", b"250",
+             b"rebalance-cooldown-ms", b"1500",
+             b"rebalance-pace-ms", b"0",
+             b"rebalance-threshold", b"1.3",
+             b"rebalance-max-moves", b"8"]
+        ).items():
+            assert r == b"OK", (addr, r)
+        assert ctl.rebalance_pause() == 3  # OFF is fleet-wide or it lies
+
+        def hot_tags(rnd, avoid):
+            """8 hash tags whose slots share ONE current owner (not
+            ``avoid``) — a genuinely single-node hot-spot that shifts
+            owner between rounds."""
+            ctl.refresh_slots()
+            by_owner: dict = {}
+            i = 0
+            while True:
+                tag = "{c15r%d-%d}" % (rnd, i)
+                i += 1
+                owner = ctl.slot_addr(key_slot(tag))
+                if owner == avoid:
+                    continue
+                grp = by_owner.setdefault(owner, [])
+                grp.append(tag)
+                if len(grp) >= 8:
+                    return owner, grp
+
+        def fleet_counter(field):
+            return sum(
+                st.get(field, 0)
+                for st in ctl.rebalance_status().values()
+                if "error" not in st
+            )
+
+        # FORKED closed-loop clients (the config13 discipline): writer
+        # threads in the driver process share one GIL and never
+        # saturate the hot node, so spreading slots can't show a
+        # goodput win.  Forked processes make the single hot SERVER
+        # process the bottleneck, which is the regime the rebalancer
+        # exists for.
+        ctx = _mp.get_context("fork")
+
+        def _burst_proc(tags, stop_at, seed, q):
+            counts = [0] * CONNS
+            lats = [[] for _ in range(CONNS)]
+            ackd = [set() for _ in range(CONNS)]
+
+            def worker(c):
+                cc = ClusterClient(sup.addrs)
+                rng = np.random.default_rng(1000 * seed + c)
+                wid = seed * CONNS + c
+                seq = 0
+                try:
+                    while time.time() < stop_at:
+                        seq += 1
+                        # Flat-ish zipf over 8 tags: rank-1 must not
+                        # dwarf the rest or the mega-slot rule pins it
+                        # and the shed can never reach the dead band.
+                        tag = tags[int(rng.zipf(1.1) - 1) % len(tags)]
+                        # TIGHTLY bounded key space per (tag, worker):
+                        # the pump is one MIGRATE round trip per key,
+                        # so hot slots must stay small (~100 keys) for
+                        # a wave to finish inside a window — heat is
+                        # ops-driven, 12 keys are as hot as 12k.
+                        key = "%s-%d-%d" % (tag, wid, seq % 12)
+                        t0 = time.perf_counter()
+                        try:
+                            rep = cc.execute("SET", key, "v%d" % seq)
+                        except Exception:
+                            continue  # retry budget exhausted mid-wave
+                        if rep == b"OK":
+                            lats[c].append(
+                                (time.perf_counter() - t0) * 1000.0
+                            )
+                            counts[c] += 1
+                            ackd[c].add(key)
+                finally:
+                    cc.close()
+
+            t0 = time.time()
+            ths = [
+                _threading.Thread(target=worker, args=(c,))
+                for c in range(CONNS)
+            ]
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
+            q.put((
+                sum(counts),
+                time.time() - t0,
+                [x for la in lats for x in la],
+                sorted(set().union(*ackd)),
+            ))
+
+        acked_keys: set = set()
+
+        def burst(tags, duration_s):
+            """Run one measured traffic window via forked clients;
+            returns (acked rate, p50 ms, p99 ms)."""
+            q = ctx.Queue()
+            stop_at = time.time() + duration_s + 0.3  # absorb fork
+            procs = [
+                ctx.Process(
+                    target=_burst_proc, args=(tags, stop_at, i, q)
+                )
+                for i in range(N_PROCS)
+            ]
+            for p in procs:
+                p.start()
+            res = [q.get(timeout=duration_s + 120.0) for _ in procs]
+            for p in procs:
+                p.join(timeout=30)
+            total = sum(r[0] for r in res)
+            dt = float(np.median([r[1] for r in res]))
+            lat = sorted(x for r in res for x in r[2])
+            acked_keys.update(k for r in res for k in r[3])
+            pct = (lambda f: round(
+                lat[min(len(lat) - 1, int(len(lat) * f))], 2
+            )) if lat else (lambda f: None)
+            return total / max(dt, 1e-9), pct(0.5), pct(0.99)
+
+        def settle_moves(floor, cap_s):
+            """Poll the fleet slots_moved counter until it has been
+            quiet for 1.5s (in-flight waves keep pumping after their
+            heat source stops; counters land only on wave return)."""
+            prev, stable_at = fleet_counter("slots_moved"), time.time()
+            deadline = time.time() + cap_s
+            while time.time() < deadline:
+                time.sleep(0.5)
+                cur = fleet_counter("slots_moved")
+                if cur != prev:
+                    prev, stable_at = cur, time.time()
+                elif cur >= floor and time.time() - stable_at >= 1.5:
+                    break
+
+        arms: dict = {"off": [], "wave": [], "on": []}
+        pass_link = []
+        slots_moved_per_round = []
+        imbalance_rounds = []
+        hot_owner = None
+        burst(hot_tags(0, None)[1], 1.0)  # warm path off the books
+        for rnd in range(ROUNDS):
+            # OFF: hot-spot pinned on one node, assigner frozen — the
+            # baseline the rebalancer is supposed to beat.
+            hot_owner, tags = hot_tags(rnd, hot_owner)
+            arms["off"].append(burst(tags, PASS_S))
+            moved0 = fleet_counter("slots_moved")
+            bracket = measure_pass_link_sample()
+            # Sample the coordinator's observed imbalance ratio across
+            # the armed window: the PEAK is the skew the planner saw
+            # (why it shed), the LAST sample is the rebalanced steady
+            # state under live traffic — the placement-plane win that
+            # holds regardless of host core count.
+            ratio_samples: list = []
+            samp_stop = _threading.Event()
+
+            def sampler():
+                sc = ClusterClient(sup.addrs)
+                try:
+                    while not samp_stop.is_set():
+                        try:
+                            vals = [
+                                st.get("imbalance_ratio", 0.0)
+                                for st in sc.rebalance_status().values()
+                                if "error" not in st
+                            ]
+                            if vals:
+                                ratio_samples.append(max(vals))
+                        except Exception:
+                            pass
+                        time.sleep(0.3)
+                finally:
+                    sc.close()
+
+            samp_th = _threading.Thread(target=sampler)
+            samp_th.start()
+            # WAVE: resume; the burst itself is the heat source and
+            # this window IS "p99 during waves".
+            assert ctl.rebalance_resume() >= 1
+            arms["wave"].append(burst(tags, WAVE_S))
+            # Generous cap: slots_moved lands only when the WHOLE wave
+            # returns, and a wave can outlive the burst under CPU
+            # contention — the ON pass must not start mid-pump.
+            settle_moves(moved0 + 1, 60.0)
+            # ON: the rebalanced steady state, assigner still armed —
+            # the dead band keeps it quiet unless the fleet re-skews.
+            arms["on"].append(burst(tags, PASS_S))
+            assert ctl.rebalance_pause() >= 1
+            samp_stop.set()
+            samp_th.join(timeout=10)
+            imbalance_rounds.append(
+                [round(max(ratio_samples), 3),
+                 round(ratio_samples[-1], 3)]
+                if ratio_samples else [None, None]
+            )
+            post = measure_pass_link_sample()
+            pass_link.append({
+                k: [bracket[k], post[k]]
+                for k in ("link_h2d_put_rt_ms", "link_resident_rt_ms")
+            })
+            slots_moved_per_round.append(
+                fleet_counter("slots_moved") - moved0
+            )
+        # A wave armed during the last ON pass may still be pumping
+        # past the pause — settle before the loss differential.
+        settle_moves(0, 60.0)
+
+        def arm(name):
+            rates = [r for r, _, _ in arms[name]]
+            p50s = [p for _, p, _ in arms[name] if p is not None]
+            p99s = [p for _, _, p in arms[name] if p is not None]
+            return (
+                round(float(np.mean(rates))) if rates else 0,
+                round(float(np.median(p50s)), 2) if p50s else None,
+                round(float(max(p99s)), 2) if p99s else None,
+            )
+
+        off_rate, off_p50, off_p99 = arm("off")
+        on_rate, on_p50, on_p99 = arm("on")
+        wave_rate, wave_p50, wave_p99 = arm("wave")
+        out["config15_rounds"] = ROUNDS
+        out["config15_goodput_off_per_sec"] = off_rate
+        out["config15_goodput_on_per_sec"] = on_rate
+        out["config15_goodput_wave_per_sec"] = wave_rate
+        out["config15_goodput_on_vs_off"] = (
+            round(on_rate / off_rate, 3) if off_rate else None
+        )
+        out["config15_set_p50_off_ms"] = off_p50
+        out["config15_set_p50_on_ms"] = on_p50
+        out["config15_set_p99_off_ms"] = off_p99
+        out["config15_set_p99_on_ms"] = on_p99
+        out["config15_set_p99_wave_ms"] = wave_p99
+        slots_moved = fleet_counter("slots_moved")
+        out["config15_slots_moved_per_round"] = slots_moved_per_round
+        out["config15_slots_moved"] = slots_moved
+        out["config15_keys_moved"] = fleet_counter("keys_moved")
+        out["config15_waves"] = fleet_counter("waves")
+        out["config15_wave_failures"] = fleet_counter("failures")
+        out["config15_imbalance_peak_post"] = imbalance_rounds
+        out["config15_host_cores"] = len(os.sched_getaffinity(0))
+        out["config15_pass_link"] = pass_link
+        # The assigner must have actually moved the hot-spot, and the
+        # p99 during waves must stay bounded (no multi-second stall).
+        assert slots_moved > 0, "assigner never moved"
+        assert wave_p99 is not None and wave_p99 < 5000.0, (
+            f"p99 during waves unbounded: {wave_p99}ms"
+        )
+        # Placement-plane win, valid on ANY host: the planner must have
+        # OBSERVED the skew (peak ratio past the trigger) and ended the
+        # round back inside the dead band under live traffic.
+        peaks = [p for p, _ in imbalance_rounds if p is not None]
+        posts = [q for _, q in imbalance_rounds if q is not None]
+        assert peaks and max(peaks) >= 1.3, (
+            f"planner never observed the skew: {imbalance_rounds}"
+        )
+        assert posts and posts[-1] <= 1.3, (
+            f"fleet still skewed after waves: {imbalance_rounds}"
+        )
+
+        # Migration-seconds from the coordinator's histogram family —
+        # the rtpu_rebalancer_* plane feeding the artifact directly.
+        mig_sum = mig_count = 0.0
+        for host, port in sup.metrics_addrs:
+            try:
+                with _urlreq.urlopen(
+                    "http://%s:%d/metrics" % (host, port), timeout=5.0
+                ) as resp:
+                    body = resp.read().decode()
+            except OSError:
+                continue
+            for ln in body.splitlines():
+                if ln.startswith("rtpu_rebalancer_migration_seconds_sum"):
+                    mig_sum += float(ln.rsplit(" ", 1)[1])
+                elif ln.startswith(
+                    "rtpu_rebalancer_migration_seconds_count"
+                ):
+                    mig_count += float(ln.rsplit(" ", 1)[1])
+        out["config15_migration_seconds_sum"] = round(mig_sum, 3)
+        out["config15_migration_seconds_count"] = int(mig_count)
+
+        # Zero acked-write loss + full slot coverage after the dust
+        # settles: planned moves must strand neither keys nor slots.
+        ctl.refresh_slots()
+        unowned = sum(1 for a in ctl._slots if a is None)
+        assert unowned == 0, f"{unowned} slots unowned after waves"
+        guaranteed = sorted(acked_keys)
+        lost = 0
+        for i in range(0, len(guaranteed), 512):
+            chunk = guaranteed[i:i + 512]
+            got = ctl.execute_many([("GET", k) for k in chunk])
+            lost += sum(1 for g in got if g is None)
+        out["config15_acked_writes_checked"] = len(guaranteed)
+        out["config15_acked_write_loss"] = lost
+        assert lost == 0, f"{lost} acked writes lost across waves"
+        ctl.close()
+    finally:
+        sup.shutdown()
+    return out
+
+
 def bench_config3_bitset(client):
     """Config 3: 2^30-bit RBitSet, batched get/set (raw bitmap path).
 
@@ -2586,6 +2954,25 @@ def main():
         write_bench_artifact(result, line)
         return
 
+    if "--config15" in sys.argv:
+        # CI smoke mode (ISSUE 19): the rebalancer A/B alone — shifting
+        # single-node zipf hot-spot, assigner paused vs running, zero
+        # acked-write loss after the waves — written as a BENCH.json
+        # artifact so the workflow can assert the published keys exist
+        # without paying for the full bench.
+        stats = bench_config15_rebalance(make_client)
+        result = {
+            "metric": "config15_rebalance_smoke",
+            "value": stats.get("config15_goodput_on_vs_off"),
+            "unit": "x goodput, assigner on vs off",
+            "vs_baseline": None,
+            "extra": stats,
+        }
+        line = json.dumps(result)
+        print(line)
+        write_bench_artifact(result, line)
+        return
+
     if "--config13" in sys.argv:
         # CI smoke mode (ISSUE 17): the per-core front door A/B alone,
         # written as a BENCH.json artifact so the workflow can assert
@@ -2727,6 +3114,14 @@ def main():
         failover_stats = bench_config14_failover(make_client)
     except Exception as e:  # pragma: no cover - env-dependent spawn
         failover_stats = {"config14_failover_error": repr(e)}
+    # Autonomous rebalancer (ISSUE 19): config15_rebalance — shifting
+    # single-node zipf hot-spot, assigner-off vs assigner-on passes,
+    # zero acked-write loss after the waves.  Isolated like
+    # config9/10/12/13/14 (subprocess spawn).
+    try:
+        rebalance_stats = bench_config15_rebalance(make_client)
+    except Exception as e:  # pragma: no cover - env-dependent spawn
+        rebalance_stats = {"config15_rebalance_error": repr(e)}
     host_ops = measure_host_baseline()
 
     # vs_baseline: the bench env ships no redis-server, so the Redis-backed
@@ -2821,6 +3216,10 @@ def main():
                     # goodput, promotion time, zero acked-write loss,
                     # replica staleness percentiles.
                     **failover_stats,
+                    # Autonomous rebalancer (ISSUE 19): assigner on/off
+                    # goodput + p99, slots/keys moved, migration
+                    # seconds, zero acked-write loss across waves.
+                    **rebalance_stats,
                     "hll_pfadd_ops_per_sec": round(hll_ops),
                     "config3_bitset_ops_per_sec": round(bitset_ops),
                     "config4_mixed_ops_per_sec": round(mixed_ops),

@@ -59,6 +59,8 @@ def _serve_multicore(args, nworkers: int) -> int:
         ]
     if args.cluster:
         extra += ["--cluster"]
+    if args.rebalance:
+        extra += ["--rebalance"]
     for val, flag in (
         (args.cluster_slots, "--cluster-slots"),
         (args.cluster_topology, "--cluster-topology"),
@@ -230,6 +232,14 @@ def main(argv=None) -> int:
         "failed primary start a failover election (default 1500)",
     )
     p.add_argument(
+        "--rebalance", action="store_true",
+        help="arm the autonomous rebalancer (ISSUE 19; docs/"
+        "clustering.md 'Autonomous rebalancing'): the node scrapes the "
+        "fleet's CLUSTER LOADMAPs into a smoothed per-slot heat model "
+        "and, when coordinator, migrates slots to level the load; "
+        "requires --cluster",
+    )
+    p.add_argument(
         "--frontdoor-processes", type=int, default=None,
         help="per-core front door (ISSUE 17): serve with this many "
         "reactor processes sharing the port via SO_REUSEPORT, each "
@@ -324,6 +334,11 @@ def main(argv=None) -> int:
         cfg.resp_reactor_threads = args.resp_reactor_threads
     if args.cluster:
         cfg.cluster_enabled = True
+    if args.rebalance:
+        if not cfg.cluster_enabled:
+            p.error("--rebalance requires --cluster (or a config file "
+                    "with cluster_enabled: true)")
+        cfg.rebalance_enabled = True
     for flag, key in (
         (args.cluster_slots, "cluster_slots"),
         (args.cluster_topology, "cluster_topology"),
@@ -445,6 +460,29 @@ def main(argv=None) -> int:
                 getattr(cfg, "cluster_ping_interval_ms", 300) or 300
             ) / 1000.0,
         ).start()
+        if getattr(cfg, "rebalance_enabled", False):
+            # Autonomous rebalancer (ISSUE 19): observe everywhere,
+            # execute on the coordinator.  server.close() stops it.
+            from redisson_tpu.cluster.rebalancer import RebalanceAgent
+
+            RebalanceAgent(
+                server,
+                interval_s=float(
+                    getattr(cfg, "rebalance_interval_ms", 1000) or 1000
+                ) / 1000.0,
+                threshold=float(
+                    getattr(cfg, "rebalance_threshold", 1.3) or 1.3
+                ),
+                max_moves=int(
+                    getattr(cfg, "rebalance_max_moves", 8) or 8
+                ),
+                pace_s=float(
+                    getattr(cfg, "rebalance_pace_ms", 50) or 0
+                ) / 1000.0,
+                cooldown_s=float(
+                    getattr(cfg, "rebalance_cooldown_ms", 15000) or 0
+                ) / 1000.0,
+            ).start()
     metrics_srv = None
     if args.metrics_port is not None:
         metrics_srv = client.start_metrics_endpoint(

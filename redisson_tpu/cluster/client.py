@@ -670,10 +670,17 @@ class ClusterClient:
         key_heat: dict = {}
         tenants: dict = {}
         nodes: dict = {}
+        down_nodes: list = []
         for addr, raw in self._fanout([b"CLUSTER", b"LOADMAP"]).items():
             node = "%s:%d" % tuple(addr)
             if isinstance(raw, (ReplyError, Exception)):
+                # A member dying mid-scrape DEGRADES the merge (the
+                # federation `rtpu_federation_node_up 0` discipline):
+                # its last-known slots simply don't refresh, and the
+                # assigner sees exactly which node went dark instead of
+                # the whole fleet view raising away.
                 nodes[node] = {"error": str(raw)}
+                down_nodes.append(node)
                 continue
             snap = _json.loads(raw)
             fields = snap["fields"]
@@ -713,7 +720,44 @@ class ClusterClient:
             ],
             "tenants": tenants,
             "nodes": nodes,
+            "down_nodes": sorted(down_nodes),
         }
+
+    def rebalance_status(self) -> dict:
+        """Every node's CLUSTER REBALANCE STATUS, node-tagged —
+        unreachable members report ``{"error": …}`` (degrade, never
+        raise: same discipline as fleet_loadmap)."""
+        import json as _json
+
+        out: dict = {}
+        fan = self._fanout([b"CLUSTER", b"REBALANCE", b"STATUS"])
+        for addr, raw in fan.items():
+            node = "%s:%d" % tuple(addr)
+            if isinstance(raw, (ReplyError, Exception)):
+                out[node] = {"error": str(raw)}
+                continue
+            out[node] = _json.loads(raw)
+        return out
+
+    def rebalance_pause(self) -> int:
+        """PAUSE every armed node's rebalancer; returns how many
+        acked (pausing everywhere is what makes an assigner-off bench
+        pass honest — a surviving coordinator would keep migrating)."""
+        acked = 0
+        fan = self._fanout([b"CLUSTER", b"REBALANCE", b"PAUSE"])
+        for raw in fan.values():
+            if not isinstance(raw, (ReplyError, Exception)):
+                acked += 1
+        return acked
+
+    def rebalance_resume(self) -> int:
+        """RESUME every armed node's rebalancer; returns acks."""
+        acked = 0
+        fan = self._fanout([b"CLUSTER", b"REBALANCE", b"RESUME"])
+        for raw in fan.values():
+            if not isinstance(raw, (ReplyError, Exception)):
+                acked += 1
+        return acked
 
     def _executor(self):
         """Shared scatter-leg thread pool (threads spawn on demand and

@@ -72,6 +72,10 @@ class ClusterDoor:
         # sit inside the guarded critical section every concurrent
         # write to the migrating slot waits on.
         self._mig_socks: dict = {}
+        # Write-time slot->key index (cluster/slotindex.py), installed
+        # by the server when the keyspace hooks are wired.  None means
+        # keys_in_slot falls back to the full-keyspace scan.
+        self.slot_index = None
 
     @classmethod
     def from_config(cls, server, config, obs=None):
@@ -270,11 +274,21 @@ class ClusterDoor:
     # -- key enumeration (GETKEYSINSLOT / COUNTKEYSINSLOT) ------------------
 
     def keys_in_slot(self, slot: int, count=None) -> list:
-        # O(total keys) per call: the keyspace keeps no slot index, so
-        # the migration pump re-hashes every key name per batch.  Fine
-        # at the current scale (migration-time only, CRC16 on host
-        # names is ~100ns/key); a write-time slot->keys index is the
-        # upgrade path if a node ever hosts millions of keys.
+        # Index-backed since ISSUE 19: the rebalancer made many-slot
+        # migration the common case, so the old O(total keys) re-hash
+        # per pump batch (quadratic across a wave) moved to write time
+        # (cluster/slotindex.py).  The scan survives below as the
+        # DEBUG-level ground-truth cross-check.
+        if self.slot_index is not None:
+            return self.slot_index.keys(slot, count)
+        return self.keys_in_slot_scan(slot, count)
+
+    def keys_in_slot_scan(self, slot: int, count=None) -> list:
+        """The pre-index full-keyspace scan: O(total keys) per call,
+        re-hashing every key name.  Kept as the authoritative
+        cross-check (``DEBUG GETKEYSINSLOT`` / ``DEBUG
+        COUNTKEYSINSLOT`` serve from here) — if index and scan ever
+        disagree, the index's write-time hooks missed a path."""
         out = []
         for name in self._server._client.get_keys().get_keys():
             if key_slot(name) == slot:

@@ -127,6 +127,99 @@ def migrate_slot(slot: int, src_addr, dst_addr, notify=(),
     return moved
 
 
+def migrate_slots_bulk(slots, src_addr, dst_addr, notify=(),
+                       batch: int = 64, chunk: int = 128,
+                       timeout_s: float = 30.0) -> int:
+    """Migrate MANY slots from one source to one destination — the
+    join/drain workhorse (ISSUE 19).  Same five-step protocol and the
+    same per-step idempotence as :func:`migrate_slot`, but amortized
+    for the thousands-of-slots case: two persistent control
+    connections, SETSLOT phases pipelined per ``chunk`` of slots, and
+    one shared pump (which the write-time slot index makes O(keys in
+    slot) per batch instead of O(total keys)).  Empty slots — the vast
+    majority in a share shift — cost two pipelined SETSLOTs, one empty
+    GETKEYSINSLOT, and their share of the finalize broadcast.
+
+    Finalize order per chunk is preserved from the single-slot driver:
+    target first, then source, then the notify list — a lagging node's
+    MOVED always points at a node that already owns the slot.  Returns
+    total keys moved."""
+    slots = list(slots)
+    if not slots:
+        return 0
+    src_id = _check(
+        _request(src_addr, [[b"CLUSTER", b"MYID"]], timeout_s)[0],
+        "CLUSTER MYID (source)",
+    ).decode()
+    dst_id = _check(
+        _request(dst_addr, [[b"CLUSTER", b"MYID"]], timeout_s)[0],
+        "CLUSTER MYID (target)",
+    ).decode()
+    dst_host, dst_port = dst_addr
+    moved = 0
+    src_sock = socket.create_connection(src_addr, timeout=timeout_s)
+    dst_sock = socket.create_connection(dst_addr, timeout=timeout_s)
+    try:
+        for i in range(0, len(slots), chunk):
+            group = slots[i:i + chunk]
+            bslots = [b"%d" % s for s in group]
+            # Pre-flight the whole chunk before ANY migration state.
+            for s, bad in zip(group, _check_all(exchange(src_sock, [
+                [b"CLUSTER", b"MIGRATABLE", bs] for bs in bslots
+            ]), "CLUSTER MIGRATABLE")):
+                if bad:
+                    raise RuntimeError(
+                        f"slot {s} refuses to migrate: {len(bad)} "
+                        f"key(s) of unmigratable kinds"
+                    )
+            _check_all(exchange(dst_sock, [
+                [b"CLUSTER", b"SETSLOT", bs, b"IMPORTING",
+                 src_id.encode()] for bs in bslots
+            ]), "SETSLOT IMPORTING")
+            _check_all(exchange(src_sock, [
+                [b"CLUSTER", b"SETSLOT", bs, b"MIGRATING",
+                 dst_id.encode()] for bs in bslots
+            ]), "SETSLOT MIGRATING")
+            for s, bs in zip(group, bslots):
+                while True:
+                    keys = _check(exchange(src_sock, [
+                        [b"CLUSTER", b"GETKEYSINSLOT", bs, b"%d" % batch],
+                    ])[0], "GETKEYSINSLOT")
+                    if not keys:
+                        break
+                    for key in keys:
+                        r = _check(exchange(src_sock, [[
+                            b"MIGRATE", dst_host.encode(),
+                            b"%d" % dst_port, key, b"0",
+                            b"%d" % int(timeout_s * 1000),
+                        ]])[0], f"MIGRATE {key!r}")
+                        if r == b"OK":
+                            moved += 1
+            fin = [
+                [b"CLUSTER", b"SETSLOT", bs, b"NODE", dst_id.encode()]
+                for bs in bslots
+            ]
+            _check_all(exchange(dst_sock, fin), "SETSLOT NODE (target)")
+            _check_all(exchange(src_sock, fin), "SETSLOT NODE (source)")
+            for addr in notify:
+                if tuple(addr) in (tuple(src_addr), tuple(dst_addr)):
+                    continue
+                _check_all(
+                    _request(tuple(addr), fin, timeout_s),
+                    f"SETSLOT NODE ({addr})",
+                )
+    finally:
+        src_sock.close()
+        dst_sock.close()
+    return moved
+
+
+def _check_all(replies, what: str):
+    for r in replies:
+        _check(r, what)
+    return replies
+
+
 class ClusterSupervisor:
     """Spawn and own N cluster node processes on this host."""
 
@@ -166,6 +259,15 @@ class ClusterSupervisor:
         self._procs: list = []  # subprocess.Popen, index-aligned w/ addrs
         self.addrs: list = []  # (host, port) per node
         self.node_ids: list = []
+        # Elastic membership (ISSUE 19): primaries added after start()
+        # append to addrs/node_ids AND to _procs, so alive()/shutdown()
+        # cover them (the CI no-orphans contract).  _primary_proc_idx
+        # maps an addrs index to its _procs slot (added primaries land
+        # AFTER the replicas in _procs); _drained marks primaries
+        # retired by drain_node (roster keeps their slot — indices stay
+        # stable, like kill_node).
+        self._primary_proc_idx: list = []
+        self._drained: set = set()
         self._tmpdir = None
         self._started = False
         # Metrics federation (ISSUE 13): with metrics=True each node
@@ -316,6 +418,7 @@ class ClusterSupervisor:
             raise
         with self._lock:
             self._procs = procs
+            self._primary_proc_idx = list(range(self.n_nodes))
             self._started = True
         return self
 
@@ -383,20 +486,267 @@ class ClusterSupervisor:
         )
         return self._federation
 
+    def slots_table(self) -> list:
+        """The live ownership table — ``CLUSTER SLOTS`` from the first
+        answering primary, as (start, end, node_id, host, port) rows.
+        Asks the fleet instead of assuming the boot-time partition: the
+        rebalancer (and past migrate_slot calls) move slots, so the
+        static math went stale the moment any slot moved."""
+        last_err = None
+        for i, addr in enumerate(self.addrs):
+            if i in self._drained:
+                continue
+            try:
+                reply = _check(
+                    _request(addr, [[b"CLUSTER", b"SLOTS"]], 5.0)[0],
+                    "CLUSTER SLOTS",
+                )
+                return [
+                    (int(row[0]), int(row[1]), row[2][2].decode(),
+                     row[2][0].decode(), int(row[2][1]))
+                    for row in reply
+                ]
+            except (OSError, RuntimeError, ValueError,
+                    IndexError) as e:
+                last_err = e
+        raise RuntimeError(f"no primary answered CLUSTER SLOTS: {last_err}")
+
+    def slot_owner_addr(self, slot: int):
+        """(host, port) of ``slot``'s CURRENT owner, or None."""
+        for start, end, _nid, host, port in self.slots_table():
+            if start <= slot <= end:
+                return (host, port)
+        return None
+
     def migrate_slot(self, slot: int, dst_index: int,
                      src_index=None, **kw) -> int:
         """Drive a live migration of ``slot`` to node ``dst_index``
-        (source defaults to the slot's current owner per the static
-        partition)."""
+        (source defaults to the slot's current owner per the live
+        CLUSTER SLOTS table — the boot partition stops being true the
+        moment anything reshards)."""
         if src_index is None:
-            per = NSLOTS // self.n_nodes
-            src_index = min(slot // per, self.n_nodes - 1)
-        if src_index == dst_index:
+            src_addr = self.slot_owner_addr(slot)
+            if src_addr is None:
+                raise RuntimeError(f"slot {slot} has no live owner")
+        else:
+            src_addr = tuple(self.addrs[src_index])
+        if tuple(src_addr) == tuple(self.addrs[dst_index]):
             return 0
         return migrate_slot(
-            slot, self.addrs[src_index], self.addrs[dst_index],
+            slot, src_addr, self.addrs[dst_index],
             notify=self.addrs, **kw
         )
+
+    # -- elastic join / drain (ISSUE 19) -----------------------------------
+
+    def _live_topology(self, extra=None) -> dict:
+        """The CURRENT cluster map as a topology dict (what a joining
+        node boots with): every known primary with its live ranges from
+        ``slots_table`` (zero-slot members included — a just-added node
+        owns nothing yet), the replica roster, plus ``extra`` =
+        (node_id, (host, port)) as a new slotless primary."""
+        ranges: dict = {}
+        for start, end, nid, _h, _p in self.slots_table():
+            ranges.setdefault(nid, []).append([start, end])
+        nodes = []
+        for i, (h, p) in enumerate(self.addrs):
+            if i in self._drained:
+                continue
+            nid = self.node_ids[i]
+            nodes.append({
+                "id": nid, "host": h, "port": p,
+                "slots": sorted(ranges.get(nid, [])),
+            })
+        for j, (h, p) in enumerate(self.replica_addrs):
+            pi = j // self.replicas_per_shard
+            nodes.append({
+                "id": self.replica_ids[j], "host": h, "port": p,
+                "slots": [], "role": "replica",
+                "replica_of": self.node_ids[pi],
+            })
+        if extra is not None:
+            nid, (h, p) = extra
+            nodes.append({"id": nid, "host": h, "port": p, "slots": []})
+        return {"nodes": nodes}
+
+    def primary_alive(self, index: int) -> bool:
+        """Is primary ``index`` (addrs numbering) still running?"""
+        with self._lock:
+            p = self._procs[self._primary_proc_idx[index]]
+            return p.poll() is None
+
+    def add_node(self, shift_slots=None, node_args=()) -> int:
+        """Elastic scale-out: spawn one new primary, teach the fleet
+        its identity (``CLUSTER MEET`` broadcast), and shift slots onto
+        it — ``shift_slots=None`` moves an even 1/(n+1) share from the
+        current owners (``0`` to leave the shift to a running
+        rebalancer, which sees a zero-load member and packs/sheds onto
+        it).  Returns the new node's index (addrs numbering).  The
+        process joins the supervisor roster, so ``alive()`` and
+        ``shutdown()`` — the CI no-orphans contract — cover it."""
+        with self._lock:
+            if not self._started:
+                raise RuntimeError("add_node needs a started cluster")
+        nports = 2 if self.metrics else 1
+        ports = self._free_ports(self.host, nports)
+        addr = (self.host, ports[0])
+        nid = "node-%d-%d" % (len(self.node_ids), ports[0])
+        topo_path = os.path.join(self._tmpdir, f"topology-{nid}.json")
+        with open(topo_path, "w") as f:
+            json.dump(self._live_topology(extra=(nid, addr)), f)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = self.platform
+        env.pop("XLA_FLAGS", None)
+        env.update(self.env_extra)
+        log = open(os.path.join(self._tmpdir, f"{nid}0.log"), "wb")
+        argv = [sys.executable, "-m", "redisson_tpu",
+                "--host", addr[0], "--port", str(addr[1]),
+                "--platform", self.platform,
+                "--cluster",
+                "--cluster-topology", topo_path,
+                "--cluster-myid", nid]
+        if self.replicas_per_shard:
+            argv += self._durability_args(nid)
+        if self.metrics:
+            argv += ["--metrics-port", str(ports[1])]
+        if self.frontdoor_processes > 1:
+            argv += ["--frontdoor-processes",
+                     str(self.frontdoor_processes)]
+        proc = subprocess.Popen(
+            argv + self.node_args + list(node_args),
+            stdout=log, stderr=subprocess.STDOUT, env=env,
+        )
+        log.close()
+        # Roster BEFORE readiness: even a boot that dies half-way is
+        # the supervisor's to reap (shutdown() must leave no orphans).
+        with self._lock:
+            self._procs.append(proc)
+            self._primary_proc_idx.append(len(self._procs) - 1)
+        index = len(self.addrs)
+        self.addrs.append(addr)
+        self.node_ids.append(nid)
+        if self.metrics:
+            self.metrics_addrs.append((self.host, ports[1]))
+        self._await_ready([proc], [addr], nid)
+        # Existing members learn the new id/address — without this
+        # their slot maps cannot SETSLOT toward the newcomer.
+        meet = [[b"CLUSTER", b"MEET", nid.encode(),
+                 addr[0].encode(), b"%d" % addr[1]]]
+        for i, a in enumerate(self.addrs[:-1]):
+            if i in self._drained:
+                continue
+            try:
+                _check(_request(tuple(a), meet, 5.0)[0], "CLUSTER MEET")
+            except OSError:
+                pass  # dead member; failover owns that problem
+        for a in self.replica_addrs:
+            try:
+                _check(_request(tuple(a), meet, 5.0)[0], "CLUSTER MEET")
+            except OSError:
+                pass
+        if shift_slots is None or shift_slots > 0:
+            self._shift_share_to(index, shift_slots)
+        return index
+
+    def _shift_share_to(self, index: int, limit=None) -> int:
+        """Bulk-move an even share of every current owner's slots onto
+        primary ``index`` (the supervisor-driven half of elastic join,
+        for fleets not running the rebalancer)."""
+        nid = self.node_ids[index]
+        by_owner: dict = {}
+        for start, end, owner, host, port in self.slots_table():
+            if owner == nid:
+                continue
+            by_owner.setdefault((owner, (host, port)), []).extend(
+                range(start, end + 1)
+            )
+        if not by_owner:
+            return 0
+        # Even final share: new member ends with total/(owners+1).
+        total = sum(len(v) for v in by_owner.values())
+        share = total // (len(by_owner) + 1)
+        if limit is not None:
+            share = min(share, int(limit))
+        moved = 0
+        remaining = share
+        for (owner, src_addr), slots in sorted(by_owner.items()):
+            if remaining <= 0:
+                break
+            take = min(len(slots) * share // total + 1, remaining,
+                       len(slots))
+            chunk = sorted(slots)[-take:]
+            moved += migrate_slots_bulk(
+                chunk, tuple(src_addr), tuple(self.addrs[index]),
+                notify=[
+                    a for i, a in enumerate(self.addrs)
+                    if i not in self._drained
+                ] + list(self.replica_addrs),
+            )
+            remaining -= take
+        return moved
+
+    def drain_node(self, index: int, timeout_s: float = 30.0) -> bool:
+        """Elastic scale-in, the add_node inverse: bulk-migrate every
+        slot off primary ``index`` (distributed across the remaining
+        alive primaries), verify it owns nothing, and only THEN retire
+        the process (SIGTERM, SIGKILL fallback).  Returns True when the
+        node exited cleanly from the SIGTERM.  The roster keeps its
+        slot so indices stay stable; ``alive()`` drops it."""
+        nid = self.node_ids[index]
+        targets = [
+            i for i in range(len(self.addrs))
+            if i != index and i not in self._drained
+            and self.primary_alive(i)
+        ]
+        if not targets:
+            raise RuntimeError("drain_node needs another alive primary")
+        owned = [
+            s
+            for start, end, owner, _h, _p in self.slots_table()
+            if owner == nid
+            for s in range(start, end + 1)
+        ]
+        notify = [
+            a for i, a in enumerate(self.addrs)
+            if i not in self._drained
+        ] + list(self.replica_addrs)
+        # Round-robin contiguous shares across the survivors.
+        per = (len(owned) + len(targets) - 1) // max(1, len(targets))
+        for k, t in enumerate(targets):
+            chunk = owned[k * per:(k + 1) * per]
+            if not chunk:
+                break
+            migrate_slots_bulk(
+                chunk, tuple(self.addrs[index]),
+                tuple(self.addrs[t]), notify=notify,
+            )
+        left = [
+            (start, end)
+            for start, end, owner, _h, _p in self.slots_table()
+            if owner == nid
+        ]
+        if left:
+            raise RuntimeError(
+                f"drain of {nid} left it owning {left!r}"
+            )
+        self._drained.add(index)
+        with self._lock:
+            p = self._procs[self._primary_proc_idx[index]]
+        try:
+            p.send_signal(signal.SIGTERM)
+        except OSError:
+            pass
+        try:
+            p.wait(timeout=timeout_s)
+            clean = True
+        except subprocess.TimeoutExpired:
+            clean = False
+            p.kill()
+            try:
+                p.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass
+        return clean and p.poll() is not None
 
     def replica_index(self, primary_index: int, k: int = 0) -> int:
         """Roster index of ``primary_index``'s k-th replica — the

@@ -386,6 +386,25 @@ class Config:
         self.repl_max_staleness_ops = 0
         self.cluster_node_timeout_ms = 1500
         self.cluster_ping_interval_ms = 300
+        # Autonomous rebalancer (ISSUE 19).  ``rebalance_enabled`` arms
+        # the per-node control loop (cluster/rebalancer.py): every armed
+        # node scrapes the fleet's CLUSTER LOADMAPs into a smoothed
+        # per-slot heat EWMA; the coordinator (lowest-id alive primary)
+        # additionally executes migration waves.  The damping knobs —
+        # all live-settable via CONFIG SET rebalance-* — implement the
+        # Memcache-at-Facebook churn lesson: ``rebalance_threshold`` is
+        # the imbalance ratio (max node load / mean) that triggers a
+        # wave, ``rebalance_max_moves`` caps migrations per wave,
+        # ``rebalance_pace_ms`` breathes between consecutive pumps (the
+        # p99 bound during a wave), and ``rebalance_cooldown_ms`` keeps
+        # a just-moved slot untouchable so the loop can never ping-pong
+        # one slot between two nodes.
+        self.rebalance_enabled = False
+        self.rebalance_interval_ms = 1000
+        self.rebalance_threshold = 1.3
+        self.rebalance_max_moves = 8
+        self.rebalance_pace_ms = 50
+        self.rebalance_cooldown_ms = 15000
 
     # -- fluent setters, mirroring the Java builder idiom ------------------
 
@@ -457,6 +476,12 @@ class Config:
         "repl_max_staleness_ops",
         "cluster_node_timeout_ms",
         "cluster_ping_interval_ms",
+        "rebalance_enabled",
+        "rebalance_interval_ms",
+        "rebalance_threshold",
+        "rebalance_max_moves",
+        "rebalance_pace_ms",
+        "rebalance_cooldown_ms",
     )
 
     def to_dict(self) -> dict:

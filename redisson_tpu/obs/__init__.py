@@ -320,6 +320,26 @@ class Observability:
             "rtpu_failover_takeovers",
             "slot takeovers this node performed after winning an "
             "election (or via manual FAILOVER promotion)")
+        # Autonomous rebalancer (ISSUE 19).  `decisions` kinds: planned
+        # (moves a wave scheduled), moved, failed, and the last-moment
+        # vetoes skip_busy / skip_stale / skip_failover.
+        self.rebalancer_decisions = r.counter(
+            "rtpu_rebalancer_decisions",
+            "rebalancer planning/execution decisions by kind",
+            ("kind",))
+        self.rebalancer_keys_moved = r.counter(
+            "rtpu_rebalancer_keys_moved",
+            "keys migrated by rebalancer-driven slot moves")
+        self.rebalancer_migration_seconds = r.histogram(
+            "rtpu_rebalancer_migration_seconds",
+            "wall seconds per rebalancer-driven slot migration")
+        self.rebalancer_imbalance_source = None  # wired by the agent
+        r.gauge_callback(
+            "rtpu_rebalancer_imbalance_ratio",
+            "fleet imbalance (max node load / mean) from the planner's "
+            "smoothed heat model; 1.0 = perfectly level",
+            lambda: float(self.rebalancer_imbalance_source())
+            if self.rebalancer_imbalance_source is not None else 1.0)
         self.repl_offset_source = None  # wired by the RESP door
         self.repl_lag_source = None
         r.gauge_callback(

@@ -813,15 +813,36 @@ class RespServer:
             reg = getattr(
                 getattr(client, "_engine", None), "registry", None
             )
+            # Slot->key index (ISSUE 19): rides the SAME keyspace hooks
+            # as the load map's exact counters — one fan-out closure
+            # feeds counts (loadmap) and names (slotindex), so the two
+            # planes can never disagree about which writes were seen.
+            # Cluster-only: single-node servers have no GETKEYSINSLOT
+            # callers and the scan stays fine.
+            idx = None
+            if self.cluster is not None and (
+                    grid is not None and reg is not None):
+                from redisson_tpu.cluster.slotindex import SlotKeyIndex
+
+                idx = SlotKeyIndex()
+
+                def _keyspace_note(name, delta, _lm=lm, _idx=idx):
+                    _lm.note_key(name, delta)
+                    _idx.note(name, delta)
+            else:
+                _keyspace_note = lm.note_key
             if grid is not None:
-                grid.on_keyspace = lm.note_key
+                grid.on_keyspace = _keyspace_note
             if reg is not None:
-                reg.on_keyspace = lm.note_key
+                reg.on_keyspace = _keyspace_note
             if grid is not None or reg is not None:
                 lm.seed_keys(client.get_keys().get_keys())
                 self._loadmap_keys_exact = (
                     grid is not None and reg is not None
                 )
+            if idx is not None:
+                idx.seed(client.get_keys().get_keys())
+                self.cluster.slot_index = idx
         except Exception:
             self._loadmap_keys_exact = False
         # Reactor front door (ISSUE 11 tentpole): a small fixed pool of
@@ -890,6 +911,9 @@ class RespServer:
         self.repl_hub = None
         self.replica_link = None
         self.failover = None
+        # Autonomous rebalancer agent (cluster/rebalancer.py) when
+        # armed via --rebalance / config rebalance_enabled.
+        self.rebalancer = None
         self._repl_hub()  # eager when the journal is already attached
         self._obs_wire_repl_gauges()
         master = getattr(client.config, "replica_of", None)
@@ -1100,7 +1124,12 @@ class RespServer:
                 self._conn_idle.wait(timeout=remaining)
         # Replication plane down BEFORE the client engine can shut down
         # under it: the link thread applies into the engine, the
-        # failover agent dials peers, the hub taps the journal.
+        # failover agent dials peers, the hub taps the journal.  The
+        # rebalancer first — mid-wave it drives migrations THROUGH the
+        # failover-tracked peers.
+        rb = getattr(self, "rebalancer", None)
+        if rb is not None:
+            rb.stop()
         fo = getattr(self, "failover", None)
         if fo is not None:
             fo.stop()
@@ -2514,6 +2543,20 @@ class RespServer:
                 "loadmap-key-sample-rate": f"{lm.sample_rate:g}",
                 "loadmap-enabled": "yes" if lm.enabled else "no",
             })
+        rb = getattr(self, "rebalancer", None)
+        if rb is not None:
+            # Autonomous rebalancer (ISSUE 19): damping knobs live-apply
+            # to the agent/planner; rows register only when the agent is
+            # armed (acking them unarmed would fake the capability).
+            table.update({
+                "rebalance-threshold": f"{rb.planner.threshold:g}",
+                "rebalance-interval-ms": str(int(rb.interval_s * 1000)),
+                "rebalance-max-moves": str(rb.planner.max_moves),
+                "rebalance-pace-ms": str(int(rb.pace_s * 1000)),
+                "rebalance-cooldown-ms": str(
+                    int(rb.planner.cooldown_s * 1000)
+                ),
+            })
         rm = self._residency()
         if rm is not None:
             # Tiered residency (ISSUE 14): budgets and the promotion
@@ -2690,6 +2733,56 @@ class RespServer:
         elif key == "loadmap-enabled":
             lm.enabled = val.lower() in ("yes", "1", "true", "on")
 
+    _REBALANCE_KEYS = frozenset((
+        "rebalance-threshold", "rebalance-interval-ms",
+        "rebalance-max-moves", "rebalance-pace-ms",
+        "rebalance-cooldown-ms",
+    ))
+
+    def _validate_rebalance_config(self, key: str, raw: bytes) -> None:
+        if key == "rebalance-threshold":
+            try:
+                fv = float(raw)
+            except ValueError:
+                raise RespError(
+                    f"Invalid argument '{raw.decode()}' for CONFIG SET "
+                    f"'{key}'"
+                )
+            if fv < 1.0:
+                raise RespError(
+                    f"argument must be >= 1.0 for CONFIG SET '{key}'"
+                )
+            return
+        try:
+            iv = int(raw)
+        except ValueError:
+            raise RespError(
+                f"Invalid argument '{raw.decode()}' for CONFIG SET "
+                f"'{key}'"
+            )
+        floor = 1 if key in (
+            "rebalance-interval-ms", "rebalance-max-moves"
+        ) else 0
+        if iv < floor:
+            raise RespError(
+                f"argument must be >= {floor} for CONFIG SET '{key}'"
+            )
+
+    def _apply_rebalance_config(self, key: str, val: str) -> None:
+        rb = getattr(self, "rebalancer", None)
+        if rb is None:
+            return
+        if key == "rebalance-threshold":
+            rb.planner.threshold = float(val)
+        elif key == "rebalance-interval-ms":
+            rb.interval_s = int(val) / 1000.0
+        elif key == "rebalance-max-moves":
+            rb.planner.max_moves = int(val)
+        elif key == "rebalance-pace-ms":
+            rb.pace_s = int(val) / 1000.0
+        elif key == "rebalance-cooldown-ms":
+            rb.planner.cooldown_s = int(val) / 1000.0
+
     def _validate_overload_config(self, key: str, raw: bytes) -> None:
         def bad(msg: str):
             raise RespError(
@@ -2788,6 +2881,8 @@ class RespServer:
                     self._validate_telemetry_config(key, pairs[i + 1])
                 elif key in self._LOADMAP_KEYS:
                     self._validate_loadmap_config(key, pairs[i + 1])
+                elif key in self._REBALANCE_KEYS:
+                    self._validate_rebalance_config(key, pairs[i + 1])
                 elif key == "appendonly":
                     v = pairs[i + 1].decode().lower()
                     if v not in ("yes", "no"):
@@ -2902,6 +2997,8 @@ class RespServer:
                     self._apply_telemetry_config(key, val)
                 elif key in self._LOADMAP_KEYS:
                     self._apply_loadmap_config(key, val)
+                elif key in self._REBALANCE_KEYS:
+                    self._apply_rebalance_config(key, val)
                 elif key.startswith("nearcache"):
                     self._apply_nearcache_config(key, val)
             return _encode_simple("OK")
@@ -3565,7 +3662,9 @@ class RespServer:
             # O(1) per-slot key counters behind CLUSTER COUNTKEYSINSLOT
             # — re-hashes every live key name, so tests (and a
             # suspicious operator) can diff the counter against ground
-            # truth without trusting the hook coverage.
+            # truth without trusting the hook coverage.  Explicitly the
+            # scan (NOT the ISSUE 19 slot index): this command IS the
+            # ground truth both fast paths are diffed against.
             if len(args) < 2:
                 raise RespError("DEBUG COUNTKEYSINSLOT <slot>")
             try:
@@ -3573,9 +3672,30 @@ class RespServer:
             except ValueError:
                 raise RespError("value is not an integer or out of range")
             if self.cluster is not None:
-                return _encode_int(len(self.cluster.keys_in_slot(slot)))
+                return _encode_int(
+                    len(self.cluster.keys_in_slot_scan(slot))
+                )
             n = self._client.get_keys().count()
             return _encode_int(n if slot == 0 else 0)
+        if sub == "GETKEYSINSLOT":
+            # ISSUE 19 satellite: ground-truth twin of the above for
+            # key NAMES — the full-keyspace re-hash scan that CLUSTER
+            # GETKEYSINSLOT used before the write-time slot index.
+            # Index vs scan set-equality is the index's differential
+            # test.
+            if len(args) < 2:
+                raise RespError("DEBUG GETKEYSINSLOT <slot> [count]")
+            try:
+                slot = int(args[1])
+                count = int(args[2]) if len(args) > 2 else None
+            except ValueError:
+                raise RespError("value is not an integer or out of range")
+            if self.cluster is None:
+                raise RespError("DEBUG GETKEYSINSLOT requires cluster mode")
+            return _encode_array([
+                k.encode()
+                for k in self.cluster.keys_in_slot_scan(slot, count)
+            ])
         raise RespError(f"unsupported DEBUG subcommand {sub}")
 
     def _cmd_OBJECT(self, args):
@@ -4285,7 +4405,7 @@ class RespServer:
     _INFO_DEFAULT = (
         "server", "clients", "memory", "stats", "persistence",
         "replication", "nearcache", "frontdoor", "overload", "cluster",
-        "telemetry", "loadstats", "keyspace",
+        "rebalance", "telemetry", "loadstats", "keyspace",
     )
 
     def _cmd_INFO(self, args):
@@ -4634,6 +4754,34 @@ class RespServer:
                     f"latency_samples:{ls['samples']}",
                     f"monitors:{len(self._monitors)}",
                 ]
+            elif s == "rebalance":
+                # Autonomous rebalancer (ISSUE 19): knobs as literals
+                # so the served-config coherence pass (RT004) ties the
+                # CONFIG SET rows to an operator-visible INFO surface,
+                # plus the agent's live wave counters.
+                rb = getattr(self, "rebalancer", None)
+                lines.append("# Rebalance")
+                if rb is None:
+                    lines.append("rebalance_enabled:0")
+                else:
+                    st = rb.status()
+                    lines += [
+                        "rebalance_enabled:1",
+                        f"rebalance_paused:{1 if st['paused'] else 0}",
+                        "rebalance_is_coordinator:"
+                        f"{1 if st['is_coordinator'] else 0}",
+                        f"rebalance_threshold:{st['threshold']:g}",
+                        f"rebalance_interval_ms:{st['interval_ms']}",
+                        f"rebalance_max_moves:{st['max_moves']}",
+                        f"rebalance_pace_ms:{st['pace_ms']}",
+                        f"rebalance_cooldown_ms:{st['cooldown_ms']}",
+                        "rebalance_imbalance_ratio:"
+                        f"{st['imbalance_ratio']:g}",
+                        f"rebalance_waves:{st['waves']}",
+                        f"rebalance_slots_moved:{st['slots_moved']}",
+                        f"rebalance_keys_moved:{st['keys_moved']}",
+                        f"rebalance_failures:{st['failures']}",
+                    ]
             elif s == "loadstats":
                 # Load-attribution plane (ISSUE 16): the loadmap's
                 # totals, hottest slots/keys, and the per-tenant
@@ -5072,6 +5220,65 @@ class RespServer:
             return _encode_array([
                 k.encode() for k in door.keys_in_slot(int(args[1]), count)
             ])
+        if sub == "MEET":
+            # Elastic join (ISSUE 19): teach this node a new member's
+            # id/address so slots can be SETSLOT'd onto it.  Argument
+            # shape is `MEET <id> <host> <port>` — ids are explicit in
+            # this cluster (no gossip handshake to mint one).
+            if len(args) < 4:
+                raise RespError("CLUSTER MEET needs an id, host and port")
+            door.slotmap.add_node(
+                self._s(args[1]), self._s(args[2]), int(args[3])
+            )
+            return _encode_simple("OK")
+        if sub == "REBALANCE":
+            # Autonomous rebalancer surface (ISSUE 19).  STATUS works
+            # even unarmed (reports enabled=false) so operators can
+            # probe; the verbs require the agent.
+            import json
+
+            verb = (
+                self._s(args[1]).upper() if len(args) > 1 else "STATUS"
+            )
+            rb = getattr(self, "rebalancer", None)
+            if verb == "STATUS":
+                if rb is None:
+                    payload = {"enabled": False}
+                else:
+                    payload = rb.status()
+                payload["node"] = door.myid
+                return _encode_bulk(json.dumps(payload).encode())
+            if rb is None:
+                raise RespError(
+                    "rebalancer is not armed on this node "
+                    "(start with --rebalance)"
+                )
+            if verb == "PAUSE":
+                rb.pause()
+                return _encode_simple("OK")
+            if verb == "RESUME":
+                rb.resume()
+                return _encode_simple("OK")
+            if verb == "NOW":
+                # Synchronous forced tick in this connection's thread:
+                # the reply carries how many migrations the wave ran,
+                # so scripts can drive rebalancing step by step.
+                return _encode_int(rb.tick(force=True))
+            if verb == "DRAIN":
+                if len(args) < 3:
+                    raise RespError("CLUSTER REBALANCE DRAIN needs a node id")
+                rb.planner.drain(self._s(args[2]))
+                return _encode_simple("OK")
+            if verb == "UNDRAIN":
+                if len(args) < 3:
+                    raise RespError(
+                        "CLUSTER REBALANCE UNDRAIN needs a node id"
+                    )
+                rb.planner.undrain(self._s(args[2]))
+                return _encode_simple("OK")
+            raise RespError(
+                f"Unknown CLUSTER REBALANCE verb '{verb.lower()}'"
+            )
         raise RespError(
             f"Unknown CLUSTER subcommand or wrong number of arguments "
             f"for '{sub.lower()}'"

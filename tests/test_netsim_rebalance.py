@@ -1,0 +1,400 @@
+"""netsim rebalancer models (ISSUE 19): the REAL assigner executor —
+``rebalancer.run_wave`` with its last-moment ``blocked_reason`` gate —
+racing organic ``supervisor.migrate_slot`` pumps, stale plans left by
+failover takeovers, and failover-excluded nodes, over the simulated
+network with the schedule explorer enumerating interleavings.
+
+Invariant in EVERY schedule: the fleet's slot maps converge on exactly
+one owner per slot, and every acked value is readable at that owner —
+the assigner can only ever skip (busy / stale / failover), never strand
+a slot unowned or doubly-owned.
+
+The two mutation guards revert the assigner's protections one at a
+time — :func:`rebalancer.slot_in_migration` (a second driver races a
+mid-pump slot toward a DIFFERENT destination: divergent owners) and
+:func:`rebalancer.owner_matches` (a stale plan finalizes ownership away
+from the node actually holding the keys: acked data stranded) — and
+assert the models CATCH the regression with a replayable
+``RTPU_SCHEDULE_REPLAY`` token.
+"""
+
+import threading
+
+import pytest
+
+from redisson_tpu.analysis import netsim
+from redisson_tpu.analysis.explorer import (
+    ScheduleFailure,
+    explore,
+    schedule_test,
+)
+from redisson_tpu.cluster import rebalancer as rb_mod
+from redisson_tpu.cluster import supervisor as supervisor_mod
+from redisson_tpu.cluster.client import ClusterClient
+from redisson_tpu.cluster.rebalancer import Move, RebalancePlanner, run_wave
+from redisson_tpu.cluster.slots import NSLOTS, key_slot
+from test_netsim import MiniClusterNode
+
+pytestmark = pytest.mark.netsim
+
+
+@pytest.fixture(autouse=True)
+def _unpatch_network():
+    """A failing schedule abandons the body mid-``with Net()``; restore
+    the real socket layer so later tests don't dial the dead sim."""
+    yield
+    netsim.restore_patches()
+
+
+ADDR_A = ("rb-node-a", 7101)
+ADDR_B = ("rb-node-b", 7102)
+ADDR_C = ("rb-node-c", 7103)
+
+TOPO3 = {"nodes": [
+    {"id": "A", "host": ADDR_A[0], "port": ADDR_A[1],
+     "slots": [[0, NSLOTS - 1]]},
+    {"id": "B", "host": ADDR_B[0], "port": ADDR_B[1], "slots": []},
+    {"id": "C", "host": ADDR_C[0], "port": ADDR_C[1], "slots": []},
+]}
+
+KEY = b"k"
+SLOT = key_slot(KEY)
+
+
+def _hot_key_pair():
+    """Two keys in two DIFFERENT slots (the planner-driven wave model
+    needs a divisible load: one mega slot legally never moves)."""
+    first = b"h0"
+    for i in range(1, 100000):
+        k = b"h%d" % i
+        if key_slot(k) != key_slot(first):
+            return first, k
+    raise AssertionError("no second slot found")
+
+
+HOT1, HOT2 = _hot_key_pair()
+
+
+def _spawn3(net):
+    na = MiniClusterNode(net, ADDR_A, "A", TOPO3)
+    nb = MiniClusterNode(net, ADDR_B, "B", TOPO3)
+    nc = MiniClusterNode(net, ADDR_C, "C", TOPO3)
+    return na, nb, nc
+
+
+def _client(*seeds):
+    c = ClusterClient(list(seeds), timeout_s=30.0, deadnode_attempts=0)
+    c._pool = netsim.SimThreadExecutor()
+    return c
+
+
+def _assert_converged(nodes, slot, owner, key, value):
+    """The never-strand invariant: every map agrees on ``owner``, no
+    residual migration state, and the acked ``value`` lives exactly at
+    the owner."""
+    by_id = {n.door.myid: n for n in nodes}
+    owners = {n.door.myid: n.slotmap.owner(slot) for n in nodes}
+    assert set(owners.values()) == {owner}, (
+        f"divergent ownership for slot {slot}: {owners}"
+    )
+    for n in nodes:
+        d = n.slotmap.lookup(slot)
+        assert d.importing_from is None and d.migrating_to is None, (
+            f"{n.door.myid} kept migration state on finalized slot "
+            f"{slot}"
+        )
+    holder = by_id[owner]
+    assert holder.store.get(key.decode()) == value, (
+        f"acked value stranded: owner {owner} holds "
+        f"{holder.store.get(key.decode())!r}, expected {value!r}"
+    )
+    for n in nodes:
+        if n is not holder:
+            assert key.decode() not in n.store, (
+                f"key duplicated on non-owner {n.door.myid}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# model 1: the assigner races a mid-pump organic migration
+# ---------------------------------------------------------------------------
+
+
+def _busy_race_body():
+    """An organic ``migrate_slot`` A->B is mid-pump (IMPORTING/MIGRATING
+    already up) when the assigner executes a wave moving the SAME slot
+    toward C.  The last-moment ``blocked_reason`` must turn the wave
+    away (busy while pumping, stale once finalized) in EVERY
+    interleaving — a second driver finalizing the slot toward a
+    different destination than the one receiving keys is exactly how a
+    slot ends up doubly-owned."""
+    with netsim.Net() as net:
+        na, nb, nc = _spawn3(net)
+        na.store[KEY.decode()] = b"0"
+        na.slotmap.set_migrating(SLOT, "B")
+        nb.slotmap.set_importing(SLOT, "A")
+        records = []
+
+        def organic():
+            # Resumable by design: a racing driver re-runs the pump.
+            for _ in range(4):
+                try:
+                    supervisor_mod.migrate_slot(
+                        SLOT, ADDR_A, ADDR_B,
+                        notify=(ADDR_A, ADDR_B, ADDR_C), batch=4,
+                    )
+                except (OSError, RuntimeError):
+                    continue
+                return
+            raise AssertionError("organic pump never completed")
+
+        def assigner():
+            records.extend(run_wave(
+                na.slotmap, [Move(SLOT, "A", "C", 1.0)]
+            ))
+
+        ot = threading.Thread(target=organic)
+        at = threading.Thread(target=assigner)
+        ot.start()
+        at.start()
+        ot.join()
+        at.join()
+        assert records and records[0]["outcome"] in (
+            "skip_busy", "skip_stale"
+        ), records
+        _assert_converged((na, nb, nc), SLOT, "B", KEY, b"0")
+
+
+@schedule_test(max_schedules=40, random_schedules=24, preemption_bound=2,
+               max_steps=200000)
+def test_model_assigner_skips_mid_pump_slot():
+    _busy_race_body()
+
+
+def test_model_busy_check_mutation_guard():
+    """Reverting the in-migration check (netsim guard #1): the wave no
+    longer sees the organic pump and drives a second migration of the
+    same slot toward C — some schedule diverges the fleet's owner maps
+    or strands the key, and the failure replays from its token."""
+    orig = rb_mod.slot_in_migration
+    rb_mod.slot_in_migration = lambda slotmap, slot: False
+    try:
+        with pytest.raises(ScheduleFailure) as ei:
+            explore(_busy_race_body, max_schedules=40,
+                    random_schedules=24, preemption_bound=2,
+                    max_steps=200000)
+        token = ei.value.token
+        with pytest.raises(ScheduleFailure) as ei2:
+            explore(_busy_race_body, replay=token, max_steps=200000)
+        assert ei2.value.token == token
+    finally:
+        rb_mod.slot_in_migration = orig
+
+
+# ---------------------------------------------------------------------------
+# model 2: a stale plan after the slot already moved (takeover/reshard)
+# ---------------------------------------------------------------------------
+
+
+def _stale_plan_body():
+    """Between planning and execution the slot finalized A->B (organic
+    reshard or a failover takeover) and the acked value lives on B.
+    The stale move still says "pump A->C"; ``owner_matches`` against
+    the coordinator's CURRENT map must skip it — executing would
+    finalize ownership to C while B holds the only copy of the data
+    (acked write lost for every future reader)."""
+    with netsim.Net() as net:
+        na, nb, nc = _spawn3(net)
+        for n in (na, nb, nc):
+            n.slotmap.set_owner(SLOT, "B")
+        nb.store[KEY.decode()] = b"1"
+        client = _client(ADDR_B)
+        stale = Move(SLOT, "A", "C", 1.0)
+        records = []
+
+        def assigner():
+            records.extend(run_wave(nc.slotmap, [stale]))
+
+        def reader():
+            assert client.execute(b"GET", KEY) == b"1", (
+                "acked value unreadable after the stale wave"
+            )
+
+        at = threading.Thread(target=assigner)
+        rt = threading.Thread(target=reader)
+        at.start()
+        rt.start()
+        at.join()
+        rt.join()
+        assert records and records[0]["outcome"] == "skip_stale", records
+        _assert_converged((na, nb, nc), SLOT, "B", KEY, b"1")
+        assert client.execute(b"GET", KEY) == b"1"
+        client.close()
+
+
+@schedule_test(max_schedules=30, random_schedules=16, preemption_bound=2,
+               max_steps=200000)
+def test_model_assigner_skips_stale_plan():
+    _stale_plan_body()
+
+
+def test_model_owner_check_mutation_guard():
+    """Reverting the owner re-check (netsim guard #2): the stale plan
+    pumps from a node that no longer owns the slot — the empty pump
+    happily finalizes NODE C fleet-wide while the acked value sits on
+    B, and the reader loses it.  Caught with a replayable token."""
+    orig = rb_mod.owner_matches
+    rb_mod.owner_matches = lambda slotmap, move: True
+    try:
+        with pytest.raises(ScheduleFailure) as ei:
+            explore(_stale_plan_body, max_schedules=30,
+                    random_schedules=16, preemption_bound=2,
+                    max_steps=200000)
+        token = ei.value.token
+        with pytest.raises(ScheduleFailure) as ei2:
+            explore(_stale_plan_body, replay=token, max_steps=200000)
+        assert ei2.value.token == token
+    finally:
+        rb_mod.owner_matches = orig
+
+
+# ---------------------------------------------------------------------------
+# model 3: failover-excluded nodes are untouchable (and undialed)
+# ---------------------------------------------------------------------------
+
+
+CS = (SLOT + 1) % NSLOTS  # a slot C owns in the exclusion model
+
+TOPO_C_OWNS = {"nodes": [
+    {"id": "A", "host": ADDR_A[0], "port": ADDR_A[1],
+     "slots": [r for r in ([0, CS - 1], [CS + 1, NSLOTS - 1])
+               if r[0] <= r[1]]},
+    {"id": "B", "host": ADDR_B[0], "port": ADDR_B[1], "slots": []},
+    {"id": "C", "host": ADDR_C[0], "port": ADDR_C[1],
+     "slots": [[CS, CS]]},
+]}
+
+
+def _failover_exclusion_body():
+    """C is marked failed by the failover plane: a wave scheduled
+    before the verdict must skip every move touching C — as source
+    (its keys are unreachable) and as destination (landing slots on a
+    dead node IS stranding them) — without opening one socket to it."""
+    with netsim.Net() as net:
+        na = MiniClusterNode(net, ADDR_A, "A", TOPO_C_OWNS)
+        nb = MiniClusterNode(net, ADDR_B, "B", TOPO_C_OWNS)
+        nc = MiniClusterNode(net, ADDR_C, "C", TOPO_C_OWNS)
+        na.store[KEY.decode()] = b"0"
+        recs = run_wave(na.slotmap, [
+            Move(SLOT, "A", "C", 2.0),
+            Move(CS, "C", "B", 1.0),
+        ], excluded=("C",))
+        assert [r["outcome"] for r in recs] == [
+            "skip_failover", "skip_failover"
+        ], recs
+        assert nc.counts == {}, (
+            f"wave dialed the failed node: {nc.counts}"
+        )
+        _assert_converged((na, nb, nc), SLOT, "A", KEY, b"0")
+
+
+@schedule_test(max_schedules=10, random_schedules=4, preemption_bound=1)
+def test_model_assigner_never_touches_failed_node():
+    _failover_exclusion_body()
+
+
+# ---------------------------------------------------------------------------
+# model 4: a planner-driven wave under concurrent acked writes
+# ---------------------------------------------------------------------------
+
+
+def _planned_wave_body():
+    """The full assigner loop over the sim: the PURE planner ingests a
+    skewed load (two hot slots on A, B idle), plans a shed wave, and
+    ``run_wave`` executes it through the real migration pump while a
+    writer keeps landing acked writes on a moving slot.  In every
+    schedule: the planned slot finalizes on B fleet-wide and the last
+    ACKED value is what a read returns — the assigner inherits
+    migrate_slot's zero-acked-write-loss discipline wholesale."""
+    with netsim.Net() as net:
+        na, nb, nc = _spawn3(net)
+        s1, s2 = key_slot(HOT1), key_slot(HOT2)
+        na.store[HOT1.decode()] = b"0"
+        na.store[HOT2.decode()] = b"0"
+        planner = RebalancePlanner(warmup_ticks=1, threshold=1.2)
+        planner.observe({"A": {s1: (0.0, 0.0, 1), s2: (0.0, 0.0, 1)}},
+                        now=0.0)
+        planner.observe(
+            {"A": {s1: (100.0, 0.0, 1), s2: (100.0, 0.0, 1)}}, now=1.0
+        )
+        owners = {s1: "A", s2: "A"}
+        moves = planner.plan(owners, ["A", "B"], excluded=("C",), now=1.0)
+        # Equal heat, ratio 2.0: exactly one slot sheds (the second
+        # would overshoot past the mega-slot half-gap rule).
+        assert len(moves) == 1 and moves[0].dst == "B"
+        hot_key = HOT1 if moves[0].slot == s1 else HOT2
+        client = _client(ADDR_A, ADDR_B)
+        acked = [b"0"]
+
+        def wave():
+            recs = []
+            for _ in range(4):
+                recs = run_wave(na.slotmap, moves, excluded=("C",),
+                                batch=4)
+                if recs and recs[0]["outcome"] == "moved":
+                    return
+            raise AssertionError(f"wave never completed: {recs}")
+
+        # The writer targets the key on the MOVING slot so schedules
+        # land writes before, during, and after the pump.
+        wt = threading.Thread(
+            target=lambda: _writes(client, hot_key, acked)
+        )
+        pt = threading.Thread(target=wave)
+        wt.start()
+        pt.start()
+        wt.join()
+        pt.join()
+        _assert_converged(
+            (na, nb, nc), moves[0].slot, "B", hot_key, acked[-1]
+        )
+        final = client.execute(b"GET", hot_key)
+        assert final == acked[-1], (
+            f"acked write lost across the planned wave: read {final!r},"
+            f" last acked {acked[-1]!r}"
+        )
+        client.close()
+
+
+def _writes(client, key, acked, n=2):
+    """Acked writes retried through fault windows (idempotent SET: the
+    ACK is the contract, un-acked attempts are unconstrained)."""
+    import time
+
+    from redisson_tpu.cluster.client import ClusterError
+    from redisson_tpu.serve.wireutil import ReplyError
+
+    for i in range(1, n + 1):
+        val = b"%d" % i
+        for _ in range(60):
+            try:
+                r = client.execute(b"SET", key, val)
+            except (OSError, ClusterError):
+                time.sleep(0.05)  # virtual
+                continue
+            except ReplyError as e:
+                if e.code in ("TRYAGAIN", "CLUSTERDOWN"):
+                    time.sleep(0.05)
+                    continue
+                raise
+            assert r == b"OK"
+            acked.append(val)
+            break
+        else:
+            raise AssertionError("write never acked")
+
+
+@schedule_test(max_schedules=50, random_schedules=24, preemption_bound=2,
+               max_steps=300000)
+def test_model_planned_wave_no_acked_write_lost():
+    _planned_wave_body()

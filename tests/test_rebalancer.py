@@ -1,0 +1,655 @@
+"""Autonomous rebalancer (ISSUE 19): the pure planner's damping rules
+(EWMA warmup, hysteresis dead band, per-slot cooldown, the mega-slot
+refusal, drain and cold-pack phases), the last-moment eligibility
+predicates, the write-time slot->key index and its DEBUG-scan
+differential, the CLUSTER REBALANCE / CONFIG surfaces, the heat-driven
+end-to-end loop over two in-process cluster nodes, fleet_loadmap's
+dead-member degradation, and (slow-marked) elastic join/drain through
+the subprocess supervisor.
+"""
+
+import json
+import socket
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu import Config
+from redisson_tpu.cluster import rebalancer as rb_mod
+from redisson_tpu.cluster.rebalancer import (
+    Move,
+    RebalanceAgent,
+    RebalancePlanner,
+    blocked_reason,
+    run_wave,
+)
+from redisson_tpu.cluster.slotindex import SlotKeyIndex
+from redisson_tpu.cluster.slotmap import SlotMap
+from redisson_tpu.cluster.slots import NSLOTS, key_slot
+from redisson_tpu.serve.resp import RespServer
+from test_resp_server import RespClient
+
+
+# -- planner helpers ---------------------------------------------------------
+
+
+def _feed(planner, rates, ticks, keys=None, start=0):
+    """Drive ``observe`` with synthetic CUMULATIVE counters: ``rates``
+    maps node -> {slot: ops_per_tick}; tick 0 establishes baselines."""
+    keys = keys or {}
+    for t in range(start, start + ticks):
+        per_node = {
+            node: {
+                slot: (float(rate * t), 0.0, keys.get(slot, 1))
+                for slot, rate in slots.items()
+            }
+            for node, slots in rates.items()
+        }
+        planner.observe(per_node, now=float(t))
+
+
+def test_planner_first_observation_is_baseline_only():
+    p = RebalancePlanner()
+    p.observe({"A": {7: (1000.0, 0.0, 3)}}, now=0.0)
+    # A huge first reading is a counter BASELINE, not a spike: a node
+    # handed a slot (restarted counters) must never read as hot.
+    assert p.heat == {}
+    assert p.slot_keys[7] == 3
+    p.observe({"A": {7: (1100.0, 0.0, 3)}}, now=1.0)
+    assert p.heat[7] == pytest.approx(0.3 * 100.0)
+
+
+def test_planner_warmup_gate_blocks_early_waves():
+    p = RebalancePlanner(warmup_ticks=3)
+    _feed(p, {"A": {1: 100, 2: 100}}, ticks=2)  # ticks == 2 < 3
+    owners = {1: "A", 2: "A", 3: "B"}
+    assert p.plan(owners, ["A", "B"]) == []
+    _feed(p, {"A": {1: 100, 2: 100}}, ticks=2, start=2)
+    assert p.ticks >= 3
+    assert p.plan(owners, ["A", "B"]) != []
+
+
+def test_planner_hot_shed_and_hysteresis_dead_band():
+    p = RebalancePlanner(warmup_ticks=1)
+    _feed(p, {"A": {s: 100 for s in (1, 2, 3, 4)}}, ticks=5)
+    owners = {1: "A", 2: "A", 3: "A", 4: "A", 5: "B"}
+    moves = p.plan(owners, ["A", "B"])
+    # ratio 2.0: shed down past the half-band (1.15), which lands at a
+    # perfect 1.0 split after two equal-heat slots.
+    assert [m.src for m in moves] == ["A", "A"]
+    assert all(m.dst == "B" for m in moves)
+    assert len(moves) == 2
+    # Hottest-first and recorded heat carried on the move.
+    assert moves[0].heat >= moves[1].heat > 0
+    # Apply the wave; at the new split the ratio is 1.0 -> quiet.
+    for m in moves:
+        owners[m.slot] = m.dst
+    _feed(p, {"A": {s: 100 for s in (1, 2, 3, 4)}}, ticks=2, start=5)
+    assert [m for m in p.plan(owners, ["A", "B"]) if m.heat > 0] == []
+    assert p.last_ratio == pytest.approx(1.0, abs=0.2)
+
+
+def test_planner_below_threshold_never_triggers():
+    # 5 vs 4 equal slots: ratio 10/9 < 1.3 — inside the dead band,
+    # chasing it would be exactly the churn the EWMA exists to stop.
+    p = RebalancePlanner(warmup_ticks=1, threshold=1.3)
+    rates = {"A": {s: 100 for s in range(5)},
+             "B": {s: 100 for s in range(10, 14)}}
+    _feed(p, rates, ticks=4)
+    owners = {s: "A" for s in range(5)}
+    owners.update({s: "B" for s in range(10, 14)})
+    assert p.plan(owners, ["A", "B"]) == []
+    assert 1.0 < p.last_ratio < 1.3
+
+
+def test_planner_cooldown_blocks_ping_pong():
+    p = RebalancePlanner(warmup_ticks=1, cooldown_s=10.0)
+    _feed(p, {"A": {s: 100 for s in (1, 2, 3, 4)}}, ticks=4)
+    owners = {1: "A", 2: "A", 3: "A", 4: "A", 5: "B"}
+    first = p.plan(owners, ["A", "B"], now=100.0)
+    assert first
+    for m in first:
+        p.note_moved(m.slot, now=100.0)
+    # Inside the cooldown the SAME slots are untouchable; the remaining
+    # candidates can't close the gap without overshooting, so: quiet.
+    again = p.plan(owners, ["A", "B"], now=101.0)
+    assert not any(
+        m.slot in {f.slot for f in first} for m in again
+    )
+    # Cooldown expiry re-arms them.
+    later = p.plan(owners, ["A", "B"], now=200.0)
+    assert later
+
+
+def test_planner_mega_slot_never_bounces():
+    # ALL heat in one indivisible slot: moving it just swaps which node
+    # is hot (h > gap/2), so the planner must refuse forever.
+    p = RebalancePlanner(warmup_ticks=1)
+    _feed(p, {"A": {9: 1000}}, ticks=4)
+    owners = {9: "A", 10: "B"}
+    assert p.plan(owners, ["A", "B"]) == []
+    assert p.last_ratio == pytest.approx(2.0)
+
+
+def test_planner_excluded_nodes_untouchable():
+    p = RebalancePlanner(warmup_ticks=1)
+    _feed(p, {"C": {s: 100 for s in (1, 2, 3, 4)}}, ticks=4)
+    owners = {1: "C", 2: "C", 3: "C", 4: "C", 5: "A", 6: "B"}
+    # C is the hot node but it is failover-excluded: nothing may pump
+    # FROM it (its keys are unreachable) and nothing lands ON it.
+    moves = p.plan(owners, ["A", "B", "C"], excluded=("C",))
+    assert not any(m.src == "C" or m.dst == "C" for m in moves)
+
+
+def test_planner_drain_ignores_warmup_and_empties_node():
+    p = RebalancePlanner(warmup_ticks=3, max_moves=8)
+    assert p.ticks == 0  # cold planner: drain is operator intent
+    p.drain("B")
+    owners = {1: "A", 2: "B", 3: "B", 4: "B"}
+    moves = p.plan(owners, ["A", "B"])
+    assert sorted(m.slot for m in moves) == [2, 3, 4]
+    assert all(m.src == "B" and m.dst == "A" for m in moves)
+    p.undrain("B")
+    assert p.plan(owners, ["A", "B"]) == []
+
+
+def test_planner_cold_pack_consolidates_idle_keyed_slots():
+    p = RebalancePlanner(warmup_ticks=1, max_moves=8)
+    # Balanced live heat on A and B, plus a keyed slot on B whose
+    # counters never move (constant cumulative ops -> zero delta).
+    rates = {"A": {1: 100}, "B": {2: 100, 77: 0}}
+    _feed(p, rates, ticks=4, keys={77: 50})
+    assert 77 in p.slot_keys and 77 not in p.heat
+    owners = {1: "A", 2: "B", 77: "B"}
+    moves = p.plan(owners, ["A", "B"])
+    # Balanced (ratio 1.0): phase 3 packs the observed-idle keyed slot
+    # onto the least-loaded node so tiered residency can spill it.
+    assert moves == [Move(77, "B", "A", 0.0)]
+
+
+def test_planner_min_heat_floor_keeps_idle_cluster_still():
+    p = RebalancePlanner(warmup_ticks=1, min_heat=1.0)
+    # A trickle: imbalance ratio is large but the fleet is idle.
+    _feed(p, {"A": {1: 0.1}}, ticks=4)
+    owners = {1: "A", 2: "B"}
+    assert p.plan(owners, ["A", "B"]) == []
+
+
+def test_planner_forget_node_resets_baseline():
+    p = RebalancePlanner()
+    _feed(p, {"A": {3: 100}}, ticks=3)
+    assert ("A", 3) in p._prev
+    p.forget_node("A")
+    assert ("A", 3) not in p._prev
+    # The restarted node's lower counter is a NEW baseline, not a
+    # negative delta (max(0, ...) guards the other direction too).
+    before = p.heat.get(3, 0.0)
+    p.observe({"A": {3: (5.0, 0.0, 1)}}, now=10.0)
+    assert p.heat.get(3, 0.0) <= before  # decayed, never spiked
+
+
+# -- last-moment eligibility (the netsim guard seams) ------------------------
+
+
+def _map3():
+    return SlotMap.from_dict({"nodes": [
+        {"id": "A", "host": "h", "port": 1, "slots": [[0, 99]]},
+        {"id": "B", "host": "h", "port": 2, "slots": [[100, 199]]},
+        {"id": "C", "host": "h", "port": 3, "slots": []},
+    ]})
+
+
+def test_blocked_reason_busy_stale_failover_precedence():
+    m = _map3()
+    mv = Move(5, "A", "B", 1.0)
+    assert blocked_reason(m, mv) is None
+    m.set_migrating(5, "B")
+    assert blocked_reason(m, mv) == "busy"
+    m.set_stable(5)
+    m.set_owner(5, "C")
+    assert blocked_reason(m, mv) == "stale"
+    m.set_owner(5, "A")
+    assert blocked_reason(m, mv, excluded=("B",)) == "failover"
+    assert blocked_reason(m, mv, excluded=("A",)) == "failover"
+    assert blocked_reason(m, mv) is None
+    # IMPORTING state (the destination half of a live pump) also busies.
+    m.set_importing(5, "C")
+    assert blocked_reason(m, mv) == "busy"
+
+
+def test_run_wave_skips_without_dialing(monkeypatch):
+    # A fully-blocked wave must not open a single socket.
+    def boom(*a, **k):
+        raise AssertionError("run_wave dialed for a blocked move")
+
+    monkeypatch.setattr(rb_mod._supervisor, "migrate_slot", boom)
+    m = _map3()
+    m.set_migrating(5, "B")
+    recs = run_wave(m, [
+        Move(5, "A", "B", 1.0),          # busy
+        Move(150, "A", "C", 1.0),        # stale (B owns 150)
+        Move(6, "A", "C", 1.0),          # failover (C excluded)
+    ], excluded=("C",))
+    assert [r["outcome"] for r in recs] == [
+        "skip_busy", "skip_stale", "skip_failover"
+    ]
+    assert all(r["keys"] == 0 for r in recs)
+
+
+# -- write-time slot->key index ---------------------------------------------
+
+
+def test_slot_key_index_note_seed_and_buckets():
+    idx = SlotKeyIndex()
+    s = key_slot("k1")
+    idx.note("k1", +1)
+    idx.note(b"k1", +1)  # bytes and str agree on one entry
+    assert idx.keys(s) == ["k1"]
+    assert idx.count(s) == 1
+    idx.note("k1", -1)
+    assert idx.keys(s) == []
+    assert idx.nonempty_slots() == []  # empty bucket deleted, not kept
+    idx.note("x", -1)  # removing an unseen key is a no-op
+    idx.seed(["a", b"b", "c"])
+    assert sorted(
+        k for sl in idx.nonempty_slots() for k in idx.keys(sl)
+    ) == ["a", "b", "c"]
+    # Deterministic order + count limit.
+    tagged = ["{t}%d" % i for i in range(5)]
+    idx.seed(tagged)
+    ts = key_slot(tagged[0])
+    assert idx.keys(ts) == sorted(tagged)
+    assert idx.keys(ts, count=2) == sorted(tagged)[:2]
+    assert idx.count(ts) == 5
+
+
+# -- in-process two-node cluster (engine-backed: the index is wired) ---------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _ClusterRB:
+    """Two cluster RespServers on the TPU-path engine (jax on CPU) so
+    BOTH keyspace backends hook the slot index — splitting at 8192."""
+
+    def __init__(self):
+        pa, pb = _free_port(), _free_port()
+        topo = {"nodes": [
+            {"id": "A", "host": "127.0.0.1", "port": pa,
+             "slots": [[0, 8191]]},
+            {"id": "B", "host": "127.0.0.1", "port": pb,
+             "slots": [[8192, NSLOTS - 1]]},
+        ]}
+        self.nodes = {}
+        for nid, port in (("A", pa), ("B", pb)):
+            cfg = Config().use_tpu_sketch(min_bucket=64)
+            cfg.cluster_enabled = True
+            cfg.cluster_topology = topo
+            cfg.cluster_node_id = nid
+            client = redisson_tpu.create(cfg)
+            self.nodes[nid] = (client, RespServer(client, port=port))
+        self.addr = {"A": ("127.0.0.1", pa), "B": ("127.0.0.1", pb)}
+
+    def server(self, nid):
+        return self.nodes[nid][1]
+
+    def conn(self, nid):
+        return RespClient(*self.addr[nid])
+
+    def keys_for(self, nid, n, distinct_slots=True, prefix="rk"):
+        """n keys owned by ``nid``, optionally in n distinct slots."""
+        out, slots, i = [], set(), 0
+        while len(out) < n:
+            k = f"{prefix}{i}"
+            i += 1
+            s = key_slot(k)
+            owned = (s < 8192) == (nid == "A")
+            if owned and (not distinct_slots or s not in slots):
+                out.append(k)
+                slots.add(s)
+        return out
+
+    def close(self):
+        for client, server in self.nodes.values():
+            server.close()
+            client.shutdown()
+
+
+@pytest.fixture(scope="module")
+def crb():
+    c = _ClusterRB()
+    yield c
+    c.close()
+
+
+def test_slot_index_wired_and_agrees_with_debug_scan(crb):
+    conn = crb.conn("A")
+    try:
+        door = crb.server("A").cluster
+        assert door.slot_index is not None, "engine path must wire it"
+        keys = crb.keys_for("A", 3, distinct_slots=False, prefix="ix")
+        for k in keys:
+            conn.cmd("SET", k, "v")
+        for k in keys:
+            s = key_slot(k)
+            fast = conn.cmd("CLUSTER", "GETKEYSINSLOT", s, 100)
+            slow = conn.cmd("DEBUG", "GETKEYSINSLOT", s)
+            assert sorted(fast) == sorted(slow), (k, fast, slow)
+            assert k.encode() in fast
+            assert conn.cmd("CLUSTER", "COUNTKEYSINSLOT", s) == \
+                conn.cmd("DEBUG", "COUNTKEYSINSLOT", s)
+        # Deletes retract from the index too (the no-ghost contract).
+        conn.cmd("DEL", keys[0])
+        s0 = key_slot(keys[0])
+        assert keys[0].encode() not in conn.cmd(
+            "CLUSTER", "GETKEYSINSLOT", s0, 100
+        )
+        assert sorted(conn.cmd("CLUSTER", "GETKEYSINSLOT", s0, 100)) \
+            == sorted(conn.cmd("DEBUG", "GETKEYSINSLOT", s0))
+    finally:
+        conn.close()
+
+
+def test_cluster_rebalance_status_works_unarmed(crb):
+    conn = crb.conn("A")
+    try:
+        st = json.loads(conn.cmd("CLUSTER", "REBALANCE", "STATUS"))
+        assert st == {"enabled": False, "node": "A"}
+        # Bare REBALANCE defaults to STATUS.
+        st2 = json.loads(conn.cmd("CLUSTER", "REBALANCE"))
+        assert st2["enabled"] is False
+        # Action verbs refuse without the agent (no fake capability).
+        for verb in ("PAUSE", "RESUME", "NOW", "DRAIN", "UNDRAIN"):
+            with pytest.raises(RuntimeError, match="not armed"):
+                conn.cmd("CLUSTER", "REBALANCE", verb, "B")
+    finally:
+        conn.close()
+
+
+def test_cluster_meet_teaches_new_member(crb):
+    conn = crb.conn("B")
+    try:
+        port = _free_port()
+        assert conn.cmd(
+            "CLUSTER", "MEET", "node-new", "127.0.0.1", port
+        ) == "OK"
+        assert crb.server("B").cluster.slotmap.addr("node-new") == \
+            ("127.0.0.1", port)
+        with pytest.raises(RuntimeError):
+            conn.cmd("CLUSTER", "MEET", "node-short")
+    finally:
+        conn.close()
+
+
+# -- the armed agent: surfaces, knobs, and a heat-driven wave ----------------
+
+
+def test_agent_surfaces_config_and_heat_driven_wave():
+    crb = _ClusterRB()
+    conn = crb.conn("A")
+    try:
+        srv = crb.server("A")
+        agent = RebalanceAgent(
+            srv, interval_s=60.0, threshold=1.3, max_moves=8,
+            pace_s=0.0, cooldown_s=0.5,
+        )  # NOT thread-started: CLUSTER REBALANCE NOW drives ticks
+        assert srv.rebalancer is agent
+
+        # STATUS over RESP: armed, and A (lowest id) coordinates.
+        st = json.loads(conn.cmd("CLUSTER", "REBALANCE", "STATUS"))
+        assert st["enabled"] and st["node"] == "A"
+        assert st["coordinator"] == "A" and st["is_coordinator"]
+        assert st["interval_ms"] == 60000 and st["threshold"] == 1.3
+
+        # PAUSE freezes the periodic loop (a paused tick is a no-op)…
+        assert conn.cmd("CLUSTER", "REBALANCE", "PAUSE") == "OK"
+        assert json.loads(
+            conn.cmd("CLUSTER", "REBALANCE", "STATUS")
+        )["paused"]
+        assert agent.tick() == 0 and agent.planner.ticks == 0
+        assert conn.cmd("CLUSTER", "REBALANCE", "RESUME") == "OK"
+
+        # DRAIN/UNDRAIN mark planner intent.
+        assert conn.cmd("CLUSTER", "REBALANCE", "DRAIN", "B") == "OK"
+        assert json.loads(
+            conn.cmd("CLUSTER", "REBALANCE", "STATUS")
+        )["draining"] == ["B"]
+        assert conn.cmd("CLUSTER", "REBALANCE", "UNDRAIN", "B") == "OK"
+        with pytest.raises(RuntimeError, match="verb"):
+            conn.cmd("CLUSTER", "REBALANCE", "BOGUS")
+
+        # CONFIG rows registered (the agent was armed before the first
+        # CONFIG call built the table) and live-apply to the planner.
+        assert conn.cmd("CONFIG", "GET", "rebalance-threshold") == [
+            b"rebalance-threshold", b"1.3",
+        ]
+        assert conn.cmd(
+            "CONFIG", "SET", "rebalance-threshold", "1.5",
+            "rebalance-max-moves", "4", "rebalance-pace-ms", "10",
+            "rebalance-cooldown-ms", "500",
+            "rebalance-interval-ms", "30000",
+        ) == "OK"
+        assert agent.planner.threshold == 1.5
+        assert agent.planner.max_moves == 4
+        assert agent.pace_s == pytest.approx(0.010)
+        assert agent.planner.cooldown_s == pytest.approx(0.5)
+        assert agent.interval_s == pytest.approx(30.0)
+        for bad in (("rebalance-threshold", "0.5"),
+                    ("rebalance-threshold", "nope"),
+                    ("rebalance-max-moves", "0"),
+                    ("rebalance-interval-ms", "x")):
+            with pytest.raises(RuntimeError):
+                conn.cmd("CONFIG", "SET", *bad)
+        assert agent.planner.threshold == 1.5  # validate-all held
+        conn.cmd("CONFIG", "SET", "rebalance-threshold", "1.3")
+
+        # Heat-driven wave: 4 hot slots on A, zero on B.  NOW forces
+        # synchronous ticks; the first establishes baselines, warmup
+        # holds the next two, then the wave sheds toward B.
+        hot = crb.keys_for("A", 4, distinct_slots=True, prefix="hot")
+        executed = 0
+        for _ in range(8):
+            for k in hot:
+                for _i in range(25):
+                    conn.cmd("SET", k, "v")
+            executed = conn.cmd("CLUSTER", "REBALANCE", "NOW")
+            assert isinstance(executed, int)
+            if executed:
+                break
+        assert executed > 0, "no wave after 8 forced ticks"
+
+        # Both slot maps agree on every moved slot's new owner, and the
+        # moved keys serve on B (no MOVED bounce — really migrated).
+        ma = crb.server("A").cluster.slotmap
+        mb = crb.server("B").cluster.slotmap
+        moved_slots = [
+            s for s in (key_slot(k) for k in hot)
+            if ma.owner(s) == "B"
+        ]
+        assert moved_slots, "a wave ran but no hot slot changed owner"
+        for s in moved_slots:
+            assert mb.owner(s) == "B"
+        connb = crb.conn("B")
+        try:
+            moved_keys = [
+                k for k in hot if key_slot(k) in moved_slots
+            ]
+            for k in moved_keys:
+                assert connb.cmd("GET", k) == b"v"
+                # The index followed the migration on BOTH ends: B's
+                # RESTOREs registered, A's pump deletes retracted —
+                # cross-checked against the DEBUG ground-truth scan.
+                s = key_slot(k)
+                assert sorted(
+                    connb.cmd("CLUSTER", "GETKEYSINSLOT", s, 100)
+                ) == sorted(connb.cmd("DEBUG", "GETKEYSINSLOT", s))
+                assert conn.cmd("CLUSTER", "COUNTKEYSINSLOT", s) == 0
+                assert conn.cmd("DEBUG", "COUNTKEYSINSLOT", s) == 0
+        finally:
+            connb.close()
+
+        # Book-keeping + telemetry: counters, histogram, the imbalance
+        # gauge (wired to the planner), and STATUS totals.
+        st = json.loads(conn.cmd("CLUSTER", "REBALANCE", "STATUS"))
+        assert st["waves"] >= 1
+        assert st["slots_moved"] >= len(moved_slots)
+        assert st["keys_moved"] >= len(moved_keys)
+        assert st["failures"] == 0 and st["down"] == []
+        body = srv.obs.registry.render_prometheus()
+        assert 'rtpu_rebalancer_decisions_total{kind="planned"}' in body
+        assert 'rtpu_rebalancer_decisions_total{kind="moved"}' in body
+        assert "rtpu_rebalancer_keys_moved_total" in body
+        assert "rtpu_rebalancer_migration_seconds" in body
+        assert "rtpu_rebalancer_imbalance_ratio" in body
+    finally:
+        conn.close()
+        crb.close()
+
+
+# -- fleet_loadmap degrades when a member dies mid-scrape --------------------
+
+
+def test_fleet_loadmap_degrades_not_raises_on_dead_member():
+    c2 = _make_plain_pair()
+    client = None
+    try:
+        from redisson_tpu.cluster.client import ClusterClient
+
+        client = ClusterClient([c2.addr["A"], c2.addr["B"]])
+        ka = c2.key_for("A")
+        kb = c2.key_for("B")
+        client.execute(b"SET", ka.encode(), b"1")
+        client.execute(b"SET", kb.encode(), b"1")
+        fl = client.fleet_loadmap()
+        assert fl["down_nodes"] == []
+        # Node B dies; the NEXT scrape must degrade, never raise.
+        cl_b, srv_b = c2.nodes.pop("B")
+        srv_b.close()
+        cl_b.shutdown()
+        fl = client.fleet_loadmap()
+        b_tag = "%s:%d" % c2.addr["B"]
+        assert fl["down_nodes"] == [b_tag]
+        assert "error" in fl["nodes"][b_tag]
+        # The survivor's view is intact (its slots still merge).
+        assert any(
+            row["node"] == "%s:%d" % c2.addr["A"]
+            for row in fl["slots"].values()
+        )
+        # Same discipline on the rebalance fan-out helpers.
+        rs = client.rebalance_status()
+        assert "error" in rs[b_tag]
+        assert rs["%s:%d" % c2.addr["A"]]["enabled"] is False
+        assert client.rebalance_pause() == 0  # nobody armed, nobody up
+    finally:
+        if client is not None:
+            client.close()
+        c2.close()
+
+
+def _make_plain_pair():
+    """Host-engine two-node cluster (cheap: no jax engine needed for
+    the loadmap/fan-out surface)."""
+    pa, pb = _free_port(), _free_port()
+    topo = {"nodes": [
+        {"id": "A", "host": "127.0.0.1", "port": pa,
+         "slots": [[0, 8191]]},
+        {"id": "B", "host": "127.0.0.1", "port": pb,
+         "slots": [[8192, NSLOTS - 1]]},
+    ]}
+
+    class _Pair:
+        def __init__(self):
+            self.nodes = {}
+            for nid, port in (("A", pa), ("B", pb)):
+                cfg = Config()
+                cfg.cluster_enabled = True
+                cfg.cluster_topology = topo
+                cfg.cluster_node_id = nid
+                client = redisson_tpu.create(cfg)
+                self.nodes[nid] = (client, RespServer(client, port=port))
+            self.addr = {"A": ("127.0.0.1", pa), "B": ("127.0.0.1", pb)}
+
+        def key_for(self, nid, prefix="fk"):
+            i = 0
+            while True:
+                k = f"{prefix}{i}"
+                if (key_slot(k) < 8192) == (nid == "A"):
+                    return k
+                i += 1
+
+        def close(self):
+            for client, server in self.nodes.values():
+                server.close()
+                client.shutdown()
+
+    return _Pair()
+
+
+# -- elastic join/drain end to end (subprocess fleet; CI rebalance-soak) -----
+
+
+@pytest.mark.slow
+def test_add_node_and_drain_node_e2e():
+    """ISSUE 19 acceptance: a node joins a live 2-node fleet and takes
+    an even slot share, traffic is served throughout, draining it hands
+    every slot back and retires the process cleanly, and the supervisor
+    roster (alive/shutdown — the CI no-orphans contract) tracks the
+    added node for its whole life."""
+    from redisson_tpu.cluster.supervisor import ClusterSupervisor
+
+    sup = ClusterSupervisor(n_nodes=2).start()
+    try:
+        client = sup.client()
+        try:
+            keys = [f"jd{i}" for i in range(60)]
+            for k in keys:
+                assert client.execute(b"SET", k.encode(), b"v1") == b"OK"
+
+            idx = sup.add_node()
+            assert idx == 2
+            assert idx in sup.alive()
+            assert sup.primary_alive(idx)
+            new_id = sup.node_ids[idx]
+            owned = sum(
+                end - start + 1
+                for start, end, nid, _h, _p in sup.slots_table()
+                if nid == new_id
+            )
+            # An even 1/3 share (the supervisor-driven shift), and the
+            # whole space still covered exactly once.
+            assert NSLOTS // 4 < owned < NSLOTS // 2
+            assert sum(
+                end - start + 1
+                for start, end, _n, _h, _p in sup.slots_table()
+            ) == NSLOTS
+
+            # Zero acked-write loss across the join, and the fleet
+            # serves (reads AND writes) with the newcomer in rotation.
+            client.refresh_slots()
+            for k in keys:
+                assert client.execute(b"GET", k.encode()) == b"v1"
+            for k in keys:
+                assert client.execute(b"SET", k.encode(), b"v2") == b"OK"
+
+            # Drain hands everything back and retires the process.
+            assert sup.drain_node(idx) is True
+            assert not any(
+                nid == new_id
+                for _s, _e, nid, _h, _p in sup.slots_table()
+            )
+            assert idx not in sup.alive()
+            client.refresh_slots()
+            for k in keys:
+                assert client.execute(b"GET", k.encode()) == b"v2"
+        finally:
+            client.close()
+    finally:
+        assert sup.shutdown() is True  # every spawned process reaped
+        assert sup.alive() == []
