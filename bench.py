@@ -2619,6 +2619,101 @@ def bench_config15_rebalance(_make_client):
     return out
 
 
+def bench_config16_doctor(_make_client):
+    """Config 16 — flight recorder + fleet doctor overhead (ISSUE 20):
+    a 2-shard × 1-replica fleet with ``--doctor`` armed everywhere,
+    measured under steady closed-loop SET traffic with the doctor
+    SWEEPING (on arm) vs PAUSED (off arm), interleaved rounds.
+
+    The claim: continuous invariant auditing is near-free for the data
+    plane — the doctor probes over short-lived control connections and
+    the flight recorder only writes on control-plane transitions, so
+    steady-state data traffic never touches either.
+    ``config16_doctor_overhead_ratio`` is the min of paired per-round
+    off/on ratios (the config12 noise-shedding discipline), acceptance
+    <= 1.05.  The sweeps must actually have run during the on arms
+    (config16_doctor_sweeps), and a healthy fleet must finish with
+    ZERO findings and ZERO canary failures — the bench doubles as the
+    clean-soak false-positive gate."""
+    import json as _json
+
+    from redisson_tpu.cluster.supervisor import (
+        ClusterSupervisor,
+        _request,
+    )
+
+    AB_OPS = 1200              # ops per A/B pass (~1s: sweeps overlap)
+    AB_ROUNDS = 4              # interleaved paused/sweeping rounds
+    out = {}
+    sup = ClusterSupervisor(
+        n_nodes=2, replicas_per_shard=1, node_timeout_ms=2000,
+        node_args=("--doctor",),
+    )
+    sup.start()
+    try:
+        client = sup.client()
+        addr0 = sup.addrs[0]
+
+        def doctor_status():
+            (raw,) = _request(addr0, [("CLUSTER", "DOCTOR", "STATUS")])
+            return _json.loads(raw)
+
+        # Wait for the coordinator's first sweeps so both arms measure
+        # a WORKING doctor, not its startup.
+        deadline = time.monotonic() + 60.0
+        st = {}
+        while time.monotonic() < deadline:
+            st = doctor_status()
+            if st.get("enabled") and st.get("sweeps", 0) >= 2:
+                break
+            time.sleep(0.2)
+        assert st.get("enabled"), f"doctor never armed: {st}"
+
+        def pass_cmds_per_sec():
+            t0 = time.perf_counter()
+            for i in range(AB_OPS):
+                client.execute("SET", f"dr-k{i % 64}", "v")
+            return AB_OPS / (time.perf_counter() - t0)
+
+        pass_cmds_per_sec()  # warmup: connections + grid buckets hot
+        on_rates, off_rates = [], []
+        for _ in range(AB_ROUNDS):
+            for verb, rates in (("PAUSE", off_rates),
+                                ("RESUME", on_rates)):
+                _request(addr0, [("CLUSTER", "DOCTOR", verb)])
+                rates.append(pass_cmds_per_sec())
+        st = doctor_status()
+        on_med = float(np.median(on_rates))
+        off_med = float(np.median(off_rates))
+        out["config16_doctor_on_cmds_per_sec"] = round(on_med)
+        out["config16_doctor_off_cmds_per_sec"] = round(off_med)
+        out["config16_doctor_overhead_ratio"] = round(
+            min(off / on for off, on in zip(off_rates, on_rates)), 3
+        )
+        out["config16_doctor_sweeps"] = st.get("sweeps", 0)
+        out["config16_doctor_findings_total"] = st.get(
+            "findings_total", -1
+        )
+        out["config16_doctor_canary_failures"] = st.get(
+            "canary_failures", -1
+        )
+        # The flight recorder saw the control plane (at minimum the
+        # PAUSE/RESUME cycle ran against a live ring) and the fleet
+        # timeline merges cleanly.
+        tl = client.fleet_events()
+        out["config16_fleet_events"] = len(tl["events"])
+        out["config16_fleet_event_gaps"] = tl["gaps"]
+        assert st.get("sweeps", 0) >= 2, f"doctor never swept: {st}"
+        assert out["config16_doctor_findings_total"] == 0, (
+            f"doctor raised findings on a healthy fleet: {st}"
+        )
+        assert out["config16_doctor_canary_failures"] == 0, st
+        client.close()
+    finally:
+        sup.shutdown()
+    return out
+
+
 def bench_config3_bitset(client):
     """Config 3: 2^30-bit RBitSet, batched get/set (raw bitmap path).
 
@@ -2973,6 +3068,23 @@ def main():
         write_bench_artifact(result, line)
         return
 
+    if "--config16" in sys.argv:
+        # CI smoke mode (ISSUE 20): the doctor-overhead A/B alone,
+        # written as a BENCH.json artifact so the workflow can assert
+        # the published keys exist without paying for the full bench.
+        stats = bench_config16_doctor(make_client)
+        result = {
+            "metric": "config16_doctor_smoke",
+            "value": stats.get("config16_doctor_overhead_ratio"),
+            "unit": "x goodput, doctor paused vs sweeping",
+            "vs_baseline": None,
+            "extra": stats,
+        }
+        line = json.dumps(result)
+        print(line)
+        write_bench_artifact(result, line)
+        return
+
     if "--config13" in sys.argv:
         # CI smoke mode (ISSUE 17): the per-core front door A/B alone,
         # written as a BENCH.json artifact so the workflow can assert
@@ -3122,6 +3234,14 @@ def main():
         rebalance_stats = bench_config15_rebalance(make_client)
     except Exception as e:  # pragma: no cover - env-dependent spawn
         rebalance_stats = {"config15_rebalance_error": repr(e)}
+    # Flight recorder + fleet doctor (ISSUE 20): config16_doctor —
+    # continuous invariant auditing's steady-state overhead A/B plus
+    # the clean-fleet zero-findings gate.  Isolated like
+    # config9/10/12/13/14/15 (subprocess spawn).
+    try:
+        doctor_stats = bench_config16_doctor(make_client)
+    except Exception as e:  # pragma: no cover - env-dependent spawn
+        doctor_stats = {"config16_doctor_error": repr(e)}
     host_ops = measure_host_baseline()
 
     # vs_baseline: the bench env ships no redis-server, so the Redis-backed
@@ -3220,6 +3340,10 @@ def main():
                     # goodput + p99, slots/keys moved, migration
                     # seconds, zero acked-write loss across waves.
                     **rebalance_stats,
+                    # Flight recorder + fleet doctor (ISSUE 20):
+                    # doctor sweeping vs paused goodput A/B, clean-
+                    # fleet zero-findings gate, fleet-timeline size.
+                    **doctor_stats,
                     "hll_pfadd_ops_per_sec": round(hll_ops),
                     "config3_bitset_ops_per_sec": round(bitset_ops),
                     "config4_mixed_ops_per_sec": round(mixed_ops),

@@ -61,6 +61,8 @@ def _serve_multicore(args, nworkers: int) -> int:
         extra += ["--cluster"]
     if args.rebalance:
         extra += ["--rebalance"]
+    if args.doctor:
+        extra += ["--doctor"]
     for val, flag in (
         (args.cluster_slots, "--cluster-slots"),
         (args.cluster_topology, "--cluster-topology"),
@@ -240,6 +242,15 @@ def main(argv=None) -> int:
         "requires --cluster",
     )
     p.add_argument(
+        "--doctor", action="store_true",
+        help="arm the fleet doctor (ISSUE 20; docs/observability.md "
+        "'Fleet doctor'): a continuous invariant sweep — slot "
+        "ownership, replication monotonicity, stuck migrations — plus "
+        "a black-box WAIT-fenced canary; the coordinator (lowest-id "
+        "alive primary) audits, findings surface via CLUSTER DOCTOR; "
+        "requires --cluster",
+    )
+    p.add_argument(
         "--frontdoor-processes", type=int, default=None,
         help="per-core front door (ISSUE 17): serve with this many "
         "reactor processes sharing the port via SO_REUSEPORT, each "
@@ -339,6 +350,11 @@ def main(argv=None) -> int:
             p.error("--rebalance requires --cluster (or a config file "
                     "with cluster_enabled: true)")
         cfg.rebalance_enabled = True
+    if args.doctor:
+        if not cfg.cluster_enabled:
+            p.error("--doctor requires --cluster (or a config file "
+                    "with cluster_enabled: true)")
+        cfg.doctor_enabled = True
     for flag, key in (
         (args.cluster_slots, "cluster_slots"),
         (args.cluster_topology, "cluster_topology"),
@@ -482,6 +498,24 @@ def main(argv=None) -> int:
                 cooldown_s=float(
                     getattr(cfg, "rebalance_cooldown_ms", 15000) or 0
                 ) / 1000.0,
+            ).start()
+        if getattr(cfg, "doctor_enabled", False):
+            # Fleet doctor (ISSUE 20): probe everywhere, audit on the
+            # coordinator.  server.close() stops it.
+            from redisson_tpu.obs.doctor import FleetDoctor
+
+            FleetDoctor(
+                server,
+                interval_s=float(
+                    getattr(cfg, "doctor_interval_ms", 1000) or 1000
+                ) / 1000.0,
+                stuck_slot_s=float(
+                    getattr(cfg, "doctor_stuck_slot_ms", 30000) or 30000
+                ) / 1000.0,
+                lag_bound_ops=int(
+                    getattr(cfg, "doctor_lag_bound_ops", 10000) or 10000
+                ),
+                canary=bool(getattr(cfg, "doctor_canary", True)),
             ).start()
     metrics_srv = None
     if args.metrics_port is not None:

@@ -96,6 +96,15 @@ RT014  Tmp-file persistence discipline (the snapshot/blob/journal
        store into shared state / non-path call) before the rename —
        a reference that escapes early points at a file that does not
        durably exist yet.
+RT015  Flight-recorder kind discipline (ISSUE 20; the RT005 bounded-
+       cardinality rule applied to event kinds): every
+       ``events.emit(kind, …)`` call site must pass the kind as a
+       plain string LITERAL registered in the obs/events.py ``KINDS``
+       catalog.  A dynamic kind (f-string, concat, variable) defeats
+       the catalog's cardinality bound on ``rtpu_events_emitted`` and
+       hides the emit point from the catalog audit; an unregistered
+       literal would raise ValueError at runtime — on a control-plane
+       path that may only execute during an outage.
 
 Suppression: ``# rtpulint: disable=RT001 <reason>`` on the offending
 line, or alone on the line directly above it.  The reason is mandatory
@@ -133,6 +142,7 @@ RULES = {
     "RT012": "one-shot license read without a burn on the dispatch path",
     "RT013": "pooled socket kept after an except-OSError arm",
     "RT014": "tmp-file rename without fsync / final path escapes early",
+    "RT015": "event kind not a registered literal from the KINDS catalog",
 }
 
 # Roles a rule applies to.  "*" = every non-test module.
@@ -155,6 +165,7 @@ _RULE_ROLES = {
     # (journal/host OSError arms are file-I/O cleanup, not wire desync).
     "RT013": {"serve"},
     "RT014": {"*"},  # self-scoping: only fires at tmp-file renames
+    "RT015": {"*"},  # self-scoping: only fires at events.emit call sites
     # RT010 is a WHOLE-TREE rule (analysis/lockgraph.py): it has no
     # per-file check here, but lives in RULES so disable=RT010
     # suppressions parse and the CLI can name it.
@@ -1418,6 +1429,103 @@ def _check_rt014(ctx) -> None:
                         )
 
 
+# -- RT015: flight-recorder kind discipline -----------------------------------
+
+# Mirror of obs/events.py KINDS — kept literal so the linter stays a
+# pure-AST pass with no runtime imports (the lockgraph/RT004 precedent);
+# tests/test_rtpulint.py pins this set equal to events.KINDS both ways,
+# so adding an emit kind means touching catalog AND mirror on purpose.
+_RT015_KINDS = frozenset((
+    "failover.detected",
+    "failover.vote",
+    "failover.election.won",
+    "failover.election.lost",
+    "failover.takeover.sent",
+    "failover.takeover.applied",
+    "rebalance.coordinator",
+    "rebalance.wave.planned",
+    "rebalance.wave.executed",
+    "rebalance.wave.skipped",
+    "repl.full_resync",
+    "repl.partial_resync",
+    "repl.link.down",
+    "repl.stale_read",
+    "repl.wait.timeout",
+    "health.breaker.open",
+    "health.breaker.close",
+    "health.reconcile.failed",
+    "residency.promote",
+    "residency.demote",
+    "residency.spill",
+    "multicore.worker.spawn",
+    "multicore.worker.death",
+    "multicore.handoff.broken",
+    "config.set",
+    "doctor.finding",
+    "doctor.clear",
+    "doctor.canary",
+))
+
+# Receiver names that mark an emit() call as a flight-recorder emit
+# (the repo idiom: `events = getattr(obs, "events", None)` locals,
+# `self.obs.events`, and the `_events()` accessor helpers).
+_RT015_RECEIVERS = ("events", "_events")
+
+
+def _rt015_is_recorder_emit(node) -> bool:
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "emit"):
+        return False
+    recv = f.value
+    if isinstance(recv, ast.Name):
+        return recv.id in _RT015_RECEIVERS
+    if isinstance(recv, ast.Attribute):
+        return recv.attr in _RT015_RECEIVERS
+    if isinstance(recv, ast.Call):
+        g = recv.func
+        name = g.attr if isinstance(g, ast.Attribute) else (
+            g.id if isinstance(g, ast.Name) else None
+        )
+        return name in _RT015_RECEIVERS
+    return False
+
+
+def _check_rt015(ctx) -> None:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _rt015_is_recorder_emit(node):
+            continue
+        kind = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == "kind"),
+            None,
+        )
+        if kind is None:
+            ctx.report(
+                "RT015", node.lineno,
+                "events.emit() without a kind argument",
+            )
+            continue
+        if not (isinstance(kind, ast.Constant)
+                and isinstance(kind.value, str)):
+            ctx.report(
+                "RT015", kind.lineno,
+                "dynamically-built event kind: emit kinds must be "
+                "plain string literals from the obs/events.py KINDS "
+                "catalog (one literal per branch — the catalog audit "
+                "and the rtpu_events_emitted cardinality bound both "
+                "depend on it)",
+            )
+            continue
+        if kind.value not in _RT015_KINDS:
+            ctx.report(
+                "RT015", kind.lineno,
+                f"event kind {kind.value!r} is not registered in the "
+                f"obs/events.py KINDS catalog — register it there "
+                f"(and in the linter mirror) before emitting it",
+            )
+
+
 _CHECKS = {
     "RT001": _check_rt001,
     "RT002": _check_rt002,
@@ -1432,6 +1540,7 @@ _CHECKS = {
     "RT012": _check_rt012,
     "RT013": _check_rt013,
     "RT014": _check_rt014,
+    "RT015": _check_rt015,
 }
 
 

@@ -505,6 +505,28 @@ class ClusterClient:
             f.result()
         return out
 
+    def _fanout_degraded(self, cmd) -> tuple:
+        """The uniform dead-member degradation contract (ISSUE 20,
+        generalizing what PR 19 gave fleet_loadmap): fan ``cmd`` out
+        and split the replies — ``(rows, errors, down_nodes)`` where
+        ``rows`` is ``[(node_label, reply)]`` for reachable members,
+        ``errors`` maps node label to ``{"error": str}`` (the per-node
+        error row every fleet view surfaces), and ``down_nodes`` is
+        the sorted dead-member list.  A member dying mid-scrape
+        DEGRADES the merge — partial results plus an explicit error
+        row — it never raises the whole fleet view away."""
+        rows: list = []
+        errors: dict = {}
+        down: list = []
+        for addr, raw in self._fanout(cmd).items():
+            node = "%s:%d" % tuple(addr)
+            if isinstance(raw, (ReplyError, Exception)):
+                errors[node] = {"error": str(raw)}
+                down.append(node)
+                continue
+            rows.append((node, raw))
+        return rows, errors, sorted(down)
+
     # INFO keys whose fleet-wide SUM is meaningful (counters and
     # occupancy).  Everything else (ports, uptimes, rates, thresholds,
     # version strings that happen to parse numeric) stays per-node only
@@ -549,13 +571,10 @@ class ClusterClient:
         aggregated-telemetry view regression detection reads); raw
         per-node sections stay available for drill-down."""
         cmd = [b"INFO"] + ([section.encode()] if section else [])
-        per_node: dict = {}
         totals: dict = {}
-        for addr, raw in self._fanout(cmd).items():
-            node = "%s:%d" % tuple(addr)
-            if isinstance(raw, (ReplyError, Exception)):
-                per_node[node] = {"error": str(raw)}
-                continue
+        rows, errors, down = self._fanout_degraded(cmd)
+        per_node: dict = dict(errors)
+        for node, raw in rows:
             parsed: dict = {}
             for line in raw.decode("latin-1", "replace").splitlines():
                 line = line.strip()
@@ -575,19 +594,20 @@ class ClusterClient:
             k: int(v) if float(v).is_integer() else v
             for k, v in totals.items()
         }
-        return {"nodes": per_node, "totals": totals}
+        return {"nodes": per_node, "totals": totals,
+                "down_nodes": down}
 
     def fleet_slowlog(self, count: int = 10) -> list:
         """Cross-node SLOWLOG GET merge: every node's entries tagged
         with their node label, merged newest-first; ``count < 0`` = all
-        (per node AND merged, like SLOWLOG GET -1)."""
+        (per node AND merged, like SLOWLOG GET -1).  Dead members
+        degrade to trailing ``{"node", "error"}`` rows (after the
+        count cut, so they always survive it)."""
         merged: list = []
-        for addr, raw in self._fanout(
+        rows, errors, _down = self._fanout_degraded(
             [b"SLOWLOG", b"GET", b"%d" % count]
-        ).items():
-            node = "%s:%d" % tuple(addr)
-            if isinstance(raw, (ReplyError, Exception)):
-                continue
+        )
+        for node, raw in rows:
             for e in raw:
                 entry = {
                     "node": node,
@@ -605,14 +625,23 @@ class ClusterClient:
         merged.sort(
             key=lambda d: (d["ts"], d["duration_us"]), reverse=True
         )
-        return merged if count < 0 else merged[:count]
+        if count >= 0:
+            merged = merged[:count]
+        return merged + [
+            {"node": n, **row} for n, row in sorted(errors.items())
+        ]
 
     def fleet_traces(self, trace_id=None) -> dict:
         """{trace_id: [span dicts]} merged across every node's TRACE
         GET ring PLUS this client's own tracer — the one end-to-end view
         of a scatter/gather: client root + leg spans, each node's
         ingress/door spans, and the per-launch coalescer phases, parent
-        links intact across the wire."""
+        links intact across the wire.
+
+        Dead members degrade to the reserved ``"down_nodes"`` key
+        (node label -> error row) — present only when a member was
+        unreachable, so trace-id iteration stays clean on a healthy
+        fleet."""
         import json as _json
 
         out: dict = {}
@@ -622,24 +651,26 @@ class ClusterClient:
         cmd = [b"TRACE", b"GET"] + (
             [trace_id.encode()] if trace_id else []
         )
-        for addr, raw in self._fanout(cmd).items():
-            if isinstance(raw, (ReplyError, Exception)):
-                continue
+        rows, errors, _down = self._fanout_degraded(cmd)
+        for _node, raw in rows:
             for doc in raw:
                 d = _json.loads(doc)
                 out.setdefault(d["trace_id"], []).extend(d["spans"])
+        if errors:
+            out["down_nodes"] = errors
         return out
 
     def fleet_latency(self) -> list:
         """Cross-node LATENCY LATEST merge: one row per (node, event),
         node-tagged, worst latest-ms first — the fleet-wide view of the
         latency monitor (arm it with CONFIG SET
-        latency-monitor-threshold on every node)."""
+        latency-monitor-threshold on every node).  Dead members
+        degrade to trailing ``{"node", "error"}`` rows."""
         merged: list = []
-        for addr, raw in self._fanout([b"LATENCY", b"LATEST"]).items():
-            node = "%s:%d" % tuple(addr)
-            if isinstance(raw, (ReplyError, Exception)):
-                continue
+        rows, errors, _down = self._fanout_degraded(
+            [b"LATENCY", b"LATEST"]
+        )
+        for node, raw in rows:
             for e in raw:
                 merged.append({
                     "node": node,
@@ -651,7 +682,9 @@ class ClusterClient:
         merged.sort(
             key=lambda d: (d["latest_ms"], d["max_ms"]), reverse=True
         )
-        return merged
+        return merged + [
+            {"node": n, **row} for n, row in sorted(errors.items())
+        ]
 
     def fleet_loadmap(self, hot_keys: int = 16) -> dict:
         """The fleet load map: every node's CLUSTER LOADMAP snapshot
@@ -669,19 +702,16 @@ class ClusterClient:
         slots: dict = {}
         key_heat: dict = {}
         tenants: dict = {}
-        nodes: dict = {}
-        down_nodes: list = []
-        for addr, raw in self._fanout([b"CLUSTER", b"LOADMAP"]).items():
-            node = "%s:%d" % tuple(addr)
-            if isinstance(raw, (ReplyError, Exception)):
-                # A member dying mid-scrape DEGRADES the merge (the
-                # federation `rtpu_federation_node_up 0` discipline):
-                # its last-known slots simply don't refresh, and the
-                # assigner sees exactly which node went dark instead of
-                # the whole fleet view raising away.
-                nodes[node] = {"error": str(raw)}
-                down_nodes.append(node)
-                continue
+        # Dead members degrade to error rows + down_nodes (the
+        # federation `rtpu_federation_node_up 0` discipline, now the
+        # shared _fanout_degraded contract): their last-known slots
+        # simply don't refresh, and the assigner sees exactly which
+        # node went dark instead of the whole fleet view raising away.
+        rows, errors, down_nodes = self._fanout_degraded(
+            [b"CLUSTER", b"LOADMAP"]
+        )
+        nodes: dict = dict(errors)
+        for node, raw in rows:
             snap = _json.loads(raw)
             fields = snap["fields"]
             nodes[node] = snap.get("totals", {})
@@ -723,6 +753,51 @@ class ClusterClient:
             "down_nodes": sorted(down_nodes),
         }
 
+    def fleet_events(self, count: int = 0, kind: str = "") -> dict:
+        """The fleet flight recorder (ISSUE 20): every node's EVENTS
+        GET ring merged into ONE causally-ordered timeline —
+        ``{"events": [...], "gaps": {node_id: evicted},
+        "nodes": {label: ring stats | error row},
+        "down_nodes": [...]}``.
+
+        Events order by ``(wall, node, seq)`` (wall clocks across
+        nodes, per-node seq proving intra-node order); a node whose
+        seq stream has holes lost events to ring eviction and shows up
+        in ``gaps`` with the inferred count — the record says where it
+        is incomplete instead of pretending.  ``count``/``kind``
+        forward to EVENTS GET (newest-N per node / kind filter, a
+        trailing dot selecting a whole plane, e.g. ``"failover."``).
+        Node-disjoint merge on the _fanout_degraded contract: a dead
+        member contributes an error row, never an exception."""
+        import json as _json
+
+        from redisson_tpu.obs.events import merge_timelines
+
+        cmd = [b"EVENTS", b"GET"]
+        if count or kind:
+            cmd.append(b"%d" % count)
+        if kind:
+            cmd.append(kind.encode())
+        per_node: dict = {}
+        rows, errors, down = self._fanout_degraded(cmd)
+        nodes: dict = dict(errors)
+        for node, raw in rows:
+            doc = _json.loads(raw)
+            label = doc.get("node") or node
+            nodes[node] = {
+                k: doc[k]
+                for k in ("seq", "evicted", "max_events")
+                if k in doc
+            }
+            per_node.setdefault(label, []).extend(doc["events"])
+        merged, gaps = merge_timelines(per_node)
+        return {
+            "events": merged,
+            "gaps": gaps,
+            "nodes": nodes,
+            "down_nodes": down,
+        }
+
     def rebalance_status(self) -> dict:
         """Every node's CLUSTER REBALANCE STATUS, node-tagged —
         unreachable members report ``{"error": …}`` (degrade, never
@@ -736,6 +811,21 @@ class ClusterClient:
             if isinstance(raw, (ReplyError, Exception)):
                 out[node] = {"error": str(raw)}
                 continue
+            out[node] = _json.loads(raw)
+        return out
+
+    def doctor_status(self) -> dict:
+        """Every node's CLUSTER DOCTOR STATUS, node-tagged — the
+        rebalance_status shape (error rows for dead members; armed
+        nodes report their finding ledger + coordinator view)."""
+        import json as _json
+
+        out: dict = {}
+        rows, errors, _down = self._fanout_degraded(
+            [b"CLUSTER", b"DOCTOR", b"STATUS"]
+        )
+        out.update(errors)
+        for node, raw in rows:
             out[node] = _json.loads(raw)
         return out
 

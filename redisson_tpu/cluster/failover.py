@@ -187,6 +187,11 @@ class FailoverAgent(threading.Thread):
         self._stop_evt = threading.Event()
         server.failover = self
 
+    def _events(self):
+        """The flight-recorder ring, or None pre-obs (tests build bare
+        agents); every emit point in this agent rides this accessor."""
+        return getattr(self.obs, "events", None)
+
     def stop(self, join_timeout_s: float = 5.0) -> None:
         self._stop_evt.set()
         if self.is_alive():
@@ -241,7 +246,12 @@ class FailoverAgent(threading.Thread):
                     self.peer_offsets[nid] = int(reply[3])
                 except (TypeError, ValueError):
                     pass
-        self.state.check_timeouts(time.monotonic())
+        newly_failed = self.state.check_timeouts(time.monotonic())
+        events = self._events()
+        if events is not None:
+            for nid in newly_failed:
+                events.emit("failover.detected", severity="warn",
+                            peer=nid, timeout_s=self.state.node_timeout)
         # Standing check, NOT an edge trigger on newly-failed: a lost
         # election (voters detect the death a tick later than we do, or
         # a vote round races another candidate) must retry until the
@@ -281,6 +291,7 @@ class FailoverAgent(threading.Thread):
             return
         if not self.state.is_failed(failed_primary):
             return  # it came back — no deposing a live primary
+        election_t0 = time.monotonic()
         epoch = self.state.start_election()
         self.elections += 1
         if self.obs is not None:
@@ -298,9 +309,31 @@ class FailoverAgent(threading.Thread):
             )
             if isinstance(reply, int) and reply == 1:
                 votes += 1
+        events = self._events()
         if votes < self.state.majority():
+            if events is not None:
+                events.emit("failover.election.lost", severity="warn",
+                            epoch=epoch, votes=votes,
+                            needed=self.state.majority(),
+                            failed_primary=failed_primary)
+            self._record_election_ms(election_t0)
             return  # lost (or partitioned into a minority): stand down
+        if events is not None:
+            events.emit("failover.election.won", epoch=epoch,
+                        votes=votes, needed=self.state.majority(),
+                        failed_primary=failed_primary)
         self._takeover(failed_primary, epoch)
+        self._record_election_ms(election_t0)
+
+    def _record_election_ms(self, t0: float) -> None:
+        """Feed the LATENCY 'election' event (unavailability window:
+        election start through win/loss, takeover included)."""
+        if self.obs is not None:
+            try:
+                self.obs.latency.record(
+                    "election", (time.monotonic() - t0) * 1e3)
+            except AttributeError:
+                pass
 
     def _takeover(self, failed_primary: str, epoch: int) -> None:
         """Won the election: promote locally, claim the slots, tell
@@ -316,6 +349,10 @@ class FailoverAgent(threading.Thread):
         self.slotmap.apply_takeover(failed_primary, self.myid, epoch)
         self.state.note_takeover(self.myid, failed_primary, epoch)
         self.takeovers += 1
+        events = self._events()
+        if events is not None:
+            events.emit("failover.takeover.sent", epoch=epoch,
+                        slots=spec, from_node=failed_primary)
         for nid in self.slotmap.node_ids():
             if nid in (self.myid, failed_primary):
                 continue

@@ -446,6 +446,7 @@ class RebalanceAgent(threading.Thread):
         )
         self._kick = threading.Event()
         self._stop_evt = threading.Event()
+        self._last_coord: Optional[str] = None
         if self.obs is not None:
             self.obs.rebalancer_imbalance_source = (
                 lambda: self.planner.last_ratio
@@ -586,7 +587,15 @@ class RebalanceAgent(threading.Thread):
         self.last_down = down
         excluded = down | self._failover_failed()
         self.planner.observe(per_node, now)
-        if not force and self._coordinator(excluded) != self.myid:
+        coord = self._coordinator(excluded)
+        if coord != self._last_coord:
+            events = self._events()
+            if events is not None:
+                events.emit("rebalance.coordinator",
+                            coordinator=coord or "",
+                            previous=self._last_coord or "")
+            self._last_coord = coord
+        if not force and coord != self.myid:
             return 0  # observer only — planner stays warm for takeover
         owners: dict = {}
         primaries = self.slotmap.primary_ids()
@@ -598,6 +607,10 @@ class RebalanceAgent(threading.Thread):
         self._bump_counter("rebalancer_decisions", "planned", len(moves))
         if not moves:
             return 0
+        events = self._events()
+        if events is not None:
+            events.emit("rebalance.wave.planned", moves=len(moves),
+                        imbalance=round(self.planner.last_ratio, 4))
         tracer = getattr(self.obs, "trace", None) if self.obs else None
         if tracer is not None:
             with tracer.span_scope("rebalance.wave") as span:
@@ -613,13 +626,19 @@ class RebalanceAgent(threading.Thread):
 
     def _execute(self, moves, excluded, now: float) -> list:
         self.waves += 1
+        wave_t0 = time.monotonic()
         records = run_wave(
             self.slotmap, moves, excluded=excluded, batch=self.batch,
             pace_s=self.pace_s, stop_evt=self._stop_evt,
         )
+        events = self._events()
         for rec in records:
             outcome = rec["outcome"]
             self._bump_counter("rebalancer_decisions", outcome, 1)
+            if events is not None and outcome.startswith("skip_"):
+                events.emit("rebalance.wave.skipped",
+                            slot=rec["move"].slot,
+                            reason=outcome[len("skip_"):])
             if outcome == "moved":
                 self.slots_moved += 1
                 self.keys_moved += rec["keys"]
@@ -641,6 +660,22 @@ class RebalanceAgent(threading.Thread):
                 # pump (unmigratable key, flapping peer) won't be fixed
                 # by an immediate retry storm.
                 self.planner.note_moved(rec["move"].slot, now)
+        wave_ms = (time.monotonic() - wave_t0) * 1e3
+        if events is not None:
+            events.emit(
+                "rebalance.wave.executed",
+                moved=sum(1 for r in records if r["outcome"] == "moved"),
+                failed=sum(1 for r in records
+                           if r["outcome"] == "failed"),
+                skipped=sum(1 for r in records
+                            if r["outcome"].startswith("skip_")),
+                ms=round(wave_ms, 3),
+            )
+        if self.obs is not None:
+            try:
+                self.obs.latency.record("rebalance-wave", wave_ms)
+            except AttributeError:
+                pass
         return records
 
     def _bump_counter(self, family: str, kind: str, n: int) -> None:
@@ -650,3 +685,6 @@ class RebalanceAgent(threading.Thread):
             getattr(self.obs, family).inc((kind,), n)
         except AttributeError:
             pass
+
+    def _events(self):
+        return getattr(self.obs, "events", None)

@@ -405,6 +405,20 @@ class Config:
         self.rebalance_max_moves = 8
         self.rebalance_pace_ms = 50
         self.rebalance_cooldown_ms = 15000
+        # Fleet doctor (ISSUE 20).  ``doctor_enabled`` arms the
+        # continuous invariant sweep (obs/doctor.py): every armed node
+        # probes the fleet, the coordinator (lowest-id alive primary)
+        # audits — slot ownership, offset/epoch monotonicity, replica
+        # lag, stuck migrations — and runs the black-box WAIT-fenced
+        # canary.  ``doctor_stuck_slot_ms`` is how long a slot may sit
+        # MIGRATING/IMPORTING before that reads as an abandoned
+        # reshard; ``doctor_lag_bound_ops`` the replica-lag finding
+        # threshold.
+        self.doctor_enabled = False
+        self.doctor_interval_ms = 1000
+        self.doctor_stuck_slot_ms = 30000
+        self.doctor_lag_bound_ops = 10000
+        self.doctor_canary = True
 
     # -- fluent setters, mirroring the Java builder idiom ------------------
 
@@ -482,6 +496,11 @@ class Config:
         "rebalance_max_moves",
         "rebalance_pace_ms",
         "rebalance_cooldown_ms",
+        "doctor_enabled",
+        "doctor_interval_ms",
+        "doctor_stuck_slot_ms",
+        "doctor_lag_bound_ops",
+        "doctor_canary",
     )
 
     def to_dict(self) -> dict:

@@ -188,6 +188,9 @@ class ReplicaLink(threading.Thread):
         self._stop_evt = threading.Event()
         self._sock: Optional[socket.socket] = None
 
+    def _events(self):
+        return getattr(self.obs, "events", None)
+
     # -- public surface ----------------------------------------------------
 
     def lag_ops(self) -> int:
@@ -222,6 +225,16 @@ class ReplicaLink(threading.Thread):
             except (OSError, ReplyError, ReplicaStreamError, ValueError):
                 pass
             finally:
+                if self.link_up:
+                    # Emit only on an up->down edge — a dead primary
+                    # would otherwise spam one event per reconnect try.
+                    events = self._events()
+                    if events is not None:
+                        events.emit("repl.link.down", severity="warn",
+                                    master=f"{self.master_host}:"
+                                           f"{self.master_port}",
+                                    applied=self.applied,
+                                    lag=self.lag_ops())
                 self.link_up = False
                 s, self._sock = self._sock, None
                 if s is not None:
@@ -256,6 +269,10 @@ class ReplicaLink(threading.Thread):
             with self._lock:
                 self.replid = bytes(psync[1]).decode()
                 self.partial_resyncs += 1
+            events = self._events()
+            if events is not None:
+                events.emit("repl.partial_resync", side="replica",
+                            offset=self.applied)
         elif tag == "FULLRESYNC":
             self._full_resync(psync)
         else:
@@ -301,6 +318,7 @@ class ReplicaLink(threading.Thread):
         import shutil
         import tempfile
 
+        resync_t0 = time.monotonic()
         tmp = tempfile.mkdtemp(prefix="rtpu-fullresync-")
         try:
             _safe_extract(tar_bytes, tmp)
@@ -329,6 +347,17 @@ class ReplicaLink(threading.Thread):
                 self.full_resyncs += 1
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
+        resync_ms = (time.monotonic() - resync_t0) * 1e3
+        events = self._events()
+        if events is not None:
+            events.emit("repl.full_resync", severity="warn",
+                        side="replica", snap_seq=snap_seq,
+                        bytes=len(tar_bytes), ms=round(resync_ms, 3))
+        if self.obs is not None:
+            try:
+                self.obs.latency.record("full-resync", resync_ms)
+            except AttributeError:
+                pass
 
     def _apply_batch(self, frames) -> int:
         """Verify then apply one REPLFETCH batch.  Verification is

@@ -15,6 +15,7 @@ the codebase uses; everything is lazy-cheap when nothing reads it.
 
 from __future__ import annotations
 
+from redisson_tpu.obs.events import EventRing
 from redisson_tpu.obs.latency import LatencyMonitor
 from redisson_tpu.obs.loadmap import LoadMap
 from redisson_tpu.obs.registry import Family, MetricsRegistry
@@ -38,7 +39,8 @@ class Observability:
             "rtpu_latency_events",
             "latency-monitor samples recorded, by event "
             "(command | slow-launch | fsync-stall | breaker-open | "
-            "migration | reconcile)", ("event",))
+            "migration | reconcile | election | rebalance-wave | "
+            "full-resync)", ("event",))
         self.trace_sampled = r.counter(
             "rtpu_trace_sampled",
             "requests head-sampled into a distributed trace")
@@ -340,6 +342,34 @@ class Observability:
             "smoothed heat model; 1.0 = perfectly level",
             lambda: float(self.rebalancer_imbalance_source())
             if self.rebalancer_imbalance_source is not None else 1.0)
+        # Fleet flight recorder + invariant doctor (ISSUE 20): the
+        # control planes' causal event record (obs/events.py) and the
+        # continuous protocol auditor (obs/doctor.py).  Kind label
+        # cardinality is bounded by the events.KINDS catalog (rtpulint
+        # RT015 rejects unregistered kind literals at lint time).
+        self.events_emitted = r.counter(
+            "rtpu_events_emitted",
+            "flight-recorder events emitted, by kind (bounded by the "
+            "events.KINDS catalog)", ("kind",))
+        self.events_evicted = r.counter(
+            "rtpu_events_evicted",
+            "flight-recorder events evicted from the bounded ring "
+            "(visible downstream as per-node seq gaps)")
+        self.events = EventRing(
+            counter=self.events_emitted,
+            evicted_counter=self.events_evicted)
+        self.doctor_sweeps = r.counter(
+            "rtpu_doctor_sweeps",
+            "invariant-doctor sweeps completed on this node (only the "
+            "elected coordinator sweeps)")
+        self.doctor_findings = r.counter(
+            "rtpu_doctor_findings",
+            "invariant findings raised by the doctor, by kind",
+            ("kind",))
+        self.doctor_canary_rtt_us = r.histogram(
+            "rtpu_doctor_canary_rtt_us",
+            "black-box canary round trip (WAIT-fenced write-then-read "
+            "through the real client path)")
         self.repl_offset_source = None  # wired by the RESP door
         self.repl_lag_source = None
         r.gauge_callback(
@@ -472,6 +502,7 @@ class Observability:
 
 
 __all__ = [
+    "EventRing",
     "Family",
     "LatencyMonitor",
     "LoadMap",

@@ -16,7 +16,13 @@ Event sources in this codebase:
 - ``migration``     — one key's MIGRATE dump→RESTORE→delete critical
                       section (cluster/door.py);
 - ``reconcile``     — a degraded-kind mirror write-back at breaker
-                      close (objects/engines.py).
+                      close (objects/engines.py);
+- ``election``      — one failover election attempt, start to win/loss
+                      (cluster/failover.py);
+- ``rebalance-wave``— one executed rebalance wave, plan to last move
+                      (cluster/rebalancer.py);
+- ``full-resync``   — a replica-side full resynchronization, snapshot
+                      load included (durability/replica.py).
 
 Semantics follow Redis: threshold 0 disables monitoring entirely (the
 hot-path guard is one attribute read + compare); each event keeps the
@@ -141,6 +147,15 @@ class LatencyMonitor:
                          "this during resharding",
             "reconcile": "mirror write-back volume tracks the degraded "
                          "window length; close breakers sooner",
+            "election": "slow elections lengthen the unavailability "
+                        "window; check peer timeouts and EVENTS GET "
+                        "failover. for the vote timeline",
+            "rebalance-wave": "long waves hold slot move guards; lower "
+                              "rebalance-max-moves or raise the "
+                              "interval",
+            "full-resync": "a replica fell off the backlog; grow "
+                           "repl-backlog-size or check EVENTS GET "
+                           "repl.link.down for flapping links",
         }
         for name, ts, ms, mx in latest:
             lines.append(

@@ -257,6 +257,13 @@ class MulticoreRouter:
             name="rtpu-frontdoor-peer-accept", daemon=True,
         )
         self._accept_thread.start()
+        events = getattr(obs, "events", None)
+        if events is not None:
+            # Self-announce: the parent is a pure supervisor with no
+            # obs ring, so each worker records its own spawn (and
+            # siblings record deaths via dead peer listeners).
+            events.emit("multicore.worker.spawn", index=self.index,
+                        nworkers=self.nworkers, pid=os.getpid())
 
     # -- routing decisions ---------------------------------------------------
 
@@ -363,6 +370,16 @@ class MulticoreRouter:
         self.n_errors += 1
         if self.obs is not None:
             self.obs.frontdoor_handoff_errors.inc((kind,))
+            events = getattr(self.obs, "events", None)
+            if events is not None:
+                events.emit("multicore.handoff.broken", severity="warn",
+                            kind=kind, worker=str(w), error=str(exc))
+                if isinstance(exc, (ConnectionRefusedError,
+                                    FileNotFoundError)):
+                    # The sibling's unix listener is GONE (not merely a
+                    # broken stream): the worker itself died.
+                    events.emit("multicore.worker.death",
+                                severity="error", worker=str(w))
         return _encode_error(
             f"HANDOFFBROKEN in-node {kind} leg to worker {w} failed "
             f"({exc}); retry"

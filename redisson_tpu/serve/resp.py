@@ -138,6 +138,9 @@ _SHED_EXEMPT = frozenset((
     # Load-attribution plane (ISSUE 16): HOTKEYS is how an operator
     # finds the key causing the overload being shed.
     "HOTKEYS",
+    # Flight recorder (ISSUE 20): the causal event timeline is exactly
+    # what an operator replays DURING the incident being shed around.
+    "EVENTS",
     # Replication + failover plane (ISSUE 18): the stream, the acks,
     # and the cluster bus must keep flowing DURING an overload — a shed
     # replication fetch turns node pressure into replica lag, and a
@@ -181,6 +184,7 @@ _NONMUTATING = frozenset((
     "TIME", "COMMAND", "CLIENT", "INFO", "SLOWLOG", "WAIT", "AUTH",
     "HELLO", "QUIT", "SAVE", "BGSAVE", "LASTSAVE", "BGREWRITEAOF",
     "ASKING", "LATENCY", "TRACE", "MONITOR", "RTPU.TRACE", "HOTKEYS",
+    "EVENTS",
     # Replication plane (ISSUE 18): stream/ack/bus verbs never change a
     # keyspace-read result on THIS node (a replica's keyspace changes
     # through the apply path, not through the dispatched verb).
@@ -912,8 +916,16 @@ class RespServer:
         self.replica_link = None
         self.failover = None
         # Autonomous rebalancer agent (cluster/rebalancer.py) when
-        # armed via --rebalance / config rebalance_enabled.
+        # armed via --rebalance / config rebalance_enabled; fleet
+        # doctor (obs/doctor.py) when armed via --doctor.
         self.rebalancer = None
+        self.doctor = None
+        # Flight recorder (ISSUE 20): stamp the ring with this node's
+        # cluster identity so fleet_events() merges by node id (empty
+        # node = standalone process — the ring still works).
+        events = getattr(self.obs, "events", None)
+        if events is not None and self.cluster is not None:
+            events.node = self.cluster.myid
         self._repl_hub()  # eager when the journal is already attached
         self._obs_wire_repl_gauges()
         master = getattr(client.config, "replica_of", None)
@@ -1127,6 +1139,9 @@ class RespServer:
         # failover agent dials peers, the hub taps the journal.  The
         # rebalancer first — mid-wave it drives migrations THROUGH the
         # failover-tracked peers.
+        doc = getattr(self, "doctor", None)
+        if doc is not None:
+            doc.stop()
         rb = getattr(self, "rebalancer", None)
         if rb is not None:
             rb.stop()
@@ -2242,6 +2257,11 @@ class RespServer:
                 # Bounded staleness: a keyed read on a replica that has
                 # fallen more than the configured op count behind is
                 # refused (retryable) instead of served silently stale.
+                events = self._events()
+                if events is not None:
+                    events.emit("repl.stale_read", severity="warn",
+                                lag=link.lag_ops(), bound=bound,
+                                cmd=name)
                 raise RespError(
                     f"STALEREAD replica is {link.lag_ops()} ops behind "
                     f"its primary (bound {bound}); retry or read the "
@@ -2981,8 +3001,10 @@ class RespServer:
                     elif hasattr(eng, "journal_set_policy"):
                         eng.journal_set_policy(val.lower())
                     self._config_table[key] = val
+                    self._audit_config_set(key, val)
                     continue
                 self._config_table[key] = val
+                self._audit_config_set(key, val)
                 # Live-apply the slowlog/nearcache tunables (validated
                 # above).
                 if key == "slowlog-log-slower-than":
@@ -3007,6 +3029,14 @@ class RespServer:
             self.obs.reset_command_stats()
             return _encode_simple("OK")
         raise RespError(f"Unknown CONFIG subcommand {sub}")
+
+    def _audit_config_set(self, key: str, val: str) -> None:
+        """The CONFIG SET audit trail (ISSUE 20): every applied pair
+        lands in the flight recorder, so a 3 a.m. behavior change is
+        attributable to the knob that caused it."""
+        events = self._events()
+        if events is not None:
+            events.emit("config.set", key=key, value=val)
 
     def _cmd_WAIT(self, args):
         """Standalone server, no replicas: 0 acknowledged replicas is
@@ -3057,10 +3087,17 @@ class RespServer:
         numreplicas = int(args[0]) if args else 0
         if numreplicas <= 0:
             return _encode_int(hub.count_acked(fence_seq))
-        return _encode_int(hub.wait_acked(
+        acked = hub.wait_acked(
             fence_seq, numreplicas,
             timeout_s if timeout_s is not None else float("inf"),
-        ))
+        )
+        if acked < numreplicas:
+            events = self._events()
+            if events is not None:
+                events.emit("repl.wait.timeout", severity="warn",
+                            offset=fence_seq, asked=numreplicas,
+                            acked=acked)
+        return _encode_int(acked)
 
     # -- replication plane (ISSUE 18 tentpole) -----------------------------
 
@@ -3090,6 +3127,11 @@ class RespServer:
                 ) or (4 << 20)),
             )
         return hub
+
+    def _events(self):
+        """The flight-recorder ring (obs/events.py), or None on a bare
+        bundle — every door-side emit point rides this accessor."""
+        return getattr(self.obs, "events", None)
 
     def _repl_offset(self) -> int:
         """This node's replication offset: a replica reports what it
@@ -3384,6 +3426,13 @@ class RespServer:
         granted = fo.state.grant_vote(
             self._s(args[0]), int(args[1]), self._s(args[2])
         )
+        if granted:
+            events = self._events()
+            if events is not None:
+                events.emit("failover.vote",
+                            candidate=self._s(args[0]),
+                            epoch=int(args[1]),
+                            failed_primary=self._s(args[2]))
         return _encode_int(1 if granted else 0)
 
     def _cmd_RTPU_TAKEOVER(self, args):
@@ -3414,6 +3463,11 @@ class RespServer:
         fo = self.failover
         if fo is not None:
             fo.state.note_takeover(new_id, old_id, epoch)
+        events = self._events()
+        if events is not None:
+            events.emit("failover.takeover.applied", epoch=epoch,
+                        new_primary=new_id, old_primary=old_id,
+                        slots_moved=moved)
         return _encode_int(moved)
 
     # -- persistence commands (ISSUE 10): SAVE family goes live -----------
@@ -4405,7 +4459,8 @@ class RespServer:
     _INFO_DEFAULT = (
         "server", "clients", "memory", "stats", "persistence",
         "replication", "nearcache", "frontdoor", "overload", "cluster",
-        "rebalance", "telemetry", "loadstats", "keyspace",
+        "rebalance", "telemetry", "events", "doctor", "loadstats",
+        "keyspace",
     )
 
     def _cmd_INFO(self, args):
@@ -4782,6 +4837,44 @@ class RespServer:
                         f"rebalance_keys_moved:{st['keys_moved']}",
                         f"rebalance_failures:{st['failures']}",
                     ]
+            elif s == "events":
+                # Flight recorder (ISSUE 20): ring occupancy, lifetime
+                # seq, and evictions — the "is the black box taping"
+                # check before an operator trusts EVENTS GET.
+                ring = self._events()
+                lines.append("# Events")
+                if ring is None:
+                    lines.append("events_enabled:0")
+                else:
+                    st = ring.stats()
+                    lines += [
+                        "events_enabled:1",
+                        f"events_len:{st['events']}",
+                        f"events_seq:{st['seq']}",
+                        f"events_evicted:{st['evicted']}",
+                        f"events_max:{st['max_events']}",
+                    ]
+            elif s == "doctor":
+                # Invariant doctor (ISSUE 20): armed state + live sweep
+                # and finding counts (the CLUSTER DOCTOR headline rows).
+                doc = getattr(self, "doctor", None)
+                lines.append("# Doctor")
+                if doc is None:
+                    lines.append("doctor_enabled:0")
+                else:
+                    st = doc.status()
+                    lines += [
+                        "doctor_enabled:1",
+                        f"doctor_paused:{1 if st['paused'] else 0}",
+                        "doctor_is_coordinator:"
+                        f"{1 if st['is_coordinator'] else 0}",
+                        f"doctor_interval_ms:{st['interval_ms']}",
+                        f"doctor_sweeps:{st['sweeps']}",
+                        f"doctor_active_findings:"
+                        f"{len(st['active_findings'])}",
+                        f"doctor_findings_total:{st['findings_total']}",
+                        f"doctor_canary_failures:{st['canary_failures']}",
+                    ]
             elif s == "loadstats":
                 # Load-attribution plane (ISSUE 16): the loadmap's
                 # totals, hottest slots/keys, and the per-tenant
@@ -4986,6 +5079,57 @@ class RespServer:
                 b"LATENCY HELP",
             ])
         raise RespError(f"Unknown LATENCY subcommand {sub}")
+
+    def _cmd_EVENTS(self, args):
+        """EVENTS GET [count] [kind] | LEN | RESET | HELP — the flight
+        recorder's RESP surface (ISSUE 20).  GET replies ONE JSON
+        document (node id, ring stats, events newest-last) so the
+        cluster client's fleet_events() merge is a per-node JSON parse
+        + list merge, the CLUSTER LOADMAP shape.  ``kind`` filters by
+        exact kind, or a whole control plane with a trailing dot
+        (``EVENTS GET 0 doctor.``)."""
+        if not args:
+            raise RespError(
+                "wrong number of arguments for 'events' command"
+            )
+        sub = args[0].decode().upper()
+        ring = self._events()
+        if ring is None:
+            raise RespError("this process has no flight recorder")
+        if sub == "GET":
+            count = 0
+            kind = ""
+            if len(args) > 1:
+                try:
+                    count = int(args[1])
+                except ValueError:
+                    raise RespError(
+                        "value is not an integer or out of range"
+                    )
+                if count < 0:
+                    raise RespError(
+                        "value is not an integer or out of range"
+                    )
+            if len(args) > 2:
+                kind = args[2].decode()
+            import json
+
+            doc = dict(ring.stats())
+            doc["node"] = ring.node
+            doc["events"] = ring.snapshot(count=count, kind=kind)
+            return _encode_bulk(json.dumps(doc).encode())
+        if sub == "LEN":
+            return _encode_int(len(ring))
+        if sub == "RESET":
+            return _encode_int(ring.reset())
+        if sub == "HELP":
+            return _encode_array([
+                b"EVENTS GET [<count>] [<kind> | <plane.>]",
+                b"EVENTS LEN",
+                b"EVENTS RESET",
+                b"EVENTS HELP",
+            ])
+        raise RespError(f"Unknown EVENTS subcommand {sub}")
 
     def _cmd_HOTKEYS(self, args):
         """HOTKEYS [count] (ISSUE 16): the hottest keys by the loadmap's
@@ -5278,6 +5422,70 @@ class RespServer:
                 return _encode_simple("OK")
             raise RespError(
                 f"Unknown CLUSTER REBALANCE verb '{verb.lower()}'"
+            )
+        if sub == "MIGRATIONS":
+            # This node's in-flight slot states (slot -> peer id), the
+            # doctor's stuck-migration probe surface.  JSON bulk, the
+            # LOADMAP idiom.
+            import json
+
+            with door.slotmap._lock:
+                payload = {
+                    "node": door.myid,
+                    "importing": {
+                        str(s): n
+                        for s, n in door.slotmap.importing.items()
+                    },
+                    "migrating": {
+                        str(s): n
+                        for s, n in door.slotmap.migrating.items()
+                    },
+                }
+            return _encode_bulk(json.dumps(payload).encode())
+        if sub == "DOCTOR":
+            # Fleet doctor surface (ISSUE 20): bare CLUSTER DOCTOR is
+            # the human-readable report (the LATENCY DOCTOR analog);
+            # STATUS works even unarmed (enabled=false) so operators
+            # can probe; PAUSE/RESUME/NOW require the agent — the
+            # CLUSTER REBALANCE contract.
+            import json
+
+            verb = (
+                self._s(args[1]).upper() if len(args) > 1 else "REPORT"
+            )
+            doc = getattr(self, "doctor", None)
+            if verb == "STATUS":
+                if doc is None:
+                    payload = {"enabled": False}
+                else:
+                    payload = doc.status()
+                payload["node"] = door.myid
+                return _encode_bulk(json.dumps(payload).encode())
+            if doc is None:
+                if verb == "REPORT":
+                    return _encode_bulk(
+                        b"Fleet doctor is not armed on this node "
+                        b"(start with --doctor)."
+                    )
+                raise RespError(
+                    "fleet doctor is not armed on this node "
+                    "(start with --doctor)"
+                )
+            if verb == "REPORT":
+                return _encode_bulk(doc.report().encode())
+            if verb == "PAUSE":
+                doc.pause()
+                return _encode_simple("OK")
+            if verb == "RESUME":
+                doc.resume()
+                return _encode_simple("OK")
+            if verb == "NOW":
+                # Synchronous forced sweep in this connection's
+                # thread; the reply is the active-finding count, so a
+                # chaos harness can assert convergence step by step.
+                return _encode_int(doc.tick(force=True))
+            raise RespError(
+                f"Unknown CLUSTER DOCTOR verb '{verb.lower()}'"
             )
         raise RespError(
             f"Unknown CLUSTER subcommand or wrong number of arguments "

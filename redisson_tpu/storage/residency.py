@@ -228,6 +228,19 @@ class ResidencyManager:
         }.get(kind)
         if fam is not None:
             fam.inc()
+        events = getattr(obs, "events", None)
+        if events is not None:
+            # The flight recorder records tier TRANSITIONS (promote /
+            # demote / spill); disk loads are read-path volume, not a
+            # control-plane decision.  One literal per branch: RT015
+            # requires every emitted kind to be a registered literal.
+            ms = round((self._clock() - t0) * 1e3, 3)
+            if kind == "promote":
+                events.emit("residency.promote", object=name, ms=ms)
+            elif kind == "demote":
+                events.emit("residency.demote", object=name, ms=ms)
+            elif kind == "spill":
+                events.emit("residency.spill", object=name, ms=ms)
         lat = getattr(obs, "latency", None)
         if lat is not None and lat.threshold_ms > 0:
             lat.record(

@@ -1,0 +1,33 @@
+"""RT015 known-bad corpus: flight-recorder emits whose kind is
+dynamic, missing, or not registered in the obs/events.py KINDS
+catalog."""
+
+
+class Agent:
+    def __init__(self, obs):
+        self.obs = obs
+
+    def _events(self):
+        return getattr(self.obs, "events", None)
+
+    def tick(self, kind, peer):
+        events = self._events()
+        if events is None:
+            return
+        # Dynamic kind built from a variable: invisible to the catalog
+        # audit, unbounded rtpu_events_emitted cardinality.
+        events.emit("failover." + kind, peer=peer)  # rtpulint-expect: RT015
+        # f-string kind: same failure, fancier syntax.
+        events.emit(f"failover.{kind}", peer=peer)  # rtpulint-expect: RT015
+        # Literal, but never registered in KINDS: raises ValueError at
+        # runtime — on a path that only runs during an outage.
+        events.emit("failover.exploded", peer=peer)  # rtpulint-expect: RT015
+
+    def audit(self):
+        # Accessor-call receiver form; kind passed as a keyword but
+        # still dynamic (str() call).
+        self._events().emit(kind=str("x"), a=1)  # rtpulint-expect: RT015
+
+    def note(self, obs):
+        # Attribute-chain receiver with no kind argument at all.
+        obs.events.emit(severity="warn")  # rtpulint-expect: RT015

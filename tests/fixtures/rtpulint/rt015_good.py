@@ -1,0 +1,38 @@
+"""RT015 known-good corpus: every flight-recorder emit passes a plain
+string literal registered in the obs/events.py KINDS catalog — one
+literal per branch, the residency.py discipline."""
+
+
+class Agent:
+    def __init__(self, obs):
+        self.obs = obs
+
+    def _events(self):
+        return getattr(self.obs, "events", None)
+
+    def tick(self, peer, timeout_s):
+        events = self._events()
+        if events is None:
+            return
+        events.emit("failover.detected", severity="warn",
+                    peer=peer, timeout_s=timeout_s)
+
+    def transition(self, kind, name):
+        # A dynamic category resolves to one literal per branch
+        # instead of string-building the kind.
+        events = getattr(self.obs, "events", None)
+        if events is None:
+            return
+        if kind == "promote":
+            events.emit("residency.promote", object=name)
+        elif kind == "demote":
+            events.emit("residency.demote", object=name)
+
+    def audit(self):
+        self._events().emit("doctor.finding", severity="error",
+                            kind="dead-primary", subject="n2")
+
+    def relay(self, bus, payload):
+        # Not a flight-recorder receiver: an unrelated emit() API
+        # (message bus) must not trip the rule.
+        bus.emit(payload["topic"], payload)

@@ -1,0 +1,468 @@
+"""Fleet doctor (ISSUE 20): invariant sweeps, the finding ledger's
+raise/clear lifecycle, the black-box canary, the CLUSTER DOCTOR
+surface — and the chaos acceptance: an injected fault is detected
+within one sweep, a clean fleet produces zero false positives, and
+the doctor's events join the causal fleet timeline."""
+
+import json
+import socket
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu.cluster.slots import NSLOTS, key_slot
+from redisson_tpu.config import Config
+from redisson_tpu.obs.doctor import FINDING_KINDS, FleetDoctor, canary_key
+from redisson_tpu.serve.resp import RespServer
+from redisson_tpu.serve.wireutil import ReplyError, exchange
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _raw(addr, cmds, timeout=10.0):
+    sock = socket.create_connection(addr, timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        return exchange(sock, cmds)
+    finally:
+        sock.close()
+
+
+class _Cluster2:
+    """Two in-process cluster doors splitting the slot space, with an
+    optional phantom third member (a node id whose address nothing
+    listens on — the injectable dead primary)."""
+
+    def __init__(self, phantom=False):
+        pa, pb = _free_port(), _free_port()
+        nodes = [
+            {"id": "A", "host": "127.0.0.1", "port": pa,
+             "slots": [[0, 8191]]},
+            {"id": "B", "host": "127.0.0.1", "port": pb,
+             "slots": [[8192, NSLOTS - 1]]},
+        ]
+        if phantom:
+            # Carve C's range out of B: a primary that owns slots but
+            # answers on a port nobody listens on.
+            nodes[1]["slots"] = [[8192, 12287]]
+            nodes.append({
+                "id": "C", "host": "127.0.0.1", "port": _free_port(),
+                "slots": [[12288, NSLOTS - 1]],
+            })
+        topo = {"nodes": nodes}
+        self.nodes = {}
+        for nid, port in (("A", pa), ("B", pb)):
+            cfg = Config()
+            cfg.cluster_enabled = True
+            cfg.cluster_topology = topo
+            cfg.cluster_node_id = nid
+            client = redisson_tpu.create(cfg)
+            self.nodes[nid] = (client, RespServer(client, port=port))
+        self.addr = {"A": ("127.0.0.1", pa), "B": ("127.0.0.1", pb)}
+
+    def server(self, nid):
+        return self.nodes[nid][1]
+
+    def close(self):
+        for client, server in self.nodes.values():
+            server.close()
+            client.shutdown()
+
+
+@pytest.fixture()
+def cluster2():
+    c = _Cluster2()
+    yield c
+    c.close()
+
+
+def _doctor(server, **kw):
+    kw.setdefault("interval_s", 3600.0)  # ticks only when forced
+    kw.setdefault("canary", False)
+    return FleetDoctor(server, **kw)
+
+
+class TestSweepInvariants:
+    def test_clean_fleet_zero_findings(self, cluster2):
+        doc = _doctor(cluster2.server("A"), canary=True)
+        assert doc.tick(force=True) == 0
+        assert doc.active == {}
+        assert doc.sweeps == 1
+        st = doc.status()
+        assert st["is_coordinator"] and st["coordinator"] == "A"
+        assert st["active_findings"] == []
+        # The canary ran against both primaries and failed nowhere.
+        assert doc.canary_failures == 0
+
+    def test_non_coordinator_observes_without_findings(self, cluster2):
+        doc_b = _doctor(cluster2.server("B"))
+        assert doc_b.status()["is_coordinator"] is False
+        doc_b.tick()  # unforced: observer path
+        assert doc_b.active == {}
+
+    def test_dead_primary_detected_within_one_sweep(self):
+        c = _Cluster2(phantom=True)
+        try:
+            doc = _doctor(c.server("A"))
+            n = doc.tick(force=True)
+            assert n >= 1
+            keys = set(doc.active)
+            assert "dead-primary:C" in keys, keys
+            f = doc.active["dead-primary:C"]
+            assert f["severity"] == "error"
+            # The raise left a doctor.finding event on the ring.
+            evs = c.server("A").obs.events.snapshot(
+                kind="doctor.finding"
+            )
+            assert any(
+                e["fields"]["kind"] == "dead-primary" for e in evs
+            )
+        finally:
+            c.close()
+
+    def test_finding_clears_when_invariant_restored(self, cluster2):
+        doc = _doctor(cluster2.server("A"))
+        # Inject: a slot stuck MIGRATING for longer than the (tiny)
+        # threshold.
+        doc.stuck_slot_s = 0.05
+        slotmap = cluster2.server("A").cluster.slotmap
+        slotmap.migrating[100] = "B"
+        try:
+            doc.tick(force=True)  # first sighting starts the clock
+            time.sleep(0.1)
+            doc.tick(force=True)
+            assert any(
+                k.startswith("stuck-migration:") for k in doc.active
+            ), doc.active
+        finally:
+            slotmap.migrating.pop(100, None)
+        doc.tick(force=True)
+        assert doc.active == {}
+        evs = cluster2.server("A").obs.events.snapshot(
+            kind="doctor.clear"
+        )
+        assert any(
+            e["fields"]["kind"] == "stuck-migration" for e in evs
+        )
+
+    def test_offset_and_epoch_regressions(self, cluster2):
+        doc = _doctor(cluster2.server("A"))
+        doc.tick(force=True)
+        assert doc.active == {}
+        # Poison the sweep memory to simulate a peer that previously
+        # reported further ahead.
+        doc._last_seen["B"]["offset"] += 1000
+        doc._last_seen["B"]["epoch"] += 5
+        doc.tick(force=True)
+        assert "offset-regression:B" in doc.active
+        assert "epoch-regression:B" in doc.active
+        # Memory now reflects the regressed values: next sweep clears.
+        doc.tick(force=True)
+        assert doc.active == {}
+
+    def test_findings_counter_and_total(self, cluster2):
+        doc = _doctor(cluster2.server("A"))
+        doc.stuck_slot_s = 0.0
+        slotmap = cluster2.server("A").cluster.slotmap
+        slotmap.migrating[7] = "B"
+        try:
+            doc.tick(force=True)
+            time.sleep(0.02)
+            doc.tick(force=True)
+            assert doc.findings_total >= 1
+        finally:
+            slotmap.migrating.pop(7, None)
+
+    def test_finding_kinds_are_a_bounded_catalog(self):
+        assert len(FINDING_KINDS) == len(set(FINDING_KINDS))
+        for k in FINDING_KINDS:
+            assert k == k.lower() and " " not in k
+
+
+class TestCanary:
+    def test_canary_key_lands_on_the_node(self, cluster2):
+        slotmap = cluster2.server("A").cluster.slotmap
+        for nid in ("A", "B"):
+            key = canary_key(nid, slotmap)
+            assert key is not None
+            assert slotmap.owner(key_slot(key.encode())) == nid
+            assert key.startswith("{__rtpu-doctor-")
+
+    def test_canary_probe_round_trips(self, cluster2):
+        doc = _doctor(cluster2.server("A"), canary=True)
+        assert doc._canary_probe("A") is None
+        assert doc._canary_probe("B") is None
+        assert doc.canary_failures == 0
+
+    def test_canary_failure_raises_finding(self):
+        c = _Cluster2(phantom=True)
+        try:
+            doc = _doctor(c.server("A"), canary=True)
+            doc.tick(force=True)
+            # C is unreachable: dead-primary, not a canary finding
+            # (down nodes are skipped by the canary — the liveness
+            # probe already told the truth).
+            assert "dead-primary:C" in doc.active
+            assert not any(
+                k.startswith("canary:") for k in doc.active
+            )
+            # A reachable node whose door lies, though, is a canary
+            # failure: point B's address at a closed port.
+            dead = ("127.0.0.1", _free_port())
+            with doc.slotmap._lock:
+                doc.slotmap._nodes["B"] = dead
+            err = doc._canary_probe("B")
+            assert err is not None
+        finally:
+            c.close()
+
+
+class TestClusterDoctorSurface:
+    def test_status_unarmed(self, cluster2):
+        (raw,) = _raw(cluster2.addr["A"], [("CLUSTER", "DOCTOR", "STATUS")])
+        st = json.loads(raw)
+        assert st == {"enabled": False, "node": "A"}
+
+    def test_report_unarmed_is_friendly(self, cluster2):
+        (raw,) = _raw(cluster2.addr["A"], [("CLUSTER", "DOCTOR")])
+        assert b"--doctor" in raw
+
+    def test_verbs_require_agent(self, cluster2):
+        err = _raw(cluster2.addr["A"], [("CLUSTER", "DOCTOR", "NOW")])[0]
+        assert isinstance(err, ReplyError)
+        assert "--doctor" in str(err)
+
+    def test_armed_status_now_pause_resume_report(self, cluster2):
+        doc = _doctor(cluster2.server("A"))
+        addr = cluster2.addr["A"]
+        (n,) = _raw(addr, [("CLUSTER", "DOCTOR", "NOW")])
+        assert n == 0
+        (raw,) = _raw(addr, [("CLUSTER", "DOCTOR", "STATUS")])
+        st = json.loads(raw)
+        assert st["enabled"] and st["node"] == "A"
+        assert st["sweeps"] >= 1 and st["active_findings"] == []
+        assert st["is_coordinator"] is True
+        assert _raw(addr, [("CLUSTER", "DOCTOR", "PAUSE")])[0] == b"OK" \
+            or _raw(addr, [("CLUSTER", "DOCTOR", "STATUS")])
+        assert doc.paused or json.loads(
+            _raw(addr, [("CLUSTER", "DOCTOR", "STATUS")])[0]
+        )["paused"]
+        (raw,) = _raw(addr, [("CLUSTER", "DOCTOR", "RESUME")])
+        assert doc.paused is False
+        (report,) = _raw(addr, [("CLUSTER", "DOCTOR", "REPORT")])
+        text = report.decode()
+        assert "Fleet doctor on A" in text
+        assert "No active findings" in text
+        err = _raw(addr, [("CLUSTER", "DOCTOR", "BOGUS")])[0]
+        assert isinstance(err, ReplyError)
+
+    def test_report_lists_findings_and_events(self):
+        c = _Cluster2(phantom=True)
+        try:
+            doc = _doctor(c.server("A"))
+            doc.tick(force=True)
+            text = doc.report()
+            assert "dead-primary" in text
+            assert "ACTIVE finding" in text
+            assert "doctor.finding" in text  # the events tail
+            assert "node C" in text and "DOWN" in text
+        finally:
+            c.close()
+
+    def test_info_doctor_section(self, cluster2):
+        addr = cluster2.addr["B"]
+        (info,) = _raw(addr, [("INFO", "doctor")])
+        assert b"doctor_enabled:0" in info
+        _doctor(cluster2.server("B"))
+        (info,) = _raw(addr, [("INFO", "doctor")])
+        text = info.decode()
+        assert "doctor_enabled:1" in text
+        assert "doctor_is_coordinator:0" in text
+        assert "doctor_active_findings:0" in text
+
+    def test_cluster_migrations_verb(self, cluster2):
+        slotmap = cluster2.server("A").cluster.slotmap
+        slotmap.migrating[42] = "B"
+        try:
+            (raw,) = _raw(cluster2.addr["A"], [("CLUSTER", "MIGRATIONS")])
+            doc = json.loads(raw)
+            assert doc["node"] == "A"
+            assert doc["migrating"] == {"42": "B"}
+            assert doc["importing"] == {}
+        finally:
+            slotmap.migrating.pop(42, None)
+
+    def test_doctor_metric_families_registered(self, cluster2):
+        doc = _doctor(cluster2.server("A"))
+        doc.tick(force=True)
+        reg = cluster2.server("A").obs.registry
+        # The sweep bumped the counter, so it renders; the findings and
+        # canary families are registered but empty on a clean fleet
+        # (a family with no series renders nothing — by design).
+        assert "rtpu_doctor_sweeps_total" in reg.render_prometheus()
+        assert reg.family("rtpu_doctor_findings_total") is not None
+        assert reg.family("rtpu_doctor_canary_rtt_us") is not None
+
+    def test_doctor_status_fleet_helper(self, cluster2):
+        from redisson_tpu.cluster.client import ClusterClient
+
+        _doctor(cluster2.server("A"))
+        cc = ClusterClient(list(cluster2.addr.values()))
+        try:
+            st = cc.doctor_status()
+        finally:
+            cc.close()
+        by_enabled = {
+            n: row.get("enabled") for n, row in st.items()
+        }
+        assert sorted(by_enabled.values()) == [False, True]
+
+
+# -- the doctor-armed chaos soak (ISSUE 20 acceptance) ------------------------
+
+
+def _doctor_status_at(addr):
+    from redisson_tpu.cluster.supervisor import _request
+
+    (raw,) = _request(addr, [("CLUSTER", "DOCTOR", "STATUS")])
+    return json.loads(raw)
+
+
+@pytest.mark.slow
+class TestDoctorChaosSoak:
+    def test_kill9_soak_detects_election_then_clears(self):
+        """The acceptance chain: kill -9 a primary under a doctor-armed
+        fleet -> the coordinator raises dead-primary within its sweeps,
+        the replica's election promotes it, the finding CLEARS, the
+        fleet settles to zero active findings, and the merged
+        fleet_events timeline shows election -> takeover ->
+        doctor-clear in causal order."""
+        from redisson_tpu.cluster.supervisor import (
+            ClusterSupervisor,
+            _request,
+        )
+
+        sup = ClusterSupervisor(
+            n_nodes=3, replicas_per_shard=1, node_timeout_ms=3000,
+            startup_timeout_s=180.0, node_args=("--doctor",),
+        )
+        try:
+            sup.start()
+            cc = sup.client()
+            try:
+                for i in range(24):
+                    assert cc.execute("SET", f"dk{i}", "v") == b"OK"
+                # The doctor audits on the lowest alive primary: node 0.
+                addr0 = sup.addrs[0]
+                deadline = time.monotonic() + 60.0
+                st = {}
+                while time.monotonic() < deadline:
+                    st = _doctor_status_at(addr0)
+                    if st.get("enabled") and st.get("is_coordinator") \
+                            and st.get("sweeps", 0) >= 2:
+                        break
+                    time.sleep(0.25)
+                assert st.get("is_coordinator"), st
+                # Clean fleet, zero false positives before the fault.
+                assert st["findings_total"] == 0, st
+
+                sup.kill_node(1)
+
+                # Detection within the sweep cadence: a dead-primary
+                # finding event lands on the coordinator's ring.
+                found = False
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline and not found:
+                    (raw,) = _request(
+                        addr0, [("EVENTS", "GET", "0", "doctor.finding")]
+                    )
+                    doc = json.loads(raw)
+                    found = any(
+                        ev["fields"].get("kind") == "dead-primary"
+                        for ev in doc["events"]
+                    )
+                    if not found:
+                        time.sleep(0.25)
+                assert found, "doctor never raised dead-primary"
+
+                # Settle: promotion happens, the finding clears, and the
+                # fleet returns to zero ACTIVE findings.
+                deadline = time.monotonic() + 60.0
+                settled = False
+                while time.monotonic() < deadline and not settled:
+                    st = _doctor_status_at(addr0)
+                    settled = st.get("active_findings") == []
+                    if not settled:
+                        time.sleep(0.5)
+                assert settled, st
+
+                # Dead-member degradation first: against the STALE slot
+                # table (still naming the killed node) the merge
+                # reports it down instead of raising.
+                tl = cc.fleet_events()
+                assert tl["down_nodes"], tl["down_nodes"]
+
+                # Causal order on the merged fleet timeline: refresh so
+                # the fan-out reaches the PROMOTED replica (it owns the
+                # dead node's slots now), then assert
+                # election won -> takeover applied -> doctor clear.
+                cc.refresh_slots()
+                tl = cc.fleet_events()
+                kinds = [
+                    (e["kind"], e["fields"].get("kind"))
+                    for e in tl["events"]
+                ]
+                def first(kind, fkind=None):
+                    for i, (k, fk) in enumerate(kinds):
+                        if k == kind and (fkind is None or fk == fkind):
+                            return i
+                    return -1
+                i_won = first("failover.election.won")
+                i_take = first("failover.takeover.applied")
+                i_clear = first("doctor.clear", "dead-primary")
+                assert i_won >= 0, "no election.won event in the fleet"
+                assert i_take > i_won, (i_won, i_take)
+                assert i_clear > i_take, (i_take, i_clear)
+            finally:
+                cc.close()
+        finally:
+            sup.shutdown()
+
+    def test_clean_soak_zero_false_positives(self):
+        """A healthy doctor-armed fleet under steady traffic raises
+        NOTHING: findings_total stays 0 and every canary round-trips."""
+        from redisson_tpu.cluster.supervisor import ClusterSupervisor
+
+        sup = ClusterSupervisor(
+            n_nodes=2, replicas_per_shard=1, node_timeout_ms=1000,
+            startup_timeout_s=180.0, node_args=("--doctor",),
+        )
+        try:
+            sup.start()
+            cc = sup.client()
+            try:
+                addr0 = sup.addrs[0]
+                deadline = time.monotonic() + 60.0
+                st = {}
+                while time.monotonic() < deadline:
+                    st = _doctor_status_at(addr0)
+                    if st.get("sweeps", 0) >= 4:
+                        break
+                    for i in range(50):
+                        cc.execute("SET", f"ck{i}", f"v{i}")
+                    time.sleep(0.2)
+                assert st.get("sweeps", 0) >= 4, st
+                assert st["findings_total"] == 0, st
+                assert st["canary_failures"] == 0, st
+                assert st["active_findings"] == [], st
+            finally:
+                cc.close()
+        finally:
+            sup.shutdown()

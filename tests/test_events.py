@@ -1,0 +1,512 @@
+"""Fleet flight recorder (ISSUE 20): the EventRing unit contract, the
+EVENTS RESP surface + INFO section, the fleet_events() causal merge,
+the uniform dead-member degradation of every fleet fanout, and the
+three new LATENCY feeder event names."""
+
+import json
+import os
+import shutil
+import socket
+import tempfile
+import time
+
+import pytest
+
+import redisson_tpu
+from redisson_tpu.cluster.slots import NSLOTS
+from redisson_tpu.config import Config
+from redisson_tpu.obs import trace
+from redisson_tpu.obs.events import (
+    KINDS,
+    SEVERITIES,
+    EventRing,
+    merge_timelines,
+)
+from redisson_tpu.obs.latency import LatencyMonitor
+from redisson_tpu.serve.resp import RespServer
+from redisson_tpu.serve.wireutil import ReplyError, exchange
+
+
+# -- EventRing unit contract --------------------------------------------------
+
+
+class TestEventRing:
+    def test_emit_shape_and_seq_monotone(self):
+        ring = EventRing()
+        ring.node = "N1"
+        a = ring.emit("failover.detected", severity="warn", peer="N2")
+        b = ring.emit("config.set", key="appendonly", value="yes")
+        assert a["seq"] == 1 and b["seq"] == 2
+        assert a["node"] == "N1" and a["kind"] == "failover.detected"
+        assert a["severity"] == "warn" and a["fields"] == {"peer": "N2"}
+        assert a["wall"] <= b["wall"] and a["mono"] <= b["mono"]
+        assert len(ring) == 2
+
+    def test_unregistered_kind_and_severity_raise(self):
+        ring = EventRing()
+        with pytest.raises(ValueError):
+            ring.emit("no.such.kind")
+        with pytest.raises(ValueError):
+            ring.emit("config.set", severity="fatal")
+        assert len(ring) == 0
+
+    def test_catalog_kinds_all_emittable(self):
+        ring = EventRing(max_events=len(KINDS) + 1)
+        for kind in KINDS:
+            ring.emit(kind)
+        assert len(ring) == len(KINDS)
+        assert SEVERITIES == ("info", "warn", "error")
+
+    def test_bounded_ring_evicts_and_seq_never_resets(self):
+        ring = EventRing(max_events=4)
+        for _ in range(10):
+            ring.emit("config.set")
+        assert len(ring) == 4
+        assert ring.evicted == 6
+        # Surviving events are the newest four, seq contiguous.
+        assert [e["seq"] for e in ring.snapshot()] == [7, 8, 9, 10]
+        st = ring.stats()
+        assert st == {
+            "events": 4, "seq": 10, "evicted": 6, "max_events": 4,
+        }
+
+    def test_reset_counts_as_eviction_and_seq_continues(self):
+        ring = EventRing()
+        for _ in range(3):
+            ring.emit("config.set")
+        assert ring.reset() == 3
+        assert len(ring) == 0 and ring.evicted == 3
+        # The next emit's seq proves the reset left a visible gap.
+        assert ring.emit("config.set")["seq"] == 4
+
+    def test_snapshot_count_and_kind_filters(self):
+        ring = EventRing()
+        ring.emit("doctor.finding", kind="dead-primary")
+        ring.emit("doctor.clear", kind="dead-primary")
+        ring.emit("failover.detected", peer="X")
+        assert [e["kind"] for e in ring.snapshot(count=1)] == [
+            "failover.detected"
+        ]
+        assert [e["kind"] for e in ring.snapshot(kind="doctor.clear")] \
+            == ["doctor.clear"]
+        # Trailing-dot prefix selects a whole plane.
+        assert [e["kind"] for e in ring.snapshot(kind="doctor.")] == [
+            "doctor.finding", "doctor.clear",
+        ]
+
+    def test_ambient_trace_scope_attaches_trace_id(self):
+        ring = EventRing()
+        ctx = trace.TraceContext(None, "t-abc", "s-1")
+        with trace.scope(ctx):
+            ev = ring.emit("config.set", key="k", value="v")
+        assert ev["trace_id"] == "t-abc"
+        assert "trace_id" not in ring.emit("config.set")
+
+    def test_counters_bump(self):
+        class Fam:
+            def __init__(self):
+                self.calls = []
+
+            def inc(self, labels=(), n=1):
+                self.calls.append((labels, n))
+
+        emitted, evicted = Fam(), Fam()
+        ring = EventRing(
+            max_events=1, counter=emitted, evicted_counter=evicted
+        )
+        ring.emit("config.set")
+        ring.emit("repl.link.down", severity="warn")
+        assert emitted.calls == [
+            (("config.set",), 1), (("repl.link.down",), 1),
+        ]
+        assert evicted.calls == [((), 1)]
+
+
+class TestMergeTimelines:
+    def test_orders_by_wall_then_node_then_seq(self):
+        per_node = {
+            "B": [
+                {"node": "B", "wall": 2.0, "seq": 1, "kind": "config.set"},
+                {"node": "B", "wall": 4.0, "seq": 2, "kind": "config.set"},
+            ],
+            "A": [
+                {"node": "A", "wall": 1.0, "seq": 1, "kind": "config.set"},
+                {"node": "A", "wall": 2.0, "seq": 2, "kind": "config.set"},
+                {"node": "A", "wall": 3.0, "seq": 3, "kind": "config.set"},
+            ],
+        }
+        merged, gaps = merge_timelines(per_node)
+        assert [(e["node"], e["seq"]) for e in merged] == [
+            ("A", 1), ("A", 2), ("B", 1), ("A", 3), ("B", 2),
+        ]
+        assert gaps == {}
+        # Per-node seq stays monotone inside the merged stream.
+        for node in ("A", "B"):
+            seqs = [e["seq"] for e in merged if e["node"] == node]
+            assert seqs == sorted(seqs)
+
+    def test_seq_gaps_reported_as_evictions(self):
+        merged, gaps = merge_timelines({
+            "A": [
+                {"node": "A", "wall": 1.0, "seq": 3},
+                {"node": "A", "wall": 2.0, "seq": 7},
+                {"node": "A", "wall": 3.0, "seq": 8},
+            ],
+            "B": [{"node": "B", "wall": 1.5, "seq": 1}],
+        })
+        assert gaps == {"A": 3}  # 4,5,6 evicted
+        assert len(merged) == 4
+
+
+# -- the new LATENCY feeder event names (ISSUE 20 satellite) ------------------
+
+
+class TestNewLatencyFeeders:
+    FEEDERS = ("election", "rebalance-wave", "full-resync")
+
+    def test_injected_durations_surface_in_latest(self):
+        mon = LatencyMonitor(threshold_ms=10)
+        for i, ev in enumerate(self.FEEDERS):
+            assert mon.record(ev, 25.0 + i)
+        assert mon.record("election", 5.0) is False  # below threshold
+        latest = dict(
+            (name, (ms, mx)) for name, _ts, ms, mx in mon.latest()
+        )
+        assert set(latest) == set(self.FEEDERS)
+        assert latest["election"] == (25, 25)
+        assert latest["full-resync"] == (27, 27)
+
+    def test_doctor_advice_covers_the_new_events(self):
+        mon = LatencyMonitor(threshold_ms=1)
+        for ev in self.FEEDERS:
+            mon.record(ev, 100.0)
+        advice = mon.doctor()
+        for ev in self.FEEDERS:
+            assert ev in advice
+
+
+# -- RESP surface: EVENTS, INFO events, audit/fence emits ---------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Cluster2:
+    """Two in-process cluster-mode doors splitting the slot space —
+    the test_cluster.py fixture shape, rebuilt here so these tests can
+    kill one member without disturbing a shared fixture."""
+
+    def __init__(self):
+        pa, pb = _free_port(), _free_port()
+        topo = {"nodes": [
+            {"id": "A", "host": "127.0.0.1", "port": pa,
+             "slots": [[0, 8191]]},
+            {"id": "B", "host": "127.0.0.1", "port": pb,
+             "slots": [[8192, NSLOTS - 1]]},
+        ]}
+        self._jdir = tempfile.mkdtemp(prefix="rtpu-events-")
+        self.nodes = {}
+        for nid, port in (("A", pa), ("B", pb)):
+            cfg = Config()
+            cfg.cluster_enabled = True
+            cfg.cluster_topology = topo
+            cfg.cluster_node_id = nid
+            if nid == "A":
+                # A journal on A makes WAIT a real fence there (the
+                # repl.wait.timeout emit path needs a hub).  Only the
+                # TPU-sketch engine owns the op journal, so A runs it.
+                cfg.use_tpu_sketch(min_bucket=64)
+                cfg.journal_dir = os.path.join(self._jdir, "journal-a")
+                cfg.journal_fsync = "no"
+            client = redisson_tpu.create(cfg)
+            self.nodes[nid] = (client, RespServer(client, port=port))
+        self.addr = {"A": ("127.0.0.1", pa), "B": ("127.0.0.1", pb)}
+
+    def server(self, nid):
+        return self.nodes[nid][1]
+
+    def key_for(self, nid, prefix="k"):
+        from redisson_tpu.cluster.slots import key_slot
+
+        i = 0
+        while True:
+            k = f"{prefix}{i}"
+            owner = "A" if key_slot(k.encode()) < 8192 else "B"
+            if owner == nid:
+                return k
+            i += 1
+
+    def close(self):
+        for client, server in self.nodes.values():
+            server.close()
+            client.shutdown()
+        shutil.rmtree(self._jdir, ignore_errors=True)
+
+
+def _raw(addr, cmds, timeout=10.0):
+    sock = socket.create_connection(addr, timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        return exchange(sock, cmds)
+    finally:
+        sock.close()
+
+
+@pytest.fixture()
+def cluster2():
+    c = _Cluster2()
+    yield c
+    c.close()
+
+
+class TestEventsRespSurface:
+    def test_ring_is_node_stamped(self, cluster2):
+        assert cluster2.server("A").obs.events.node == "A"
+        assert cluster2.server("B").obs.events.node == "B"
+
+    def test_events_get_len_reset_help(self, cluster2):
+        addr = cluster2.addr["A"]
+        (before,) = _raw(addr, [("EVENTS", "LEN")])
+        # CONFIG SET leaves an audit-trail event.
+        set_r, (doc_raw,) = _raw(
+            addr, [("CONFIG", "SET", "slowlog-max-len", "64")]
+        ), _raw(addr, [("EVENTS", "GET", "0", "config.set")])
+        doc = json.loads(doc_raw)
+        assert doc["node"] == "A"
+        evs = doc["events"]
+        assert evs and evs[-1]["kind"] == "config.set"
+        assert evs[-1]["fields"] == {
+            "key": "slowlog-max-len", "value": "64",
+        }
+        (after,) = _raw(addr, [("EVENTS", "LEN")])
+        assert after == before + 1
+        # Count cap returns the newest N.
+        (one_raw,) = _raw(addr, [("EVENTS", "GET", "1")])
+        assert len(json.loads(one_raw)["events"]) == 1
+        # RESET drops the ring but seq keeps counting (gap honesty).
+        (dropped,) = _raw(addr, [("EVENTS", "RESET")])
+        assert dropped == after
+        (st_raw,) = _raw(addr, [("EVENTS", "GET")])
+        st = json.loads(st_raw)
+        assert st["events"] == [] and st["seq"] == after \
+            and st["evicted"] >= dropped
+        (help_lines,) = _raw(addr, [("EVENTS", "HELP")])
+        assert any(b"GET" in ln for ln in help_lines)
+        err = _raw(addr, [("EVENTS", "BOGUS")])[0]
+        assert isinstance(err, ReplyError)
+
+    def test_info_events_section(self, cluster2):
+        addr = cluster2.addr["B"]
+        _raw(addr, [("CONFIG", "SET", "slowlog-max-len", "32")])
+        (info,) = _raw(addr, [("INFO", "events")])
+        text = info.decode()
+        assert "events_enabled:1" in text
+        assert "events_seq:" in text and "events_evicted:" in text
+
+    def test_wait_fence_timeout_emits(self, cluster2):
+        # No replicas exist, so WAIT 1 must come back short AND leave
+        # a repl.wait.timeout event behind.
+        addr = cluster2.addr["A"]
+        (acked,) = _raw(addr, [("WAIT", "1", "50")])
+        assert acked == 0
+        (doc_raw,) = _raw(
+            addr, [("EVENTS", "GET", "0", "repl.wait.timeout")]
+        )
+        evs = json.loads(doc_raw)["events"]
+        assert evs and evs[-1]["fields"]["asked"] == 1
+        assert evs[-1]["fields"]["acked"] == 0
+        assert evs[-1]["severity"] == "warn"
+
+    def test_events_metric_family_registered(self, cluster2):
+        # A RESET counts as an eviction (the record is gone either
+        # way), so it also materializes the evicted counter family.
+        _raw(cluster2.addr["A"],
+             [("CONFIG", "SET", "slowlog-max-len", "48"),
+              ("EVENTS", "RESET")])
+        text = cluster2.server("A").obs.registry.render_prometheus()
+        assert "rtpu_events_emitted_total" in text
+        assert 'kind="config.set"' in text
+        assert "rtpu_events_evicted_total" in text
+
+
+# -- fleet_events(): the causal fleet timeline --------------------------------
+
+
+class TestFleetEvents:
+    def _client(self, cluster2):
+        from redisson_tpu.cluster.client import ClusterClient
+
+        return ClusterClient(list(cluster2.addr.values()))
+
+    def test_merged_timeline_is_causally_ordered(self, cluster2):
+        # Interleave audited CONFIG SETs across both nodes so the
+        # merged timeline has something to order.
+        for i in range(3):
+            _raw(cluster2.addr["A"],
+                 [("CONFIG", "SET", "slowlog-max-len", str(100 + i))])
+            _raw(cluster2.addr["B"],
+                 [("CONFIG", "SET", "slowlog-max-len", str(200 + i))])
+        cc = self._client(cluster2)
+        try:
+            fleet = cc.fleet_events(kind="config.set")
+        finally:
+            cc.close()
+        assert fleet["down_nodes"] == []
+        evs = fleet["events"]
+        assert {e["node"] for e in evs} == {"A", "B"}
+        # Global order is (wall, node, seq)…
+        keys = [(e["wall"], e["node"], e["seq"]) for e in evs]
+        assert keys == sorted(keys)
+        # …and per-node seq stays monotone inside the merge.
+        for node in ("A", "B"):
+            seqs = [e["seq"] for e in evs if e["node"] == node]
+            assert len(seqs) >= 3 and seqs == sorted(seqs)
+        assert fleet["gaps"] == {}
+        for row in fleet["nodes"].values():
+            assert "seq" in row and "max_events" in row
+
+    def test_dead_member_degrades_to_error_row(self, cluster2):
+        _raw(cluster2.addr["A"],
+             [("CONFIG", "SET", "slowlog-max-len", "77")])
+        cc = self._client(cluster2)
+        try:
+            cc.execute("GET", "warmup")  # learn the slot table
+            client_b, server_b = cluster2.nodes["B"]
+            server_b.close()
+            fleet = cc.fleet_events()
+            label_b = "%s:%d" % cluster2.addr["B"]
+            assert fleet["down_nodes"] == [label_b]
+            assert "error" in fleet["nodes"][label_b]
+            assert any(e["node"] == "A" for e in fleet["events"])
+        finally:
+            cc.close()
+
+
+# -- uniform dead-member degradation across every fleet fanout ----------------
+
+
+class TestFanoutDegradation:
+    """ISSUE 20 satellite: fleet_info / fleet_slowlog / fleet_traces /
+    fleet_latency degrade to partial results + per-node error rows
+    when a member is down — the fleet_loadmap contract, now shared
+    via _fanout_degraded."""
+
+    @pytest.fixture()
+    def half_dead(self, cluster2):
+        from redisson_tpu.cluster.client import ClusterClient
+
+        cc = ClusterClient(list(cluster2.addr.values()))
+        # Arm slowlog + latency everywhere, generate one entry each,
+        # THEN kill B.
+        for addr in cluster2.addr.values():
+            _raw(addr, [
+                ("CONFIG", "SET", "slowlog-log-slower-than", "0"),
+                ("CONFIG", "SET", "latency-monitor-threshold", "1"),
+            ])
+        cc.execute("SET", "degrade-key", "v")
+        cluster2.server("A").obs.latency.record("command", 25.0)
+        _client_b, server_b = cluster2.nodes["B"]
+        cc.execute("GET", "warmup")  # slot table before the kill
+        server_b.close()
+        yield cc, "%s:%d" % cluster2.addr["B"]
+        cc.close()
+
+    def test_fleet_info_partial_plus_error_row(self, half_dead):
+        cc, label_b = half_dead
+        fi = cc.fleet_info("server")
+        assert fi["down_nodes"] == [label_b]
+        assert fi["nodes"][label_b].keys() == {"error"}
+        live = [
+            n for n, row in fi["nodes"].items() if "error" not in row
+        ]
+        assert live, "no partial results from the surviving node"
+
+    def test_fleet_slowlog_trailing_error_row(self, half_dead):
+        cc, label_b = half_dead
+        merged = cc.fleet_slowlog(-1)
+        err_rows = [e for e in merged if "error" in e]
+        assert [e["node"] for e in err_rows] == [label_b]
+        assert err_rows[-1] is merged[-1], "error rows must trail"
+        assert any("error" not in e for e in merged)
+
+    def test_fleet_latency_trailing_error_row(self, half_dead):
+        cc, label_b = half_dead
+        merged = cc.fleet_latency()
+        err_rows = [e for e in merged if "error" in e]
+        assert [e["node"] for e in err_rows] == [label_b]
+        live = [e for e in merged if "error" not in e]
+        assert any(e["event"] == "command" for e in live)
+
+    def test_fleet_traces_down_nodes_key(self, half_dead):
+        cc, label_b = half_dead
+        out = cc.fleet_traces()
+        assert label_b in out.get("down_nodes", {})
+        assert "error" in out["down_nodes"][label_b]
+
+    def test_fleet_loadmap_contract_unchanged(self, half_dead):
+        cc, label_b = half_dead
+        lm = cc.fleet_loadmap()
+        assert lm["down_nodes"] == [label_b]
+        assert "error" in lm["nodes"][label_b]
+
+
+# -- emit points: breaker + residency planes (in-process spot checks) ---------
+
+
+class TestControlPlaneEmits:
+    def test_health_breaker_open_close_emits(self):
+        from redisson_tpu.executor.health import DispatchHealth
+        from redisson_tpu.obs import Observability
+
+        obs = Observability()
+        dh = DispatchHealth(failure_threshold=1, open_s=0.02)
+        dh.obs = obs
+        try:
+            dh.record_failure("cms_update", RuntimeError("boom"))
+            evs = obs.events.snapshot(kind="health.breaker.open")
+            assert evs and evs[-1]["severity"] == "warn"
+            assert evs[-1]["fields"]["opcode"] == "cms_update"
+            assert evs[-1]["fields"]["kind"] == "cms"
+            # Let the window lapse, win the half-open probe slot, and
+            # report success: the close path must emit too.
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if dh.allow_dispatch("cms_update"):
+                    break
+                time.sleep(0.005)
+            dh.record_success("cms_update")
+            while time.monotonic() < deadline:
+                if obs.events.snapshot(kind="health.breaker.close"):
+                    break
+                time.sleep(0.005)
+            evs = obs.events.snapshot(kind="health.breaker.close")
+            assert evs and evs[-1]["fields"]["kind"] == "cms"
+        finally:
+            dh.shutdown()
+
+    def test_staleness_gate_emit(self, cluster2):
+        # Fake a replica link far behind its bound on node A, then a
+        # read must refuse with -STALEREAD and leave repl.stale_read.
+        server = cluster2.server("A")
+        key = cluster2.key_for("A", "stale")
+
+        class _Link:
+            def lag_ops(self):
+                return 999
+
+        server._client.config.repl_max_staleness_ops = 10
+        server.replica_link = _Link()
+        try:
+            err = _raw(cluster2.addr["A"], [("GET", key)])[0]
+            assert isinstance(err, ReplyError)
+            assert "STALEREAD" in str(err)
+        finally:
+            server.replica_link = None
+            server._client.config.repl_max_staleness_ops = 0
+        evs = server.obs.events.snapshot(kind="repl.stale_read")
+        assert evs and evs[-1]["fields"]["lag"] == 999

@@ -22,7 +22,7 @@ _EXPECT_RE = re.compile(r"#\s*rtpulint-expect:\s*(RT\d{3})")
 
 CHECKED_RULES = ("RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
                  "RT007", "RT008", "RT009", "RT011", "RT012", "RT013",
-                 "RT014")
+                 "RT014", "RT015")
 
 
 def _expected(path):
@@ -272,3 +272,21 @@ class TestParallelJobs:
         one, four = run("1"), run("4")
         assert one.returncode == four.returncode == 1
         assert one.stdout == four.stdout
+
+
+class TestRT015Catalog:
+    """The linter's literal kind mirror must track obs/events.py KINDS
+    exactly (both directions): a kind added to the catalog without the
+    mirror would lint-fail its own emit site, a kind added to the
+    mirror alone would let an unregistered emit through to a runtime
+    ValueError."""
+
+    def test_mirror_equals_catalog_both_ways(self):
+        from redisson_tpu.analysis.rtpulint import _RT015_KINDS
+        from redisson_tpu.obs.events import KINDS
+
+        assert set(_RT015_KINDS) == set(KINDS), (
+            "obs/events.py KINDS and rtpulint._RT015_KINDS drifted: "
+            f"catalog-only={sorted(set(KINDS) - set(_RT015_KINDS))} "
+            f"mirror-only={sorted(set(_RT015_KINDS) - set(KINDS))}"
+        )
